@@ -1,0 +1,38 @@
+"""accl_tpu: a TPU-native collective communication framework.
+
+A ground-up rebuild of the capabilities of ACCL (the Alveo Collective
+Communication Library, reference at /root/reference) for TPUs: an MPI-like
+API — send/recv, stream_put, copy, combine, bcast, scatter, gather,
+allgather, reduce, allreduce, reduce_scatter, alltoall, barrier — with
+communicators, eager/rendezvous transfer protocols, pluggable reduction
+arithmetic and dtype compression, an asynchronous request model, a
+device-free multi-process emulator backend for CI, and an XLA/ICI backend
+where collectives lower to jitted shard_map programs over a device mesh.
+
+Two API layers:
+
+* ``accl_tpu.ops`` — pure-functional JAX collectives over a Mesh (the
+  idiomatic TPU layer: shard_map + XLA collectives, explicit ring pipelines
+  via ppermute, Pallas kernels for the hot paths).
+* ``accl_tpu.ACCL`` — the stateful MPI-like facade with buffers, requests
+  and communicators, over the emulator or XLA backends.
+"""
+
+from .constants import (  # noqa: F401
+    ACCLError,
+    CompressionFlags,
+    DataType,
+    ErrorCode,
+    HostFlags,
+    Operation,
+    ReduceFunction,
+    StreamFlags,
+    Transport,
+)
+from .arithconfig import ArithConfig, DEFAULT_ARITH_CONFIG  # noqa: F401
+from .buffer import BaseBuffer, DummyBuffer, EmuBuffer  # noqa: F401
+from .communicator import Communicator, Rank  # noqa: F401
+from .core import ACCL, emulated_group, socket_group_member  # noqa: F401
+from .request import Request, RequestStatus  # noqa: F401
+
+__version__ = "0.1.0"

@@ -1,0 +1,84 @@
+"""Arithmetic/compression configuration.
+
+Plays the role of the reference's ``ArithConfig`` table
+(``driver/xrt/include/accl/arithconfig.hpp:32-119``): for each
+(uncompressed dtype, compressed dtype) pair it records element sizes, the
+ratio between them, and which reduction implementations are usable.  In the
+reference these map to hardware TDEST routes into the ``reduce_ops`` and
+``hp_compression`` kernels; here they select numpy/C++ reduction codepaths in
+the emulator and XLA reduction computations / cast stages on the TPU tier.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+from .constants import DataType, ReduceFunction, dtype_size
+
+
+@dataclasses.dataclass(frozen=True)
+class ArithConfig:
+    uncompressed: DataType
+    compressed: DataType
+    reduce_functions: Tuple[ReduceFunction, ...] = (
+        ReduceFunction.SUM,
+        ReduceFunction.MAX,
+    )
+
+    @property
+    def uncompressed_elem_bytes(self) -> int:
+        return dtype_size(self.uncompressed)
+
+    @property
+    def compressed_elem_bytes(self) -> int:
+        return dtype_size(self.compressed)
+
+    @property
+    def is_compressed(self) -> bool:
+        return self.uncompressed != self.compressed
+
+    @property
+    def elem_ratio(self) -> int:
+        """How many compressed elements fit in one uncompressed element's bytes."""
+        return max(1, self.uncompressed_elem_bytes // self.compressed_elem_bytes)
+
+    def supports(self, fn: ReduceFunction) -> bool:
+        return fn in self.reduce_functions
+
+
+def _identity(dt: DataType) -> ArithConfig:
+    return ArithConfig(dt, dt)
+
+
+# Default table: identity configs for every supported dtype plus the
+# fp32 -> fp16 wire-compression pair (ref arithconfig.hpp DEFAULT_ARITH_CONFIG),
+# extended with fp32 -> bf16 which is the natural TPU compression pair.
+DEFAULT_ARITH_CONFIG: Dict[Tuple[DataType, DataType], ArithConfig] = {
+    (DataType.FLOAT16, DataType.FLOAT16): _identity(DataType.FLOAT16),
+    (DataType.FLOAT32, DataType.FLOAT32): _identity(DataType.FLOAT32),
+    (DataType.FLOAT64, DataType.FLOAT64): _identity(DataType.FLOAT64),
+    (DataType.INT32, DataType.INT32): _identity(DataType.INT32),
+    (DataType.INT64, DataType.INT64): _identity(DataType.INT64),
+    (DataType.BFLOAT16, DataType.BFLOAT16): _identity(DataType.BFLOAT16),
+    (DataType.FLOAT32, DataType.FLOAT16): ArithConfig(
+        DataType.FLOAT32, DataType.FLOAT16
+    ),
+    (DataType.FLOAT32, DataType.BFLOAT16): ArithConfig(
+        DataType.FLOAT32, DataType.BFLOAT16
+    ),
+}
+
+
+def lookup(
+    table: Dict[Tuple[DataType, DataType], ArithConfig],
+    uncompressed: DataType,
+    compressed: DataType,
+) -> ArithConfig:
+    key = (uncompressed, compressed)
+    if key not in table:
+        raise KeyError(
+            f"no arithmetic configuration for dtype pair {uncompressed.name}"
+            f" -> {compressed.name}"
+        )
+    return table[key]

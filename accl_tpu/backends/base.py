@@ -1,0 +1,65 @@
+"""Backend interface: what the ACCL facade needs from a collective engine.
+
+Role model: the abstract device ``CCLO`` (``driver/xrt/include/accl/
+cclo.hpp:35-202``) with its ``Options`` record and
+``call/start/wait/test`` surface.  A backend owns the scheduling and data
+movement for one rank (emulator) or for a whole mesh (XLA tier).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ..arithconfig import ArithConfig
+from ..buffer import BaseBuffer
+from ..communicator import Communicator
+from ..constants import (
+    CompressionFlags,
+    HostFlags,
+    Operation,
+    ReduceFunction,
+    StreamFlags,
+)
+
+
+@dataclasses.dataclass
+class CallOptions:
+    """One engine call, fully resolved (ref ``CCLO::Options``)."""
+
+    op: Operation
+    comm: Optional[Communicator] = None
+    count: int = 0  # element count in *uncompressed* dtype
+    root_src: int = 0  # root / source rank (op-dependent)
+    root_dst: int = 0  # destination rank for send/recv
+    tag: int = 0
+    reduce_function: ReduceFunction = ReduceFunction.SUM
+    arithcfg: Optional[ArithConfig] = None
+    compression: CompressionFlags = CompressionFlags.NO_COMPRESSION
+    stream: StreamFlags = StreamFlags.NO_STREAM
+    host: HostFlags = HostFlags.NO_HOST
+    op0: Optional[BaseBuffer] = None
+    op1: Optional[BaseBuffer] = None
+    res: Optional[BaseBuffer] = None
+    stream_id: int = 0  # destination stream port for stream_put
+    # Operation.CONFIG only:
+    cfg_function: int = 0
+    cfg_value: float = 0.0
+
+
+class BaseEngine:
+    """One rank's collective engine."""
+
+    def start(self, options: CallOptions):
+        """Enqueue a call; returns a Request immediately."""
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        raise NotImplementedError
+
+    # -- device stream ports (stream_put / streaming operands) --------------
+    def stream_push(self, stream_id: int, data: bytes) -> None:
+        raise NotImplementedError
+
+    def stream_pop(self, stream_id: int, timeout: Optional[float] = None) -> bytes:
+        raise NotImplementedError

@@ -1,0 +1,2 @@
+from .engine import EmuEngine  # noqa: F401
+from .fabric import InProcFabric  # noqa: F401

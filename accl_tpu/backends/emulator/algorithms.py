@@ -1,0 +1,841 @@
+"""Collective algorithms for the emulated engine.
+
+This module is the TPU-build counterpart of the reference's control-plane
+firmware (``kernels/cclo/fw/sw_apps/ccl_offload_control/src/
+ccl_offload_control.c``) — every algorithm here names the firmware routine it
+re-implements.  Algorithms are Python generators: they ``yield`` wait
+conditions (see ``engine.py``) instead of recirculating through a retry queue,
+and return an ``ErrorCode``.
+
+Protocol selection matches the firmware rule (``send`` c:587, ``recv`` c:667,
+``broadcast`` c:808): rendezvous iff the transfer is larger than the eager
+threshold AND uses no compression AND no streams; otherwise eager (segmented,
+tag/seqn-matched through the RX buffer pool).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Generator, List, Optional
+
+import numpy as np
+
+from ...communicator import Communicator
+from ...constants import (
+    CompressionFlags,
+    DataType,
+    ErrorCode,
+    Operation,
+    ReduceFunction,
+    StreamFlags,
+    dtype_to_numpy,
+)
+from ..base import CallOptions
+from .dataplane import cast_array, cast_bytes, reduce_inplace
+from .fabric import Message, MsgType
+from .engine_conditions import (
+    SeekRx,
+    WaitRndzvDone,
+    WaitRndzvInit,
+    WaitStream,
+    Yield,
+)
+
+# NOTE on imports: engine.py imports this module; the wait-condition classes
+# live in engine_conditions.py to avoid a cycle.
+
+
+# ---------------------------------------------------------------------------
+# dtype / view helpers
+# ---------------------------------------------------------------------------
+
+
+def _wire_dtype(call: CallOptions) -> DataType:
+    cfg = call.arithcfg
+    if cfg is None:
+        return DataType.FLOAT32
+    if call.compression & CompressionFlags.ETH_COMPRESSED:
+        return cfg.compressed
+    return cfg.uncompressed
+
+
+def _acc_dtype(call: CallOptions) -> DataType:
+    """Accumulation dtype for reductions: always the uncompressed dtype."""
+    return call.arithcfg.uncompressed if call.arithcfg else DataType.FLOAT32
+
+
+def _op0_view(call: CallOptions, count: Optional[int] = None) -> np.ndarray:
+    n = call.count if count is None else count
+    return call.op0.device_view()[:n]
+
+
+def _op1_view(call: CallOptions, count: Optional[int] = None) -> np.ndarray:
+    n = call.count if count is None else count
+    return call.op1.device_view()[:n]
+
+
+def _res_view(call: CallOptions, count: Optional[int] = None) -> np.ndarray:
+    n = call.count if count is None else count
+    return call.res.device_view()[:n]
+
+
+def _seg_size(comm: Communicator, rank: int) -> int:
+    return comm.ranks[rank].max_segment_size
+
+
+def _use_rendezvous(eng, call: CallOptions, nbytes: int) -> bool:
+    return (
+        nbytes > eng.max_eager_size
+        and call.compression == CompressionFlags.NO_COMPRESSION
+        and call.stream == StreamFlags.NO_STREAM
+    )
+
+
+# ---------------------------------------------------------------------------
+# Point-to-point primitives
+# ---------------------------------------------------------------------------
+
+
+def eager_send(
+    eng, comm: Communicator, peer: int, tag: int, payload: bytes
+) -> Generator:
+    """Segmented eager send (ref firmware ``send`` eager path c:611-649:
+    pipelined segment moves with per-segment sequence numbers)."""
+    seg = _seg_size(comm, peer)
+    off, total = 0, len(payload)
+    first = True
+    while first or off < total:
+        first = False
+        chunk = payload[off : off + seg]
+        seqn = comm.next_outbound_seq(peer)
+        eng.post(
+            comm,
+            peer,
+            Message(
+                MsgType.EAGER,
+                comm.id,
+                comm.local_rank,
+                peer,
+                tag,
+                seqn=seqn,
+                count=len(chunk),
+                payload=chunk,
+            ),
+        )
+        off += seg
+        yield Yield()
+
+
+@dataclasses.dataclass
+class RecvHandle:
+    protocol: str  # "eager" | "rndzv"
+    peer: int
+    tag: int
+    nbytes: int  # wire bytes expected
+    nseg: int = 0  # eager: number of segments to match
+    vaddr: int = 0  # rndzv: registered write token
+    raw: Optional[bytearray] = None
+
+
+def eager_recv_post(
+    eng, comm: Communicator, peer: int, tag: int, wire_nbytes: int
+) -> RecvHandle:
+    """Plan a segmented eager receive.  Matching is strictly ordered per
+    peer: each segment seeks the communicator's *current* inbound sequence
+    number, which advances only on match (dma_mover.cpp:587-611)."""
+    seg = _seg_size(comm, comm.local_rank)
+    nseg = max(1, -(-wire_nbytes // seg))
+    return RecvHandle("eager", peer, tag, wire_nbytes, nseg=nseg)
+
+
+def eager_recv_wait(eng, comm: Communicator, handle: RecvHandle) -> Generator:
+    """Complete a posted eager receive; returns the raw wire bytes."""
+    out = bytearray()
+    for _ in range(handle.nseg):
+        buf = yield SeekRx(comm, handle.peer, handle.tag)
+        out += buf.msg.payload
+        eng.rx_pool.release(buf)
+    handle.raw = out
+    return bytes(out)
+
+
+def rndzv_recv_post(
+    eng, comm: Communicator, peer: int, tag: int, dst: np.ndarray
+) -> RecvHandle:
+    """Announce a writable address to the sender (ref ``recv`` rendezvous
+    path: ``rendezvous_send_addr`` c:142-150 + RNDZVS_INIT on the wire)."""
+    vaddr = eng.new_vaddr()
+    mem = dst.view(np.uint8).data
+    eng.endpoint.register_write_target(vaddr, mem)
+    eng.post(
+        comm,
+        peer,
+        Message(
+            MsgType.RNDZV_INIT,
+            comm.id,
+            comm.local_rank,
+            peer,
+            tag,
+            vaddr=vaddr,
+            count=dst.nbytes,
+        ),
+    )
+    return RecvHandle("rndzv", peer, tag, dst.nbytes, vaddr=vaddr)
+
+
+def rndzv_recv_wait(eng, comm: Communicator, handle: RecvHandle) -> Generator:
+    """Wait for the one-sided write completion (ref ``get_completion``
+    c:280-339)."""
+    yield WaitRndzvDone(comm.id, handle.peer, handle.tag, handle.vaddr)
+    return None
+
+
+def rndzv_send(
+    eng, comm: Communicator, peer: int, tag: int, payload: bytes
+) -> Generator:
+    """Wait for the peer's address announcement, then perform the one-sided
+    write (ref ``send`` rendezvous path c:587-610: ``rendezvous_get_addr`` +
+    RDMA WRITE via the packetizer)."""
+    init = yield WaitRndzvInit(comm.id, peer, tag)
+    eng.post(
+        comm,
+        peer,
+        Message(
+            MsgType.RNDZV_DATA,
+            comm.id,
+            comm.local_rank,
+            peer,
+            tag,
+            vaddr=init.vaddr,
+            count=len(payload),
+            payload=payload,
+        ),
+    )
+    return None
+
+
+# -- protocol-agnostic chunk send/recv --------------------------------------
+
+
+def send_chunk(
+    eng,
+    call: CallOptions,
+    comm: Communicator,
+    peer: int,
+    tag: int,
+    data: np.ndarray,
+) -> Generator:
+    """Send one logical chunk, choosing eager/rendezvous like the firmware."""
+    if _use_rendezvous(eng, call, data.nbytes):
+        yield from rndzv_send(eng, comm, peer, tag, data.tobytes())
+    else:
+        wire_dt = _wire_dtype(call)
+        payload = cast_array(data, wire_dt).tobytes()
+        yield from eager_send(eng, comm, peer, tag, payload)
+    return None
+
+
+def recv_chunk_post(
+    eng,
+    call: CallOptions,
+    comm: Communicator,
+    peer: int,
+    tag: int,
+    dst: np.ndarray,
+) -> RecvHandle:
+    if _use_rendezvous(eng, call, dst.nbytes):
+        return rndzv_recv_post(eng, comm, peer, tag, dst)
+    wire_dt = _wire_dtype(call)
+    wire_nbytes = dst.size * dtype_to_numpy(wire_dt).itemsize
+    return eager_recv_post(eng, comm, peer, tag, wire_nbytes)
+
+
+def recv_chunk_wait(
+    eng,
+    call: CallOptions,
+    comm: Communicator,
+    handle: RecvHandle,
+    dst: np.ndarray,
+) -> Generator:
+    if handle.protocol == "rndzv":
+        yield from rndzv_recv_wait(eng, comm, handle)
+    else:
+        raw = yield from eager_recv_wait(eng, comm, handle)
+        wire_dt = _wire_dtype(call)
+        arr = np.frombuffer(raw, dtype=dtype_to_numpy(wire_dt))[: dst.size]
+        np.copyto(dst, cast_array(arr, call_res_dtype_of(dst)))
+    return None
+
+
+def call_res_dtype_of(dst: np.ndarray) -> DataType:
+    from ...constants import numpy_to_dtype
+
+    return numpy_to_dtype(dst.dtype)
+
+
+def recv_chunk(
+    eng,
+    call: CallOptions,
+    comm: Communicator,
+    peer: int,
+    tag: int,
+    dst: np.ndarray,
+) -> Generator:
+    handle = recv_chunk_post(eng, call, comm, peer, tag, dst)
+    yield from recv_chunk_wait(eng, call, comm, handle, dst)
+    return None
+
+
+def recv_reduce_chunk(
+    eng,
+    call: CallOptions,
+    comm: Communicator,
+    peer: int,
+    tag: int,
+    acc: np.ndarray,
+) -> Generator:
+    """Receive a chunk and reduce it into ``acc`` (ref ``fused_recv_reduce``
+    c:716-749).  Rendezvous lands in a spare buffer first (ref TMP1-3)."""
+    if _use_rendezvous(eng, call, acc.nbytes):
+        tmp = np.empty_like(acc)
+        handle = rndzv_recv_post(eng, comm, peer, tag, tmp)
+        yield from rndzv_recv_wait(eng, comm, handle)
+        reduce_inplace(call.reduce_function, acc, tmp)
+    else:
+        handle = eager_recv_post(
+            eng,
+            comm,
+            peer,
+            tag,
+            acc.size * dtype_to_numpy(_wire_dtype(call)).itemsize,
+        )
+        raw = yield from eager_recv_wait(eng, comm, handle)
+        arr = np.frombuffer(raw, dtype=dtype_to_numpy(_wire_dtype(call)))[: acc.size]
+        reduce_inplace(call.reduce_function, acc, cast_array(arr, call_res_dtype_of(acc)))
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Operations
+# ---------------------------------------------------------------------------
+
+
+def op_nop(eng, call: CallOptions) -> Generator:
+    yield Yield()
+    return ErrorCode.OK
+
+
+def op_config(eng, call: CallOptions) -> Generator:
+    yield Yield()
+    return eng.apply_config(call)
+
+
+def _read_op0(eng, call: CallOptions) -> Generator:
+    """Operand 0 as a device array — from buffer or local stream port
+    (OP0_STREAM, the streaming-operand feature of ref ``accl_hls.h``)."""
+    if call.stream & StreamFlags.OP0_STREAM:
+        src_dt = (
+            call.arithcfg.compressed
+            if call.compression & CompressionFlags.OP0_COMPRESSED
+            else call.arithcfg.uncompressed
+        )
+        nbytes = call.count * dtype_to_numpy(src_dt).itemsize
+        raw = yield WaitStream(call.stream_id, nbytes)
+        return np.frombuffer(raw, dtype=dtype_to_numpy(src_dt))[: call.count]
+    return _op0_view(call)
+
+
+def _write_res(eng, call: CallOptions, data: np.ndarray) -> None:
+    """Result to buffer or local stream port (RES_STREAM)."""
+    if call.stream & StreamFlags.RES_STREAM:
+        res_dt = (
+            call.arithcfg.compressed
+            if call.compression & CompressionFlags.RES_COMPRESSED
+            else call.arithcfg.uncompressed
+        )
+        eng.streams.push(call.stream_id, cast_array(data, res_dt).tobytes())
+    else:
+        dst = _res_view(call)
+        np.copyto(dst, cast_array(data, call_res_dtype_of(dst)))
+
+
+def op_copy(eng, call: CallOptions) -> Generator:
+    """ref firmware ``copy`` c:531-547."""
+    data = yield from _read_op0(eng, call)
+    _write_res(eng, call, data)
+    return ErrorCode.OK
+
+
+def op_combine(eng, call: CallOptions) -> Generator:
+    """ref firmware ``combine`` c:551-569: res = fn(op0, op1)."""
+    if not call.arithcfg.supports(call.reduce_function):
+        return ErrorCode.ARITH_ERROR
+    a = yield from _read_op0(eng, call)
+    b = _op1_view(call)
+    acc_dt = _acc_dtype(call)
+    acc = cast_array(a, acc_dt).copy()
+    reduce_inplace(call.reduce_function, acc, cast_array(b, acc_dt))
+    _write_res(eng, call, acc)
+    return ErrorCode.OK
+
+
+def op_send(eng, call: CallOptions) -> Generator:
+    """ref firmware ``send`` c:573-649.  With RES_STREAM set this is
+    ``stream_put``: the payload is routed to the remote stream port
+    identified by ``stream_id`` instead of tag-matched RX buffers."""
+    comm, peer = call.comm, call.root_dst
+    data = yield from _read_op0(eng, call)
+    if call.stream & StreamFlags.RES_STREAM:
+        wire_dt = _wire_dtype(call)
+        payload = cast_array(data, wire_dt).tobytes()
+        seg = _seg_size(comm, peer)
+        for off in range(0, max(1, len(payload)), seg):
+            eng.post(
+                comm,
+                peer,
+                Message(
+                    MsgType.STREAM,
+                    comm.id,
+                    comm.local_rank,
+                    peer,
+                    call.tag,
+                    strm=call.stream_id,
+                    count=len(payload[off : off + seg]),
+                    payload=payload[off : off + seg],
+                ),
+            )
+            yield Yield()
+        return ErrorCode.OK
+    yield from send_chunk(eng, call, comm, peer, call.tag, np.asarray(data))
+    return ErrorCode.OK
+
+
+def op_recv(eng, call: CallOptions) -> Generator:
+    """ref firmware ``recv`` c:653-710."""
+    comm, peer = call.comm, call.root_src
+    if call.stream & StreamFlags.RES_STREAM:
+        # recv-to-stream: eager only; forward matched payloads to the port
+        handle = eager_recv_post(
+            eng,
+            comm,
+            peer,
+            call.tag,
+            call.count * dtype_to_numpy(_wire_dtype(call)).itemsize,
+        )
+        raw = yield from eager_recv_wait(eng, comm, handle)
+        eng.streams.push(call.stream_id, raw)
+        return ErrorCode.OK
+    dst = _res_view(call)
+    yield from recv_chunk(eng, call, comm, peer, call.tag, dst)
+    return ErrorCode.OK
+
+
+# -- collectives ------------------------------------------------------------
+
+
+def op_bcast(eng, call: CallOptions) -> Generator:
+    """ref firmware ``broadcast`` c:796-988: binomial-tree doubling for large
+    rendezvous worlds (c:815-867), flat root-fanout otherwise (c:869-987)."""
+    comm, root = call.comm, call.root_src
+    r, size = comm.local_rank, comm.size
+    if size == 1:
+        yield Yield()
+        return ErrorCode.OK
+    data_nbytes = call.count * dtype_to_numpy(_acc_dtype(call)).itemsize
+    use_tree = (
+        _use_rendezvous(eng, call, data_nbytes)
+        and size > eng.tuning["bcast_flat_tree_max_ranks"]
+    )
+    if not use_tree:
+        if r == root:
+            data = _op0_view(call)
+            for peer in range(size):
+                if peer != root:
+                    yield from send_chunk(eng, call, comm, peer, call.tag, data)
+        else:
+            dst = _res_view(call)
+            yield from recv_chunk(eng, call, comm, root, call.tag, dst)
+        return ErrorCode.OK
+    # binomial tree on root-relative ranks: node rel receives from its parent
+    # (rel with its highest bit cleared), then forwards to rel + 2^k for
+    # k = bit_length(rel).. while in range — the doubling scheme of c:815-867.
+    rel = (r - root) % size
+    buf = _op0_view(call) if r == root else _res_view(call)
+    if rel != 0:
+        parent_rel = rel - (1 << (rel.bit_length() - 1))
+        parent = (parent_rel + root) % size
+        yield from recv_chunk(eng, call, comm, parent, call.tag, buf)
+        k = rel.bit_length()
+    else:
+        k = 0
+    while rel + (1 << k) < size:
+        child = ((rel + (1 << k)) + root) % size
+        yield from send_chunk(eng, call, comm, child, call.tag, buf)
+        k += 1
+    return ErrorCode.OK
+
+
+def op_scatter(eng, call: CallOptions) -> Generator:
+    """ref firmware ``scatter`` c:992-1123: root fans out per-rank chunks
+    (MOVE_INCREMENT), non-roots receive one chunk."""
+    comm, root = call.comm, call.root_src
+    r, size, count = comm.local_rank, comm.size, call.count
+    if r == root:
+        src = _op0_view(call, size * count)
+        for peer in range(size):
+            chunk = src[peer * count : (peer + 1) * count]
+            if peer == root:
+                dst = _res_view(call)
+                np.copyto(dst, cast_array(chunk, call_res_dtype_of(dst)))
+                yield Yield()
+            else:
+                yield from send_chunk(eng, call, comm, peer, call.tag, chunk)
+    else:
+        dst = _res_view(call)
+        yield from recv_chunk(eng, call, comm, root, call.tag, dst)
+    return ErrorCode.OK
+
+
+def op_gather(eng, call: CallOptions) -> Generator:
+    """ref firmware ``gather`` c:1128-1294.  Eager tier: ring relay toward
+    the root (non-root sends its own block then relays everything arriving
+    from the next rank, c:1205-1293).  Rendezvous tier: flat fan-in with the
+    tuned window (c:1142-1204)."""
+    comm, root = call.comm, call.root_src
+    r, size, count = comm.local_rank, comm.size, call.count
+    if size == 1:
+        dst = _res_view(call)
+        np.copyto(dst, cast_array(_op0_view(call), call_res_dtype_of(dst)))
+        yield Yield()
+        return ErrorCode.OK
+    data_nbytes = count * dtype_to_numpy(_acc_dtype(call)).itemsize
+    if _use_rendezvous(eng, call, data_nbytes):
+        if r == root:
+            dst_all = _res_view(call, size * count)
+            np.copyto(
+                dst_all[root * count : (root + 1) * count], _op0_view(call)
+            )
+            window = (
+                eng.tuning["gather_flat_tree_max_fanin"]
+                if data_nbytes > eng.tuning["gather_flat_tree_max_count"]
+                else size
+            )
+            peers = [p for p in range(size) if p != root]
+            for i in range(0, len(peers), window):
+                batch = peers[i : i + window]
+                handles = [
+                    rndzv_recv_post(
+                        eng,
+                        comm,
+                        p,
+                        call.tag,
+                        dst_all[p * count : (p + 1) * count],
+                    )
+                    for p in batch
+                ]
+                for h in handles:
+                    yield from rndzv_recv_wait(eng, comm, h)
+        else:
+            yield from rndzv_send(
+                eng, comm, root, call.tag, _op0_view(call).tobytes()
+            )
+        return ErrorCode.OK
+    # eager ring relay toward root
+    rel = (r - root) % size
+    if rel == 0:
+        dst_all = _res_view(call, size * count)
+        np.copyto(dst_all[root * count : (root + 1) * count], _op0_view(call))
+        src_peer = (root + 1) % size
+        for i in range(size - 1):
+            origin = (root + 1 + i) % size
+            dst = dst_all[origin * count : (origin + 1) * count]
+            yield from recv_chunk(eng, call, comm, src_peer, call.tag, dst)
+    else:
+        fwd_peer = (r - 1) % size  # one hop closer to root
+        yield from send_chunk(
+            eng, call, comm, fwd_peer, call.tag, _op0_view(call)
+        )
+        relay_dt = _acc_dtype(call)
+        tmp = np.empty(count, dtype_to_numpy(relay_dt))
+        for _ in range(size - 1 - rel):
+            yield from recv_chunk(eng, call, comm, (r + 1) % size, call.tag, tmp)
+            yield from send_chunk(eng, call, comm, fwd_peer, call.tag, tmp)
+    return ErrorCode.OK
+
+
+def op_allgather(eng, call: CallOptions) -> Generator:
+    """ref firmware ``allgather`` c:1297-1503: ring store-and-relay with
+    strided placement (eager c:1402-1500; rendezvous ring c:1314-1401)."""
+    comm = call.comm
+    r, size, count = comm.local_rank, comm.size, call.count
+    dst_all = _res_view(call, size * count)
+    own = dst_all[r * count : (r + 1) * count]
+    np.copyto(own, cast_array(_op0_view(call), call_res_dtype_of(dst_all)))
+    if size == 1:
+        yield Yield()
+        return ErrorCode.OK
+    nxt, prv = comm.next_rank(), comm.prev_rank()
+    for step in range(size - 1):
+        send_origin = (r - step) % size
+        recv_origin = (r - step - 1) % size
+        recv_dst = dst_all[recv_origin * count : (recv_origin + 1) * count]
+        handle = recv_chunk_post(eng, call, comm, prv, call.tag, recv_dst)
+        yield from send_chunk(
+            eng,
+            call,
+            comm,
+            nxt,
+            call.tag,
+            dst_all[send_origin * count : (send_origin + 1) * count],
+        )
+        yield from recv_chunk_wait(eng, call, comm, handle, recv_dst)
+    return ErrorCode.OK
+
+
+def op_reduce(eng, call: CallOptions) -> Generator:
+    """ref firmware ``reduce`` c:1507-1744: size-1 shortcut (c:1520);
+    flat-tree accumulate for small comms/messages (c:1531-1602); binomial
+    tree for large rendezvous transfers (c:1603-1728); eager ring pipeline of
+    fused recv-reduce-send otherwise (c:1730-1743)."""
+    comm, root = call.comm, call.root_dst
+    r, size, count = comm.local_rank, comm.size, call.count
+    if not call.arithcfg.supports(call.reduce_function):
+        return ErrorCode.ARITH_ERROR
+    acc_dt = _acc_dtype(call)
+    npdt = dtype_to_numpy(acc_dt)
+    if size == 1:
+        dst = _res_view(call)
+        np.copyto(dst, cast_array(_op0_view(call), call_res_dtype_of(dst)))
+        yield Yield()
+        return ErrorCode.OK
+    data_nbytes = count * npdt.itemsize
+    rndzv = _use_rendezvous(eng, call, data_nbytes)
+    flat = size <= eng.tuning["reduce_flat_tree_max_ranks"] or data_nbytes <= (
+        eng.tuning["reduce_flat_tree_max_count"]
+    )
+    if rndzv and flat:
+        # flat tree: root accumulates everyone into spares
+        if r == root:
+            acc = cast_array(_op0_view(call), acc_dt).copy()
+            for peer in range(size):
+                if peer != root:
+                    yield from recv_reduce_chunk(
+                        eng, call, comm, peer, call.tag, acc
+                    )
+            _write_res(eng, call, acc)
+        else:
+            yield from send_chunk(eng, call, comm, root, call.tag, _op0_view(call))
+        return ErrorCode.OK
+    if rndzv:
+        # binomial reduction tree on root-relative ranks (c:1603-1728)
+        rel = (r - root) % size
+        acc = cast_array(_op0_view(call), acc_dt).copy()
+        k = 0
+        while (1 << k) < size:
+            if rel & (1 << k):
+                parent = ((rel - (1 << k)) + root) % size
+                yield from send_chunk(eng, call, comm, parent, call.tag, acc)
+                break
+            child_rel = rel + (1 << k)
+            if child_rel < size:
+                child = (child_rel + root) % size
+                yield from recv_reduce_chunk(eng, call, comm, child, call.tag, acc)
+            k += 1
+        if rel == 0:
+            _write_res(eng, call, acc)
+        return ErrorCode.OK
+    # eager ring pipeline: partials flow from the farthest rank toward root,
+    # fused recv-reduce-send at every hop (c:1730-1743)
+    rel = (r - root) % size
+    acc = cast_array(_op0_view(call), acc_dt).copy()
+    if rel == size - 1:
+        yield from send_chunk(
+            eng, call, comm, (r - 1) % size, call.tag, acc
+        )
+    else:
+        yield from recv_reduce_chunk(eng, call, comm, (r + 1) % size, call.tag, acc)
+        if rel != 0:
+            yield from send_chunk(eng, call, comm, (r - 1) % size, call.tag, acc)
+    if rel == 0:
+        _write_res(eng, call, acc)
+    return ErrorCode.OK
+
+
+def _block_bounds(total: int, parts: int) -> List[tuple]:
+    """Split ``total`` elements into ``parts`` contiguous blocks with the
+    tail spread over the leading blocks (ref allreduce tail handling
+    c:1900-1912)."""
+    base, tail = divmod(total, parts)
+    bounds = []
+    off = 0
+    for i in range(parts):
+        n = base + (1 if i < tail else 0)
+        bounds.append((off, off + n))
+        off += n
+    return bounds
+
+
+def op_reduce_scatter(eng, call: CallOptions) -> Generator:
+    """ref firmware ``reduce_scatter`` c:1748-1852: eager ring with strided
+    reads + fused recv-reduce (c:1782-1851); rendezvous composes reduce then
+    scatter (c:1768-1781)."""
+    comm = call.comm
+    r, size, count = comm.local_rank, comm.size, call.count
+    if not call.arithcfg.supports(call.reduce_function):
+        return ErrorCode.ARITH_ERROR
+    acc_dt = _acc_dtype(call)
+    npdt = dtype_to_numpy(acc_dt)
+    if size == 1:
+        dst = _res_view(call)
+        np.copyto(dst, cast_array(_op0_view(call), call_res_dtype_of(dst)))
+        yield Yield()
+        return ErrorCode.OK
+    acc = cast_array(_op0_view(call, size * count), acc_dt).copy()
+    nxt, prv = comm.next_rank(), comm.prev_rank()
+    for s in range(1, size):
+        send_c = (r - s) % size
+        recv_c = (r - 1 - s) % size
+        send_blk = acc[send_c * count : (send_c + 1) * count]
+        recv_blk = acc[recv_c * count : (recv_c + 1) * count]
+        if _use_rendezvous(eng, call, count * npdt.itemsize):
+            tmp = np.empty(count, npdt)
+            handle = rndzv_recv_post(eng, comm, prv, call.tag, tmp)
+            yield from send_chunk(eng, call, comm, nxt, call.tag, send_blk)
+            yield from rndzv_recv_wait(eng, comm, handle)
+            reduce_inplace(call.reduce_function, recv_blk, tmp)
+        else:
+            yield from send_chunk(eng, call, comm, nxt, call.tag, send_blk)
+            yield from recv_reduce_chunk(eng, call, comm, prv, call.tag, recv_blk)
+    _write_res(eng, call, acc[r * count : (r + 1) * count])
+    return ErrorCode.OK
+
+
+def op_allreduce(eng, call: CallOptions) -> Generator:
+    """ref firmware ``allreduce`` c:1855-2075.  Eager tier: segmented ring
+    reduce-scatter followed by ring allgather over ``size`` blocks with tail
+    handling (c:1888-2071).  Rendezvous tier: reduce to rank 0 + broadcast
+    (c:1878-1887)."""
+    comm = call.comm
+    r, size, count = comm.local_rank, comm.size, call.count
+    if not call.arithcfg.supports(call.reduce_function):
+        return ErrorCode.ARITH_ERROR
+    acc_dt = _acc_dtype(call)
+    npdt = dtype_to_numpy(acc_dt)
+    if size == 1:
+        dst = _res_view(call)
+        np.copyto(dst, cast_array(_op0_view(call), call_res_dtype_of(dst)))
+        yield Yield()
+        return ErrorCode.OK
+    acc = cast_array(_op0_view(call), acc_dt).copy()
+    bounds = _block_bounds(count, size)
+    nxt, prv = comm.next_rank(), comm.prev_rank()
+
+    def blk(i):
+        lo, hi = bounds[i % size]
+        return acc[lo:hi]
+
+    # phase 1: ring reduce-scatter over blocks
+    for s in range(1, size):
+        send_b, recv_b = blk(r - s), blk(r - 1 - s)
+        tmp = np.empty(recv_b.size, npdt)
+        handle = recv_chunk_post(eng, call, comm, prv, call.tag, tmp)
+        yield from send_chunk(eng, call, comm, nxt, call.tag, send_b)
+        yield from recv_chunk_wait(eng, call, comm, handle, tmp)
+        reduce_inplace(call.reduce_function, recv_b, tmp)
+    # phase 2: ring allgather over blocks (rank r now owns reduced block r)
+    for s in range(size - 1):
+        send_b, recv_b = blk(r - s), blk(r - 1 - s)
+        handle = recv_chunk_post(eng, call, comm, prv, call.tag, recv_b)
+        yield from send_chunk(eng, call, comm, nxt, call.tag, send_b)
+        yield from recv_chunk_wait(eng, call, comm, handle, recv_b)
+    _write_res(eng, call, acc)
+    return ErrorCode.OK
+
+
+def op_barrier(eng, call: CallOptions) -> Generator:
+    """ref firmware ``barrier`` c:2078-2120: zero-byte gather to rank 0 then
+    zero-byte broadcast back."""
+    comm = call.comm
+    r, size = comm.local_rank, comm.size
+    if size == 1:
+        yield Yield()
+        return ErrorCode.OK
+    tag = call.tag
+    if r == 0:
+        for peer in range(1, size):
+            h = eager_recv_post(eng, comm, peer, tag, 0)
+            yield from eager_recv_wait(eng, comm, h)
+        for peer in range(1, size):
+            yield from eager_send(eng, comm, peer, tag, b"")
+    else:
+        yield from eager_send(eng, comm, 0, tag, b"")
+        h = eager_recv_post(eng, comm, 0, tag, 0)
+        yield from eager_recv_wait(eng, comm, h)
+    return ErrorCode.OK
+
+
+def op_alltoall(eng, call: CallOptions) -> Generator:
+    """ref firmware ``all_to_all`` c:2123-2218: local copy + serve all peers,
+    completions taken out of order."""
+    comm = call.comm
+    r, size, count = comm.local_rank, comm.size, call.count
+    src_all = _op0_view(call, size * count)
+    dst_all = _res_view(call, size * count)
+    np.copyto(
+        dst_all[r * count : (r + 1) * count],
+        cast_array(src_all[r * count : (r + 1) * count], call_res_dtype_of(dst_all)),
+    )
+    if size == 1:
+        yield Yield()
+        return ErrorCode.OK
+    # post all receive addresses first (out-of-order service), then send
+    handles = {}
+    for peer in range(size):
+        if peer != r:
+            dst = dst_all[peer * count : (peer + 1) * count]
+            handles[peer] = recv_chunk_post(eng, call, comm, peer, call.tag, dst)
+    for off in range(1, size):
+        peer = (r + off) % size
+        yield from send_chunk(
+            eng,
+            call,
+            comm,
+            peer,
+            call.tag,
+            src_all[peer * count : (peer + 1) * count],
+        )
+    for peer, handle in handles.items():
+        dst = dst_all[peer * count : (peer + 1) * count]
+        yield from recv_chunk_wait(eng, call, comm, handle, dst)
+    return ErrorCode.OK
+
+
+_DISPATCH = {
+    Operation.NOP: op_nop,
+    Operation.CONFIG: op_config,
+    Operation.COPY: op_copy,
+    Operation.COMBINE: op_combine,
+    Operation.SEND: op_send,
+    Operation.RECV: op_recv,
+    Operation.BCAST: op_bcast,
+    Operation.SCATTER: op_scatter,
+    Operation.GATHER: op_gather,
+    Operation.ALLGATHER: op_allgather,
+    Operation.REDUCE: op_reduce,
+    Operation.ALLREDUCE: op_allreduce,
+    Operation.REDUCE_SCATTER: op_reduce_scatter,
+    Operation.ALLTOALL: op_alltoall,
+    Operation.BARRIER: op_barrier,
+}
+
+
+def dispatch(engine, options: CallOptions) -> Generator:
+    fn = _DISPATCH.get(options.op)
+    if fn is None:
+
+        def _unimpl():
+            yield Yield()
+            return ErrorCode.COLLECTIVE_NOT_IMPLEMENTED
+
+        return _unimpl()
+    return fn(engine, options)

@@ -1,0 +1,197 @@
+"""Emulator dataplane: eager RX buffers, reduction arithmetic, dtype casts,
+device stream ports.
+
+Role models in the reference:
+* RX buffer lifecycle + tag/src/seqn matching — ``kernels/cclo/hls/
+  rxbuf_offload/`` (enqueue/dequeue/seek/session).
+* Reduction arithmetic — ``kernels/plugins/reduce_ops/reduce_ops.cpp``
+  (SIMD SUM/MAX over {fp16, fp32, fp64, i32, i64}).
+* fp32<->fp16 wire compression — ``kernels/plugins/hp_compression/``.
+* Device stream ports — the CCLO's external-kernel AXIS ports used by
+  ``stream_put`` (``driver/hls/accl_hls.h``).
+
+Arithmetic is dispatched through the optional native C++ library
+(``accl_tpu.native``) when built, with a numpy fallback.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import queue
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...constants import DataType, ReduceFunction, dtype_to_numpy
+from .fabric import Message
+
+
+# ---------------------------------------------------------------------------
+# Eager RX buffer pool
+# ---------------------------------------------------------------------------
+
+
+class RxStatus(enum.IntEnum):
+    IDLE = 0
+    FILLED = 1  # payload landed, awaiting seek
+    CLAIMED = 2  # matched by a seek, being consumed
+
+
+@dataclasses.dataclass
+class RxBuffer:
+    index: int
+    size: int
+    status: RxStatus = RxStatus.IDLE
+    msg: Optional[Message] = None
+
+
+class RxBufferPool:
+    """Fixed pool of eager buffers with signature matching.
+
+    ``fill`` parks an arriving eager segment in an idle buffer (the role of
+    rxbuf_session + rxbuf_enqueue); ``seek`` matches {comm, src, tag, seqn}
+    against filled buffers (rxbuf_seek); ``release`` recycles.  When the pool
+    is exhausted the fill blocks — emulating link-level backpressure rather
+    than dropping, which is what the reference's dummy stacks do.
+    """
+
+    def __init__(self, count: int, size: int):
+        self._buffers = [RxBuffer(i, size) for i in range(count)]
+        self._cv = threading.Condition()
+
+    def fill(self, msg: Message, timeout: Optional[float] = None) -> bool:
+        with self._cv:
+            ok = self._cv.wait_for(
+                lambda: any(b.status == RxStatus.IDLE for b in self._buffers),
+                timeout,
+            )
+            if not ok:
+                return False
+            for b in self._buffers:
+                if b.status == RxStatus.IDLE:
+                    b.status = RxStatus.FILLED
+                    b.msg = msg
+                    self._cv.notify_all()
+                    return True
+        return False  # pragma: no cover
+
+    def seek(
+        self, comm_id: int, src: int, tag: int, seqn: int
+    ) -> Optional[RxBuffer]:
+        with self._cv:
+            for b in self._buffers:
+                m = b.msg
+                if (
+                    b.status == RxStatus.FILLED
+                    and m is not None
+                    and m.comm_id == comm_id
+                    and m.src == src
+                    and m.tag == tag
+                    and m.seqn == seqn
+                ):
+                    b.status = RxStatus.CLAIMED
+                    return b
+        return None
+
+    def release(self, buf: RxBuffer) -> None:
+        with self._cv:
+            buf.status = RxStatus.IDLE
+            buf.msg = None
+            self._cv.notify_all()
+
+    def occupancy(self) -> Tuple[int, int]:
+        with self._cv:
+            used = sum(1 for b in self._buffers if b.status != RxStatus.IDLE)
+            return used, len(self._buffers)
+
+    def dump(self) -> List[str]:
+        with self._cv:
+            out = []
+            for b in self._buffers:
+                desc = f"rxbuf[{b.index}] {b.status.name}"
+                if b.msg is not None:
+                    m = b.msg
+                    desc += (
+                        f" comm={m.comm_id} src={m.src} tag={m.tag}"
+                        f" seqn={m.seqn} bytes={len(m.payload)}"
+                    )
+                out.append(desc)
+            return out
+
+
+# ---------------------------------------------------------------------------
+# Reduction arithmetic + casts (numpy fallback; native C++ when available)
+# ---------------------------------------------------------------------------
+
+try:
+    from ... import native as _native
+except Exception:  # pragma: no cover - native lib is optional
+    _native = None
+
+
+def reduce_inplace(
+    fn: ReduceFunction, dst: np.ndarray, operand: np.ndarray
+) -> None:
+    """dst = dst (+|max) operand, elementwise, in place."""
+    if _native is not None and _native.available() and _native.reduce_inplace(
+        fn, dst, operand
+    ):
+        return
+    if fn == ReduceFunction.SUM:
+        np.add(dst, operand, out=dst)
+    elif fn == ReduceFunction.MAX:
+        np.maximum(dst, operand, out=dst)
+    else:
+        raise ValueError(f"unsupported reduce function {fn}")
+
+
+def cast_bytes(raw: bytes, src_dt: DataType, dst_dt: DataType) -> bytes:
+    """Decode raw element bytes in src_dt, re-encode in dst_dt (wire
+    compression/decompression stage)."""
+    if src_dt == dst_dt:
+        return raw
+    arr = np.frombuffer(raw, dtype=dtype_to_numpy(src_dt))
+    return arr.astype(dtype_to_numpy(dst_dt)).tobytes()
+
+
+def cast_array(arr: np.ndarray, dst_dt: DataType) -> np.ndarray:
+    npdt = dtype_to_numpy(dst_dt)
+    if arr.dtype == npdt:
+        return arr
+    return arr.astype(npdt)
+
+
+# ---------------------------------------------------------------------------
+# Device stream ports
+# ---------------------------------------------------------------------------
+
+
+class StreamPorts:
+    """Named FIFO ports standing in for the CCLO's external-kernel AXIS
+    streams.  ``stream_put`` payloads arriving with MsgType.STREAM bypass the
+    RX buffer pool and land here; local device "kernels" push operand data the
+    engine pulls when OP0_STREAM is set."""
+
+    def __init__(self):
+        self._ports: Dict[int, "queue.Queue[bytes]"] = {}
+        self._lock = threading.Lock()
+
+    def _port(self, stream_id: int) -> "queue.Queue[bytes]":
+        with self._lock:
+            if stream_id not in self._ports:
+                self._ports[stream_id] = queue.Queue()
+            return self._ports[stream_id]
+
+    def push(self, stream_id: int, data: bytes) -> None:
+        self._port(stream_id).put(data)
+
+    def pop(self, stream_id: int, timeout: Optional[float] = None) -> bytes:
+        return self._port(stream_id).get(timeout=timeout)
+
+    def try_pop(self, stream_id: int) -> Optional[bytes]:
+        try:
+            return self._port(stream_id).get_nowait()
+        except queue.Empty:
+            return None

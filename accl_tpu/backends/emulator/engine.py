@@ -1,0 +1,246 @@
+"""The emulated collective engine: a cooperative scheduler running collective
+algorithms over the fake wire.
+
+This is the TPU-build analog of the reference's control-plane firmware main
+loop (``ccl_offload_control.c:2308-2483``): calls arrive on a command queue,
+each executes as a *generator* that yields wait-conditions (see
+``engine_conditions.py``); calls whose condition is unmet are parked and
+re-polled round-robin — the same cooperative retry-queue semantics the
+firmware implements with ``NOT_READY_ERROR`` recirculation and
+``current_step`` resume state (``:2460-2478``), expressed idiomatically as
+Python coroutines instead of a hand-rolled step machine.
+
+One engine == one rank.  Data lives in numpy "device" memory; the dataplane
+(RX pool, reductions, casts, streams) is in ``dataplane.py``; the wire in
+``fabric.py``; the algorithms in ``algorithms.py``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+import traceback
+from typing import Callable, List, Optional
+
+from ...communicator import Communicator
+from ...constants import (
+    ConfigFunction,
+    DEFAULT_RX_BUFFER_COUNT,
+    DEFAULT_RX_BUFFER_SIZE,
+    DEFAULT_TIMEOUT_S,
+    EAGER_THRESHOLD_DEFAULT,
+    ErrorCode,
+    MAX_EAGER_SIZE_LIMIT,
+    TUNING_DEFAULTS,
+)
+from ...request import CommandQueue, Request
+from ..base import BaseEngine, CallOptions
+from . import algorithms
+from .dataplane import RxBufferPool, StreamPorts
+from .engine_conditions import WaitCondition
+from .fabric import Endpoint, Fabric, Message, MsgType
+
+
+class _CallTask:
+    __slots__ = ("request", "gen", "cond", "deadline", "started_ns")
+
+    def __init__(self, request: Request, gen, timeout_s: float):
+        self.request = request
+        self.gen = gen
+        self.cond: Optional[WaitCondition] = None
+        self.deadline = time.monotonic() + timeout_s
+        self.started_ns = time.perf_counter_ns()
+
+
+class EmuEngine(BaseEngine):
+    def __init__(
+        self,
+        fabric: Fabric,
+        address: str,
+        rx_buffer_count: int = DEFAULT_RX_BUFFER_COUNT,
+        rx_buffer_size: int = DEFAULT_RX_BUFFER_SIZE,
+    ):
+        self.fabric = fabric
+        self.address = address
+        self.endpoint = Endpoint()
+        fabric.attach(address, self.endpoint)
+        self.rx_pool = RxBufferPool(rx_buffer_count, rx_buffer_size)
+        self.streams = StreamPorts()
+        self.timeout_s = DEFAULT_TIMEOUT_S
+        self.max_eager_size = EAGER_THRESHOLD_DEFAULT
+        self.max_rendezvous_size = MAX_EAGER_SIZE_LIMIT
+        self.tuning = dict(TUNING_DEFAULTS)
+        self.transport_enabled = False
+
+        self._rndzv_inits: List[Message] = []
+        self._rndzv_done: List[Message] = []
+        self._notif_lock = threading.Lock()
+        self._vaddr_counter = itertools.count(1)
+
+        self._queue = CommandQueue()
+        self._wake = threading.Event()
+        self.endpoint.on_activity = self._wake.set
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._run, name=f"accl-engine-{address}", daemon=True
+        )
+        self._thread.start()
+
+    # -- public API ---------------------------------------------------------
+    def start(self, options: CallOptions) -> Request:
+        req = Request(op_name=options.op.name)
+        self._queue.push((req, options))
+        self._wake.set()
+        return req
+
+    def shutdown(self) -> None:
+        self._stop = True
+        self._wake.set()
+        self._thread.join(timeout=5)
+        self.fabric.close()
+
+    def stream_push(self, stream_id: int, data: bytes) -> None:
+        self.streams.push(stream_id, data)
+        self._wake.set()
+
+    def stream_pop(self, stream_id: int, timeout: Optional[float] = None) -> bytes:
+        return self.streams.pop(stream_id, timeout=timeout)
+
+    def new_vaddr(self) -> int:
+        return next(self._vaddr_counter)
+
+    # -- wire helpers used by algorithms ------------------------------------
+    def post(self, comm: Communicator, dst: int, msg: Message) -> None:
+        self.fabric.send(comm.ranks[dst].address, msg)
+
+    def take_rndzv_init(self, pred: Callable[[Message], bool]):
+        with self._notif_lock:
+            for i, m in enumerate(self._rndzv_inits):
+                if pred(m):
+                    return self._rndzv_inits.pop(i)
+        return None
+
+    def take_rndzv_done(self, pred: Callable[[Message], bool]):
+        with self._notif_lock:
+            for i, m in enumerate(self._rndzv_done):
+                if pred(m):
+                    return self._rndzv_done.pop(i)
+        return None
+
+    # -- debug dumps (ref ACCL::dump_eager_rx_buffers) -----------------------
+    def dump_rx_buffers(self) -> str:
+        return "\n".join(self.rx_pool.dump())
+
+    # -- scheduler ----------------------------------------------------------
+    def _route_inbox(self) -> None:
+        """Move arrived messages to their stations (the rxbuf_enqueue/dequeue
+        + depacketizer-routing roles).  EAGER messages stay in the inbox while
+        the pool is exhausted — backpressure, not drop."""
+        while True:
+            routed_any = False
+            msg = self.endpoint.take_matching(
+                lambda m: m.msg_type != MsgType.EAGER
+            )
+            if msg is not None:
+                routed_any = True
+                if msg.msg_type == MsgType.RNDZV_INIT:
+                    with self._notif_lock:
+                        self._rndzv_inits.append(msg)
+                elif msg.msg_type == MsgType.RNDZV_WR_DONE:
+                    with self._notif_lock:
+                        self._rndzv_done.append(msg)
+                elif msg.msg_type == MsgType.STREAM:
+                    self.streams.push(msg.strm, msg.payload)
+            used, total = self.rx_pool.occupancy()
+            if used < total:
+                emsg = self.endpoint.take_matching(
+                    lambda m: m.msg_type == MsgType.EAGER
+                )
+                if emsg is not None:
+                    routed_any = True
+                    self.rx_pool.fill(emsg, timeout=0)
+            if not routed_any:
+                return
+
+    def _run(self) -> None:
+        active: List[_CallTask] = []
+        while not self._stop:
+            while True:
+                item = self._queue.pop(timeout=0)
+                if item is None:
+                    break
+                req, options = item
+                req.mark_executing()
+                gen = algorithms.dispatch(self, options)
+                active.append(_CallTask(req, gen, self.timeout_s))
+
+            self._route_inbox()
+
+            progressed = False
+            now = time.monotonic()
+            for task in list(active):
+                value = None
+                if task.cond is not None:
+                    value = task.cond.poll(self)
+                    if value is None:
+                        if now > task.deadline:
+                            task.request.complete(
+                                task.cond.timeout_code,
+                                time.perf_counter_ns() - task.started_ns,
+                            )
+                            active.remove(task)
+                            progressed = True
+                        continue
+                    task.cond = None
+                try:
+                    task.cond = task.gen.send(value)
+                    progressed = True
+                except StopIteration as stop:
+                    ret = stop.value if stop.value is not None else ErrorCode.OK
+                    task.request.complete(
+                        ret, time.perf_counter_ns() - task.started_ns
+                    )
+                    active.remove(task)
+                    progressed = True
+                except Exception:
+                    traceback.print_exc()
+                    task.request.complete(
+                        ErrorCode.INVALID_OPERATION,
+                        time.perf_counter_ns() - task.started_ns,
+                    )
+                    active.remove(task)
+                    progressed = True
+
+            if not progressed:
+                self._wake.wait(timeout=0.001 if active else 0.05)
+                self._wake.clear()
+
+        self._queue.close()
+
+    # -- config ops (Operation.CONFIG) --------------------------------------
+    def apply_config(self, options: CallOptions) -> ErrorCode:
+        fn = ConfigFunction(options.cfg_function)
+        val = options.cfg_value
+        if fn == ConfigFunction.RESET:
+            with self._notif_lock:
+                self._rndzv_inits.clear()
+                self._rndzv_done.clear()
+            self.transport_enabled = False
+        elif fn == ConfigFunction.ENABLE_TRANSPORT:
+            self.transport_enabled = True
+        elif fn == ConfigFunction.SET_TIMEOUT:
+            if val <= 0:
+                return ErrorCode.CONFIG_ERROR
+            self.timeout_s = float(val)
+        elif fn == ConfigFunction.SET_MAX_EAGER_SIZE:
+            if not 0 < val <= MAX_EAGER_SIZE_LIMIT:
+                return ErrorCode.CONFIG_ERROR
+            self.max_eager_size = int(val)
+        elif fn == ConfigFunction.SET_MAX_RENDEZVOUS_SIZE:
+            if val <= 0:
+                return ErrorCode.CONFIG_ERROR
+            self.max_rendezvous_size = int(val)
+        else:
+            return ErrorCode.CONFIG_ERROR
+        return ErrorCode.OK

@@ -1,0 +1,113 @@
+"""Wait conditions yielded by algorithm generators to the engine scheduler.
+
+These are the cooperative-scheduling analog of the reference firmware's
+``NOT_READY_ERROR`` retry mechanism (``ccl_offload_control.c:2460-2478``): a
+parked call re-polls its condition each scheduler round instead of being
+recirculated through a hardware retry stream.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ...constants import ErrorCode
+from .fabric import Message
+
+
+class WaitCondition:
+    """Polled by the scheduler; returns a value when satisfied, None if not."""
+
+    timeout_code = ErrorCode.RECEIVE_TIMEOUT
+
+    def poll(self, engine):
+        raise NotImplementedError
+
+
+class SeekRx(WaitCondition):
+    """Match an eager segment {comm, src, tag, seqn} in the RX pool
+    (ref rxbuf_seek + the DMP MOVE_ON_RECV seek loop, dma_mover.cpp:587-611).
+
+    The expected sequence number is read from the communicator's inbound
+    counter at poll time and advanced only on a successful match — exactly
+    the reference semantics (seqn update at dma_mover.cpp:610), so a timed-
+    out receive leaves per-peer matching state clean."""
+
+    timeout_code = ErrorCode.RECEIVE_TIMEOUT
+
+    def __init__(self, comm, src: int, tag: int):
+        self.comm, self.src, self.tag = comm, src, tag
+
+    def poll(self, engine):
+        seqn = self.comm.peek_inbound_seq(self.src)
+        buf = engine.rx_pool.seek(self.comm.id, self.src, self.tag, seqn)
+        if buf is not None:
+            self.comm.advance_inbound_seq(self.src)
+        return buf
+
+
+class WaitRndzvInit(WaitCondition):
+    """Wait for a rendezvous address announcement from ``src`` (or any rank
+    when src is None) — ref ``rendezvous_get_addr`` / ``get_any_addr``
+    (ccl_offload_control.c:154-276)."""
+
+    timeout_code = ErrorCode.RENDEZVOUS_TIMEOUT
+
+    def __init__(self, comm_id: int, src: Optional[int], tag: int):
+        self.comm_id, self.src, self.tag = comm_id, src, tag
+
+    def poll(self, engine):
+        def pred(m: Message) -> bool:
+            return (
+                m.comm_id == self.comm_id
+                and m.tag == self.tag
+                and (self.src is None or m.src == self.src)
+            )
+
+        return engine.take_rndzv_init(pred)
+
+
+class WaitRndzvDone(WaitCondition):
+    """Wait for a write-completion notification — ref ``get_completion`` /
+    ``get_any_completion`` (ccl_offload_control.c:280-408)."""
+
+    timeout_code = ErrorCode.RENDEZVOUS_TIMEOUT
+
+    def __init__(self, comm_id: int, src: Optional[int], tag: int, vaddr: int):
+        self.comm_id, self.src, self.tag, self.vaddr = comm_id, src, tag, vaddr
+
+    def poll(self, engine):
+        def pred(m: Message) -> bool:
+            return (
+                m.comm_id == self.comm_id
+                and m.tag == self.tag
+                and m.vaddr == self.vaddr
+                and (self.src is None or m.src == self.src)
+            )
+
+        return engine.take_rndzv_done(pred)
+
+
+class WaitStream(WaitCondition):
+    """Accumulate ``nbytes`` from a local device stream port (OP0_STREAM)."""
+
+    timeout_code = ErrorCode.DMA_TIMEOUT
+
+    def __init__(self, stream_id: int, nbytes: int):
+        self.stream_id, self.nbytes = stream_id, nbytes
+        self._acc = b""
+
+    def poll(self, engine):
+        while len(self._acc) < self.nbytes:
+            chunk = engine.streams.try_pop(self.stream_id)
+            if chunk is None:
+                return None
+            self._acc += chunk
+        return self._acc[: self.nbytes]
+
+
+class Yield(WaitCondition):
+    """Cooperative yield: always ready.  Lets long segmented loops interleave
+    with other parked calls, like the firmware's bounded in-flight moves."""
+
+    def poll(self, engine):
+        return True

@@ -1,0 +1,235 @@
+"""The emulated wire: message format and transports between rank engines.
+
+Role model: the reference's ``eth_intf`` message header {count, tag, src,
+seqn, strm, dst, msg_type, host, vaddr} (``kernels/cclo/hls/eth_intf/
+eth_intf.h:114-151``) and the emulator's ZMQ pub/sub "ethernet"
+(``test/model/zmq/zmq_server.h:39-45``).  Two transports:
+
+* ``InProcFabric`` — rank engines in one process, per-rank thread-safe
+  inboxes.  This is the CI workhorse tier.
+* ``SocketFabric`` — one process per rank, length-prefixed messages over TCP
+  sockets (the multi-process tier, mirroring the reference's one-emulator-
+  process-per-rank layout).
+
+Message types follow the reference wire protocol (``eth_intf.h:42-45``):
+EAGER data messages, rendezvous INIT (address exchange) and WR_DONE
+(completion notification).  Rendezvous data is a one-sided write: the fabric
+delivers it straight into pre-registered receiver memory, then surfaces a
+WR_DONE notification — mirroring an RDMA WRITE executed by the NIC with no
+receiver-CPU involvement (``dummy_cyt_rdma_stack``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import pickle
+import socket
+import struct
+import threading
+from typing import Callable, Dict, List, Optional
+
+
+class MsgType(enum.IntEnum):
+    EAGER = 0  # tag/seqn-matched segment into an RX buffer
+    RNDZV_INIT = 2  # receiver announces a writable address
+    RNDZV_WR_DONE = 3  # write completed into receiver memory
+    RNDZV_DATA = 4  # the one-sided write itself (fabric-internal)
+    STREAM = 5  # routed directly to a device stream port
+
+
+@dataclasses.dataclass
+class Message:
+    msg_type: MsgType
+    comm_id: int
+    src: int  # sender rank within the communicator
+    dst: int  # destination rank within the communicator
+    tag: int
+    seqn: int = 0
+    vaddr: int = 0  # rendezvous buffer token
+    count: int = 0  # payload bytes (redundant w/ len(payload), kept for parity)
+    strm: int = 0  # stream id for MsgType.STREAM
+    payload: bytes = b""
+
+
+class Endpoint:
+    """Receiving side of a rank: inbox + rendezvous write registry.
+
+    The engine registers writable memory under a vaddr token; incoming
+    RNDZV_DATA is copied there by the fabric (the "NIC") and converted into a
+    WR_DONE notification in the inbox.
+    """
+
+    def __init__(self, deliver_cb: Optional[Callable[[Message], None]] = None):
+        self._lock = threading.Lock()
+        self._inbox: List[Message] = []
+        self._wr_registry: Dict[int, memoryview] = {}
+        self._deliver_cb = deliver_cb
+        self.on_activity: Optional[Callable[[], None]] = None
+
+    def register_write_target(self, vaddr: int, mem: memoryview) -> None:
+        with self._lock:
+            self._wr_registry[vaddr] = mem
+
+    def deliver(self, msg: Message) -> None:
+        if msg.msg_type == MsgType.RNDZV_DATA:
+            with self._lock:
+                mem = self._wr_registry.pop(msg.vaddr)
+            mem[: len(msg.payload)] = msg.payload
+            done = Message(
+                MsgType.RNDZV_WR_DONE,
+                msg.comm_id,
+                msg.src,
+                msg.dst,
+                msg.tag,
+                vaddr=msg.vaddr,
+                count=msg.count,
+            )
+            self._push(done)
+        else:
+            self._push(msg)
+
+    def _push(self, msg: Message) -> None:
+        with self._lock:
+            self._inbox.append(msg)
+        if self._deliver_cb is not None:
+            self._deliver_cb(msg)
+        if self.on_activity is not None:
+            self.on_activity()
+
+    def take_matching(self, pred: Callable[[Message], bool]) -> Optional[Message]:
+        """Remove and return the first inbox message satisfying ``pred``."""
+        with self._lock:
+            for i, m in enumerate(self._inbox):
+                if pred(m):
+                    return self._inbox.pop(i)
+        return None
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._inbox)
+
+
+class Fabric:
+    """Abstract transport: address -> endpoint delivery."""
+
+    def attach(self, address: str, endpoint: Endpoint) -> None:
+        raise NotImplementedError
+
+    def send(self, address: str, msg: Message) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class InProcFabric(Fabric):
+    """All ranks in one process; delivery is a direct endpoint call."""
+
+    def __init__(self):
+        self._endpoints: Dict[str, Endpoint] = {}
+        self._lock = threading.Lock()
+
+    def attach(self, address: str, endpoint: Endpoint) -> None:
+        with self._lock:
+            if address in self._endpoints:
+                raise ValueError(f"address {address} already attached")
+            self._endpoints[address] = endpoint
+
+    def send(self, address: str, msg: Message) -> None:
+        with self._lock:
+            ep = self._endpoints.get(address)
+        if ep is None:
+            raise KeyError(f"no endpoint at {address}")
+        ep.deliver(msg)
+
+
+class SocketFabric(Fabric):
+    """One process per rank; messages are pickled with a u32 length prefix.
+
+    Address format: ``"host:port"``.  Each fabric instance owns one listening
+    socket (this rank's address) and lazily opened client connections to
+    peers.  Mirrors the per-rank ZMQ endpoints of the reference emulator
+    (``test/model/emulator/run.py``).
+    """
+
+    def __init__(self, bind_address: str):
+        self._bind_address = bind_address
+        self._endpoint: Optional[Endpoint] = None
+        host, port = bind_address.rsplit(":", 1)
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, int(port)))
+        self._listener.listen(64)
+        self._conns: Dict[str, socket.socket] = {}
+        self._conn_lock = threading.Lock()
+        self._closing = False
+        self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._accept_thread.start()
+
+    def attach(self, address: str, endpoint: Endpoint) -> None:
+        if address != self._bind_address:
+            raise ValueError("socket fabric serves exactly its bind address")
+        self._endpoint = endpoint
+
+    def _accept_loop(self) -> None:
+        while not self._closing:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._recv_loop, args=(conn,), daemon=True
+            ).start()
+
+    def _recv_loop(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                hdr = self._recv_exact(conn, 4)
+                if hdr is None:
+                    return
+                (n,) = struct.unpack("<I", hdr)
+                body = self._recv_exact(conn, n)
+                if body is None:
+                    return
+                msg: Message = pickle.loads(body)
+                if self._endpoint is not None:
+                    self._endpoint.deliver(msg)
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _recv_exact(conn: socket.socket, n: int) -> Optional[bytes]:
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def send(self, address: str, msg: Message) -> None:
+        with self._conn_lock:
+            conn = self._conns.get(address)
+            if conn is None:
+                host, port = address.rsplit(":", 1)
+                conn = socket.create_connection((host, int(port)))
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._conns[address] = conn
+        body = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+        with self._conn_lock:
+            conn.sendall(struct.pack("<I", len(body)) + body)
+
+    def close(self) -> None:
+        self._closing = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._conn_lock:
+            for c in self._conns.values():
+                try:
+                    c.close()
+                except OSError:
+                    pass
+            self._conns.clear()

@@ -1,0 +1,161 @@
+"""Buffer abstraction: host/device arrays the engine moves data between.
+
+Role model: ``driver/xrt/include/accl/buffer.hpp:32-141`` (``BaseBuffer`` with
+``sync_to_device`` / ``sync_from_device`` / ``slice`` / ``address`` /
+``is_host_only``) and its backend implementations (XRTBuffer / SimBuffer /
+DummyBuffer).  TPU-natively, "device memory" is TPU HBM addressed through JAX
+arrays; on the emulator tier the device side is a distinct host allocation so
+that sync semantics stay observable (a test can detect a missing sync exactly
+like the reference suite does).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .constants import DataType, dtype_to_numpy, numpy_to_dtype
+
+
+class BaseBuffer:
+    """A typed 1-D region with a host view and a device residence."""
+
+    def __init__(self, count: int, dtype: DataType):
+        self._count = int(count)
+        self._dtype = DataType(dtype)
+
+    # -- introspection ------------------------------------------------------
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def dtype(self) -> DataType:
+        return self._dtype
+
+    @property
+    def nbytes(self) -> int:
+        return self._count * dtype_to_numpy(self._dtype).itemsize
+
+    @property
+    def is_dummy(self) -> bool:
+        return False
+
+    @property
+    def is_host_only(self) -> bool:
+        return False
+
+    # -- data movement ------------------------------------------------------
+    def sync_to_device(self) -> None:
+        raise NotImplementedError
+
+    def sync_from_device(self) -> None:
+        raise NotImplementedError
+
+    def free_buffer(self) -> None:
+        pass
+
+    # -- views --------------------------------------------------------------
+    def slice(self, start: int, stop: int) -> "BaseBuffer":
+        raise NotImplementedError
+
+    def host_view(self) -> np.ndarray:
+        """Host-side numpy view (mutating it mutates host memory)."""
+        raise NotImplementedError
+
+    def device_view(self) -> np.ndarray:
+        """Engine-side view of device memory (emulator tiers only)."""
+        raise NotImplementedError
+
+
+class EmuBuffer(BaseBuffer):
+    """Emulator-tier buffer: host and 'device' are separate host allocations.
+
+    The engine dataplane only ever touches ``device_view()``; user code writes
+    ``host_view()`` (or the ``data`` property) and must ``sync_to_device`` —
+    exactly the contract the reference tests rely on.  Slices alias the parent
+    storage on both sides.
+    """
+
+    def __init__(
+        self,
+        count: int,
+        dtype: DataType,
+        host: Optional[np.ndarray] = None,
+        dev: Optional[np.ndarray] = None,
+        host_only: bool = False,
+    ):
+        super().__init__(count, dtype)
+        npdt = dtype_to_numpy(dtype)
+        self._host = host if host is not None else np.zeros(count, npdt)
+        if host_only:
+            self._dev = self._host
+        else:
+            self._dev = dev if dev is not None else np.zeros(count, npdt)
+        self._host_only = host_only
+
+    @classmethod
+    def from_array(cls, arr: np.ndarray, host_only: bool = False) -> "EmuBuffer":
+        arr = np.ascontiguousarray(arr).reshape(-1)
+        return cls(arr.size, numpy_to_dtype(arr.dtype), host=arr, host_only=host_only)
+
+    @property
+    def is_host_only(self) -> bool:
+        return self._host_only
+
+    @property
+    def data(self) -> np.ndarray:
+        return self._host
+
+    def sync_to_device(self) -> None:
+        if not self._host_only:
+            np.copyto(self._dev, self._host)
+
+    def sync_from_device(self) -> None:
+        if not self._host_only:
+            np.copyto(self._host, self._dev)
+
+    def slice(self, start: int, stop: int) -> "EmuBuffer":
+        if not (0 <= start <= stop <= self._count):
+            raise IndexError(f"slice [{start}:{stop}) out of range 0..{self._count}")
+        return EmuBuffer(
+            stop - start,
+            self._dtype,
+            host=self._host[start:stop],
+            dev=self._dev[start:stop],
+            host_only=self._host_only,
+        )
+
+    def host_view(self) -> np.ndarray:
+        return self._host
+
+    def device_view(self) -> np.ndarray:
+        return self._dev
+
+
+class DummyBuffer(BaseBuffer):
+    """Placeholder operand for ranks that contribute no data to a collective
+    (ref ``driver/xrt/include/accl/dummybuffer.hpp``)."""
+
+    def __init__(self, count: int = 0, dtype: DataType = DataType.FLOAT32):
+        super().__init__(count, dtype)
+
+    @property
+    def is_dummy(self) -> bool:
+        return True
+
+    def sync_to_device(self) -> None:
+        pass
+
+    def sync_from_device(self) -> None:
+        pass
+
+    def slice(self, start: int, stop: int) -> "DummyBuffer":
+        return DummyBuffer(stop - start, self._dtype)
+
+    def host_view(self) -> np.ndarray:
+        raise RuntimeError("dummy buffer has no storage")
+
+    def device_view(self) -> np.ndarray:
+        raise RuntimeError("dummy buffer has no storage")

@@ -1,0 +1,234 @@
+"""Core vocabulary of the framework: operations, flags, error codes, dtypes.
+
+This mirrors the *semantic surface* of the reference's constant tables
+(``driver/xrt/include/accl/constants.hpp`` in bo3z/ACCL: op enum at :191-210,
+cfg functions :179-185, reduce functions :218-221, dataType :256-264,
+stream/host/compression flags :279-326, networkProtocol :334-338, errorCode
+bitmask :355-384) re-expressed for a TPU-native engine.  Values are our own;
+what matters for parity is the set of names and their meaning, which the test
+suite exercises.
+"""
+
+from __future__ import annotations
+
+import enum
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# Operations understood by the collective engine (the "CCLO" role).
+# ---------------------------------------------------------------------------
+
+
+class Operation(enum.IntEnum):
+    """Every callable scenario of the engine (ref constants.hpp:191-210)."""
+
+    CONFIG = 0
+    COPY = 1
+    COMBINE = 2
+    SEND = 3
+    RECV = 4
+    BCAST = 5
+    SCATTER = 6
+    GATHER = 7
+    REDUCE = 8
+    ALLGATHER = 9
+    ALLREDUCE = 10
+    REDUCE_SCATTER = 11
+    ALLTOALL = 12
+    BARRIER = 13
+    NOP = 14
+
+
+class ConfigFunction(enum.IntEnum):
+    """Sub-functions of Operation.CONFIG (ref constants.hpp:179-185)."""
+
+    RESET = 0
+    ENABLE_TRANSPORT = 1
+    SET_TIMEOUT = 2
+    SET_MAX_EAGER_SIZE = 3
+    SET_MAX_RENDEZVOUS_SIZE = 4
+
+
+class ReduceFunction(enum.IntEnum):
+    """Reduction arithmetic selector (ref constants.hpp:218-221)."""
+
+    SUM = 0
+    MAX = 1
+
+
+# ---------------------------------------------------------------------------
+# Data types.  The reference supports f16/f32/f64/i32/i64 (constants.hpp:256-264)
+# plus an f32->f16 compression pair; on TPU we add bfloat16 as a first-class
+# citizen since it is the native MXU dtype.
+# ---------------------------------------------------------------------------
+
+
+class DataType(enum.IntEnum):
+    NONE = 0
+    FLOAT16 = 1
+    FLOAT32 = 2
+    FLOAT64 = 3
+    INT32 = 4
+    INT64 = 5
+    BFLOAT16 = 6
+    INT8 = 7
+
+
+try:  # ml_dtypes ships with jax; bfloat16 numpy dtype lives there.
+    import ml_dtypes
+
+    _BFLOAT16 = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover - ml_dtypes is bundled with jax
+    _BFLOAT16 = np.dtype(np.float32)
+
+_DTYPE_TO_NUMPY = {
+    DataType.FLOAT16: np.dtype(np.float16),
+    DataType.FLOAT32: np.dtype(np.float32),
+    DataType.FLOAT64: np.dtype(np.float64),
+    DataType.INT32: np.dtype(np.int32),
+    DataType.INT64: np.dtype(np.int64),
+    DataType.BFLOAT16: _BFLOAT16,
+    DataType.INT8: np.dtype(np.int8),
+}
+
+_NUMPY_TO_DTYPE = {v: k for k, v in _DTYPE_TO_NUMPY.items()}
+
+
+def dtype_to_numpy(dt: DataType) -> np.dtype:
+    return _DTYPE_TO_NUMPY[dt]
+
+
+def numpy_to_dtype(dt) -> DataType:
+    dt = np.dtype(dt)
+    try:
+        return _NUMPY_TO_DTYPE[dt]
+    except KeyError:
+        raise ValueError(f"unsupported dtype {dt}") from None
+
+
+def dtype_size(dt: DataType) -> int:
+    return _DTYPE_TO_NUMPY[dt].itemsize
+
+
+# ---------------------------------------------------------------------------
+# Operand flags (ref constants.hpp:279-326).  streamFlags select whether an
+# operand comes from / goes to a device stream rather than a buffer;
+# compressionFlags select which operands are in the compressed dtype;
+# hostFlags mark operands living in host memory.
+# ---------------------------------------------------------------------------
+
+
+class StreamFlags(enum.IntFlag):
+    NO_STREAM = 0
+    OP0_STREAM = 1
+    RES_STREAM = 2
+
+
+class CompressionFlags(enum.IntFlag):
+    NO_COMPRESSION = 0
+    OP0_COMPRESSED = 1
+    OP1_COMPRESSED = 2
+    RES_COMPRESSED = 4
+    ETH_COMPRESSED = 8
+
+
+class HostFlags(enum.IntFlag):
+    NO_HOST = 0
+    OP0_HOST = 1
+    OP1_HOST = 2
+    RES_HOST = 4
+
+
+# ---------------------------------------------------------------------------
+# Transports.  The reference speaks UDP / TCP / RDMA over 100G Ethernet
+# (constants.hpp:334-338).  The TPU-native equivalents:
+#   INPROC  - in-process queues between rank engines (emulator CI tier)
+#   SOCKET  - TCP sockets between per-rank processes (emulator, multi-process)
+#   ICI     - XLA collectives over the TPU inter-chip interconnect
+#   DCN     - XLA collectives across slice boundaries (multi-slice)
+# ---------------------------------------------------------------------------
+
+
+class Transport(enum.IntEnum):
+    INPROC = 0
+    SOCKET = 1
+    ICI = 2
+    DCN = 3
+
+
+# ---------------------------------------------------------------------------
+# Error codes: a bitmask so multiple failures can be reported per call
+# (ref constants.hpp:355-384 defines 27 codes; we keep the ones meaningful
+# for a TPU engine and reserve the rest of the bit space).
+# ---------------------------------------------------------------------------
+
+
+class ErrorCode(enum.IntFlag):
+    OK = 0
+    DMA_MISMATCH = 1 << 0
+    DMA_TRANSACTION_ERROR = 1 << 1
+    DMA_TIMEOUT = 1 << 2
+    RECEIVE_TIMEOUT = 1 << 3
+    SEND_TIMEOUT = 1 << 4
+    COLLECTIVE_NOT_IMPLEMENTED = 1 << 5
+    RECEIVE_OFFCHIP_UNSUPPORTED = 1 << 6
+    INVALID_COMM = 1 << 7
+    INVALID_RANK = 1 << 8
+    INVALID_COUNT = 1 << 9
+    INVALID_TAG = 1 << 10
+    INVALID_OPERATION = 1 << 11
+    INVALID_DTYPE = 1 << 12
+    ARITH_ERROR = 1 << 13
+    COMPRESSION_ERROR = 1 << 14
+    SEGMENT_TOO_LARGE = 1 << 15
+    RX_BUFFER_EXHAUSTED = 1 << 16
+    RENDEZVOUS_TIMEOUT = 1 << 17
+    TRANSPORT_ERROR = 1 << 18
+    NOT_READY = 1 << 19  # internal: call must be retried (never surfaced)
+    DEADLOCK_SUSPECTED = 1 << 20
+    CONFIG_ERROR = 1 << 21
+
+    @staticmethod
+    def describe(code: "ErrorCode") -> str:
+        if code == ErrorCode.OK:
+            return "no error"
+        names = [f.name for f in ErrorCode if f and (code & f)]
+        return " | ".join(names)
+
+
+class ACCLError(RuntimeError):
+    """Raised by check_return_value when a call completes with errors.
+
+    Mirrors the exception surface of the reference host driver
+    (``driver/xrt/src/accl.cpp:1210-1234`` check_return_value).
+    """
+
+    def __init__(self, code: ErrorCode, context: str = ""):
+        self.code = ErrorCode(code)
+        msg = f"ACCL call failed [{ErrorCode.describe(self.code)}]"
+        if context:
+            msg += f" during {context}"
+        super().__init__(msg)
+
+
+# ---------------------------------------------------------------------------
+# Engine defaults (ref accl.hpp:102-104 and ccl_offload_control.c:27-28).
+# ---------------------------------------------------------------------------
+
+TAG_ANY = 0xFFFFFFFF
+EAGER_THRESHOLD_DEFAULT = 32 * 1024  # bytes; above this, rendezvous
+MAX_EAGER_SIZE_LIMIT = 16 * 1024 * 1024
+DEFAULT_RX_BUFFER_COUNT = 16
+DEFAULT_RX_BUFFER_SIZE = 4 * 1024  # bytes per eager RX buffer / segment
+DEFAULT_TIMEOUT_S = 30.0
+
+# Tuning-parameter surface (ref ccl_offload_control.h:86-90, accl.cpp:1198-1208):
+# thresholds steering flat-tree vs binary-tree vs ring algorithm selection.
+TUNING_DEFAULTS = {
+    "gather_flat_tree_max_fanin": 2,
+    "gather_flat_tree_max_count": 32 * 1024,
+    "bcast_flat_tree_max_ranks": 3,
+    "reduce_flat_tree_max_ranks": 4,
+    "reduce_flat_tree_max_count": 8 * 1024,
+}

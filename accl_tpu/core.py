@@ -1,0 +1,659 @@
+"""The ACCL facade: user-facing MPI-like API over a collective engine.
+
+Role model: ``class ACCL`` in ``driver/xrt/include/accl.hpp:45-1131`` /
+``src/accl.cpp`` — all collectives, buffer factories, communicator
+management, request objects, config surface, debug dumps.  Call preparation
+(dtype -> arithmetic config resolution, compression flags) mirrors
+``prepare_call`` (accl.cpp:1236-1356); the sync path mirrors
+``call_sync`` + ``check_return_value`` (accl.cpp:1379-1397, 1210-1234).
+
+Ops default to synchronous; pass ``run_async=True`` to get the Request and
+overlap calls (the reference's ``run_async`` flag).
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+from .arithconfig import DEFAULT_ARITH_CONFIG, ArithConfig
+from .backends.base import BaseEngine, CallOptions
+from .buffer import BaseBuffer, DummyBuffer, EmuBuffer
+from .communicator import Communicator, Rank
+from .constants import (
+    ACCLError,
+    CompressionFlags,
+    ConfigFunction,
+    DataType,
+    DEFAULT_RX_BUFFER_SIZE,
+    ErrorCode,
+    HostFlags,
+    Operation,
+    ReduceFunction,
+    StreamFlags,
+    numpy_to_dtype,
+)
+from .request import Request
+
+DTypeLike = Union[DataType, str, np.dtype, type]
+
+
+def _as_datatype(dt: DTypeLike) -> DataType:
+    if isinstance(dt, DataType):
+        return dt
+    return numpy_to_dtype(np.dtype(dt))
+
+
+class ACCL:
+    """One rank's handle onto the collective engine."""
+
+    def __init__(
+        self,
+        engine: BaseEngine,
+        ranks: Sequence[Rank],
+        local_rank: int,
+        arith_config: Optional[dict] = None,
+        timeout_s: float = 30.0,
+        max_eager_size: int = 32 * 1024,
+        max_rendezvous_size: int = 16 * 1024 * 1024,
+    ):
+        self.engine = engine
+        self._arith = dict(arith_config or DEFAULT_ARITH_CONFIG)
+        self._world = Communicator(ranks, local_rank, comm_id=0)
+        self._communicators: List[Communicator] = [self._world]
+        self._initialized = False
+        self._initialize(timeout_s, max_eager_size, max_rendezvous_size)
+
+    # -- init sequence (ref ACCL::initialize, accl.cpp:1066-1114) ------------
+    def _initialize(
+        self, timeout_s: float, max_eager_size: int, max_rendezvous_size: int
+    ) -> None:
+        self._config(ConfigFunction.RESET, 0)
+        self._config(ConfigFunction.SET_TIMEOUT, timeout_s)
+        self._config(ConfigFunction.SET_MAX_EAGER_SIZE, max_eager_size)
+        self._config(ConfigFunction.SET_MAX_RENDEZVOUS_SIZE, max_rendezvous_size)
+        self._config(ConfigFunction.ENABLE_TRANSPORT, 1)
+        self._initialized = True
+
+    def _config(self, fn: ConfigFunction, value: float) -> None:
+        req = self.engine.start(
+            CallOptions(op=Operation.CONFIG, cfg_function=int(fn), cfg_value=value)
+        )
+        req.wait()
+        req.check(f"config {fn.name}")
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def comm(self) -> Communicator:
+        return self._world
+
+    @property
+    def rank(self) -> int:
+        return self._world.local_rank
+
+    @property
+    def size(self) -> int:
+        return self._world.size
+
+    # -- config surface ------------------------------------------------------
+    def set_timeout(self, seconds: float) -> None:
+        self._config(ConfigFunction.SET_TIMEOUT, seconds)
+
+    def set_max_eager_size(self, nbytes: int) -> None:
+        self._config(ConfigFunction.SET_MAX_EAGER_SIZE, nbytes)
+
+    def set_max_rendezvous_size(self, nbytes: int) -> None:
+        self._config(ConfigFunction.SET_MAX_RENDEZVOUS_SIZE, nbytes)
+
+    # -- buffer factories (ref ACCL::create_buffer family) -------------------
+    def create_buffer(
+        self, count: int, dtype: DTypeLike, host_only: bool = False
+    ) -> EmuBuffer:
+        return EmuBuffer(count, _as_datatype(dtype), host_only=host_only)
+
+    def create_buffer_from(
+        self, array: np.ndarray, host_only: bool = False
+    ) -> EmuBuffer:
+        buf = EmuBuffer.from_array(np.asarray(array), host_only=host_only)
+        buf.sync_to_device()
+        return buf
+
+    # -- communicator management --------------------------------------------
+    def create_communicator(
+        self, members: Sequence[int], base: Optional[Communicator] = None
+    ) -> Optional[Communicator]:
+        """Collective: every member calls with the same ``members`` list.
+
+        The new communicator id is derived deterministically from the parent
+        id + membership, so all ranks (including separate processes on the
+        socket tier) agree without extra wire traffic.
+        """
+        base = base or self._world
+        comm_id = zlib.crc32(repr((base.id, tuple(members))).encode()) & 0x7FFFFFFF
+        comm = base.split(members, comm_id=comm_id)
+        if comm is not None:
+            self._communicators.append(comm)
+        return comm
+
+    # -- call plumbing -------------------------------------------------------
+    def _resolve_arithcfg(
+        self, dtype: DataType, compress_dtype: Optional[DTypeLike]
+    ) -> tuple:
+        """(arithcfg, compression flags) from operand dtype + requested wire
+        compression (ref prepare_call's arithcfg address resolution)."""
+        if compress_dtype is None:
+            key = (dtype, dtype)
+            flags = CompressionFlags.NO_COMPRESSION
+        else:
+            cdt = _as_datatype(compress_dtype)
+            key = (dtype, cdt)
+            flags = (
+                CompressionFlags.ETH_COMPRESSED
+                if cdt != dtype
+                else CompressionFlags.NO_COMPRESSION
+            )
+        if key not in self._arith:
+            raise ACCLError(
+                ErrorCode.INVALID_DTYPE,
+                f"no arithmetic config for {key[0].name}->{key[1].name}",
+            )
+        return self._arith[key], flags
+
+    def _host_flags(self, *bufs: Optional[BaseBuffer]) -> HostFlags:
+        flags = HostFlags.NO_HOST
+        slots = (HostFlags.OP0_HOST, HostFlags.OP1_HOST, HostFlags.RES_HOST)
+        for slot, buf in zip(slots, bufs):
+            if buf is not None and buf.is_host_only:
+                flags |= slot
+        return flags
+
+    def _launch(
+        self, options: CallOptions, run_async: bool, context: str
+    ) -> Optional[Request]:
+        req = self.engine.start(options)
+        if run_async:
+            return req
+        if not req.wait(timeout=max(60.0, 4 * 30.0)):
+            raise ACCLError(ErrorCode.DEADLOCK_SUSPECTED, context)
+        req.check(context)
+        return req
+
+    @staticmethod
+    def _check_rank(comm: Communicator, rank: int) -> None:
+        if not 0 <= rank < comm.size:
+            raise ACCLError(ErrorCode.INVALID_RANK, f"rank {rank}")
+
+    @staticmethod
+    def _count_of(buf: BaseBuffer, count: Optional[int]) -> int:
+        n = buf.count if count is None else int(count)
+        if n < 0:
+            raise ACCLError(ErrorCode.INVALID_COUNT, f"count {n}")
+        return n
+
+    def get_duration(self, request: Request) -> int:
+        """Engine-measured call duration in ns (ref ACCL::get_duration)."""
+        return request.get_duration_ns()
+
+    # -- primitives ----------------------------------------------------------
+    def nop(self, run_async: bool = False):
+        return self._launch(CallOptions(op=Operation.NOP), run_async, "nop")
+
+    def copy(
+        self,
+        srcbuf: BaseBuffer,
+        dstbuf: BaseBuffer,
+        count: Optional[int] = None,
+        run_async: bool = False,
+    ):
+        n = self._count_of(srcbuf, count)
+        cfg, flags = self._resolve_arithcfg(srcbuf.dtype, None)
+        opts = CallOptions(
+            op=Operation.COPY,
+            comm=self._world,
+            count=n,
+            arithcfg=cfg,
+            compression=flags,
+            host=self._host_flags(srcbuf, None, dstbuf),
+            op0=srcbuf,
+            res=dstbuf,
+        )
+        return self._launch(opts, run_async, "copy")
+
+    def combine(
+        self,
+        function: ReduceFunction,
+        op0: BaseBuffer,
+        op1: BaseBuffer,
+        res: BaseBuffer,
+        count: Optional[int] = None,
+        run_async: bool = False,
+    ):
+        n = self._count_of(op0, count)
+        cfg, flags = self._resolve_arithcfg(op0.dtype, None)
+        opts = CallOptions(
+            op=Operation.COMBINE,
+            comm=self._world,
+            count=n,
+            reduce_function=function,
+            arithcfg=cfg,
+            compression=flags,
+            host=self._host_flags(op0, op1, res),
+            op0=op0,
+            op1=op1,
+            res=res,
+        )
+        return self._launch(opts, run_async, "combine")
+
+    # -- point-to-point ------------------------------------------------------
+    def send(
+        self,
+        srcbuf: BaseBuffer,
+        count: Optional[int],
+        dst: int,
+        tag: int = 0,
+        comm: Optional[Communicator] = None,
+        compress_dtype: Optional[DTypeLike] = None,
+        from_stream: bool = False,
+        stream_id: int = 0,
+        run_async: bool = False,
+    ):
+        comm = comm or self._world
+        self._check_rank(comm, dst)
+        dtype = srcbuf.dtype if srcbuf is not None else DataType.FLOAT32
+        n = self._count_of(srcbuf, count) if srcbuf is not None else int(count)
+        cfg, flags = self._resolve_arithcfg(dtype, compress_dtype)
+        stream = StreamFlags.OP0_STREAM if from_stream else StreamFlags.NO_STREAM
+        opts = CallOptions(
+            op=Operation.SEND,
+            comm=comm,
+            count=n,
+            root_dst=dst,
+            tag=tag,
+            arithcfg=cfg,
+            compression=flags,
+            stream=stream,
+            stream_id=stream_id,
+            host=self._host_flags(srcbuf),
+            op0=srcbuf if srcbuf is not None else DummyBuffer(n, dtype),
+        )
+        return self._launch(opts, run_async, "send")
+
+    def recv(
+        self,
+        dstbuf: BaseBuffer,
+        count: Optional[int],
+        src: int,
+        tag: int = 0,
+        comm: Optional[Communicator] = None,
+        compress_dtype: Optional[DTypeLike] = None,
+        to_stream: bool = False,
+        stream_id: int = 0,
+        run_async: bool = False,
+    ):
+        comm = comm or self._world
+        self._check_rank(comm, src)
+        dtype = dstbuf.dtype if dstbuf is not None else DataType.FLOAT32
+        n = self._count_of(dstbuf, count) if dstbuf is not None else int(count)
+        cfg, flags = self._resolve_arithcfg(dtype, compress_dtype)
+        stream = StreamFlags.RES_STREAM if to_stream else StreamFlags.NO_STREAM
+        opts = CallOptions(
+            op=Operation.RECV,
+            comm=comm,
+            count=n,
+            root_src=src,
+            tag=tag,
+            arithcfg=cfg,
+            compression=flags,
+            stream=stream,
+            stream_id=stream_id,
+            host=self._host_flags(None, None, dstbuf),
+            res=dstbuf if dstbuf is not None else DummyBuffer(n, dtype),
+        )
+        return self._launch(opts, run_async, "recv")
+
+    def stream_put(
+        self,
+        srcbuf: BaseBuffer,
+        count: Optional[int],
+        dst: int,
+        stream_id: int,
+        tag: int = 0,
+        comm: Optional[Communicator] = None,
+        run_async: bool = False,
+    ):
+        """Send straight into the destination rank's device stream port —
+        the reference's ``stream_put`` (accl.hpp / accl_hls.h:277-298), used
+        by device kernels to receive data without tag matching."""
+        comm = comm or self._world
+        self._check_rank(comm, dst)
+        n = self._count_of(srcbuf, count)
+        cfg, flags = self._resolve_arithcfg(srcbuf.dtype, None)
+        opts = CallOptions(
+            op=Operation.SEND,
+            comm=comm,
+            count=n,
+            root_dst=dst,
+            tag=tag,
+            arithcfg=cfg,
+            compression=flags,
+            stream=StreamFlags.RES_STREAM,
+            stream_id=stream_id,
+            op0=srcbuf,
+        )
+        return self._launch(opts, run_async, "stream_put")
+
+    # -- collectives ---------------------------------------------------------
+    def bcast(
+        self,
+        buf: BaseBuffer,
+        count: Optional[int] = None,
+        root: int = 0,
+        comm: Optional[Communicator] = None,
+        compress_dtype: Optional[DTypeLike] = None,
+        run_async: bool = False,
+    ):
+        comm = comm or self._world
+        self._check_rank(comm, root)
+        n = self._count_of(buf, count)
+        cfg, flags = self._resolve_arithcfg(buf.dtype, compress_dtype)
+        opts = CallOptions(
+            op=Operation.BCAST,
+            comm=comm,
+            count=n,
+            root_src=root,
+            arithcfg=cfg,
+            compression=flags,
+            host=self._host_flags(buf, None, buf),
+            op0=buf,
+            res=buf,
+        )
+        return self._launch(opts, run_async, "bcast")
+
+    def scatter(
+        self,
+        sendbuf: Optional[BaseBuffer],
+        recvbuf: BaseBuffer,
+        count: Optional[int] = None,
+        root: int = 0,
+        comm: Optional[Communicator] = None,
+        compress_dtype: Optional[DTypeLike] = None,
+        run_async: bool = False,
+    ):
+        comm = comm or self._world
+        self._check_rank(comm, root)
+        n = self._count_of(recvbuf, count)
+        cfg, flags = self._resolve_arithcfg(recvbuf.dtype, compress_dtype)
+        opts = CallOptions(
+            op=Operation.SCATTER,
+            comm=comm,
+            count=n,
+            root_src=root,
+            arithcfg=cfg,
+            compression=flags,
+            host=self._host_flags(sendbuf, None, recvbuf),
+            op0=sendbuf if sendbuf is not None else DummyBuffer(0, recvbuf.dtype),
+            res=recvbuf,
+        )
+        return self._launch(opts, run_async, "scatter")
+
+    def gather(
+        self,
+        sendbuf: BaseBuffer,
+        recvbuf: Optional[BaseBuffer],
+        count: Optional[int] = None,
+        root: int = 0,
+        comm: Optional[Communicator] = None,
+        compress_dtype: Optional[DTypeLike] = None,
+        run_async: bool = False,
+    ):
+        comm = comm or self._world
+        self._check_rank(comm, root)
+        n = self._count_of(sendbuf, count)
+        cfg, flags = self._resolve_arithcfg(sendbuf.dtype, compress_dtype)
+        opts = CallOptions(
+            op=Operation.GATHER,
+            comm=comm,
+            count=n,
+            root_src=root,
+            arithcfg=cfg,
+            compression=flags,
+            host=self._host_flags(sendbuf, None, recvbuf),
+            op0=sendbuf,
+            res=recvbuf if recvbuf is not None else DummyBuffer(0, sendbuf.dtype),
+        )
+        return self._launch(opts, run_async, "gather")
+
+    def allgather(
+        self,
+        sendbuf: BaseBuffer,
+        recvbuf: BaseBuffer,
+        count: Optional[int] = None,
+        comm: Optional[Communicator] = None,
+        compress_dtype: Optional[DTypeLike] = None,
+        run_async: bool = False,
+    ):
+        comm = comm or self._world
+        n = self._count_of(sendbuf, count)
+        cfg, flags = self._resolve_arithcfg(sendbuf.dtype, compress_dtype)
+        opts = CallOptions(
+            op=Operation.ALLGATHER,
+            comm=comm,
+            count=n,
+            arithcfg=cfg,
+            compression=flags,
+            host=self._host_flags(sendbuf, None, recvbuf),
+            op0=sendbuf,
+            res=recvbuf,
+        )
+        return self._launch(opts, run_async, "allgather")
+
+    def reduce(
+        self,
+        sendbuf: BaseBuffer,
+        recvbuf: Optional[BaseBuffer],
+        count: Optional[int] = None,
+        root: int = 0,
+        function: ReduceFunction = ReduceFunction.SUM,
+        comm: Optional[Communicator] = None,
+        compress_dtype: Optional[DTypeLike] = None,
+        run_async: bool = False,
+    ):
+        comm = comm or self._world
+        self._check_rank(comm, root)
+        n = self._count_of(sendbuf, count)
+        cfg, flags = self._resolve_arithcfg(sendbuf.dtype, compress_dtype)
+        opts = CallOptions(
+            op=Operation.REDUCE,
+            comm=comm,
+            count=n,
+            root_dst=root,
+            reduce_function=function,
+            arithcfg=cfg,
+            compression=flags,
+            host=self._host_flags(sendbuf, None, recvbuf),
+            op0=sendbuf,
+            res=recvbuf if recvbuf is not None else DummyBuffer(0, sendbuf.dtype),
+        )
+        return self._launch(opts, run_async, "reduce")
+
+    def allreduce(
+        self,
+        sendbuf: BaseBuffer,
+        recvbuf: BaseBuffer,
+        count: Optional[int] = None,
+        function: ReduceFunction = ReduceFunction.SUM,
+        comm: Optional[Communicator] = None,
+        compress_dtype: Optional[DTypeLike] = None,
+        run_async: bool = False,
+    ):
+        comm = comm or self._world
+        n = self._count_of(sendbuf, count)
+        cfg, flags = self._resolve_arithcfg(sendbuf.dtype, compress_dtype)
+        opts = CallOptions(
+            op=Operation.ALLREDUCE,
+            comm=comm,
+            count=n,
+            reduce_function=function,
+            arithcfg=cfg,
+            compression=flags,
+            host=self._host_flags(sendbuf, None, recvbuf),
+            op0=sendbuf,
+            res=recvbuf,
+        )
+        return self._launch(opts, run_async, "allreduce")
+
+    def reduce_scatter(
+        self,
+        sendbuf: BaseBuffer,
+        recvbuf: BaseBuffer,
+        count: Optional[int] = None,
+        function: ReduceFunction = ReduceFunction.SUM,
+        comm: Optional[Communicator] = None,
+        compress_dtype: Optional[DTypeLike] = None,
+        run_async: bool = False,
+    ):
+        comm = comm or self._world
+        n = self._count_of(recvbuf, count)
+        cfg, flags = self._resolve_arithcfg(recvbuf.dtype, compress_dtype)
+        opts = CallOptions(
+            op=Operation.REDUCE_SCATTER,
+            comm=comm,
+            count=n,
+            reduce_function=function,
+            arithcfg=cfg,
+            compression=flags,
+            host=self._host_flags(sendbuf, None, recvbuf),
+            op0=sendbuf,
+            res=recvbuf,
+        )
+        return self._launch(opts, run_async, "reduce_scatter")
+
+    def alltoall(
+        self,
+        sendbuf: BaseBuffer,
+        recvbuf: BaseBuffer,
+        count: Optional[int] = None,
+        comm: Optional[Communicator] = None,
+        compress_dtype: Optional[DTypeLike] = None,
+        run_async: bool = False,
+    ):
+        comm = comm or self._world
+        if count is None:
+            count = sendbuf.count // comm.size
+        cfg, flags = self._resolve_arithcfg(sendbuf.dtype, compress_dtype)
+        opts = CallOptions(
+            op=Operation.ALLTOALL,
+            comm=comm,
+            count=int(count),
+            arithcfg=cfg,
+            compression=flags,
+            host=self._host_flags(sendbuf, None, recvbuf),
+            op0=sendbuf,
+            res=recvbuf,
+        )
+        return self._launch(opts, run_async, "alltoall")
+
+    def barrier(
+        self, comm: Optional[Communicator] = None, run_async: bool = False
+    ):
+        comm = comm or self._world
+        cfg, flags = self._resolve_arithcfg(DataType.FLOAT32, None)
+        opts = CallOptions(
+            op=Operation.BARRIER,
+            comm=comm,
+            count=0,
+            tag=0x7FFFFFF0,  # reserved tag space so barriers never cross-match
+            arithcfg=cfg,
+            compression=flags,
+        )
+        return self._launch(opts, run_async, "barrier")
+
+    # -- device stream ports -------------------------------------------------
+    def stream_push(self, data: np.ndarray, stream_id: int = 0) -> None:
+        self.engine.stream_push(stream_id, np.ascontiguousarray(data).tobytes())
+
+    def stream_pop(
+        self, count: int, dtype: DTypeLike, stream_id: int = 0, timeout: float = 30.0
+    ) -> np.ndarray:
+        from .constants import dtype_to_numpy
+
+        npdt = dtype_to_numpy(_as_datatype(dtype))
+        need = count * npdt.itemsize
+        out = b""
+        while len(out) < need:
+            out += self.engine.stream_pop(stream_id, timeout=timeout)
+        return np.frombuffer(out[:need], dtype=npdt).copy()
+
+    # -- debug ---------------------------------------------------------------
+    def dump_rx_buffers(self) -> str:
+        if hasattr(self.engine, "dump_rx_buffers"):
+            return self.engine.dump_rx_buffers()
+        return ""
+
+    def dump_communicator(self, comm: Optional[Communicator] = None) -> str:
+        return (comm or self._world).dump()
+
+    def deinit(self) -> None:
+        if self._initialized:
+            self.engine.shutdown()
+            self._initialized = False
+
+
+# ---------------------------------------------------------------------------
+# Group construction helpers
+# ---------------------------------------------------------------------------
+
+
+def emulated_group(
+    n: int,
+    rx_buffer_count: int = 16,
+    rx_buffer_size: int = DEFAULT_RX_BUFFER_SIZE,
+    **accl_kwargs,
+) -> List[ACCL]:
+    """N ranks in one process over the in-proc fabric — the CI tier, standing
+    in for the reference's `mpirun N emulator processes` harness."""
+    from .backends.emulator import EmuEngine, InProcFabric
+
+    fabric = InProcFabric()
+    ranks = [
+        Rank(address=f"inproc:{i}", session=i, max_segment_size=rx_buffer_size)
+        for i in range(n)
+    ]
+    engines = [
+        EmuEngine(
+            fabric,
+            f"inproc:{i}",
+            rx_buffer_count=rx_buffer_count,
+            rx_buffer_size=rx_buffer_size,
+        )
+        for i in range(n)
+    ]
+    return [ACCL(engines[i], ranks, i, **accl_kwargs) for i in range(n)]
+
+
+def socket_group_member(
+    rank: int,
+    addresses: Sequence[str],
+    rx_buffer_count: int = 16,
+    rx_buffer_size: int = DEFAULT_RX_BUFFER_SIZE,
+    **accl_kwargs,
+) -> ACCL:
+    """This process's member of a multi-process group over TCP sockets (one
+    process per rank, like the reference's per-rank emulator processes)."""
+    from .backends.emulator import EmuEngine
+    from .backends.emulator.fabric import SocketFabric
+
+    fabric = SocketFabric(addresses[rank])
+    ranks = [
+        Rank(address=a, session=i, max_segment_size=rx_buffer_size)
+        for i, a in enumerate(addresses)
+    ]
+    engine = EmuEngine(
+        fabric,
+        addresses[rank],
+        rx_buffer_count=rx_buffer_count,
+        rx_buffer_size=rx_buffer_size,
+    )
+    return ACCL(engine, ranks, rank, **accl_kwargs)

@@ -1,0 +1,80 @@
+"""Python binding for the native C++ dataplane library.
+
+The reference implements its dataplane in native code (HLS C++ reduce_ops /
+hp_compression kernels, C firmware); our equivalent hot paths live in
+``native/src`` (C++, built into ``libaccl_dataplane.so``) and are loaded here
+via ctypes, with numpy fallbacks in ``backends/emulator/dataplane.py`` when
+the library has not been built.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import pathlib
+
+import numpy as np
+
+from ..constants import ReduceFunction
+
+_LIB = None
+_LOAD_ATTEMPTED = False
+
+
+def _load():
+    global _LIB, _LOAD_ATTEMPTED
+    if _LOAD_ATTEMPTED:
+        return _LIB
+    _LOAD_ATTEMPTED = True
+    here = pathlib.Path(__file__).resolve().parent
+    for cand in (
+        here / "libaccl_dataplane.so",
+        here.parent.parent / "native" / "build" / "libaccl_dataplane.so",
+    ):
+        if cand.exists():
+            try:
+                lib = ctypes.CDLL(str(cand))
+                lib.accl_reduce_inplace.restype = ctypes.c_int
+                lib.accl_reduce_inplace.argtypes = [
+                    ctypes.c_int,  # reduce function
+                    ctypes.c_int,  # dtype code
+                    ctypes.c_void_p,  # dst
+                    ctypes.c_void_p,  # src
+                    ctypes.c_size_t,  # element count
+                ]
+                _LIB = lib
+                break
+            except OSError:
+                continue
+    return _LIB
+
+
+# dtype codes shared with native/src/dataplane.cpp
+_DTYPE_CODE = {
+    np.dtype(np.float32): 0,
+    np.dtype(np.float64): 1,
+    np.dtype(np.int32): 2,
+    np.dtype(np.int64): 3,
+    np.dtype(np.float16): 4,
+}
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def reduce_inplace(fn: ReduceFunction, dst: np.ndarray, src: np.ndarray) -> bool:
+    """Returns True if the native path handled the reduction."""
+    lib = _load()
+    if lib is None:
+        return False
+    code = _DTYPE_CODE.get(dst.dtype)
+    if code is None or not dst.flags.c_contiguous or not src.flags.c_contiguous:
+        return False
+    rc = lib.accl_reduce_inplace(
+        int(fn),
+        code,
+        dst.ctypes.data,
+        src.ctypes.data,
+        dst.size,
+    )
+    return rc == 0

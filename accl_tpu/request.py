@@ -1,0 +1,116 @@
+"""Asynchronous request model.
+
+Role model: ``driver/xrt/include/accl/acclrequest.hpp`` — ``BaseRequest``
+(mutex + condvar guarded status, return code, device-measured duration,
+:39-147) and the thread-safe ``FPGAQueue`` (:153-211) that serializes
+operations onto the single offload engine.  Here requests are completed by the
+backend's engine thread(s); ``wait``/``test`` expose the same non-blocking /
+blocking surface.
+"""
+
+from __future__ import annotations
+
+import enum
+import itertools
+import threading
+from typing import Any, Optional
+
+from .constants import ACCLError, ErrorCode
+
+
+class RequestStatus(enum.IntEnum):
+    QUEUED = 0
+    EXECUTING = 1
+    COMPLETED = 2
+
+
+_request_ids = itertools.count(1)
+
+
+class Request:
+    def __init__(self, op_name: str = ""):
+        self.id = next(_request_ids)
+        self.op_name = op_name
+        self._done = threading.Event()
+        self._status = RequestStatus.QUEUED
+        self._retcode = ErrorCode.OK
+        self._duration_ns: int = 0
+        # backend-private payload (e.g. the engine call record)
+        self.payload: Any = None
+
+    # -- engine side --------------------------------------------------------
+    def mark_executing(self) -> None:
+        self._status = RequestStatus.EXECUTING
+
+    def complete(self, retcode: ErrorCode, duration_ns: int = 0) -> None:
+        self._retcode = ErrorCode(retcode)
+        self._duration_ns = int(duration_ns)
+        self._status = RequestStatus.COMPLETED
+        self._done.set()
+
+    # -- user side ----------------------------------------------------------
+    @property
+    def status(self) -> RequestStatus:
+        return self._status
+
+    def test(self) -> bool:
+        """Non-blocking completion probe."""
+        return self._done.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    def get_retcode(self) -> ErrorCode:
+        return self._retcode
+
+    def get_duration_ns(self) -> int:
+        """Engine-measured duration of the call in nanoseconds.
+
+        The reference reads a free-running device cycle counter
+        (``ccl_offload_control.c:2279-2303``); emulator tiers substitute a
+        monotonic host clock, the TPU tier device timings.
+        """
+        return self._duration_ns
+
+    def check(self, context: str = "") -> None:
+        if self._retcode != ErrorCode.OK:
+            raise ACCLError(self._retcode, context or self.op_name)
+
+
+class CommandQueue:
+    """FIFO serializing calls onto one engine, preserving issue order.
+
+    The reference needs this because a single CCLO executes one host command
+    stream (``acclrequest.hpp:153-211``); we keep it so that the async API has
+    deterministic ordering regardless of backend threading.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items: list = []
+        self._cv = threading.Condition(self._lock)
+        self._closed = False
+
+    def push(self, item) -> None:
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("command queue closed")
+            self._items.append(item)
+            self._cv.notify()
+
+    def pop(self, timeout: Optional[float] = None):
+        with self._cv:
+            if not self._items:
+                self._cv.wait(timeout)
+            if not self._items:
+                return None
+            return self._items.pop(0)
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)

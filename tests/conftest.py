@@ -1,0 +1,39 @@
+"""Test configuration: force an 8-device virtual CPU mesh before jax loads.
+
+Tests never require real TPU hardware; sharding/collective tests run over
+XLA's host-platform device emulation (the same way the driver's
+dryrun_multichip validates the multi-chip path).
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def group2():
+    from accl_tpu import emulated_group
+
+    g = emulated_group(2)
+    yield g
+    for a in g:
+        a.deinit()
+
+
+@pytest.fixture(scope="module")
+def group4():
+    from accl_tpu import emulated_group
+
+    g = emulated_group(4)
+    yield g
+    for a in g:
+        a.deinit()
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,35 @@
+"""Shared helpers: drive one call per rank concurrently, like the reference's
+mpirun-launched per-rank host processes."""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Sequence
+
+
+def run_parallel(group: Sequence, fn: Callable, timeout: float = 60.0) -> List:
+    """Call ``fn(accl_instance, rank)`` on one thread per rank; re-raise the
+    first exception; return per-rank results."""
+    results = [None] * len(group)
+    errors = [None] * len(group)
+
+    def runner(i):
+        try:
+            results[i] = fn(group[i], i)
+        except BaseException as e:  # noqa: BLE001
+            errors[i] = e
+
+    threads = [
+        threading.Thread(target=runner, args=(i,), daemon=True)
+        for i in range(len(group))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+        if t.is_alive():
+            raise TimeoutError("a rank did not finish its call (likely deadlock)")
+    for e in errors:
+        if e is not None:
+            raise e
+    return results

@@ -1,0 +1,82 @@
+"""Failure surface: timeouts, config validation, error recovery.
+
+Mirrors the reference's error-code/timeout machinery (constants.hpp:355-393,
+check_return_value accl.cpp:1210-1234, HOUSEKEEP_TIMEOUT).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from accl_tpu import ACCLError, ErrorCode, emulated_group
+
+
+@pytest.fixture()
+def fresh_group2():
+    g = emulated_group(2)
+    yield g
+    for a in g:
+        a.deinit()
+
+
+def test_recv_timeout_raises(fresh_group2):
+    a = fresh_group2[0]
+    a.set_timeout(0.2)
+    buf = a.create_buffer(10, np.float32)
+    with pytest.raises(ACCLError) as exc:
+        a.recv(buf, 10, src=1, tag=77)
+    assert exc.value.code == ErrorCode.RECEIVE_TIMEOUT
+
+
+def test_recv_after_timeout_recovers(fresh_group2):
+    """A timed-out receive must not poison per-peer sequence matching:
+    the inbound counter advances only on match (ref dma_mover.cpp:610)."""
+    a, b = fresh_group2
+    a.set_timeout(0.2)
+    buf = a.create_buffer(10, np.float32)
+    with pytest.raises(ACCLError):
+        a.recv(buf, 10, src=1, tag=99)
+    a.set_timeout(10)
+
+    def sender():
+        sb = b.create_buffer_from(np.full(10, 3.0, np.float32))
+        b.send(sb, 10, dst=0, tag=1)
+
+    t = threading.Thread(target=sender, daemon=True)
+    t.start()
+    a.recv(buf, 10, src=1, tag=1)
+    t.join(10)
+    buf.sync_from_device()
+    np.testing.assert_array_equal(buf.data, np.full(10, 3.0, np.float32))
+
+
+def test_rendezvous_timeout(fresh_group2):
+    a = fresh_group2[0]
+    a.set_timeout(0.2)
+    buf = a.create_buffer_from(np.zeros(64 * 1024, np.float32))
+    with pytest.raises(ACCLError) as exc:
+        a.send(buf, 64 * 1024, dst=1, tag=5)  # rendezvous; no receiver
+    assert exc.value.code == ErrorCode.RENDEZVOUS_TIMEOUT
+
+
+def test_config_validation(fresh_group2):
+    a = fresh_group2[0]
+    with pytest.raises(ACCLError):
+        a.set_max_eager_size(10**9)
+    with pytest.raises(ACCLError):
+        a.set_timeout(-1)
+
+
+def test_engine_survives_errors(fresh_group2):
+    a = fresh_group2[0]
+    a.set_timeout(0.2)
+    buf = a.create_buffer(10, np.float32)
+    for _ in range(3):
+        with pytest.raises(ACCLError):
+            a.recv(buf, 10, src=1, tag=123)
+    src = a.create_buffer_from(np.ones(4, np.float32))
+    dst = a.create_buffer(4, np.float32)
+    a.copy(src, dst)
+    dst.sync_from_device()
+    np.testing.assert_array_equal(dst.data, np.ones(4, np.float32))

@@ -1,0 +1,132 @@
+"""Primitives: copy / combine / buffer semantics / request model.
+
+Mirrors the reference suite's ``test_copy*`` (test/host/xrt/src/test.cpp:30-165,
+incl. host-memory variants), ``test_combine`` (:167-195) and the request
+surface.
+"""
+
+import numpy as np
+import pytest
+
+from accl_tpu import ACCLError, DataType, ErrorCode, ReduceFunction, RequestStatus
+
+
+def test_copy(group2, rng):
+    accl = group2[0]
+    data = rng.standard_normal(77).astype(np.float32)
+    src = accl.create_buffer_from(data)
+    dst = accl.create_buffer(77, np.float32)
+    accl.copy(src, dst)
+    dst.sync_from_device()
+    np.testing.assert_array_equal(dst.data, data)
+
+
+def test_copy_requires_sync(group2, rng):
+    """Data written to host memory is invisible to the engine until synced."""
+    accl = group2[0]
+    src = accl.create_buffer(16, np.float32)
+    dst = accl.create_buffer(16, np.float32)
+    src.data[:] = 7.0  # host write, no sync_to_device
+    accl.copy(src, dst)
+    dst.sync_from_device()
+    np.testing.assert_array_equal(dst.data, np.zeros(16, np.float32))
+
+
+def test_copy_host_only_buffers(group2, rng):
+    """Host-only buffers alias host memory (the reference's h2h copy path)."""
+    accl = group2[0]
+    data = rng.standard_normal(32).astype(np.float32)
+    src = accl.create_buffer_from(data, host_only=True)
+    dst = accl.create_buffer(32, np.float32, host_only=True)
+    accl.copy(src, dst)
+    np.testing.assert_array_equal(dst.data, data)
+
+
+def test_copy_partial_count(group2, rng):
+    accl = group2[0]
+    data = rng.standard_normal(64).astype(np.float32)
+    src = accl.create_buffer_from(data)
+    dst = accl.create_buffer(64, np.float32)
+    accl.copy(src, dst, count=10)
+    dst.sync_from_device()
+    np.testing.assert_array_equal(dst.data[:10], data[:10])
+    np.testing.assert_array_equal(dst.data[10:], np.zeros(54, np.float32))
+
+
+def test_buffer_slice_aliases(group2, rng):
+    accl = group2[0]
+    data = rng.standard_normal(100).astype(np.float32)
+    buf = accl.create_buffer_from(data)
+    sl = buf.slice(10, 20)
+    assert sl.count == 10
+    sl.data[:] = 0.5
+    np.testing.assert_array_equal(buf.data[10:20], np.full(10, 0.5, np.float32))
+
+
+@pytest.mark.parametrize("fn", [ReduceFunction.SUM, ReduceFunction.MAX])
+@pytest.mark.parametrize(
+    "dtype", [np.float32, np.float64, np.int32, np.int64, np.float16]
+)
+def test_combine(group2, rng, fn, dtype):
+    accl = group2[0]
+    n = 53
+    if np.dtype(dtype).kind == "f":
+        a = rng.standard_normal(n).astype(dtype)
+        b = rng.standard_normal(n).astype(dtype)
+    else:
+        a = rng.integers(-1000, 1000, n).astype(dtype)
+        b = rng.integers(-1000, 1000, n).astype(dtype)
+    op0 = accl.create_buffer_from(a)
+    op1 = accl.create_buffer_from(b)
+    res = accl.create_buffer(n, dtype)
+    accl.combine(fn, op0, op1, res)
+    res.sync_from_device()
+    expected = a + b if fn == ReduceFunction.SUM else np.maximum(a, b)
+    np.testing.assert_allclose(res.data, expected, rtol=1e-3)
+
+
+def test_async_request(group2, rng):
+    accl = group2[0]
+    data = rng.standard_normal(1000).astype(np.float32)
+    src = accl.create_buffer_from(data)
+    dst = accl.create_buffer(1000, np.float32)
+    req = accl.copy(src, dst, run_async=True)
+    assert req.wait(timeout=10)
+    assert req.status == RequestStatus.COMPLETED
+    assert req.get_retcode() == ErrorCode.OK
+    req.check()
+    dst.sync_from_device()
+    np.testing.assert_array_equal(dst.data, data)
+
+
+def test_perf_counter(group2, rng):
+    """Every completed call reports a nonzero engine-side duration
+    (ref test_perf_counter, test.cpp:1137)."""
+    accl = group2[0]
+    src = accl.create_buffer_from(rng.standard_normal(4096).astype(np.float32))
+    dst = accl.create_buffer(4096, np.float32)
+    req = accl.copy(src, dst, run_async=True)
+    req.wait(timeout=10)
+    assert accl.get_duration(req) > 0
+
+
+def test_invalid_rank_raises(group2):
+    accl = group2[0]
+    buf = accl.create_buffer(4, np.float32)
+    with pytest.raises(ACCLError) as exc:
+        accl.send(buf, 4, dst=99)
+    assert exc.value.code == ErrorCode.INVALID_RANK
+
+
+def test_dtype_roundtrip():
+    from accl_tpu.constants import dtype_to_numpy, numpy_to_dtype
+
+    for dt in [
+        DataType.FLOAT16,
+        DataType.FLOAT32,
+        DataType.FLOAT64,
+        DataType.INT32,
+        DataType.INT64,
+        DataType.BFLOAT16,
+    ]:
+        assert numpy_to_dtype(dtype_to_numpy(dt)) == dt
