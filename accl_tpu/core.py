@@ -633,6 +633,24 @@ def emulated_group(
     return [ACCL(engines[i], ranks, i, **accl_kwargs) for i in range(n)]
 
 
+def xla_group(n: int, **accl_kwargs) -> List[ACCL]:
+    """N rank handles over the XLA gang backend: collectives execute as one
+    jitted shard_map program over an n-device mesh (ICI on real TPU slices,
+    virtual CPU devices under XLA_FLAGS host-device forcing)."""
+    from .backends.xla.engine import XLAEngine, XLAGangContext, _P2PChannel
+
+    gang = XLAGangContext()
+    p2p = _P2PChannel()
+    peers: dict = {}
+    ranks = [Rank(address=f"xla:{i}", session=i) for i in range(n)]
+    group = []
+    for i in range(n):
+        eng = XLAEngine(gang, p2p=p2p, peers=peers)
+        peers[i] = eng
+        group.append(ACCL(eng, ranks, i, **accl_kwargs))
+    return group
+
+
 def socket_group_member(
     rank: int,
     addresses: Sequence[str],

@@ -1,0 +1,20 @@
+"""Demonstration models built ON the framework.
+
+The reference is a collectives library, not a trainer — its "application
+layer" is MPI-style host programs and device kernels (``test/host``,
+``vadd_put``).  The TPU-native equivalent of those applications is a
+distributed model whose every communication edge goes through
+``accl_tpu.ops``: a tensor/data-parallel transformer (``transformer.py``)
+and ring attention for sequence parallelism (``ring_attention.py``) —
+the long-context layer SURVEY.md §5 notes the reference's segmented-ring
+machinery is the substrate for.
+"""
+
+from .transformer import (  # noqa: F401
+    TransformerConfig,
+    init_params,
+    forward,
+    make_sharded_train_step,
+    make_sharded_forward,
+)
+from .ring_attention import ring_attention, reference_attention  # noqa: F401

@@ -1,0 +1,96 @@
+"""Ring attention: sequence parallelism on the framework's ring substrate.
+
+Long-context attention with the sequence sharded over a mesh axis: K/V
+blocks rotate around the ring (one ``ppermute`` hop per step — neighbor DMA
+on ICI) while each device folds the visiting block into its local queries'
+online-softmax state.  Compute overlaps the wire exactly the way the
+reference's segmented ring pipelines overlap recv/reduce/send hops
+(``ccl_offload_control.c:1888-2071``); SURVEY.md §5 calls that machinery the
+substrate such strategies sit on — this is the strategy, sitting on it.
+
+Causal masking is handled per-visiting-block via the block's origin rank:
+origin > self  -> fully masked (future), origin < self -> unmasked (past),
+origin == self -> triangular.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _fold_block(q, k_blk, v_blk, o, m, l, block_mask):
+    """Online-softmax accumulation of one K/V block.
+
+    q: (B,H,Tq,D); k_blk/v_blk: (B,H,Tk,D); o: (B,H,Tq,D) running numerator;
+    m: (B,H,Tq,1) running max; l: (B,H,Tq,1) running denominator.
+    block_mask: (Tq,Tk) bool, True = attend."""
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk) / np.sqrt(q.shape[-1])
+    scores = jnp.where(block_mask[None, None], scores, -jnp.inf)
+    m_blk = scores.max(axis=-1, keepdims=True)
+    m_new = jnp.maximum(m, m_blk)
+    # guard fully-masked blocks (m_new == -inf): contribute nothing
+    m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.exp(scores - m_safe)
+    p = jnp.where(jnp.isneginf(scores), 0.0, p)
+    alpha = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_safe))
+    o = o * alpha + jnp.einsum("bhqk,bhkd->bhqd", p, v_blk)
+    l = l * alpha + p.sum(axis=-1, keepdims=True)
+    return o, m_new, l
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    causal: bool = True,
+) -> jax.Array:
+    """Attention over the full (sharded) sequence.  q,k,v: (B,H,T_local,D)
+    per device; returns (B,H,T_local,D) — this device's query rows attended
+    over every device's keys."""
+    size = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    Tq, Tk = q.shape[2], k.shape[2]
+    perm = [(i, (i + 1) % size) for i in range(size)]
+
+    tri = jnp.tril(jnp.ones((Tq, Tk), bool))
+    full = jnp.ones((Tq, Tk), bool)
+
+    def mask_for(origin):
+        if not causal:
+            return full
+        return jnp.where(
+            origin == idx, tri, jnp.where(origin < idx, full, jnp.zeros_like(full))
+        )
+
+    o = jnp.zeros_like(q)
+    m = jnp.full(q.shape[:3] + (1,), -jnp.inf, q.dtype)
+    l = jnp.zeros(q.shape[:3] + (1,), q.dtype)
+
+    # fold own block first, then rotate K/V around the ring P-1 times
+    o, m, l = _fold_block(q, k, v, o, m, l, mask_for(idx))
+
+    def body(s, carry):
+        o, m, l, k_cur, v_cur = carry
+        k_cur = lax.ppermute(k_cur, axis_name, perm)
+        v_cur = lax.ppermute(v_cur, axis_name, perm)
+        origin = jnp.mod(idx - 1 - s, size)  # whose block just arrived
+        o, m, l = _fold_block(q, k_cur, v_cur, o, m, l, mask_for(origin))
+        return o, m, l, k_cur, v_cur
+
+    if size > 1:
+        o, m, l, _, _ = lax.fori_loop(0, size - 1, body, (o, m, l, k, v))
+    return o / jnp.maximum(l, 1e-30)
+
+
+def reference_attention(q, k, v, causal: bool = True) -> jax.Array:
+    """Single-device ground truth for tests: q,k,v (B,H,T,D) full sequence."""
+    T = q.shape[2]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(q.shape[-1])
+    if causal:
+        scores = jnp.where(jnp.tril(jnp.ones((T, T), bool)), scores, -1e30)
+    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, axis=-1), v)

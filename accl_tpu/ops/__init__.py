@@ -1,0 +1,31 @@
+"""accl_tpu.ops: the idiomatic TPU collective layer.
+
+Pure-functional JAX collectives in two flavors:
+
+* ``collectives`` — XLA's native collectives (psum / all_gather /
+  psum_scatter / all_to_all / ppermute) wrapped with the reference op
+  vocabulary, for use inside ``shard_map``/``pjit`` over a Mesh.  This is
+  the fast path: XLA schedules the ICI transfers.
+* ``ring`` — explicit, segment-controlled ring pipelines built from
+  ``lax.ppermute`` (algorithm-faithful mode, mirroring the reference
+  firmware's ring reduce-scatter + allgather allreduce,
+  ccl_offload_control.c:1888-2071), for when you need the reference's
+  tuning surface (segment sizes, overlap) rather than XLA's choices.
+
+The ``driver`` module wraps both in host-level helpers that take global
+arrays and a Mesh and run the jitted SPMD program.
+"""
+
+from . import collectives, ring  # noqa: F401
+from .driver import (  # noqa: F401
+    make_mesh,
+    run_allgather,
+    run_allreduce,
+    run_alltoall,
+    run_bcast,
+    run_gather,
+    run_reduce,
+    run_reduce_scatter,
+    run_ring_allreduce,
+    run_scatter,
+)

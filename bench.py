@@ -1,0 +1,127 @@
+"""Benchmark on real hardware: prints ONE JSON line.
+
+Headline metric (BASELINE.md): allreduce bus bandwidth.  With >= 2 chips,
+runs the ring-allreduce sweep and reports peak bus bandwidth
+(2*(P-1)/P * bytes / t) against the reference's 100 GbE wire rate
+(12.5 GB/s).  On a single chip (no ICI path to exercise), reports the
+collective engine's datapath throughput — a large fused ``combine``
+(elementwise SUM, the reduce_ops role) — against the reference CCLO's
+internal datapath envelope of 16 GB/s (64 B/cycle @ 250 MHz,
+ccl_offload_control.h:34).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def _bench_combine() -> dict:
+    """Device-side fori_loop amortizes dispatch; the K2-K1 slope cancels the
+    host<->device roundtrip so only on-chip time per combine remains."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from functools import partial
+
+    n = 64 * 1024 * 1024  # 256 MB per operand, fp32
+    a = jnp.ones((n,), jnp.float32)
+    b = jnp.full((n,), 1.0, jnp.float32)
+
+    @partial(jax.jit, static_argnums=2)
+    def loop(a, b, k):
+        return lax.fori_loop(0, k, lambda i, acc: acc + b, a)
+
+    def timed(k):
+        t0 = time.perf_counter()
+        out = loop(a, b, k)
+        float(out[0])  # forced readback: completion barrier
+        return time.perf_counter() - t0
+
+    k1, k2 = 10, 110
+    for k in (k1, k2):
+        timed(k)  # compile + warm both loop lengths
+    t1 = min(timed(k1) for _ in range(3))
+    t2 = min(timed(k2) for _ in range(3))
+    per_iter = max((t2 - t1) / (k2 - k1), 1e-9)
+    moved = 3 * n * 4  # two reads + one write per combine
+    gbps = moved / per_iter / 1e9
+    return {
+        "metric": "combine_datapath_bandwidth",
+        "value": round(gbps, 2),
+        "unit": "GB/s",
+        "vs_baseline": round(gbps / 16.0, 2),  # CCLO internal datapath
+    }
+
+
+def _bench_ring_allreduce(ndev: int) -> dict:
+    """K-iteration device-side loop of psum over the mesh; slope timing as in
+    the combine bench so tunnel dispatch cancels out."""
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        from jax import shard_map
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+
+    from accl_tpu.ops import make_mesh
+    from accl_tpu.ops.driver import AXIS
+
+    mesh = make_mesh(ndev)
+    n = 16 * 1024 * 1024  # 64 MB per rank fp32
+    stacked = jnp.ones((ndev, n), jnp.float32)
+
+    @partial(jax.jit, static_argnums=1)
+    def loop(x, k):
+        def body(x):
+            def it(i, acc):
+                return lax.psum(acc, AXIS) / ndev  # keep magnitude bounded
+            return lax.fori_loop(0, k, it, x[0])[None]
+
+        return shard_map(
+            body, mesh=mesh, in_specs=(P(AXIS),), out_specs=P(AXIS),
+            check_vma=False,
+        )(x)
+
+    def timed(k):
+        t0 = time.perf_counter()
+        out = loop(stacked, k)
+        float(out[0, 0])  # forced readback: completion barrier
+        return time.perf_counter() - t0
+
+    k1, k2 = 5, 25
+    for k in (k1, k2):
+        timed(k)
+    t1 = min(timed(k1) for _ in range(3))
+    t2 = min(timed(k2) for _ in range(3))
+    per_iter = max((t2 - t1) / (k2 - k1), 1e-9)
+    bytes_per_rank = n * 4
+    bus = 2 * (ndev - 1) / ndev * bytes_per_rank / per_iter / 1e9
+    return {
+        "metric": "allreduce_bus_bandwidth",
+        "value": round(bus, 2),
+        "unit": "GB/s",
+        "vs_baseline": round(bus / 12.5, 2),  # 100 GbE wire rate
+    }
+
+
+def main() -> None:
+    import jax
+
+    ndev = len(jax.devices())
+    if ndev >= 2:
+        result = _bench_ring_allreduce(ndev)
+    else:
+        result = _bench_combine()
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()
