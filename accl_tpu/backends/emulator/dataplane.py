@@ -55,11 +55,20 @@ class RxBufferPool:
     against filled buffers (rxbuf_seek); ``release`` recycles.  When the pool
     is exhausted the fill blocks — emulating link-level backpressure rather
     than dropping, which is what the reference's dummy stacks do.
+
+    Signature matching runs in the native C++ matcher when the library is
+    built (the rxbuf_seek hardware role); payloads always stay here.
     """
 
     def __init__(self, count: int, size: int):
         self._buffers = [RxBuffer(i, size) for i in range(count)]
         self._cv = threading.Condition()
+        self._matcher = None
+        if _native is not None and _native.available():
+            try:
+                self._matcher = _native.NativeRxMatcher(count)
+            except Exception:
+                self._matcher = None
 
     def fill(self, msg: Message, timeout: Optional[float] = None) -> bool:
         with self._cv:
@@ -69,6 +78,15 @@ class RxBufferPool:
             )
             if not ok:
                 return False
+            if self._matcher is not None:
+                slot = self._matcher.fill(msg.comm_id, msg.src, msg.tag, msg.seqn)
+                if slot >= 0:
+                    b = self._buffers[slot]
+                    b.status = RxStatus.FILLED
+                    b.msg = msg
+                    self._cv.notify_all()
+                    return True
+                return False  # pragma: no cover - cv guard keeps slots free
             for b in self._buffers:
                 if b.status == RxStatus.IDLE:
                     b.status = RxStatus.FILLED
@@ -81,6 +99,13 @@ class RxBufferPool:
         self, comm_id: int, src: int, tag: int, seqn: int
     ) -> Optional[RxBuffer]:
         with self._cv:
+            if self._matcher is not None:
+                slot = self._matcher.seek(comm_id, src, tag, seqn)
+                if slot < 0:
+                    return None
+                b = self._buffers[slot]
+                b.status = RxStatus.CLAIMED
+                return b
             for b in self._buffers:
                 m = b.msg
                 if (
@@ -97,6 +122,8 @@ class RxBufferPool:
 
     def release(self, buf: RxBuffer) -> None:
         with self._cv:
+            if self._matcher is not None:
+                self._matcher.release(buf.index)
             buf.status = RxStatus.IDLE
             buf.msg = None
             self._cv.notify_all()
@@ -147,20 +174,38 @@ def reduce_inplace(
         raise ValueError(f"unsupported reduce function {fn}")
 
 
-def cast_bytes(raw: bytes, src_dt: DataType, dst_dt: DataType) -> bytes:
-    """Decode raw element bytes in src_dt, re-encode in dst_dt (wire
-    compression/decompression stage)."""
-    if src_dt == dst_dt:
-        return raw
-    arr = np.frombuffer(raw, dtype=dtype_to_numpy(src_dt))
-    return arr.astype(dtype_to_numpy(dst_dt)).tobytes()
+_NATIVE_CAST_NAMES = {DataType.FLOAT16: "float16", DataType.BFLOAT16: "bfloat16"}
 
 
 def cast_array(arr: np.ndarray, dst_dt: DataType) -> np.ndarray:
+    """Elementwise dtype cast (wire compression/decompression stage); the
+    f32<->f16/bf16 pairs go through the native hp_compression-role lanes."""
     npdt = dtype_to_numpy(dst_dt)
     if arr.dtype == npdt:
         return arr
+    if _native is not None and _native.available() and arr.flags.c_contiguous:
+        wire = _NATIVE_CAST_NAMES.get(dst_dt)
+        if wire is not None and arr.dtype == np.float32:
+            return _native.cast_f32(arr, wire).view(npdt)
+        from ...constants import numpy_to_dtype
+
+        try:
+            src_dt = numpy_to_dtype(arr.dtype)
+        except ValueError:
+            src_dt = None
+        if dst_dt == DataType.FLOAT32 and src_dt in _NATIVE_CAST_NAMES:
+            return _native.uncast_f32(
+                arr.view(np.uint16), _NATIVE_CAST_NAMES[src_dt]
+            )
     return arr.astype(npdt)
+
+
+def cast_bytes(raw: bytes, src_dt: DataType, dst_dt: DataType) -> bytes:
+    """Decode raw element bytes in src_dt, re-encode in dst_dt."""
+    if src_dt == dst_dt:
+        return raw
+    arr = np.frombuffer(raw, dtype=dtype_to_numpy(src_dt))
+    return cast_array(arr, dst_dt).tobytes()
 
 
 # ---------------------------------------------------------------------------

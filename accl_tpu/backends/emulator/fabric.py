@@ -208,14 +208,38 @@ class SocketFabric(Fabric):
             buf += chunk
         return buf
 
+    def _connect(self, address: str) -> socket.socket:
+        """Dial a peer, retrying until its listener is up (peers start
+        concurrently; the reference leans on MPI barriers for this,
+        fixture.hpp:124-132 — we self-synchronize instead)."""
+        import time as _time
+
+        host, port = address.rsplit(":", 1)
+        deadline = _time.monotonic() + 15.0
+        while True:
+            try:
+                conn = socket.create_connection((host, int(port)), 2.0)
+                break
+            except OSError:
+                if _time.monotonic() > deadline:
+                    raise
+                _time.sleep(0.05)
+        conn.settimeout(None)  # connect timeout must not outlive the dial
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return conn
+
     def send(self, address: str, msg: Message) -> None:
         with self._conn_lock:
             conn = self._conns.get(address)
-            if conn is None:
-                host, port = address.rsplit(":", 1)
-                conn = socket.create_connection((host, int(port)))
-                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                self._conns[address] = conn
+        if conn is None:
+            # dial OUTSIDE the lock so a slow-starting peer doesn't stall
+            # sends to already-connected peers
+            conn = self._connect(address)
+            with self._conn_lock:
+                winner = self._conns.setdefault(address, conn)
+            if winner is not conn:
+                conn.close()
+                conn = winner
         body = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
         with self._conn_lock:
             conn.sendall(struct.pack("<I", len(body)) + body)

@@ -2,15 +2,18 @@
 
 The reference implements its dataplane in native code (HLS C++ reduce_ops /
 hp_compression kernels, C firmware); our equivalent hot paths live in
-``native/src`` (C++, built into ``libaccl_dataplane.so``) and are loaded here
-via ctypes, with numpy fallbacks in ``backends/emulator/dataplane.py`` when
-the library has not been built.
+``native/src/dataplane.cpp`` (built into ``libaccl_dataplane.so`` by
+``native/Makefile``) and are loaded here via ctypes, with numpy fallbacks in
+``backends/emulator/dataplane.py`` when the library is unavailable.  If the
+shared library is missing but a C++ toolchain exists, it is built on first
+import (best-effort, silent fallback).
 """
 
 from __future__ import annotations
 
 import ctypes
 import pathlib
+import subprocess
 
 import numpy as np
 
@@ -19,33 +22,86 @@ from ..constants import ReduceFunction
 _LIB = None
 _LOAD_ATTEMPTED = False
 
+_NATIVE_DIR = pathlib.Path(__file__).resolve().parent.parent.parent / "native"
+_SO_PATH = _NATIVE_DIR / "build" / "libaccl_dataplane.so"
+
+
+def _try_build() -> None:
+    """Best-effort make, serialized across processes with a file lock so N
+    spawn-launched ranks don't race on the same output file."""
+    try:
+        import fcntl
+
+        _NATIVE_DIR.mkdir(exist_ok=True)
+        with open(_NATIVE_DIR / ".build.lock", "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            if not _SO_PATH.exists():
+                subprocess.run(
+                    ["make", "-C", str(_NATIVE_DIR)],
+                    capture_output=True,
+                    timeout=120,
+                    check=True,
+                )
+    except Exception:
+        pass
+
+
+def _bind(lib):
+    c = ctypes
+    lib.accl_reduce_inplace.restype = c.c_int
+    lib.accl_reduce_inplace.argtypes = [
+        c.c_int, c.c_int, c.c_void_p, c.c_void_p, c.c_size_t,
+    ]
+    for name in (
+        "accl_f32_to_f16", "accl_f32_to_bf16", "accl_f16_to_f32",
+        "accl_bf16_to_f32",
+    ):
+        fn = getattr(lib, name)
+        fn.restype = None
+        fn.argtypes = [c.c_void_p, c.c_void_p, c.c_size_t]
+    lib.accl_rxpool_create.restype = c.c_int
+    lib.accl_rxpool_create.argtypes = [c.c_int]
+    lib.accl_rxpool_fill.restype = c.c_int
+    lib.accl_rxpool_fill.argtypes = [
+        c.c_int, c.c_uint32, c.c_uint32, c.c_uint32, c.c_uint64,
+    ]
+    lib.accl_rxpool_seek.restype = c.c_int
+    lib.accl_rxpool_seek.argtypes = lib.accl_rxpool_fill.argtypes
+    lib.accl_rxpool_release.restype = None
+    lib.accl_rxpool_release.argtypes = [c.c_int, c.c_int]
+    lib.accl_rxpool_occupancy.restype = c.c_int
+    lib.accl_rxpool_occupancy.argtypes = [c.c_int]
+    lib.accl_rxpool_destroy.restype = None
+    lib.accl_rxpool_destroy.argtypes = [c.c_int]
+
 
 def _load():
     global _LIB, _LOAD_ATTEMPTED
     if _LOAD_ATTEMPTED:
         return _LIB
     _LOAD_ATTEMPTED = True
-    here = pathlib.Path(__file__).resolve().parent
-    for cand in (
-        here / "libaccl_dataplane.so",
-        here.parent.parent / "native" / "build" / "libaccl_dataplane.so",
-    ):
-        if cand.exists():
+    if not _SO_PATH.exists():
+        _try_build()
+    rebuilt = False
+    while True:
+        if not _SO_PATH.exists():
+            return None
+        try:
+            lib = ctypes.CDLL(str(_SO_PATH))
+            _bind(lib)
+            _LIB = lib
+            return _LIB
+        except (OSError, AttributeError):
+            # stale library from older sources: rebuild once, then give up
+            # to the numpy fallback
+            if rebuilt:
+                return None
+            rebuilt = True
             try:
-                lib = ctypes.CDLL(str(cand))
-                lib.accl_reduce_inplace.restype = ctypes.c_int
-                lib.accl_reduce_inplace.argtypes = [
-                    ctypes.c_int,  # reduce function
-                    ctypes.c_int,  # dtype code
-                    ctypes.c_void_p,  # dst
-                    ctypes.c_void_p,  # src
-                    ctypes.c_size_t,  # element count
-                ]
-                _LIB = lib
-                break
+                _SO_PATH.unlink()
             except OSError:
-                continue
-    return _LIB
+                return None
+            _try_build()
 
 
 # dtype codes shared with native/src/dataplane.cpp
@@ -71,10 +127,66 @@ def reduce_inplace(fn: ReduceFunction, dst: np.ndarray, src: np.ndarray) -> bool
     if code is None or not dst.flags.c_contiguous or not src.flags.c_contiguous:
         return False
     rc = lib.accl_reduce_inplace(
-        int(fn),
-        code,
-        dst.ctypes.data,
-        src.ctypes.data,
-        dst.size,
+        int(fn), code, dst.ctypes.data, src.ctypes.data, dst.size
     )
     return rc == 0
+
+
+def cast_f32(src: np.ndarray, wire: str) -> np.ndarray:
+    """f32 -> f16/bf16 wire compression (returns uint16 bit patterns)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    src = np.ascontiguousarray(src, np.float32)
+    out = np.empty(src.size, np.uint16)
+    fn = lib.accl_f32_to_f16 if wire == "float16" else lib.accl_f32_to_bf16
+    fn(src.ctypes.data, out.ctypes.data, src.size)
+    return out
+
+
+def uncast_f32(src: np.ndarray, wire: str) -> np.ndarray:
+    """f16/bf16 bit patterns -> f32."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    src = np.ascontiguousarray(src, np.uint16)
+    out = np.empty(src.size, np.float32)
+    fn = lib.accl_f16_to_f32 if wire == "float16" else lib.accl_bf16_to_f32
+    fn(src.ctypes.data, out.ctypes.data, src.size)
+    return out
+
+
+class NativeRxMatcher:
+    """C++-backed RX signature pool (the rxbuf_seek role); payloads stay in
+    Python, indexed by slot id."""
+
+    def __init__(self, nslots: int):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._pool = lib.accl_rxpool_create(nslots)
+        self.nslots = nslots
+
+    def fill(self, comm: int, src: int, tag: int, seqn: int) -> int:
+        return self._lib.accl_rxpool_fill(self._pool, comm, src, tag, seqn)
+
+    def seek(self, comm: int, src: int, tag: int, seqn: int) -> int:
+        return self._lib.accl_rxpool_seek(self._pool, comm, src, tag, seqn)
+
+    def release(self, slot: int) -> None:
+        self._lib.accl_rxpool_release(self._pool, slot)
+
+    def occupancy(self) -> int:
+        return self._lib.accl_rxpool_occupancy(self._pool)
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._lib.accl_rxpool_destroy(self._pool)
+            self._pool = None
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            self.close()
+        except Exception:
+            pass

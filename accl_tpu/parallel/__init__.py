@@ -1,0 +1,7 @@
+from .topology import (  # noqa: F401
+    Design,
+    bootstrap,
+    device_memory_report,
+    generate_ranks,
+    mesh_from_topology,
+)

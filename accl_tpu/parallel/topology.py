@@ -1,0 +1,131 @@
+"""Topology bootstrap: from "what hardware is there" to a ready ACCL group.
+
+Role model: ``driver/utils/accl_network_utils`` — the ``acclDesign`` enum
+{AXIS3x, TCP, UDP, CYT_TCP, CYT_RDMA} (include/accl_network_utils.hpp:32),
+rank generation from JSON cluster files or synthetic subnets
+(``generate_ranks``), and the one-call ``initialize_accl`` that loads the
+xclbin, finds kernels, configures the network stack and initializes the
+driver.  TPU-natively: the "network" is the slice topology JAX/PJRT already
+knows, so bootstrap reads ``jax.devices()`` and builds a mesh; the emulated
+designs build in-proc or socket fabrics; and the ``xclbin_scan``
+memory-topology introspection (driver/utils/xclbin_scan) maps to per-device
+HBM stats.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+from typing import Dict, List, Optional, Sequence
+
+from ..communicator import Rank
+from ..constants import DEFAULT_RX_BUFFER_SIZE
+
+
+class Design(enum.Enum):
+    """Which transport/backend fabric to bootstrap (ref acclDesign)."""
+
+    INPROC = "inproc"  # emulated, all ranks in one process (CI tier)
+    SOCKET = "socket"  # emulated, one process per rank over TCP
+    ICI = "ici"  # XLA gang backend over the device mesh
+
+
+def generate_ranks(
+    design: Design,
+    world: int,
+    json_path: Optional[str] = None,
+    base_port: int = 47000,
+    segment_size: int = DEFAULT_RX_BUFFER_SIZE,
+) -> List[Rank]:
+    """Rank table for a world (ref generate_ranks: JSON cluster file or
+    synthetic subnet)."""
+    if json_path is not None:
+        with open(json_path) as f:
+            entries = json.load(f)
+        return [
+            Rank(
+                address=e["address"],
+                session=e.get("session", i),
+                max_segment_size=e.get("max_segment_size", segment_size),
+            )
+            for i, e in enumerate(entries)
+        ]
+    if design == Design.INPROC:
+        return [
+            Rank(f"inproc:{i}", session=i, max_segment_size=segment_size)
+            for i in range(world)
+        ]
+    if design == Design.SOCKET:
+        return [
+            Rank(f"127.0.0.1:{base_port + i}", session=i, max_segment_size=segment_size)
+            for i in range(world)
+        ]
+    return [Rank(f"xla:{i}", session=i, max_segment_size=segment_size) for i in range(world)]
+
+
+def bootstrap(
+    design: Design,
+    world: int,
+    rank: Optional[int] = None,
+    json_path: Optional[str] = None,
+    base_port: int = 47000,
+    **kwargs,
+):
+    """One-call group construction (ref initialize_accl).
+
+    INPROC / ICI return the whole group (single-controller); SOCKET returns
+    this process's member (give ``rank``)."""
+    from .. import core
+
+    if design == Design.INPROC:
+        return core.emulated_group(world, **kwargs)
+    if design == Design.ICI:
+        return core.xla_group(world, **kwargs)
+    if design == Design.SOCKET:
+        if rank is None:
+            raise ValueError("socket design needs this process's rank")
+        ranks = generate_ranks(
+            Design.SOCKET, world, json_path=json_path, base_port=base_port
+        )
+        return core.socket_group_member(
+            rank, [r.address for r in ranks], **kwargs
+        )
+    raise ValueError(design)
+
+
+def mesh_from_topology(axes: Optional[Dict[str, int]] = None):
+    """Build a Mesh over the visible devices, optionally shaped by named
+    axes (ref: communicator setup from slice topology, SURVEY.md §5)."""
+    import numpy as np
+
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if not axes:
+        return Mesh(np.array(devs), ("ranks",))
+    total = 1
+    for n in axes.values():
+        total *= n
+    if total > len(devs):
+        raise ValueError(f"axes need {total} devices, have {len(devs)}")
+    arr = np.array(devs[:total]).reshape(tuple(axes.values()))
+    return Mesh(arr, tuple(axes.keys()))
+
+
+def device_memory_report() -> List[Dict]:
+    """Per-device memory stats (the xclbin_scan role: what memory banks
+    exist and how full they are)."""
+    import jax
+
+    report = []
+    for d in jax.devices():
+        entry = {"id": d.id, "platform": d.platform, "kind": getattr(d, "device_kind", "?")}
+        try:
+            stats = d.memory_stats() or {}
+            entry["bytes_in_use"] = stats.get("bytes_in_use")
+            entry["bytes_limit"] = stats.get("bytes_limit")
+        except Exception:
+            pass
+        report.append(entry)
+    return report

@@ -1,0 +1,2 @@
+from .timing import Timer  # noqa: F401
+from .logging import Log, LogLevel  # noqa: F401

@@ -1,0 +1,51 @@
+"""Leveled engine logging (ref: the emulator's hlslib ``Log`` with a
+verbosity flag, cclo_emu.cpp:511-514 — every DMA/switch/packet event is
+printed at high verbosity).  Level comes from the ``ACCL_DEBUG`` env var
+like the reference host driver's ``debug()`` gate (driver/xrt/src/common.cpp).
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+import sys
+import threading
+import time
+
+
+class LogLevel(enum.IntEnum):
+    NONE = 0
+    ERROR = 1
+    INFO = 2
+    DEBUG = 3
+    TRACE = 4  # per-message wire events
+
+
+class Log:
+    _lock = threading.Lock()
+
+    def __init__(self, name: str, level=None):
+        self.name = name
+        if level is None:
+            level = int(os.environ.get("ACCL_DEBUG", "0"))
+        self.level = LogLevel(min(int(level), int(LogLevel.TRACE)))
+
+    def _emit(self, lvl: LogLevel, msg: str) -> None:
+        if lvl <= self.level:
+            with Log._lock:
+                print(
+                    f"[{time.monotonic():12.6f}] {lvl.name:5s} {self.name}: {msg}",
+                    file=sys.stderr,
+                )
+
+    def error(self, msg: str) -> None:
+        self._emit(LogLevel.ERROR, msg)
+
+    def info(self, msg: str) -> None:
+        self._emit(LogLevel.INFO, msg)
+
+    def debug(self, msg: str) -> None:
+        self._emit(LogLevel.DEBUG, msg)
+
+    def trace(self, msg: str) -> None:
+        self._emit(LogLevel.TRACE, msg)

@@ -1,0 +1,200 @@
+"""Per-collective message-size sweep -> CSV.
+
+Role model: the reference benchmark harness (``test/host/xrt/src/
+bench.cpp:25-61`` + ``fixture.hpp:134-152`` + ``parse_bench_results.py``):
+sweep 2^4..2^19 elements per collective, record per-call engine durations,
+write CSV.  Runs against any tier: the in-proc emulator (default, like the
+reference's CI emulator runs), the XLA gang backend, or the pure
+shard_map ops layer over the device mesh.
+
+Usage:
+    python benchmarks/sweep.py --backend emulator --world 4 --csv out.csv
+    python benchmarks/sweep.py --backend ops --world 8   # device mesh
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import os
+import sys
+import threading
+import time
+from typing import List
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+COLLECTIVES = [
+    "sendrecv",
+    "bcast",
+    "scatter",
+    "gather",
+    "allgather",
+    "reduce",
+    "reduce_scatter",
+    "allreduce",
+]
+
+
+def _run_group_op(group, op: str, count: int) -> float:
+    """One synchronized run across all rank handles; returns max engine
+    duration in ns (the reference records device cycle counts per rank)."""
+    durations = [0] * len(group)
+    world = len(group)
+
+    def work(i):
+        accl = group[i]
+        n = count
+        if op == "sendrecv":
+            if i == 0:
+                buf = accl.create_buffer_from(np.ones(n, np.float32))
+                req = accl.send(buf, n, dst=1, tag=0, run_async=True)
+            elif i == 1:
+                buf = accl.create_buffer(n, np.float32)
+                req = accl.recv(buf, n, src=0, tag=0, run_async=True)
+            else:
+                return
+        elif op == "bcast":
+            buf = accl.create_buffer_from(np.ones(n, np.float32))
+            req = accl.bcast(buf, n, root=0, run_async=True)
+        elif op == "scatter":
+            send = accl.create_buffer_from(np.ones(world * n, np.float32))
+            recv = accl.create_buffer(n, np.float32)
+            req = accl.scatter(send, recv, n, root=0, run_async=True)
+        elif op == "gather":
+            send = accl.create_buffer_from(np.ones(n, np.float32))
+            recv = accl.create_buffer(world * n, np.float32)
+            req = accl.gather(send, recv, n, root=0, run_async=True)
+        elif op == "allgather":
+            send = accl.create_buffer_from(np.ones(n, np.float32))
+            recv = accl.create_buffer(world * n, np.float32)
+            req = accl.allgather(send, recv, n, run_async=True)
+        elif op == "reduce":
+            send = accl.create_buffer_from(np.ones(n, np.float32))
+            recv = accl.create_buffer(n, np.float32)
+            req = accl.reduce(send, recv, n, root=0, run_async=True)
+        elif op == "reduce_scatter":
+            send = accl.create_buffer_from(np.ones(world * n, np.float32))
+            recv = accl.create_buffer(n, np.float32)
+            req = accl.reduce_scatter(send, recv, n, run_async=True)
+        elif op == "allreduce":
+            send = accl.create_buffer_from(np.ones(n, np.float32))
+            recv = accl.create_buffer(n, np.float32)
+            req = accl.allreduce(send, recv, n, run_async=True)
+        else:
+            raise ValueError(op)
+        assert req.wait(120), f"{op} count={n} rank={i} timed out"
+        req.check()
+        durations[i] = req.get_duration_ns()
+
+    errors: List[BaseException] = []
+
+    def guarded(i):
+        try:
+            work(i)
+        except BaseException as e:  # noqa: BLE001 - re-raised on the main thread
+            errors.append(e)
+
+    threads = [threading.Thread(target=guarded, args=(i,)) for i in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0]
+    return max(durations)
+
+
+def sweep_group(group, sizes: List[int], collectives: List[str], writer) -> None:
+    for op in collectives:
+        for n in sizes:
+            ns = _run_group_op(group, op, n)
+            writer.writerow(
+                {
+                    "collective": op,
+                    "count": n,
+                    "bytes": n * 4,
+                    "duration_ns": ns,
+                    "gbps": 8 * (n * 4) / max(ns, 1) if ns else 0.0,
+                }
+            )
+
+
+def sweep_ops(world: int, sizes: List[int], writer) -> None:
+    """Sweep the pure shard_map ops layer over the device mesh (wall-clock
+    around the jitted program; slope-corrected like bench.py would need on
+    tunneled backends is overkill here — this path is for CPU/TPU local)."""
+    import jax.numpy as jnp
+
+    from accl_tpu.ops import driver as opdriver
+
+    mesh = opdriver.make_mesh(world)
+    runners = {
+        "allreduce": opdriver.run_allreduce,
+        "allgather": opdriver.run_allgather,
+        "reduce_scatter": opdriver.run_reduce_scatter,
+        "bcast": opdriver.run_bcast,
+        "alltoall": opdriver.run_alltoall,
+    }
+    for op, fn in runners.items():
+        for n in sizes:
+            shape = (world, world * n) if op in ("reduce_scatter", "alltoall") else (world, n)
+            stacked = jnp.ones(shape, jnp.float32)
+            fn(stacked, mesh).block_until_ready()  # compile
+            t0 = time.perf_counter()
+            for _ in range(5):
+                out = fn(stacked, mesh)
+            out.block_until_ready()
+            ns = (time.perf_counter() - t0) / 5 * 1e9
+            writer.writerow(
+                {
+                    "collective": op,
+                    "count": n,
+                    "bytes": n * 4,
+                    "duration_ns": int(ns),
+                    "gbps": 8 * (n * 4) / max(ns, 1),
+                }
+            )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--backend", choices=["emulator", "xla", "ops"], default="emulator")
+    ap.add_argument("--world", type=int, default=4)
+    ap.add_argument("--min-exp", type=int, default=4)
+    ap.add_argument("--max-exp", type=int, default=19)
+    ap.add_argument("--csv", default="-")
+    ap.add_argument("--collectives", nargs="*", default=COLLECTIVES)
+    args = ap.parse_args(argv)
+
+    sizes = [2**e for e in range(args.min_exp, args.max_exp + 1)]
+    out = sys.stdout if args.csv == "-" else open(args.csv, "w", newline="")
+    writer = csv.DictWriter(
+        out, fieldnames=["collective", "count", "bytes", "duration_ns", "gbps"]
+    )
+    writer.writeheader()
+
+    if args.backend == "ops":
+        sweep_ops(args.world, sizes, writer)
+    else:
+        from accl_tpu import core
+
+        group = (
+            core.emulated_group(args.world)
+            if args.backend == "emulator"
+            else core.xla_group(args.world)
+        )
+        try:
+            sweep_group(group, sizes, args.collectives, writer)
+        finally:
+            for a in group:
+                a.deinit()
+    if out is not sys.stdout:
+        out.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,256 @@
+// Native dataplane hot paths for the emulated collective engine.
+//
+// Role models in the reference (bo3z/ACCL): the SIMD reduction kernels
+// (kernels/plugins/reduce_ops/reduce_ops.cpp — 512-bit SUM/MAX lanes over
+// {fp32, fp64, i32, i64, fp16}), the fp32<->fp16 compression lanes
+// (kernels/plugins/hp_compression/), and the RX-buffer signature matcher
+// (kernels/cclo/hls/rxbuf_offload/rxbuf_seek.cpp).  Re-designed as a plain
+// C ABI shared library: contiguous loops the compiler auto-vectorizes onto
+// AVX, driven from Python via ctypes.
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <vector>
+
+// ---------------------------------------------------------------------------
+// reductions: dst = dst (op) src, elementwise
+// dtype codes: 0=f32 1=f64 2=i32 3=i64 4=f16 (IEEE binary16)
+// op codes: 0=SUM 1=MAX  (ref reduceFunction, constants.hpp:218-221)
+// returns 0 on success, nonzero on unsupported combination
+// ---------------------------------------------------------------------------
+
+namespace {
+
+template <typename T>
+inline void sum_loop(T* dst, const T* src, size_t n) {
+  for (size_t i = 0; i < n; ++i) dst[i] += src[i];
+}
+
+template <typename T>
+inline void max_loop(T* dst, const T* src, size_t n) {
+  for (size_t i = 0; i < n; ++i) dst[i] = dst[i] > src[i] ? dst[i] : src[i];
+}
+
+// scalar IEEE binary16 <-> float conversion (no hardware fp16 assumed)
+inline float h2f(uint16_t h) {
+  uint32_t sign = (uint32_t)(h & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1f;
+  uint32_t man = h & 0x3ffu;
+  uint32_t bits;
+  if (exp == 0) {
+    if (man == 0) {
+      bits = sign;
+    } else {  // subnormal: normalize
+      int shift = 0;
+      while (!(man & 0x400u)) {
+        man <<= 1;
+        ++shift;
+      }
+      man &= 0x3ffu;
+      bits = sign | ((127 - 15 - shift + 1) << 23) | (man << 13);
+    }
+  } else if (exp == 0x1f) {
+    bits = sign | 0x7f800000u | (man << 13);
+  } else {
+    bits = sign | ((exp - 15 + 127) << 23) | (man << 13);
+  }
+  float f;
+  std::memcpy(&f, &bits, 4);
+  return f;
+}
+
+inline uint16_t f2h(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  uint32_t sign = (bits >> 16) & 0x8000u;
+  int32_t exp = (int32_t)((bits >> 23) & 0xff) - 127 + 15;
+  uint32_t man = bits & 0x7fffffu;
+  if (((bits >> 23) & 0xff) == 0xff) return (uint16_t)(sign | 0x7c00u | (man ? 0x200u : 0));
+  if (exp >= 0x1f) return (uint16_t)(sign | 0x7c00u);  // overflow -> inf
+  if (exp <= 0) {
+    if (exp < -10) return (uint16_t)sign;  // underflow -> 0
+    man |= 0x800000u;
+    uint32_t shift = (uint32_t)(14 - exp);
+    uint32_t half = man >> shift;
+    // round to nearest even
+    uint32_t rem = man & ((1u << shift) - 1);
+    uint32_t halfway = 1u << (shift - 1);
+    if (rem > halfway || (rem == halfway && (half & 1))) ++half;
+    return (uint16_t)(sign | half);
+  }
+  uint32_t half = (uint32_t)(exp << 10) | (man >> 13);
+  uint32_t rem = man & 0x1fffu;
+  if (rem > 0x1000u || (rem == 0x1000u && (half & 1))) ++half;
+  return (uint16_t)(sign | half);
+}
+
+}  // namespace
+
+extern "C" {
+
+int accl_reduce_inplace(int op, int dtype, void* dst, const void* src,
+                        size_t n) {
+  switch (dtype) {
+    case 0:
+      if (op == 0) sum_loop((float*)dst, (const float*)src, n);
+      else if (op == 1) max_loop((float*)dst, (const float*)src, n);
+      else return 2;
+      return 0;
+    case 1:
+      if (op == 0) sum_loop((double*)dst, (const double*)src, n);
+      else if (op == 1) max_loop((double*)dst, (const double*)src, n);
+      else return 2;
+      return 0;
+    case 2:
+      if (op == 0) sum_loop((int32_t*)dst, (const int32_t*)src, n);
+      else if (op == 1) max_loop((int32_t*)dst, (const int32_t*)src, n);
+      else return 2;
+      return 0;
+    case 3:
+      if (op == 0) sum_loop((int64_t*)dst, (const int64_t*)src, n);
+      else if (op == 1) max_loop((int64_t*)dst, (const int64_t*)src, n);
+      else return 2;
+      return 0;
+    case 4: {
+      uint16_t* d = (uint16_t*)dst;
+      const uint16_t* s = (const uint16_t*)src;
+      for (size_t i = 0; i < n; ++i) {
+        float a = h2f(d[i]), b = h2f(s[i]);
+        d[i] = f2h(op == 0 ? a + b : (a > b ? a : b));
+      }
+      return op <= 1 ? 0 : 2;
+    }
+    default:
+      return 1;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// dtype casts for wire compression (ref hp_compression fp2hp/hp2fp lanes,
+// extended with bf16 which is the TPU-native wire dtype)
+// ---------------------------------------------------------------------------
+
+void accl_f32_to_f16(const float* src, uint16_t* dst, size_t n) {
+  for (size_t i = 0; i < n; ++i) dst[i] = f2h(src[i]);
+}
+
+void accl_f16_to_f32(const uint16_t* src, float* dst, size_t n) {
+  for (size_t i = 0; i < n; ++i) dst[i] = h2f(src[i]);
+}
+
+void accl_f32_to_bf16(const float* src, uint16_t* dst, size_t n) {
+  for (size_t i = 0; i < n; ++i) {
+    uint32_t bits;
+    std::memcpy(&bits, &src[i], 4);
+    if ((bits & 0x7f800000u) == 0x7f800000u && (bits & 0x7fffffu)) {
+      // NaN: rounding-add would carry low-mantissa payloads into inf
+      dst[i] = (uint16_t)((bits >> 16) | 0x0040u);  // quiet, keep sign
+      continue;
+    }
+    uint32_t rounding = 0x7fffu + ((bits >> 16) & 1);  // round-nearest-even
+    dst[i] = (uint16_t)((bits + rounding) >> 16);
+  }
+}
+
+void accl_bf16_to_f32(const uint16_t* src, float* dst, size_t n) {
+  for (size_t i = 0; i < n; ++i) {
+    uint32_t bits = (uint32_t)src[i] << 16;
+    std::memcpy(&dst[i], &bits, 4);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// RX signature matcher: the rxbuf_seek role.  A fixed pool of slots holding
+// {comm, src, tag, seqn} signatures; fill() parks an arriving segment's
+// signature, seek() matches one and claims the slot, release() recycles.
+// Payload storage stays on the Python side, indexed by slot id.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct RxSlot {
+  int state;  // 0 idle, 1 filled, 2 claimed
+  uint32_t comm, src, tag;
+  uint64_t seqn;
+};
+
+struct RxPool {
+  std::vector<RxSlot> slots;
+  std::mutex mu;
+};
+
+std::vector<RxPool*> g_pools;
+std::mutex g_pools_mu;
+
+}  // namespace
+
+int accl_rxpool_create(int nslots) {
+  RxPool* p = new RxPool();
+  p->slots.assign((size_t)nslots, RxSlot{0, 0, 0, 0, 0});
+  std::lock_guard<std::mutex> g(g_pools_mu);
+  for (size_t i = 0; i < g_pools.size(); ++i) {
+    if (g_pools[i] == nullptr) {  // reuse destroyed ids
+      g_pools[i] = p;
+      return (int)i;
+    }
+  }
+  g_pools.push_back(p);
+  return (int)g_pools.size() - 1;
+}
+
+void accl_rxpool_destroy(int pool) {
+  std::lock_guard<std::mutex> g(g_pools_mu);
+  if (pool >= 0 && (size_t)pool < g_pools.size() && g_pools[(size_t)pool]) {
+    delete g_pools[(size_t)pool];
+    g_pools[(size_t)pool] = nullptr;
+  }
+}
+
+// returns slot index, or -1 when the pool is exhausted (backpressure)
+int accl_rxpool_fill(int pool, uint32_t comm, uint32_t src, uint32_t tag,
+                     uint64_t seqn) {
+  RxPool* p = g_pools[(size_t)pool];
+  std::lock_guard<std::mutex> g(p->mu);
+  for (size_t i = 0; i < p->slots.size(); ++i) {
+    if (p->slots[i].state == 0) {
+      p->slots[i] = RxSlot{1, comm, src, tag, seqn};
+      return (int)i;
+    }
+  }
+  return -1;
+}
+
+// returns matched slot index (claimed), or -1 when no match
+int accl_rxpool_seek(int pool, uint32_t comm, uint32_t src, uint32_t tag,
+                     uint64_t seqn) {
+  RxPool* p = g_pools[(size_t)pool];
+  std::lock_guard<std::mutex> g(p->mu);
+  for (size_t i = 0; i < p->slots.size(); ++i) {
+    RxSlot& s = p->slots[i];
+    if (s.state == 1 && s.comm == comm && s.src == src && s.tag == tag &&
+        s.seqn == seqn) {
+      s.state = 2;
+      return (int)i;
+    }
+  }
+  return -1;
+}
+
+void accl_rxpool_release(int pool, int slot) {
+  RxPool* p = g_pools[(size_t)pool];
+  std::lock_guard<std::mutex> g(p->mu);
+  p->slots[(size_t)slot].state = 0;
+}
+
+int accl_rxpool_occupancy(int pool) {
+  RxPool* p = g_pools[(size_t)pool];
+  std::lock_guard<std::mutex> g(p->mu);
+  int used = 0;
+  for (auto& s : p->slots)
+    if (s.state != 0) ++used;
+  return used;
+}
+
+}  // extern "C"

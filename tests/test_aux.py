@@ -1,0 +1,142 @@
+"""Auxiliary subsystems: topology bootstrap, launcher, timing/logging,
+device-kernel example, debug dumps — SURVEY.md §2.7/§2.5/§5 parity.
+"""
+
+import numpy as np
+import pytest
+
+from helpers import run_parallel
+
+
+def test_generate_ranks_synthetic():
+    from accl_tpu.parallel import Design, generate_ranks
+
+    ranks = generate_ranks(Design.SOCKET, 4, base_port=48000)
+    assert [r.address for r in ranks] == [
+        f"127.0.0.1:{48000 + i}" for i in range(4)
+    ]
+    assert [r.session for r in ranks] == [0, 1, 2, 3]
+
+
+def test_generate_ranks_json(tmp_path):
+    import json
+
+    from accl_tpu.parallel import Design, generate_ranks
+
+    path = tmp_path / "cluster.json"
+    path.write_text(
+        json.dumps(
+            [
+                {"address": "10.0.0.1:5000", "max_segment_size": 2048},
+                {"address": "10.0.0.2:5000", "session": 7},
+            ]
+        )
+    )
+    ranks = generate_ranks(Design.SOCKET, 2, json_path=str(path))
+    assert ranks[0].address == "10.0.0.1:5000"
+    assert ranks[0].max_segment_size == 2048
+    assert ranks[1].session == 7
+
+
+def test_bootstrap_inproc():
+    from accl_tpu.parallel import Design, bootstrap
+
+    group = bootstrap(Design.INPROC, 2)
+    try:
+        a, b = group
+        import threading
+
+        def sender():
+            buf = b.create_buffer_from(np.full(8, 5.0, np.float32))
+            b.send(buf, 8, dst=0, tag=1)
+
+        t = threading.Thread(target=sender)
+        t.start()
+        buf = a.create_buffer(8, np.float32)
+        a.recv(buf, 8, src=1, tag=1)
+        t.join(10)
+        buf.sync_from_device()
+        np.testing.assert_array_equal(buf.data, np.full(8, 5.0, np.float32))
+    finally:
+        for x in group:
+            x.deinit()
+
+
+def test_mesh_from_topology():
+    from accl_tpu.parallel import mesh_from_topology
+
+    mesh = mesh_from_topology({"dp": 2, "tp": 4})
+    assert mesh.shape == {"dp": 2, "tp": 4}
+
+
+def test_device_memory_report():
+    from accl_tpu.parallel import device_memory_report
+
+    report = device_memory_report()
+    assert len(report) >= 8
+    assert all("platform" in e for e in report)
+
+
+def test_timer():
+    import time
+
+    from accl_tpu.utils import Timer
+
+    with Timer() as t:
+        time.sleep(0.01)
+    assert 8_000 < t.elapsed_us() < 1_000_000
+
+
+def test_log_levels(capsys):
+    from accl_tpu.utils import Log, LogLevel
+
+    log = Log("test", level=LogLevel.INFO)
+    log.info("visible")
+    log.trace("hidden")
+    err = capsys.readouterr().err
+    assert "visible" in err and "hidden" not in err
+
+
+def test_vadd_put_example(group2, rng):
+    """The device-kernel-initiated flow (ref vadd_put.cpp demo)."""
+    from accl_tpu.examples.vadd_put import vadd_put, vadd_put_streamed
+
+    data = rng.standard_normal(64).astype(np.float32)
+
+    def work(accl, rank):
+        if rank == 0:
+            vadd_put(accl, data, dst=1, stream_id=3)
+            return None
+        buf = accl.create_buffer(64, np.float32)
+        accl.recv(buf, 64, src=0, tag=3)
+        buf.sync_from_device()
+        return buf.data.copy()
+
+    res = run_parallel(group2, work)
+    np.testing.assert_allclose(res[1], data + 1.0, rtol=1e-6)
+
+    def work2(accl, rank):
+        if rank == 0:
+            vadd_put_streamed(accl, data, dst=1, stream_id=4)
+            return None
+        return accl.stream_pop(64, np.float32, stream_id=4)
+
+    res = run_parallel(group2, work2)
+    np.testing.assert_allclose(res[1], data + 1.0, rtol=1e-6)
+
+
+def test_debug_dumps(group2):
+    a = group2[0]
+    rx = a.dump_rx_buffers()
+    assert "rxbuf[0]" in rx
+    comm = a.dump_communicator()
+    assert "size=2" in comm and "rank 0" in comm
+
+
+def test_launcher_multiprocess():
+    """The mpirun-analog: N OS processes over the socket fabric."""
+    from accl_tpu.launch import launch_processes
+    from tests_launch_target import allreduce_main  # see module below
+
+    results = launch_processes(allreduce_main, world=2, base_port=47411)
+    assert results == [3.0, 3.0]

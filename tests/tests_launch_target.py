@@ -1,0 +1,13 @@
+"""Module-level target functions for launcher tests (must be importable in
+spawned worker processes)."""
+
+import numpy as np
+
+
+def allreduce_main(accl, rank, world):
+    n = 100
+    send = accl.create_buffer_from(np.full(n, float(rank + 1), np.float32))
+    recv = accl.create_buffer(n, np.float32)
+    accl.allreduce(send, recv, n)
+    recv.sync_from_device()
+    return float(recv.data[0])
