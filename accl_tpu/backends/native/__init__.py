@@ -1,0 +1,22 @@
+"""Native backend: the C++ collective engine driven via ctypes.
+
+The reference implements its control plane and host runtime in native code
+(C firmware ``ccl_offload_control.c`` + C++ driver ``driver/xrt``); this
+backend is our equivalent — the full eager/rendezvous protocol engine and
+every collective algorithm live in C++ (``native/src/engine/``), built into
+``libaccl_engine.so``.  Python supplies only the facade: `NativeEngine`
+adapts `CallOptions` records onto the C ABI, exactly as the reference's thin
+``hostctrl`` kernel forwards 15 scalar args to the CCLO.
+
+Two transports, mirroring the emulator backend's tiers:
+
+* INPROC — all rank engines in one process (CI tier)
+* SOCKET — one process per rank over TCP (the per-rank-process tier)
+"""
+
+from .engine import (  # noqa: F401
+    NativeEngine,
+    engine_library_available,
+    native_group,
+    native_socket_member,
+)

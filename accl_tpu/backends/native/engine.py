@@ -1,0 +1,346 @@
+"""ctypes binding for the native C++ collective engine (libaccl_engine.so).
+
+Role split (mirrors the reference): Python is the host driver facade; the
+C++ library owns scheduling, protocol state machines (eager segmentation with
+per-peer sequence numbers, rendezvous address handshake), RX buffer matching,
+reductions/casts, and both transports.  See ``native/src/engine/`` for the
+firmware-role citations.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import itertools
+import threading
+from typing import List, Optional, Sequence
+
+from ...buffer import BaseBuffer
+from ...communicator import Communicator, Rank
+from ...constants import (
+    DEFAULT_RX_BUFFER_COUNT,
+    DEFAULT_RX_BUFFER_SIZE,
+    ErrorCode,
+)
+from ...request import Request
+from ..base import BaseEngine, CallOptions
+from ... import native as _native_dataplane
+
+_group_ids = itertools.count(0)
+
+_LIB = None
+_LOAD_ATTEMPTED = False
+
+
+class _CallArgs(ctypes.Structure):
+    """Field-for-field mirror of accl::CallArgs (native/src/engine/accl_engine.h)."""
+
+    _fields_ = [
+        ("op", ctypes.c_int32),
+        ("comm_id", ctypes.c_uint32),
+        ("count", ctypes.c_int64),
+        ("root_src", ctypes.c_int32),
+        ("root_dst", ctypes.c_int32),
+        ("tag", ctypes.c_uint32),
+        ("rfunc", ctypes.c_int32),
+        ("acc_dtype", ctypes.c_int32),
+        ("cmp_dtype", ctypes.c_int32),
+        ("supports_rfunc", ctypes.c_int32),
+        ("compression", ctypes.c_uint32),
+        ("stream_flags", ctypes.c_uint32),
+        ("stream_id", ctypes.c_int32),
+        ("cfg_function", ctypes.c_int32),
+        ("cfg_value", ctypes.c_double),
+        ("op0", ctypes.c_void_p),
+        ("op1", ctypes.c_void_p),
+        ("res", ctypes.c_void_p),
+        ("op0_dtype", ctypes.c_int32),
+        ("op1_dtype", ctypes.c_int32),
+        ("res_dtype", ctypes.c_int32),
+        ("pad_", ctypes.c_int32),
+    ]
+
+
+def _bind(lib) -> None:
+    c = ctypes
+    lib.accl_ng_engine_new.restype = c.c_int
+    lib.accl_ng_engine_new.argtypes = [c.c_char_p, c.c_int, c.c_int, c.c_int]
+    lib.accl_ng_engine_shutdown.restype = None
+    lib.accl_ng_engine_shutdown.argtypes = [c.c_int]
+    lib.accl_ng_add_comm.restype = c.c_int
+    lib.accl_ng_add_comm.argtypes = [
+        c.c_int, c.c_uint32, c.c_int, c.c_int,
+        c.POINTER(c.c_char_p), c.POINTER(c.c_uint32),
+    ]
+    lib.accl_ng_start.restype = c.c_uint64
+    lib.accl_ng_start.argtypes = [c.c_int, c.POINTER(_CallArgs)]
+    lib.accl_ng_wait.restype = c.c_int
+    lib.accl_ng_wait.argtypes = [c.c_int, c.c_uint64, c.c_double]
+    lib.accl_ng_test.restype = c.c_int
+    lib.accl_ng_test.argtypes = [c.c_int, c.c_uint64]
+    lib.accl_ng_retcode.restype = c.c_uint32
+    lib.accl_ng_retcode.argtypes = [c.c_int, c.c_uint64]
+    lib.accl_ng_duration_ns.restype = c.c_int64
+    lib.accl_ng_duration_ns.argtypes = [c.c_int, c.c_uint64]
+    lib.accl_ng_free_request.restype = None
+    lib.accl_ng_free_request.argtypes = [c.c_int, c.c_uint64]
+    lib.accl_ng_stream_push.restype = None
+    lib.accl_ng_stream_push.argtypes = [c.c_int, c.c_int, c.c_void_p, c.c_int64]
+    lib.accl_ng_stream_pop.restype = c.c_int64
+    lib.accl_ng_stream_pop.argtypes = [
+        c.c_int, c.c_int, c.c_void_p, c.c_int64, c.c_double,
+    ]
+    lib.accl_ng_rx_occupancy.restype = c.c_int
+    lib.accl_ng_rx_occupancy.argtypes = [c.c_int]
+    lib.accl_ng_rx_capacity.restype = c.c_int
+    lib.accl_ng_rx_capacity.argtypes = [c.c_int]
+
+
+def _load():
+    global _LIB, _LOAD_ATTEMPTED
+    if _LOAD_ATTEMPTED:
+        return _LIB
+    _LOAD_ATTEMPTED = True
+    so = _native_dataplane._NATIVE_DIR / "build" / "libaccl_engine.so"
+    if not so.exists():
+        _native_dataplane._try_build()
+    if not so.exists():
+        return None
+    try:
+        lib = ctypes.CDLL(str(so))
+        _bind(lib)
+    except (OSError, AttributeError):
+        return None
+    _LIB = lib
+    return _LIB
+
+
+def engine_library_available() -> bool:
+    return _load() is not None
+
+
+class NativeRequest(Request):
+    """Request completed inside the C++ engine; wait/test bridge the C ABI."""
+
+    def __init__(self, engine: "NativeEngine", native_id: int, op_name: str,
+                 keepalive):
+        super().__init__(op_name=op_name)
+        self._engine = engine
+        self._native_id = native_id
+        self._keepalive = keepalive  # numpy views the engine writes into
+        self._fin_lock = threading.Lock()
+
+    def _finalize(self) -> None:
+        with self._fin_lock:
+            if self._done.is_set():
+                return
+            lib, h = self._engine._lib, self._engine._handle
+            ret = ErrorCode(lib.accl_ng_retcode(h, self._native_id))
+            dur = lib.accl_ng_duration_ns(h, self._native_id)
+            lib.accl_ng_free_request(h, self._native_id)
+            self._keepalive = None
+            self.complete(ret, dur)
+
+    def test(self) -> bool:
+        if self._done.is_set():
+            return True
+        if self._engine._lib.accl_ng_test(
+            self._engine._handle, self._native_id
+        ):
+            self._finalize()
+            return True
+        return False
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        if self._done.is_set():
+            return True
+        t = -1.0 if timeout is None else float(timeout)
+        if self._engine._lib.accl_ng_wait(
+            self._engine._handle, self._native_id, t
+        ):
+            self._finalize()
+            return True
+        return False
+
+
+class NativeEngine(BaseEngine):
+    """One rank's handle onto the C++ engine."""
+
+    TRANSPORT_INPROC = 0
+    TRANSPORT_SOCKET = 1
+
+    def __init__(
+        self,
+        address: str,
+        transport: int = TRANSPORT_INPROC,
+        rx_buffer_count: int = DEFAULT_RX_BUFFER_COUNT,
+        rx_buffer_size: int = DEFAULT_RX_BUFFER_SIZE,
+    ):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(
+                "libaccl_engine.so unavailable (native toolchain missing?)"
+            )
+        self._lib = lib
+        self.address = address
+        self._handle = lib.accl_ng_engine_new(
+            address.encode(), transport, rx_buffer_count, rx_buffer_size
+        )
+        if self._handle < 0:
+            raise RuntimeError(f"native engine failed to open {address!r}")
+        self._registered_comms: set = set()
+        self._shut = False
+
+    # -- plumbing ------------------------------------------------------------
+    def _ensure_comm(self, comm: Communicator) -> None:
+        if comm.id in self._registered_comms:
+            return
+        n = comm.size
+        addrs = (ctypes.c_char_p * n)(
+            *[r.address.encode() for r in comm.ranks]
+        )
+        segs = (ctypes.c_uint32 * n)(
+            *[r.max_segment_size for r in comm.ranks]
+        )
+        rc = self._lib.accl_ng_add_comm(
+            self._handle, comm.id, comm.local_rank, n, addrs, segs
+        )
+        if rc != 0:
+            raise RuntimeError("add_comm failed")
+        self._registered_comms.add(comm.id)
+
+    @staticmethod
+    def _operand(buf: Optional[BaseBuffer]):
+        """(pointer, dtype code, keepalive view) for one operand."""
+        if buf is None or buf.is_dummy:
+            return 0, 0, None
+        view = buf.device_view()
+        return view.ctypes.data, int(buf.dtype), view
+
+    def start(self, options: CallOptions) -> Request:
+        args = _CallArgs()
+        args.op = int(options.op)
+        args.cfg_function = int(options.cfg_function)
+        args.cfg_value = float(options.cfg_value)
+        args.count = int(options.count)
+        args.root_src = int(options.root_src)
+        args.root_dst = int(options.root_dst)
+        args.tag = int(options.tag) & 0xFFFFFFFF
+        args.rfunc = int(options.reduce_function)
+        args.compression = int(options.compression)
+        args.stream_flags = int(options.stream)
+        args.stream_id = int(options.stream_id)
+        if options.comm is not None:
+            self._ensure_comm(options.comm)
+            args.comm_id = options.comm.id
+        cfg = options.arithcfg
+        if cfg is not None:
+            args.acc_dtype = int(cfg.uncompressed)
+            args.cmp_dtype = int(cfg.compressed)
+            args.supports_rfunc = int(cfg.supports(options.reduce_function))
+        else:
+            args.acc_dtype = args.cmp_dtype = 2  # FLOAT32
+            args.supports_rfunc = 1
+        keep = []
+        args.op0, args.op0_dtype, k0 = self._operand(options.op0)
+        args.op1, args.op1_dtype, k1 = self._operand(options.op1)
+        args.res, args.res_dtype, k2 = self._operand(options.res)
+        keep = [k for k in (k0, k1, k2) if k is not None]
+        native_id = self._lib.accl_ng_start(self._handle, ctypes.byref(args))
+        req = NativeRequest(self, native_id, options.op.name, keep)
+        req.mark_executing()
+        return req
+
+    def shutdown(self) -> None:
+        if not self._shut:
+            self._shut = True
+            self._lib.accl_ng_engine_shutdown(self._handle)
+
+    # -- device stream ports -------------------------------------------------
+    def stream_push(self, stream_id: int, data: bytes) -> None:
+        self._lib.accl_ng_stream_push(
+            self._handle, stream_id, data, len(data)
+        )
+
+    def stream_pop(self, stream_id: int, timeout: Optional[float] = None) -> bytes:
+        t = 30.0 if timeout is None else float(timeout)
+        cap = 1 << 16
+        while True:
+            out = ctypes.create_string_buffer(cap)
+            n = self._lib.accl_ng_stream_pop(
+                self._handle, stream_id, out, cap, t
+            )
+            if n < 0:
+                raise TimeoutError(f"stream {stream_id} pop timed out")
+            if n <= cap:
+                return out.raw[:n]
+            cap = int(n)  # chunk bigger than buffer: retry with exact size
+
+    # -- debug (ref ACCL::dump_eager_rx_buffers) -----------------------------
+    def dump_rx_buffers(self) -> str:
+        used = self._lib.accl_ng_rx_occupancy(self._handle)
+        total = self._lib.accl_ng_rx_capacity(self._handle)
+        return "\n".join(
+            f"rxbuf[{i}] {'FILLED' if i < used else 'IDLE'}"
+            for i in range(total)
+        )
+
+
+# ---------------------------------------------------------------------------
+# group constructors (mirror core.emulated_group / socket_group_member)
+# ---------------------------------------------------------------------------
+
+
+def native_group(
+    n: int,
+    rx_buffer_count: int = DEFAULT_RX_BUFFER_COUNT,
+    rx_buffer_size: int = DEFAULT_RX_BUFFER_SIZE,
+    **accl_kwargs,
+) -> List:
+    """N ranks in one process over the C++ in-proc transport."""
+    from ...core import ACCL
+
+    # unique address namespace per group so groups never collide in the
+    # process-wide native registry
+    gid = next(_group_ids)
+    ranks = [
+        Rank(
+            address=f"native:{gid}:{i}",
+            session=i,
+            max_segment_size=rx_buffer_size,
+        )
+        for i in range(n)
+    ]
+    engines = [
+        NativeEngine(
+            f"native:{gid}:{i}",
+            NativeEngine.TRANSPORT_INPROC,
+            rx_buffer_count=rx_buffer_count,
+            rx_buffer_size=rx_buffer_size,
+        )
+        for i in range(n)
+    ]
+    return [ACCL(engines[i], ranks, i, **accl_kwargs) for i in range(n)]
+
+
+def native_socket_member(
+    rank: int,
+    addresses: Sequence[str],
+    rx_buffer_count: int = DEFAULT_RX_BUFFER_COUNT,
+    rx_buffer_size: int = DEFAULT_RX_BUFFER_SIZE,
+    **accl_kwargs,
+):
+    """This process's member of a multi-process native group over TCP (one
+    process per rank, the reference's per-rank emulator-process layout)."""
+    from ...core import ACCL
+
+    ranks = [
+        Rank(address=a, session=i, max_segment_size=rx_buffer_size)
+        for i, a in enumerate(addresses)
+    ]
+    engine = NativeEngine(
+        addresses[rank],
+        NativeEngine.TRANSPORT_SOCKET,
+        rx_buffer_count=rx_buffer_count,
+        rx_buffer_size=rx_buffer_size,
+    )
+    return ACCL(engine, ranks, rank, **accl_kwargs)

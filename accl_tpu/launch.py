@@ -26,7 +26,7 @@ import traceback
 from typing import Callable, List, Optional
 
 
-def _worker(fn_spec, rank, world, base_port, conn):
+def _worker(fn_spec, rank, world, base_port, design_name, conn):
     try:
         if isinstance(fn_spec, tuple):  # (script_path, fn_name) from the CLI
             import importlib.util
@@ -42,7 +42,9 @@ def _worker(fn_spec, rank, world, base_port, conn):
             fn = pickle.loads(fn_spec)
         from .parallel.topology import Design, bootstrap
 
-        accl = bootstrap(Design.SOCKET, world, rank=rank, base_port=base_port)
+        accl = bootstrap(
+            Design(design_name), world, rank=rank, base_port=base_port
+        )
         try:
             result = fn(accl, rank, world)
         finally:
@@ -57,12 +59,15 @@ def launch_processes(
     world: int,
     base_port: int = 47300,
     timeout: float = 120.0,
+    design: str = "socket",
 ) -> List:
     """Run ``fn(accl, rank, world)`` in ``world`` separate OS processes over
-    the TCP socket fabric; returns per-rank results, raises on any failure.
+    a per-rank TCP fabric; returns per-rank results, raises on any failure.
 
-    ``fn`` is either a picklable module-level function or a
-    ``(script_path, fn_name)`` tuple loaded fresh in each worker."""
+    ``design`` selects the engine tier: "socket" (Python emulator) or
+    "native_socket" (C++ engine).  ``fn`` is either a picklable module-level
+    function or a ``(script_path, fn_name)`` tuple loaded fresh in each
+    worker."""
     ctx = mp.get_context("spawn")
     payload = fn if isinstance(fn, tuple) else pickle.dumps(fn)
     procs = []
@@ -70,9 +75,12 @@ def launch_processes(
     for r in range(world):
         parent, child = ctx.Pipe()
         p = ctx.Process(
-            target=_worker, args=(payload, r, world, base_port, child)
+            target=_worker, args=(payload, r, world, base_port, design, child)
         )
         p.start()
+        # drop the parent's copy of the child end so a crashed worker
+        # surfaces as EOF instead of a silent full-timeout wait
+        child.close()
         procs.append(p)
         conns.append(parent)
     results = [None] * world
@@ -113,6 +121,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(description="accl_tpu multi-process launcher")
     ap.add_argument("-n", "--world", type=int, default=2)
     ap.add_argument("--base-port", type=int, default=47300)
+    ap.add_argument(
+        "--design",
+        default="socket",
+        choices=["socket", "native_socket"],
+        help="per-rank engine tier: Python emulator or native C++ engine",
+    )
     ap.add_argument("script")
     args = ap.parse_args(argv)
 
@@ -120,6 +134,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         (os.path.abspath(args.script), "main"),
         args.world,
         base_port=args.base_port,
+        design=args.design,
     )
     return 0
 

@@ -27,6 +27,8 @@ class Design(enum.Enum):
 
     INPROC = "inproc"  # emulated, all ranks in one process (CI tier)
     SOCKET = "socket"  # emulated, one process per rank over TCP
+    NATIVE = "native"  # C++ engine, all ranks in one process
+    NATIVE_SOCKET = "native_socket"  # C++ engine, one process per rank
     ICI = "ici"  # XLA gang backend over the device mesh
 
 
@@ -79,14 +81,24 @@ def bootstrap(
 
     if design == Design.INPROC:
         return core.emulated_group(world, **kwargs)
+    if design == Design.NATIVE:
+        from ..backends.native import native_group
+
+        return native_group(world, **kwargs)
     if design == Design.ICI:
         return core.xla_group(world, **kwargs)
-    if design == Design.SOCKET:
+    if design in (Design.SOCKET, Design.NATIVE_SOCKET):
         if rank is None:
-            raise ValueError("socket design needs this process's rank")
+            raise ValueError("socket designs need this process's rank")
         ranks = generate_ranks(
             Design.SOCKET, world, json_path=json_path, base_port=base_port
         )
+        if design == Design.NATIVE_SOCKET:
+            from ..backends.native import native_socket_member
+
+            return native_socket_member(
+                rank, [r.address for r in ranks], **kwargs
+            )
         return core.socket_group_member(
             rank, [r.address for r in ranks], **kwargs
         )

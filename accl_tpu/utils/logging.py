@@ -27,8 +27,15 @@ class Log:
     def __init__(self, name: str, level=None):
         self.name = name
         if level is None:
-            level = int(os.environ.get("ACCL_DEBUG", "0"))
-        self.level = LogLevel(min(int(level), int(LogLevel.TRACE)))
+            raw = os.environ.get("ACCL_DEBUG", "0")
+            try:
+                level = int(raw)
+            except ValueError:
+                # accept level names ("trace"); anything else means off —
+                # a debug env var must never crash startup
+                level = getattr(LogLevel, raw.strip().upper(), LogLevel.NONE)
+        clamped = max(int(LogLevel.NONE), min(int(level), int(LogLevel.TRACE)))
+        self.level = LogLevel(clamped)
 
     def _emit(self, lvl: LogLevel, msg: str) -> None:
         if lvl <= self.level:

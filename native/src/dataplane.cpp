@@ -14,6 +14,9 @@
 #include <mutex>
 #include <vector>
 
+#include "fp16.h"
+#include "reduce.h"
+
 // ---------------------------------------------------------------------------
 // reductions: dst = dst (op) src, elementwise
 // dtype codes: 0=f32 1=f64 2=i32 3=i64 4=f16 (IEEE binary16)
@@ -23,68 +26,10 @@
 
 namespace {
 
-template <typename T>
-inline void sum_loop(T* dst, const T* src, size_t n) {
-  for (size_t i = 0; i < n; ++i) dst[i] += src[i];
-}
-
-template <typename T>
-inline void max_loop(T* dst, const T* src, size_t n) {
-  for (size_t i = 0; i < n; ++i) dst[i] = dst[i] > src[i] ? dst[i] : src[i];
-}
-
-// scalar IEEE binary16 <-> float conversion (no hardware fp16 assumed)
-inline float h2f(uint16_t h) {
-  uint32_t sign = (uint32_t)(h & 0x8000u) << 16;
-  uint32_t exp = (h >> 10) & 0x1f;
-  uint32_t man = h & 0x3ffu;
-  uint32_t bits;
-  if (exp == 0) {
-    if (man == 0) {
-      bits = sign;
-    } else {  // subnormal: normalize
-      int shift = 0;
-      while (!(man & 0x400u)) {
-        man <<= 1;
-        ++shift;
-      }
-      man &= 0x3ffu;
-      bits = sign | ((127 - 15 - shift + 1) << 23) | (man << 13);
-    }
-  } else if (exp == 0x1f) {
-    bits = sign | 0x7f800000u | (man << 13);
-  } else {
-    bits = sign | ((exp - 15 + 127) << 23) | (man << 13);
-  }
-  float f;
-  std::memcpy(&f, &bits, 4);
-  return f;
-}
-
-inline uint16_t f2h(float f) {
-  uint32_t bits;
-  std::memcpy(&bits, &f, 4);
-  uint32_t sign = (bits >> 16) & 0x8000u;
-  int32_t exp = (int32_t)((bits >> 23) & 0xff) - 127 + 15;
-  uint32_t man = bits & 0x7fffffu;
-  if (((bits >> 23) & 0xff) == 0xff) return (uint16_t)(sign | 0x7c00u | (man ? 0x200u : 0));
-  if (exp >= 0x1f) return (uint16_t)(sign | 0x7c00u);  // overflow -> inf
-  if (exp <= 0) {
-    if (exp < -10) return (uint16_t)sign;  // underflow -> 0
-    man |= 0x800000u;
-    uint32_t shift = (uint32_t)(14 - exp);
-    uint32_t half = man >> shift;
-    // round to nearest even
-    uint32_t rem = man & ((1u << shift) - 1);
-    uint32_t halfway = 1u << (shift - 1);
-    if (rem > halfway || (rem == halfway && (half & 1))) ++half;
-    return (uint16_t)(sign | half);
-  }
-  uint32_t half = (uint32_t)(exp << 10) | (man >> 13);
-  uint32_t rem = man & 0x1fffu;
-  if (rem > 0x1000u || (rem == 0x1000u && (half & 1))) ++half;
-  return (uint16_t)(sign | half);
-}
+using accl_fp::f2h;
+using accl_fp::h2f;
+using accl_reduce::max_loop;
+using accl_reduce::sum_loop;
 
 }  // namespace
 
@@ -141,24 +86,11 @@ void accl_f16_to_f32(const uint16_t* src, float* dst, size_t n) {
 }
 
 void accl_f32_to_bf16(const float* src, uint16_t* dst, size_t n) {
-  for (size_t i = 0; i < n; ++i) {
-    uint32_t bits;
-    std::memcpy(&bits, &src[i], 4);
-    if ((bits & 0x7f800000u) == 0x7f800000u && (bits & 0x7fffffu)) {
-      // NaN: rounding-add would carry low-mantissa payloads into inf
-      dst[i] = (uint16_t)((bits >> 16) | 0x0040u);  // quiet, keep sign
-      continue;
-    }
-    uint32_t rounding = 0x7fffu + ((bits >> 16) & 1);  // round-nearest-even
-    dst[i] = (uint16_t)((bits + rounding) >> 16);
-  }
+  for (size_t i = 0; i < n; ++i) dst[i] = accl_fp::f2bf(src[i]);
 }
 
 void accl_bf16_to_f32(const uint16_t* src, float* dst, size_t n) {
-  for (size_t i = 0; i < n; ++i) {
-    uint32_t bits = (uint32_t)src[i] << 16;
-    std::memcpy(&dst[i], &bits, 4);
-  }
+  for (size_t i = 0; i < n; ++i) dst[i] = accl_fp::bf2f(src[i]);
 }
 
 // ---------------------------------------------------------------------------
@@ -183,6 +115,14 @@ struct RxPool {
 
 std::vector<RxPool*> g_pools;
 std::mutex g_pools_mu;
+
+// fetch under the registry lock: create's push_back may reallocate the
+// vector while another thread's fill/seek is executing
+RxPool* get_pool(int pool) {
+  std::lock_guard<std::mutex> g(g_pools_mu);
+  if (pool < 0 || (size_t)pool >= g_pools.size()) return nullptr;
+  return g_pools[(size_t)pool];
+}
 
 }  // namespace
 
@@ -211,7 +151,8 @@ void accl_rxpool_destroy(int pool) {
 // returns slot index, or -1 when the pool is exhausted (backpressure)
 int accl_rxpool_fill(int pool, uint32_t comm, uint32_t src, uint32_t tag,
                      uint64_t seqn) {
-  RxPool* p = g_pools[(size_t)pool];
+  RxPool* p = get_pool(pool);
+  if (!p) return -1;
   std::lock_guard<std::mutex> g(p->mu);
   for (size_t i = 0; i < p->slots.size(); ++i) {
     if (p->slots[i].state == 0) {
@@ -225,7 +166,8 @@ int accl_rxpool_fill(int pool, uint32_t comm, uint32_t src, uint32_t tag,
 // returns matched slot index (claimed), or -1 when no match
 int accl_rxpool_seek(int pool, uint32_t comm, uint32_t src, uint32_t tag,
                      uint64_t seqn) {
-  RxPool* p = g_pools[(size_t)pool];
+  RxPool* p = get_pool(pool);
+  if (!p) return -1;
   std::lock_guard<std::mutex> g(p->mu);
   for (size_t i = 0; i < p->slots.size(); ++i) {
     RxSlot& s = p->slots[i];
@@ -239,13 +181,15 @@ int accl_rxpool_seek(int pool, uint32_t comm, uint32_t src, uint32_t tag,
 }
 
 void accl_rxpool_release(int pool, int slot) {
-  RxPool* p = g_pools[(size_t)pool];
+  RxPool* p = get_pool(pool);
+  if (!p) return;
   std::lock_guard<std::mutex> g(p->mu);
   p->slots[(size_t)slot].state = 0;
 }
 
 int accl_rxpool_occupancy(int pool) {
-  RxPool* p = g_pools[(size_t)pool];
+  RxPool* p = get_pool(pool);
+  if (!p) return 0;
   std::lock_guard<std::mutex> g(p->mu);
   int used = 0;
   for (auto& s : p->slots)

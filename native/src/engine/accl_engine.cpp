@@ -1,0 +1,645 @@
+// Engine core: dtype arithmetic, transports, request machinery.
+// See accl_engine.h for the role map onto the reference.
+
+#include "accl_engine.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstring>
+
+#include "../fp16.h"
+#include "../reduce.h"
+
+namespace accl {
+
+using Clock = std::chrono::steady_clock;
+
+// --------------------------------------------------------------------------
+// dtype arithmetic (role: reduce_ops + hp_compression plugins)
+// --------------------------------------------------------------------------
+
+size_t dtype_size(int32_t dt) {
+  switch (dt) {
+    case DT_F16:
+    case DT_BF16:
+      return 2;
+    case DT_F32:
+    case DT_I32:
+      return 4;
+    case DT_F64:
+    case DT_I64:
+      return 8;
+    case DT_I8:
+      return 1;
+    default:
+      return 0;
+  }
+}
+
+namespace {
+
+template <typename T>
+bool reduce_typed(int32_t rfunc, T* d, const T* s, size_t n) {
+  if (rfunc == RF_SUM)
+    accl_reduce::sum_loop(d, s, n);
+  else if (rfunc == RF_MAX)
+    accl_reduce::max_loop(d, s, n);
+  else
+    return false;
+  return true;
+}
+
+// read/write one element as double through the dtype's encoding
+double load_elem(const uint8_t* p, int32_t dt) {
+  switch (dt) {
+    case DT_F16:
+      return accl_fp::h2f(*(const uint16_t*)p);
+    case DT_BF16:
+      return accl_fp::bf2f(*(const uint16_t*)p);
+    case DT_F32:
+      return *(const float*)p;
+    case DT_F64:
+      return *(const double*)p;
+    case DT_I32:
+      return (double)*(const int32_t*)p;
+    case DT_I64:
+      return (double)*(const int64_t*)p;
+    case DT_I8:
+      return (double)*(const int8_t*)p;
+    default:
+      return 0.0;
+  }
+}
+
+void store_elem(uint8_t* p, int32_t dt, double v) {
+  switch (dt) {
+    case DT_F16:
+      *(uint16_t*)p = accl_fp::f2h((float)v);
+      break;
+    case DT_BF16:
+      *(uint16_t*)p = accl_fp::f2bf((float)v);
+      break;
+    case DT_F32:
+      *(float*)p = (float)v;
+      break;
+    case DT_F64:
+      *(double*)p = v;
+      break;
+    case DT_I32:
+      *(int32_t*)p = (int32_t)v;
+      break;
+    case DT_I64:
+      *(int64_t*)p = (int64_t)v;
+      break;
+    case DT_I8:
+      *(int8_t*)p = (int8_t)v;
+      break;
+    default:
+      break;
+  }
+}
+
+}  // namespace
+
+bool reduce_inplace(int32_t rfunc, int32_t dt, void* dst, const void* src,
+                    size_t n) {
+  switch (dt) {
+    case DT_F32:
+      return reduce_typed(rfunc, (float*)dst, (const float*)src, n);
+    case DT_F64:
+      return reduce_typed(rfunc, (double*)dst, (const double*)src, n);
+    case DT_I32:
+      return reduce_typed(rfunc, (int32_t*)dst, (const int32_t*)src, n);
+    case DT_I64:
+      return reduce_typed(rfunc, (int64_t*)dst, (const int64_t*)src, n);
+    case DT_I8:
+      return reduce_typed(rfunc, (int8_t*)dst, (const int8_t*)src, n);
+    case DT_F16:
+    case DT_BF16: {
+      uint8_t* d = (uint8_t*)dst;
+      const uint8_t* s = (const uint8_t*)src;
+      for (size_t i = 0; i < n; ++i) {
+        double a = load_elem(d + 2 * i, dt), b = load_elem(s + 2 * i, dt);
+        double r = rfunc == RF_SUM ? a + b : (a > b ? a : b);
+        if (rfunc != RF_SUM && rfunc != RF_MAX) return false;
+        store_elem(d + 2 * i, dt, r);
+      }
+      return true;
+    }
+    default:
+      return false;
+  }
+}
+
+void convert(const void* src, int32_t src_dt, void* dst, int32_t dst_dt,
+             size_t n) {
+  if (src_dt == dst_dt) {
+    std::memcpy(dst, src, n * dtype_size(src_dt));
+    return;
+  }
+  const uint8_t* s = (const uint8_t*)src;
+  uint8_t* d = (uint8_t*)dst;
+  size_t ss = dtype_size(src_dt), ds = dtype_size(dst_dt);
+  for (size_t i = 0; i < n; ++i)
+    store_elem(d + i * ds, dst_dt, load_elem(s + i * ss, src_dt));
+}
+
+// --------------------------------------------------------------------------
+// in-proc registry
+// --------------------------------------------------------------------------
+
+namespace {
+std::mutex g_registry_mu;
+std::unordered_map<std::string, std::shared_ptr<Engine>> g_registry;
+}  // namespace
+
+std::shared_ptr<Engine> registry_find(const std::string& address) {
+  std::lock_guard<std::mutex> g(g_registry_mu);
+  auto it = g_registry.find(address);
+  return it == g_registry.end() ? nullptr : it->second;
+}
+
+void registry_add(const std::string& address, std::shared_ptr<Engine> e) {
+  std::lock_guard<std::mutex> g(g_registry_mu);
+  g_registry[address] = std::move(e);
+}
+
+void registry_remove(const std::string& address) {
+  std::lock_guard<std::mutex> g(g_registry_mu);
+  g_registry.erase(address);
+}
+
+// --------------------------------------------------------------------------
+// Engine lifecycle
+// --------------------------------------------------------------------------
+
+Engine::Engine(std::string address, int32_t transport, int rx_count,
+               int rx_size)
+    : address_(std::move(address)),
+      transport_(transport),
+      rx_count_(rx_count),
+      rx_size_(rx_size) {
+  rx_slots_.resize((size_t)rx_count);
+}
+
+Engine::~Engine() { shutdown(); }
+
+bool Engine::open() {
+  if (transport_ == TR_SOCKET) return socket_listen();
+  registry_add(address_, shared_from_this());
+  return true;
+}
+
+void Engine::shutdown() {
+  bool expected = false;
+  if (!stopping_.compare_exchange_strong(expected, true)) return;
+  if (transport_ == TR_INPROC) registry_remove(address_);
+  cv_.notify_all();
+  // join all in-flight call threads (their waits observe stopping_); the
+  // handles are moved out first because run_call's completion path takes
+  // reqs_mu_ — joining under the lock would deadlock
+  std::vector<std::thread> threads;
+  {
+    std::lock_guard<std::mutex> g(reqs_mu_);
+    for (auto& kv : reqs_)
+      if (kv.second->th.joinable()) threads.push_back(std::move(kv.second->th));
+  }
+  for (auto& t : threads) t.join();
+  if (listen_fd_ >= 0) {
+    ::shutdown(listen_fd_, SHUT_RDWR);
+    ::close(listen_fd_);
+    listen_fd_ = -1;
+  }
+  if (accept_thread_.joinable()) accept_thread_.join();
+  {
+    std::lock_guard<std::mutex> g(conn_mu_);
+    for (auto& kv : conns_) ::close(kv.second);
+    conns_.clear();
+  }
+  {
+    std::lock_guard<std::mutex> g(reader_mu_);
+    for (auto& t : reader_threads_)
+      if (t.joinable()) t.join();
+    reader_threads_.clear();
+  }
+}
+
+void Engine::add_comm(uint32_t comm_id, int local_rank,
+                      const std::vector<Peer>& peers) {
+  auto cs = std::make_unique<CommState>();
+  cs->id = comm_id;
+  cs->local_rank = local_rank;
+  cs->peers = peers;
+  cs->in_seq.assign(peers.size(), 0);
+  cs->out_seq.assign(peers.size(), 0);
+  std::lock_guard<std::mutex> g(mu_);
+  comms_[comm_id] = std::move(cs);
+}
+
+// --------------------------------------------------------------------------
+// delivery (the depacketizer + rxbuf_enqueue + notification-routing role)
+// --------------------------------------------------------------------------
+
+void Engine::deliver(Message&& msg) {
+  std::unique_lock<std::mutex> lk(mu_);
+  switch (msg.msg_type) {
+    case MSG_RNDZV_DATA: {
+      auto it = wr_registry_.find(msg.vaddr);
+      if (it != wr_registry_.end()) {
+        size_t n = std::min(it->second.second, msg.payload.size());
+        std::memcpy(it->second.first, msg.payload.data(), n);
+        wr_registry_.erase(it);
+      }
+      Message done;
+      done.msg_type = MSG_RNDZV_WR_DONE;
+      done.comm_id = msg.comm_id;
+      done.src = msg.src;
+      done.dst = msg.dst;
+      done.tag = msg.tag;
+      done.vaddr = msg.vaddr;
+      done.count = msg.count;
+      rndzv_dones_.push_back(std::move(done));
+      break;
+    }
+    case MSG_RNDZV_INIT:
+      rndzv_inits_.push_back(std::move(msg));
+      break;
+    case MSG_RNDZV_WR_DONE:
+      rndzv_dones_.push_back(std::move(msg));
+      break;
+    case MSG_STREAM:
+      streams_[(int)msg.strm].push_back(std::move(msg.payload));
+      break;
+    case MSG_EAGER:
+    default: {
+      bool placed = false;
+      for (auto& s : rx_slots_) {
+        if (s.state == 0) {
+          s.state = 1;
+          s.msg = std::move(msg);
+          placed = true;
+          break;
+        }
+      }
+      // pool exhausted: park in overflow — backpressure, never drop
+      // (the reference's dummy stacks block the wire the same way)
+      if (!placed) rx_overflow_.push_back(std::move(msg));
+      break;
+    }
+  }
+  lk.unlock();
+  cv_.notify_all();
+}
+
+bool Engine::post(CommState* comm, int dst, Message&& msg) {
+  const std::string& addr = comm->peers[(size_t)dst].address;
+  if (transport_ == TR_INPROC) {
+    auto target = registry_find(addr);
+    if (!target) return false;
+    target->deliver(std::move(msg));
+    return true;
+  }
+  return socket_send(addr, msg);
+}
+
+int Engine::rx_occupancy() {
+  std::lock_guard<std::mutex> g(mu_);
+  int used = 0;
+  for (auto& s : rx_slots_)
+    if (s.state != 0) ++used;
+  return used + (int)rx_overflow_.size();
+}
+
+// --------------------------------------------------------------------------
+// request machinery (ref acclrequest.hpp BaseRequest + FPGAQueue; the
+// one-thread-per-call model mirrors the Python scheduler's interleaving)
+// --------------------------------------------------------------------------
+
+uint64_t Engine::start(const CallArgs& args) {
+  uint64_t id = req_counter_.fetch_add(1);
+  auto req = std::make_unique<Req>();
+  Req* rp = req.get();
+  {
+    std::lock_guard<std::mutex> g(reqs_mu_);
+    reqs_[id] = std::move(req);
+  }
+  rp->th = std::thread([this, id, args]() { run_call(id, args); });
+  return id;
+}
+
+void Engine::run_call(uint64_t id, CallArgs args) {
+  auto t0 = Clock::now();
+  auto deadline = t0 + std::chrono::duration_cast<Clock::duration>(
+                           std::chrono::duration<double>(timeout_s_.load()));
+  uint32_t ret = execute(args, deadline);
+  auto t1 = Clock::now();
+  Req* rp = nullptr;
+  {
+    std::lock_guard<std::mutex> g(reqs_mu_);
+    auto it = reqs_.find(id);
+    if (it != reqs_.end()) rp = it->second.get();
+  }
+  if (rp) {
+    std::lock_guard<std::mutex> g(rp->mu);
+    rp->ret = ret;
+    rp->dur_ns =
+        std::chrono::duration_cast<std::chrono::nanoseconds>(t1 - t0).count();
+    rp->done = true;
+    rp->cv.notify_all();
+  }
+}
+
+int Engine::wait(uint64_t req, double timeout_s) {
+  Req* rp = nullptr;
+  {
+    std::lock_guard<std::mutex> g(reqs_mu_);
+    auto it = reqs_.find(req);
+    if (it == reqs_.end()) return 1;  // unknown == already freed == done
+    rp = it->second.get();
+  }
+  std::unique_lock<std::mutex> lk(rp->mu);
+  if (timeout_s < 0) {
+    rp->cv.wait(lk, [&] { return rp->done; });
+    return 1;
+  }
+  return rp->cv.wait_for(lk, std::chrono::duration<double>(timeout_s),
+                         [&] { return rp->done; })
+             ? 1
+             : 0;
+}
+
+int Engine::test(uint64_t req) {
+  std::lock_guard<std::mutex> g(reqs_mu_);
+  auto it = reqs_.find(req);
+  if (it == reqs_.end()) return 1;
+  std::lock_guard<std::mutex> g2(it->second->mu);
+  return it->second->done ? 1 : 0;
+}
+
+uint32_t Engine::retcode(uint64_t req) {
+  std::lock_guard<std::mutex> g(reqs_mu_);
+  auto it = reqs_.find(req);
+  if (it == reqs_.end()) return E_OK;
+  std::lock_guard<std::mutex> g2(it->second->mu);
+  return it->second->ret;
+}
+
+int64_t Engine::duration_ns(uint64_t req) {
+  std::lock_guard<std::mutex> g(reqs_mu_);
+  auto it = reqs_.find(req);
+  if (it == reqs_.end()) return 0;
+  std::lock_guard<std::mutex> g2(it->second->mu);
+  return it->second->dur_ns;
+}
+
+void Engine::free_request(uint64_t req) {
+  std::unique_ptr<Req> owned;
+  {
+    std::lock_guard<std::mutex> g(reqs_mu_);
+    auto it = reqs_.find(req);
+    if (it == reqs_.end()) return;
+    owned = std::move(it->second);
+    reqs_.erase(it);
+  }
+  if (owned->th.joinable()) owned->th.join();
+}
+
+// --------------------------------------------------------------------------
+// config ops (ref HOUSEKEEP_* handling, ccl_offload_control.c:2416-2452)
+// --------------------------------------------------------------------------
+
+uint32_t Engine::apply_config(const CallArgs& args) {
+  double v = args.cfg_value;
+  switch (args.cfg_function) {
+    case CFG_RESET: {
+      std::lock_guard<std::mutex> g(mu_);
+      rndzv_inits_.clear();
+      rndzv_dones_.clear();
+      transport_enabled_ = false;
+      return E_OK;
+    }
+    case CFG_ENABLE_TRANSPORT:
+      transport_enabled_ = true;
+      return E_OK;
+    case CFG_SET_TIMEOUT:
+      if (v <= 0) return E_CONFIG_ERROR;
+      timeout_s_ = v;
+      return E_OK;
+    case CFG_SET_MAX_EAGER_SIZE:
+      if (v <= 0 || v > 16.0 * 1024 * 1024) return E_CONFIG_ERROR;
+      max_eager_ = (uint64_t)v;
+      return E_OK;
+    case CFG_SET_MAX_RENDEZVOUS_SIZE:
+      if (v <= 0) return E_CONFIG_ERROR;
+      max_rndzv_ = (uint64_t)v;
+      return E_OK;
+    default:
+      return E_CONFIG_ERROR;
+  }
+}
+
+// --------------------------------------------------------------------------
+// stream ports (the external-kernel AXIS stream role)
+// --------------------------------------------------------------------------
+
+void Engine::stream_push(int stream_id, const uint8_t* data, size_t n) {
+  {
+    std::lock_guard<std::mutex> g(mu_);
+    streams_[stream_id].emplace_back(data, data + n);
+  }
+  cv_.notify_all();
+}
+
+int64_t Engine::stream_pop(int stream_id, uint8_t* out, size_t cap,
+                           double timeout_s) {
+  auto deadline = Clock::now() + std::chrono::duration_cast<Clock::duration>(
+                                     std::chrono::duration<double>(timeout_s));
+  std::unique_lock<std::mutex> lk(mu_);
+  for (;;) {
+    auto& q = streams_[stream_id];
+    if (!q.empty()) {
+      size_t n = q.front().size();
+      if (n > cap) return (int64_t)n;  // caller retries with a bigger buffer
+      std::memcpy(out, q.front().data(), n);
+      q.pop_front();
+      return (int64_t)n;
+    }
+    if (stopping_.load() || cv_.wait_until(lk, deadline) ==
+                                std::cv_status::timeout)
+      return -1;
+  }
+}
+
+// --------------------------------------------------------------------------
+// socket transport (role: the ZMQ "ethernet" between per-rank emulator
+// processes, zmq_server.h:39-45; framing is ours: length-prefixed binary)
+// --------------------------------------------------------------------------
+
+namespace {
+
+struct WireHeader {
+  uint32_t msg_type, comm_id, src, dst, tag, strm;
+  uint64_t seqn, vaddr, count, payload_len;
+};
+
+bool send_all(int fd, const void* buf, size_t n) {
+  const char* p = (const char*)buf;
+  while (n > 0) {
+    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (w <= 0) return false;
+    p += w;
+    n -= (size_t)w;
+  }
+  return true;
+}
+
+bool recv_all(int fd, void* buf, size_t n) {
+  char* p = (char*)buf;
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+bool split_hostport(const std::string& addr, std::string& host, int& port) {
+  auto pos = addr.rfind(':');
+  if (pos == std::string::npos) return false;
+  host = addr.substr(0, pos);
+  port = std::atoi(addr.c_str() + pos + 1);
+  return port > 0;
+}
+
+}  // namespace
+
+bool Engine::socket_listen() {
+  std::string host;
+  int port;
+  if (!split_hostport(address_, host, port)) return false;
+  listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (listen_fd_ < 0) return false;
+  int one = 1;
+  ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in sa{};
+  sa.sin_family = AF_INET;
+  sa.sin_port = htons((uint16_t)port);
+  sa.sin_addr.s_addr =
+      host.empty() || host == "0.0.0.0" ? INADDR_ANY : inet_addr(host.c_str());
+  if (::bind(listen_fd_, (sockaddr*)&sa, sizeof(sa)) != 0 ||
+      ::listen(listen_fd_, 64) != 0) {
+    ::close(listen_fd_);
+    listen_fd_ = -1;
+    return false;
+  }
+  accept_thread_ = std::thread([this] { socket_accept_loop(); });
+  return true;
+}
+
+void Engine::socket_accept_loop() {
+  while (!stopping_.load()) {
+    int fd = ::accept(listen_fd_, nullptr, nullptr);
+    if (fd < 0) return;
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    std::lock_guard<std::mutex> g(reader_mu_);
+    reader_threads_.emplace_back([this, fd] { socket_reader(fd); });
+  }
+}
+
+void Engine::socket_reader(int fd) {
+  for (;;) {
+    WireHeader h;
+    if (!recv_all(fd, &h, sizeof(h))) break;
+    Message m;
+    m.msg_type = h.msg_type;
+    m.comm_id = h.comm_id;
+    m.src = h.src;
+    m.dst = h.dst;
+    m.tag = h.tag;
+    m.strm = h.strm;
+    m.seqn = h.seqn;
+    m.vaddr = h.vaddr;
+    m.count = h.count;
+    m.payload.resize(h.payload_len);
+    if (h.payload_len && !recv_all(fd, m.payload.data(), h.payload_len)) break;
+    if (stopping_.load()) break;
+    deliver(std::move(m));
+  }
+  ::close(fd);
+}
+
+int Engine::socket_dial(const std::string& address) {
+  std::string host;
+  int port;
+  if (!split_hostport(address, host, port)) return -1;
+  // retry until the peer's listener is up (peers start concurrently; the
+  // reference leans on MPI barriers here, fixture.hpp:124-132)
+  auto deadline = Clock::now() + std::chrono::seconds(15);
+  for (;;) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+    sockaddr_in sa{};
+    sa.sin_family = AF_INET;
+    sa.sin_port = htons((uint16_t)port);
+    sa.sin_addr.s_addr =
+        host.empty() ? inet_addr("127.0.0.1") : inet_addr(host.c_str());
+    if (::connect(fd, (sockaddr*)&sa, sizeof(sa)) == 0) {
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      return fd;
+    }
+    ::close(fd);
+    if (Clock::now() > deadline || stopping_.load()) return -1;
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+}
+
+bool Engine::socket_send(const std::string& address, const Message& msg) {
+  int fd;
+  {
+    std::lock_guard<std::mutex> g(conn_mu_);
+    auto it = conns_.find(address);
+    fd = it == conns_.end() ? -1 : it->second;
+  }
+  if (fd < 0) {
+    // dial outside the lock so a slow-starting peer doesn't stall sends to
+    // already-connected peers
+    fd = socket_dial(address);
+    if (fd < 0) return false;
+    std::lock_guard<std::mutex> g(conn_mu_);
+    auto it = conns_.find(address);
+    if (it != conns_.end()) {
+      ::close(fd);
+      fd = it->second;
+    } else {
+      conns_[address] = fd;
+    }
+  }
+  WireHeader h{};
+  h.msg_type = msg.msg_type;
+  h.comm_id = msg.comm_id;
+  h.src = msg.src;
+  h.dst = msg.dst;
+  h.tag = msg.tag;
+  h.strm = msg.strm;
+  h.seqn = msg.seqn;
+  h.vaddr = msg.vaddr;
+  h.count = msg.count;
+  h.payload_len = msg.payload.size();
+  std::lock_guard<std::mutex> g(conn_mu_);  // serialize frames per engine
+  if (!send_all(fd, &h, sizeof(h))) return false;
+  if (!msg.payload.empty() &&
+      !send_all(fd, msg.payload.data(), msg.payload.size()))
+    return false;
+  return true;
+}
+
+}  // namespace accl

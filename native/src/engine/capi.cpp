@@ -1,0 +1,123 @@
+// C ABI for the native engine, consumed by accl_tpu/native/engine.py via
+// ctypes (role: the hostctrl command surface — driver/xrt talks to the CCLO
+// through 15 scalar kernel args; we talk to the engine through CallArgs).
+
+#include <cstring>
+#include <mutex>
+#include <vector>
+
+#include "accl_engine.h"
+
+namespace {
+
+std::mutex g_mu;
+std::vector<std::shared_ptr<accl::Engine>> g_engines;
+
+std::shared_ptr<accl::Engine> get(int h) {
+  std::lock_guard<std::mutex> g(g_mu);
+  if (h < 0 || (size_t)h >= g_engines.size()) return nullptr;
+  return g_engines[(size_t)h];
+}
+
+}  // namespace
+
+extern "C" {
+
+// returns engine handle, or -1 when the transport failed to open
+int accl_ng_engine_new(const char* address, int transport, int rx_count,
+                       int rx_size) {
+  auto e = std::make_shared<accl::Engine>(std::string(address), transport,
+                                          rx_count, rx_size);
+  if (!e->open()) return -1;
+  std::lock_guard<std::mutex> g(g_mu);
+  for (size_t i = 0; i < g_engines.size(); ++i) {
+    if (!g_engines[i]) {
+      g_engines[i] = std::move(e);
+      return (int)i;
+    }
+  }
+  g_engines.push_back(std::move(e));
+  return (int)g_engines.size() - 1;
+}
+
+void accl_ng_engine_shutdown(int h) {
+  std::shared_ptr<accl::Engine> e;
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    if (h < 0 || (size_t)h >= g_engines.size()) return;
+    e = std::move(g_engines[(size_t)h]);
+  }
+  if (e) e->shutdown();
+}
+
+int accl_ng_add_comm(int h, uint32_t comm_id, int local_rank, int nranks,
+                     const char** addresses, const uint32_t* seg_sizes) {
+  auto e = get(h);
+  if (!e) return -1;
+  std::vector<accl::Peer> peers((size_t)nranks);
+  for (int i = 0; i < nranks; ++i) {
+    peers[(size_t)i].address = addresses[i];
+    peers[(size_t)i].max_segment_size = seg_sizes[i];
+  }
+  e->add_comm(comm_id, local_rank, peers);
+  return 0;
+}
+
+uint64_t accl_ng_start(int h, const accl::CallArgs* args) {
+  auto e = get(h);
+  if (!e) return 0;
+  return e->start(*args);
+}
+
+int accl_ng_wait(int h, uint64_t req, double timeout_s) {
+  auto e = get(h);
+  if (!e) return 1;
+  return e->wait(req, timeout_s);
+}
+
+int accl_ng_test(int h, uint64_t req) {
+  auto e = get(h);
+  if (!e) return 1;
+  return e->test(req);
+}
+
+uint32_t accl_ng_retcode(int h, uint64_t req) {
+  auto e = get(h);
+  if (!e) return 0;
+  return e->retcode(req);
+}
+
+int64_t accl_ng_duration_ns(int h, uint64_t req) {
+  auto e = get(h);
+  if (!e) return 0;
+  return e->duration_ns(req);
+}
+
+void accl_ng_free_request(int h, uint64_t req) {
+  auto e = get(h);
+  if (e) e->free_request(req);
+}
+
+void accl_ng_stream_push(int h, int stream_id, const void* data, int64_t n) {
+  auto e = get(h);
+  if (e) e->stream_push(stream_id, (const uint8_t*)data, (size_t)n);
+}
+
+int64_t accl_ng_stream_pop(int h, int stream_id, void* out, int64_t cap,
+                           double timeout_s) {
+  auto e = get(h);
+  if (!e) return -1;
+  return e->stream_pop(stream_id, (uint8_t*)out, (size_t)cap, timeout_s);
+}
+
+int accl_ng_rx_occupancy(int h) {
+  auto e = get(h);
+  return e ? e->rx_occupancy() : 0;
+}
+
+int accl_ng_rx_capacity(int h) {
+  auto e = get(h);
+  return e ? e->rx_capacity() : 0;
+}
+
+}  // extern "C"

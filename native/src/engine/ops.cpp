@@ -1,0 +1,921 @@
+// Collective algorithms for the native engine.
+//
+// This file is the C++ counterpart of the reference control-plane firmware
+// (ccl_offload_control.c) — each routine cites the firmware function it
+// re-implements, and mirrors accl_tpu/backends/emulator/algorithms.py so the
+// Python and native tiers stay behaviorally interchangeable under the shared
+// pytest suite.  Protocol selection follows the firmware rule (send c:587,
+// recv c:667, broadcast c:808): rendezvous iff bytes > max_eager_size AND no
+// compression AND no streams; else segmented eager with tag/src/seqn matching.
+
+#include <algorithm>
+#include <chrono>
+#include <cstring>
+
+#include "accl_engine.h"
+
+namespace accl {
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+using TimePoint = Clock::time_point;
+
+int bit_length(uint64_t x) {
+  int n = 0;
+  while (x) {
+    ++n;
+    x >>= 1;
+  }
+  return n;
+}
+
+struct CallCtx {
+  Engine& e;
+  const CallArgs& c;
+  CommState* comm = nullptr;
+  TimePoint deadline;
+
+  int rank() const { return comm->local_rank; }
+  int size() const { return comm->size(); }
+  uint32_t seg_size(int rank_idx) const {
+    return comm->peers[(size_t)rank_idx].max_segment_size;
+  }
+
+  int32_t wire_dtype() const {
+    // ref: arithcfg resolution in prepare_call — the wire carries the
+    // compressed dtype iff ETH_COMPRESSED is set
+    return (c.compression & CF_ETH) ? c.cmp_dtype : c.acc_dtype;
+  }
+
+  bool use_rendezvous(size_t nbytes) const {
+    return nbytes > e.max_eager_.load() && c.compression == CF_NONE &&
+           c.stream_flags == SF_NONE;
+  }
+
+  // ------------------------------------------------------------------
+  // waits (the NOT_READY retry-queue analog: block on cv until matched)
+  // ------------------------------------------------------------------
+
+  // match one eager segment {comm, src, tag, seqn==inbound} — ref rxbuf_seek
+  // + the DMP MOVE_ON_RECV seek loop (dma_mover.cpp:587-611); the inbound
+  // counter advances only on match so timed-out receives leave matching
+  // state clean
+  bool seek_rx(int src, uint32_t tag, std::vector<uint8_t>& out) {
+    std::unique_lock<std::mutex> lk(e.mu_);
+    for (;;) {
+      uint64_t expect = comm->in_seq[(size_t)src];
+      for (auto& s : e.rx_slots_) {
+        if (s.state == 1 && s.msg.comm_id == comm->id &&
+            s.msg.src == (uint32_t)src && s.msg.tag == tag &&
+            s.msg.seqn == expect) {
+          out = std::move(s.msg.payload);
+          s.state = 0;
+          s.msg = Message{};
+          comm->in_seq[(size_t)src] = expect + 1;
+          drain_overflow_locked();
+          return true;
+        }
+      }
+      // the slot pool can be monopolized by other senders while the wanted
+      // segment sits in the overflow queue — match it there too, else a
+      // multi-source receive at high fan-in livelocks until timeout
+      for (auto it = e.rx_overflow_.begin(); it != e.rx_overflow_.end(); ++it) {
+        if (it->comm_id == comm->id && it->src == (uint32_t)src &&
+            it->tag == tag && it->seqn == expect) {
+          out = std::move(it->payload);
+          e.rx_overflow_.erase(it);
+          comm->in_seq[(size_t)src] = expect + 1;
+          return true;
+        }
+      }
+      if (e.stopping_.load() ||
+          e.cv_.wait_until(lk, deadline) == std::cv_status::timeout)
+        return false;
+    }
+  }
+
+  void drain_overflow_locked() {
+    while (!e.rx_overflow_.empty()) {
+      bool placed = false;
+      for (auto& s : e.rx_slots_) {
+        if (s.state == 0) {
+          s.state = 1;
+          s.msg = std::move(e.rx_overflow_.front());
+          e.rx_overflow_.pop_front();
+          placed = true;
+          break;
+        }
+      }
+      if (!placed) return;
+    }
+  }
+
+  // ref rendezvous_get_addr / get_any_addr (c:154-276)
+  bool wait_rndzv_init(int src, uint32_t tag, Message& out) {
+    std::unique_lock<std::mutex> lk(e.mu_);
+    for (;;) {
+      auto& v = e.rndzv_inits_;
+      for (size_t i = 0; i < v.size(); ++i) {
+        if (v[i].comm_id == comm->id && v[i].tag == tag &&
+            v[i].src == (uint32_t)src) {
+          out = std::move(v[i]);
+          v.erase(v.begin() + (long)i);
+          return true;
+        }
+      }
+      if (e.stopping_.load() ||
+          e.cv_.wait_until(lk, deadline) == std::cv_status::timeout)
+        return false;
+    }
+  }
+
+  // ref get_completion / get_any_completion (c:280-408)
+  bool wait_rndzv_done(int src, uint32_t tag, uint64_t vaddr) {
+    std::unique_lock<std::mutex> lk(e.mu_);
+    for (;;) {
+      auto& v = e.rndzv_dones_;
+      for (size_t i = 0; i < v.size(); ++i) {
+        if (v[i].comm_id == comm->id && v[i].tag == tag &&
+            v[i].vaddr == vaddr && v[i].src == (uint32_t)src) {
+          v.erase(v.begin() + (long)i);
+          return true;
+        }
+      }
+      if (e.stopping_.load() ||
+          e.cv_.wait_until(lk, deadline) == std::cv_status::timeout)
+        return false;
+    }
+  }
+
+  // accumulate nbytes from a local stream port (OP0_STREAM); surplus bytes
+  // of the final chunk are dropped, matching the emulator tier
+  bool wait_stream(int stream_id, size_t nbytes, std::vector<uint8_t>& out) {
+    out.clear();
+    std::unique_lock<std::mutex> lk(e.mu_);
+    for (;;) {
+      auto& q = e.streams_[stream_id];
+      while (!q.empty() && out.size() < nbytes) {
+        auto chunk = std::move(q.front());
+        q.pop_front();
+        out.insert(out.end(), chunk.begin(), chunk.end());
+      }
+      if (out.size() >= nbytes) {
+        out.resize(nbytes);
+        return true;
+      }
+      if (e.stopping_.load() ||
+          e.cv_.wait_until(lk, deadline) == std::cv_status::timeout)
+        return false;
+    }
+  }
+
+  // ------------------------------------------------------------------
+  // point-to-point primitives (ref firmware send/recv c:573-710)
+  // ------------------------------------------------------------------
+
+  // segmented eager send with per-segment sequence numbers (c:611-649)
+  uint32_t eager_send(int peer, uint32_t tag, const uint8_t* data, size_t n) {
+    size_t seg = seg_size(peer);
+    size_t off = 0;
+    bool first = true;
+    while (first || off < n) {
+      first = false;
+      size_t chunk = std::min(seg, n - off);
+      Message m;
+      m.msg_type = MSG_EAGER;
+      m.comm_id = comm->id;
+      m.src = (uint32_t)rank();
+      m.dst = (uint32_t)peer;
+      m.tag = tag;
+      m.count = chunk;
+      m.payload.assign(data + off, data + off + chunk);
+      {
+        std::lock_guard<std::mutex> g(e.mu_);
+        m.seqn = comm->out_seq[(size_t)peer]++;
+      }
+      if (!e.post(comm, peer, std::move(m))) return E_TRANSPORT_ERROR;
+      off += seg;
+    }
+    return E_OK;
+  }
+
+  uint32_t eager_recv(int peer, uint32_t tag, size_t wire_nbytes,
+                      std::vector<uint8_t>& out) {
+    size_t seg = seg_size(rank());
+    size_t nseg = std::max<size_t>(1, (wire_nbytes + seg - 1) / seg);
+    out.clear();
+    out.reserve(wire_nbytes);
+    std::vector<uint8_t> piece;
+    for (size_t i = 0; i < nseg; ++i) {
+      if (!seek_rx(peer, tag, piece)) return E_RECEIVE_TIMEOUT;
+      out.insert(out.end(), piece.begin(), piece.end());
+    }
+    return E_OK;
+  }
+
+  // receiver announces a writable address (ref rendezvous_send_addr c:142-150
+  // + RNDZVS_INIT on the wire)
+  uint64_t rndzv_recv_post(int peer, uint32_t tag, uint8_t* dst, size_t n) {
+    uint64_t vaddr = e.vaddr_counter_.fetch_add(1);
+    {
+      std::lock_guard<std::mutex> g(e.mu_);
+      e.wr_registry_[vaddr] = {dst, n};
+    }
+    Message m;
+    m.msg_type = MSG_RNDZV_INIT;
+    m.comm_id = comm->id;
+    m.src = (uint32_t)rank();
+    m.dst = (uint32_t)peer;
+    m.tag = tag;
+    m.vaddr = vaddr;
+    m.count = n;
+    e.post(comm, peer, std::move(m));
+    return vaddr;
+  }
+
+  // wait for the address, then one-sided write (ref send rendezvous path
+  // c:587-610: rendezvous_get_addr + RDMA WRITE via the packetizer)
+  uint32_t rndzv_send(int peer, uint32_t tag, const uint8_t* data, size_t n) {
+    Message init;
+    if (!wait_rndzv_init(peer, tag, init)) return E_RENDEZVOUS_TIMEOUT;
+    Message m;
+    m.msg_type = MSG_RNDZV_DATA;
+    m.comm_id = comm->id;
+    m.src = (uint32_t)rank();
+    m.dst = (uint32_t)peer;
+    m.tag = tag;
+    m.vaddr = init.vaddr;
+    m.count = n;
+    m.payload.assign(data, data + n);
+    if (!e.post(comm, peer, std::move(m))) return E_TRANSPORT_ERROR;
+    return E_OK;
+  }
+
+  // ------------------------------------------------------------------
+  // protocol-agnostic chunk transfer (wire-dtype casts = the
+  // hp_compression stage)
+  // ------------------------------------------------------------------
+
+  uint32_t send_chunk(int peer, uint32_t tag, const uint8_t* data,
+                      int32_t data_dt, size_t count) {
+    size_t nbytes = count * dtype_size(data_dt);
+    if (use_rendezvous(nbytes)) return rndzv_send(peer, tag, data, nbytes);
+    int32_t wdt = wire_dtype();
+    if (wdt == data_dt) return eager_send(peer, tag, data, nbytes);
+    std::vector<uint8_t> wire(count * dtype_size(wdt));
+    convert(data, data_dt, wire.data(), wdt, count);
+    return eager_send(peer, tag, wire.data(), wire.size());
+  }
+
+  struct RecvHandle {
+    bool rndzv = false;
+    int peer = 0;
+    uint32_t tag = 0;
+    uint64_t vaddr = 0;
+    size_t count = 0;
+  };
+
+  RecvHandle recv_chunk_post(int peer, uint32_t tag, uint8_t* dst,
+                             int32_t dst_dt, size_t count) {
+    RecvHandle h;
+    h.peer = peer;
+    h.tag = tag;
+    h.count = count;
+    size_t nbytes = count * dtype_size(dst_dt);
+    if (use_rendezvous(nbytes)) {
+      h.rndzv = true;
+      h.vaddr = rndzv_recv_post(peer, tag, dst, nbytes);
+    }
+    return h;
+  }
+
+  uint32_t recv_chunk_wait(const RecvHandle& h, uint8_t* dst, int32_t dst_dt) {
+    if (h.rndzv)
+      return wait_rndzv_done(h.peer, h.tag, h.vaddr) ? E_OK
+                                                     : E_RENDEZVOUS_TIMEOUT;
+    int32_t wdt = wire_dtype();
+    std::vector<uint8_t> raw;
+    uint32_t rc = eager_recv(h.peer, h.tag, h.count * dtype_size(wdt), raw);
+    if (rc != E_OK) return rc;
+    convert(raw.data(), wdt, dst, dst_dt, h.count);
+    return E_OK;
+  }
+
+  uint32_t recv_chunk(int peer, uint32_t tag, uint8_t* dst, int32_t dst_dt,
+                      size_t count) {
+    RecvHandle h = recv_chunk_post(peer, tag, dst, dst_dt, count);
+    return recv_chunk_wait(h, dst, dst_dt);
+  }
+
+  // receive + reduce into acc (ref fused_recv_reduce c:716-749); rendezvous
+  // lands in a spare buffer first (ref TMP1-3)
+  uint32_t recv_reduce_chunk(int peer, uint32_t tag, uint8_t* acc,
+                             int32_t acc_dt, size_t count) {
+    size_t nbytes = count * dtype_size(acc_dt);
+    std::vector<uint8_t> tmp(nbytes);
+    if (use_rendezvous(nbytes)) {
+      uint64_t vaddr = rndzv_recv_post(peer, tag, tmp.data(), nbytes);
+      if (!wait_rndzv_done(peer, tag, vaddr)) return E_RENDEZVOUS_TIMEOUT;
+    } else {
+      int32_t wdt = wire_dtype();
+      std::vector<uint8_t> raw;
+      uint32_t rc = eager_recv(peer, tag, count * dtype_size(wdt), raw);
+      if (rc != E_OK) return rc;
+      convert(raw.data(), wdt, tmp.data(), acc_dt, count);
+    }
+    if (!reduce_inplace(c.rfunc, acc_dt, acc, tmp.data(), count))
+      return E_ARITH_ERROR;
+    return E_OK;
+  }
+
+  // ------------------------------------------------------------------
+  // operand plumbing (streaming operands of ref accl_hls.h)
+  // ------------------------------------------------------------------
+
+  // operand 0 as (ptr, dtype); streams pull into `owned`
+  uint32_t read_op0(std::vector<uint8_t>& owned, const uint8_t** ptr,
+                    int32_t* dt) {
+    if (c.stream_flags & SF_OP0) {
+      int32_t sdt = (c.compression & CF_OP0) ? c.cmp_dtype : c.acc_dtype;
+      if (!wait_stream(c.stream_id, (size_t)c.count * dtype_size(sdt), owned))
+        return E_DMA_TIMEOUT;
+      *ptr = owned.data();
+      *dt = sdt;
+      return E_OK;
+    }
+    if (c.op0 == nullptr) return E_INVALID_OPERATION;
+    *ptr = (const uint8_t*)c.op0;
+    *dt = c.op0_dtype;
+    return E_OK;
+  }
+
+  // result to buffer or local stream port (RES_STREAM)
+  uint32_t write_res(const uint8_t* data, int32_t data_dt, size_t count) {
+    if (c.stream_flags & SF_RES) {
+      int32_t rdt = (c.compression & CF_RES) ? c.cmp_dtype : c.acc_dtype;
+      std::vector<uint8_t> out(count * dtype_size(rdt));
+      convert(data, data_dt, out.data(), rdt, count);
+      e.stream_push(c.stream_id, out.data(), out.size());
+      return E_OK;
+    }
+    if (c.res == nullptr) return E_INVALID_OPERATION;
+    convert(data, data_dt, (uint8_t*)c.res, c.res_dtype, count);
+    return E_OK;
+  }
+};
+
+// --------------------------------------------------------------------------
+// operations (each names its firmware role model)
+// --------------------------------------------------------------------------
+
+// ref firmware copy c:531-547
+uint32_t op_copy(CallCtx& x) {
+  std::vector<uint8_t> owned;
+  const uint8_t* src;
+  int32_t sdt;
+  uint32_t rc = x.read_op0(owned, &src, &sdt);
+  if (rc != E_OK) return rc;
+  return x.write_res(src, sdt, (size_t)x.c.count);
+}
+
+// ref firmware combine c:551-569: res = fn(op0, op1)
+uint32_t op_combine(CallCtx& x) {
+  if (!x.c.supports_rfunc) return E_ARITH_ERROR;
+  std::vector<uint8_t> owned;
+  const uint8_t* a;
+  int32_t adt;
+  uint32_t rc = x.read_op0(owned, &a, &adt);
+  if (rc != E_OK) return rc;
+  if (x.c.op1 == nullptr) return E_INVALID_OPERATION;
+  size_t n = (size_t)x.c.count;
+  int32_t acc_dt = x.c.acc_dtype;
+  std::vector<uint8_t> acc(n * dtype_size(acc_dt));
+  convert(a, adt, acc.data(), acc_dt, n);
+  std::vector<uint8_t> b(n * dtype_size(acc_dt));
+  convert(x.c.op1, x.c.op1_dtype, b.data(), acc_dt, n);
+  if (!reduce_inplace(x.c.rfunc, acc_dt, acc.data(), b.data(), n))
+    return E_ARITH_ERROR;
+  return x.write_res(acc.data(), acc_dt, n);
+}
+
+// ref firmware send c:573-649; with RES_STREAM this is stream_put — the
+// payload routes to the remote stream port instead of tag-matched RX buffers
+uint32_t op_send(CallCtx& x) {
+  int peer = x.c.root_dst;
+  std::vector<uint8_t> owned;
+  const uint8_t* data;
+  int32_t ddt;
+  uint32_t rc = x.read_op0(owned, &data, &ddt);
+  if (rc != E_OK) return rc;
+  size_t n = (size_t)x.c.count;
+  if (x.c.stream_flags & SF_RES) {
+    int32_t wdt = x.wire_dtype();
+    std::vector<uint8_t> wire(n * dtype_size(wdt));
+    convert(data, ddt, wire.data(), wdt, n);
+    size_t seg = x.seg_size(peer);
+    size_t total = wire.size(), off = 0;
+    bool first = true;
+    while (first || off < total) {
+      first = false;
+      size_t chunk = std::min(seg, total - off);
+      Message m;
+      m.msg_type = MSG_STREAM;
+      m.comm_id = x.comm->id;
+      m.src = (uint32_t)x.rank();
+      m.dst = (uint32_t)peer;
+      m.tag = x.c.tag;
+      m.strm = (uint32_t)x.c.stream_id;
+      m.count = chunk;
+      m.payload.assign(wire.data() + off, wire.data() + off + chunk);
+      if (!x.e.post(x.comm, peer, std::move(m))) return E_TRANSPORT_ERROR;
+      off += seg;
+    }
+    return E_OK;
+  }
+  return x.send_chunk(peer, x.c.tag, data, ddt, n);
+}
+
+// ref firmware recv c:653-710
+uint32_t op_recv(CallCtx& x) {
+  int peer = x.c.root_src;
+  size_t n = (size_t)x.c.count;
+  if (x.c.stream_flags & SF_RES) {
+    // recv-to-stream: eager only; matched payloads forward to the port
+    std::vector<uint8_t> raw;
+    uint32_t rc =
+        x.eager_recv(peer, x.c.tag, n * dtype_size(x.wire_dtype()), raw);
+    if (rc != E_OK) return rc;
+    x.e.stream_push(x.c.stream_id, raw.data(), raw.size());
+    return E_OK;
+  }
+  if (x.c.res == nullptr) return E_INVALID_OPERATION;
+  return x.recv_chunk(peer, x.c.tag, (uint8_t*)x.c.res, x.c.res_dtype, n);
+}
+
+// ref firmware broadcast c:796-988: binomial-tree doubling for large
+// rendezvous worlds (c:815-867), flat root-fanout otherwise (c:869-987)
+uint32_t op_bcast(CallCtx& x) {
+  int root = x.c.root_src, r = x.rank(), size = x.size();
+  if (size == 1) return E_OK;
+  size_t n = (size_t)x.c.count;
+  size_t nbytes = n * dtype_size(x.c.acc_dtype);
+  bool tree =
+      x.use_rendezvous(nbytes) && size > x.e.tune_bcast_flat_ranks_.load();
+  if (!tree) {
+    if (r == root) {
+      if (x.c.op0 == nullptr) return E_INVALID_OPERATION;
+      for (int p = 0; p < size; ++p) {
+        if (p == root) continue;
+        uint32_t rc = x.send_chunk(p, x.c.tag, (const uint8_t*)x.c.op0,
+                                   x.c.op0_dtype, n);
+        if (rc != E_OK) return rc;
+      }
+      return E_OK;
+    }
+    if (x.c.res == nullptr) return E_INVALID_OPERATION;
+    return x.recv_chunk(root, x.c.tag, (uint8_t*)x.c.res, x.c.res_dtype, n);
+  }
+  // binomial tree on root-relative ranks (the doubling scheme of c:815-867)
+  int rel = ((r - root) % size + size) % size;
+  uint8_t* buf = (uint8_t*)(r == root ? x.c.op0 : x.c.res);
+  int32_t bdt = r == root ? x.c.op0_dtype : x.c.res_dtype;
+  if (buf == nullptr) return E_INVALID_OPERATION;
+  int k;
+  if (rel != 0) {
+    int parent_rel = rel - (1 << (bit_length((uint64_t)rel) - 1));
+    int parent = (parent_rel + root) % size;
+    uint32_t rc = x.recv_chunk(parent, x.c.tag, buf, bdt, n);
+    if (rc != E_OK) return rc;
+    k = bit_length((uint64_t)rel);
+  } else {
+    k = 0;
+  }
+  while (rel + (1 << k) < size) {
+    int child = ((rel + (1 << k)) + root) % size;
+    uint32_t rc = x.send_chunk(child, x.c.tag, buf, bdt, n);
+    if (rc != E_OK) return rc;
+    ++k;
+  }
+  return E_OK;
+}
+
+// ref firmware scatter c:992-1123: root fans out per-rank chunks
+// (MOVE_INCREMENT), non-roots receive one chunk
+uint32_t op_scatter(CallCtx& x) {
+  int root = x.c.root_src, r = x.rank(), size = x.size();
+  size_t n = (size_t)x.c.count;
+  if (r == root) {
+    if (x.c.op0 == nullptr) return E_INVALID_OPERATION;
+    const uint8_t* src = (const uint8_t*)x.c.op0;
+    size_t es = dtype_size(x.c.op0_dtype);
+    for (int p = 0; p < size; ++p) {
+      const uint8_t* chunk = src + (size_t)p * n * es;
+      if (p == root) {
+        uint32_t rc = x.write_res(chunk, x.c.op0_dtype, n);
+        if (rc != E_OK) return rc;
+      } else {
+        uint32_t rc = x.send_chunk(p, x.c.tag, chunk, x.c.op0_dtype, n);
+        if (rc != E_OK) return rc;
+      }
+    }
+    return E_OK;
+  }
+  if (x.c.res == nullptr) return E_INVALID_OPERATION;
+  return x.recv_chunk(root, x.c.tag, (uint8_t*)x.c.res, x.c.res_dtype, n);
+}
+
+// ref firmware gather c:1128-1294.  Eager tier: ring relay toward the root
+// (c:1205-1293).  Rendezvous tier: flat fan-in with the tuned window
+// (c:1142-1204).
+uint32_t op_gather(CallCtx& x) {
+  int root = x.c.root_src, r = x.rank(), size = x.size();
+  size_t n = (size_t)x.c.count;
+  if (size == 1) {
+    if (x.c.op0 == nullptr) return E_INVALID_OPERATION;
+    return x.write_res((const uint8_t*)x.c.op0, x.c.op0_dtype, n);
+  }
+  size_t nbytes = n * dtype_size(x.c.acc_dtype);
+  if (x.use_rendezvous(nbytes)) {
+    if (r == root) {
+      if (x.c.res == nullptr || x.c.op0 == nullptr)
+        return E_INVALID_OPERATION;
+      uint8_t* dst_all = (uint8_t*)x.c.res;
+      size_t es = dtype_size(x.c.res_dtype);
+      convert(x.c.op0, x.c.op0_dtype, dst_all + (size_t)root * n * es,
+              x.c.res_dtype, n);
+      int window = nbytes > x.e.tune_gather_flat_count_.load()
+                       ? x.e.tune_gather_fanin_.load()
+                       : size;
+      std::vector<int> peers;
+      for (int p = 0; p < size; ++p)
+        if (p != root) peers.push_back(p);
+      for (size_t i = 0; i < peers.size(); i += (size_t)window) {
+        size_t hi = std::min(peers.size(), i + (size_t)window);
+        std::vector<std::pair<int, uint64_t>> handles;
+        for (size_t j = i; j < hi; ++j) {
+          int p = peers[j];
+          handles.emplace_back(
+              p, x.rndzv_recv_post(p, x.c.tag, dst_all + (size_t)p * n * es,
+                                   n * es));
+        }
+        for (auto& h : handles)
+          if (!x.wait_rndzv_done(h.first, x.c.tag, h.second))
+            return E_RENDEZVOUS_TIMEOUT;
+      }
+      return E_OK;
+    }
+    if (x.c.op0 == nullptr) return E_INVALID_OPERATION;
+    return x.rndzv_send(root, x.c.tag, (const uint8_t*)x.c.op0,
+                        n * dtype_size(x.c.op0_dtype));
+  }
+  // eager ring relay toward root (non-root sends its own block then relays
+  // everything arriving from the next rank)
+  int rel = ((r - root) % size + size) % size;
+  if (rel == 0) {
+    if (x.c.res == nullptr || x.c.op0 == nullptr) return E_INVALID_OPERATION;
+    uint8_t* dst_all = (uint8_t*)x.c.res;
+    size_t es = dtype_size(x.c.res_dtype);
+    convert(x.c.op0, x.c.op0_dtype, dst_all + (size_t)root * n * es,
+            x.c.res_dtype, n);
+    int src_peer = (root + 1) % size;
+    for (int i = 0; i < size - 1; ++i) {
+      int origin = (root + 1 + i) % size;
+      uint32_t rc = x.recv_chunk(src_peer, x.c.tag,
+                                 dst_all + (size_t)origin * n * es,
+                                 x.c.res_dtype, n);
+      if (rc != E_OK) return rc;
+    }
+    return E_OK;
+  }
+  if (x.c.op0 == nullptr) return E_INVALID_OPERATION;
+  int fwd_peer = ((r - 1) % size + size) % size;  // one hop closer to root
+  uint32_t rc =
+      x.send_chunk(fwd_peer, x.c.tag, (const uint8_t*)x.c.op0, x.c.op0_dtype, n);
+  if (rc != E_OK) return rc;
+  int32_t acc_dt = x.c.acc_dtype;
+  std::vector<uint8_t> tmp(n * dtype_size(acc_dt));
+  for (int i = 0; i < size - 1 - rel; ++i) {
+    rc = x.recv_chunk((r + 1) % size, x.c.tag, tmp.data(), acc_dt, n);
+    if (rc != E_OK) return rc;
+    rc = x.send_chunk(fwd_peer, x.c.tag, tmp.data(), acc_dt, n);
+    if (rc != E_OK) return rc;
+  }
+  return E_OK;
+}
+
+// ref firmware allgather c:1297-1503: ring store-and-relay with strided
+// placement (eager c:1402-1500; rendezvous ring c:1314-1401)
+uint32_t op_allgather(CallCtx& x) {
+  int r = x.rank(), size = x.size();
+  size_t n = (size_t)x.c.count;
+  if (x.c.res == nullptr || x.c.op0 == nullptr) return E_INVALID_OPERATION;
+  uint8_t* dst_all = (uint8_t*)x.c.res;
+  size_t es = dtype_size(x.c.res_dtype);
+  convert(x.c.op0, x.c.op0_dtype, dst_all + (size_t)r * n * es, x.c.res_dtype,
+          n);
+  if (size == 1) return E_OK;
+  int nxt = (r + 1) % size, prv = (r - 1 + size) % size;
+  for (int step = 0; step < size - 1; ++step) {
+    int send_origin = ((r - step) % size + size) % size;
+    int recv_origin = ((r - step - 1) % size + size) % size;
+    uint8_t* recv_dst = dst_all + (size_t)recv_origin * n * es;
+    auto h = x.recv_chunk_post(prv, x.c.tag, recv_dst, x.c.res_dtype, n);
+    uint32_t rc = x.send_chunk(nxt, x.c.tag,
+                               dst_all + (size_t)send_origin * n * es,
+                               x.c.res_dtype, n);
+    if (rc != E_OK) return rc;
+    rc = x.recv_chunk_wait(h, recv_dst, x.c.res_dtype);
+    if (rc != E_OK) return rc;
+  }
+  return E_OK;
+}
+
+// ref firmware reduce c:1507-1744: size-1 shortcut (c:1520); flat-tree
+// accumulate for small comms/messages (c:1531-1602); binomial tree for large
+// rendezvous transfers (c:1603-1728); eager ring pipeline of fused
+// recv-reduce-send otherwise (c:1730-1743)
+uint32_t op_reduce(CallCtx& x) {
+  if (!x.c.supports_rfunc) return E_ARITH_ERROR;
+  int root = x.c.root_dst, r = x.rank(), size = x.size();
+  size_t n = (size_t)x.c.count;
+  int32_t acc_dt = x.c.acc_dtype;
+  if (size == 1) {
+    if (x.c.op0 == nullptr) return E_INVALID_OPERATION;
+    return x.write_res((const uint8_t*)x.c.op0, x.c.op0_dtype, n);
+  }
+  if (x.c.op0 == nullptr) return E_INVALID_OPERATION;
+  size_t nbytes = n * dtype_size(acc_dt);
+  bool rndzv = x.use_rendezvous(nbytes);
+  bool flat = size <= x.e.tune_reduce_flat_ranks_.load() ||
+              nbytes <= x.e.tune_reduce_flat_count_.load();
+  if (rndzv && flat) {
+    // flat tree: root accumulates everyone into spares
+    if (r == root) {
+      std::vector<uint8_t> acc(n * dtype_size(acc_dt));
+      convert(x.c.op0, x.c.op0_dtype, acc.data(), acc_dt, n);
+      for (int p = 0; p < size; ++p) {
+        if (p == root) continue;
+        uint32_t rc = x.recv_reduce_chunk(p, x.c.tag, acc.data(), acc_dt, n);
+        if (rc != E_OK) return rc;
+      }
+      return x.write_res(acc.data(), acc_dt, n);
+    }
+    return x.send_chunk(root, x.c.tag, (const uint8_t*)x.c.op0, x.c.op0_dtype,
+                        n);
+  }
+  if (rndzv) {
+    // binomial reduction tree on root-relative ranks (c:1603-1728)
+    int rel = ((r - root) % size + size) % size;
+    std::vector<uint8_t> acc(n * dtype_size(acc_dt));
+    convert(x.c.op0, x.c.op0_dtype, acc.data(), acc_dt, n);
+    int k = 0;
+    while ((1 << k) < size) {
+      if (rel & (1 << k)) {
+        int parent = ((rel - (1 << k)) + root) % size;
+        uint32_t rc = x.send_chunk(parent, x.c.tag, acc.data(), acc_dt, n);
+        if (rc != E_OK) return rc;
+        break;
+      }
+      int child_rel = rel + (1 << k);
+      if (child_rel < size) {
+        int child = (child_rel + root) % size;
+        uint32_t rc =
+            x.recv_reduce_chunk(child, x.c.tag, acc.data(), acc_dt, n);
+        if (rc != E_OK) return rc;
+      }
+      ++k;
+    }
+    if (rel == 0) return x.write_res(acc.data(), acc_dt, n);
+    return E_OK;
+  }
+  // eager ring pipeline: partials flow from the farthest rank toward root,
+  // fused recv-reduce-send at every hop (c:1730-1743)
+  int rel = ((r - root) % size + size) % size;
+  std::vector<uint8_t> acc(n * dtype_size(acc_dt));
+  convert(x.c.op0, x.c.op0_dtype, acc.data(), acc_dt, n);
+  if (rel == size - 1) {
+    uint32_t rc =
+        x.send_chunk((r - 1 + size) % size, x.c.tag, acc.data(), acc_dt, n);
+    if (rc != E_OK) return rc;
+  } else {
+    uint32_t rc =
+        x.recv_reduce_chunk((r + 1) % size, x.c.tag, acc.data(), acc_dt, n);
+    if (rc != E_OK) return rc;
+    if (rel != 0) {
+      rc = x.send_chunk((r - 1 + size) % size, x.c.tag, acc.data(), acc_dt, n);
+      if (rc != E_OK) return rc;
+    }
+  }
+  if (rel == 0) return x.write_res(acc.data(), acc_dt, n);
+  return E_OK;
+}
+
+// contiguous block bounds with the tail spread over leading blocks (ref
+// allreduce tail handling c:1900-1912)
+void block_bounds(size_t total, int parts, std::vector<size_t>& lo,
+                  std::vector<size_t>& hi) {
+  size_t base = total / (size_t)parts, tail = total % (size_t)parts;
+  size_t off = 0;
+  lo.resize((size_t)parts);
+  hi.resize((size_t)parts);
+  for (int i = 0; i < parts; ++i) {
+    size_t n = base + ((size_t)i < tail ? 1 : 0);
+    lo[(size_t)i] = off;
+    hi[(size_t)i] = off + n;
+    off += n;
+  }
+}
+
+// ref firmware reduce_scatter c:1748-1852: eager ring with strided reads +
+// fused recv-reduce (c:1782-1851); rendezvous ring with spare-buffer landing
+uint32_t op_reduce_scatter(CallCtx& x) {
+  if (!x.c.supports_rfunc) return E_ARITH_ERROR;
+  int r = x.rank(), size = x.size();
+  size_t n = (size_t)x.c.count;
+  int32_t acc_dt = x.c.acc_dtype;
+  size_t es = dtype_size(acc_dt);
+  if (x.c.op0 == nullptr) return E_INVALID_OPERATION;
+  if (size == 1) return x.write_res((const uint8_t*)x.c.op0, x.c.op0_dtype, n);
+  std::vector<uint8_t> acc((size_t)size * n * es);
+  convert(x.c.op0, x.c.op0_dtype, acc.data(), acc_dt, (size_t)size * n);
+  int nxt = (r + 1) % size, prv = (r - 1 + size) % size;
+  for (int s = 1; s < size; ++s) {
+    int send_c = ((r - s) % size + size) % size;
+    int recv_c = ((r - 1 - s) % size + size) % size;
+    uint8_t* send_blk = acc.data() + (size_t)send_c * n * es;
+    uint8_t* recv_blk = acc.data() + (size_t)recv_c * n * es;
+    if (x.use_rendezvous(n * es)) {
+      std::vector<uint8_t> tmp(n * es);
+      uint64_t vaddr = x.rndzv_recv_post(prv, x.c.tag, tmp.data(), n * es);
+      uint32_t rc = x.send_chunk(nxt, x.c.tag, send_blk, acc_dt, n);
+      if (rc != E_OK) return rc;
+      if (!x.wait_rndzv_done(prv, x.c.tag, vaddr)) return E_RENDEZVOUS_TIMEOUT;
+      if (!reduce_inplace(x.c.rfunc, acc_dt, recv_blk, tmp.data(), n))
+        return E_ARITH_ERROR;
+    } else {
+      uint32_t rc = x.send_chunk(nxt, x.c.tag, send_blk, acc_dt, n);
+      if (rc != E_OK) return rc;
+      rc = x.recv_reduce_chunk(prv, x.c.tag, recv_blk, acc_dt, n);
+      if (rc != E_OK) return rc;
+    }
+  }
+  return x.write_res(acc.data() + (size_t)r * n * es, acc_dt, n);
+}
+
+// ref firmware allreduce c:1855-2075: segmented ring reduce-scatter followed
+// by ring allgather over `size` blocks with tail handling (c:1888-2071)
+uint32_t op_allreduce(CallCtx& x) {
+  if (!x.c.supports_rfunc) return E_ARITH_ERROR;
+  int r = x.rank(), size = x.size();
+  size_t n = (size_t)x.c.count;
+  int32_t acc_dt = x.c.acc_dtype;
+  size_t es = dtype_size(acc_dt);
+  if (x.c.op0 == nullptr) return E_INVALID_OPERATION;
+  if (size == 1) return x.write_res((const uint8_t*)x.c.op0, x.c.op0_dtype, n);
+  std::vector<uint8_t> acc(n * es);
+  convert(x.c.op0, x.c.op0_dtype, acc.data(), acc_dt, n);
+  std::vector<size_t> lo, hi;
+  block_bounds(n, size, lo, hi);
+  int nxt = (r + 1) % size, prv = (r - 1 + size) % size;
+  auto blk_lo = [&](int i) { return lo[(size_t)(((i % size) + size) % size)]; };
+  auto blk_hi = [&](int i) { return hi[(size_t)(((i % size) + size) % size)]; };
+  // phase 1: ring reduce-scatter over blocks
+  for (int s = 1; s < size; ++s) {
+    size_t slo = blk_lo(r - s), shi = blk_hi(r - s);
+    size_t rlo = blk_lo(r - 1 - s), rhi = blk_hi(r - 1 - s);
+    size_t rn = rhi - rlo;
+    std::vector<uint8_t> tmp(rn * es);
+    auto h = x.recv_chunk_post(prv, x.c.tag, tmp.data(), acc_dt, rn);
+    uint32_t rc =
+        x.send_chunk(nxt, x.c.tag, acc.data() + slo * es, acc_dt, shi - slo);
+    if (rc != E_OK) return rc;
+    rc = x.recv_chunk_wait(h, tmp.data(), acc_dt);
+    if (rc != E_OK) return rc;
+    if (!reduce_inplace(x.c.rfunc, acc_dt, acc.data() + rlo * es, tmp.data(),
+                        rn))
+      return E_ARITH_ERROR;
+  }
+  // phase 2: ring allgather over blocks (rank r now owns reduced block r)
+  for (int s = 0; s < size - 1; ++s) {
+    size_t slo = blk_lo(r - s), shi = blk_hi(r - s);
+    size_t rlo = blk_lo(r - 1 - s), rhi = blk_hi(r - 1 - s);
+    uint8_t* recv_blk = acc.data() + rlo * es;
+    auto h = x.recv_chunk_post(prv, x.c.tag, recv_blk, acc_dt, rhi - rlo);
+    uint32_t rc =
+        x.send_chunk(nxt, x.c.tag, acc.data() + slo * es, acc_dt, shi - slo);
+    if (rc != E_OK) return rc;
+    rc = x.recv_chunk_wait(h, recv_blk, acc_dt);
+    if (rc != E_OK) return rc;
+  }
+  return x.write_res(acc.data(), acc_dt, n);
+}
+
+// ref firmware barrier c:2078-2120: zero-byte gather to rank 0 then
+// zero-byte broadcast back
+uint32_t op_barrier(CallCtx& x) {
+  int r = x.rank(), size = x.size();
+  if (size == 1) return E_OK;
+  uint32_t tag = x.c.tag;
+  std::vector<uint8_t> none;
+  if (r == 0) {
+    for (int p = 1; p < size; ++p) {
+      uint32_t rc = x.eager_recv(p, tag, 0, none);
+      if (rc != E_OK) return rc;
+    }
+    for (int p = 1; p < size; ++p) {
+      uint32_t rc = x.eager_send(p, tag, nullptr, 0);
+      if (rc != E_OK) return rc;
+    }
+    return E_OK;
+  }
+  uint32_t rc = x.eager_send(0, tag, nullptr, 0);
+  if (rc != E_OK) return rc;
+  return x.eager_recv(0, tag, 0, none);
+}
+
+// ref firmware all_to_all c:2123-2218: local copy + serve all peers,
+// completions taken out of order
+uint32_t op_alltoall(CallCtx& x) {
+  int r = x.rank(), size = x.size();
+  size_t n = (size_t)x.c.count;
+  if (x.c.op0 == nullptr || x.c.res == nullptr) return E_INVALID_OPERATION;
+  const uint8_t* src_all = (const uint8_t*)x.c.op0;
+  uint8_t* dst_all = (uint8_t*)x.c.res;
+  size_t ses = dtype_size(x.c.op0_dtype), des = dtype_size(x.c.res_dtype);
+  convert(src_all + (size_t)r * n * ses, x.c.op0_dtype,
+          dst_all + (size_t)r * n * des, x.c.res_dtype, n);
+  if (size == 1) return E_OK;
+  // post all receive addresses first (out-of-order service), then send
+  std::vector<CallCtx::RecvHandle> handles((size_t)size);
+  for (int p = 0; p < size; ++p) {
+    if (p == r) continue;
+    handles[(size_t)p] = x.recv_chunk_post(
+        p, x.c.tag, dst_all + (size_t)p * n * des, x.c.res_dtype, n);
+  }
+  for (int off = 1; off < size; ++off) {
+    int p = (r + off) % size;
+    uint32_t rc = x.send_chunk(p, x.c.tag, src_all + (size_t)p * n * ses,
+                               x.c.op0_dtype, n);
+    if (rc != E_OK) return rc;
+  }
+  for (int p = 0; p < size; ++p) {
+    if (p == r) continue;
+    uint32_t rc = x.recv_chunk_wait(handles[(size_t)p],
+                                    dst_all + (size_t)p * n * des,
+                                    x.c.res_dtype);
+    if (rc != E_OK) return rc;
+  }
+  return E_OK;
+}
+
+}  // namespace
+
+// --------------------------------------------------------------------------
+// dispatch (ref run() switch on scenario, ccl_offload_control.c:2375-2459)
+// --------------------------------------------------------------------------
+
+uint32_t Engine::execute(const CallArgs& args, TimePoint deadline) {
+  if (args.op == OP_NOP) return E_OK;
+  if (args.op == OP_CONFIG) return apply_config(args);
+  CommState* comm = nullptr;
+  {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = comms_.find(args.comm_id);
+    if (it == comms_.end()) return E_INVALID_COMM;
+    comm = it->second.get();
+  }
+  CallCtx x{*this, args, comm, deadline};
+  switch (args.op) {
+    case OP_COPY:
+      return op_copy(x);
+    case OP_COMBINE:
+      return op_combine(x);
+    case OP_SEND:
+      return op_send(x);
+    case OP_RECV:
+      return op_recv(x);
+    case OP_BCAST:
+      return op_bcast(x);
+    case OP_SCATTER:
+      return op_scatter(x);
+    case OP_GATHER:
+      return op_gather(x);
+    case OP_ALLGATHER:
+      return op_allgather(x);
+    case OP_REDUCE:
+      return op_reduce(x);
+    case OP_ALLREDUCE:
+      return op_allreduce(x);
+    case OP_REDUCE_SCATTER:
+      return op_reduce_scatter(x);
+    case OP_ALLTOALL:
+      return op_alltoall(x);
+    case OP_BARRIER:
+      return op_barrier(x);
+    default:
+      return E_COLLECTIVE_NOT_IMPLEMENTED;
+  }
+}
+
+}  // namespace accl

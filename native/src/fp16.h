@@ -1,0 +1,84 @@
+// Scalar IEEE binary16 / bfloat16 <-> float32 conversions shared by the
+// dataplane library and the native engine (role: the hp_compression plugin's
+// fp2hp/hp2fp lanes, kernels/plugins/hp_compression/hp_compression.cpp:30-80,
+// extended with bf16 — the TPU-native wire dtype).
+
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+
+namespace accl_fp {
+
+inline float h2f(uint16_t h) {
+  uint32_t sign = (uint32_t)(h & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1f;
+  uint32_t man = h & 0x3ffu;
+  uint32_t bits;
+  if (exp == 0) {
+    if (man == 0) {
+      bits = sign;
+    } else {  // subnormal: normalize
+      int shift = 0;
+      while (!(man & 0x400u)) {
+        man <<= 1;
+        ++shift;
+      }
+      man &= 0x3ffu;
+      bits = sign | ((127 - 15 - shift + 1) << 23) | (man << 13);
+    }
+  } else if (exp == 0x1f) {
+    bits = sign | 0x7f800000u | (man << 13);
+  } else {
+    bits = sign | ((exp - 15 + 127) << 23) | (man << 13);
+  }
+  float f;
+  std::memcpy(&f, &bits, 4);
+  return f;
+}
+
+inline uint16_t f2h(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  uint32_t sign = (bits >> 16) & 0x8000u;
+  int32_t exp = (int32_t)((bits >> 23) & 0xff) - 127 + 15;
+  uint32_t man = bits & 0x7fffffu;
+  if (((bits >> 23) & 0xff) == 0xff)
+    return (uint16_t)(sign | 0x7c00u | (man ? 0x200u : 0));
+  if (exp >= 0x1f) return (uint16_t)(sign | 0x7c00u);  // overflow -> inf
+  if (exp <= 0) {
+    if (exp < -10) return (uint16_t)sign;  // underflow -> 0
+    man |= 0x800000u;
+    uint32_t shift = (uint32_t)(14 - exp);
+    uint32_t half = man >> shift;
+    // round to nearest even
+    uint32_t rem = man & ((1u << shift) - 1);
+    uint32_t halfway = 1u << (shift - 1);
+    if (rem > halfway || (rem == halfway && (half & 1))) ++half;
+    return (uint16_t)(sign | half);
+  }
+  uint32_t half = (uint32_t)(exp << 10) | (man >> 13);
+  uint32_t rem = man & 0x1fffu;
+  if (rem > 0x1000u || (rem == 0x1000u && (half & 1))) ++half;
+  return (uint16_t)(sign | half);
+}
+
+inline float bf2f(uint16_t b) {
+  uint32_t bits = (uint32_t)b << 16;
+  float f;
+  std::memcpy(&f, &bits, 4);
+  return f;
+}
+
+inline uint16_t f2bf(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  if ((bits & 0x7f800000u) == 0x7f800000u && (bits & 0x7fffffu)) {
+    // NaN: rounding-add would carry low-mantissa payloads into inf
+    return (uint16_t)((bits >> 16) | 0x0040u);  // quiet, keep sign
+  }
+  uint32_t rounding = 0x7fffu + ((bits >> 16) & 1);  // round-nearest-even
+  return (uint16_t)((bits + rounding) >> 16);
+}
+
+}  // namespace accl_fp

@@ -1,0 +1,22 @@
+// Elementwise reduction loops shared by the dataplane library and the native
+// engine (role: the reduce_ops plugin's SIMD SUM/MAX lanes,
+// kernels/plugins/reduce_ops/reduce_ops.cpp:88-97).  Plain contiguous loops
+// the compiler auto-vectorizes.
+
+#pragma once
+
+#include <cstddef>
+
+namespace accl_reduce {
+
+template <typename T>
+inline void sum_loop(T* dst, const T* src, size_t n) {
+  for (size_t i = 0; i < n; ++i) dst[i] += src[i];
+}
+
+template <typename T>
+inline void max_loop(T* dst, const T* src, size_t n) {
+  for (size_t i = 0; i < n; ++i) dst[i] = dst[i] > src[i] ? dst[i] : src[i];
+}
+
+}  // namespace accl_reduce

@@ -24,21 +24,36 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
-@pytest.fixture(scope="module")
-def group2():
+def _make_group(backend: str, n: int):
+    """The reference runs one gtest suite against every execution tier
+    (emulator / RTL sim / hardware, utility.hpp:29-51); we parameterize the
+    shared fixtures over the Python emulator and the native C++ engine the
+    same way."""
+    if backend == "native":
+        from accl_tpu.backends.native import (
+            engine_library_available,
+            native_group,
+        )
+
+        if not engine_library_available():
+            pytest.skip("native engine library unavailable")
+        return native_group(n)
     from accl_tpu import emulated_group
 
-    g = emulated_group(2)
+    return emulated_group(n)
+
+
+@pytest.fixture(scope="module", params=["emu", "native"])
+def group2(request):
+    g = _make_group(request.param, 2)
     yield g
     for a in g:
         a.deinit()
 
 
-@pytest.fixture(scope="module")
-def group4():
-    from accl_tpu import emulated_group
-
-    g = emulated_group(4)
+@pytest.fixture(scope="module", params=["emu", "native"])
+def group4(request):
+    g = _make_group(request.param, 4)
     yield g
     for a in g:
         a.deinit()
