@@ -11,12 +11,18 @@ Pure-functional JAX collectives in two flavors:
   firmware's ring reduce-scatter + allgather allreduce,
   ccl_offload_control.c:1888-2071), for when you need the reference's
   tuning surface (segment sizes, overlap) rather than XLA's choices.
+* ``pallas`` — hand-written TPU kernels for the dataplane hot ops: the
+  reduce_ops/hp_compression plugins as VMEM-tiled VPU passes, and the
+  segmented ring collectives as single Pallas kernels whose hops are
+  Mosaic remote DMAs over ICI with slot-ack flow control (the RX-buffer
+  release protocol).  Off-TPU they execute under the Pallas TPU
+  interpreter, optionally with its vector-clock race detector.
 
 The ``driver`` module wraps both in host-level helpers that take global
 arrays and a Mesh and run the jitted SPMD program.
 """
 
-from . import collectives, ring  # noqa: F401
+from . import collectives, pallas, ring  # noqa: F401
 from .driver import (  # noqa: F401
     make_mesh,
     run_allgather,

@@ -1,0 +1,25 @@
+"""Pallas TPU kernel tier: the reference's hardware dataplane as real
+TPU kernels.
+
+* ``combine`` — the reduce_ops arithmetic plugin (fused elementwise
+  SUM/MAX with optional result-lane cast).
+* ``compression`` — the hp_compression plugin (dtype casts incl.
+  stochastic rounding, plus blockwise int8 wire quantization).
+* ``ring`` — the firmware's segmented ring collectives as single Pallas
+  kernels whose hops are Mosaic remote DMAs over ICI, with slot-ack flow
+  control (the RX-buffer release protocol).
+
+On non-TPU backends every kernel runs under the Pallas TPU interpreter so
+the CI tier exercises the identical kernel code (see
+``_common.default_interpret``).
+"""
+
+from . import compression, ring  # noqa: F401
+from ._common import default_interpret, pack_lanes, unpack_lanes  # noqa: F401
+from .combine import combine  # noqa: F401
+from .compression import cast, dequantize_int8, quantize_int8  # noqa: F401
+from .ring import (  # noqa: F401
+    ring_allgather,
+    ring_allreduce,
+    ring_reduce_scatter,
+)

@@ -1,0 +1,75 @@
+"""Shared plumbing for the Pallas kernel tier.
+
+The kernels in this package are the TPU-native re-design of the reference's
+HLS dataplane plugins (reduce_ops, hp_compression — /root/reference
+kernels/plugins/) and of the segmented-ring hot loop the firmware drives
+through the dma_mover (ccl_offload_control.c:1888-2071): instead of AXIS
+streams through a 512-bit switch, data moves HBM->VMEM->VPU in (rows, 128)
+lane tiles, and inter-chip hops are Mosaic remote DMAs over ICI.
+
+Every public kernel takes ``interpret=None``: on a real TPU it compiles via
+Mosaic; elsewhere it runs under the Pallas TPU interpreter
+(``pltpu.InterpretParams``), which is how the CI tier (virtual CPU mesh)
+executes the very same kernels — the role the reference's x86-compiled HLS
+emulator plays for its hardware dataplane.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.pallas import tpu as pltpu
+
+# TPU vector lane width: last dim of every tile is 128 lanes.
+LANES = 128
+# Sublane padding that satisfies every dtype's minimum tile (f32 needs 8,
+# bf16/f16 16, int8 32 — pad rows to the worst case).
+SUBLANES = 32
+
+InterpretArg = Union[None, bool, "pltpu.InterpretParams"]
+
+
+def default_interpret(interpret: InterpretArg = None):
+    """Resolve the ``interpret`` argument: explicit values pass through;
+    ``None`` selects compiled Mosaic on TPU and the TPU interpreter on any
+    other backend (the CI tier)."""
+    if interpret is not None:
+        return interpret
+    if jax.default_backend() == "tpu":
+        return False
+    return pltpu.InterpretParams()
+
+
+def pack_lanes(x: jax.Array, min_rows: int = SUBLANES):
+    """Flatten ``x`` and pad it into a (rows, LANES) tile-aligned 2-D array.
+
+    Returns ``(packed, n)`` where ``n`` is the original element count;
+    ``unpack_lanes`` inverts it.  Zero padding is benign for every wire/
+    arith op in this package (pads are sliced off before results are used).
+    """
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    rows = -(-n // LANES)
+    rows = max(-(-rows // min_rows), 1) * min_rows  # >=1 tile even for n=0
+    pad = rows * LANES - n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), x.dtype)])
+    return flat.reshape(rows, LANES), n
+
+
+def unpack_lanes(packed: jax.Array, n: int, shape, dtype=None) -> jax.Array:
+    out = packed.reshape(-1)[:n].reshape(shape)
+    return out.astype(dtype) if dtype is not None else out
+
+
+def block_rows(total_rows: int, want: int = 512) -> int:
+    """Pick a grid block height: a divisor of ``total_rows`` close to
+    ``want`` that keeps tiles sublane-aligned."""
+    if total_rows <= want:
+        return total_rows
+    for cand in range(want, SUBLANES - 1, -SUBLANES):
+        if total_rows % cand == 0:
+            return cand
+    return total_rows

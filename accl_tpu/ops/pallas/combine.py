@@ -1,0 +1,83 @@
+"""Fused elementwise combine kernel — the ``reduce_ops`` plugin as a TPU
+kernel.
+
+The reference's arithmetic plugin is a SIMD unit on 512-bit stream words
+with a TDEST-selected (dtype x function) lane table
+(/root/reference/kernels/plugins/reduce_ops/reduce_ops.cpp:88-97, SUM/MAX
+over {fp32, fp64, i32, i64, fp16}).  Here the same role is a Pallas grid
+kernel: operands stream HBM->VMEM in (rows, 128) tiles (the grid pipeline
+double-buffers the DMAs), the VPU applies the reduction, and the result
+streams back — optionally cast to a different output dtype, which fuses the
+``hp_compression`` result lane into the same pass.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ...constants import ReduceFunction
+from ._common import (
+    LANES,
+    InterpretArg,
+    block_rows,
+    default_interpret,
+    pack_lanes,
+    unpack_lanes,
+)
+
+_OPS = {
+    ReduceFunction.SUM: jnp.add,
+    ReduceFunction.MAX: jnp.maximum,
+}
+
+
+def _kernel(op, out_dtype):
+    def kernel(a_ref, b_ref, o_ref):
+        o_ref[:] = op(a_ref[:], b_ref[:]).astype(out_dtype)
+
+    return kernel
+
+
+def combine(
+    a: jax.Array,
+    b: jax.Array,
+    function: ReduceFunction = ReduceFunction.SUM,
+    out_dtype: Optional[jnp.dtype] = None,
+    *,
+    interpret: InterpretArg = None,
+) -> jax.Array:
+    """``out = function(a, b)`` on device — ref ``ACCL::combine``
+    (driver/xrt/src/accl.cpp) executed by the reduce_ops lane.
+
+    Accepts any shape; internally tiles to (rows, 128).  ``out_dtype``
+    fuses the result-lane compression cast.
+    """
+    if a.shape != b.shape or a.dtype != b.dtype:
+        raise ValueError("combine operands must match in shape and dtype")
+    try:
+        op = _OPS[function]
+    except KeyError:
+        raise ValueError(f"unsupported reduce function {function}") from None
+    out_dtype = jnp.dtype(out_dtype or a.dtype)
+
+    ap, n = pack_lanes(a)
+    bp, _ = pack_lanes(b)
+    rows = ap.shape[0]
+    br = block_rows(rows)
+    grid = (rows // br,)
+    spec = pl.BlockSpec((br, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM)
+
+    out = pl.pallas_call(
+        _kernel(op, out_dtype),
+        out_shape=jax.ShapeDtypeStruct((rows, LANES), out_dtype),
+        grid=grid,
+        in_specs=[spec, spec],
+        out_specs=spec,
+        interpret=default_interpret(interpret),
+    )(ap, bp)
+    return unpack_lanes(out, n, a.shape)

@@ -62,3 +62,10 @@ def group4(request):
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "pallas: Pallas kernel tier (runs interpreted off-TPU)",
+    )
