@@ -84,6 +84,12 @@ class XLAGangContext:
         self._seq: Dict[Tuple[int, int], int] = {}  # (comm_id, rank) -> call #
         self._submeshes: Dict[int, object] = {}
         self.timeout_s = DEFAULT_TIMEOUT_S
+        # algorithm-selection tuning registers (the reference's runtime
+        # flat-vs-tree threshold registers, accl.cpp:1198-1208):
+        #   allreduce_algorithm: "xla" (XLA's scheduler picks),
+        #   "ring" (explicit ppermute pipeline), "pallas_ring" (the
+        #   Pallas remote-DMA kernel)
+        self.tuning = {"allreduce_algorithm": "xla", "ring_segments": 1}
 
     # -- communicator -> mesh -----------------------------------------------
     def submesh(self, comm: Communicator):
@@ -300,6 +306,12 @@ class XLAGangContext:
             return opdriver.run_compressed_allreduce(
                 stacked, mesh, fn, wire_dtype=dtype_to_numpy(wire_dtype).name
             )
+        algo = self.tuning.get("allreduce_algorithm", "xla")
+        nseg = int(self.tuning.get("ring_segments", 1))
+        if algo == "ring":
+            return opdriver.run_ring_allreduce(stacked, mesh, fn, nseg)
+        if algo == "pallas_ring":
+            return opdriver.run_pallas_allreduce(stacked, mesh, fn, nseg)
         return opdriver.run_allreduce(stacked, mesh, fn)
 
     @staticmethod

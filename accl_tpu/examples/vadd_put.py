@@ -69,3 +69,36 @@ def vadd_put_streamed(
         stream_id=stream_id,
     )
     accl._launch(opts, False, "vadd_put_streamed")
+
+
+def vadd_put_pallas(stacked, mesh, increment: float = 1.0, distance: int = 1):
+    """The fully-fused variant: compute AND wire in ONE Mosaic kernel.
+
+    Where :func:`vadd_put` computes under jit and hands the result to the
+    engine's stream port, this form is the exact analog of the FPGA flow —
+    a single device kernel (``ops.pallas.fused_shift``) computes
+    ``x + increment`` in VMEM and itself issues the remote DMA to the
+    neighbor ``distance`` away, host and XLA collective scheduler both out
+    of the data path.  ``stacked[r]`` is rank r's operand; returns stacked
+    results (row r = what rank r received)."""
+    try:
+        from jax import shard_map
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops.driver import AXIS
+    from ..ops.pallas import fused_shift
+
+    fn = jax.jit(
+        shard_map(
+            lambda x: fused_shift(
+                x[0], AXIS, distance, lambda v: v + increment
+            )[None],
+            mesh=mesh,
+            in_specs=P(AXIS),
+            out_specs=P(AXIS),
+            check_vma=False,
+        )
+    )
+    return fn(jnp.asarray(stacked, jnp.float32))

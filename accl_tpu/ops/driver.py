@@ -22,7 +22,7 @@ except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map  # type: ignore
 
 from ..constants import ReduceFunction
-from . import collectives, ring
+from . import collectives, pallas, ring
 
 AXIS = "ranks"
 
@@ -57,6 +57,9 @@ def _program(op: str, mesh_id: int, fn: ReduceFunction, extra=None):
     elif op == "ring_allreduce":
         nseg = extra or 1
         body = lambda x: ring.ring_allreduce(x[0], AXIS, fn, nseg)[None]
+    elif op == "pallas_allreduce":
+        nseg = extra or 1
+        body = lambda x: pallas.ring_allreduce(x[0], AXIS, fn, nseg)[None]
     elif op == "compressed_allreduce":
         wire = jnp.dtype(extra or "bfloat16")
         body = lambda x: collectives.compressed_allreduce(
@@ -106,6 +109,16 @@ def run_ring_allreduce(
 ):
     """The explicit segmented-ring pipeline (algorithm-faithful mode)."""
     return _program("ring_allreduce", _mesh_key(mesh), function, num_segments)(
+        _put(stacked, mesh)
+    )
+
+
+def run_pallas_allreduce(
+    stacked, mesh: Mesh, function=ReduceFunction.SUM, num_segments: int = 1
+):
+    """The segmented ring as a single Pallas kernel: remote-DMA hops over
+    ICI with slot-ack flow control (interpreted off-TPU)."""
+    return _program("pallas_allreduce", _mesh_key(mesh), function, num_segments)(
         _put(stacked, mesh)
     )
 

@@ -14,10 +14,11 @@ the CI tier exercises the identical kernel code (see
 ``_common.default_interpret``).
 """
 
-from . import compression, ring  # noqa: F401
+from . import compression, put, ring  # noqa: F401
 from ._common import default_interpret, pack_lanes, unpack_lanes  # noqa: F401
 from .combine import combine  # noqa: F401
 from .compression import cast, dequantize_int8, quantize_int8  # noqa: F401
+from .put import fused_shift  # noqa: F401
 from .ring import (  # noqa: F401
     ring_allgather,
     ring_allreduce,

@@ -73,3 +73,18 @@ def block_rows(total_rows: int, want: int = 512) -> int:
         if total_rows % cand == 0:
             return cand
     return total_rows
+
+
+def neighbor_barrier(peer_a, peer_b):
+    """Barrier with two (possibly equal) peers before the first remote
+    write: signal each peer's global barrier semaphore, wait for both of
+    ours — the precondition that the remote comm scratch exists before
+    data lands in it.  Requires ``collective_id`` in the kernel's
+    CompilerParams."""
+    sem = pltpu.get_barrier_semaphore()
+    for peer in (peer_a, peer_b):
+        pltpu.semaphore_signal(
+            sem, inc=1, device_id=peer,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+    pltpu.semaphore_wait(sem, 2)

@@ -44,7 +44,9 @@ def _stochastic_cast_kernel(out_dtype):
     # then keep the top half-word.  Non-finite values fall back to the
     # deterministic cast.
     def kernel(seed_ref, x_ref, o_ref):
-        pltpu.prng_seed(seed_ref[0])
+        # mix the grid position into the seed so every block draws
+        # independent bits (one seed stream per tile, not one reused one)
+        pltpu.prng_seed(seed_ref[0] + pl.program_id(0))
         x = x_ref[:]
         rand = pltpu.bitcast(pltpu.prng_random_bits(x.shape), jnp.uint32)
         u = pltpu.bitcast(x, jnp.uint32)

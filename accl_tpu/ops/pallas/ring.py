@@ -31,7 +31,12 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ...constants import ReduceFunction
-from ._common import LANES, InterpretArg, default_interpret
+from ._common import (
+    LANES,
+    InterpretArg,
+    default_interpret,
+    neighbor_barrier,
+)
 
 _OPS = {
     ReduceFunction.SUM: jnp.add,
@@ -60,16 +65,7 @@ def _neighbors(axis_name: str, size: int):
 
 
 def _ring_barrier(nxt, prv):
-    """Neighbor barrier before first remote write (both neighbors' scratch
-    must exist before data lands in it)."""
-    sem = pltpu.get_barrier_semaphore()
-    pltpu.semaphore_signal(
-        sem, inc=1, device_id=nxt, device_id_type=pltpu.DeviceIdType.LOGICAL
-    )
-    pltpu.semaphore_signal(
-        sem, inc=1, device_id=prv, device_id_type=pltpu.DeviceIdType.LOGICAL
-    )
-    pltpu.semaphore_wait(sem, 2)
+    neighbor_barrier(nxt, prv)
 
 
 def _hop(comm, send_sem, recv_sem, ack_sem, src_ref, slot, seg, nxt, prv,

@@ -14,7 +14,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 from jax.experimental.pallas import tpu as pltpu
 
@@ -248,3 +251,39 @@ def test_int8_dtype_restore():
     v, s, n = pk.quantize_int8(x)
     back = pk.dequantize_int8(v, s, n, x.shape, dtype=x.dtype)
     assert back.dtype == jnp.bfloat16
+
+
+# ---------------------------------------------------------------------------
+# fused compute + put (device-initiated communication, vadd_put role)
+# ---------------------------------------------------------------------------
+
+
+def test_fused_shift_put():
+    mesh = _mesh(4)
+    n = 700
+    data = jnp.asarray(
+        np.random.default_rng(7).normal(size=(4, n)), jnp.float32
+    )
+    fn = jax.jit(
+        shard_map(
+            lambda x: pk.fused_shift(
+                x[0], "x", 1, lambda v: v * 2.0
+            )[None],
+            mesh=mesh, in_specs=P("x"), out_specs=P("x"), check_vma=False,
+        )
+    )
+    out = np.asarray(fn(data))
+    expect = np.roll(np.asarray(data) * 2.0, 1, axis=0)
+    np.testing.assert_allclose(out, expect)
+
+
+def test_vadd_put_pallas_example():
+    from accl_tpu.examples.vadd_put import vadd_put_pallas
+    from accl_tpu.ops import make_mesh
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+    mesh = make_mesh(4)
+    data = np.arange(4 * 300, dtype=np.float32).reshape(4, 300)
+    out = np.asarray(vadd_put_pallas(data, mesh, increment=1.0))
+    np.testing.assert_allclose(out, np.roll(data + 1.0, 1, axis=0))

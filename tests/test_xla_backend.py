@@ -344,3 +344,32 @@ def test_xla_watchdog_threads_bounded(rng):
     finally:
         for a in g:
             a.deinit()
+
+
+@pytest.mark.parametrize("algo", ["ring", "pallas_ring"])
+def test_xla_allreduce_algorithm_tuning(algo, rng):
+    """The gang's algorithm-selection tuning register (the reference's
+    runtime flat-vs-tree threshold surface, accl.cpp:1198-1208) switches
+    the allreduce lowering: explicit ppermute ring or the Pallas
+    remote-DMA ring kernel — same MPI-facade semantics either way."""
+    g = xla_group(4)
+    try:
+        g[0].engine.gang.tuning.update(
+            {"allreduce_algorithm": algo, "ring_segments": 2}
+        )
+        count = 2 * 8 * 128
+        chunks = [rng.standard_normal(count).astype(np.float32) for _ in g]
+        expected = np.sum(chunks, axis=0)
+
+        def work(accl, rank):
+            send = accl.create_buffer_from(chunks[rank])
+            recv = accl.create_buffer(count, np.float32)
+            accl.allreduce(send, recv, count)
+            recv.sync_from_device()
+            return recv.data.copy()
+
+        for got in run_parallel(g, work):
+            np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+    finally:
+        for a in g:
+            a.deinit()
