@@ -18,3 +18,6 @@ from .transformer import (  # noqa: F401
     make_sharded_forward,
 )
 from .ring_attention import ring_attention, reference_attention  # noqa: F401
+from ..ops.pallas.attention import (  # noqa: F401
+    ring_attention as ring_attention_pallas,
+)

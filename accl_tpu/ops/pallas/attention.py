@@ -1,0 +1,209 @@
+"""Ring attention as one Pallas kernel: K/V blocks rotate over ICI while
+the MXU folds the visiting block — wire/compute overlap *inside* the
+kernel.
+
+The model-level ``models.ring_attention`` expresses the rotation as
+``lax.ppermute`` hops and leaves overlap to XLA's scheduler.  This kernel
+owns the schedule the way the reference firmware owns its segmented ring
+hot loop (ccl_offload_control.c:1888-2071 — recv/reduce/send of hop ``s``
+overlapped explicitly): at every hop the *next* remote DMA is launched
+first, then the just-arrived K/V block is folded into the online-softmax
+state while the wire runs.  Slot reuse is ack-gated exactly like
+``ops.pallas.ring`` (the RX-buffer release protocol).
+
+Layout: per device q/k/v are ``(BH, T, D)`` — batch x heads folded into
+the leading dim, D padded to the 128-lane width by the wrapper.  The
+online-softmax state (running numerator, max, denominator) lives in VMEM
+scratch in float32 regardless of input dtype.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ._common import LANES, InterpretArg, default_interpret, neighbor_barrier
+
+_NEG = -1e30
+
+
+def _fold(bh, q_ref, k_blk_ref, v_blk_ref, o_acc, m_ref, l_ref, mask, scale):
+    """Fold one visiting K/V block into (o, m, l) for batch-head ``bh``."""
+    q = q_ref[bh].astype(jnp.float32)
+    k_blk = k_blk_ref[bh].astype(jnp.float32)
+    v_blk = v_blk_ref[bh].astype(jnp.float32)
+    scores = jax.lax.dot_general(
+        q, k_blk,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    scores = jnp.where(mask, scores, _NEG)
+    m_old = m_ref[bh][:, :1]
+    m_new = jnp.maximum(m_old, scores.max(axis=-1, keepdims=True))
+    p = jnp.exp(scores - m_new)
+    alpha = jnp.exp(m_old - m_new)
+    o_acc[bh] = o_acc[bh] * alpha + jax.lax.dot_general(
+        p, v_blk,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    l_ref[bh] = jnp.broadcast_to(
+        l_ref[bh][:, :1] * alpha + p.sum(axis=-1, keepdims=True),
+        l_ref[bh].shape,
+    )
+    m_ref[bh] = jnp.broadcast_to(m_new, m_ref[bh].shape)
+
+
+def _attention_kernel(axis_name, size, causal, scale):
+    total_hops = size - 1
+
+    def kernel(q_ref, k_ref, v_ref, o_ref,
+               o_acc, m_ref, l_ref, comm, send_sem, recv_sem, ack_sem):
+        BH, T, D = q_ref.shape
+        me = lax.axis_index(axis_name)
+        nxt = jnp.where(me + 1 == size, 0, me + 1)
+        prv = jnp.where(me == 0, size - 1, me - 1)
+
+        rows = lax.broadcasted_iota(jnp.int32, (T, T), 0)
+        cols = lax.broadcasted_iota(jnp.int32, (T, T), 1)
+        tri = rows >= cols
+        ones = jnp.ones((T, T), jnp.bool_)
+
+        def mask_for(origin):
+            if not causal:
+                return ones
+            return jnp.where(
+                origin == me, tri,
+                jnp.where(origin < me, ones, jnp.zeros((T, T), jnp.bool_)),
+            )
+
+        # init state + fold the local block
+        for bh in range(BH):
+            o_acc[bh] = jnp.zeros((T, D), jnp.float32)
+            m_ref[bh] = jnp.full((T, LANES), _NEG, jnp.float32)
+            l_ref[bh] = jnp.zeros((T, LANES), jnp.float32)
+
+        if size > 1:
+            neighbor_barrier(nxt, prv)
+
+            # hop 1 in flight before any compute: send local K/V to next
+            def start_hop(hop, src_k, src_v):
+                slot = hop % 2
+                if hop > 2:
+                    pltpu.semaphore_wait(ack_sem.at[slot], 2)
+                for which, src in ((0, src_k), (1, src_v)):
+                    pltpu.make_async_remote_copy(
+                        src_ref=src,
+                        dst_ref=comm.at[slot, which],
+                        send_sem=send_sem.at[slot, which],
+                        recv_sem=recv_sem.at[slot, which],
+                        device_id=nxt,
+                        device_id_type=pltpu.DeviceIdType.LOGICAL,
+                    ).start()
+
+            def wait_hop(hop):
+                slot = hop % 2
+                for which in (0, 1):
+                    pltpu.make_async_remote_copy(
+                        src_ref=comm.at[slot, which],
+                        dst_ref=comm.at[slot, which],
+                        send_sem=send_sem.at[slot, which],
+                        recv_sem=recv_sem.at[slot, which],
+                        device_id=nxt,
+                        device_id_type=pltpu.DeviceIdType.LOGICAL,
+                    ).wait()
+
+            start_hop(1, k_ref, v_ref)
+
+        for bh in range(BH):
+            _fold(bh, q_ref, k_ref, v_ref, o_acc, m_ref, l_ref,
+                  mask_for(me), scale)
+
+        for s in range(1, size):
+            slot = s % 2
+            wait_hop(s)  # K/V block s landed; send side of hop s drained
+            # hop s's send read comm[(s-1)%2]; that drain (just waited) is
+            # what frees the *previous* slot for the upstream neighbor —
+            # acking any earlier would let prv overwrite a slot the
+            # forwarding DMA is still reading (real race, caught by the
+            # interpreter's detector).  Signal only while a future hop
+            # (s+1 <= P-1 at prv) will consume the ack.
+            if 2 <= s <= size - 2:
+                pltpu.semaphore_signal(
+                    ack_sem.at[(s - 1) % 2], inc=2, device_id=prv,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL,
+                )
+            if s + 1 < size:
+                # launch the next rotation *before* folding: the wire moves
+                # hop s+1 while the MXU folds hop s (the overlap the
+                # firmware gets from its segmented move pipeline)
+                start_hop(s + 1, comm.at[slot, 0], comm.at[slot, 1])
+            origin = jnp.mod(me - s, size)
+            for bh in range(BH):
+                _fold(bh, q_ref, comm.at[slot, 0], comm.at[slot, 1],
+                      o_acc, m_ref, l_ref, mask_for(origin), scale)
+
+        for bh in range(BH):
+            o_ref[bh] = (
+                o_acc[bh] / jnp.maximum(l_ref[bh][:, :1], 1e-30)
+            ).astype(o_ref.dtype)
+
+    return kernel
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    causal: bool = True,
+    *,
+    collective_id: int = 2,
+    interpret: InterpretArg = None,
+) -> jax.Array:
+    """Sequence-parallel attention in one Pallas kernel.
+
+    q, k, v: ``(B, H, T_local, D)`` per device inside ``shard_map`` over a
+    1-D mesh axis (sequence axis sharded).  Returns ``(B, H, T_local, D)``.
+    D is padded to 128 lanes internally; T_local must be a multiple of 8.
+    """
+    B, H, T, D = q.shape
+    size = lax.axis_size(axis_name)
+    if T % 8:
+        raise ValueError("T_local must be a multiple of 8")
+    scale = 1.0 / (D ** 0.5)  # scale by the *logical* head dim, not padded
+
+    pad = (-D) % LANES
+    if pad:
+        padding = [(0, 0)] * 3 + [(0, pad)]
+        q, k, v = (jnp.pad(a, padding) for a in (q, k, v))
+    Dp = D + pad
+
+    qf = q.reshape(B * H, T, Dp)
+    kf = k.reshape(B * H, T, Dp)
+    vf = v.reshape(B * H, T, Dp)
+
+    out = pl.pallas_call(
+        _attention_kernel(axis_name, size, causal, scale),
+        out_shape=jax.ShapeDtypeStruct((B * H, T, Dp), q.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 3,
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((B * H, T, Dp), jnp.float32),   # o accumulator
+            pltpu.VMEM((B * H, T, LANES), jnp.float32),  # running max
+            pltpu.VMEM((B * H, T, LANES), jnp.float32),  # running denom
+            pltpu.VMEM((2, 2, B * H, T, Dp), q.dtype),   # K/V comm slots
+            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.SemaphoreType.REGULAR((2,)),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=collective_id
+        ),
+        interpret=default_interpret(interpret),
+    )(qf, kf, vf)
+    out = out.reshape(B, H, T, Dp)
+    return out[..., :D] if pad else out
