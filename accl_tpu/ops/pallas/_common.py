@@ -88,3 +88,26 @@ def neighbor_barrier(peer_a, peer_b):
             device_id_type=pltpu.DeviceIdType.LOGICAL,
         )
     pltpu.semaphore_wait(sem, 2)
+
+
+def ack_gate(ack_sem_ref, hop: int, value: int = 1):
+    """Slot-reuse gate of the RX-release protocol: before writing a
+    double-buffered comm slot at ring hop ``hop`` (1-based), wait for the
+    consumer's ack.  Hops 1 and 2 write fresh slots and pass ungated;
+    hop h >= 3 reuses hop h-2's slot and must absorb ``value`` signals
+    (one per DMA the consumer drained)."""
+    if hop > 2:
+        pltpu.semaphore_wait(ack_sem_ref, value)
+
+
+def ack_release(ack_sem_ref, hop: int, total_hops: int, upstream, value: int = 1):
+    """Release half of the protocol: after hop ``hop``'s slot is fully
+    consumed — folded/copied *and* any forwarding DMA reading it has
+    drained — signal the upstream sender that the slot is free.  Only
+    emitted while a future hop (hop+2 <= total_hops) will absorb it, so
+    all semaphores drain to zero by kernel end."""
+    if hop + 2 <= total_hops:
+        pltpu.semaphore_signal(
+            ack_sem_ref, inc=value, device_id=upstream,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )

@@ -25,7 +25,14 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ._common import LANES, InterpretArg, default_interpret, neighbor_barrier
+from ._common import (
+    LANES,
+    InterpretArg,
+    ack_gate,
+    ack_release,
+    default_interpret,
+    neighbor_barrier,
+)
 
 _NEG = -1e30
 
@@ -92,8 +99,7 @@ def _attention_kernel(axis_name, size, causal, scale):
             # hop 1 in flight before any compute: send local K/V to next
             def start_hop(hop, src_k, src_v):
                 slot = hop % 2
-                if hop > 2:
-                    pltpu.semaphore_wait(ack_sem.at[slot], 2)
+                ack_gate(ack_sem.at[slot], hop, value=2)  # 2 DMAs (K+V)
                 for which, src in ((0, src_k), (1, src_v)):
                     pltpu.make_async_remote_copy(
                         src_ref=src,
@@ -131,10 +137,9 @@ def _attention_kernel(axis_name, size, causal, scale):
             # forwarding DMA is still reading (real race, caught by the
             # interpreter's detector).  Signal only while a future hop
             # (s+1 <= P-1 at prv) will consume the ack.
-            if 2 <= s <= size - 2:
-                pltpu.semaphore_signal(
-                    ack_sem.at[(s - 1) % 2], inc=2, device_id=prv,
-                    device_id_type=pltpu.DeviceIdType.LOGICAL,
+            if s >= 2:  # hop 1 sent from the input refs, not a comm slot
+                ack_release(
+                    ack_sem.at[(s - 1) % 2], s - 1, total_hops, prv, value=2
                 )
             if s + 1 < size:
                 # launch the next rotation *before* folding: the wire moves
@@ -171,9 +176,18 @@ def ring_attention(
     D is padded to 128 lanes internally; T_local must be a multiple of 8.
     """
     B, H, T, D = q.shape
-    size = lax.axis_size(axis_name)
+    if k.shape != q.shape or v.shape != q.shape:
+        raise ValueError(
+            f"q/k/v shapes must match, got {q.shape}/{k.shape}/{v.shape}"
+        )
+    if k.dtype != q.dtype or v.dtype != q.dtype:
+        raise ValueError(
+            f"q/k/v dtypes must match (comm slots and DMAs are typed from "
+            f"q), got {q.dtype}/{k.dtype}/{v.dtype}"
+        )
     if T % 8:
         raise ValueError("T_local must be a multiple of 8")
+    size = lax.axis_size(axis_name)
     scale = 1.0 / (D ** 0.5)  # scale by the *logical* head dim, not padded
 
     pad = (-D) % LANES

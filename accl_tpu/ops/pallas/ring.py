@@ -34,6 +34,8 @@ from ...constants import ReduceFunction
 from ._common import (
     LANES,
     InterpretArg,
+    ack_gate,
+    ack_release,
     default_interpret,
     neighbor_barrier,
 )
@@ -74,8 +76,7 @@ def _hop(comm, send_sem, recv_sem, ack_sem, src_ref, slot, seg, nxt, prv,
     into the next rank's ``comm[slot, seg]``.  Returns the descriptor to
     wait on.  Ack protocol = the reference's RX-buffer release: a slot is
     rewritten two hops later only after its consumer signalled it free."""
-    if hop > 2:
-        pltpu.semaphore_wait(ack_sem.at[slot, seg], 1)
+    ack_gate(ack_sem.at[slot, seg], hop)
     rdma = pltpu.make_async_remote_copy(
         src_ref=src_ref,
         dst_ref=comm.at[slot, seg],
@@ -91,13 +92,7 @@ def _hop(comm, send_sem, recv_sem, ack_sem, src_ref, slot, seg, nxt, prv,
 def _release(ack_sem, slot, seg, prv, hop, total_hops):
     """Tell the sender (prev rank) its slot is consumed — unless no future
     hop will reuse it (semaphores must drain to zero by kernel end)."""
-    if hop + 2 <= total_hops:
-        pltpu.semaphore_signal(
-            ack_sem.at[slot, seg],
-            inc=1,
-            device_id=prv,
-            device_id_type=pltpu.DeviceIdType.LOGICAL,
-        )
+    ack_release(ack_sem.at[slot, seg], hop, total_hops, prv)
 
 
 def _scratch(size, num_segments, seg_rows, dtype, with_acc):

@@ -122,7 +122,7 @@ def sweep_group(group, sizes: List[int], collectives: List[str], writer) -> None
             )
 
 
-def sweep_ops(world: int, sizes: List[int], writer) -> None:
+def sweep_ops(world: int, sizes: List[int], writer, extra_algos=()) -> None:
     """Sweep the pure shard_map ops layer over the device mesh (wall-clock
     around the jitted program; slope-corrected like bench.py would need on
     tunneled backends is overkill here — this path is for CPU/TPU local)."""
@@ -138,6 +138,20 @@ def sweep_ops(world: int, sizes: List[int], writer) -> None:
         "bcast": opdriver.run_bcast,
         "alltoall": opdriver.run_alltoall,
     }
+    # algorithm-faithful variants (the tuning-register surface): opt-in via
+    # --extra-algos since the Pallas kernels run interpreted (slowly) off-TPU
+    if "ring" in extra_algos:
+        runners["allreduce_ring"] = (
+            lambda stacked, mesh: opdriver.run_ring_allreduce(
+                stacked, mesh, num_segments=4
+            )
+        )
+    if "pallas" in extra_algos:
+        runners["allreduce_pallas_ring"] = (
+            lambda stacked, mesh: opdriver.run_pallas_allreduce(
+                stacked, mesh, num_segments=4
+            )
+        )
     for op, fn in runners.items():
         for n in sizes:
             shape = (world, world * n) if op in ("reduce_scatter", "alltoall") else (world, n)
@@ -167,7 +181,22 @@ def main(argv=None) -> int:
     ap.add_argument("--max-exp", type=int, default=19)
     ap.add_argument("--csv", default="-")
     ap.add_argument("--collectives", nargs="*", default=COLLECTIVES)
+    ap.add_argument(
+        "--platform", default=None,
+        help="force a jax platform (e.g. 'cpu'); needed where a site PJRT "
+             "plugin overrides the JAX_PLATFORMS env var",
+    )
+    ap.add_argument(
+        "--extra-algos", nargs="*", default=[], choices=["ring", "pallas"],
+        help="ops backend only: also sweep explicit ring / Pallas-ring "
+             "allreduce (the algorithm-faithful modes)",
+    )
     args = ap.parse_args(argv)
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
 
     sizes = [2**e for e in range(args.min_exp, args.max_exp + 1)]
     out = sys.stdout if args.csv == "-" else open(args.csv, "w", newline="")
@@ -177,7 +206,7 @@ def main(argv=None) -> int:
     writer.writeheader()
 
     if args.backend == "ops":
-        sweep_ops(args.world, sizes, writer)
+        sweep_ops(args.world, sizes, writer, tuple(args.extra_algos))
     else:
         from accl_tpu import core
 

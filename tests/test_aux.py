@@ -140,3 +140,19 @@ def test_launcher_multiprocess():
 
     results = launch_processes(allreduce_main, world=2, base_port=47411)
     assert results == [3.0, 3.0]
+
+
+def test_stress_short(group2):
+    """Short randomized stress pass (the reference's stress.cpp loop,
+    test/host/xrt/src/stress.cpp:24) against the shared 2-rank fixture —
+    integrity-checked send/recv pairs and mixed collectives."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "stress",
+        os.path.join(os.path.dirname(__file__), "..", "benchmarks", "stress.py"),
+    )
+    stress_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(stress_mod)
+    stress_mod.stress(group2, iters=40, max_count=512, report_every=0)

@@ -362,10 +362,12 @@ def test_pallas_ring_attention_race_free(capsys):
     premature-release variant as a write/read race on the comm scratch."""
     from accl_tpu.models.ring_attention import reference_attention
 
-    if len(jax.devices()) < 4:
-        pytest.skip("needs 4 devices")
-    mesh = Mesh(np.array(jax.devices()[:4]), ("sp",))
-    B, H, T, D = 1, 1, 4 * 8, 32
+    if len(jax.devices()) < 5:
+        pytest.skip("needs 5 devices")
+    # 5 ranks: 4 hops, so BOTH comm slots get reused (gates at hops 3 and
+    # 4, releases at s=2 and s=3) — the full flow-control surface
+    mesh = Mesh(np.array(jax.devices()[:5]), ("sp",))
+    B, H, T, D = 1, 1, 5 * 8, 32
     keys = jax.random.split(jax.random.PRNGKey(3), 3)
     q, k, v = (
         jax.random.normal(kk, (B, H, T, D), jnp.float32) * 0.5 for kk in keys
@@ -386,3 +388,17 @@ def test_pallas_ring_attention_race_free(capsys):
     expect = np.asarray(reference_attention(q, k, v))
     np.testing.assert_allclose(out, expect, rtol=2e-3, atol=2e-3)
     assert "RACE DETECTED" not in capsys.readouterr().out
+
+
+def test_pallas_ring_attention_validates_qkv():
+    with pytest.raises(ValueError, match="shapes"):
+        pk.attention.ring_attention(
+            jnp.zeros((1, 1, 8, 32)), jnp.zeros((1, 1, 16, 32)),
+            jnp.zeros((1, 1, 8, 32)), "sp",
+        )
+    with pytest.raises(ValueError, match="dtypes"):
+        pk.attention.ring_attention(
+            jnp.zeros((1, 1, 8, 32), jnp.float32),
+            jnp.zeros((1, 1, 8, 32), jnp.bfloat16),
+            jnp.zeros((1, 1, 8, 32), jnp.bfloat16), "sp",
+        )
