@@ -5,3 +5,9 @@ from .topology import (  # noqa: F401
     generate_ranks,
     mesh_from_topology,
 )
+from .multihost import (  # noqa: F401
+    MultihostContext,
+    bootstrap_multihost,
+    dp_over_dcn_mesh,
+    hybrid_mesh,
+)

@@ -1,0 +1,168 @@
+"""Multi-host bootstrap: DCN x ICI meshes over ``jax.distributed``.
+
+The reference scales beyond one FPGA cluster node with MPI process launch
+plus per-rank IP/session tables (``accl_network_utils::generate_ranks`` +
+``initialize_accl`` configuring the 100G stacks per rank; test fixtures
+launched via ``mpirun`` — test/host/xrt/include/fixture.hpp:124-132).  On
+TPU pods the same role splits in two:
+
+* **ICI** connects chips within a slice — collectives ride it when the
+  mesh axis stays inside the slice;
+* **DCN** (data-center network) connects hosts/slices — the analog of the
+  reference's Ethernet fabric between nodes.
+
+``jax.distributed`` is the process bootstrap (the mpirun + rank-table
+role): a coordinator address and (process_id, num_processes) wire every
+host into one global runtime, after which ``jax.devices()`` spans the pod
+and meshes can be laid out so that the *outer* axis maps to DCN and the
+*inner* axes to ICI — XLA then picks the right transport per collective
+hop, exactly the way the reference routes intra- vs inter-node traffic.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+@dataclass
+class MultihostContext:
+    """What ``bootstrap_multihost`` gives back: identity + topology."""
+
+    process_id: int
+    num_processes: int
+    coordinator_address: Optional[str]
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.process_id == 0
+
+    def local_devices(self):
+        import jax
+
+        return jax.local_devices()
+
+    def global_devices(self):
+        import jax
+
+        return jax.devices()
+
+    def process_count(self) -> int:
+        import jax
+
+        return jax.process_count()
+
+
+def bootstrap_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids: Optional[Sequence[int]] = None,
+    *,
+    auto: bool = False,
+) -> MultihostContext:
+    """Join (or run standalone in) a multi-host JAX runtime.
+
+    On a TPU pod call with ``auto=True`` and no other arguments: JAX's own
+    cluster detection supplies coordinator and ranks (the TPU metadata
+    server is the rank table).  On CPU/GPU clusters pass the arguments
+    explicitly — they play exactly the role of the reference's rank JSON +
+    ``mpirun`` rank/size.  Must run before any other JAX call (backend
+    initialization pins the process topology).
+
+    With no coordinator, no explicit world size, and ``auto=False`` this is
+    the single-process path — ``jax.distributed`` is skipped entirely so
+    the same code works in tests and single-host runs.
+    """
+    import jax
+
+    if (
+        not auto
+        and coordinator_address is None
+        and (num_processes or 1) == 1
+    ):
+        return MultihostContext(0, 1, None)
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    return MultihostContext(
+        jax.process_index(), jax.process_count(), coordinator_address
+    )
+
+
+def hybrid_mesh(
+    dcn_axis: str = "dcn",
+    ici_axes: Optional[Dict[str, int]] = None,
+    *,
+    devices: Optional[Sequence] = None,
+    allow_split_physical_axes: bool = False,
+):
+    """A Mesh whose outer axis crosses hosts/slices (DCN) and whose inner
+    axes stay inside a slice (ICI).
+
+    ``ici_axes`` maps axis names to sizes for the per-slice sub-mesh; the
+    DCN axis size is ``len(devices) // prod(ici_axes)``.  Collectives over
+    the inner axes ride ICI; only the outer-axis hops (e.g. the dp
+    gradient allreduce) touch DCN — the layout rule from the scaling
+    playbook, and the reason the reference keeps its ring *within* the
+    100G cluster fabric.
+
+    On slice-aware platforms (real TPU pods) the device grid comes from
+    ``mesh_utils.create_hybrid_device_mesh`` so slice boundaries line up
+    with the DCN axis; errors there are real configuration errors and
+    propagate.  Devices without slice topology (CPU, emulated tiers) get a
+    contiguous split — device order stands in for slice adjacency.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devs = list(devices) if devices is not None else jax.devices()
+    n = len(devs)
+    if ici_axes:
+        ici = int(np.prod(list(ici_axes.values())))
+    else:
+        per = max(len(jax.local_devices()), 1)
+        ici_axes = {"ici": per}
+        ici = per
+    if n % ici:
+        raise ValueError(
+            f"{n} devices do not divide into ICI submeshes of {ici}"
+        )
+    num_slices = n // ici
+    ici_shape = tuple(ici_axes.values())
+
+    slice_aware = getattr(devs[0], "slice_index", None) is not None
+    if slice_aware and num_slices > 1:
+        from jax.experimental import mesh_utils
+
+        # documented contract: mesh_shape and dcn_mesh_shape have the same
+        # length; the result shape is their elementwise product =
+        # (num_slices, *ici_shape)
+        arr = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(1,) + ici_shape,
+            dcn_mesh_shape=(num_slices,) + (1,) * len(ici_shape),
+            devices=devs,
+            allow_split_physical_axes=allow_split_physical_axes,
+        )
+    else:
+        # no slice topology: contiguous split — devices within a process
+        # are DCN-adjacent the way chips in a slice are
+        arr = np.array(devs).reshape((num_slices,) + ici_shape)
+    return Mesh(arr, (dcn_axis,) + tuple(ici_axes.keys()))
+
+
+def dp_over_dcn_mesh(tp: int = 1, dcn_axis: str = "dp", tp_axis: str = "tp"):
+    """The canonical two-level training layout: model (tp) inside a slice
+    on ICI, data parallel across slices on DCN."""
+    import jax
+
+    n = len(jax.devices())
+    if n % tp:
+        raise ValueError(f"{n} devices not divisible by tp={tp}")
+    return hybrid_mesh(dcn_axis, {tp_axis: tp})

@@ -156,3 +156,65 @@ def test_stress_short(group2):
     stress_mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(stress_mod)
     stress_mod.stress(group2, iters=40, max_count=512, report_every=0)
+
+
+def test_multihost_singleprocess_bootstrap():
+    """Single-process path of the multi-host bootstrap (the degenerate
+    'cluster of one', like running the reference's fixtures without
+    mpirun)."""
+    from accl_tpu.parallel import bootstrap_multihost
+
+    ctx = bootstrap_multihost()
+    assert ctx.is_coordinator and ctx.num_processes == 1
+    assert len(ctx.global_devices()) >= 1
+
+
+def test_hybrid_mesh_layout():
+    """DCN x ICI mesh layout on the virtual device pool: outer axis =
+    'slices', inner axes stay within a slice."""
+    import jax
+
+    from accl_tpu.parallel import dp_over_dcn_mesh, hybrid_mesh
+
+    mesh = hybrid_mesh("dcn", {"x": 4})
+    assert mesh.axis_names == ("dcn", "x")
+    assert mesh.devices.shape == (len(jax.devices()) // 4, 4)
+
+    sub = hybrid_mesh("dcn", {"x": 2}, devices=jax.devices()[:4])
+    assert sub.devices.shape == (2, 2)
+
+    mesh2 = dp_over_dcn_mesh(tp=2)
+    assert mesh2.axis_names == ("dp", "tp")
+    assert mesh2.devices.shape == (len(jax.devices()) // 2, 2)
+
+
+def test_hybrid_mesh_runs_two_level_collective():
+    """A two-level program: psum over ICI axis then over the DCN axis —
+    the dp-gradient-over-DCN pattern."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        from jax import shard_map
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+
+    from accl_tpu.parallel import hybrid_mesh
+
+    mesh = hybrid_mesh("dcn", {"x": 4})
+    n = mesh.devices.size
+
+    def body(v):
+        local = jax.lax.psum(v, "x")     # intra-slice: ICI
+        return jax.lax.psum(local, "dcn")  # cross-slice: DCN
+
+    fn = jax.jit(
+        shard_map(
+            body, mesh=mesh, in_specs=P(("dcn", "x")), out_specs=P(),
+            check_vma=False,
+        )
+    )
+    out = np.asarray(fn(jnp.ones((n,), jnp.float32)))
+    np.testing.assert_allclose(out, float(n))
