@@ -70,94 +70,120 @@ def _ring_barrier(nxt, prv):
     neighbor_barrier(nxt, prv)
 
 
-def _hop(comm, send_sem, recv_sem, ack_sem, src_ref, slot, seg, nxt, prv,
-         hop, total_hops):
+def _hop(dst_ref, src_ref, send_ref, recv_ref, ack_ref, dst_dev, hop):
     """One segment of one ring hop: ack-gated remote DMA of ``src_ref``
-    into the next rank's ``comm[slot, seg]``.  Returns the descriptor to
-    wait on.  Ack protocol = the reference's RX-buffer release: a slot is
-    rewritten two hops later only after its consumer signalled it free."""
-    ack_gate(ack_sem.at[slot, seg], hop)
+    into ``dst_ref`` on device ``dst_dev`` (a comm slot there).  All refs
+    arrive fully indexed.  Returns the descriptor to wait on.  Ack
+    protocol = the reference's RX-buffer release: a slot is rewritten two
+    hops later only after its consumer signalled it free."""
+    ack_gate(ack_ref, hop)
     rdma = pltpu.make_async_remote_copy(
         src_ref=src_ref,
-        dst_ref=comm.at[slot, seg],
-        send_sem=send_sem.at[slot, seg],
-        recv_sem=recv_sem.at[slot, seg],
-        device_id=nxt,
+        dst_ref=dst_ref,
+        send_sem=send_ref,
+        recv_sem=recv_ref,
+        device_id=dst_dev,
         device_id_type=pltpu.DeviceIdType.LOGICAL,
     )
     rdma.start()
     return rdma
 
 
-def _release(ack_sem, slot, seg, prv, hop, total_hops):
-    """Tell the sender (prev rank) its slot is consumed — unless no future
-    hop will reuse it (semaphores must drain to zero by kernel end)."""
-    ack_release(ack_sem.at[slot, seg], hop, total_hops, prv)
+def _release(ack_ref, ups, hop, total_hops):
+    """Tell the sender (upstream rank) its slot is consumed — unless no
+    future hop will reuse it (semaphores drain to zero by kernel end)."""
+    ack_release(ack_ref, hop, total_hops, ups)
 
 
-def _scratch(size, num_segments, seg_rows, dtype, with_acc):
-    shapes = [
+def _scratch(size, num_segments, seg_rows, dtype):
+    return [
         pltpu.VMEM((2, num_segments, seg_rows, LANES), dtype),  # comm slots
         pltpu.SemaphoreType.DMA((2, num_segments)),  # send
         pltpu.SemaphoreType.DMA((2, num_segments)),  # recv
         pltpu.SemaphoreType.REGULAR((2, num_segments)),  # slot acks
     ]
-    if with_acc:
-        shapes.insert(0, pltpu.VMEM((num_segments, seg_rows, LANES), dtype))
-    return shapes
 
 
-def _allreduce_kernel(axis_name, size, num_segments, op):
+def _allreduce_kernel(axis_name, size, num_segments, op, ndirs=1):
+    """Segmented ring allreduce over 1 or 2 direction lanes.
+
+    ``ndirs=2`` is the bidirectional ring (pallas_guide 'Bi-directional
+    Ring'): the operand's two halves travel in opposite directions around
+    the ring simultaneously, using both ICI links of each neighbor pair —
+    2x the usable ring bandwidth.  Each direction lane is a complete,
+    independent instance of the slot-ack protocol (own comm slots,
+    semaphores, accumulator); the hop loop interleaves them so both wires
+    are in flight before either fold begins."""
     total_hops = 2 * (size - 1)
 
     def kernel(x_ref, o_ref, acc, comm, send_sem, recv_sem, ack_sem):
         me, nxt, prv = _neighbors(axis_name, size)
         S = num_segments
-        segB = comm.shape[2]
+        segB = comm.shape[3]
         B = S * segB
+        H = size * B  # rows per direction half
 
-        def xseg(blk, j):
-            start = jnp.mod(blk, size) * B + j * segB
+        # (destination, upstream, ring orientation sign) per lane
+        dirs = [(nxt, prv, 1)]
+        if ndirs == 2:
+            dirs.append((prv, nxt, -1))
+
+        def xseg(d, blk, j):
+            start = d * H + jnp.mod(blk, size) * B + j * segB
             return x_ref[pl.ds(start, segB), :]
 
         _ring_barrier(nxt, prv)
 
         # --- ring reduce-scatter: hops 1 .. P-1 --------------------------
-        for j in range(S):
-            acc[j] = xseg(me - 1, j)
+        for d, (_, _, sg) in enumerate(dirs):
+            for j in range(S):
+                acc[d, j] = xseg(d, me - sg, j)
         for s in range(1, size):
             slot = s % 2
-            rdmas = [
-                _hop(comm, send_sem, recv_sem, ack_sem, acc.at[j], slot, j,
-                     nxt, prv, s, total_hops)
-                for j in range(S)
-            ]
-            for j in range(S):
-                rdmas[j].wait_recv()  # prev's partial landed
-                rdmas[j].wait_send()  # our acc[j] is free to overwrite
-                acc[j] = op(comm[slot, j], xseg(me - 1 - s, j))
-                _release(ack_sem, slot, j, prv, s, total_hops)
+            rdmas = {}
+            for d, (dst, ups, _) in enumerate(dirs):
+                for j in range(S):
+                    rdmas[d, j] = _hop(
+                        comm.at[d, slot, j], acc.at[d, j],
+                        send_sem.at[d, slot, j], recv_sem.at[d, slot, j],
+                        ack_sem.at[d, slot, j], dst, s,
+                    )
+            for d, (_, ups, sg) in enumerate(dirs):
+                for j in range(S):
+                    rdmas[d, j].wait_recv()  # upstream partial landed
+                    rdmas[d, j].wait_send()  # our acc is free to overwrite
+                    acc[d, j] = op(
+                        comm[d, slot, j], xseg(d, me - sg * (1 + s), j)
+                    )
+                    _release(ack_sem.at[d, slot, j], ups, s, total_hops)
 
-        # acc now holds the fully-reduced block ``me``
-        for j in range(S):
-            o_ref[pl.ds(me * B + j * segB, segB), :] = acc[j]
+        # acc now holds the fully-reduced block ``me`` of each half
+        for d in range(len(dirs)):
+            for j in range(S):
+                o_ref[pl.ds(d * H + me * B + j * segB, segB), :] = acc[d, j]
 
         # --- ring allgather: hops P .. 2P-2 ------------------------------
         for t in range(1, size):
             h = size - 1 + t
             slot = h % 2
-            rdmas = [
-                _hop(comm, send_sem, recv_sem, ack_sem, acc.at[j], slot, j,
-                     nxt, prv, h, total_hops)
-                for j in range(S)
-            ]
-            origin = jnp.mod(me - t, size)
-            for j in range(S):
-                rdmas[j].wait_recv()
-                rdmas[j].wait_send()
-                o_ref[pl.ds(origin * B + j * segB, segB), :] = comm[slot, j]
-                acc[j] = comm[slot, j]  # relay on the next hop
-                _release(ack_sem, slot, j, prv, h, total_hops)
+            rdmas = {}
+            for d, (dst, ups, _) in enumerate(dirs):
+                for j in range(S):
+                    rdmas[d, j] = _hop(
+                        comm.at[d, slot, j], acc.at[d, j],
+                        send_sem.at[d, slot, j], recv_sem.at[d, slot, j],
+                        ack_sem.at[d, slot, j], dst, h,
+                    )
+            for d, (_, ups, sg) in enumerate(dirs):
+                origin = jnp.mod(me - sg * t, size)
+                for j in range(S):
+                    rdmas[d, j].wait_recv()
+                    rdmas[d, j].wait_send()
+                    o_ref[pl.ds(d * H + origin * B + j * segB, segB), :] = (
+                        comm[d, slot, j]
+                    )
+                    acc[d, j] = comm[d, slot, j]  # relay on the next hop
+                    _release(ack_sem.at[d, slot, j], ups, h, total_hops)
 
     return kernel
 
@@ -181,9 +207,9 @@ def _reduce_scatter_kernel(axis_name, size, num_segments, op):
         for s in range(1, size):
             slot = s % 2
             rdmas = [
-                _hop(comm, send_sem, recv_sem, ack_sem,
-                     o_ref.at[pl.ds(j * segB, segB), :], slot, j,
-                     nxt, prv, s, total_hops)
+                _hop(comm.at[slot, j], o_ref.at[pl.ds(j * segB, segB), :],
+                     send_sem.at[slot, j], recv_sem.at[slot, j],
+                     ack_sem.at[slot, j], nxt, s)
                 for j in range(S)
             ]
             for j in range(S):
@@ -192,7 +218,7 @@ def _reduce_scatter_kernel(axis_name, size, num_segments, op):
                 o_ref[pl.ds(j * segB, segB), :] = op(
                     comm[slot, j], xseg(me - 1 - s, j)
                 )
-                _release(ack_sem, slot, j, prv, s, total_hops)
+                _release(ack_sem.at[slot, j], prv, s, total_hops)
 
     return kernel
 
@@ -213,8 +239,9 @@ def _allgather_kernel(axis_name, size, num_segments):
         for t in range(1, size):
             slot = t % 2
             rdmas = [
-                _hop(comm, send_sem, recv_sem, ack_sem, carry.at[j], slot, j,
-                     nxt, prv, t, total_hops)
+                _hop(comm.at[slot, j], carry.at[j],
+                     send_sem.at[slot, j], recv_sem.at[slot, j],
+                     ack_sem.at[slot, j], nxt, t)
                 for j in range(S)
             ]
             origin = jnp.mod(me - t, size)
@@ -223,7 +250,7 @@ def _allgather_kernel(axis_name, size, num_segments):
                 rdmas[j].wait_send()
                 o_ref[pl.ds(origin * B + j * segB, segB), :] = comm[slot, j]
                 carry[j] = comm[slot, j]
-                _release(ack_sem, slot, j, prv, t, total_hops)
+                _release(ack_sem.at[slot, j], prv, t, total_hops)
 
     return kernel
 
@@ -248,22 +275,37 @@ def ring_allreduce(
     function: ReduceFunction = ReduceFunction.SUM,
     num_segments: int = 1,
     *,
+    bidirectional: bool = False,
     collective_id: int = 0,
     interpret: InterpretArg = None,
 ) -> jax.Array:
     """Segmented-ring allreduce (reduce-scatter + allgather) as one Pallas
     kernel: 2(P-1) neighbor remote-DMA hops on ICI (ref allreduce,
-    ccl_offload_control.c:1888-2071)."""
+    ccl_offload_control.c:1888-2071).
+
+    ``bidirectional=True`` splits the operand in half and runs the two
+    halves around the ring in opposite directions simultaneously — both
+    ICI links per neighbor pair carry payload, doubling usable ring
+    bandwidth (beyond the reference, whose eager ring is one-directional).
+    """
     size = lax.axis_size(axis_name)
     if size == 1:
         return x
     op = _OPS[function]
-    xp, n = _pack_ring(x, size, num_segments)
+    ndirs = 2 if bidirectional else 1
+    xp, n = _pack_ring(x, ndirs * size, num_segments)
     rows = xp.shape[0]
-    seg_rows = rows // (size * num_segments)
-    scratch = _scratch(size, num_segments, seg_rows, x.dtype, with_acc=True)
+    seg_rows = rows // (ndirs * size * num_segments)
+    S = num_segments
+    scratch = [
+        pltpu.VMEM((ndirs, S, seg_rows, LANES), x.dtype),  # accumulators
+        pltpu.VMEM((ndirs, 2, S, seg_rows, LANES), x.dtype),  # comm slots
+        pltpu.SemaphoreType.DMA((ndirs, 2, S)),  # send
+        pltpu.SemaphoreType.DMA((ndirs, 2, S)),  # recv
+        pltpu.SemaphoreType.REGULAR((ndirs, 2, S)),  # slot acks
+    ]
     out = _call(
-        _allreduce_kernel(axis_name, size, num_segments, op),
+        _allreduce_kernel(axis_name, size, num_segments, op, ndirs),
         xp, rows, scratch, collective_id, interpret,
     )
     return out.reshape(-1)[:n].reshape(x.shape)
@@ -288,7 +330,7 @@ def ring_reduce_scatter(
     if size == 1:
         return xp
     seg_rows = rows // (size * num_segments)
-    scratch = _scratch(size, num_segments, seg_rows, x.dtype, with_acc=False)
+    scratch = _scratch(size, num_segments, seg_rows, x.dtype)
     return _call(
         _reduce_scatter_kernel(axis_name, size, num_segments, op),
         xp, rows // size, scratch, collective_id, interpret,
@@ -313,7 +355,7 @@ def ring_allgather(
     rows = xp.shape[0]
     seg_rows = rows // num_segments
     scratch = [pltpu.VMEM((num_segments, seg_rows, LANES), x.dtype)]
-    scratch += _scratch(size, num_segments, seg_rows, x.dtype, with_acc=False)
+    scratch += _scratch(size, num_segments, seg_rows, x.dtype)
     out = _call(
         _allgather_kernel(axis_name, size, num_segments),
         xp, rows * size, scratch, collective_id, interpret,

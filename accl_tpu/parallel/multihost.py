@@ -81,7 +81,8 @@ def bootstrap_multihost(
     if (
         not auto
         and coordinator_address is None
-        and (num_processes or 1) == 1
+        and num_processes is None
+        and process_id is None
     ):
         return MultihostContext(0, 1, None)
 
@@ -137,8 +138,18 @@ def hybrid_mesh(
     num_slices = n // ici
     ici_shape = tuple(ici_axes.values())
 
-    slice_aware = getattr(devs[0], "slice_index", None) is not None
-    if slice_aware and num_slices > 1:
+    distinct_slices = (
+        len({getattr(d, "slice_index", None) for d in devs})
+        if getattr(devs[0], "slice_index", None) is not None
+        else 1
+    )
+    if distinct_slices > 1 and distinct_slices != num_slices:
+        raise ValueError(
+            f"devices span {distinct_slices} slices but the requested "
+            f"layout needs a DCN axis of {num_slices}; make the ICI axes "
+            f"cover exactly one slice ({n // distinct_slices} devices)"
+        )
+    if distinct_slices == num_slices and num_slices > 1:
         from jax.experimental import mesh_utils
 
         # documented contract: mesh_shape and dcn_mesh_shape have the same
@@ -151,8 +162,9 @@ def hybrid_mesh(
             allow_split_physical_axes=allow_split_physical_axes,
         )
     else:
-        # no slice topology: contiguous split — devices within a process
-        # are DCN-adjacent the way chips in a slice are
+        # single slice or no slice topology: contiguous split — device
+        # order stands in for slice adjacency (all hops are ICI anyway
+        # when one slice holds every device)
         arr = np.array(devs).reshape((num_slices,) + ici_shape)
     return Mesh(arr, (dcn_axis,) + tuple(ici_axes.keys()))
 

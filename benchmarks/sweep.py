@@ -152,8 +152,23 @@ def sweep_ops(world: int, sizes: List[int], writer, extra_algos=()) -> None:
                 stacked, mesh, num_segments=4
             )
         )
+
+    import jax
+
+    pallas_cap = None if jax.default_backend() == "tpu" else 2**13
+    # off-TPU the Pallas kernels run under the interpreter, whose on_wait
+    # semaphore loop busy-spins; on few-core hosts large transfers convoy
+    # (minutes per call) — cap the interpreted sweep sizes
     for op, fn in runners.items():
-        for n in sizes:
+        op_sizes = sizes
+        if pallas_cap is not None and op.endswith("pallas_ring"):
+            op_sizes = [n for n in sizes if n <= pallas_cap]
+            if len(op_sizes) < len(sizes):
+                print(
+                    f"# {op}: capped at {pallas_cap} elements off-TPU "
+                    "(interpreter tier)", file=sys.stderr,
+                )
+        for n in op_sizes:
             shape = (world, world * n) if op in ("reduce_scatter", "alltoall") else (world, n)
             stacked = jnp.ones(shape, jnp.float32)
             fn(stacked, mesh).block_until_ready()  # compile

@@ -402,3 +402,28 @@ def test_pallas_ring_attention_validates_qkv():
             jnp.zeros((1, 1, 8, 32), jnp.bfloat16),
             jnp.zeros((1, 1, 8, 32), jnp.bfloat16), "sp",
         )
+
+
+def test_ring_allreduce_bidirectional():
+    """Bidirectional ring: the operand's halves travel opposite directions
+    (both ICI links carry payload — pallas_guide bi-directional pattern).
+    Sizes stay small: the interpreter's on_wait semaphore loop busy-spins,
+    which convoys on few-core CI hosts at larger transfers."""
+    mesh = _mesh(4)
+    for n in (2 * 4 * 8 * 128, 1000):  # exact packing + ragged
+        data = jnp.asarray(
+            np.random.default_rng(8).normal(size=(4, n)), jnp.float32
+        )
+        fn = jax.jit(
+            shard_map(
+                lambda x: pk.ring_allreduce(
+                    x[0], "x", bidirectional=True
+                )[None],
+                mesh=mesh, in_specs=P("x"), out_specs=P("x"),
+                check_vma=False,
+            )
+        )
+        out = np.asarray(fn(data))
+        expect = np.asarray(data).sum(0)
+        for r in range(4):
+            np.testing.assert_allclose(out[r], expect, rtol=1e-4, atol=1e-5)
