@@ -21,3 +21,4 @@ from .ring_attention import ring_attention, reference_attention  # noqa: F401
 from ..ops.pallas.attention import (  # noqa: F401
     ring_attention as ring_attention_pallas,
 )
+from .ulysses_attention import ulysses_attention  # noqa: F401

@@ -14,8 +14,9 @@ the CI tier exercises the identical kernel code (see
 ``_common.default_interpret``).
 """
 
-from . import attention, compression, put, ring  # noqa: F401
+from . import alltoall, attention, compression, put, ring  # noqa: F401
 from ._common import default_interpret, pack_lanes, unpack_lanes  # noqa: F401
+from .alltoall import alltoall as alltoall_kernel  # noqa: F401
 from .combine import combine  # noqa: F401
 from .compression import cast, dequantize_int8, quantize_int8  # noqa: F401
 from .put import fused_shift  # noqa: F401

@@ -31,6 +31,14 @@ SUBLANES = 32
 InterpretArg = Union[None, bool, "pltpu.InterpretParams"]
 
 
+def sublanes_for(dtype) -> int:
+    """Minimum sublane multiple for a dtype's VMEM tile (second-to-last
+    dim): f32 8, bf16/f16 16, int8/fp8 32."""
+    import jax.numpy as jnp
+
+    return {4: 8, 2: 16, 1: 32}.get(jnp.dtype(dtype).itemsize, 8)
+
+
 def default_interpret(interpret: InterpretArg = None):
     """Resolve the ``interpret`` argument: explicit values pass through;
     ``None`` selects compiled Mosaic on TPU and the TPU interpreter on any

@@ -46,8 +46,7 @@ _OPS = {
 }
 
 
-def _sublanes(dtype) -> int:
-    return {4: 8, 2: 16, 1: 32}.get(jnp.dtype(dtype).itemsize, 8)
+from ._common import sublanes_for as _sublanes  # noqa: E402
 
 
 def _pack_ring(x: jax.Array, size: int, num_segments: int):
