@@ -302,12 +302,18 @@ class XLAGangContext:
                 npdt = dtype_to_numpy(wire_dtype)
                 stacked = stacked.astype(npdt).astype(stacked.dtype)
             return self._host_reduce(stacked, fn)[None].repeat(stacked.shape[0], 0)
-        if wire_dtype is not None:
-            return opdriver.run_compressed_allreduce(
-                stacked, mesh, fn, wire_dtype=dtype_to_numpy(wire_dtype).name
-            )
         algo = self.tuning.get("allreduce_algorithm", "xla")
         nseg = int(self.tuning.get("ring_segments", 1))
+        if wire_dtype is not None:
+            wire_name = dtype_to_numpy(wire_dtype).name
+            if algo == "pallas_ring":
+                # compression lanes run inside the kernel
+                return opdriver.run_pallas_allreduce(
+                    stacked, mesh, fn, nseg, wire_dtype=wire_name
+                )
+            return opdriver.run_compressed_allreduce(
+                stacked, mesh, fn, wire_dtype=wire_name
+            )
         if algo == "ring":
             return opdriver.run_ring_allreduce(stacked, mesh, fn, nseg)
         if algo == "pallas_ring":

@@ -58,8 +58,11 @@ def _program(op: str, mesh_id: int, fn: ReduceFunction, extra=None):
         nseg = extra or 1
         body = lambda x: ring.ring_allreduce(x[0], AXIS, fn, nseg)[None]
     elif op == "pallas_allreduce":
-        nseg = extra or 1
-        body = lambda x: pallas.ring_allreduce(x[0], AXIS, fn, nseg)[None]
+        nseg, wire = extra if isinstance(extra, tuple) else (extra, None)
+        nseg = nseg or 1
+        body = lambda x: pallas.ring_allreduce(
+            x[0], AXIS, fn, nseg, wire_dtype=wire and jnp.dtype(wire)
+        )[None]
     elif op == "compressed_allreduce":
         wire = jnp.dtype(extra or "bfloat16")
         body = lambda x: collectives.compressed_allreduce(
@@ -114,13 +117,20 @@ def run_ring_allreduce(
 
 
 def run_pallas_allreduce(
-    stacked, mesh: Mesh, function=ReduceFunction.SUM, num_segments: int = 1
+    stacked,
+    mesh: Mesh,
+    function=ReduceFunction.SUM,
+    num_segments: int = 1,
+    wire_dtype: str = None,
 ):
     """The segmented ring as a single Pallas kernel: remote-DMA hops over
-    ICI with slot-ack flow control (interpreted off-TPU)."""
-    return _program("pallas_allreduce", _mesh_key(mesh), function, num_segments)(
-        _put(stacked, mesh)
-    )
+    ICI with slot-ack flow control (interpreted off-TPU).  ``wire_dtype``
+    (a dtype name string, to key the program cache) narrows the payload on
+    the wire with in-kernel compress/decompress lanes."""
+    return _program(
+        "pallas_allreduce", _mesh_key(mesh), function,
+        (num_segments, wire_dtype),
+    )(_put(stacked, mesh))
 
 
 def run_compressed_allreduce(

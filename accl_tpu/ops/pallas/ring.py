@@ -38,6 +38,8 @@ from ._common import (
     ack_release,
     default_interpret,
     neighbor_barrier,
+    pack_lanes,
+    sublanes_for,
 )
 
 _OPS = {
@@ -46,16 +48,17 @@ _OPS = {
 }
 
 
-from ._common import sublanes_for as _sublanes  # noqa: E402
+def _pack_ring(x: jax.Array, size: int, num_segments: int,
+               wire_dtype=None):
+    """Flatten + pad to (size * num_segments * sublane-aligned segB, LANES).
 
-
-def _pack_ring(x: jax.Array, size: int, num_segments: int):
-    """Flatten + pad to (size * num_segments * sublane-aligned segB, LANES)."""
-    from ._common import pack_lanes
-
-    return pack_lanes(
-        x, min_rows=size * num_segments * _sublanes(x.dtype)
-    )
+    When a narrower wire dtype rides the comm buffers, segment tiles must
+    satisfy BOTH dtypes' sublane minimums (bf16 needs 16 where f32 needs
+    8) or the compiled wire buffers violate Mosaic tile alignment."""
+    sub = sublanes_for(x.dtype)
+    if wire_dtype is not None:
+        sub = max(sub, sublanes_for(wire_dtype))
+    return pack_lanes(x, min_rows=size * num_segments * sub)
 
 
 def _neighbors(axis_name: str, size: int):
@@ -103,7 +106,8 @@ def _scratch(size, num_segments, seg_rows, dtype):
     ]
 
 
-def _allreduce_kernel(axis_name, size, num_segments, op, ndirs=1):
+def _allreduce_kernel(axis_name, size, num_segments, op, ndirs=1,
+                      wire_dtype=None):
     """Segmented ring allreduce over 1 or 2 direction lanes.
 
     ``ndirs=2`` is the bidirectional ring (pallas_guide 'Bi-directional
@@ -114,13 +118,25 @@ def _allreduce_kernel(axis_name, size, num_segments, op, ndirs=1):
     semaphores, accumulator); the hop loop interleaves them so both wires
     are in flight before either fold begins."""
     total_hops = 2 * (size - 1)
+    compressed = wire_dtype is not None
 
-    def kernel(x_ref, o_ref, acc, comm, send_sem, recv_sem, ack_sem):
+    def kernel(x_ref, o_ref, acc, comm, *rest):
+        # rest = (stage, send_sem, recv_sem, ack_sem) when compressed,
+        #        (send_sem, recv_sem, ack_sem) otherwise
+        if compressed:
+            stage, send_sem, recv_sem, ack_sem = rest
+        else:
+            stage = acc  # send directly from the accumulator
+            send_sem, recv_sem, ack_sem = rest
         me, nxt, prv = _neighbors(axis_name, size)
         S = num_segments
         segB = comm.shape[3]
         B = S * segB
         H = size * B  # rows per direction half
+
+        def up(v):
+            # wire -> accumulate dtype (the hp_compression decompress lane)
+            return v.astype(acc.dtype) if compressed else v
 
         # (destination, upstream, ring orientation sign) per lane
         dirs = [(nxt, prv, 1)]
@@ -142,17 +158,19 @@ def _allreduce_kernel(axis_name, size, num_segments, op, ndirs=1):
             rdmas = {}
             for d, (dst, ups, _) in enumerate(dirs):
                 for j in range(S):
+                    if compressed:  # narrow onto the wire (compress lane)
+                        stage[d, j] = acc[d, j].astype(stage.dtype)
                     rdmas[d, j] = _hop(
-                        comm.at[d, slot, j], acc.at[d, j],
+                        comm.at[d, slot, j], stage.at[d, j],
                         send_sem.at[d, slot, j], recv_sem.at[d, slot, j],
                         ack_sem.at[d, slot, j], dst, s,
                     )
             for d, (_, ups, sg) in enumerate(dirs):
                 for j in range(S):
                     rdmas[d, j].wait_recv()  # upstream partial landed
-                    rdmas[d, j].wait_send()  # our acc is free to overwrite
+                    rdmas[d, j].wait_send()  # our stage is free to rewrite
                     acc[d, j] = op(
-                        comm[d, slot, j], xseg(d, me - sg * (1 + s), j)
+                        up(comm[d, slot, j]), xseg(d, me - sg * (1 + s), j)
                     )
                     _release(ack_sem.at[d, slot, j], ups, s, total_hops)
 
@@ -168,8 +186,10 @@ def _allreduce_kernel(axis_name, size, num_segments, op, ndirs=1):
             rdmas = {}
             for d, (dst, ups, _) in enumerate(dirs):
                 for j in range(S):
+                    if compressed:
+                        stage[d, j] = acc[d, j].astype(stage.dtype)
                     rdmas[d, j] = _hop(
-                        comm.at[d, slot, j], acc.at[d, j],
+                        comm.at[d, slot, j], stage.at[d, j],
                         send_sem.at[d, slot, j], recv_sem.at[d, slot, j],
                         ack_sem.at[d, slot, j], dst, h,
                     )
@@ -179,9 +199,9 @@ def _allreduce_kernel(axis_name, size, num_segments, op, ndirs=1):
                     rdmas[d, j].wait_recv()
                     rdmas[d, j].wait_send()
                     o_ref[pl.ds(d * H + origin * B + j * segB, segB), :] = (
-                        comm[d, slot, j]
+                        up(comm[d, slot, j]).astype(o_ref.dtype)
                     )
-                    acc[d, j] = comm[d, slot, j]  # relay on the next hop
+                    acc[d, j] = up(comm[d, slot, j])  # relay on the next hop
                     _release(ack_sem.at[d, slot, j], ups, h, total_hops)
 
     return kernel
@@ -275,6 +295,7 @@ def ring_allreduce(
     num_segments: int = 1,
     *,
     bidirectional: bool = False,
+    wire_dtype=None,
     collective_id: int = 0,
     interpret: InterpretArg = None,
 ) -> jax.Array:
@@ -286,25 +307,42 @@ def ring_allreduce(
     halves around the ring in opposite directions simultaneously — both
     ICI links per neighbor pair carry payload, doubling usable ring
     bandwidth (beyond the reference, whose eager ring is one-directional).
+
+    ``wire_dtype`` (e.g. ``jnp.bfloat16``) narrows every hop's payload on
+    the wire while accumulating in the operand dtype — the ETH_COMPRESSED
+    / hp_compression composition executed inside the kernel: compress lane
+    before the DMA, decompress after, half the ICI bytes.
     """
     size = lax.axis_size(axis_name)
     if size == 1:
         return x
     op = _OPS[function]
     ndirs = 2 if bidirectional else 1
-    xp, n = _pack_ring(x, ndirs * size, num_segments)
+    wire = jnp.dtype(wire_dtype) if wire_dtype is not None else None
+    if wire is not None and wire == x.dtype:
+        wire = None  # no-op compression
+    xp, n = _pack_ring(x, ndirs * size, num_segments, wire)
     rows = xp.shape[0]
     seg_rows = rows // (ndirs * size * num_segments)
     S = num_segments
+    comm_dtype = wire if wire is not None else x.dtype
     scratch = [
         pltpu.VMEM((ndirs, S, seg_rows, LANES), x.dtype),  # accumulators
-        pltpu.VMEM((ndirs, 2, S, seg_rows, LANES), x.dtype),  # comm slots
+        pltpu.VMEM((ndirs, 2, S, seg_rows, LANES), comm_dtype),  # comm slots
+    ]
+    if wire is not None:
+        scratch.append(
+            pltpu.VMEM((ndirs, S, seg_rows, LANES), wire)  # send staging
+        )
+    scratch += [
         pltpu.SemaphoreType.DMA((ndirs, 2, S)),  # send
         pltpu.SemaphoreType.DMA((ndirs, 2, S)),  # recv
         pltpu.SemaphoreType.REGULAR((ndirs, 2, S)),  # slot acks
     ]
     out = _call(
-        _allreduce_kernel(axis_name, size, num_segments, op, ndirs),
+        _allreduce_kernel(
+            axis_name, size, num_segments, op, ndirs, wire
+        ),
         xp, rows, scratch, collective_id, interpret,
     )
     return out.reshape(-1)[:n].reshape(x.shape)

@@ -373,3 +373,28 @@ def test_xla_allreduce_algorithm_tuning(algo, rng):
     finally:
         for a in g:
             a.deinit()
+
+
+def test_xla_allreduce_compressed_pallas_ring(rng):
+    """ETH_COMPRESSED + pallas_ring tuning: the compression lanes execute
+    inside the kernel (wire narrowed to bf16, f32 accumulation)."""
+    g = xla_group(4)
+    try:
+        g[0].engine.gang.tuning.update({"allreduce_algorithm": "pallas_ring"})
+        count = 8 * 128
+        chunks = [rng.standard_normal(count).astype(np.float32) for _ in g]
+        expected = np.sum(chunks, axis=0)
+
+        def work(accl, rank):
+            send = accl.create_buffer_from(chunks[rank])
+            recv = accl.create_buffer(count, np.float32)
+            accl.allreduce(send, recv, count, compress_dtype=np.float16)
+            recv.sync_from_device()
+            return recv.data.copy()
+
+        for got in run_parallel(g, work):
+            np.testing.assert_allclose(got, expected, rtol=3e-2, atol=3e-2)
+            assert not np.array_equal(got, expected)  # wire was narrowed
+    finally:
+        for a in g:
+            a.deinit()
