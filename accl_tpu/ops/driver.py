@@ -58,7 +58,7 @@ def _program(op: str, mesh_id: int, fn: ReduceFunction, extra=None):
         nseg = extra or 1
         body = lambda x: ring.ring_allreduce(x[0], AXIS, fn, nseg)[None]
     elif op == "pallas_allreduce":
-        nseg, wire = extra if isinstance(extra, tuple) else (extra, None)
+        nseg, wire = extra  # always (num_segments, wire_dtype_name)
         nseg = nseg or 1
         body = lambda x: pallas.ring_allreduce(
             x[0], AXIS, fn, nseg, wire_dtype=wire and jnp.dtype(wire)
