@@ -93,14 +93,23 @@ class XLAGangContext:
 
     # -- communicator -> mesh -----------------------------------------------
     def submesh(self, comm: Communicator):
-        """Sub-mesh over the first ``comm.size`` devices (None when the host
-        has fewer devices than ranks — execution falls back to host numpy,
-        the single-controller analog of the reference's emulator tier)."""
-        if comm.size in self._submeshes:
-            return self._submeshes[comm.size]
+        """Sub-mesh over the communicator's member devices — rank i of the
+        communicator executes on the device of its *global* rank identity
+        (``Rank.session``), so a subcommunicator of ranks {4..7} runs on
+        devices 4-7, not 0-3.  None when the host has fewer devices than the
+        membership needs — execution falls back to host numpy, the
+        single-controller analog of the reference's emulator tier."""
+        sessions = tuple(r.session for r in comm.ranks)
+        if sessions in self._submeshes:
+            return self._submeshes[sessions]
         devs = jax.devices()
-        mesh = opdriver.make_mesh(comm.size) if comm.size <= len(devs) else None
-        self._submeshes[comm.size] = mesh
+        if max(sessions) < len(devs):
+            from jax.sharding import Mesh
+
+            mesh = Mesh([devs[s] for s in sessions], (opdriver.AXIS,))
+        else:
+            mesh = None
+        self._submeshes[sessions] = mesh
         return mesh
 
     # -- gang assembly -------------------------------------------------------
@@ -185,6 +194,10 @@ class XLAGangContext:
             return arr.astype(wire_npdt).astype(arr.dtype)
 
         if op == Operation.BARRIER:
+            # gang assembly IS the barrier on this tier: reaching here means
+            # every rank of the communicator posted the call in this process.
+            # A multi-process gang must NOT reuse this (see backends/dist for
+            # the cross-process barrier over the device mesh).
             return ErrorCode.OK
 
         if op == Operation.ALLREDUCE:

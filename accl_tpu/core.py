@@ -70,6 +70,7 @@ class ACCL:
     def _initialize(
         self, timeout_s: float, max_eager_size: int, max_rendezvous_size: int
     ) -> None:
+        self._timeout_s = float(timeout_s)
         self._config(ConfigFunction.RESET, 0)
         self._config(ConfigFunction.SET_TIMEOUT, timeout_s)
         self._config(ConfigFunction.SET_MAX_EAGER_SIZE, max_eager_size)
@@ -100,6 +101,7 @@ class ACCL:
     # -- config surface ------------------------------------------------------
     def set_timeout(self, seconds: float) -> None:
         self._config(ConfigFunction.SET_TIMEOUT, seconds)
+        self._timeout_s = float(seconds)
 
     def set_max_eager_size(self, nbytes: int) -> None:
         self._config(ConfigFunction.SET_MAX_EAGER_SIZE, nbytes)
@@ -175,7 +177,10 @@ class ACCL:
         req = self.engine.start(options)
         if run_async:
             return req
-        if not req.wait(timeout=max(60.0, 4 * 30.0)):
+        # facade-level deadline tracks the configured engine timeout (with a
+        # 2x margin so the engine's own RECEIVE_TIMEOUT fires first and we
+        # report its error code, not a generic deadlock)
+        if not req.wait(timeout=max(1.0, 2 * self._timeout_s)):
             raise ACCLError(ErrorCode.DEADLOCK_SUSPECTED, context)
         req.check(context)
         return req

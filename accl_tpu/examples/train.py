@@ -60,11 +60,13 @@ def train(
     )
 
     devs = jax.devices()
+    tp = min(tp, len(devs))  # a 1-device host runs with tp=1, not a ValueError
     if dp is None:
         dp = max(len(devs) // tp, 1)
     mesh = Mesh(np.array(devs[: dp * tp]).reshape(dp, tp), ("dp", "tp"))
 
     heads = max(4, tp)
+    heads += (-heads) % tp  # tp must divide heads (and so d_model/d_ff)
     cfg = TransformerConfig(
         vocab=128, d_model=16 * heads, n_heads=heads, n_layers=2,
         d_ff=32 * heads, max_seq=32,
@@ -102,9 +104,12 @@ def train(
             ckptr.close()
         return start_step, None
 
-    rng = np.random.default_rng(seed + start_step)
     loss = None
     for it in range(start_step, steps):
+        # per-step data stream keyed by (seed, step): a resumed run consumes
+        # the exact token stream an uninterrupted run would, so losses stay
+        # bit-comparable across restarts
+        rng = np.random.default_rng([seed, it])
         tokens = jnp.asarray(
             rng.integers(0, cfg.vocab, (2 * dp, cfg.max_seq)), jnp.int32
         )
