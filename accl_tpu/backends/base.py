@@ -54,6 +54,24 @@ class BaseEngine:
         """Enqueue a call; returns a Request immediately."""
         raise NotImplementedError
 
+    def create_buffer(self, count: int, dtype, host_only: bool = False,
+                      data=None):
+        """Backend-appropriate buffer (ref: ACCL::create_buffer dispatching
+        to XRTBuffer/SimBuffer per device).  Default: emulator-tier host
+        pair; device tiers override with HBM-resident buffers.
+
+        ``data`` (a 1-D numpy array) seeds the buffer: the host side ALIASES
+        it (mutating the caller's array mutates host memory, the reference's
+        wrap-existing-pointer buffer constructor) and the device side is
+        synced on return."""
+        from ..buffer import EmuBuffer
+
+        if data is not None:
+            buf = EmuBuffer.from_array(data, host_only=host_only)
+            buf.sync_to_device()
+            return buf
+        return EmuBuffer(count, dtype, host_only=host_only)
+
     def shutdown(self) -> None:
         raise NotImplementedError
 

@@ -25,6 +25,7 @@ Mapping notes (ref -> here):
 
 from __future__ import annotations
 
+import functools
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -46,6 +47,7 @@ from ...constants import (
     StreamFlags,
     dtype_to_numpy,
 )
+from ...buffer import DeviceBuffer, EmuBuffer, dev_zeros as _dev_zeros
 from ...request import Request
 from ..base import BaseEngine, CallOptions
 from ...ops import driver as opdriver
@@ -64,6 +66,46 @@ def _np_stack_op0(calls: List[CallOptions], counts: List[int]) -> np.ndarray:
             row = np.zeros(width, dtype_to_numpy(call.arithcfg.uncompressed))
         rows.append(row)
     return np.stack(rows)
+
+
+def _write_host_result(buf, row, n: int) -> None:
+    """Place a host-computed result row into any buffer type (the fallback
+    path's writer; the zero-copy path uses DeviceBuffer.store directly)."""
+    if isinstance(buf, DeviceBuffer):
+        npdt = dtype_to_numpy(buf.dtype)
+        arr = jax.device_put(np.asarray(row)[:n].astype(npdt), buf.device)
+        buf.store(arr, n)
+    else:
+        dst = buf.device_view()[:n]
+        np.copyto(dst, np.asarray(row)[:n].astype(dst.dtype))
+
+
+# The shard prep/trim steps run as tiny cached jitted programs rather than
+# eager ops: eager slicing dispatches its index scalars host->device, which
+# would break the zero-host-copy guarantee (and trip transfer guards).
+@functools.lru_cache(maxsize=1024)
+def _prep_program(width: int, wire_name: Optional[str], device):
+    from jax.sharding import SingleDeviceSharding
+
+    def f(a):
+        a = a[:width]
+        if wire_name is not None:
+            a = a.astype(jnp.dtype(wire_name)).astype(a.dtype)
+        return a.reshape(1, width)
+
+    return jax.jit(f, out_shardings=SingleDeviceSharding(device))
+
+
+@functools.lru_cache(maxsize=1024)
+def _trim_program(width: int, device):
+    from jax.sharding import SingleDeviceSharding
+
+    return jax.jit(
+        lambda a: a.reshape(-1)[:width],
+        out_shardings=SingleDeviceSharding(device),
+    )
+
+
 
 
 class _GangSlot:
@@ -175,12 +217,179 @@ class XLAGangContext:
         for req in reqs:
             req.complete(code, dt)
 
+    # per-op operand/result widths in units of ``count`` ('P' = size*count)
+    _IN_W = {
+        Operation.ALLREDUCE: 1, Operation.REDUCE: 1, Operation.BCAST: 1,
+        Operation.ALLGATHER: 1, Operation.GATHER: 1,
+        Operation.REDUCE_SCATTER: "P", Operation.SCATTER: "P",
+        Operation.ALLTOALL: "P",
+    }
+    _OUT_W = {
+        Operation.ALLREDUCE: 1, Operation.REDUCE: 1, Operation.BCAST: 1,
+        Operation.SCATTER: 1, Operation.REDUCE_SCATTER: 1,
+        Operation.ALLGATHER: "P", Operation.GATHER: "P",
+        Operation.ALLTOALL: "P",
+    }
+
     def _run_op(
         self, comm: Communicator, calls: List[CallOptions], lead: CallOptions
     ) -> ErrorCode:
+        if lead.op == Operation.BARRIER:
+            # gang assembly IS the barrier on this tier: reaching here means
+            # every rank of the communicator posted the call in this process.
+            # A multi-process gang must NOT reuse this (see backends/dist for
+            # the cross-process barrier over the device mesh).
+            return ErrorCode.OK
+        mesh = self.submesh(comm)
+        if mesh is not None:
+            code = self._run_op_device(comm, calls, lead, mesh)
+            if code is not None:
+                return code
+        return self._run_op_host(comm, calls, lead, mesh)
+
+    # -- zero-host-copy device path ------------------------------------------
+    def _run_op_device(
+        self,
+        comm: Communicator,
+        calls: List[CallOptions],
+        lead: CallOptions,
+        mesh,
+    ) -> Optional[ErrorCode]:
+        """Run the collective entirely on device-resident operands.
+
+        Every rank's operand must be a :class:`DeviceBuffer` committed to
+        that rank's mesh device (dummies become on-device zeros); the
+        per-rank arrays are assembled into ONE sharded global array with
+        ``jax.make_array_from_single_device_arrays`` — zero copy — the
+        jitted shard_map program runs over the mesh, and the output shards
+        are adopted back into the result buffers.  The host never touches
+        payload bytes, matching the reference's device-to-device hot path
+        (``accl.cpp:780-826``).  Returns None to fall back to the
+        host-staged path (mixed/host operands, exotic dtypes).
+        """
+        op = lead.op
+        if op not in self._IN_W:
+            return None
+        size = comm.size
+        n = lead.count
+        if n <= 0:
+            return None
+        in_w = n * (size if self._IN_W[op] == "P" else 1)
+        out_w = n * (size if self._OUT_W[op] == "P" else 1)
+        devs = list(mesh.devices.flat)
+        npdt = dtype_to_numpy(lead.arithcfg.uncompressed)
+        compressed = bool(lead.compression & CompressionFlags.ETH_COMPRESSED)
+        wire_npdt = (
+            dtype_to_numpy(lead.arithcfg.compressed) if compressed else None
+        )
+
+        # which ranks' results get written
+        if op in (Operation.REDUCE, Operation.GATHER):
+            writers = {lead.root_dst if op == Operation.REDUCE else lead.root_src}
+        else:
+            writers = set(range(size))
+
+        # validate operands + results device-resident before any work
+        any_device = False
+        for r, call in enumerate(calls):
+            buf = call.op0
+            if buf is not None and not buf.is_dummy:
+                if not (
+                    isinstance(buf, DeviceBuffer)
+                    and buf.device == devs[r]
+                    and buf.count >= in_w
+                    and dtype_to_numpy(buf.dtype) == npdt
+                ):
+                    return None
+                any_device = True
+            if r in writers:
+                res = call.res
+                if res is None or res.is_dummy:
+                    continue
+                if not (
+                    isinstance(res, DeviceBuffer)
+                    and res.device == devs[r]
+                    and res.count >= out_w
+                    and dtype_to_numpy(res.dtype) == npdt
+                ):
+                    return None
+        if not any_device:
+            return None
+        if op == Operation.BCAST and any(
+            c.op0 is not c.res for c in calls
+        ):
+            # the donating bcast program consumes its operand; only safe for
+            # the facade's in-place form (op0 IS res on every rank)
+            return None
+
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        # wire-dtype rounding before the op (the hp_compression lanes);
+        # allreduce keeps this inside its program for a single rounding
+        wire_name = (
+            np.dtype(wire_npdt).name
+            if wire_npdt is not None and op != Operation.ALLREDUCE
+            else None
+        )
+        shards = []
+        for r, call in enumerate(calls):
+            buf = call.op0
+            if buf is None or buf.is_dummy:
+                shards.append(_dev_zeros((1, in_w), npdt, devs[r]))
+                continue
+            arr = buf.device_array()
+            shards.append(_prep_program(in_w, wire_name, devs[r])(arr))
+        global_arr = jax.make_array_from_single_device_arrays(
+            (size, in_w),
+            NamedSharding(mesh, PartitionSpec(opdriver.AXIS)),
+            shards,
+        )
+
+        fn = lead.reduce_function
+        if op == Operation.ALLREDUCE:
+            wire = lead.arithcfg.compressed if compressed else None
+            out = self._allreduce(global_arr, mesh, fn, wire)
+        elif op == Operation.REDUCE:
+            out = opdriver.run_reduce(global_arr, mesh, lead.root_dst, fn)
+        elif op == Operation.BCAST:
+            out = opdriver.run_bcast(
+                global_arr, mesh, lead.root_src, donate=True
+            )
+        elif op == Operation.ALLGATHER:
+            out = opdriver.run_allgather(global_arr, mesh)
+        elif op == Operation.REDUCE_SCATTER:
+            out = opdriver.run_reduce_scatter(global_arr, mesh, fn)
+        elif op == Operation.SCATTER:
+            out = opdriver.run_scatter(global_arr, mesh, lead.root_src)
+        elif op == Operation.GATHER:
+            out = opdriver.run_gather(global_arr, mesh, lead.root_src)
+        elif op == Operation.ALLTOALL:
+            out = opdriver.run_alltoall(global_arr, mesh)
+        else:  # pragma: no cover - guarded by _IN_W
+            return None
+
+        dev_to_rank = {d: r for r, d in enumerate(devs)}
+        for shard in out.addressable_shards:
+            r = dev_to_rank.get(shard.device)
+            if r is None or r not in writers:
+                continue
+            res = calls[r].res
+            if res is None or res.is_dummy:
+                continue
+            res.store(_trim_program(out_w, shard.device)(shard.data), out_w)
+        return ErrorCode.OK
+
+    # -- host-staged fallback path -------------------------------------------
+    def _run_op_host(
+        self,
+        comm: Communicator,
+        calls: List[CallOptions],
+        lead: CallOptions,
+        mesh,
+    ) -> ErrorCode:
         op = lead.op
         size = comm.size
-        mesh = self.submesh(comm)
         fn = lead.reduce_function
         n = lead.count
         compressed = bool(lead.compression & CompressionFlags.ETH_COMPRESSED)
@@ -193,13 +402,6 @@ class XLAGangContext:
                 return arr
             return arr.astype(wire_npdt).astype(arr.dtype)
 
-        if op == Operation.BARRIER:
-            # gang assembly IS the barrier on this tier: reaching here means
-            # every rank of the communicator posted the call in this process.
-            # A multi-process gang must NOT reuse this (see backends/dist for
-            # the cross-process barrier over the device mesh).
-            return ErrorCode.OK
-
         if op == Operation.ALLREDUCE:
             # no host-side pre-cast here: the compressed program casts to the
             # requested wire dtype itself (single rounding, on device)
@@ -208,8 +410,7 @@ class XLAGangContext:
             out = self._allreduce(stacked, mesh, fn, wire)
             out = np.asarray(out)
             for r, call in enumerate(calls):
-                np.copyto(call.res.device_view()[:n], out[r].astype(
-                    call.res.device_view().dtype))
+                _write_host_result(call.res, out[r], n)
             return ErrorCode.OK
 
         if op == Operation.REDUCE:
@@ -222,8 +423,7 @@ class XLAGangContext:
             root = lead.root_dst
             res = calls[root].res
             if res is not None and not res.is_dummy:
-                np.copyto(res.device_view()[:n], out[root].astype(
-                    res.device_view().dtype))
+                _write_host_result(res, out[root], n)
             return ErrorCode.OK
 
         if op == Operation.BCAST:
@@ -234,8 +434,7 @@ class XLAGangContext:
                 else stacked[lead.root_src][None].repeat(size, 0)
             )
             for r, call in enumerate(calls):
-                np.copyto(call.res.device_view()[:n], out[r].astype(
-                    call.res.device_view().dtype))
+                _write_host_result(call.res, out[r], n)
             return ErrorCode.OK
 
         if op == Operation.ALLGATHER:
@@ -246,10 +445,7 @@ class XLAGangContext:
                 else stacked.reshape(-1)[None].repeat(size, 0)
             )
             for r, call in enumerate(calls):
-                np.copyto(
-                    call.res.device_view()[: size * n],
-                    out[r].astype(call.res.device_view().dtype),
-                )
+                _write_host_result(call.res, out[r], size * n)
             return ErrorCode.OK
 
         if op == Operation.REDUCE_SCATTER:
@@ -260,8 +456,7 @@ class XLAGangContext:
                 else self._host_reduce(stacked, fn).reshape(size, n)
             )
             for r, call in enumerate(calls):
-                np.copyto(call.res.device_view()[:n], out[r][:n].astype(
-                    call.res.device_view().dtype))
+                _write_host_result(call.res, out[r][:n], n)
             return ErrorCode.OK
 
         if op == Operation.SCATTER:
@@ -273,8 +468,7 @@ class XLAGangContext:
                 else stacked[root].reshape(size, n)
             )
             for r, call in enumerate(calls):
-                np.copyto(call.res.device_view()[:n], out[r].astype(
-                    call.res.device_view().dtype))
+                _write_host_result(call.res, out[r], n)
             return ErrorCode.OK
 
         if op == Operation.GATHER:
@@ -287,8 +481,7 @@ class XLAGangContext:
             )
             res = calls[root].res
             if res is not None and not res.is_dummy:
-                np.copyto(res.device_view()[: size * n], out[root].astype(
-                    res.device_view().dtype))
+                _write_host_result(res, out[root], size * n)
             return ErrorCode.OK
 
         if op == Operation.ALLTOALL:
@@ -301,10 +494,7 @@ class XLAGangContext:
                 )
             )
             for r, call in enumerate(calls):
-                np.copyto(
-                    call.res.device_view()[: size * n],
-                    out[r].astype(call.res.device_view().dtype),
-                )
+                _write_host_result(call.res, out[r], size * n)
             return ErrorCode.OK
 
         return ErrorCode.COLLECTIVE_NOT_IMPLEMENTED
@@ -392,10 +582,12 @@ class XLAEngine(BaseEngine):
         gang: XLAGangContext,
         p2p: Optional[_P2PChannel] = None,
         peers: Optional[Dict[int, "XLAEngine"]] = None,
+        device=None,
     ):
         self.gang = gang
         self.p2p = p2p or _P2PChannel()
         self.peers = peers if peers is not None else {}
+        self.device = device  # this rank's chip; buffers commit to its HBM
         self.timeout_s = DEFAULT_TIMEOUT_S
         self.max_eager_size = 32 * 1024
         self.max_rendezvous_size = MAX_EAGER_SIZE_LIMIT
@@ -428,8 +620,7 @@ class XLAEngine(BaseEngine):
             else:
 
                 def sink(payload, call=options):
-                    dst = call.res.device_view()[: call.count]
-                    np.copyto(dst, payload[: call.count].astype(dst.dtype))
+                    _write_host_result(call.res, payload, call.count)
 
             self.p2p.post_recv(key, sink, req)
         else:
@@ -492,6 +683,34 @@ class XLAEngine(BaseEngine):
 
     def _local_op(self, options: CallOptions) -> ErrorCode:
         n = options.count
+        bufs = [options.op0, options.res]
+        if options.op == Operation.COMBINE:
+            bufs.insert(1, options.op1)
+        if all(isinstance(b, DeviceBuffer) for b in bufs) and len(
+            {b.device for b in bufs}
+        ) == 1:
+            # all-device fast path: compute on the owning chip, adopt the
+            # result — the reference's DMA-loopback copy/combine with no
+            # host in the loop
+            src = options.op0.device_array()[:n]
+            if options.op == Operation.COMBINE:
+                other = options.op1.device_array()[:n]
+                if options.reduce_function == ReduceFunction.SUM:
+                    out = src + other
+                elif options.reduce_function == ReduceFunction.MAX:
+                    out = jnp.maximum(src, other)
+                else:
+                    return ErrorCode.ARITH_ERROR
+            else:
+                # force a distinct array: a full-count slice returns the
+                # IDENTICAL jax.Array, and sharing storage would make a later
+                # free_buffer() on either buffer delete the other's data
+                out = jnp.copy(src)
+            res_npdt = dtype_to_numpy(options.res.dtype)
+            if out.dtype != res_npdt:
+                out = out.astype(res_npdt)  # cross-dtype copy/combine
+            options.res.store(out, n)
+            return ErrorCode.OK
         src = jnp.asarray(options.op0.device_view()[:n])
         if options.op == Operation.COMBINE:
             other = jnp.asarray(options.op1.device_view()[:n])
@@ -503,8 +722,7 @@ class XLAEngine(BaseEngine):
                 return ErrorCode.ARITH_ERROR
         else:
             out = src
-        dst = options.res.device_view()[:n]
-        np.copyto(dst, np.asarray(out).astype(dst.dtype))
+        _write_host_result(options.res, np.asarray(out), n)
         return ErrorCode.OK
 
     def _apply_config(self, options: CallOptions) -> ErrorCode:
@@ -524,6 +742,23 @@ class XLAEngine(BaseEngine):
                 return ErrorCode.CONFIG_ERROR
             self.max_rendezvous_size = int(val)
         return ErrorCode.OK
+
+    def create_buffer(self, count: int, dtype, host_only: bool = False,
+                      data=None):
+        """HBM-resident DeviceBuffer on this rank's chip; host-only buffers
+        (and device-less fallback ranks) stay host pairs.  ``data`` seeds
+        the device array directly (one device_put, no zeros pass) with the
+        host mirror aliasing the caller's array."""
+        if host_only or self.device is None:
+            return super().create_buffer(
+                count, dtype, host_only=host_only, data=data
+            )
+        if data is not None:
+            arr = jax.device_put(data, self.device)
+            return DeviceBuffer(
+                count, dtype, self.device, array=arr, host=data
+            )
+        return DeviceBuffer(count, dtype, self.device)
 
     def shutdown(self) -> None:
         pass

@@ -11,11 +11,49 @@ like the reference suite does).
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import numpy as np
 
 from .constants import DataType, dtype_to_numpy, numpy_to_dtype
+
+
+@functools.lru_cache(maxsize=512)
+def _zeros_program(shape: tuple, npdt, device):
+    """Jitted on-device zeros initializer, cached per (shape, dtype, device)
+    so repeated buffer creation reuses the compiled program.  Shared with
+    the XLA engine's dummy-operand shards."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import SingleDeviceSharding
+
+    return jax.jit(
+        lambda: jnp.zeros(shape, npdt),
+        out_shardings=SingleDeviceSharding(device),
+    )
+
+
+def dev_zeros(shape: tuple, npdt, device):
+    """A zeros array committed to ``device`` without touching the host."""
+    return _zeros_program(tuple(shape), np.dtype(npdt), device)()
+
+
+# Slicing and scatter-writeback run as cached jitted programs, not eager
+# ops: eager indexing dispatches its index scalars host->device, which
+# would violate the zero-host-copy contract (and trip transfer guards).
+@functools.lru_cache(maxsize=2048)
+def _slice_program(start: int, stop: int):
+    import jax
+
+    return jax.jit(lambda a: a[start:stop])
+
+
+@functools.lru_cache(maxsize=2048)
+def _writeback_program(start: int, n: int):
+    import jax
+
+    return jax.jit(lambda base, a: base.at[start : start + n].set(a[:n]))
 
 
 class BaseBuffer:
@@ -132,6 +170,135 @@ class EmuBuffer(BaseBuffer):
 
     def device_view(self) -> np.ndarray:
         return self._dev
+
+
+class DeviceBuffer(BaseBuffer):
+    """HBM-resident buffer: the device side is a committed ``jax.Array``.
+
+    Role model: ``XRTBuffer`` (``driver/xrt/include/accl/xrtbuffer.hpp``) —
+    a device BO with a host shadow and ``sync_to/from_device``.  On TPU the
+    BO is a single-device ``jax.Array`` pinned to one chip's HBM; the
+    collective engine assembles per-rank device arrays into one sharded
+    global array with ``jax.make_array_from_single_device_arrays`` (zero
+    copy) and adopts result shards back — the host never touches the data
+    path, matching the reference's "no host in the loop" contract
+    (``README.md:7-14``, hot path ``accl.cpp:780-826``).
+
+    jax.Arrays are immutable, so "writes" replace the underlying array
+    (``store``) — a device-side computation, never a host transfer.  Slices
+    carry a parent link and write back with ``.at[...].set`` on store,
+    preserving the reference's aliasing semantics.
+    """
+
+    def __init__(
+        self,
+        count: int,
+        dtype: DataType,
+        device,
+        array=None,
+        parent: Optional["DeviceBuffer"] = None,
+        offset: int = 0,
+        host: Optional[np.ndarray] = None,
+    ):
+        super().__init__(count, dtype)
+        self.device = device
+        self._parent = parent
+        self._offset = int(offset)
+        npdt = dtype_to_numpy(dtype)
+        self._host = host if host is not None else np.zeros(count, npdt)
+        if parent is not None:
+            self._dev = None  # storage lives in the root buffer
+        elif array is not None:
+            self._dev = array
+        else:
+            self._dev = dev_zeros((self._count,), npdt, device)
+
+    # -- introspection ------------------------------------------------------
+    @property
+    def data(self) -> np.ndarray:
+        return self._host
+
+    def _root(self) -> "DeviceBuffer":
+        buf = self
+        while buf._parent is not None:
+            buf = buf._parent
+        return buf
+
+    def _root_offset(self) -> int:
+        buf, off = self, 0
+        while buf._parent is not None:
+            off += buf._offset
+            buf = buf._parent
+        return off
+
+    def device_array(self):
+        """The committed ``jax.Array`` (sliced view for child buffers —
+        a device-side computation, not a transfer)."""
+        root = self._root()
+        if root is self:
+            return self._dev
+        off = self._root_offset()
+        return _slice_program(off, off + self._count)(root._dev)
+
+    def store(self, array, count: Optional[int] = None) -> None:
+        """Engine-side result placement: replace the first ``count`` device
+        elements with ``array`` (a jax.Array already on this device).
+        Whole-buffer stores on root buffers are free (pointer swap); partial
+        or sliced stores write back with ``.at[...].set``."""
+        n = self._count if count is None else int(count)
+        if getattr(array, "ndim", 1) != 1 or array.shape[0] < n:
+            raise ValueError(
+                f"store of shape {getattr(array, 'shape', '?')} into {n} "
+                f"elements of a {self._count}-element buffer"
+            )
+        if array.dtype != dtype_to_numpy(self._dtype):
+            raise TypeError(
+                f"store dtype {array.dtype} != buffer dtype {self._dtype.name}"
+            )
+        root = self._root()
+        off = self._root_offset()
+        if root is self and n == self._count and array.shape[0] == n:
+            root._dev = array
+        else:
+            root._dev = _writeback_program(off, n)(root._dev, array)
+
+    # -- data movement ------------------------------------------------------
+    def sync_to_device(self) -> None:
+        import jax
+
+        arr = jax.device_put(self._host, self.device)
+        self.store(arr)
+
+    def sync_from_device(self) -> None:
+        np.copyto(self._host, np.asarray(self.device_array()))
+
+    def free_buffer(self) -> None:
+        root = self._root()
+        if root is self and self._dev is not None:
+            self._dev.delete()
+            self._dev = None
+
+    # -- views --------------------------------------------------------------
+    def slice(self, start: int, stop: int) -> "DeviceBuffer":
+        if not (0 <= start <= stop <= self._count):
+            raise IndexError(f"slice [{start}:{stop}) out of range 0..{self._count}")
+        return DeviceBuffer(
+            stop - start,
+            self._dtype,
+            self.device,
+            parent=self,
+            offset=start,
+            host=self._host[start:stop],
+        )
+
+    def host_view(self) -> np.ndarray:
+        return self._host
+
+    def device_view(self) -> np.ndarray:
+        """Host copy of device memory — the generic fallback path for mixed
+        emulator/device operands.  The zero-copy engine path never calls
+        this (it uses :meth:`device_array`)."""
+        return np.asarray(self.device_array())
 
 
 class DummyBuffer(BaseBuffer):

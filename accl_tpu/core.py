@@ -112,15 +112,26 @@ class ACCL:
     # -- buffer factories (ref ACCL::create_buffer family) -------------------
     def create_buffer(
         self, count: int, dtype: DTypeLike, host_only: bool = False
-    ) -> EmuBuffer:
-        return EmuBuffer(count, _as_datatype(dtype), host_only=host_only)
+    ) -> BaseBuffer:
+        """Backend-appropriate buffer: HBM-resident jax.Array on device
+        tiers, host pair on the emulator (ref ACCL::create_buffer
+        dispatching to XRTBuffer/SimBuffer)."""
+        return self.engine.create_buffer(
+            count, _as_datatype(dtype), host_only=host_only
+        )
 
     def create_buffer_from(
         self, array: np.ndarray, host_only: bool = False
-    ) -> EmuBuffer:
-        buf = EmuBuffer.from_array(np.asarray(array), host_only=host_only)
-        buf.sync_to_device()
-        return buf
+    ) -> BaseBuffer:
+        """Wrap an existing host array: the buffer's host side ALIASES
+        ``array`` when it is already contiguous 1-D (mutate + sync to
+        update the device side, ref Buffer-from-pointer ctor), and the
+        device side is synced on return."""
+        array = np.ascontiguousarray(array).reshape(-1)
+        return self.engine.create_buffer(
+            array.size, numpy_to_dtype(array.dtype),
+            host_only=host_only, data=array,
+        )
 
     # -- communicator management --------------------------------------------
     def create_communicator(
@@ -177,10 +188,11 @@ class ACCL:
         req = self.engine.start(options)
         if run_async:
             return req
-        # facade-level deadline tracks the configured engine timeout (with a
-        # 2x margin so the engine's own RECEIVE_TIMEOUT fires first and we
-        # report its error code, not a generic deadlock)
-        if not req.wait(timeout=max(1.0, 2 * self._timeout_s)):
+        # facade-level deadline tracks the configured engine timeout, with a
+        # 4x margin (60s floor) so the engine's own RECEIVE_TIMEOUT fires
+        # first for assembly stalls — and a first-call XLA compile of a large
+        # program doesn't spuriously trip the deadlock detector
+        if not req.wait(timeout=max(60.0, 4 * self._timeout_s)):
             raise ACCLError(ErrorCode.DEADLOCK_SUSPECTED, context)
         req.check(context)
         return req
@@ -644,13 +656,19 @@ def xla_group(n: int, **accl_kwargs) -> List[ACCL]:
     virtual CPU devices under XLA_FLAGS host-device forcing)."""
     from .backends.xla.engine import XLAEngine, XLAGangContext, _P2PChannel
 
+    import jax
+
     gang = XLAGangContext()
     p2p = _P2PChannel()
     peers: dict = {}
+    devs = jax.devices()
     ranks = [Rank(address=f"xla:{i}", session=i) for i in range(n)]
     group = []
     for i in range(n):
-        eng = XLAEngine(gang, p2p=p2p, peers=peers)
+        # rank i owns device i's HBM; over-subscribed ranks (more ranks
+        # than chips) stay host-resident and use the fallback path
+        dev = devs[i] if n <= len(devs) else None
+        eng = XLAEngine(gang, p2p=p2p, peers=peers, device=dev)
         peers[i] = eng
         group.append(ACCL(eng, ranks, i, **accl_kwargs))
     return group

@@ -35,7 +35,7 @@ def make_mesh(n: Optional[int] = None, axis: str = AXIS) -> Mesh:
     return Mesh(devs[:n], (axis,))
 
 
-def _smap(mesh: Mesh, fn, in_spec, out_spec):
+def _smap(mesh: Mesh, fn, in_spec, out_spec, donate: bool = False):
     return jax.jit(
         shard_map(
             fn,
@@ -43,7 +43,11 @@ def _smap(mesh: Mesh, fn, in_spec, out_spec):
             in_specs=in_spec,
             out_specs=out_spec,
             check_vma=False,
-        )
+        ),
+        # donation: in-place collectives (bcast writes its own operand) hand
+        # their operand's HBM to XLA, the jax analog of the reference's
+        # in-place device BOs
+        donate_argnums=(0,) if donate else (),
     )
 
 
@@ -76,6 +80,12 @@ def _program(op: str, mesh_id: int, fn: ReduceFunction, extra=None):
         body = lambda x: collectives.allgather(x[0], AXIS, tiled=True)[None]
     elif op == "bcast":
         body = lambda x: collectives.bcast(x[0], AXIS, extra)[None]
+    elif op == "bcast_inplace":
+        # donating variant for the engine's device-resident in-place bcast
+        # (op0 IS res on every rank); the public run_bcast never donates —
+        # callers may hold the input array
+        body = lambda x: collectives.bcast(x[0], AXIS, extra)[None]
+        return _smap(mesh, body, (spec,), spec, donate=True)
     elif op == "scatter":
         body = lambda x: collectives.scatter(x[0], AXIS, extra)[None]
     elif op == "gather":
@@ -97,8 +107,11 @@ def _mesh_key(mesh: Mesh) -> int:
 
 
 def _put(stacked, mesh: Mesh):
+    sharding = NamedSharding(mesh, P(AXIS))
+    if isinstance(stacked, jax.Array) and stacked.sharding == sharding:
+        return stacked  # already assembled on the mesh: zero-copy passthrough
     stacked = jnp.asarray(stacked)
-    return jax.device_put(stacked, NamedSharding(mesh, P(AXIS)))
+    return jax.device_put(stacked, sharding)
 
 
 def run_allreduce(stacked, mesh: Mesh, function=ReduceFunction.SUM):
@@ -160,8 +173,11 @@ def run_allgather(stacked, mesh: Mesh):
     )
 
 
-def run_bcast(stacked, mesh: Mesh, root=0):
-    return _program("bcast", _mesh_key(mesh), ReduceFunction.SUM, root)(
+def run_bcast(stacked, mesh: Mesh, root=0, donate: bool = False):
+    """``donate=True`` hands the input's HBM to XLA (in-place bcast); only
+    safe when the caller no longer needs the input array."""
+    op = "bcast_inplace" if donate else "bcast"
+    return _program(op, _mesh_key(mesh), ReduceFunction.SUM, root)(
         _put(stacked, mesh)
     )
 

@@ -1,0 +1,325 @@
+"""Device-resident (HBM/jax.Array) buffer tier: zero-host-copy proof.
+
+The reference's whole point is *no host in the data path* (README.md:7-14;
+device BOs ``buffer.hpp:32-141``; hot path ``accl.cpp:780-826`` moves
+device-to-device).  These tests pin the TPU equivalent: facade collectives
+over :class:`DeviceBuffer` operands must execute with ZERO host transfers
+between buffer creation and ``sync_from_device`` — enforced with
+``jax.transfer_guard("disallow")``, which raises on any implicit or
+explicit host<->device copy on the guarded thread.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+from accl_tpu.buffer import DeviceBuffer, EmuBuffer
+from accl_tpu.constants import DataType, ReduceFunction
+from accl_tpu.core import xla_group
+
+
+def _run_ranks(group, fn):
+    """Drive fn(accl, rank) on one thread per rank; re-raise any failure."""
+    errs = []
+
+    def work(a, r):
+        try:
+            fn(a, r)
+        except Exception as e:  # pragma: no cover - failure reporting
+            import traceback
+
+            traceback.print_exc()
+            errs.append((r, e))
+
+    ts = [
+        threading.Thread(target=work, args=(a, r))
+        for r, a in enumerate(group)
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(60)
+    assert not any(t.is_alive() for t in ts), "rank thread hung"
+    assert not errs, errs
+
+
+@pytest.fixture(scope="module")
+def dgroup4():
+    g = xla_group(4)
+    yield g
+    for a in g:
+        a.deinit()
+
+
+# ---------------------------------------------------------------------------
+# DeviceBuffer unit semantics
+# ---------------------------------------------------------------------------
+
+
+def test_device_buffer_factory_and_sync():
+    g = xla_group(2)
+    try:
+        buf = g[0].create_buffer(8, np.float32)
+        assert isinstance(buf, DeviceBuffer)
+        assert buf.device == jax.devices()[0]
+        buf.data[:] = np.arange(8, dtype=np.float32)
+        buf.sync_to_device()
+        dev = np.asarray(buf.device_array())
+        np.testing.assert_array_equal(dev, np.arange(8, dtype=np.float32))
+        # engine-side store must not leak into host until sync_from_device
+        buf2 = g[1].create_buffer_from(np.ones(8, np.float32))
+        assert isinstance(buf2, DeviceBuffer)
+        assert buf2.device == jax.devices()[1]
+        np.testing.assert_array_equal(np.asarray(buf2.device_array()), 1.0)
+        # host-only stays host-resident
+        hbuf = g[0].create_buffer(4, np.float32, host_only=True)
+        assert isinstance(hbuf, EmuBuffer) and hbuf.is_host_only
+    finally:
+        for a in g:
+            a.deinit()
+
+
+def test_device_buffer_slice_writeback():
+    dev = jax.devices()[0]
+    buf = DeviceBuffer(10, DataType.FLOAT32, dev)
+    buf.data[:] = np.arange(10, dtype=np.float32)
+    buf.sync_to_device()
+    sl = buf.slice(2, 6)
+    assert sl.count == 4
+    np.testing.assert_array_equal(
+        np.asarray(sl.device_array()), [2.0, 3.0, 4.0, 5.0]
+    )
+    # storing into the slice writes back into the parent device array
+    import jax.numpy as jnp
+
+    sl.store(jnp.full((4,), 9.0, jnp.float32))
+    buf.sync_from_device()
+    np.testing.assert_array_equal(
+        buf.data, [0, 1, 9, 9, 9, 9, 6, 7, 8, 9]
+    )
+    # host view of the slice aliases the parent host mirror
+    assert sl.host_view().base is not None
+
+
+def test_device_buffer_partial_store_preserves_tail():
+    dev = jax.devices()[0]
+    buf = DeviceBuffer(8, DataType.FLOAT32, dev)
+    buf.data[:] = np.arange(8, dtype=np.float32)
+    buf.sync_to_device()
+    import jax.numpy as jnp
+
+    buf.store(jnp.full((3,), -1.0, jnp.float32), 3)
+    buf.sync_from_device()
+    np.testing.assert_array_equal(buf.data, [-1, -1, -1, 3, 4, 5, 6, 7])
+
+
+# ---------------------------------------------------------------------------
+# Zero-host-copy collectives (the VERDICT item-1 "done" criterion)
+# ---------------------------------------------------------------------------
+
+
+def test_allreduce_zero_host_copy(dgroup4):
+    n = 64
+    send = [
+        a.create_buffer_from(np.full(n, float(r + 1), np.float32))
+        for r, a in enumerate(dgroup4)
+    ]
+    recv = [a.create_buffer(n, np.float32) for a in dgroup4]
+    assert all(isinstance(b, DeviceBuffer) for b in send + recv)
+
+    def work(a, r):
+        # any host<->device transfer between here and sync_from_device
+        # raises: the collective must be entirely device-resident
+        with jax.transfer_guard("disallow"):
+            a.allreduce(send[r], recv[r], n)
+
+    _run_ranks(dgroup4, work)
+    for r in range(4):
+        recv[r].sync_from_device()
+        np.testing.assert_allclose(recv[r].data, 10.0)
+        # send operand unharmed (no donation on allreduce)
+        send[r].sync_from_device()
+        np.testing.assert_allclose(send[r].data, float(r + 1))
+
+
+def test_all_collectives_zero_host_copy(dgroup4):
+    """Every mesh collective rides the device path under the guard."""
+    n = 8
+    size = 4
+    rng = np.random.default_rng(7)
+    op0 = [rng.standard_normal(size * n).astype(np.float32) for _ in range(4)]
+    sb = [a.create_buffer_from(op0[r]) for r, a in enumerate(dgroup4)]
+    rb_small = [a.create_buffer(n, np.float32) for a in dgroup4]
+    rb_big = [a.create_buffer(size * n, np.float32) for a in dgroup4]
+
+    def work(a, r):
+        with jax.transfer_guard("disallow"):
+            a.reduce_scatter(
+                sb[r], rb_small[r], n, function=ReduceFunction.SUM
+            )
+            a.allgather(sb[r], rb_big[r], n)
+            a.alltoall(sb[r], rb_big[r], n)
+            a.reduce(sb[r], rb_small[r] if r == 1 else None, n, root=1)
+            a.gather(sb[r], rb_big[r] if r == 2 else None, n, root=2)
+            a.scatter(sb[r] if r == 0 else None, rb_small[r], n, root=0)
+            a.barrier()
+
+    _run_ranks(dgroup4, work)
+    # spot-check the last op (scatter from root 0)
+    for r in range(4):
+        rb_small[r].sync_from_device()
+        np.testing.assert_allclose(
+            rb_small[r].data, op0[0][r * n : (r + 1) * n], rtol=1e-6
+        )
+
+
+def test_bcast_in_place_donation(dgroup4):
+    """bcast donates its operand (in-place on every rank) and the buffer
+    remains fully usable afterwards."""
+    n = 16
+    bufs = [
+        a.create_buffer_from(np.full(n, float(r * 100), np.float32))
+        for r, a in enumerate(dgroup4)
+    ]
+
+    def work(a, r):
+        with jax.transfer_guard("disallow"):
+            a.bcast(bufs[r], n, root=2)
+
+    _run_ranks(dgroup4, work)
+    for r in range(4):
+        bufs[r].sync_from_device()
+        np.testing.assert_allclose(bufs[r].data, 200.0)
+    # buffer still live: run a second collective on it
+    out = [a.create_buffer(n, np.float32) for a in dgroup4]
+
+    def work2(a, r):
+        with jax.transfer_guard("disallow"):
+            a.allreduce(bufs[r], out[r], n)
+
+    _run_ranks(dgroup4, work2)
+    out[0].sync_from_device()
+    np.testing.assert_allclose(out[0].data, 800.0)
+
+
+def test_subcommunicator_device_path(dgroup4):
+    """Subcommunicator collectives execute on the members' own devices."""
+    n = 8
+    send, recv, comms = {}, {}, {}
+    for r in (1, 3):
+        send[r] = dgroup4[r].create_buffer_from(
+            np.full(n, float(r), np.float32)
+        )
+        recv[r] = dgroup4[r].create_buffer(n, np.float32)
+        assert send[r].device == jax.devices()[r]
+
+    def work(a, r):
+        comm = a.create_communicator([1, 3])
+        if comm is None:
+            return
+        with jax.transfer_guard("disallow"):
+            a.allreduce(send[r], recv[r], n, comm=comm)
+
+    _run_ranks(dgroup4, work)
+    for r in (1, 3):
+        recv[r].sync_from_device()
+        np.testing.assert_allclose(recv[r].data, 4.0)
+
+
+def test_compressed_allreduce_device_path(dgroup4):
+    """ETH_COMPRESSED allreduce stays on device (in-program wire cast)."""
+    n = 32
+    send = [
+        a.create_buffer_from(np.full(n, float(r + 1), np.float32))
+        for r, a in enumerate(dgroup4)
+    ]
+    recv = [a.create_buffer(n, np.float32) for a in dgroup4]
+
+    def work(a, r):
+        with jax.transfer_guard("disallow"):
+            a.allreduce(send[r], recv[r], n, compress_dtype=np.float16)
+
+    _run_ranks(dgroup4, work)
+    for r in range(4):
+        recv[r].sync_from_device()
+        np.testing.assert_allclose(recv[r].data, 10.0, rtol=1e-2)
+
+
+def test_create_buffer_from_aliases_host(dgroup4):
+    """create_buffer_from wraps the caller's array: mutate + sync updates
+    the device side (reference Buffer-from-pointer semantics)."""
+    data = np.zeros(8, np.float32)
+    buf = dgroup4[0].create_buffer_from(data)
+    data[:] = 5.0
+    buf.sync_to_device()
+    np.testing.assert_allclose(np.asarray(buf.device_array()), 5.0)
+
+
+def test_copy_then_free_source(dgroup4):
+    """Full-count device copy must not share storage: freeing the source
+    leaves the destination alive."""
+    a = dgroup4[0]
+    src = a.create_buffer_from(np.arange(8, dtype=np.float32))
+    dst = a.create_buffer(8, np.float32)
+    a.copy(src, dst, 8)
+    src.free_buffer()
+    dst.sync_from_device()
+    np.testing.assert_array_equal(dst.data, np.arange(8, dtype=np.float32))
+
+
+def test_store_validates_shape_and_dtype():
+    import jax.numpy as jnp
+
+    buf = DeviceBuffer(8, DataType.FLOAT32, jax.devices()[0])
+    with pytest.raises(ValueError):
+        buf.store(jnp.zeros((4,), jnp.float32), 8)  # too short
+    with pytest.raises(TypeError):
+        buf.store(jnp.zeros((8,), jnp.int32), 8)  # wrong dtype
+
+
+def test_cross_dtype_device_copy(dgroup4):
+    """copy between device buffers of different dtypes casts on device."""
+    a = dgroup4[0]
+    src = a.create_buffer_from(np.arange(8, dtype=np.float32))
+    dst = a.create_buffer(8, np.int32)
+    a.copy(src, dst, 8)
+    dst.sync_from_device()
+    np.testing.assert_array_equal(dst.data, np.arange(8, dtype=np.int32))
+
+
+def test_run_bcast_does_not_consume_callers_array():
+    """Public driver bcast must not donate: callers may chain collective
+    outputs (regression for the donating-bcast program)."""
+    import jax.numpy as jnp
+
+    from accl_tpu.ops import driver as opdriver
+
+    mesh = opdriver.make_mesh(4)
+    x = opdriver.run_allreduce(np.ones((4, 8), np.float32), mesh)
+    opdriver.run_bcast(x, mesh, 0)
+    np.testing.assert_allclose(np.asarray(x), 4.0)  # x still alive
+
+
+def test_mixed_host_operand_falls_back(dgroup4):
+    """A host-only operand routes through the staged fallback and still
+    produces correct results (no guard here — fallback stages via host)."""
+    n = 8
+    send = [
+        dgroup4[r].create_buffer(n, np.float32, host_only=(r == 0))
+        for r in range(4)
+    ]
+    recv = [a.create_buffer(n, np.float32) for a in dgroup4]
+    for r in range(4):
+        send[r].data[:] = float(r + 1)
+        send[r].sync_to_device()
+
+    def work(a, r):
+        a.allreduce(send[r], recv[r], n)
+
+    _run_ranks(dgroup4, work)
+    for r in range(4):
+        recv[r].sync_from_device()
+        np.testing.assert_allclose(recv[r].data, 10.0)
