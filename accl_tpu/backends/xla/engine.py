@@ -47,7 +47,7 @@ from ...constants import (
     StreamFlags,
     dtype_to_numpy,
 )
-from ...buffer import DeviceBuffer, EmuBuffer, dev_zeros as _dev_zeros
+from ...buffer import DeviceBuffer, dev_zeros as _dev_zeros
 from ...request import Request
 from ..base import BaseEngine, CallOptions
 from ...ops import driver as opdriver
@@ -104,6 +104,85 @@ def _trim_program(width: int, device):
         lambda a: a.reshape(-1)[:width],
         out_shardings=SingleDeviceSharding(device),
     )
+
+
+@functools.lru_cache(maxsize=1024)
+def _cast_program(npdt, device):
+    from jax.sharding import SingleDeviceSharding
+
+    return jax.jit(
+        lambda a: a.astype(npdt),
+        out_shardings=SingleDeviceSharding(device),
+    )
+
+
+@functools.lru_cache(maxsize=512)
+def _p2p_hop_program(n: int, dtname: str, src_dev, dst_dev):
+    """The device-fabric hop for a matched send/recv pair: a jitted
+    collective-permute over a two-device mesh [src, dst] — on real TPU
+    slices the payload moves over ICI, the analog of the reference's
+    packetizer->wire->depacketizer path (ccl_offload_control.c:573-710).
+    Returns (mesh, program)."""
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec
+
+    try:
+        from jax import shard_map
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map  # type: ignore
+
+    mesh = Mesh([src_dev, dst_dev], ("p2p",))
+    spec = PartitionSpec("p2p")
+    prog = jax.jit(
+        shard_map(
+            lambda x: lax.ppermute(x, "p2p", [(0, 1)]),
+            mesh=mesh,
+            in_specs=(spec,),
+            out_specs=spec,
+            check_vma=False,
+        )
+    )
+    return mesh, prog
+
+
+def _p2p_device_deliver(payload, res: DeviceBuffer, count: int) -> None:
+    """Move a device-resident p2p payload to the receiver's chip with a
+    collective-permute and adopt it into the result buffer — no host in
+    the data path."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    if payload.ndim != 1 or payload.shape[0] < count:
+        raise ValueError(
+            f"p2p payload of shape {payload.shape} into count {count}"
+        )
+    (src_dev,) = payload.devices()
+    dst_dev = res.device
+    res_npdt = dtype_to_numpy(res.dtype)
+    if src_dev == dst_dev:
+        # self-send: a device-local copy (jit output, distinct array)
+        arr = _trim_program(count, dst_dev)(payload)
+    else:
+        mesh, prog = _p2p_hop_program(
+            count, np.dtype(payload.dtype).name, src_dev, dst_dev
+        )
+        shards = [
+            _prep_program(count, None, src_dev)(payload),
+            _dev_zeros((1, count), payload.dtype, dst_dev),
+        ]
+        global_in = jax.make_array_from_single_device_arrays(
+            (2, count),
+            NamedSharding(mesh, PartitionSpec("p2p")),
+            shards,
+        )
+        out = prog(global_in)
+        arr = next(
+            s.data for s in out.addressable_shards if s.device == dst_dev
+        )
+        arr = _trim_program(count, dst_dev)(arr)
+    if arr.dtype != res_npdt:
+        # wire-compressed payload: decompress lane on the receiving chip
+        arr = _cast_program(res_npdt, dst_dev)(arr)
+    res.store(arr, count)
 
 
 
@@ -322,7 +401,6 @@ class XLAGangContext:
             # the facade's in-place form (op0 IS res on every rank)
             return None
 
-        import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec
 
         # wire-dtype rounding before the op (the hp_compression lanes);
@@ -534,28 +612,63 @@ class XLAGangContext:
 
 # p2p pairing: send/recv matched by (comm, tag, src, dst) independent of the
 # collective gang sequence.  Receivers register a *sink* callable so the same
-# channel serves buffer receives and recv-to-stream.
+# channel serves buffer receives and recv-to-stream.  Unmatched posts carry a
+# watchdog honoring the engine timeout (the firmware's per-call deadline);
+# delivery — which may jit the fabric-hop program — runs OUTSIDE the channel
+# lock so unrelated pairs never serialize behind a compile.
 class _P2PChannel:
     def __init__(self):
         self._lock = threading.Lock()
         self._sends: Dict[tuple, list] = {}
         self._recvs: Dict[tuple, list] = {}
 
-    def post_send(self, key, payload, request):
+    def post_send(self, key, payload, request, timeout_s=None):
+        match = None
         with self._lock:
             if self._recvs.get(key):
-                sink, rreq = self._recvs[key].pop(0)
-                self._deliver(sink, rreq, payload, request)
-                return
-            self._sends.setdefault(key, []).append((payload, request))
+                sink, rreq, rtimer = self._recvs[key].pop(0)
+                if rtimer is not None:
+                    rtimer.cancel()
+                match = (sink, rreq)
+            else:
+                self._park(self._sends, key, [payload, request], timeout_s)
+        if match is not None:
+            self._deliver(match[0], match[1], payload, request)
 
-    def post_recv(self, key, sink, request):
+    def post_recv(self, key, sink, request, timeout_s=None):
+        match = None
         with self._lock:
             if self._sends.get(key):
-                payload, sreq = self._sends[key].pop(0)
-                self._deliver(sink, request, payload, sreq)
-                return
-            self._recvs.setdefault(key, []).append((sink, request))
+                payload, sreq, stimer = self._sends[key].pop(0)
+                if stimer is not None:
+                    stimer.cancel()
+                match = (payload, sreq)
+            else:
+                self._park(self._recvs, key, [sink, request], timeout_s)
+        if match is not None:
+            self._deliver(sink, request, match[0], match[1])
+
+    def _park(self, table, key, entry, timeout_s) -> None:
+        """Append an unmatched post (caller holds the lock), arming a
+        timeout watchdog when requested."""
+        entry.append(None)
+        if timeout_s:
+            t = threading.Timer(
+                timeout_s, self._expire, (table, key, entry)
+            )
+            t.daemon = True
+            entry[2] = t
+            t.start()
+        table.setdefault(key, []).append(entry)
+
+    def _expire(self, table, key, entry) -> None:
+        with self._lock:
+            lst = table.get(key, [])
+            if entry in lst:
+                lst.remove(entry)
+            else:
+                return  # matched in the meantime: nothing to do
+        entry[1].complete(ErrorCode.RECEIVE_TIMEOUT)
 
     @staticmethod
     def _deliver(sink, rreq: Request, payload: np.ndarray, sreq):
@@ -620,9 +733,17 @@ class XLAEngine(BaseEngine):
             else:
 
                 def sink(payload, call=options):
+                    if isinstance(payload, jax.Array) and isinstance(
+                        call.res, DeviceBuffer
+                    ):
+                        # both ends device-resident: ride the fabric
+                        _p2p_device_deliver(payload, call.res, call.count)
+                        return
+                    if isinstance(payload, jax.Array):
+                        payload = np.asarray(payload)  # host-side receiver
                     _write_host_result(call.res, payload, call.count)
 
-            self.p2p.post_recv(key, sink, req)
+            self.p2p.post_recv(key, sink, req, timeout_s=self.timeout_s)
         else:
             self.gang.submit(options.comm, options, req)
         return req
@@ -655,11 +776,30 @@ class XLAEngine(BaseEngine):
                     req.complete(ErrorCode.DMA_TIMEOUT)
                     return
                 payload = np.frombuffer(raw[:need], npdt).copy()
+            elif isinstance(options.op0, DeviceBuffer) and not (
+                options.stream & StreamFlags.RES_STREAM
+            ):
+                # device-resident send: post the payload as a committed
+                # jax.Array (a fresh device copy, so the sender may free or
+                # overwrite its buffer immediately); the matched receiver
+                # moves it over the fabric with a collective-permute
+                src_dev = options.op0.device
+                payload = _trim_program(options.count, src_dev)(
+                    options.op0.device_array()
+                )
+                if options.compression & CompressionFlags.ETH_COMPRESSED:
+                    # compress lane on the sending chip: the wire (and the
+                    # ICI hop) carries the narrow dtype
+                    payload = _cast_program(
+                        dtype_to_numpy(cfg.compressed), src_dev
+                    )(payload)
             else:
                 payload = np.asarray(
                     options.op0.device_view()[: options.count]
                 ).copy()
-            if options.compression & CompressionFlags.ETH_COMPRESSED:
+            if isinstance(payload, np.ndarray) and (
+                options.compression & CompressionFlags.ETH_COMPRESSED
+            ):
                 payload = payload.astype(dtype_to_numpy(cfg.compressed))
             dst_world = comm.ranks[options.root_dst].session
             me_world = comm.ranks[comm.local_rank].session
@@ -672,7 +812,7 @@ class XLAEngine(BaseEngine):
                     req.complete(ErrorCode.OK, 1)
                 return
             key = (comm.id, options.tag, me_world, dst_world)
-            self.p2p.post_send(key, payload, req)
+            self.p2p.post_send(key, payload, req, timeout_s=self.timeout_s)
 
         if options.stream & StreamFlags.OP0_STREAM:
             # operand arrives asynchronously from a device kernel: wait for

@@ -303,6 +303,103 @@ def test_run_bcast_does_not_consume_callers_array():
     np.testing.assert_allclose(np.asarray(x), 4.0)  # x still alive
 
 
+def test_p2p_sendrecv_device_fabric(dgroup4):
+    """Matched send/recv between device buffers rides the collective-
+    permute fabric: zero host transfers under the guard (VERDICT item-2
+    'done' criterion)."""
+    n = 32
+    src = dgroup4[0].create_buffer_from(
+        np.arange(n, dtype=np.float32) * 2.0
+    )
+    dst = dgroup4[3].create_buffer(n, np.float32)
+
+    def work(a, r):
+        with jax.transfer_guard("disallow"):
+            if r == 0:
+                a.send(src, n, dst=3, tag=7)
+            elif r == 3:
+                a.recv(dst, n, src=0, tag=7)
+
+    _run_ranks(dgroup4, work)
+    dst.sync_from_device()
+    np.testing.assert_array_equal(dst.data, np.arange(n) * 2.0)
+
+
+def test_p2p_compressed_device_fabric(dgroup4):
+    """Compressed send: the wire (ICI hop) carries the narrow dtype; the
+    receiving chip decompresses — all on device."""
+    n = 16
+    src = dgroup4[1].create_buffer_from(
+        np.linspace(0, 1, n).astype(np.float32)
+    )
+    dst = dgroup4[2].create_buffer(n, np.float32)
+
+    def work(a, r):
+        with jax.transfer_guard("disallow"):
+            if r == 1:
+                a.send(src, n, dst=2, tag=9, compress_dtype=np.float16)
+            elif r == 2:
+                a.recv(dst, n, src=1, tag=9, compress_dtype=np.float16)
+
+    _run_ranks(dgroup4, work)
+    dst.sync_from_device()
+    np.testing.assert_allclose(
+        dst.data, np.linspace(0, 1, n).astype(np.float16), rtol=1e-3
+    )
+
+
+def test_p2p_self_send_device(dgroup4):
+    n = 8
+    src = dgroup4[2].create_buffer_from(np.full(n, 3.0, np.float32))
+    dst = dgroup4[2].create_buffer(n, np.float32)
+    a = dgroup4[2]
+    r1 = a.send(src, n, dst=2, tag=11, run_async=True)
+    a.recv(dst, n, src=2, tag=11)
+    r1.wait()
+    # freeing the source must not invalidate the delivered payload
+    src.free_buffer()
+    dst.sync_from_device()
+    np.testing.assert_allclose(dst.data, 3.0)
+
+
+def test_p2p_device_to_host_buffer(dgroup4):
+    """Device sender, host-only receiver: payload falls back to the host
+    path and still arrives."""
+    n = 8
+    src = dgroup4[0].create_buffer_from(np.full(n, 4.0, np.float32))
+    dst = dgroup4[1].create_buffer(n, np.float32, host_only=True)
+
+    def work(a, r):
+        if r == 0:
+            a.send(src, n, dst=1, tag=13)
+        elif r == 1:
+            a.recv(dst, n, src=0, tag=13)
+
+    _run_ranks(dgroup4, work)
+    dst.sync_from_device()
+    np.testing.assert_allclose(dst.data, 4.0)
+
+
+def test_p2p_recv_timeout_honors_configured_timeout():
+    """An unmatched recv fails with RECEIVE_TIMEOUT after the configured
+    engine timeout (p2p watchdog), not a fixed facade deadline."""
+    import time
+
+    from accl_tpu.constants import ACCLError, ErrorCode
+
+    g = xla_group(2, timeout_s=1.0)
+    try:
+        buf = g[0].create_buffer(4, np.float32)
+        t0 = time.monotonic()
+        with pytest.raises(ACCLError) as ei:
+            g[0].recv(buf, 4, src=1, tag=99)
+        assert ei.value.code == ErrorCode.RECEIVE_TIMEOUT
+        assert time.monotonic() - t0 < 30.0
+    finally:
+        for a in g:
+            a.deinit()
+
+
 def test_mixed_host_operand_falls_back(dgroup4):
     """A host-only operand routes through the staged fallback and still
     produces correct results (no guard here — fallback stages via host)."""
