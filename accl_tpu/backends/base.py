@@ -45,6 +45,7 @@ class CallOptions:
     # Operation.CONFIG only:
     cfg_function: int = 0
     cfg_value: float = 0.0
+    cfg_key: int = 0  # tuning register selector for SET_TUNING
 
 
 class BaseEngine:

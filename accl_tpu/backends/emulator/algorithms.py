@@ -603,10 +603,11 @@ def op_reduce(eng, call: CallOptions) -> Generator:
         return ErrorCode.ARITH_ERROR
     acc_dt = _acc_dtype(call)
     npdt = dtype_to_numpy(acc_dt)
+    # operand via the stream-capable reader: reduce accepts a streaming
+    # operand like the reference's stream reduce overloads (accl.hpp:514-590)
+    op0 = yield from _read_op0(eng, call)
     if size == 1:
-        dst = _res_view(call)
-        np.copyto(dst, cast_array(_op0_view(call), call_res_dtype_of(dst)))
-        yield Yield()
+        _write_res(eng, call, op0)
         return ErrorCode.OK
     data_nbytes = count * npdt.itemsize
     rndzv = _use_rendezvous(eng, call, data_nbytes)
@@ -616,7 +617,7 @@ def op_reduce(eng, call: CallOptions) -> Generator:
     if rndzv and flat:
         # flat tree: root accumulates everyone into spares
         if r == root:
-            acc = cast_array(_op0_view(call), acc_dt).copy()
+            acc = cast_array(op0, acc_dt).copy()
             for peer in range(size):
                 if peer != root:
                     yield from recv_reduce_chunk(
@@ -624,12 +625,12 @@ def op_reduce(eng, call: CallOptions) -> Generator:
                     )
             _write_res(eng, call, acc)
         else:
-            yield from send_chunk(eng, call, comm, root, call.tag, _op0_view(call))
+            yield from send_chunk(eng, call, comm, root, call.tag, op0)
         return ErrorCode.OK
     if rndzv:
         # binomial reduction tree on root-relative ranks (c:1603-1728)
         rel = (r - root) % size
-        acc = cast_array(_op0_view(call), acc_dt).copy()
+        acc = cast_array(op0, acc_dt).copy()
         k = 0
         while (1 << k) < size:
             if rel & (1 << k):
@@ -647,7 +648,7 @@ def op_reduce(eng, call: CallOptions) -> Generator:
     # eager ring pipeline: partials flow from the farthest rank toward root,
     # fused recv-reduce-send at every hop (c:1730-1743)
     rel = (r - root) % size
-    acc = cast_array(_op0_view(call), acc_dt).copy()
+    acc = cast_array(op0, acc_dt).copy()
     if rel == size - 1:
         yield from send_chunk(
             eng, call, comm, (r - 1) % size, call.tag, acc

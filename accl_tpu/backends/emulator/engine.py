@@ -241,6 +241,33 @@ class EmuEngine(BaseEngine):
             if val <= 0:
                 return ErrorCode.CONFIG_ERROR
             self.max_rendezvous_size = int(val)
+        elif fn == ConfigFunction.SET_TUNING:
+            from ...constants import (
+                AllreduceAlgorithm,
+                TUNING_KEY_NAMES,
+                TuningKey,
+            )
+
+            try:
+                key = TuningKey(int(options.cfg_key))
+            except ValueError:
+                return ErrorCode.CONFIG_ERROR
+            if val < 0:
+                return ErrorCode.CONFIG_ERROR
+            # per-key validation matches the XLA/native tiers so code
+            # validated against the emulator doesn't skew on device
+            if key == TuningKey.GATHER_FLAT_TREE_MAX_FANIN and val < 1:
+                return ErrorCode.CONFIG_ERROR
+            if key == TuningKey.RING_SEGMENTS and val < 1:
+                return ErrorCode.CONFIG_ERROR
+            if key == TuningKey.ALLREDUCE_ALGORITHM:
+                try:
+                    AllreduceAlgorithm(int(val))
+                except ValueError:
+                    return ErrorCode.CONFIG_ERROR
+            # device-tier registers (algorithm select) are accepted and
+            # stored but don't affect the emulated firmware algorithms
+            self.tuning[TUNING_KEY_NAMES[key]] = int(val)
         else:
             return ErrorCode.CONFIG_ERROR
         return ErrorCode.OK

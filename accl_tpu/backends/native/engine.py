@@ -56,7 +56,7 @@ class _CallArgs(ctypes.Structure):
         ("op0_dtype", ctypes.c_int32),
         ("op1_dtype", ctypes.c_int32),
         ("res_dtype", ctypes.c_int32),
-        ("pad_", ctypes.c_int32),
+        ("cfg_key", ctypes.c_int32),
     ]
 
 
@@ -221,6 +221,7 @@ class NativeEngine(BaseEngine):
         args.op = int(options.op)
         args.cfg_function = int(options.cfg_function)
         args.cfg_value = float(options.cfg_value)
+        args.cfg_key = int(options.cfg_key)
         args.count = int(options.count)
         args.root_src = int(options.root_src)
         args.root_dst = int(options.root_dst)

@@ -47,7 +47,7 @@ from ...constants import (
     StreamFlags,
     dtype_to_numpy,
 )
-from ...buffer import DeviceBuffer, dev_zeros as _dev_zeros
+from ...buffer import DeviceBuffer, DummyBuffer, EmuBuffer, dev_zeros as _dev_zeros
 from ...request import Request
 from ..base import BaseEngine, CallOptions
 from ...ops import driver as opdriver
@@ -117,7 +117,7 @@ def _cast_program(npdt, device):
 
 
 @functools.lru_cache(maxsize=512)
-def _p2p_hop_program(n: int, dtname: str, src_dev, dst_dev):
+def _p2p_hop_program(src_dev, dst_dev):
     """The device-fabric hop for a matched send/recv pair: a jitted
     collective-permute over a two-device mesh [src, dst] — on real TPU
     slices the payload moves over ICI, the analog of the reference's
@@ -162,9 +162,7 @@ def _p2p_device_deliver(payload, res: DeviceBuffer, count: int) -> None:
         # self-send: a device-local copy (jit output, distinct array)
         arr = _trim_program(count, dst_dev)(payload)
     else:
-        mesh, prog = _p2p_hop_program(
-            count, np.dtype(payload.dtype).name, src_dev, dst_dev
-        )
+        mesh, prog = _p2p_hop_program(src_dev, dst_dev)
         shards = [
             _prep_program(count, None, src_dev)(payload),
             _dev_zeros((1, count), payload.dtype, dst_dev),
@@ -653,22 +651,29 @@ class _P2PChannel:
         timeout watchdog when requested."""
         entry.append(None)
         if timeout_s:
+            code = (
+                ErrorCode.SEND_TIMEOUT
+                if table is self._sends
+                else ErrorCode.RECEIVE_TIMEOUT
+            )
             t = threading.Timer(
-                timeout_s, self._expire, (table, key, entry)
+                timeout_s, self._expire, (table, key, entry, code)
             )
             t.daemon = True
             entry[2] = t
             t.start()
         table.setdefault(key, []).append(entry)
 
-    def _expire(self, table, key, entry) -> None:
+    def _expire(self, table, key, entry, code) -> None:
         with self._lock:
+            # identity-based scan: payloads are arrays, so `in`/`remove`
+            # would trip elementwise ==
             lst = table.get(key, [])
-            if entry in lst:
-                lst.remove(entry)
-            else:
+            idx = next((i for i, e in enumerate(lst) if e is entry), None)
+            if idx is None:
                 return  # matched in the meantime: nothing to do
-        entry[1].complete(ErrorCode.RECEIVE_TIMEOUT)
+            del lst[idx]
+        entry[1].complete(code)
 
     @staticmethod
     def _deliver(sink, rreq: Request, payload: np.ndarray, sreq):
@@ -716,7 +721,20 @@ class XLAEngine(BaseEngine):
         elif op == Operation.NOP:
             req.complete(ErrorCode.OK)
         elif op in (Operation.COPY, Operation.COMBINE):
-            req.complete(self._local_op(options))
+            if options.stream & StreamFlags.OP0_STREAM:
+                # streaming operand arrives asynchronously from a device
+                # kernel: wait for it off the caller's thread
+                self._spawn_completing(
+                    lambda: req.complete(self._local_op(options)), req
+                )
+            else:
+                req.complete(self._local_op(options))
+        elif op == Operation.REDUCE and options.stream != StreamFlags.NO_STREAM:
+            # stream-operand reduce (ref accl.hpp:514-590): bridge the
+            # stream ports onto the gang off-thread
+            self._spawn_completing(
+                lambda: self._gang_with_streams(options, req), req
+            )
         elif op == Operation.SEND:
             self._start_send(options, req)
         elif op == Operation.RECV:
@@ -757,25 +775,10 @@ class XLAEngine(BaseEngine):
         def resolve_and_route():
             cfg = options.arithcfg
             if options.stream & StreamFlags.OP0_STREAM:
-                src_dt = (
-                    cfg.compressed
-                    if options.compression & CompressionFlags.OP0_COMPRESSED
-                    else cfg.uncompressed
-                )
-                npdt = dtype_to_numpy(src_dt)
-                need = options.count * npdt.itemsize
-                raw = b""
-                deadline = time.monotonic() + self.timeout_s
-                try:
-                    while len(raw) < need:
-                        raw += self.stream_pop(
-                            options.stream_id,
-                            timeout=max(0.01, deadline - time.monotonic()),
-                        )
-                except TimeoutError:
+                payload = self._pop_stream_payload(options)
+                if payload is None:
                     req.complete(ErrorCode.DMA_TIMEOUT)
                     return
-                payload = np.frombuffer(raw[:need], npdt).copy()
             elif isinstance(options.op0, DeviceBuffer) and not (
                 options.stream & StreamFlags.RES_STREAM
             ):
@@ -817,12 +820,143 @@ class XLAEngine(BaseEngine):
         if options.stream & StreamFlags.OP0_STREAM:
             # operand arrives asynchronously from a device kernel: wait for
             # it off the caller's thread (the emulator parks in its scheduler)
-            threading.Thread(target=resolve_and_route, daemon=True).start()
+            self._spawn_completing(resolve_and_route, req)
         else:
             resolve_and_route()
 
+    def _spawn_completing(self, fn, req: Request) -> None:
+        """Run ``fn`` on a daemon thread; an escaping exception completes
+        the request with an error instead of leaving the caller waiting
+        forever (the scheduler-level guard the emulator tier has)."""
+
+        def run():
+            try:
+                fn()
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+                if not req.test():
+                    req.complete(ErrorCode.INVALID_OPERATION)
+
+        threading.Thread(target=run, daemon=True).start()
+
+    def _pop_stream_payload(self, options: CallOptions):
+        """Blocking pop of a full streaming operand from this rank's stream
+        port; None on timeout (the engine's DMA deadline)."""
+        cfg = options.arithcfg
+        src_dt = (
+            cfg.compressed
+            if options.compression & CompressionFlags.OP0_COMPRESSED
+            else cfg.uncompressed
+        )
+        npdt = dtype_to_numpy(src_dt)
+        need = options.count * npdt.itemsize
+        raw = b""
+        deadline = time.monotonic() + self.timeout_s
+        try:
+            while len(raw) < need:
+                raw += self.stream_pop(
+                    options.stream_id,
+                    timeout=max(0.01, deadline - time.monotonic()),
+                )
+        except TimeoutError:
+            return None
+        return np.frombuffer(raw[:need], npdt).copy()
+
+    def _push_stream_result(self, options: CallOptions, data: np.ndarray):
+        """Result row to this rank's stream port, in the wire dtype the
+        compression flags request (the RES_STREAM lane)."""
+        cfg = options.arithcfg
+        res_dt = (
+            cfg.compressed
+            if options.compression & CompressionFlags.RES_COMPRESSED
+            else cfg.uncompressed
+        )
+        npdt = dtype_to_numpy(res_dt)
+        self.stream_push(
+            options.stream_id,
+            np.asarray(data)[: options.count].astype(npdt).tobytes(),
+        )
+
+    def _gang_with_streams(self, options: CallOptions, req: Request) -> None:
+        """Stream-operand collective: pull OP0 from the stream port, run
+        the gang collective on a host-staged temp, deliver the root result
+        back to the stream port."""
+        import dataclasses
+
+        opts = options
+        if opts.stream & StreamFlags.OP0_STREAM:
+            payload = self._pop_stream_payload(opts)
+            if payload is None:
+                req.complete(ErrorCode.DMA_TIMEOUT)
+                return
+            acc_npdt = dtype_to_numpy(opts.arithcfg.uncompressed)
+            tmp = EmuBuffer.from_array(payload.astype(acc_npdt))
+            tmp.sync_to_device()
+            opts = dataclasses.replace(
+                opts, op0=tmp, stream=opts.stream & ~StreamFlags.OP0_STREAM
+            )
+        res_to_stream = bool(opts.stream & StreamFlags.RES_STREAM)
+        tmp_res = None
+        if res_to_stream:
+            is_root = opts.comm.local_rank == opts.root_dst
+            tmp_res = (
+                EmuBuffer(opts.count, opts.arithcfg.uncompressed)
+                if is_root
+                else DummyBuffer(0, opts.arithcfg.uncompressed)
+            )
+            opts = dataclasses.replace(
+                opts, res=tmp_res,
+                stream=opts.stream & ~StreamFlags.RES_STREAM,
+            )
+        inner = Request(op_name=opts.op.name)
+        inner.mark_executing()
+        self.gang.submit(opts.comm, opts, inner)
+        inner.wait()  # gang watchdog bounds this
+        code = inner.get_retcode()
+        if (
+            code == ErrorCode.OK
+            and res_to_stream
+            and not tmp_res.is_dummy
+        ):
+            self._push_stream_result(options, tmp_res.device_view())
+        req.complete(code, inner.get_duration_ns())
+
     def _local_op(self, options: CallOptions) -> ErrorCode:
         n = options.count
+        if options.stream & StreamFlags.OP0_STREAM:
+            payload = self._pop_stream_payload(options)
+            if payload is None:
+                return ErrorCode.DMA_TIMEOUT
+            acc = payload.astype(
+                dtype_to_numpy(options.arithcfg.uncompressed)
+            )
+            if options.op == Operation.COMBINE:
+                other = np.asarray(options.op1.device_view()[:n])
+                if options.reduce_function == ReduceFunction.SUM:
+                    acc = acc + other
+                elif options.reduce_function == ReduceFunction.MAX:
+                    acc = np.maximum(acc, other)
+                else:
+                    return ErrorCode.ARITH_ERROR
+            if options.stream & StreamFlags.RES_STREAM:
+                self._push_stream_result(options, acc)
+            else:
+                _write_host_result(options.res, acc, n)
+            return ErrorCode.OK
+        if options.stream & StreamFlags.RES_STREAM:
+            src = np.asarray(options.op0.device_view()[:n])
+            if options.op == Operation.COMBINE:
+                other = np.asarray(options.op1.device_view()[:n])
+                if options.reduce_function == ReduceFunction.SUM:
+                    src = src + other
+                elif options.reduce_function == ReduceFunction.MAX:
+                    src = np.maximum(src, other)
+                else:
+                    return ErrorCode.ARITH_ERROR
+            self._push_stream_result(options, src)
+            return ErrorCode.OK
         bufs = [options.op0, options.res]
         if options.op == Operation.COMBINE:
             bufs.insert(1, options.op1)
@@ -881,6 +1015,39 @@ class XLAEngine(BaseEngine):
             if val <= 0:
                 return ErrorCode.CONFIG_ERROR
             self.max_rendezvous_size = int(val)
+        elif fn == ConfigFunction.SET_TUNING:
+            return self._apply_tuning(options)
+        return ErrorCode.OK
+
+    def _apply_tuning(self, options: CallOptions) -> ErrorCode:
+        """Tuning registers on the device tier: algorithm selection maps to
+        the gang's lowering choice (the reference's firmware-variant
+        thresholds re-homed as program selection)."""
+        from ...constants import (
+            AllreduceAlgorithm,
+            TUNING_KEY_NAMES,
+            TuningKey,
+        )
+
+        try:
+            key = TuningKey(int(options.cfg_key))
+        except ValueError:
+            return ErrorCode.CONFIG_ERROR
+        val = options.cfg_value
+        if val < 0:
+            return ErrorCode.CONFIG_ERROR
+        if key == TuningKey.ALLREDUCE_ALGORITHM:
+            try:
+                algo = AllreduceAlgorithm(int(val))
+            except ValueError:
+                return ErrorCode.CONFIG_ERROR
+            self.gang.tuning["allreduce_algorithm"] = algo.name.lower()
+        elif key == TuningKey.RING_SEGMENTS:
+            if int(val) < 1:
+                return ErrorCode.CONFIG_ERROR
+            self.gang.tuning["ring_segments"] = int(val)
+        else:
+            self.gang.tuning[TUNING_KEY_NAMES[key]] = int(val)
         return ErrorCode.OK
 
     def create_buffer(self, count: int, dtype, host_only: bool = False,

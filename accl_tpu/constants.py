@@ -48,6 +48,44 @@ class ConfigFunction(enum.IntEnum):
     SET_TIMEOUT = 2
     SET_MAX_EAGER_SIZE = 3
     SET_MAX_RENDEZVOUS_SIZE = 4
+    SET_TUNING = 5
+
+
+class TuningKey(enum.IntEnum):
+    """Runtime tuning registers (ref ``ccl_offload_control.h:86-90``,
+    written by the host at ``accl.cpp:1198-1208``).  The first five mirror
+    the firmware's flat-vs-tree threshold registers; the last two select
+    the device tier's allreduce lowering (the TPU analog of picking the
+    firmware algorithm variant)."""
+
+    GATHER_FLAT_TREE_MAX_FANIN = 0
+    GATHER_FLAT_TREE_MAX_COUNT = 1
+    BCAST_FLAT_TREE_MAX_RANKS = 2
+    REDUCE_FLAT_TREE_MAX_RANKS = 3
+    REDUCE_FLAT_TREE_MAX_COUNT = 4
+    ALLREDUCE_ALGORITHM = 5
+    RING_SEGMENTS = 6
+
+
+class AllreduceAlgorithm(enum.IntEnum):
+    """Values for TuningKey.ALLREDUCE_ALGORITHM on the device tier."""
+
+    XLA = 0          # let XLA's collective scheduler pick
+    RING = 1         # explicit segmented ppermute ring pipeline
+    PALLAS_RING = 2  # the Pallas remote-DMA ring kernel
+
+
+#: TuningKey -> engine tuning-table name (the emulator/native engines index
+#: their registers by these names; see TUNING_DEFAULTS below)
+TUNING_KEY_NAMES = {
+    TuningKey.GATHER_FLAT_TREE_MAX_FANIN: "gather_flat_tree_max_fanin",
+    TuningKey.GATHER_FLAT_TREE_MAX_COUNT: "gather_flat_tree_max_count",
+    TuningKey.BCAST_FLAT_TREE_MAX_RANKS: "bcast_flat_tree_max_ranks",
+    TuningKey.REDUCE_FLAT_TREE_MAX_RANKS: "reduce_flat_tree_max_ranks",
+    TuningKey.REDUCE_FLAT_TREE_MAX_COUNT: "reduce_flat_tree_max_count",
+    TuningKey.ALLREDUCE_ALGORITHM: "allreduce_algorithm",
+    TuningKey.RING_SEGMENTS: "ring_segments",
+}
 
 
 class ReduceFunction(enum.IntEnum):

@@ -78,9 +78,14 @@ class ACCL:
         self._config(ConfigFunction.ENABLE_TRANSPORT, 1)
         self._initialized = True
 
-    def _config(self, fn: ConfigFunction, value: float) -> None:
+    def _config(self, fn: ConfigFunction, value: float, key: int = 0) -> None:
         req = self.engine.start(
-            CallOptions(op=Operation.CONFIG, cfg_function=int(fn), cfg_value=value)
+            CallOptions(
+                op=Operation.CONFIG,
+                cfg_function=int(fn),
+                cfg_value=value,
+                cfg_key=int(key),
+            )
         )
         req.wait()
         req.check(f"config {fn.name}")
@@ -108,6 +113,25 @@ class ACCL:
 
     def set_max_rendezvous_size(self, nbytes: int) -> None:
         self._config(ConfigFunction.SET_MAX_RENDEZVOUS_SIZE, nbytes)
+
+    def set_tuning(self, key, value) -> None:
+        """Write a runtime tuning register (ref configure_tuning_parameters,
+        accl.cpp:1198-1208): flat-vs-tree thresholds on the engine tiers,
+        allreduce algorithm / ring segmentation on the device tier.
+
+        ``key``: a :class:`TuningKey`, its name, or its int value.
+        ``value``: a number, or an algorithm name ("xla" / "ring" /
+        "pallas_ring") for ``ALLREDUCE_ALGORITHM``.
+        """
+        from .constants import AllreduceAlgorithm, TuningKey
+
+        if isinstance(key, str):
+            key = TuningKey[key.upper()]
+        else:
+            key = TuningKey(key)
+        if isinstance(value, str):
+            value = AllreduceAlgorithm[value.upper()]
+        self._config(ConfigFunction.SET_TUNING, float(value), key=int(key))
 
     # -- buffer factories (ref ACCL::create_buffer family) -------------------
     def create_buffer(
@@ -237,6 +261,82 @@ class ACCL:
             res=dstbuf,
         )
         return self._launch(opts, run_async, "copy")
+
+    def copy_from_stream(
+        self,
+        dstbuf: BaseBuffer,
+        count: Optional[int] = None,
+        stream_id: int = 0,
+        run_async: bool = False,
+    ):
+        """Pull ``count`` elements from the local device stream port into a
+        buffer (ref ``copy_from_stream``, accl.hpp:317-333)."""
+        n = self._count_of(dstbuf, count)
+        cfg, flags = self._resolve_arithcfg(dstbuf.dtype, None)
+        opts = CallOptions(
+            op=Operation.COPY,
+            comm=self._world,
+            count=n,
+            arithcfg=cfg,
+            compression=flags,
+            stream=StreamFlags.OP0_STREAM,
+            stream_id=stream_id,
+            host=self._host_flags(None, None, dstbuf),
+            op0=DummyBuffer(n, dstbuf.dtype),
+            res=dstbuf,
+        )
+        return self._launch(opts, run_async, "copy_from_stream")
+
+    def copy_to_stream(
+        self,
+        srcbuf: BaseBuffer,
+        count: Optional[int] = None,
+        stream_id: int = 0,
+        run_async: bool = False,
+    ):
+        """Push a buffer into the local device stream port (ref
+        ``copy_to_stream``, accl.hpp:334-348)."""
+        n = self._count_of(srcbuf, count)
+        cfg, flags = self._resolve_arithcfg(srcbuf.dtype, None)
+        opts = CallOptions(
+            op=Operation.COPY,
+            comm=self._world,
+            count=n,
+            arithcfg=cfg,
+            compression=flags,
+            stream=StreamFlags.RES_STREAM,
+            stream_id=stream_id,
+            host=self._host_flags(srcbuf),
+            op0=srcbuf,
+            res=DummyBuffer(n, srcbuf.dtype),
+        )
+        return self._launch(opts, run_async, "copy_to_stream")
+
+    def copy_from_to_stream(
+        self,
+        dtype: DTypeLike,
+        count: int,
+        stream_id: int = 0,
+        run_async: bool = False,
+    ):
+        """Relay ``count`` elements through the engine from the stream port
+        back to the stream port (ref ``copy_from_to_stream``,
+        accl.hpp:349-363) — the loopback-kernel data path."""
+        dt = _as_datatype(dtype)
+        n = int(count)
+        cfg, flags = self._resolve_arithcfg(dt, None)
+        opts = CallOptions(
+            op=Operation.COPY,
+            comm=self._world,
+            count=n,
+            arithcfg=cfg,
+            compression=flags,
+            stream=StreamFlags.OP0_STREAM | StreamFlags.RES_STREAM,
+            stream_id=stream_id,
+            op0=DummyBuffer(n, dt),
+            res=DummyBuffer(n, dt),
+        )
+        return self._launch(opts, run_async, "copy_from_to_stream")
 
     def combine(
         self,
@@ -468,19 +568,55 @@ class ACCL:
 
     def reduce(
         self,
-        sendbuf: BaseBuffer,
+        sendbuf: Optional[BaseBuffer],
         recvbuf: Optional[BaseBuffer],
         count: Optional[int] = None,
         root: int = 0,
         function: ReduceFunction = ReduceFunction.SUM,
         comm: Optional[Communicator] = None,
         compress_dtype: Optional[DTypeLike] = None,
+        from_stream: bool = False,
+        to_stream: bool = False,
+        stream_id: int = 0,
+        dtype: Optional[DTypeLike] = None,
         run_async: bool = False,
     ):
+        """Reduce to ``root``.  ``from_stream`` pulls this rank's operand
+        from its device stream port (``sendbuf=None``); ``to_stream``
+        delivers the root's result to its stream port (``recvbuf=None``) —
+        the reference's four reduce overloads incl. stream operands
+        (accl.hpp:514-590)."""
         comm = comm or self._world
         self._check_rank(comm, root)
-        n = self._count_of(sendbuf, count)
-        cfg, flags = self._resolve_arithcfg(sendbuf.dtype, compress_dtype)
+        if sendbuf is not None:
+            op_dtype = sendbuf.dtype
+            n = self._count_of(sendbuf, count)
+        else:
+            if not from_stream:
+                raise ACCLError(
+                    ErrorCode.INVALID_OPERATION,
+                    "reduce needs sendbuf unless from_stream",
+                )
+            op_dtype = (
+                _as_datatype(dtype)
+                if dtype is not None
+                else (recvbuf.dtype if recvbuf is not None else DataType.FLOAT32)
+            )
+            if count is None and recvbuf is not None:
+                n = self._count_of(recvbuf, count)
+            elif count is None:
+                raise ACCLError(
+                    ErrorCode.INVALID_COUNT,
+                    "stream reduce needs an explicit count without recvbuf",
+                )
+            else:
+                n = int(count)
+        cfg, flags = self._resolve_arithcfg(op_dtype, compress_dtype)
+        stream = StreamFlags.NO_STREAM
+        if from_stream:
+            stream |= StreamFlags.OP0_STREAM
+        if to_stream:
+            stream |= StreamFlags.RES_STREAM
         opts = CallOptions(
             op=Operation.REDUCE,
             comm=comm,
@@ -489,9 +625,11 @@ class ACCL:
             reduce_function=function,
             arithcfg=cfg,
             compression=flags,
+            stream=stream,
+            stream_id=stream_id,
             host=self._host_flags(sendbuf, None, recvbuf),
-            op0=sendbuf,
-            res=recvbuf if recvbuf is not None else DummyBuffer(0, sendbuf.dtype),
+            op0=sendbuf if sendbuf is not None else DummyBuffer(n, op_dtype),
+            res=recvbuf if recvbuf is not None else DummyBuffer(0, op_dtype),
         )
         return self._launch(opts, run_async, "reduce")
 

@@ -439,6 +439,36 @@ uint32_t Engine::apply_config(const CallArgs& args) {
       if (v <= 0) return E_CONFIG_ERROR;
       max_rndzv_ = (uint64_t)v;
       return E_OK;
+    case CFG_SET_TUNING: {
+      // runtime tuning registers (ref ccl_offload_control.h:86-90,
+      // host writes at accl.cpp:1198-1208)
+      if (v < 0) return E_CONFIG_ERROR;
+      switch (args.cfg_key) {
+        case 0:  // gather flat-tree max fan-in
+          if (v < 1) return E_CONFIG_ERROR;
+          tune_gather_fanin_ = (int)v;
+          return E_OK;
+        case 1:
+          tune_gather_flat_count_ = (uint64_t)v;
+          return E_OK;
+        case 2:
+          tune_bcast_flat_ranks_ = (int)v;
+          return E_OK;
+        case 3:
+          tune_reduce_flat_ranks_ = (int)v;
+          return E_OK;
+        case 4:
+          tune_reduce_flat_count_ = (uint64_t)v;
+          return E_OK;
+        case 5:  // ALLREDUCE_ALGORITHM: device-tier register, validated
+                 // for config parity (values 0..2), unused here
+          return (v <= 2.0) ? E_OK : E_CONFIG_ERROR;
+        case 6:  // RING_SEGMENTS: device-tier register, >= 1
+          return (v >= 1.0) ? E_OK : E_CONFIG_ERROR;
+        default:
+          return E_CONFIG_ERROR;
+      }
+    }
     default:
       return E_CONFIG_ERROR;
   }

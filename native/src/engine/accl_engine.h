@@ -63,6 +63,7 @@ enum CfgFunc : int32_t {
   CFG_SET_TIMEOUT = 2,
   CFG_SET_MAX_EAGER_SIZE = 3,
   CFG_SET_MAX_RENDEZVOUS_SIZE = 4,
+  CFG_SET_TUNING = 5,
 };
 
 enum DType : int32_t {
@@ -166,7 +167,7 @@ struct CallArgs {
   int32_t op0_dtype = DT_NONE;
   int32_t op1_dtype = DT_NONE;
   int32_t res_dtype = DT_NONE;
-  int32_t pad_ = 0;
+  int32_t cfg_key = 0;  // tuning register selector for CFG_SET_TUNING
 };
 #pragma pack(pop)
 

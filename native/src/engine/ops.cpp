@@ -640,11 +640,16 @@ uint32_t op_reduce(CallCtx& x) {
   int root = x.c.root_dst, r = x.rank(), size = x.size();
   size_t n = (size_t)x.c.count;
   int32_t acc_dt = x.c.acc_dtype;
+  // operand via the stream-capable reader: reduce accepts a streaming
+  // operand like the reference's stream reduce overloads (accl.hpp:514-590)
+  std::vector<uint8_t> owned;
+  const uint8_t* op0 = nullptr;
+  int32_t op0_dt = 0;
+  uint32_t rc0 = x.read_op0(owned, &op0, &op0_dt);
+  if (rc0 != E_OK) return rc0;
   if (size == 1) {
-    if (x.c.op0 == nullptr) return E_INVALID_OPERATION;
-    return x.write_res((const uint8_t*)x.c.op0, x.c.op0_dtype, n);
+    return x.write_res(op0, op0_dt, n);
   }
-  if (x.c.op0 == nullptr) return E_INVALID_OPERATION;
   size_t nbytes = n * dtype_size(acc_dt);
   bool rndzv = x.use_rendezvous(nbytes);
   bool flat = size <= x.e.tune_reduce_flat_ranks_.load() ||
@@ -653,7 +658,7 @@ uint32_t op_reduce(CallCtx& x) {
     // flat tree: root accumulates everyone into spares
     if (r == root) {
       std::vector<uint8_t> acc(n * dtype_size(acc_dt));
-      convert(x.c.op0, x.c.op0_dtype, acc.data(), acc_dt, n);
+      convert(op0, op0_dt, acc.data(), acc_dt, n);
       for (int p = 0; p < size; ++p) {
         if (p == root) continue;
         uint32_t rc = x.recv_reduce_chunk(p, x.c.tag, acc.data(), acc_dt, n);
@@ -661,14 +666,13 @@ uint32_t op_reduce(CallCtx& x) {
       }
       return x.write_res(acc.data(), acc_dt, n);
     }
-    return x.send_chunk(root, x.c.tag, (const uint8_t*)x.c.op0, x.c.op0_dtype,
-                        n);
+    return x.send_chunk(root, x.c.tag, op0, op0_dt, n);
   }
   if (rndzv) {
     // binomial reduction tree on root-relative ranks (c:1603-1728)
     int rel = ((r - root) % size + size) % size;
     std::vector<uint8_t> acc(n * dtype_size(acc_dt));
-    convert(x.c.op0, x.c.op0_dtype, acc.data(), acc_dt, n);
+    convert(op0, op0_dt, acc.data(), acc_dt, n);
     int k = 0;
     while ((1 << k) < size) {
       if (rel & (1 << k)) {
@@ -693,7 +697,7 @@ uint32_t op_reduce(CallCtx& x) {
   // fused recv-reduce-send at every hop (c:1730-1743)
   int rel = ((r - root) % size + size) % size;
   std::vector<uint8_t> acc(n * dtype_size(acc_dt));
-  convert(x.c.op0, x.c.op0_dtype, acc.data(), acc_dt, n);
+  convert(op0, op0_dt, acc.data(), acc_dt, n);
   if (rel == size - 1) {
     uint32_t rc =
         x.send_chunk((r - 1 + size) % size, x.c.tag, acc.data(), acc_dt, n);
