@@ -1047,6 +1047,9 @@ class XLAEngine(BaseEngine):
                 return ErrorCode.CONFIG_ERROR
             self.gang.tuning["ring_segments"] = int(val)
         else:
+            # same per-key validation as the emulator/native tiers
+            if key == TuningKey.GATHER_FLAT_TREE_MAX_FANIN and val < 1:
+                return ErrorCode.CONFIG_ERROR
             self.gang.tuning[TUNING_KEY_NAMES[key]] = int(val)
         return ErrorCode.OK
 

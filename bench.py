@@ -1,34 +1,83 @@
 """Benchmark on real hardware: prints ONE JSON line.
 
-Headline metric (BASELINE.md): allreduce bus bandwidth.  With >= 2 chips,
-runs the ring-allreduce sweep and reports peak bus bandwidth
-(2*(P-1)/P * bytes / t) against the reference's 100 GbE wire rate
-(12.5 GB/s).  On a single chip (no ICI path to exercise), reports the
-collective engine's datapath throughput — a large fused ``combine``
-(elementwise SUM, the reduce_ops role) — against the reference CCLO's
-internal datapath envelope of 16 GB/s (64 B/cycle @ 250 MHz,
+Headline metric (BASELINE.md): allreduce bus bandwidth with >= 2 chips
+(2*(P-1)/P * bytes / t vs the reference's 100 GbE wire rate of
+12.5 GB/s); on a single chip, the collective engine's datapath
+throughput — a large fused ``combine`` (the reduce_ops role) — against
+the reference CCLO's internal envelope of 16 GB/s (64 B/cycle @ 250 MHz,
 ccl_offload_control.h:34).
+
+Beyond the headline, the JSON carries an ``extras`` map with the
+per-kernel single-chip numbers (XLA vs Pallas combine, the Pallas
+compression lanes, flagship train-step MFU) and an ``errors`` map:
+kernel compile/run failures are REPORTED, never swallowed (ref
+bench.cpp:25-61 records every op it sweeps).
 """
 
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
 
 import numpy as np
 
+# ACCL_BENCH_SMALL=1 shrinks workloads ~1000x so the full bench harness can
+# be smoke-tested on CPU/CI; numbers are then meaningless but every code
+# path (incl. error reporting) runs.
+_SMALL = bool(int(os.environ.get("ACCL_BENCH_SMALL", "0")))
 
-def _combine_slope_bench(combine_fn) -> dict:
-    """Slope-timed combine datapath bench: a device-side fori_loop
-    amortizes dispatch; the K2-K1 slope cancels the host<->device
-    roundtrip so only on-chip time per combine remains.  ``combine_fn``
-    is the (acc, b) -> acc implementation under test."""
+
+def _size(n: int) -> int:
+    return max(n // 1024, 1024) if _SMALL else n
+
+# bf16 dense peak FLOP/s per chip, by device_kind substring (most specific
+# first).  Sources: published TPU specs; used only to turn achieved FLOP/s
+# into an MFU fraction.
+_PEAK_FLOPS = [
+    ("v6e", 918e12),
+    ("v6 lite", 918e12),
+    ("v5p", 459e12),
+    ("v5 lite", 197e12),
+    ("v5e", 197e12),
+    ("v5", 459e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 46e12),
+]
+
+
+def _peak_flops(device_kind: str):
+    kind = device_kind.lower()
+    for key, peak in _PEAK_FLOPS:
+        if key in kind:
+            return peak
+    return None
+
+
+
+def _slope_time(timed, k1: int, k2: int) -> float:
+    """Seconds per iteration from the (k2-k1) slope: warm both loop
+    lengths (compile), take min-of-3 for each, difference cancels the
+    host<->device dispatch overhead."""
+    for k in (k1, k2):
+        timed(k)
+    t1 = min(timed(k1) for _ in range(3))
+    t2 = min(timed(k2) for _ in range(3))
+    return max((t2 - t1) / (k2 - k1), 1e-9)
+
+
+def _combine_slope_bench(combine_fn) -> float:
+    """Slope-timed combine datapath GB/s: a device-side fori_loop amortizes
+    dispatch; the K2-K1 slope cancels the host<->device roundtrip so only
+    on-chip time per combine remains."""
     import jax
     import jax.numpy as jnp
     from jax import lax
     from functools import partial
 
-    n = 64 * 1024 * 1024  # 256 MB per operand, fp32
+    n = _size(64 * 1024 * 1024)  # 256 MB per operand, fp32
     a = jnp.ones((n,), jnp.float32)
     b = jnp.full((n,), 1.0, jnp.float32)
 
@@ -42,29 +91,162 @@ def _combine_slope_bench(combine_fn) -> dict:
         float(out[0])  # forced readback: completion barrier
         return time.perf_counter() - t0
 
-    k1, k2 = 10, 110
-    for k in (k1, k2):
-        timed(k)  # compile + warm both loop lengths
-    t1 = min(timed(k1) for _ in range(3))
-    t2 = min(timed(k2) for _ in range(3))
-    per_iter = max((t2 - t1) / (k2 - k1), 1e-9)
+    per_iter = _slope_time(timed, *((2, 6) if _SMALL else (10, 110)))
     moved = 3 * n * 4  # two reads + one write per combine
-    gbps = moved / per_iter / 1e9
-    return {
-        "metric": "combine_datapath_bandwidth",
-        "value": round(gbps, 2),
-        "unit": "GB/s",
-        "vs_baseline": round(gbps / 16.0, 2),  # CCLO internal datapath
-    }
+    return moved / per_iter / 1e9
 
 
-def _bench_combine() -> dict:
+def _bench_combine_xla() -> float:
     return _combine_slope_bench(lambda acc, b: acc + b)
 
 
-def _bench_ring_allreduce(ndev: int) -> dict:
-    """K-iteration device-side loop of psum over the mesh; slope timing as in
-    the combine bench so tunnel dispatch cancels out."""
+def _bench_combine_pallas() -> float:
+    """Same slope harness, the combine being the Pallas reduce_ops kernel
+    — the hand-written dataplane vs XLA's fusion on the identical op."""
+    from accl_tpu.ops.pallas import combine as pallas_combine
+
+    return _combine_slope_bench(lambda acc, b: pallas_combine(acc, b))
+
+
+def _bench_cast_pallas(stochastic: bool = False) -> float:
+    """Compression-lane bandwidth: the Pallas cast kernel (f32<->bf16, the
+    hp_compression role).  Each loop iteration is a down-cast + up-cast
+    round trip (12 bytes moved per element); slope timing as above."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from functools import partial
+
+    from accl_tpu.ops.pallas import cast
+
+    n = _size(32 * 1024 * 1024)  # 128 MB fp32
+    x = jnp.ones((n,), jnp.float32)
+
+    def body(i, acc):
+        y = cast(acc, jnp.bfloat16, stochastic=stochastic, seed=7)
+        return cast(y, jnp.float32)
+
+    @partial(jax.jit, static_argnums=1)
+    def loop(x, k):
+        return lax.fori_loop(0, k, body, x)
+
+    def timed(k):
+        t0 = time.perf_counter()
+        out = loop(x, k)
+        float(out[0])
+        return time.perf_counter() - t0
+
+    per_iter = _slope_time(timed, *((2, 6) if _SMALL else (4, 24)))
+    moved = n * (4 + 2) + n * (2 + 4)  # down + up round trip
+    return moved / per_iter / 1e9
+
+
+def _bench_quant_int8_pallas() -> float:
+    """int8 wire-quantization lane (quantize + dequantize round trip)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from functools import partial
+
+    from accl_tpu.ops.pallas import dequantize_int8, quantize_int8
+
+    n = _size(32 * 1024 * 1024)
+    x = jnp.linspace(-3.0, 3.0, n, dtype=jnp.float32)
+
+    def body(i, acc):
+        v, s, cnt = quantize_int8(acc)
+        return dequantize_int8(v, s, cnt, acc.shape, acc.dtype)
+
+    @partial(jax.jit, static_argnums=1)
+    def loop(x, k):
+        return lax.fori_loop(0, k, body, x)
+
+    def timed(k):
+        t0 = time.perf_counter()
+        out = loop(x, k)
+        float(out[0])
+        return time.perf_counter() - t0
+
+    per_iter = _slope_time(timed, *((2, 6) if _SMALL else (4, 24)))
+    moved = n * (4 + 1) + n * (1 + 4)  # quantize + dequantize
+    return moved / per_iter / 1e9
+
+
+def _bench_train_mfu(small: bool = False) -> dict:
+    """Flagship train-step MFU on the local devices: one dp x tp=1 sharded
+    SGD step on the bf16 transformer; FLOPs from XLA's own cost analysis
+    of the compiled step."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from accl_tpu.models import (
+        TransformerConfig,
+        init_params,
+        make_sharded_train_step,
+    )
+
+    ndev = len(jax.devices())
+    if small:  # CPU smoke-test path
+        cfg = TransformerConfig(
+            vocab=256, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+            max_seq=64, dtype=jnp.float32,
+        )
+        batch, seq = 2 * ndev, 64
+    else:
+        cfg = TransformerConfig(
+            vocab=32768, d_model=1024, n_heads=16, n_layers=8, d_ff=4096,
+            max_seq=1024, dtype=jnp.bfloat16,
+        )
+        batch, seq = 8 * ndev, 1024
+    mesh = Mesh(np.array(jax.devices()).reshape(ndev, 1), ("dp", "tp"))
+    step, shard = make_sharded_train_step(cfg, mesh, lr=0.01)
+    params = shard(init_params(jax.random.PRNGKey(0), cfg))
+    tokens = jnp.zeros((batch, seq), jnp.int32)
+    targets = jnp.ones((batch, seq), jnp.int32)
+
+    lowered = step.lower(params, tokens, targets)
+    compiled = lowered.compile()
+    # per-DEVICE FLOPs per step: compiled.cost_analysis() reports the
+    # post-SPMD per-device module, so MFU divides by ONE chip's peak (the
+    # analytic fallback computes global FLOPs and is divided by ndev to
+    # stay consistent)
+    flops_per_dev = None
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, list):  # older jax returns [dict]
+            cost = cost[0]
+        flops_per_dev = float(cost.get("flops", 0.0)) or None
+    except Exception:
+        flops_per_dev = None
+    if flops_per_dev is None:
+        # analytic fallback: 6 * params * tokens (fwd+bwd dense), global
+        n_params = sum(
+            int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(params)
+        )
+        flops_per_dev = 6.0 * n_params * batch * seq / ndev
+
+    params, loss = step(params, tokens, targets)  # warm (reuses compile)
+    float(loss)
+    iters = 3 if small else 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, loss = step(params, tokens, targets)
+    float(loss)
+    dt = (time.perf_counter() - t0) / iters
+
+    achieved_per_dev = flops_per_dev / dt
+    out = {"train_tflops": round(achieved_per_dev * ndev / 1e12, 2)}
+    peak = _peak_flops(jax.devices()[0].device_kind)
+    if peak is not None:
+        out["train_mfu"] = round(achieved_per_dev / peak, 4)
+    return out
+
+
+def _bench_ring_allreduce(ndev: int, algo: str = "xla") -> float:
+    """Bus bandwidth of a K-iteration device-side allreduce loop over the
+    mesh; slope timing so dispatch cancels out.  ``algo`` picks the XLA
+    psum or the explicit ring pipeline."""
     from functools import partial
 
     import jax
@@ -79,16 +261,22 @@ def _bench_ring_allreduce(ndev: int) -> dict:
 
     from accl_tpu.ops import make_mesh
     from accl_tpu.ops.driver import AXIS
+    from accl_tpu.ops import ring as ring_ops
 
     mesh = make_mesh(ndev)
-    n = 16 * 1024 * 1024  # 64 MB per rank fp32
+    n = _size(16 * 1024 * 1024)  # 64 MB per rank fp32
     stacked = jnp.ones((ndev, n), jnp.float32)
 
     @partial(jax.jit, static_argnums=1)
     def loop(x, k):
         def body(x):
             def it(i, acc):
-                return lax.psum(acc, AXIS) / ndev  # keep magnitude bounded
+                if algo == "ring":
+                    red = ring_ops.ring_allreduce(acc, AXIS, num_segments=4)
+                else:
+                    red = lax.psum(acc, AXIS)
+                return red / ndev  # keep magnitude bounded
+
             return lax.fori_loop(0, k, it, x[0])[None]
 
         return shard_map(
@@ -99,51 +287,95 @@ def _bench_ring_allreduce(ndev: int) -> dict:
     def timed(k):
         t0 = time.perf_counter()
         out = loop(stacked, k)
-        float(out[0, 0])  # forced readback: completion barrier
+        float(out[0, 0])
         return time.perf_counter() - t0
 
-    k1, k2 = 5, 25
-    for k in (k1, k2):
-        timed(k)
-    t1 = min(timed(k1) for _ in range(3))
-    t2 = min(timed(k2) for _ in range(3))
-    per_iter = max((t2 - t1) / (k2 - k1), 1e-9)
+    per_iter = _slope_time(timed, *((2, 6) if _SMALL else (5, 25)))
     bytes_per_rank = n * 4
-    bus = 2 * (ndev - 1) / ndev * bytes_per_rank / per_iter / 1e9
-    return {
-        "metric": "allreduce_bus_bandwidth",
-        "value": round(bus, 2),
-        "unit": "GB/s",
-        "vs_baseline": round(bus / 12.5, 2),  # 100 GbE wire rate
-    }
+    return 2 * (ndev - 1) / ndev * bytes_per_rank / per_iter / 1e9
 
 
-def _bench_combine_pallas() -> dict:
-    """Same slope harness, but the combine is the Pallas reduce_ops kernel
-    (ops.pallas.combine) — the hand-written dataplane vs XLA's fusion on
-    the identical op."""
-    from accl_tpu.ops.pallas import combine as pallas_combine
-
-    return _combine_slope_bench(lambda acc, b: pallas_combine(acc, b))
+def _try(extras: dict, errors: dict, key: str, fn):
+    """Run one bench; record its number or its failure — never silent."""
+    try:
+        val = fn()
+        if isinstance(val, dict):
+            extras.update(val)
+        else:
+            extras[key] = round(val, 2)
+        return val
+    except Exception as e:  # noqa: BLE001 - reported, not swallowed
+        msg = f"{type(e).__name__}: {e}"
+        errors[key] = msg[:400]
+        print(f"bench {key} FAILED: {msg}", file=sys.stderr)
+        return None
 
 
 def main() -> None:
     import jax
 
+    # honor an explicit platform request via config as well as env: some
+    # site PJRT hooks only respect the config path
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms:
+        jax.config.update("jax_platforms", platforms)
+
     ndev = len(jax.devices())
+    on_tpu = jax.default_backend() == "tpu"
+    extras: dict = {}
+    errors: dict = {}
+
     if ndev >= 2:
-        result = _bench_ring_allreduce(ndev)
+        bus = _bench_ring_allreduce(ndev)
+        result = {
+            "metric": "allreduce_bus_bandwidth",
+            "value": round(bus, 2),
+            "unit": "GB/s",
+            "vs_baseline": round(bus / 12.5, 2),  # 100 GbE wire rate
+        }
+        extras["allreduce_xla"] = round(bus, 2)
+        _try(
+            extras, errors, "allreduce_ring",
+            lambda: _bench_ring_allreduce(ndev, algo="ring"),
+        )
     else:
-        result = _bench_combine()
-        if jax.default_backend() == "tpu":
-            # race the hand-written Pallas dataplane against XLA's fusion
-            # and report the faster path (reference envelope is the same)
-            try:
-                alt = _bench_combine_pallas()
-                if alt["value"] > result["value"]:
-                    result = dict(alt, impl="pallas")
-            except Exception:
-                pass  # keep the XLA number; kernels validated in tests
+        xla_gbps = _bench_combine_xla()
+        result = {
+            "metric": "combine_datapath_bandwidth",
+            "value": round(xla_gbps, 2),
+            "unit": "GB/s",
+            "vs_baseline": round(xla_gbps / 16.0, 2),  # CCLO datapath
+        }
+        extras["combine_xla"] = round(xla_gbps, 2)
+        pallas_gbps = _try(
+            extras, errors, "combine_pallas", _bench_combine_pallas
+        )
+        if pallas_gbps is not None and pallas_gbps > xla_gbps:
+            result.update(
+                value=round(pallas_gbps, 2),
+                vs_baseline=round(pallas_gbps / 16.0, 2),
+                impl="pallas",
+            )
+
+    # per-kernel compression lanes (single-chip ops; Mosaic compilation on
+    # TPU, interpreter elsewhere — failures surface in `errors`)
+    _try(extras, errors, "cast_pallas", _bench_cast_pallas)
+    _try(
+        extras, errors, "cast_stochastic_pallas",
+        lambda: _bench_cast_pallas(stochastic=True),
+    )
+    _try(extras, errors, "quant_int8_pallas", _bench_quant_int8_pallas)
+
+    # flagship train-step MFU (small shapes off-TPU so CI smoke runs fast)
+    _try(
+        extras, errors, "train_mfu",
+        lambda: _bench_train_mfu(small=_SMALL or not on_tpu),
+    )
+
+    result["device"] = jax.devices()[0].device_kind
+    result["extras"] = extras
+    if errors:
+        result["errors"] = errors
     print(json.dumps(result))
 
 

@@ -9,10 +9,10 @@ between buffer creation and ``sync_from_device`` — enforced with
 explicit host<->device copy on the guarded thread.
 """
 
-import threading
-
 import numpy as np
 import pytest
+
+from helpers import run_parallel
 
 import jax
 
@@ -21,29 +21,6 @@ from accl_tpu.constants import DataType, ReduceFunction
 from accl_tpu.core import xla_group
 
 
-def _run_ranks(group, fn):
-    """Drive fn(accl, rank) on one thread per rank; re-raise any failure."""
-    errs = []
-
-    def work(a, r):
-        try:
-            fn(a, r)
-        except Exception as e:  # pragma: no cover - failure reporting
-            import traceback
-
-            traceback.print_exc()
-            errs.append((r, e))
-
-    ts = [
-        threading.Thread(target=work, args=(a, r))
-        for r, a in enumerate(group)
-    ]
-    for t in ts:
-        t.start()
-    for t in ts:
-        t.join(60)
-    assert not any(t.is_alive() for t in ts), "rank thread hung"
-    assert not errs, errs
 
 
 @pytest.fixture(scope="module")
@@ -136,7 +113,7 @@ def test_allreduce_zero_host_copy(dgroup4):
         with jax.transfer_guard("disallow"):
             a.allreduce(send[r], recv[r], n)
 
-    _run_ranks(dgroup4, work)
+    run_parallel(dgroup4, work)
     for r in range(4):
         recv[r].sync_from_device()
         np.testing.assert_allclose(recv[r].data, 10.0)
@@ -167,7 +144,7 @@ def test_all_collectives_zero_host_copy(dgroup4):
             a.scatter(sb[r] if r == 0 else None, rb_small[r], n, root=0)
             a.barrier()
 
-    _run_ranks(dgroup4, work)
+    run_parallel(dgroup4, work)
     # spot-check the last op (scatter from root 0)
     for r in range(4):
         rb_small[r].sync_from_device()
@@ -189,7 +166,7 @@ def test_bcast_in_place_donation(dgroup4):
         with jax.transfer_guard("disallow"):
             a.bcast(bufs[r], n, root=2)
 
-    _run_ranks(dgroup4, work)
+    run_parallel(dgroup4, work)
     for r in range(4):
         bufs[r].sync_from_device()
         np.testing.assert_allclose(bufs[r].data, 200.0)
@@ -200,7 +177,7 @@ def test_bcast_in_place_donation(dgroup4):
         with jax.transfer_guard("disallow"):
             a.allreduce(bufs[r], out[r], n)
 
-    _run_ranks(dgroup4, work2)
+    run_parallel(dgroup4, work2)
     out[0].sync_from_device()
     np.testing.assert_allclose(out[0].data, 800.0)
 
@@ -223,7 +200,7 @@ def test_subcommunicator_device_path(dgroup4):
         with jax.transfer_guard("disallow"):
             a.allreduce(send[r], recv[r], n, comm=comm)
 
-    _run_ranks(dgroup4, work)
+    run_parallel(dgroup4, work)
     for r in (1, 3):
         recv[r].sync_from_device()
         np.testing.assert_allclose(recv[r].data, 4.0)
@@ -242,7 +219,7 @@ def test_compressed_allreduce_device_path(dgroup4):
         with jax.transfer_guard("disallow"):
             a.allreduce(send[r], recv[r], n, compress_dtype=np.float16)
 
-    _run_ranks(dgroup4, work)
+    run_parallel(dgroup4, work)
     for r in range(4):
         recv[r].sync_from_device()
         np.testing.assert_allclose(recv[r].data, 10.0, rtol=1e-2)
@@ -320,7 +297,7 @@ def test_p2p_sendrecv_device_fabric(dgroup4):
             elif r == 3:
                 a.recv(dst, n, src=0, tag=7)
 
-    _run_ranks(dgroup4, work)
+    run_parallel(dgroup4, work)
     dst.sync_from_device()
     np.testing.assert_array_equal(dst.data, np.arange(n) * 2.0)
 
@@ -341,7 +318,7 @@ def test_p2p_compressed_device_fabric(dgroup4):
             elif r == 2:
                 a.recv(dst, n, src=1, tag=9, compress_dtype=np.float16)
 
-    _run_ranks(dgroup4, work)
+    run_parallel(dgroup4, work)
     dst.sync_from_device()
     np.testing.assert_allclose(
         dst.data, np.linspace(0, 1, n).astype(np.float16), rtol=1e-3
@@ -375,7 +352,7 @@ def test_p2p_device_to_host_buffer(dgroup4):
         elif r == 1:
             a.recv(dst, n, src=0, tag=13)
 
-    _run_ranks(dgroup4, work)
+    run_parallel(dgroup4, work)
     dst.sync_from_device()
     np.testing.assert_allclose(dst.data, 4.0)
 
@@ -416,7 +393,7 @@ def test_mixed_host_operand_falls_back(dgroup4):
     def work(a, r):
         a.allreduce(send[r], recv[r], n)
 
-    _run_ranks(dgroup4, work)
+    run_parallel(dgroup4, work)
     for r in range(4):
         recv[r].sync_from_device()
         np.testing.assert_allclose(recv[r].data, 10.0)

@@ -8,36 +8,14 @@ stream ports stand in for the device-kernel AXIS interface: data a device
 kernel pushed (or will pop) without tag matching.
 """
 
-import threading
-
 import numpy as np
 import pytest
+
+from helpers import run_parallel
 
 from accl_tpu.constants import ReduceFunction
 
 
-def _all_ranks(group, fn):
-    errs = []
-
-    def work(a, r):
-        try:
-            fn(a, r)
-        except Exception as e:  # pragma: no cover
-            import traceback
-
-            traceback.print_exc()
-            errs.append((r, e))
-
-    ts = [
-        threading.Thread(target=work, args=(a, r))
-        for r, a in enumerate(group)
-    ]
-    for t in ts:
-        t.start()
-    for t in ts:
-        t.join(60)
-    assert not any(t.is_alive() for t in ts), "rank thread hung"
-    assert not errs, errs
 
 
 def test_copy_from_stream(group2, rng):
@@ -88,7 +66,7 @@ def test_reduce_from_stream(group4, rng):
             dtype=np.float32,
         )
 
-    _all_ranks(group4, work)
+    run_parallel(group4, work)
     rb.sync_from_device()
     np.testing.assert_allclose(
         rb.host_view(), np.sum(rows, axis=0), rtol=1e-4, atol=1e-5
@@ -104,7 +82,7 @@ def test_reduce_to_stream(group4, rng):
     def work(a, r):
         a.reduce(sb[r], None, n, root=1, to_stream=True, stream_id=2)
 
-    _all_ranks(group4, work)
+    run_parallel(group4, work)
     out = group4[1].stream_pop(n, np.float32, stream_id=2)
     np.testing.assert_allclose(
         out, np.sum(rows, axis=0), rtol=1e-4, atol=1e-5
@@ -125,7 +103,7 @@ def test_reduce_from_and_to_stream(group4, rng):
             dtype=np.float32,
         )
 
-    _all_ranks(group4, work)
+    run_parallel(group4, work)
     out = group4[0].stream_pop(n, np.float32, stream_id=6)
     np.testing.assert_allclose(
         out, np.sum(rows, axis=0), rtol=1e-4, atol=1e-5
@@ -192,7 +170,7 @@ def test_xla_reduce_from_stream(xgroup4s, rng):
             from_stream=True, stream_id=7, dtype=np.float32,
         )
 
-    _all_ranks(xgroup4s, work)
+    run_parallel(xgroup4s, work)
     rb.sync_from_device()
     np.testing.assert_allclose(
         rb.host_view(), np.sum(rows, axis=0), rtol=1e-4, atol=1e-5
@@ -207,7 +185,7 @@ def test_xla_reduce_to_stream(xgroup4s, rng):
     def work(a, r):
         a.reduce(sb[r], None, n, root=3, to_stream=True, stream_id=8)
 
-    _all_ranks(xgroup4s, work)
+    run_parallel(xgroup4s, work)
     out = xgroup4s[3].stream_pop(n, np.float32, stream_id=8)
     np.testing.assert_allclose(
         out, np.sum(rows, axis=0), rtol=1e-4, atol=1e-5

@@ -9,10 +9,10 @@ emulator's tuning table, the native C++ engine's atomics, or the XLA
 gang's algorithm-selection registers.
 """
 
-import threading
-
 import numpy as np
 import pytest
+
+from helpers import run_parallel
 
 from accl_tpu.constants import (
     ACCLError,
@@ -22,25 +22,6 @@ from accl_tpu.constants import (
 )
 
 
-def _all_ranks(group, fn):
-    errs = []
-
-    def work(a, r):
-        try:
-            fn(a, r)
-        except Exception as e:  # pragma: no cover
-            errs.append((r, e))
-
-    ts = [
-        threading.Thread(target=work, args=(a, r))
-        for r, a in enumerate(group)
-    ]
-    for t in ts:
-        t.start()
-    for t in ts:
-        t.join(60)
-    assert not any(t.is_alive() for t in ts), "rank thread hung"
-    assert not errs, errs
 
 
 # ---------------------------------------------------------------------------
@@ -63,7 +44,7 @@ def test_bcast_flat_vs_tree_at_runtime(group4, rng, flat):
     np.copyto(bufs[1].host_view(), data)
     bufs[1].sync_to_device()
 
-    _all_ranks(group4, lambda a, r: a.bcast(bufs[r], n, root=1))
+    run_parallel(group4, lambda a, r: a.bcast(bufs[r], n, root=1))
     for r in range(4):
         bufs[r].sync_from_device()
         np.testing.assert_allclose(bufs[r].host_view(), data, rtol=1e-6)
@@ -85,7 +66,7 @@ def test_reduce_flat_vs_tree_at_runtime(group4, rng, flat):
     sb = [a.create_buffer_from(rows[r]) for r, a in enumerate(group4)]
     rb = [a.create_buffer(n, np.float32) for a in group4]
 
-    _all_ranks(
+    run_parallel(
         group4,
         lambda a, r: a.reduce(sb[r], rb[r] if r == 2 else None, n, root=2),
     )
@@ -111,7 +92,7 @@ def test_gather_fanin_register(group4, rng):
         sb = [a.create_buffer_from(rows[r]) for r, a in enumerate(group4)]
         rb0 = group4[0].create_buffer(4 * n, np.float32)
 
-        _all_ranks(
+        run_parallel(
             group4,
             lambda a, r: a.gather(
                 sb[r], rb0 if r == 0 else None, n, root=0
@@ -168,7 +149,7 @@ def test_xla_allreduce_algorithm_via_facade(algo, rng):
         rows = [rng.standard_normal(n).astype(np.float32) for _ in range(4)]
         sb = [a.create_buffer_from(rows[r]) for r, a in enumerate(g)]
         rb = [a.create_buffer(n, np.float32) for a in g]
-        _all_ranks(g, lambda a, r: a.allreduce(sb[r], rb[r], n))
+        run_parallel(g, lambda a, r: a.allreduce(sb[r], rb[r], n))
         for r in range(4):
             rb[r].sync_from_device()
             np.testing.assert_allclose(
