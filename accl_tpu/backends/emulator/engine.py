@@ -243,6 +243,7 @@ class EmuEngine(BaseEngine):
             self.max_rendezvous_size = int(val)
         elif fn == ConfigFunction.SET_TUNING:
             from ...constants import (
+                ALGORITHM_TUNING_KEYS,
                 AllreduceAlgorithm,
                 TUNING_KEY_NAMES,
                 TuningKey,
@@ -260,10 +261,15 @@ class EmuEngine(BaseEngine):
                 return ErrorCode.CONFIG_ERROR
             if key == TuningKey.RING_SEGMENTS and val < 1:
                 return ErrorCode.CONFIG_ERROR
-            if key == TuningKey.ALLREDUCE_ALGORITHM:
+            if key in ALGORITHM_TUNING_KEYS:
                 try:
-                    AllreduceAlgorithm(int(val))
+                    algo = AllreduceAlgorithm(int(val))
                 except ValueError:
+                    return ErrorCode.CONFIG_ERROR
+                if (
+                    key != TuningKey.ALLREDUCE_ALGORITHM
+                    and algo == AllreduceAlgorithm.RING
+                ):
                     return ErrorCode.CONFIG_ERROR
             # device-tier registers (algorithm select) are accepted and
             # stored but don't affect the emulated firmware algorithms

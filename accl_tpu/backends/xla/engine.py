@@ -426,20 +426,15 @@ class XLAGangContext:
         if op == Operation.ALLREDUCE:
             wire = lead.arithcfg.compressed if compressed else None
             out = self._allreduce(global_arr, mesh, fn, wire)
-        elif op == Operation.REDUCE:
-            out = opdriver.run_reduce(global_arr, mesh, lead.root_dst, fn)
-        elif op == Operation.BCAST:
-            out = opdriver.run_bcast(
-                global_arr, mesh, lead.root_src, donate=True
-            )
+        elif op in (
+            Operation.REDUCE, Operation.BCAST, Operation.SCATTER,
+            Operation.GATHER,
+        ):
+            out = self._run_rooted(op, global_arr, mesh, lead, donate=True)
         elif op == Operation.ALLGATHER:
             out = opdriver.run_allgather(global_arr, mesh)
         elif op == Operation.REDUCE_SCATTER:
             out = opdriver.run_reduce_scatter(global_arr, mesh, fn)
-        elif op == Operation.SCATTER:
-            out = opdriver.run_scatter(global_arr, mesh, lead.root_src)
-        elif op == Operation.GATHER:
-            out = opdriver.run_gather(global_arr, mesh, lead.root_src)
         elif op == Operation.ALLTOALL:
             out = opdriver.run_alltoall(global_arr, mesh)
         else:  # pragma: no cover - guarded by _IN_W
@@ -455,6 +450,40 @@ class XLAGangContext:
                 continue
             res.store(_trim_program(out_w, shard.device)(shard.data), out_w)
         return ErrorCode.OK
+
+    def _run_rooted(self, op, global_arr, mesh, lead, donate=False):
+        """Rooted collective with algorithm selection from the tuning
+        registers: XLA lowering, or the rooted Pallas ring-relay kernels
+        (the algorithm-faithful mode of the reference's rooted trees)."""
+        nseg = int(self.tuning.get("ring_segments", 1))
+        fn = lead.reduce_function
+        if op == Operation.REDUCE:
+            if self.tuning.get("reduce_algorithm", "xla") == "pallas_ring":
+                return opdriver.run_pallas_reduce(
+                    global_arr, mesh, lead.root_dst, fn, nseg
+                )
+            return opdriver.run_reduce(global_arr, mesh, lead.root_dst, fn)
+        if op == Operation.BCAST:
+            if self.tuning.get("bcast_algorithm", "xla") == "pallas_ring":
+                return opdriver.run_pallas_bcast(
+                    global_arr, mesh, lead.root_src, nseg
+                )
+            return opdriver.run_bcast(
+                global_arr, mesh, lead.root_src, donate=donate
+            )
+        if op == Operation.SCATTER:
+            if self.tuning.get("scatter_algorithm", "xla") == "pallas_ring":
+                return opdriver.run_pallas_scatter(
+                    global_arr, mesh, lead.root_src, nseg
+                )
+            return opdriver.run_scatter(global_arr, mesh, lead.root_src)
+        if op == Operation.GATHER:
+            if self.tuning.get("gather_algorithm", "xla") == "pallas_ring":
+                return opdriver.run_pallas_gather(
+                    global_arr, mesh, lead.root_src, nseg
+                )
+            return opdriver.run_gather(global_arr, mesh, lead.root_src)
+        raise ValueError(op)  # pragma: no cover
 
     # -- host-staged fallback path -------------------------------------------
     def _run_op_host(
@@ -492,7 +521,7 @@ class XLAGangContext:
         if op == Operation.REDUCE:
             stacked = wire_cast(_np_stack_op0(calls, [n] * size))
             out = np.asarray(
-                opdriver.run_reduce(stacked, mesh, lead.root_dst, fn)
+                self._run_rooted(op, stacked, mesh, lead)
                 if mesh is not None
                 else self._host_reduce(stacked, fn)[None].repeat(size, 0)
             )
@@ -505,7 +534,7 @@ class XLAGangContext:
         if op == Operation.BCAST:
             stacked = wire_cast(_np_stack_op0(calls, [n] * size))
             out = np.asarray(
-                opdriver.run_bcast(stacked, mesh, lead.root_src)
+                self._run_rooted(op, stacked, mesh, lead)
                 if mesh is not None
                 else stacked[lead.root_src][None].repeat(size, 0)
             )
@@ -539,7 +568,7 @@ class XLAGangContext:
             root = lead.root_src
             stacked = wire_cast(_np_stack_op0(calls, [size * n] * size))
             out = np.asarray(
-                opdriver.run_scatter(stacked, mesh, root)
+                self._run_rooted(op, stacked, mesh, lead)
                 if mesh is not None
                 else stacked[root].reshape(size, n)
             )
@@ -551,7 +580,7 @@ class XLAGangContext:
             root = lead.root_src
             stacked = wire_cast(_np_stack_op0(calls, [n] * size))
             out = np.asarray(
-                opdriver.run_gather(stacked, mesh, root)
+                self._run_rooted(op, stacked, mesh, lead)
                 if mesh is not None
                 else stacked.reshape(-1)[None].repeat(size, 0)
             )
@@ -1024,6 +1053,7 @@ class XLAEngine(BaseEngine):
         the gang's lowering choice (the reference's firmware-variant
         thresholds re-homed as program selection)."""
         from ...constants import (
+            ALGORITHM_TUNING_KEYS,
             AllreduceAlgorithm,
             TUNING_KEY_NAMES,
             TuningKey,
@@ -1036,12 +1066,18 @@ class XLAEngine(BaseEngine):
         val = options.cfg_value
         if val < 0:
             return ErrorCode.CONFIG_ERROR
-        if key == TuningKey.ALLREDUCE_ALGORITHM:
+        if key in ALGORITHM_TUNING_KEYS:
             try:
                 algo = AllreduceAlgorithm(int(val))
             except ValueError:
                 return ErrorCode.CONFIG_ERROR
-            self.gang.tuning["allreduce_algorithm"] = algo.name.lower()
+            if (
+                key != TuningKey.ALLREDUCE_ALGORITHM
+                and algo == AllreduceAlgorithm.RING
+            ):
+                # rooted ops have no ppermute-ring form: xla or pallas_ring
+                return ErrorCode.CONFIG_ERROR
+            self.gang.tuning[TUNING_KEY_NAMES[key]] = algo.name.lower()
         elif key == TuningKey.RING_SEGMENTS:
             if int(val) < 1:
                 return ErrorCode.CONFIG_ERROR

@@ -65,6 +65,13 @@ class TuningKey(enum.IntEnum):
     REDUCE_FLAT_TREE_MAX_COUNT = 4
     ALLREDUCE_ALGORITHM = 5
     RING_SEGMENTS = 6
+    # rooted-collective lowering on the device tier (XLA vs the rooted
+    # Pallas ring-relay kernels); values from AllreduceAlgorithm
+    # (XLA / PALLAS_RING)
+    BCAST_ALGORITHM = 7
+    REDUCE_ALGORITHM = 8
+    SCATTER_ALGORITHM = 9
+    GATHER_ALGORITHM = 10
 
 
 class AllreduceAlgorithm(enum.IntEnum):
@@ -85,7 +92,20 @@ TUNING_KEY_NAMES = {
     TuningKey.REDUCE_FLAT_TREE_MAX_COUNT: "reduce_flat_tree_max_count",
     TuningKey.ALLREDUCE_ALGORITHM: "allreduce_algorithm",
     TuningKey.RING_SEGMENTS: "ring_segments",
+    TuningKey.BCAST_ALGORITHM: "bcast_algorithm",
+    TuningKey.REDUCE_ALGORITHM: "reduce_algorithm",
+    TuningKey.SCATTER_ALGORITHM: "scatter_algorithm",
+    TuningKey.GATHER_ALGORITHM: "gather_algorithm",
 }
+
+#: tuning keys that select a collective lowering (value: AllreduceAlgorithm)
+ALGORITHM_TUNING_KEYS = (
+    TuningKey.ALLREDUCE_ALGORITHM,
+    TuningKey.BCAST_ALGORITHM,
+    TuningKey.REDUCE_ALGORITHM,
+    TuningKey.SCATTER_ALGORITHM,
+    TuningKey.GATHER_ALGORITHM,
+)
 
 
 class ReduceFunction(enum.IntEnum):

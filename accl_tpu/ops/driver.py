@@ -74,6 +74,24 @@ def _program(op: str, mesh_id: int, fn: ReduceFunction, extra=None):
         )[None]
     elif op == "reduce":
         body = lambda x: collectives.reduce(x[0], AXIS, extra, fn)[None]
+    elif op == "pallas_reduce":
+        root, nseg = extra
+        body = lambda x: pallas.ring_reduce(
+            x[0], AXIS, root, fn, nseg or 1
+        )[None]
+    elif op == "pallas_bcast":
+        root, nseg = extra
+        body = lambda x: pallas.ring_bcast(x[0], AXIS, root, nseg or 1)[None]
+    elif op == "pallas_scatter":
+        root, nseg = extra
+        body = lambda x: pallas.ring_scatter(
+            x[0], AXIS, root, nseg or 1
+        )[None]
+    elif op == "pallas_gather":
+        root, nseg = extra
+        body = lambda x: pallas.ring_gather(
+            x[0], AXIS, root, nseg or 1
+        )[None]
     elif op == "reduce_scatter":
         body = lambda x: collectives.reduce_scatter(x[0], AXIS, fn, tiled=True)[None]
     elif op == "allgather":
@@ -159,6 +177,40 @@ def run_compressed_allreduce(
 
 def run_reduce(stacked, mesh: Mesh, root=0, function=ReduceFunction.SUM):
     return _program("reduce", _mesh_key(mesh), function, root)(_put(stacked, mesh))
+
+
+def run_pallas_reduce(
+    stacked, mesh: Mesh, root=0, function=ReduceFunction.SUM,
+    num_segments: int = 1,
+):
+    """Reduce-to-root as the rooted Pallas ring pipeline (algorithm-
+    faithful mode; only the root row of the result is meaningful)."""
+    return _program(
+        "pallas_reduce", _mesh_key(mesh), function, (root, num_segments)
+    )(_put(stacked, mesh))
+
+
+def run_pallas_bcast(stacked, mesh: Mesh, root=0, num_segments: int = 1):
+    return _program(
+        "pallas_bcast", _mesh_key(mesh), ReduceFunction.SUM,
+        (root, num_segments),
+    )(_put(stacked, mesh))
+
+
+def run_pallas_scatter(stacked, mesh: Mesh, root=0, num_segments: int = 1):
+    return _program(
+        "pallas_scatter", _mesh_key(mesh), ReduceFunction.SUM,
+        (root, num_segments),
+    )(_put(stacked, mesh))
+
+
+def run_pallas_gather(stacked, mesh: Mesh, root=0, num_segments: int = 1):
+    """Gather via the ring relay (every row holds the full gather; the
+    root's row is the result)."""
+    return _program(
+        "pallas_gather", _mesh_key(mesh), ReduceFunction.SUM,
+        (root, num_segments),
+    )(_put(stacked, mesh))
 
 
 def run_reduce_scatter(stacked, mesh: Mesh, function=ReduceFunction.SUM):

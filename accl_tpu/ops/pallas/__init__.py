@@ -14,7 +14,7 @@ the CI tier exercises the identical kernel code (see
 ``_common.default_interpret``).
 """
 
-from . import alltoall, attention, compression, put, ring  # noqa: F401
+from . import alltoall, attention, compression, put, ring, rooted  # noqa: F401
 from ._common import default_interpret, pack_lanes, unpack_lanes  # noqa: F401
 from .alltoall import alltoall as alltoall_kernel  # noqa: F401
 from .combine import combine  # noqa: F401
@@ -24,4 +24,10 @@ from .ring import (  # noqa: F401
     ring_allgather,
     ring_allreduce,
     ring_reduce_scatter,
+)
+from .rooted import (  # noqa: F401
+    ring_bcast,
+    ring_gather,
+    ring_reduce,
+    ring_scatter,
 )

@@ -460,11 +460,16 @@ uint32_t Engine::apply_config(const CallArgs& args) {
         case 4:
           tune_reduce_flat_count_ = (uint64_t)v;
           return E_OK;
-        case 5:  // ALLREDUCE_ALGORITHM: device-tier register, validated
-                 // for config parity (values 0..2), unused here
+        case 5:   // ALLREDUCE_ALGORITHM: device-tier register, validated
+                  // for config parity (values 0..2), unused here
           return (v <= 2.0) ? E_OK : E_CONFIG_ERROR;
-        case 6:  // RING_SEGMENTS: device-tier register, >= 1
+        case 6:   // RING_SEGMENTS: device-tier register, >= 1
           return (v >= 1.0) ? E_OK : E_CONFIG_ERROR;
+        case 7:   // BCAST_ALGORITHM   (device-tier rooted lowering:
+        case 8:   // REDUCE_ALGORITHM   0 = xla, 2 = pallas_ring)
+        case 9:   // SCATTER_ALGORITHM
+        case 10:  // GATHER_ALGORITHM
+          return (v == 0.0 || v == 2.0) ? E_OK : E_CONFIG_ERROR;
         default:
           return E_CONFIG_ERROR;
       }

@@ -21,8 +21,6 @@ from accl_tpu.constants import DataType, ReduceFunction
 from accl_tpu.core import xla_group
 
 
-
-
 @pytest.fixture(scope="module")
 def dgroup4():
     g = xla_group(4)

@@ -1,0 +1,126 @@
+"""Rooted Pallas kernels (VERDICT item 7): bcast / reduce / gather /
+scatter ring relays, validated against numpy on the interpreted tier.
+
+Role models: firmware broadcast c:796-988, scatter c:992-1123, gather
+ring relay c:1205-1293, eager reduce pipeline c:1730-1743.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+try:
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from accl_tpu.constants import ReduceFunction
+from accl_tpu.ops import pallas as pk
+
+pytestmark = pytest.mark.pallas
+
+
+def _mesh(n):
+    devs = jax.devices()[:n]
+    assert len(devs) == n
+    return Mesh(devs, ("x",))
+
+
+def _run(fn, stacked, n=4):
+    mesh = _mesh(n)
+    prog = jax.jit(
+        shard_map(
+            fn, mesh=mesh, in_specs=P("x"), out_specs=P("x"),
+            check_vma=False,
+        )
+    )
+    return np.asarray(prog(jnp.asarray(stacked)))
+
+
+_N = 300  # deliberately not lane/sublane aligned
+
+
+@pytest.mark.parametrize("root", [0, 2, 3])
+@pytest.mark.parametrize("num_segments", [1, 2])
+def test_ring_bcast(root, num_segments):
+    rng = np.random.default_rng(5)
+    data = rng.standard_normal((4, _N)).astype(np.float32)
+    out = _run(
+        lambda x: pk.ring_bcast(x[0], "x", root, num_segments)[None],
+        data,
+    )
+    for r in range(4):
+        np.testing.assert_allclose(out[r], data[root], rtol=1e-6)
+
+
+@pytest.mark.parametrize("root", [0, 1, 3])
+@pytest.mark.parametrize(
+    "function", [ReduceFunction.SUM, ReduceFunction.MAX]
+)
+def test_ring_reduce(root, function):
+    rng = np.random.default_rng(6)
+    data = rng.standard_normal((4, _N)).astype(np.float32)
+    out = _run(
+        lambda x: pk.ring_reduce(x[0], "x", root, function)[None],
+        data,
+    )
+    expect = (
+        data.sum(0) if function == ReduceFunction.SUM else data.max(0)
+    )
+    np.testing.assert_allclose(out[root], expect, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("num_segments", [1, 2])
+def test_ring_reduce_segmented(num_segments):
+    rng = np.random.default_rng(7)
+    data = rng.standard_normal((4, _N)).astype(np.float32)
+    out = _run(
+        lambda x: pk.ring_reduce(
+            x[0], "x", 2, ReduceFunction.SUM, num_segments
+        )[None],
+        data,
+    )
+    np.testing.assert_allclose(out[2], data.sum(0), rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("root", [0, 1, 3])
+@pytest.mark.parametrize("num_segments", [1, 2])
+def test_ring_scatter(root, num_segments):
+    rng = np.random.default_rng(8)
+    blk = 256
+    full = rng.standard_normal(4 * blk).astype(np.float32)
+    # every rank passes the same full operand (only the root's is read)
+    stacked = np.stack([full] * 4)
+    stacked[np.arange(4) != root] = -1.0  # non-root values must not leak
+    stacked[root] = full
+    out = _run(
+        lambda x: pk.ring_scatter(x[0], "x", root, num_segments)[None],
+        stacked,
+    )
+    for r in range(4):
+        np.testing.assert_allclose(
+            out[r], full[r * blk : (r + 1) * blk], rtol=1e-6
+        )
+
+
+def test_ring_gather():
+    rng = np.random.default_rng(9)
+    data = rng.standard_normal((4, 128)).astype(np.float32)
+    out = _run(lambda x: pk.ring_gather(x[0], "x", 1)[None], data)
+    # the root's row carries the concatenated blocks in rank order
+    np.testing.assert_allclose(
+        out[1].reshape(4, 128), data, rtol=1e-6
+    )
+
+
+def test_ring_bcast_bf16():
+    data = np.arange(4 * 256, dtype=np.float32).reshape(4, 256)
+    out = _run(
+        lambda x: pk.ring_bcast(
+            x[0].astype(jnp.bfloat16), "x", 2
+        ).astype(jnp.float32)[None],
+        data,
+    )
+    np.testing.assert_allclose(out[0], data[2], rtol=1e-2)

@@ -16,8 +16,6 @@ from helpers import run_parallel
 from accl_tpu.constants import ReduceFunction
 
 
-
-
 def test_copy_from_stream(group2, rng):
     a = group2[0]
     data = rng.standard_normal(32).astype(np.float32)

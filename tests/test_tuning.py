@@ -22,8 +22,6 @@ from accl_tpu.constants import (
 )
 
 
-
-
 # ---------------------------------------------------------------------------
 # engine tiers (emulator + native C++): flat-vs-tree threshold flips
 # ---------------------------------------------------------------------------
@@ -158,6 +156,72 @@ def test_xla_allreduce_algorithm_via_facade(algo, rng):
     finally:
         for a in g:
             a.deinit()
+
+
+@pytest.mark.parametrize("algo", ["xla", "pallas_ring"])
+def test_xla_rooted_algorithms_via_facade(algo, rng):
+    """bcast/reduce/scatter/gather flip between the XLA lowering and the
+    rooted Pallas ring-relay kernels through the tuning registers."""
+    from accl_tpu.core import xla_group
+
+    g = xla_group(4)
+    try:
+        n = 64
+        for a in g:
+            for key in (
+                TuningKey.BCAST_ALGORITHM,
+                TuningKey.REDUCE_ALGORITHM,
+                TuningKey.SCATTER_ALGORITHM,
+                TuningKey.GATHER_ALGORITHM,
+            ):
+                a.set_tuning(key, algo)
+            a.set_tuning(TuningKey.RING_SEGMENTS, 2)
+        rows = [rng.standard_normal(n).astype(np.float32) for _ in range(4)]
+        big = rng.standard_normal(4 * n).astype(np.float32)
+        # snapshot expectations up front: buffers ALIAS the arrays they
+        # wrap, so sync_from_device overwrites rows[r]
+        expect_sum = np.sum(rows, axis=0)
+        expect_cat = np.concatenate(rows)
+        expect_b = rows[3].copy()
+        sb = [a.create_buffer_from(rows[r]) for r, a in enumerate(g)]
+        bb = [a.create_buffer_from(rows[r].copy()) for r, a in enumerate(g)]
+        rb = [a.create_buffer(n, np.float32) for a in g]
+        gb2 = g[2].create_buffer(4 * n, np.float32)
+        scat_src = g[1].create_buffer_from(big)
+        scat_dst = [a.create_buffer(n, np.float32) for a in g]
+
+        def work(a, r):
+            a.bcast(bb[r], n, root=3)
+            a.reduce(sb[r], rb[r] if r == 1 else None, n, root=1)
+            a.gather(sb[r], gb2 if r == 2 else None, n, root=2)
+            a.scatter(
+                scat_src if r == 1 else None, scat_dst[r], n, root=1
+            )
+
+        run_parallel(g, work)
+        for r in range(4):
+            bb[r].sync_from_device()
+            np.testing.assert_allclose(bb[r].host_view(), expect_b, rtol=1e-6)
+            scat_dst[r].sync_from_device()
+            np.testing.assert_allclose(
+                scat_dst[r].host_view(), big[r * n : (r + 1) * n], rtol=1e-6
+            )
+        rb[1].sync_from_device()
+        np.testing.assert_allclose(
+            rb[1].host_view(), expect_sum, rtol=1e-4, atol=1e-5
+        )
+        gb2.sync_from_device()
+        np.testing.assert_allclose(gb2.host_view(), expect_cat, rtol=1e-6)
+    finally:
+        for a in g:
+            a.deinit()
+
+
+def test_rooted_algorithm_rejects_ppermute_ring(group2):
+    """RING is an allreduce-only lowering: rooted registers reject it."""
+    with pytest.raises(ACCLError) as ei:
+        group2[0].set_tuning(TuningKey.BCAST_ALGORITHM, "ring")
+    assert ei.value.code == ErrorCode.CONFIG_ERROR
 
 
 def test_xla_invalid_algorithm_value_errors():
