@@ -82,3 +82,79 @@ class BaseEngine:
 
     def stream_pop(self, stream_id: int, timeout: Optional[float] = None) -> bytes:
         raise NotImplementedError
+
+
+class StreamPortMixin:
+    """Local device stream ports (the external-kernel AXIS interface) and
+    the streaming-operand/result payload helpers, shared by the device-tier
+    engines.  Hosts must call :meth:`_init_streams` and provide
+    ``self.timeout_s``."""
+
+    def _init_streams(self) -> None:
+        import threading
+
+        self._streams: dict = {}
+        self._stream_cv = threading.Condition()
+
+    def stream_push(self, stream_id: int, data: bytes) -> None:
+        with self._stream_cv:
+            self._streams.setdefault(stream_id, []).append(data)
+            self._stream_cv.notify_all()
+
+    def stream_pop(self, stream_id: int, timeout: Optional[float] = None) -> bytes:
+        with self._stream_cv:
+            ok = self._stream_cv.wait_for(
+                lambda: self._streams.get(stream_id), timeout
+            )
+            if not ok:
+                raise TimeoutError(f"stream {stream_id} empty")
+            return self._streams[stream_id].pop(0)
+
+    def _pop_stream_payload(self, options: CallOptions, count=None):
+        """Blocking pop of a full streaming operand from this rank's
+        stream port; None on timeout (the engine's DMA deadline)."""
+        import time
+
+        import numpy as np
+
+        from ..constants import dtype_to_numpy
+
+        cfg = options.arithcfg
+        src_dt = (
+            cfg.compressed
+            if options.compression & CompressionFlags.OP0_COMPRESSED
+            else cfg.uncompressed
+        )
+        npdt = dtype_to_numpy(src_dt)
+        n = options.count if count is None else int(count)
+        need = n * npdt.itemsize
+        raw = b""
+        deadline = time.monotonic() + self.timeout_s
+        try:
+            while len(raw) < need:
+                raw += self.stream_pop(
+                    options.stream_id,
+                    timeout=max(0.01, deadline - time.monotonic()),
+                )
+        except TimeoutError:
+            return None
+        return np.frombuffer(raw[:need], npdt).copy()
+
+    def _push_stream_result(self, options: CallOptions, data) -> None:
+        """Result row to this rank's stream port, in the wire dtype the
+        compression flags request (the RES_STREAM lane)."""
+        import numpy as np
+
+        from ..constants import dtype_to_numpy
+
+        cfg = options.arithcfg
+        res_dt = (
+            cfg.compressed
+            if options.compression & CompressionFlags.RES_COMPRESSED
+            else cfg.uncompressed
+        )
+        npdt = dtype_to_numpy(res_dt)
+        self.stream_push(
+            options.stream_id,
+            np.asarray(data)[: options.count].astype(npdt).tobytes(),
+        )

@@ -1,0 +1,13 @@
+"""Multi-process device tier: one OS process per rank over jax.distributed.
+
+The reference's device deployment is mpirun-per-rank host processes, each
+driving its own FPGA over the shared fabric
+(``test/host/xrt/include/fixture.hpp:124-132``,
+``accl_network_utils.cpp``).  This backend is the TPU analog: each process
+owns its chip(s) through a multi-controller ``jax.distributed`` runtime,
+and every collective executes as the same jitted shard_map program in all
+participating processes — ICI/DCN (or gloo on the CPU test tier) carries
+the data, with no single-controller gang in the way.
+"""
+
+from .engine import DistEngine, dist_group_member  # noqa: F401

@@ -47,9 +47,15 @@ from ...constants import (
     StreamFlags,
     dtype_to_numpy,
 )
-from ...buffer import DeviceBuffer, DummyBuffer, EmuBuffer, dev_zeros as _dev_zeros
+from ...buffer import (
+    DeviceBuffer,
+    DummyBuffer,
+    EmuBuffer,
+    dev_zeros as _dev_zeros,
+    make_buffer,
+)
 from ...request import Request
-from ..base import BaseEngine, CallOptions
+from ..base import BaseEngine, CallOptions, StreamPortMixin
 from ...ops import driver as opdriver
 
 
@@ -184,6 +190,119 @@ def _p2p_device_deliver(payload, res: DeviceBuffer, count: int) -> None:
 
 
 
+# per-op operand/result widths in units of ``count`` ('P' = size*count)
+IN_W = {
+    Operation.ALLREDUCE: 1, Operation.REDUCE: 1, Operation.BCAST: 1,
+    Operation.ALLGATHER: 1, Operation.GATHER: 1,
+    Operation.REDUCE_SCATTER: "P", Operation.SCATTER: "P",
+    Operation.ALLTOALL: "P",
+}
+OUT_W = {
+    Operation.ALLREDUCE: 1, Operation.REDUCE: 1, Operation.BCAST: 1,
+    Operation.SCATTER: 1, Operation.REDUCE_SCATTER: 1,
+    Operation.ALLGATHER: "P", Operation.GATHER: "P",
+    Operation.ALLTOALL: "P",
+}
+
+
+def run_rooted_with_tuning(op, global_arr, mesh, lead, tuning, donate=False):
+    """Rooted collective with algorithm selection from the tuning
+    registers: XLA lowering, or the rooted Pallas ring-relay kernels (the
+    algorithm-faithful mode of the reference's rooted trees).  Shared by
+    the single-process gang and the multi-process dist engine."""
+    nseg = int(tuning.get("ring_segments", 1))
+    fn = lead.reduce_function
+    if op == Operation.REDUCE:
+        if tuning.get("reduce_algorithm", "xla") == "pallas_ring":
+            return opdriver.run_pallas_reduce(
+                global_arr, mesh, lead.root_dst, fn, nseg
+            )
+        return opdriver.run_reduce(global_arr, mesh, lead.root_dst, fn)
+    if op == Operation.BCAST:
+        if tuning.get("bcast_algorithm", "xla") == "pallas_ring":
+            return opdriver.run_pallas_bcast(
+                global_arr, mesh, lead.root_src, nseg
+            )
+        return opdriver.run_bcast(
+            global_arr, mesh, lead.root_src, donate=donate
+        )
+    if op == Operation.SCATTER:
+        if tuning.get("scatter_algorithm", "xla") == "pallas_ring":
+            return opdriver.run_pallas_scatter(
+                global_arr, mesh, lead.root_src, nseg
+            )
+        return opdriver.run_scatter(global_arr, mesh, lead.root_src)
+    if op == Operation.GATHER:
+        if tuning.get("gather_algorithm", "xla") == "pallas_ring":
+            return opdriver.run_pallas_gather(
+                global_arr, mesh, lead.root_src, nseg
+            )
+        return opdriver.run_gather(global_arr, mesh, lead.root_src)
+    raise ValueError(op)  # pragma: no cover
+
+
+def apply_tuning(tuning: dict, options) -> ErrorCode:
+    """Validate + apply one SET_TUNING register write into a device-tier
+    tuning table (shared by the gang and dist engines; identical checks
+    to the emulator/native tiers)."""
+    from ...constants import (
+        ALGORITHM_TUNING_KEYS,
+        AllreduceAlgorithm,
+        TUNING_KEY_NAMES,
+        TuningKey,
+    )
+
+    try:
+        key = TuningKey(int(options.cfg_key))
+    except ValueError:
+        return ErrorCode.CONFIG_ERROR
+    val = options.cfg_value
+    if val < 0:
+        return ErrorCode.CONFIG_ERROR
+    if key in ALGORITHM_TUNING_KEYS:
+        try:
+            algo = AllreduceAlgorithm(int(val))
+        except ValueError:
+            return ErrorCode.CONFIG_ERROR
+        if (
+            key != TuningKey.ALLREDUCE_ALGORITHM
+            and algo == AllreduceAlgorithm.RING
+        ):
+            # rooted ops have no ppermute-ring form: xla or pallas_ring
+            return ErrorCode.CONFIG_ERROR
+        tuning[TUNING_KEY_NAMES[key]] = algo.name.lower()
+    elif key == TuningKey.RING_SEGMENTS:
+        if int(val) < 1:
+            return ErrorCode.CONFIG_ERROR
+        tuning["ring_segments"] = int(val)
+    else:
+        if key == TuningKey.GATHER_FLAT_TREE_MAX_FANIN and val < 1:
+            return ErrorCode.CONFIG_ERROR
+        tuning[TUNING_KEY_NAMES[key]] = int(val)
+    return ErrorCode.OK
+
+
+def run_allreduce_with_tuning(global_arr, mesh, fn, wire_dtype, tuning):
+    """Allreduce with algorithm + segmentation + wire compression from the
+    tuning registers."""
+    algo = tuning.get("allreduce_algorithm", "xla")
+    nseg = int(tuning.get("ring_segments", 1))
+    if wire_dtype is not None:
+        wire_name = dtype_to_numpy(wire_dtype).name
+        if algo == "pallas_ring":
+            # compression lanes run inside the kernel
+            return opdriver.run_pallas_allreduce(
+                global_arr, mesh, fn, nseg, wire_dtype=wire_name
+            )
+        return opdriver.run_compressed_allreduce(
+            global_arr, mesh, fn, wire_dtype=wire_name
+        )
+    if algo == "ring":
+        return opdriver.run_ring_allreduce(global_arr, mesh, fn, nseg)
+    if algo == "pallas_ring":
+        return opdriver.run_pallas_allreduce(global_arr, mesh, fn, nseg)
+    return opdriver.run_allreduce(global_arr, mesh, fn)
+
 
 class _GangSlot:
     def __init__(self, world: int, timeout_s: float):
@@ -294,20 +413,6 @@ class XLAGangContext:
         for req in reqs:
             req.complete(code, dt)
 
-    # per-op operand/result widths in units of ``count`` ('P' = size*count)
-    _IN_W = {
-        Operation.ALLREDUCE: 1, Operation.REDUCE: 1, Operation.BCAST: 1,
-        Operation.ALLGATHER: 1, Operation.GATHER: 1,
-        Operation.REDUCE_SCATTER: "P", Operation.SCATTER: "P",
-        Operation.ALLTOALL: "P",
-    }
-    _OUT_W = {
-        Operation.ALLREDUCE: 1, Operation.REDUCE: 1, Operation.BCAST: 1,
-        Operation.SCATTER: 1, Operation.REDUCE_SCATTER: 1,
-        Operation.ALLGATHER: "P", Operation.GATHER: "P",
-        Operation.ALLTOALL: "P",
-    }
-
     def _run_op(
         self, comm: Communicator, calls: List[CallOptions], lead: CallOptions
     ) -> ErrorCode:
@@ -345,14 +450,14 @@ class XLAGangContext:
         host-staged path (mixed/host operands, exotic dtypes).
         """
         op = lead.op
-        if op not in self._IN_W:
+        if op not in IN_W:
             return None
         size = comm.size
         n = lead.count
         if n <= 0:
             return None
-        in_w = n * (size if self._IN_W[op] == "P" else 1)
-        out_w = n * (size if self._OUT_W[op] == "P" else 1)
+        in_w = n * (size if IN_W[op] == "P" else 1)
+        out_w = n * (size if OUT_W[op] == "P" else 1)
         devs = list(mesh.devices.flat)
         npdt = dtype_to_numpy(lead.arithcfg.uncompressed)
         compressed = bool(lead.compression & CompressionFlags.ETH_COMPRESSED)
@@ -437,7 +542,7 @@ class XLAGangContext:
             out = opdriver.run_reduce_scatter(global_arr, mesh, fn)
         elif op == Operation.ALLTOALL:
             out = opdriver.run_alltoall(global_arr, mesh)
-        else:  # pragma: no cover - guarded by _IN_W
+        else:  # pragma: no cover - guarded by IN_W
             return None
 
         dev_to_rank = {d: r for r, d in enumerate(devs)}
@@ -452,38 +557,9 @@ class XLAGangContext:
         return ErrorCode.OK
 
     def _run_rooted(self, op, global_arr, mesh, lead, donate=False):
-        """Rooted collective with algorithm selection from the tuning
-        registers: XLA lowering, or the rooted Pallas ring-relay kernels
-        (the algorithm-faithful mode of the reference's rooted trees)."""
-        nseg = int(self.tuning.get("ring_segments", 1))
-        fn = lead.reduce_function
-        if op == Operation.REDUCE:
-            if self.tuning.get("reduce_algorithm", "xla") == "pallas_ring":
-                return opdriver.run_pallas_reduce(
-                    global_arr, mesh, lead.root_dst, fn, nseg
-                )
-            return opdriver.run_reduce(global_arr, mesh, lead.root_dst, fn)
-        if op == Operation.BCAST:
-            if self.tuning.get("bcast_algorithm", "xla") == "pallas_ring":
-                return opdriver.run_pallas_bcast(
-                    global_arr, mesh, lead.root_src, nseg
-                )
-            return opdriver.run_bcast(
-                global_arr, mesh, lead.root_src, donate=donate
-            )
-        if op == Operation.SCATTER:
-            if self.tuning.get("scatter_algorithm", "xla") == "pallas_ring":
-                return opdriver.run_pallas_scatter(
-                    global_arr, mesh, lead.root_src, nseg
-                )
-            return opdriver.run_scatter(global_arr, mesh, lead.root_src)
-        if op == Operation.GATHER:
-            if self.tuning.get("gather_algorithm", "xla") == "pallas_ring":
-                return opdriver.run_pallas_gather(
-                    global_arr, mesh, lead.root_src, nseg
-                )
-            return opdriver.run_gather(global_arr, mesh, lead.root_src)
-        raise ValueError(op)  # pragma: no cover
+        return run_rooted_with_tuning(
+            op, global_arr, mesh, lead, self.tuning, donate=donate
+        )
 
     # -- host-staged fallback path -------------------------------------------
     def _run_op_host(
@@ -610,23 +686,9 @@ class XLAGangContext:
                 npdt = dtype_to_numpy(wire_dtype)
                 stacked = stacked.astype(npdt).astype(stacked.dtype)
             return self._host_reduce(stacked, fn)[None].repeat(stacked.shape[0], 0)
-        algo = self.tuning.get("allreduce_algorithm", "xla")
-        nseg = int(self.tuning.get("ring_segments", 1))
-        if wire_dtype is not None:
-            wire_name = dtype_to_numpy(wire_dtype).name
-            if algo == "pallas_ring":
-                # compression lanes run inside the kernel
-                return opdriver.run_pallas_allreduce(
-                    stacked, mesh, fn, nseg, wire_dtype=wire_name
-                )
-            return opdriver.run_compressed_allreduce(
-                stacked, mesh, fn, wire_dtype=wire_name
-            )
-        if algo == "ring":
-            return opdriver.run_ring_allreduce(stacked, mesh, fn, nseg)
-        if algo == "pallas_ring":
-            return opdriver.run_pallas_allreduce(stacked, mesh, fn, nseg)
-        return opdriver.run_allreduce(stacked, mesh, fn)
+        return run_allreduce_with_tuning(
+            stacked, mesh, fn, wire_dtype, self.tuning
+        )
 
     @staticmethod
     def _host_reduce(stacked: np.ndarray, fn: ReduceFunction) -> np.ndarray:
@@ -716,7 +778,7 @@ class _P2PChannel:
         sreq.complete(ErrorCode.OK, 1)
 
 
-class XLAEngine(BaseEngine):
+class XLAEngine(StreamPortMixin, BaseEngine):
     """One rank handle's engine over a shared gang context.
 
     Local ops (copy/combine) execute immediately with jax.numpy on the
@@ -738,8 +800,7 @@ class XLAEngine(BaseEngine):
         self.timeout_s = DEFAULT_TIMEOUT_S
         self.max_eager_size = 32 * 1024
         self.max_rendezvous_size = MAX_EAGER_SIZE_LIMIT
-        self._streams: Dict[int, list] = {}
-        self._stream_cv = threading.Condition()
+        self._init_streams()
 
     def start(self, options: CallOptions) -> Request:
         req = Request(op_name=options.op.name)
@@ -869,44 +930,6 @@ class XLAEngine(BaseEngine):
                     req.complete(ErrorCode.INVALID_OPERATION)
 
         threading.Thread(target=run, daemon=True).start()
-
-    def _pop_stream_payload(self, options: CallOptions):
-        """Blocking pop of a full streaming operand from this rank's stream
-        port; None on timeout (the engine's DMA deadline)."""
-        cfg = options.arithcfg
-        src_dt = (
-            cfg.compressed
-            if options.compression & CompressionFlags.OP0_COMPRESSED
-            else cfg.uncompressed
-        )
-        npdt = dtype_to_numpy(src_dt)
-        need = options.count * npdt.itemsize
-        raw = b""
-        deadline = time.monotonic() + self.timeout_s
-        try:
-            while len(raw) < need:
-                raw += self.stream_pop(
-                    options.stream_id,
-                    timeout=max(0.01, deadline - time.monotonic()),
-                )
-        except TimeoutError:
-            return None
-        return np.frombuffer(raw[:need], npdt).copy()
-
-    def _push_stream_result(self, options: CallOptions, data: np.ndarray):
-        """Result row to this rank's stream port, in the wire dtype the
-        compression flags request (the RES_STREAM lane)."""
-        cfg = options.arithcfg
-        res_dt = (
-            cfg.compressed
-            if options.compression & CompressionFlags.RES_COMPRESSED
-            else cfg.uncompressed
-        )
-        npdt = dtype_to_numpy(res_dt)
-        self.stream_push(
-            options.stream_id,
-            np.asarray(data)[: options.count].astype(npdt).tobytes(),
-        )
 
     def _gang_with_streams(self, options: CallOptions, req: Request) -> None:
         """Stream-operand collective: pull OP0 from the stream port, run
@@ -1049,76 +1072,15 @@ class XLAEngine(BaseEngine):
         return ErrorCode.OK
 
     def _apply_tuning(self, options: CallOptions) -> ErrorCode:
-        """Tuning registers on the device tier: algorithm selection maps to
-        the gang's lowering choice (the reference's firmware-variant
-        thresholds re-homed as program selection)."""
-        from ...constants import (
-            ALGORITHM_TUNING_KEYS,
-            AllreduceAlgorithm,
-            TUNING_KEY_NAMES,
-            TuningKey,
-        )
-
-        try:
-            key = TuningKey(int(options.cfg_key))
-        except ValueError:
-            return ErrorCode.CONFIG_ERROR
-        val = options.cfg_value
-        if val < 0:
-            return ErrorCode.CONFIG_ERROR
-        if key in ALGORITHM_TUNING_KEYS:
-            try:
-                algo = AllreduceAlgorithm(int(val))
-            except ValueError:
-                return ErrorCode.CONFIG_ERROR
-            if (
-                key != TuningKey.ALLREDUCE_ALGORITHM
-                and algo == AllreduceAlgorithm.RING
-            ):
-                # rooted ops have no ppermute-ring form: xla or pallas_ring
-                return ErrorCode.CONFIG_ERROR
-            self.gang.tuning[TUNING_KEY_NAMES[key]] = algo.name.lower()
-        elif key == TuningKey.RING_SEGMENTS:
-            if int(val) < 1:
-                return ErrorCode.CONFIG_ERROR
-            self.gang.tuning["ring_segments"] = int(val)
-        else:
-            # same per-key validation as the emulator/native tiers
-            if key == TuningKey.GATHER_FLAT_TREE_MAX_FANIN and val < 1:
-                return ErrorCode.CONFIG_ERROR
-            self.gang.tuning[TUNING_KEY_NAMES[key]] = int(val)
-        return ErrorCode.OK
+        return apply_tuning(self.gang.tuning, options)
 
     def create_buffer(self, count: int, dtype, host_only: bool = False,
                       data=None):
-        """HBM-resident DeviceBuffer on this rank's chip; host-only buffers
-        (and device-less fallback ranks) stay host pairs.  ``data`` seeds
-        the device array directly (one device_put, no zeros pass) with the
-        host mirror aliasing the caller's array."""
-        if host_only or self.device is None:
-            return super().create_buffer(
-                count, dtype, host_only=host_only, data=data
-            )
-        if data is not None:
-            arr = jax.device_put(data, self.device)
-            return DeviceBuffer(
-                count, dtype, self.device, array=arr, host=data
-            )
-        return DeviceBuffer(count, dtype, self.device)
+        """HBM-resident DeviceBuffer on this rank's chip; host-only
+        buffers (and device-less fallback ranks) stay host pairs."""
+        return make_buffer(
+            self.device, count, dtype, host_only=host_only, data=data
+        )
 
     def shutdown(self) -> None:
         pass
-
-    def stream_push(self, stream_id: int, data: bytes) -> None:
-        with self._stream_cv:
-            self._streams.setdefault(stream_id, []).append(data)
-            self._stream_cv.notify_all()
-
-    def stream_pop(self, stream_id: int, timeout: Optional[float] = None) -> bytes:
-        with self._stream_cv:
-            ok = self._stream_cv.wait_for(
-                lambda: self._streams.get(stream_id), timeout
-            )
-            if not ok:
-                raise TimeoutError(f"stream {stream_id} empty")
-            return self._streams[stream_id].pop(0)

@@ -39,6 +39,26 @@ def dev_zeros(shape: tuple, npdt, device):
     return _zeros_program(tuple(shape), np.dtype(npdt), device)()
 
 
+def make_buffer(device, count: int, dtype, host_only: bool = False,
+                data=None):
+    """Backend-appropriate buffer for a device-tier engine: an HBM-resident
+    :class:`DeviceBuffer` on ``device``, or an :class:`EmuBuffer` when
+    host-only (or no device is available).  ``data`` seeds the buffer —
+    the host side ALIASES it and the device side is synced on return."""
+    if host_only or device is None:
+        if data is not None:
+            buf = EmuBuffer.from_array(data, host_only=host_only)
+            buf.sync_to_device()
+            return buf
+        return EmuBuffer(count, dtype, host_only=host_only)
+    if data is not None:
+        import jax
+
+        arr = jax.device_put(data, device)
+        return DeviceBuffer(count, dtype, device, array=arr, host=data)
+    return DeviceBuffer(count, dtype, device)
+
+
 # Slicing and scatter-writeback run as cached jitted programs, not eager
 # ops: eager indexing dispatches its index scalars host->device, which
 # would violate the zero-host-copy contract (and trip transfer guards).

@@ -30,6 +30,7 @@ class Design(enum.Enum):
     NATIVE = "native"  # C++ engine, all ranks in one process
     NATIVE_SOCKET = "native_socket"  # C++ engine, one process per rank
     ICI = "ici"  # XLA gang backend over the device mesh
+    XLA_DIST = "xla_dist"  # one process per rank over jax.distributed
 
 
 def generate_ranks(
@@ -87,6 +88,19 @@ def bootstrap(
         return native_group(world, **kwargs)
     if design == Design.ICI:
         return core.xla_group(world, **kwargs)
+    if design == Design.XLA_DIST:
+        if rank is None:
+            raise ValueError("xla_dist needs this process's rank")
+        from ..backends.dist import dist_group_member
+
+        # multi-host pods pass coordinator="host0:port"; the default only
+        # suits single-host (test) deployments
+        coordinator = kwargs.pop("coordinator", None) or (
+            f"127.0.0.1:{base_port}"
+        )
+        return dist_group_member(
+            rank, world, coordinator=coordinator, **kwargs
+        )
     if design in (Design.SOCKET, Design.NATIVE_SOCKET):
         if rank is None:
             raise ValueError("socket designs need this process's rank")

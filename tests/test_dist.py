@@ -1,0 +1,85 @@
+"""Multi-process device tier (VERDICT item 4): one OS process per rank
+over jax.distributed, the facade collectives riding the global device
+mesh (gloo cross-process collectives on the CPU test tier; ICI/DCN on
+real pods).
+
+Role model: the reference's mpirun-per-rank host processes over the
+shared fabric (``fixture.hpp:124-132``, ``accl_network_utils.cpp``).
+"""
+
+import numpy as np
+import pytest
+
+from accl_tpu.launch import launch_processes
+
+
+def _dist_worker(accl, rank, world):
+    """Runs inside each spawned process: the facade surface end-to-end."""
+    import numpy as np
+
+    from accl_tpu.buffer import DeviceBuffer
+    from accl_tpu.constants import TuningKey
+
+    n = 32
+    results = {}
+
+    # allreduce on device-resident buffers (the VERDICT "done" criterion)
+    send = accl.create_buffer_from(np.full(n, float(rank + 1), np.float32))
+    recv = accl.create_buffer(n, np.float32)
+    assert isinstance(send, DeviceBuffer) and isinstance(recv, DeviceBuffer)
+    accl.allreduce(send, recv, n)
+    recv.sync_from_device()
+    results["allreduce"] = float(recv.data[0])
+
+    # bcast + reduce (rooted, SPMD program order is the match)
+    b = accl.create_buffer_from(np.full(n, float(rank * 10), np.float32))
+    accl.bcast(b, n, root=1)
+    b.sync_from_device()
+    results["bcast"] = float(b.data[0])
+
+    rb = accl.create_buffer(n, np.float32) if rank == 0 else None
+    accl.reduce(send, rb, n, root=0)
+    if rb is not None:
+        rb.sync_from_device()
+        results["reduce"] = float(rb.data[0])
+
+    # allgather
+    gb = accl.create_buffer(world * n, np.float32)
+    accl.allgather(send, gb, n)
+    gb.sync_from_device()
+    results["allgather"] = [float(gb.data[i * n]) for i in range(world)]
+
+    # barrier (a real cross-process collective)
+    accl.barrier()
+
+    # p2p: rank 0 -> rank 1 over a two-process ppermute
+    if rank == 0:
+        accl.send(send, n, dst=1, tag=3)
+    elif rank == 1:
+        pb = accl.create_buffer(n, np.float32)
+        accl.recv(pb, n, src=0, tag=3)
+        pb.sync_from_device()
+        results["p2p"] = float(pb.data[0])
+
+    # tuning registers apply per process
+    accl.set_tuning(TuningKey.ALLREDUCE_ALGORITHM, "ring")
+    accl.allreduce(send, recv, n)
+    recv.sync_from_device()
+    results["allreduce_ring"] = float(recv.data[0])
+    return results
+
+
+@pytest.mark.parametrize("world", [2])
+def test_dist_two_process_facade(world):
+    results = launch_processes(
+        _dist_worker, world=world, base_port=47610, design="xla_dist",
+        timeout=300.0,
+    )
+    total = float(sum(range(1, world + 1)))
+    for r, res in enumerate(results):
+        assert res["allreduce"] == total, res
+        assert res["allreduce_ring"] == total, res
+        assert res["bcast"] == 10.0, res
+        assert res["allgather"] == [float(i + 1) for i in range(world)], res
+    assert results[0]["reduce"] == total
+    assert results[1]["p2p"] == 1.0
