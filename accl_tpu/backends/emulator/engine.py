@@ -245,6 +245,7 @@ class EmuEngine(BaseEngine):
             from ...constants import (
                 ALGORITHM_TUNING_KEYS,
                 AllreduceAlgorithm,
+                ROOTED_ALGORITHMS,
                 TUNING_KEY_NAMES,
                 TuningKey,
             )
@@ -268,7 +269,7 @@ class EmuEngine(BaseEngine):
                     return ErrorCode.CONFIG_ERROR
                 if (
                     key != TuningKey.ALLREDUCE_ALGORITHM
-                    and algo == AllreduceAlgorithm.RING
+                    and algo not in ROOTED_ALGORITHMS
                 ):
                     return ErrorCode.CONFIG_ERROR
             # device-tier registers (algorithm select) are accepted and

@@ -248,6 +248,7 @@ def apply_tuning(tuning: dict, options) -> ErrorCode:
     from ...constants import (
         ALGORITHM_TUNING_KEYS,
         AllreduceAlgorithm,
+        ROOTED_ALGORITHMS,
         TUNING_KEY_NAMES,
         TuningKey,
     )
@@ -266,9 +267,8 @@ def apply_tuning(tuning: dict, options) -> ErrorCode:
             return ErrorCode.CONFIG_ERROR
         if (
             key != TuningKey.ALLREDUCE_ALGORITHM
-            and algo == AllreduceAlgorithm.RING
+            and algo not in ROOTED_ALGORITHMS
         ):
-            # rooted ops have no ppermute-ring form: xla or pallas_ring
             return ErrorCode.CONFIG_ERROR
         tuning[TUNING_KEY_NAMES[key]] = algo.name.lower()
     elif key == TuningKey.RING_SEGMENTS:
@@ -287,20 +287,24 @@ def run_allreduce_with_tuning(global_arr, mesh, fn, wire_dtype, tuning):
     tuning registers."""
     algo = tuning.get("allreduce_algorithm", "xla")
     nseg = int(tuning.get("ring_segments", 1))
+    bidir = algo == "pallas_ring_bidir"
     if wire_dtype is not None:
         wire_name = dtype_to_numpy(wire_dtype).name
-        if algo == "pallas_ring":
+        if algo in ("pallas_ring", "pallas_ring_bidir"):
             # compression lanes run inside the kernel
             return opdriver.run_pallas_allreduce(
-                global_arr, mesh, fn, nseg, wire_dtype=wire_name
+                global_arr, mesh, fn, nseg, wire_dtype=wire_name,
+                bidirectional=bidir,
             )
         return opdriver.run_compressed_allreduce(
             global_arr, mesh, fn, wire_dtype=wire_name
         )
     if algo == "ring":
         return opdriver.run_ring_allreduce(global_arr, mesh, fn, nseg)
-    if algo == "pallas_ring":
-        return opdriver.run_pallas_allreduce(global_arr, mesh, fn, nseg)
+    if algo in ("pallas_ring", "pallas_ring_bidir"):
+        return opdriver.run_pallas_allreduce(
+            global_arr, mesh, fn, nseg, bidirectional=bidir
+        )
     return opdriver.run_allreduce(global_arr, mesh, fn)
 
 

@@ -80,6 +80,7 @@ class AllreduceAlgorithm(enum.IntEnum):
     XLA = 0          # let XLA's collective scheduler pick
     RING = 1         # explicit segmented ppermute ring pipeline
     PALLAS_RING = 2  # the Pallas remote-DMA ring kernel
+    PALLAS_RING_BIDIR = 3  # bidirectional ring: both ICI links per pair
 
 
 #: TuningKey -> engine tuning-table name (the emulator/native engines index
@@ -97,6 +98,10 @@ TUNING_KEY_NAMES = {
     TuningKey.SCATTER_ALGORITHM: "scatter_algorithm",
     TuningKey.GATHER_ALGORITHM: "gather_algorithm",
 }
+
+#: lowerings valid for the ROOTED algorithm registers (no ppermute-ring /
+#: bidirectional form exists for rooted ops)
+ROOTED_ALGORITHMS = (AllreduceAlgorithm.XLA, AllreduceAlgorithm.PALLAS_RING)
 
 #: tuning keys that select a collective lowering (value: AllreduceAlgorithm)
 ALGORITHM_TUNING_KEYS = (

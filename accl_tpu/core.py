@@ -121,7 +121,8 @@ class ACCL:
 
         ``key``: a :class:`TuningKey`, its name, or its int value.
         ``value``: a number, or an algorithm name ("xla" / "ring" /
-        "pallas_ring") for ``ALLREDUCE_ALGORITHM``.
+        "pallas_ring" / "pallas_ring_bidir") for ``ALLREDUCE_ALGORITHM``
+        ("xla" / "pallas_ring" for the rooted registers).
         """
         from .constants import AllreduceAlgorithm, TuningKey
 

@@ -62,10 +62,12 @@ def _program(op: str, mesh_id: int, fn: ReduceFunction, extra=None):
         nseg = extra or 1
         body = lambda x: ring.ring_allreduce(x[0], AXIS, fn, nseg)[None]
     elif op == "pallas_allreduce":
-        nseg, wire = extra  # always (num_segments, wire_dtype_name)
+        nseg, wire, bidir = extra  # (num_segments, wire_dtype_name, bidir)
         nseg = nseg or 1
         body = lambda x: pallas.ring_allreduce(
-            x[0], AXIS, fn, nseg, wire_dtype=wire and jnp.dtype(wire)
+            x[0], AXIS, fn, nseg,
+            bidirectional=bidir,
+            wire_dtype=wire and jnp.dtype(wire),
         )[None]
     elif op == "compressed_allreduce":
         wire = jnp.dtype(extra or "bfloat16")
@@ -153,14 +155,17 @@ def run_pallas_allreduce(
     function=ReduceFunction.SUM,
     num_segments: int = 1,
     wire_dtype: str = None,
+    bidirectional: bool = False,
 ):
     """The segmented ring as a single Pallas kernel: remote-DMA hops over
     ICI with slot-ack flow control (interpreted off-TPU).  ``wire_dtype``
     (a dtype name string, to key the program cache) narrows the payload on
-    the wire with in-kernel compress/decompress lanes."""
+    the wire with in-kernel compress/decompress lanes; ``bidirectional``
+    runs the operand's halves around the ring in opposite directions,
+    using both ICI links of every neighbor pair."""
     return _program(
         "pallas_allreduce", _mesh_key(mesh), function,
-        (num_segments, wire_dtype),
+        (num_segments, wire_dtype, bool(bidirectional)),
     )(_put(stacked, mesh))
 
 

@@ -146,6 +146,12 @@ def sweep_ops(world: int, sizes: List[int], writer, extra_algos=()) -> None:
                 stacked, mesh, num_segments=4
             )
         )
+    if "pallas_bidir" in extra_algos:
+        runners["allreduce_pallas_bidir"] = (
+            lambda stacked, mesh: opdriver.run_pallas_allreduce(
+                stacked, mesh, num_segments=2, bidirectional=True
+            )
+        )
     if "pallas" in extra_algos:
         runners["allreduce_pallas_ring"] = (
             lambda stacked, mesh: opdriver.run_pallas_allreduce(
@@ -161,7 +167,9 @@ def sweep_ops(world: int, sizes: List[int], writer, extra_algos=()) -> None:
     # (minutes per call) — cap the interpreted sweep sizes
     for op, fn in runners.items():
         op_sizes = sizes
-        if pallas_cap is not None and op.endswith("pallas_ring"):
+        if pallas_cap is not None and (
+            op.endswith("pallas_ring") or op.endswith("pallas_bidir")
+        ):
             op_sizes = [n for n in sizes if n <= pallas_cap]
             if len(op_sizes) < len(sizes):
                 print(
@@ -202,7 +210,8 @@ def main(argv=None) -> int:
              "plugin overrides the JAX_PLATFORMS env var",
     )
     ap.add_argument(
-        "--extra-algos", nargs="*", default=[], choices=["ring", "pallas"],
+        "--extra-algos", nargs="*", default=[],
+        choices=["ring", "pallas", "pallas_bidir"],
         help="ops backend only: also sweep explicit ring / Pallas-ring "
              "allreduce (the algorithm-faithful modes)",
     )

@@ -461,8 +461,8 @@ uint32_t Engine::apply_config(const CallArgs& args) {
           tune_reduce_flat_count_ = (uint64_t)v;
           return E_OK;
         case 5:   // ALLREDUCE_ALGORITHM: device-tier register, validated
-                  // for config parity (values 0..2), unused here
-          return (v <= 2.0) ? E_OK : E_CONFIG_ERROR;
+                  // for config parity (values 0..3), unused here
+          return (v <= 3.0) ? E_OK : E_CONFIG_ERROR;
         case 6:   // RING_SEGMENTS: device-tier register, >= 1
           return (v >= 1.0) ? E_OK : E_CONFIG_ERROR;
         case 7:   // BCAST_ALGORITHM   (device-tier rooted lowering:

@@ -66,6 +66,16 @@ def _dist_worker(accl, rank, world):
     accl.allreduce(send, recv, n)
     recv.sync_from_device()
     results["allreduce_ring"] = float(recv.data[0])
+
+    # zero-host-copy on this tier too: the collective must not touch the
+    # host between buffer creation and sync_from_device
+    import jax
+
+    accl.set_tuning(TuningKey.ALLREDUCE_ALGORITHM, "xla")
+    with jax.transfer_guard("disallow"):
+        accl.allreduce(send, recv, n)
+    recv.sync_from_device()
+    results["allreduce_guarded"] = float(recv.data[0])
     return results
 
 
@@ -83,3 +93,29 @@ def test_dist_two_process_facade(world):
         assert res["allgather"] == [float(i + 1) for i in range(world)], res
     assert results[0]["reduce"] == total
     assert results[1]["p2p"] == 1.0
+    for res in results:
+        assert res["allreduce_guarded"] == total, res
+
+
+def _subcomm_worker(accl, rank, world):
+    """Subcommunicator {0, 2} of a 3-process world: only member processes
+    run the sub-mesh program."""
+    import numpy as np
+
+    n = 16
+    comm = accl.create_communicator([0, 2])
+    if comm is None:
+        return None  # rank 1: not a member
+    s = accl.create_buffer_from(np.full(n, float(rank + 1), np.float32))
+    d = accl.create_buffer(n, np.float32)
+    accl.allreduce(s, d, n, comm=comm)
+    d.sync_from_device()
+    return float(d.data[0])
+
+
+def test_dist_subcommunicator():
+    results = launch_processes(
+        _subcomm_worker, world=3, base_port=47640, design="xla_dist",
+        timeout=300.0,
+    )
+    assert results == [4.0, None, 4.0]  # ranks 0+2: 1.0 + 3.0

@@ -132,7 +132,9 @@ def test_tuning_invalid_inputs(group2):
 # ---------------------------------------------------------------------------
 
 
-@pytest.mark.parametrize("algo", ["ring", "pallas_ring", "xla"])
+@pytest.mark.parametrize(
+    "algo", ["ring", "pallas_ring", "pallas_ring_bidir", "xla"]
+)
 def test_xla_allreduce_algorithm_via_facade(algo, rng):
     from accl_tpu.core import xla_group
 
