@@ -79,6 +79,21 @@ class DistEngine(StreamPortMixin, BaseEngine):
         self.tuning = {"allreduce_algorithm": "xla", "ring_segments": 1}
         self._init_streams()
         self._meshes: Dict[tuple, object] = {}
+        # one serialized executor thread (the FPGAQueue role): calls run
+        # in submission order — the property SPMD needs — while start()
+        # returns immediately so facade timeouts can fire even if a
+        # mismatched cross-process program wedges the executor (the
+        # reference's wedged-CCLO failure mode, recovered by re-init)
+        from ...request import CommandQueue
+
+        self._queue = CommandQueue()
+        self._shut = False
+        import threading
+
+        self._executor = threading.Thread(
+            target=self._run, name="accl-dist-engine", daemon=True
+        )
+        self._executor.start()
         # global rank -> that process's first device (a process may hold
         # several local devices, e.g. a forced multi-device CPU host or a
         # TPU host with 4 chips; the MPI-like facade rank uses the first)
@@ -117,29 +132,50 @@ class DistEngine(StreamPortMixin, BaseEngine):
     # -- call entry ----------------------------------------------------------
     def start(self, options: CallOptions) -> Request:
         req = Request(op_name=options.op.name)
-        req.mark_executing()
-        t0 = time.perf_counter_ns()
-
-        def run():
-            try:
-                code = self._dispatch(options)
-            except Exception:
-                traceback.print_exc()
-                code = ErrorCode.INVALID_OPERATION
-            req.complete(code, time.perf_counter_ns() - t0)
-
         if options.stream & StreamFlags.OP0_STREAM:
-            # the streaming operand arrives asynchronously (a device
-            # kernel's push, possibly from this thread after run_async):
-            # block off-thread.  NOTE: the caller must still keep the
-            # cross-process collective ORDER consistent — the same
-            # contract MPI nonblocking collectives impose.
+            # ANY streaming-operand op must not occupy the serialized
+            # executor while waiting for the local kernel push (which may
+            # come from the submitting thread after run_async — head-of-
+            # line blocking would wedge the rank).  It runs on its own
+            # thread; the caller must keep the cross-process op ORDER
+            # consistent, the contract MPI nonblocking collectives impose.
             import threading
 
-            threading.Thread(target=run, daemon=True).start()
+            threading.Thread(
+                target=self._execute, args=(options, req), daemon=True
+            ).start()
         else:
-            run()
+            try:
+                self._queue.push((options, req))
+            except RuntimeError:  # engine shut down
+                req.mark_executing()
+                req.complete(ErrorCode.INVALID_OPERATION)
         return req
+
+    def _run(self) -> None:
+        while not self._shut:
+            item = self._queue.pop(timeout=0.5)
+            if item is None:
+                continue  # timeout/spurious wake; re-check shutdown
+            self._execute(*item)
+        # drain: abandoned queued requests complete with an error instead
+        # of leaving waiters blocked forever
+        while True:
+            item = self._queue.pop(timeout=0)
+            if item is None:
+                return
+            item[1].mark_executing()
+            item[1].complete(ErrorCode.INVALID_OPERATION)
+
+    def _execute(self, options: CallOptions, req: Request) -> None:
+        req.mark_executing()
+        t0 = time.perf_counter_ns()
+        try:
+            code = self._dispatch(options)
+        except Exception:
+            traceback.print_exc()
+            code = ErrorCode.INVALID_OPERATION
+        req.complete(code, time.perf_counter_ns() - t0)
 
     def _dispatch(self, options: CallOptions) -> ErrorCode:
         op = options.op
@@ -415,7 +451,13 @@ class DistEngine(StreamPortMixin, BaseEngine):
         return apply_tuning(self.tuning, options)
 
     def shutdown(self) -> None:
-        pass
+        self._shut = True
+        self._queue.close()
+        # executor exits at its next 0.5s poll and drains the queue; a
+        # wedged in-flight program (mismatched cross-process call) cannot
+        # be interrupted — the daemon thread dies with the process, the
+        # reference's wedged-CCLO failure mode
+        self._executor.join(timeout=2.0)
 
 
 def dist_group_member(

@@ -243,6 +243,30 @@ def _bench_train_mfu(small: bool = False) -> dict:
     return out
 
 
+def _bench_facade_overhead() -> float:
+    """Per-call latency (us) of a small collective through the full MPI
+    facade (buffer -> CallOptions -> gang -> jitted program -> result
+    adoption).  The reference's equivalent is the hostctrl kernel-start +
+    firmware round trip per call; here it bounds the Python control
+    plane's cost — the data path itself is device-resident."""
+    from accl_tpu.core import xla_group
+
+    g = xla_group(1)
+    try:
+        a = g[0]
+        s = a.create_buffer_from(np.ones(1024, np.float32))
+        d = a.create_buffer(1024, np.float32)
+        a.allreduce(s, d, 1024)  # warm: compiles the program
+        iters = 50 if _SMALL else 300
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            a.allreduce(s, d, 1024)
+        return (time.perf_counter() - t0) / iters * 1e6
+    finally:
+        for x in g:
+            x.deinit()
+
+
 def _bench_ring_allreduce(ndev: int, algo: str = "xla") -> float:
     """Bus bandwidth of a K-iteration device-side allreduce loop over the
     mesh; slope timing so dispatch cancels out.  ``algo`` picks the XLA
@@ -379,6 +403,10 @@ def main() -> None:
             lambda: _bench_cast_pallas(stochastic=True),
         )
         _try(extras, errors, "quant_int8_pallas", _bench_quant_int8_pallas)
+
+    _try(
+        extras, errors, "facade_call_overhead_us", _bench_facade_overhead
+    )
 
     # flagship train-step MFU (small shapes off-TPU so CI smoke runs fast)
     _try(
