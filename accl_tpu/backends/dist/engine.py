@@ -451,8 +451,10 @@ class DistEngine(StreamPortMixin, BaseEngine):
         return apply_tuning(self.tuning, options)
 
     def shutdown(self) -> None:
-        self._shut = True
+        # close FIRST so a racing start() either lands before (drained) or
+        # gets the closed-queue error — never a forever-queued request
         self._queue.close()
+        self._shut = True
         # executor exits at its next 0.5s poll and drains the queue; a
         # wedged in-flight program (mismatched cross-process call) cannot
         # be interrupted — the daemon thread dies with the process, the

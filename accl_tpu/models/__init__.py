@@ -22,3 +22,5 @@ from ..ops.pallas.attention import (  # noqa: F401
     ring_attention as ring_attention_pallas,
 )
 from .ulysses_attention import ulysses_attention  # noqa: F401
+from .moe import init_moe_params, moe_ffn  # noqa: F401
+from .pipeline import pipeline_apply, pipeline_loss  # noqa: F401

@@ -1,0 +1,105 @@
+"""Pipeline parallelism (pp): GPipe-style microbatch streaming over a mesh
+axis, the stage handoff a neighbor ``ppermute`` on ICI.
+
+The fifth first-class sharding axis of the flagship family (dp x tp x sp x
+ep x pp): each ``pp`` rank owns one contiguous span of layers; M
+microbatches stream through the S stages in M + S - 1 steps, stage s
+working on microbatch t - s at step t.  The inter-stage edge is the same
+neighbor collective-permute the ring collectives are built from — on real
+slices the activations ride one ICI hop per stage boundary.
+
+Everything is static-shaped and uniform SPMD: every rank executes every
+step, with validity predicated in data (``jnp.where``), never in
+communication — the discipline that keeps XLA's collective schedule
+deadlock-free (and matches the Pallas kernel tier's design rule).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_apply(
+    stage_params,
+    microbatches: jax.Array,
+    pp_axis: str,
+    stage_fn: Callable,
+):
+    """Run ``microbatches`` through the S-stage pipeline.
+
+    Inside ``shard_map`` over ``pp_axis``:
+
+    * ``stage_params``: THIS rank's stage parameters (stage ``i`` = rank
+      ``i``'s layer span);
+    * ``microbatches``: (M, ...) inputs to stage 0, replicated on every
+      rank (only stage 0 reads them);
+    * ``stage_fn(stage_params, x) -> y``: one stage's computation; input
+      and output must share shape/dtype (the homogeneous-stage contract).
+
+    Returns (M, ...) final-stage outputs, valid on the LAST stage (other
+    ranks return zeros — the caller broadcasts or reads the last rank,
+    like a rooted collective's DummyBuffer convention).
+    """
+    S = lax.axis_size(pp_axis)
+    me = lax.axis_index(pp_axis)
+    M = microbatches.shape[0]
+    mb_shape = microbatches.shape[1:]
+
+    fwd = [(i, i + 1) for i in range(S - 1)]  # stage s -> s+1 edges
+
+    def step(t, state):
+        carry, outputs = state
+        mb = t - me  # which microbatch this stage works on at step t
+        idx = jnp.clip(mb, 0, M - 1)
+        valid = (mb >= 0) & (mb < M)
+        inp = jnp.where(
+            me == 0, lax.dynamic_index_in_dim(microbatches, idx, 0, False),
+            carry,
+        )
+        act = stage_fn(stage_params, inp)
+        act = jnp.where(valid, act, jnp.zeros_like(act))
+        # the last stage banks its result; everyone else hands off
+        bank = jnp.where(valid & (me == S - 1), act, outputs[idx])
+        outputs = outputs.at[idx].set(bank)
+        # stage handoff: one ICI hop (uniform: every rank permutes every
+        # step; invalid lanes carry zeros)
+        return lax.ppermute(act, pp_axis, fwd), outputs
+
+    carry = jnp.zeros(mb_shape, microbatches.dtype)  # activation entering me
+    outputs = jnp.zeros((M,) + mb_shape, microbatches.dtype)
+    # the schedule is step-index-uniform, so the whole pipeline is ONE
+    # compiled loop body (O(1) program size in M and S, differentiable)
+    _, outputs = lax.fori_loop(
+        0, M + S - 1, step, (carry, outputs), unroll=False
+    )
+    return outputs
+
+
+def pipeline_loss(
+    stage_params,
+    microbatches: jax.Array,
+    targets: jax.Array,
+    pp_axis: str,
+    stage_fn: Callable,
+    loss_fn: Callable,
+):
+    """Pipeline forward + per-microbatch loss.
+
+    ``loss_fn(final_activations, targets_mb) -> scalar``; the mean loss is
+    computed on the last stage and broadcast to all pp ranks (a masked
+    psum), so every rank returns the same differentiable scalar —
+    ``jax.grad`` through it yields each stage's parameter gradients with
+    the activation/gradient handoffs transposed onto the reverse edges
+    automatically.
+    """
+    S = lax.axis_size(pp_axis)
+    me = lax.axis_index(pp_axis)
+    M = microbatches.shape[0]
+    outs = pipeline_apply(stage_params, microbatches, pp_axis, stage_fn)
+    per_mb = jax.vmap(loss_fn)(outs, targets)  # (M,)
+    local = jnp.where(me == S - 1, per_mb.mean(), 0.0)
+    return lax.psum(local, pp_axis)
