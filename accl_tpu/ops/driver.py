@@ -52,69 +52,65 @@ def _smap(mesh: Mesh, fn, in_spec, out_spec, donate: bool = False):
 
 
 @lru_cache(maxsize=256)
-def _program(op: str, mesh_id: int, fn: ReduceFunction, extra=None):
+def _program(op: str, mesh_id: int, fn: ReduceFunction, extra=None,
+             flat: bool = False):
+    """``flat=False``: operands/results are (size, w) stacked arrays (the
+    host/test convention).  ``flat=True``: 1-D (size*w,) globals whose
+    per-rank shards ARE raw (w,) device arrays — the engine's zero-dispatch
+    path (a rank's HBM buffer plugs in as a shard with no reshape program,
+    and result shards adopt straight into buffers)."""
     mesh = _MESHES[mesh_id]
     spec = P(AXIS)
 
     if op == "allreduce":
-        body = lambda x: collectives.allreduce(x[0], AXIS, fn)[None]
+        sfn = lambda x: collectives.allreduce(x, AXIS, fn)
     elif op == "ring_allreduce":
         nseg = extra or 1
-        body = lambda x: ring.ring_allreduce(x[0], AXIS, fn, nseg)[None]
+        sfn = lambda x: ring.ring_allreduce(x, AXIS, fn, nseg)
     elif op == "pallas_allreduce":
         nseg, wire, bidir = extra  # (num_segments, wire_dtype_name, bidir)
         nseg = nseg or 1
-        body = lambda x: pallas.ring_allreduce(
-            x[0], AXIS, fn, nseg,
+        sfn = lambda x: pallas.ring_allreduce(
+            x, AXIS, fn, nseg,
             bidirectional=bidir,
             wire_dtype=wire and jnp.dtype(wire),
-        )[None]
+        )
     elif op == "compressed_allreduce":
         wire = jnp.dtype(extra or "bfloat16")
-        body = lambda x: collectives.compressed_allreduce(
-            x[0], AXIS, wire, fn
-        )[None]
+        sfn = lambda x: collectives.compressed_allreduce(x, AXIS, wire, fn)
     elif op == "reduce":
-        body = lambda x: collectives.reduce(x[0], AXIS, extra, fn)[None]
+        sfn = lambda x: collectives.reduce(x, AXIS, extra, fn)
     elif op == "pallas_reduce":
         root, nseg = extra
-        body = lambda x: pallas.ring_reduce(
-            x[0], AXIS, root, fn, nseg or 1
-        )[None]
+        sfn = lambda x: pallas.ring_reduce(x, AXIS, root, fn, nseg or 1)
     elif op == "pallas_bcast":
         root, nseg = extra
-        body = lambda x: pallas.ring_bcast(x[0], AXIS, root, nseg or 1)[None]
+        sfn = lambda x: pallas.ring_bcast(x, AXIS, root, nseg or 1)
     elif op == "pallas_scatter":
         root, nseg = extra
-        body = lambda x: pallas.ring_scatter(
-            x[0], AXIS, root, nseg or 1
-        )[None]
+        sfn = lambda x: pallas.ring_scatter(x, AXIS, root, nseg or 1)
     elif op == "pallas_gather":
         root, nseg = extra
-        body = lambda x: pallas.ring_gather(
-            x[0], AXIS, root, nseg or 1
-        )[None]
+        sfn = lambda x: pallas.ring_gather(x, AXIS, root, nseg or 1)
     elif op == "reduce_scatter":
-        body = lambda x: collectives.reduce_scatter(x[0], AXIS, fn, tiled=True)[None]
+        sfn = lambda x: collectives.reduce_scatter(x, AXIS, fn, tiled=True)
     elif op == "allgather":
-        body = lambda x: collectives.allgather(x[0], AXIS, tiled=True)[None]
-    elif op == "bcast":
-        body = lambda x: collectives.bcast(x[0], AXIS, extra)[None]
-    elif op == "bcast_inplace":
-        # donating variant for the engine's device-resident in-place bcast
-        # (op0 IS res on every rank); the public run_bcast never donates —
-        # callers may hold the input array
-        body = lambda x: collectives.bcast(x[0], AXIS, extra)[None]
-        return _smap(mesh, body, (spec,), spec, donate=True)
+        sfn = lambda x: collectives.allgather(x, AXIS, tiled=True)
+    elif op in ("bcast", "bcast_inplace"):
+        # bcast_inplace: donating variant for the engine's device-resident
+        # in-place bcast (op0 IS res on every rank); the public run_bcast
+        # never donates — callers may hold the input array
+        sfn = lambda x: collectives.bcast(x, AXIS, extra)
     elif op == "scatter":
-        body = lambda x: collectives.scatter(x[0], AXIS, extra)[None]
+        sfn = lambda x: collectives.scatter(x, AXIS, extra)
     elif op == "gather":
-        body = lambda x: collectives.gather(x[0], AXIS, extra)[None]
+        sfn = lambda x: collectives.gather(x, AXIS, extra)
     elif op == "alltoall":
-        body = lambda x: collectives.alltoall(x[0], AXIS)[None]
+        sfn = lambda x: collectives.alltoall(x, AXIS)
     else:
         raise ValueError(op)
-    return _smap(mesh, body, (spec,), spec)
+    body = sfn if flat else (lambda x: sfn(x[0])[None])
+    return _smap(mesh, body, (spec,), spec, donate=op == "bcast_inplace")
 
 
 _MESHES = {}
@@ -134,19 +130,27 @@ def _put(stacked, mesh: Mesh):
     return jax.device_put(stacked, sharding)
 
 
+def _is_flat(stacked) -> bool:
+    return getattr(stacked, "ndim", 2) == 1
+
+
 def run_allreduce(stacked, mesh: Mesh, function=ReduceFunction.SUM):
     """stacked[r] = rank r's operand; returns stacked results (identical
-    rows).  One XLA all-reduce over the mesh axis."""
-    return _program("allreduce", _mesh_key(mesh), function)(_put(stacked, mesh))
+    rows).  One XLA all-reduce over the mesh axis.  A 1-D operand selects
+    the flat layout (shards are raw per-rank arrays; see _program)."""
+    return _program(
+        "allreduce", _mesh_key(mesh), function, flat=_is_flat(stacked)
+    )(_put(stacked, mesh))
 
 
 def run_ring_allreduce(
     stacked, mesh: Mesh, function=ReduceFunction.SUM, num_segments: int = 1
 ):
     """The explicit segmented-ring pipeline (algorithm-faithful mode)."""
-    return _program("ring_allreduce", _mesh_key(mesh), function, num_segments)(
-        _put(stacked, mesh)
-    )
+    return _program(
+        "ring_allreduce", _mesh_key(mesh), function, num_segments,
+        flat=_is_flat(stacked),
+    )(_put(stacked, mesh))
 
 
 def run_pallas_allreduce(
@@ -166,6 +170,7 @@ def run_pallas_allreduce(
     return _program(
         "pallas_allreduce", _mesh_key(mesh), function,
         (num_segments, wire_dtype, bool(bidirectional)),
+        flat=_is_flat(stacked),
     )(_put(stacked, mesh))
 
 
@@ -176,12 +181,15 @@ def run_compressed_allreduce(
     ETH_COMPRESSED analog); ``wire_dtype`` is a dtype name string so it can
     key the program cache."""
     return _program(
-        "compressed_allreduce", _mesh_key(mesh), function, str(wire_dtype)
+        "compressed_allreduce", _mesh_key(mesh), function, str(wire_dtype),
+        flat=_is_flat(stacked),
     )(_put(stacked, mesh))
 
 
 def run_reduce(stacked, mesh: Mesh, root=0, function=ReduceFunction.SUM):
-    return _program("reduce", _mesh_key(mesh), function, root)(_put(stacked, mesh))
+    return _program(
+        "reduce", _mesh_key(mesh), function, root, flat=_is_flat(stacked)
+    )(_put(stacked, mesh))
 
 
 def run_pallas_reduce(
@@ -191,21 +199,22 @@ def run_pallas_reduce(
     """Reduce-to-root as the rooted Pallas ring pipeline (algorithm-
     faithful mode; only the root row of the result is meaningful)."""
     return _program(
-        "pallas_reduce", _mesh_key(mesh), function, (root, num_segments)
+        "pallas_reduce", _mesh_key(mesh), function, (root, num_segments),
+        flat=_is_flat(stacked),
     )(_put(stacked, mesh))
 
 
 def run_pallas_bcast(stacked, mesh: Mesh, root=0, num_segments: int = 1):
     return _program(
         "pallas_bcast", _mesh_key(mesh), ReduceFunction.SUM,
-        (root, num_segments),
+        (root, num_segments), flat=_is_flat(stacked),
     )(_put(stacked, mesh))
 
 
 def run_pallas_scatter(stacked, mesh: Mesh, root=0, num_segments: int = 1):
     return _program(
         "pallas_scatter", _mesh_key(mesh), ReduceFunction.SUM,
-        (root, num_segments),
+        (root, num_segments), flat=_is_flat(stacked),
     )(_put(stacked, mesh))
 
 
@@ -214,44 +223,49 @@ def run_pallas_gather(stacked, mesh: Mesh, root=0, num_segments: int = 1):
     root's row is the result)."""
     return _program(
         "pallas_gather", _mesh_key(mesh), ReduceFunction.SUM,
-        (root, num_segments),
+        (root, num_segments), flat=_is_flat(stacked),
     )(_put(stacked, mesh))
 
 
 def run_reduce_scatter(stacked, mesh: Mesh, function=ReduceFunction.SUM):
-    return _program("reduce_scatter", _mesh_key(mesh), function)(
-        _put(stacked, mesh)
-    )
+    return _program(
+        "reduce_scatter", _mesh_key(mesh), function, flat=_is_flat(stacked)
+    )(_put(stacked, mesh))
 
 
 def run_allgather(stacked, mesh: Mesh):
-    return _program("allgather", _mesh_key(mesh), ReduceFunction.SUM)(
-        _put(stacked, mesh)
-    )
+    return _program(
+        "allgather", _mesh_key(mesh), ReduceFunction.SUM,
+        flat=_is_flat(stacked),
+    )(_put(stacked, mesh))
 
 
 def run_bcast(stacked, mesh: Mesh, root=0, donate: bool = False):
     """``donate=True`` hands the input's HBM to XLA (in-place bcast); only
     safe when the caller no longer needs the input array."""
     op = "bcast_inplace" if donate else "bcast"
-    return _program(op, _mesh_key(mesh), ReduceFunction.SUM, root)(
-        _put(stacked, mesh)
-    )
+    return _program(
+        op, _mesh_key(mesh), ReduceFunction.SUM, root,
+        flat=_is_flat(stacked),
+    )(_put(stacked, mesh))
 
 
 def run_scatter(stacked, mesh: Mesh, root=0):
-    return _program("scatter", _mesh_key(mesh), ReduceFunction.SUM, root)(
-        _put(stacked, mesh)
-    )
+    return _program(
+        "scatter", _mesh_key(mesh), ReduceFunction.SUM, root,
+        flat=_is_flat(stacked),
+    )(_put(stacked, mesh))
 
 
 def run_gather(stacked, mesh: Mesh, root=0):
-    return _program("gather", _mesh_key(mesh), ReduceFunction.SUM, root)(
-        _put(stacked, mesh)
-    )
+    return _program(
+        "gather", _mesh_key(mesh), ReduceFunction.SUM, root,
+        flat=_is_flat(stacked),
+    )(_put(stacked, mesh))
 
 
 def run_alltoall(stacked, mesh: Mesh):
-    return _program("alltoall", _mesh_key(mesh), ReduceFunction.SUM)(
-        _put(stacked, mesh)
-    )
+    return _program(
+        "alltoall", _mesh_key(mesh), ReduceFunction.SUM,
+        flat=_is_flat(stacked),
+    )(_put(stacked, mesh))

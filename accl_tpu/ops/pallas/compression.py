@@ -117,16 +117,19 @@ def cast(
 
 
 def _quantize_kernel(x_ref, values_ref, scales_ref):
+    # scales lives whole in SMEM (per-tile (1,1) blocks don't lower on
+    # real TPUs); each grid step writes its own slot.
+    i = pl.program_id(0)
     amax = jnp.max(jnp.abs(x_ref[:]))
     scale = jnp.maximum(amax / 127.0, 1e-30)
-    scales_ref[0, 0] = scale
+    scales_ref[i, 0] = scale
     values_ref[:] = jnp.clip(
         jnp.round(x_ref[:] / scale), -127, 127
     ).astype(jnp.int8)
 
 
 def _dequantize_kernel(values_ref, scales_ref, o_ref):
-    o_ref[:] = values_ref[:].astype(jnp.float32) * scales_ref[0, 0]
+    o_ref[:] = values_ref[:].astype(jnp.float32) * scales_ref[pl.program_id(0), 0]
 
 
 def quantize_int8(
@@ -139,7 +142,7 @@ def quantize_int8(
     br = block_rows(rows)
     grid = (rows // br,)
     vspec = pl.BlockSpec((br, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM)
-    sspec = pl.BlockSpec((1, 1), lambda i: (i, 0), memory_space=pltpu.SMEM)
+    sspec = pl.BlockSpec(memory_space=pltpu.SMEM)  # whole array, every step
     values, scales = pl.pallas_call(
         _quantize_kernel,
         out_shape=(
@@ -169,7 +172,7 @@ def dequantize_int8(
     br = rows // scales.shape[0]
     grid = (rows // br,)
     vspec = pl.BlockSpec((br, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM)
-    sspec = pl.BlockSpec((1, 1), lambda i: (i, 0), memory_space=pltpu.SMEM)
+    sspec = pl.BlockSpec(memory_space=pltpu.SMEM)  # whole array, every step
     out = pl.pallas_call(
         _dequantize_kernel,
         out_shape=jax.ShapeDtypeStruct((rows, LANES), jnp.float32),
