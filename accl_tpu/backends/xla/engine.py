@@ -28,6 +28,7 @@ from __future__ import annotations
 import functools
 import threading
 import time
+import weakref
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -554,20 +555,25 @@ class XLAGangContext:
         # assembled-global reuse: keyed by the BUFFER identities (stable
         # across in-place loops, unlike shard ids), re-validated against
         # each buffer's current _dev; a stale entry is REPLACED under its
-        # key, so repeated in-place calls can't accumulate dead entries
-        # that pin HBM.  Donating ops (bcast) bypass the cache entirely.
+        # key, so repeated in-place calls can't accumulate dead entries.
+        # Buffers are held by WEAKREF with eviction callbacks — the cached
+        # global (which pins every shard's HBM) dies with its buffers, so
+        # the cache never outlives what the application released.
+        # Donating ops (bcast) bypass the cache entirely.
         cacheable = raw_bufs is not None and op != Operation.BCAST
         global_arr = None
         key = None
         if cacheable:
             key = (tuple(map(id, raw_bufs)), in_w)
             hit = self._asm_cache.get(key)
-            if (
-                hit is not None
-                and all(b is hb for b, hb in zip(raw_bufs, hit[2]))
-                and all(s is b._dev for s, b in zip(hit[1], raw_bufs))
-            ):
-                global_arr = hit[0]
+            if hit is not None:
+                hit_bufs = [r() for r in hit[2]]
+                if all(
+                    b is hb for b, hb in zip(raw_bufs, hit_bufs)
+                ) and all(
+                    s is b._dev for s, b in zip(hit[1], raw_bufs)
+                ):
+                    global_arr = hit[0]
         if global_arr is None:
             global_arr = jax.make_array_from_single_device_arrays(
                 (size * in_w,),
@@ -577,7 +583,15 @@ class XLAGangContext:
             if cacheable:
                 if len(self._asm_cache) >= 64 and key not in self._asm_cache:
                     self._asm_cache.clear()
-                self._asm_cache[key] = (global_arr, shards, raw_bufs)
+
+                def _evict(_ref, cache=self._asm_cache, key=key):
+                    cache.pop(key, None)
+
+                self._asm_cache[key] = (
+                    global_arr,
+                    shards,
+                    [weakref.ref(b, _evict) for b in raw_bufs],
+                )
 
         fn = lead.reduce_function
         if op == Operation.ALLREDUCE:

@@ -116,45 +116,60 @@ def cast(
     return unpack_lanes(out, n, x.shape)
 
 
-def _quantize_kernel(x_ref, values_ref, scales_ref):
-    # scales lives whole in SMEM (per-tile (1,1) blocks don't lower on
-    # real TPUs); each grid step writes its own slot.
-    i = pl.program_id(0)
-    amax = jnp.max(jnp.abs(x_ref[:]))
-    scale = jnp.maximum(amax / 127.0, 1e-30)
-    scales_ref[i, 0] = scale
+def _quantize_kernel(scales_ref, x_ref, values_ref):
+    # per-tile scale arrives via scalar prefetch (SMEM); outputs that are
+    # revisited across grid steps ((1,1) SMEM blocks, or whole-array
+    # outputs written one slot per step) either fail to lower or wedge
+    # the TPU runtime under fori_loop, so the kernel never writes scales
+    # — the XLA pre-pass computes them
+    scale = scales_ref[pl.program_id(0)]
     values_ref[:] = jnp.clip(
         jnp.round(x_ref[:] / scale), -127, 127
     ).astype(jnp.int8)
 
 
-def _dequantize_kernel(values_ref, scales_ref, o_ref):
-    o_ref[:] = values_ref[:].astype(jnp.float32) * scales_ref[pl.program_id(0), 0]
+def _dequantize_kernel(scales_ref, values_ref, o_ref):
+    o_ref[:] = (
+        values_ref[:].astype(jnp.float32) * scales_ref[pl.program_id(0)]
+    )
+
+
+def _tile_specs(br: int):
+    # index maps under scalar prefetch also receive the scalar ref
+    return pl.BlockSpec(
+        (br, LANES), lambda i, s_ref: (i, 0), memory_space=pltpu.VMEM
+    )
 
 
 def quantize_int8(
     x: jax.Array, *, interpret: InterpretArg = None
 ):
     """Blockwise int8 quantization: returns ``(values, scales, n)`` where
-    each grid tile carries one fp32 scale (absmax / 127)."""
+    each grid tile carries one fp32 scale (absmax / 127).
+
+    The scales are an XLA reduction pass over the tiles; the Pallas kernel
+    consumes them as scalar-prefetch operands and emits only the lane-
+    aligned int8 payload."""
     xp, n = pack_lanes(x.astype(jnp.float32))
     rows = xp.shape[0]
     br = block_rows(rows)
-    grid = (rows // br,)
-    vspec = pl.BlockSpec((br, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM)
-    sspec = pl.BlockSpec(memory_space=pltpu.SMEM)  # whole array, every step
-    values, scales = pl.pallas_call(
+    nblk = rows // br
+    scales = jnp.maximum(
+        jnp.max(jnp.abs(xp.reshape(nblk, br * LANES)), axis=1) / 127.0,
+        1e-30,
+    )
+    values = pl.pallas_call(
         _quantize_kernel,
-        out_shape=(
-            jax.ShapeDtypeStruct((rows, LANES), jnp.int8),
-            jax.ShapeDtypeStruct((rows // br, 1), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((rows, LANES), jnp.int8),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(nblk,),
+            in_specs=[_tile_specs(br)],
+            out_specs=_tile_specs(br),
         ),
-        grid=grid,
-        in_specs=[vspec],
-        out_specs=(vspec, sspec),
         interpret=default_interpret(interpret),
-    )(xp)
-    return values, scales, n
+    )(scales, xp)
+    return values, scales.reshape(nblk, 1), n
 
 
 def dequantize_int8(
@@ -169,16 +184,17 @@ def dequantize_int8(
     """Inverse of :func:`quantize_int8`.  ``dtype`` restores the original
     operand dtype (quantization always computes in float32)."""
     rows = values.shape[0]
-    br = rows // scales.shape[0]
-    grid = (rows // br,)
-    vspec = pl.BlockSpec((br, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM)
-    sspec = pl.BlockSpec(memory_space=pltpu.SMEM)  # whole array, every step
+    nblk = scales.shape[0]
+    br = rows // nblk
     out = pl.pallas_call(
         _dequantize_kernel,
         out_shape=jax.ShapeDtypeStruct((rows, LANES), jnp.float32),
-        grid=grid,
-        in_specs=[vspec, sspec],
-        out_specs=vspec,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(nblk,),
+            in_specs=[_tile_specs(br)],
+            out_specs=_tile_specs(br),
+        ),
         interpret=default_interpret(interpret),
-    )(values, scales)
+    )(scales.reshape(-1), values)
     return unpack_lanes(out, n, shape, dtype=dtype)

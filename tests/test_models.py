@@ -91,6 +91,29 @@ def test_sharded_train_step_matches_single_device(cfg, mesh22):
         )
 
 
+def test_remat_train_step_matches_plain(cfg, mesh22):
+    """remat=True (jax.checkpoint around each block) changes the backward
+    schedule, not the math: same loss and same updated params."""
+    import dataclasses
+
+    key = jax.random.PRNGKey(4)
+    params = init_params(key, cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(5), (4, 16), 0, cfg.vocab)
+    targets = jnp.roll(tokens, -1, axis=1)
+
+    outs = []
+    for remat in (False, True):
+        c = dataclasses.replace(cfg, remat=remat)
+        step, shard = make_sharded_train_step(c, mesh22, lr=0.05)
+        new_params, loss = step(shard(params), tokens, targets)
+        outs.append((float(loss), jax.tree.leaves(new_params)))
+    assert outs[0][0] == pytest.approx(outs[1][0], rel=1e-6)
+    for a, b in zip(outs[0][1], outs[1][1]):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        )
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_attention_matches_reference(causal):
     B, H, T, D = 2, 2, 64, 16

@@ -72,6 +72,36 @@ def test_combine_rejects_mismatch():
         pk.combine(jnp.zeros(4), jnp.zeros(5))
 
 
+@pytest.mark.parametrize(
+    "function", [ReduceFunction.SUM, ReduceFunction.MAX]
+)
+def test_combine_accumulate(function):
+    """In-place form: result aliases the first operand's storage (donated);
+    values match the out-of-place combine."""
+    rng = np.random.default_rng(3)
+    a_np = rng.standard_normal(1111).astype(np.float32)
+    b_np = rng.standard_normal(1111).astype(np.float32)
+    out = pk.combine(
+        jnp.asarray(a_np), jnp.asarray(b_np), function, accumulate=True
+    )
+    expect = (
+        a_np + b_np
+        if function == ReduceFunction.SUM
+        else np.maximum(a_np, b_np)
+    )
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-6)
+
+
+def test_combine_accumulate_rejects_cast():
+    with pytest.raises(ValueError):
+        pk.combine(
+            jnp.zeros(8, jnp.float32),
+            jnp.zeros(8, jnp.float32),
+            out_dtype=jnp.bfloat16,
+            accumulate=True,
+        )
+
+
 # ---------------------------------------------------------------------------
 # compression (hp_compression plugin)
 # ---------------------------------------------------------------------------
