@@ -78,9 +78,11 @@ def combine(
     ap, n = pack_lanes(a)
     bp, _ = pack_lanes(b)
     rows = ap.shape[0]
-    # block height by dtype width: ~1 MiB blocks (3 streams x 2 pipeline
-    # buffers stay well under VMEM for every dtype incl. f64)
-    br = block_rows(rows, want=max(512, 2048 * 4 // out_dtype.itemsize))
+    # block height by the WIDEST stream's dtype: ~1 MiB blocks, so the
+    # 3 streams x 2 pipeline buffers stay well under VMEM even for f64
+    # operands with a narrow fused output cast
+    widest = max(jnp.dtype(a.dtype).itemsize, out_dtype.itemsize)
+    br = block_rows(rows, want=max(512, 2048 * 4 // widest))
     grid = (rows // br,)
     spec = pl.BlockSpec((br, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM)
 

@@ -397,8 +397,13 @@ def _run_guarded() -> None:
         except subprocess.TimeoutExpired:
             reason = f"bench child exceeded {budget:.0f}s (device wedge?)"
             err_tail = []
-        ckpt.seek(0)
-        raw = ckpt.read()
+        # re-open by NAME: the child's atomic os.replace installed a new
+        # inode at this path, so the original handle sees only stale bytes
+        try:
+            with open(ckpt.name) as f:
+                raw = f.read()
+        except OSError:
+            raw = ""
     try:
         partial = json.loads(raw) if raw else {"extras": {}, "errors": {}}
     except json.JSONDecodeError:
@@ -406,27 +411,43 @@ def _run_guarded() -> None:
     extras, errors = partial["extras"], partial["errors"]
     errors["bench_harness"] = "; ".join([reason] + err_tail)[:400]
     print(f"bench FAILED: {reason}", file=sys.stderr)
-    # headline selection mirrors main(): the multi-chip bus-bandwidth
-    # metric (vs 100 GbE wire rate) when an allreduce number exists,
-    # else the single-chip combine datapath (vs the CCLO envelope)
-    bus = [extras[k] for k in ("allreduce_xla", "allreduce_ring")
-           if extras.get(k)]
-    dp = [extras[k] for k in ("combine_pallas", "combine_xla")
-          if extras.get(k)]
-    if bus:
-        metric, value, base = "allreduce_bus_bandwidth", max(bus), 12.5
-    else:
-        metric, value, base = (
-            "combine_datapath_bandwidth", max(dp) if dp else None, 16.0
-        )
-    print(json.dumps({
-        "metric": metric,
-        "value": value,
+    result = _headline(extras)
+    result["extras"] = extras
+    result["errors"] = errors
+    print(json.dumps(result))
+
+
+def _headline(extras: dict) -> dict:
+    """The one-line headline from whatever metrics exist — shared by the
+    normal path and the wedge-guard partial path so both report the same
+    way: multi-chip allreduce bus bandwidth (vs the 100 GbE wire rate of
+    12.5 GB/s) when present, else the single-chip combine datapath (vs
+    the CCLO 16 GB/s envelope), preferring the Pallas number when it
+    beats XLA's."""
+    bus = extras.get("allreduce_xla")
+    if bus is not None:
+        return {
+            "metric": "allreduce_bus_bandwidth",
+            "value": round(bus, 2),
+            "unit": "GB/s",
+            "vs_baseline": round(bus / 12.5, 2),
+        }
+    result = {
+        "metric": "combine_datapath_bandwidth",
+        "value": None,
         "unit": "GB/s",
-        "vs_baseline": round(value / base, 2) if value else None,
-        "extras": extras,
-        "errors": errors,
-    }))
+        "vs_baseline": None,
+    }
+    xla = extras.get("combine_xla")
+    pal = extras.get("combine_pallas")
+    if xla is not None:
+        result.update(value=round(xla, 2), vs_baseline=round(xla / 16.0, 2))
+    if pal is not None and (xla is None or pal > xla):
+        result.update(
+            value=round(pal, 2), vs_baseline=round(pal / 16.0, 2),
+            impl="pallas",
+        )
+    return result
 
 
 def main() -> None:
@@ -444,48 +465,18 @@ def main() -> None:
     errors: dict = {}
 
     if ndev >= 2:
-        bus = _try(
+        _try(
             extras, errors, "allreduce_xla",
             lambda: _bench_ring_allreduce(ndev),
         )
-        result = {
-            "metric": "allreduce_bus_bandwidth",
-            "value": round(bus, 2) if bus is not None else None,
-            "unit": "GB/s",
-            "vs_baseline": (
-                round(bus / 12.5, 2) if bus is not None else None
-            ),  # 100 GbE wire rate
-        }
         _try(
             extras, errors, "allreduce_ring",
             lambda: _bench_ring_allreduce(ndev, algo="ring"),
         )
     else:
-        xla_gbps = _try(
-            extras, errors, "combine_xla", _bench_combine_xla
-        )
-        result = {
-            "metric": "combine_datapath_bandwidth",
-            "value": round(xla_gbps, 2) if xla_gbps is not None else None,
-            "unit": "GB/s",
-            "vs_baseline": (
-                round(xla_gbps / 16.0, 2) if xla_gbps is not None else None
-            ),  # CCLO datapath
-        }
+        _try(extras, errors, "combine_xla", _bench_combine_xla)
         if on_tpu or _SMALL:
-            pallas_gbps = _try(
-                extras, errors, "combine_pallas", _bench_combine_pallas
-            )
-            if (
-                pallas_gbps is not None
-                and xla_gbps is not None
-                and pallas_gbps > xla_gbps
-            ):
-                result.update(
-                    value=round(pallas_gbps, 2),
-                    vs_baseline=round(pallas_gbps / 16.0, 2),
-                    impl="pallas",
-                )
+            _try(extras, errors, "combine_pallas", _bench_combine_pallas)
 
     # per-kernel compression lanes: Mosaic-compiled on TPU; elsewhere the
     # interpreter would grind for hours at full size, so only the _SMALL
@@ -508,6 +499,7 @@ def main() -> None:
         lambda: _bench_train_mfu(small=_SMALL or not on_tpu),
     )
 
+    result = _headline(extras)
     result["device"] = jax.devices()[0].device_kind
     result["extras"] = extras
     if errors:

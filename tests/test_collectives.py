@@ -435,3 +435,124 @@ def test_concurrent_collectives_different_comms(group4, rng):
     res = run_parallel(group4, work)
     np.testing.assert_allclose(res[0], data[0] + data[1], rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(res[3], data[2] + data[3], rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# compressed variants of every collective (ref test.cpp:508-1129 runs a
+# _compressed twin of each op; fp32 payload, fp16 on the wire)
+# ---------------------------------------------------------------------------
+
+_CTOL = dict(rtol=2e-2, atol=2e-2)
+
+
+def test_scatter_compressed(group4, rng):
+    size = len(group4)
+    count = 1500
+    data = rng.standard_normal(size * count).astype(np.float32)
+
+    def work(accl, rank):
+        send = accl.create_buffer_from(data) if rank == 1 else None
+        recv = accl.create_buffer(count, np.float32)
+        accl.scatter(send, recv, count, root=1, compress_dtype=np.float16)
+        recv.sync_from_device()
+        return recv.data.copy()
+
+    for r, got in enumerate(run_parallel(group4, work)):
+        np.testing.assert_allclose(
+            got, data[r * count : (r + 1) * count], **_CTOL
+        )
+
+
+def test_gather_compressed(group4, rng):
+    size = len(group4)
+    count = 1500
+    chunks = [rng.standard_normal(count).astype(np.float32) for _ in range(size)]
+
+    def work(accl, rank):
+        send = accl.create_buffer_from(chunks[rank])
+        recv = accl.create_buffer(size * count, np.float32) if rank == 2 else None
+        accl.gather(send, recv, count, root=2, compress_dtype=np.float16)
+        if rank == 2:
+            recv.sync_from_device()
+            return recv.data.copy()
+        return None
+
+    res = run_parallel(group4, work)
+    np.testing.assert_allclose(res[2], np.concatenate(chunks), **_CTOL)
+
+
+def test_allgather_compressed(group4, rng):
+    size = len(group4)
+    count = 1500
+    chunks = [rng.standard_normal(count).astype(np.float32) for _ in range(size)]
+
+    def work(accl, rank):
+        send = accl.create_buffer_from(chunks[rank])
+        recv = accl.create_buffer(size * count, np.float32)
+        accl.allgather(send, recv, count, compress_dtype=np.float16)
+        recv.sync_from_device()
+        return recv.data.copy()
+
+    for got in run_parallel(group4, work):
+        np.testing.assert_allclose(got, np.concatenate(chunks), **_CTOL)
+
+
+def test_reduce_compressed(group4, rng):
+    count = 1500
+    chunks = [rng.standard_normal(count).astype(np.float32) for _ in group4]
+    expected = np.sum(chunks, axis=0)
+
+    def work(accl, rank):
+        send = accl.create_buffer_from(chunks[rank])
+        recv = accl.create_buffer(count, np.float32) if rank == 3 else None
+        accl.reduce(send, recv, count, root=3, compress_dtype=np.float16)
+        if rank == 3:
+            recv.sync_from_device()
+            return recv.data.copy()
+        return None
+
+    res = run_parallel(group4, work)
+    np.testing.assert_allclose(res[3], expected, rtol=5e-2, atol=5e-2)
+
+
+def test_reduce_scatter_compressed(group4, rng):
+    size = len(group4)
+    count = 1500
+    full = [rng.standard_normal(size * count).astype(np.float32) for _ in group4]
+    expected = np.sum(full, axis=0)
+
+    def work(accl, rank):
+        send = accl.create_buffer_from(full[rank])
+        recv = accl.create_buffer(count, np.float32)
+        accl.reduce_scatter(send, recv, count, compress_dtype=np.float16)
+        recv.sync_from_device()
+        return recv.data.copy()
+
+    res = run_parallel(group4, work)
+    for r, got in enumerate(res):
+        np.testing.assert_allclose(
+            got, expected[r * count : (r + 1) * count], rtol=5e-2, atol=5e-2
+        )
+
+
+def test_alltoall_compressed(group4, rng):
+    """Beyond the reference: its eager/compressed all_to_all returns
+    COLLECTIVE_NOT_IMPLEMENTED (ccl_offload_control.c:2123-2218); ours
+    runs the compression lanes on every transport."""
+    size = len(group4)
+    count = 700
+    mats = [rng.standard_normal(size * count).astype(np.float32) for _ in group4]
+
+    def work(accl, rank):
+        send = accl.create_buffer_from(mats[rank])
+        recv = accl.create_buffer(size * count, np.float32)
+        accl.alltoall(send, recv, count, compress_dtype=np.float16)
+        recv.sync_from_device()
+        return recv.data.copy()
+
+    res = run_parallel(group4, work)
+    for r, got in enumerate(res):
+        expected = np.concatenate(
+            [mats[p][r * count : (r + 1) * count] for p in range(size)]
+        )
+        np.testing.assert_allclose(got, expected, **_CTOL)

@@ -395,3 +395,58 @@ def test_mixed_host_operand_falls_back(dgroup4):
     for r in range(4):
         recv[r].sync_from_device()
         np.testing.assert_allclose(recv[r].data, 10.0)
+
+
+@pytest.mark.parametrize("op", ["scatter", "gather", "allgather", "reduce_scatter"])
+def test_compressed_collectives_device_path(dgroup4, op):
+    """ETH_COMPRESSED rooted/data-movement collectives stay device-resident:
+    the flat-layout prep program applies the wire-dtype rounding on-chip
+    (the hp_compression operand lanes), no host transfers permitted."""
+    size = 4
+    n = 32
+    rng = np.random.default_rng(11)
+    wide = op in ("scatter", "reduce_scatter")
+    in_w = size * n if wide else n
+    data = [rng.standard_normal(in_w).astype(np.float32) for _ in range(size)]
+    send = [a.create_buffer_from(data[r]) for r, a in enumerate(dgroup4)]
+    out_w = size * n if op in ("gather", "allgather") else n
+    recv = [a.create_buffer(out_w, np.float32) for a in dgroup4]
+
+    def work(a, r):
+        with jax.transfer_guard("disallow"):
+            if op == "scatter":
+                a.scatter(send[r], recv[r], n, root=0, compress_dtype=np.float16)
+            elif op == "gather":
+                a.gather(send[r], recv[r], n, root=0, compress_dtype=np.float16)
+            elif op == "allgather":
+                a.allgather(send[r], recv[r], n, compress_dtype=np.float16)
+            else:
+                a.reduce_scatter(send[r], recv[r], n, compress_dtype=np.float16)
+
+    run_parallel(dgroup4, work)
+    tol = dict(rtol=5e-2, atol=5e-2)
+    rounded = [d.astype(np.float16).astype(np.float32) for d in data]
+    if op == "scatter":
+        for r in range(size):
+            recv[r].sync_from_device()
+            np.testing.assert_allclose(
+                recv[r].data, rounded[0][r * n : (r + 1) * n], **tol
+            )
+    elif op == "gather":
+        recv[0].sync_from_device()
+        np.testing.assert_allclose(
+            recv[0].data, np.concatenate(rounded), **tol
+        )
+    elif op == "allgather":
+        for r in range(size):
+            recv[r].sync_from_device()
+            np.testing.assert_allclose(
+                recv[r].data, np.concatenate(rounded), **tol
+            )
+    else:
+        expected = np.sum(rounded, axis=0)
+        for r in range(size):
+            recv[r].sync_from_device()
+            np.testing.assert_allclose(
+                recv[r].data, expected[r * n : (r + 1) * n], **tol
+            )
