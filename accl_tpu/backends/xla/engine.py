@@ -419,7 +419,12 @@ class XLAGangContext:
             if any(sig(c) != sig(lead) for c in calls[1:]):
                 code = ErrorCode.INVALID_OPERATION  # mismatched gang calls
             else:
-                code = self._run_op(comm, calls, lead)
+                # named range in the xprof timeline (the per-call span the
+                # reference's perf counter provides, SURVEY §5 tracing)
+                with jax.profiler.TraceAnnotation(
+                    f"accl::{lead.op.name.lower()}"
+                ):
+                    code = self._run_op(comm, calls, lead)
         except Exception:
             import traceback
 

@@ -12,10 +12,13 @@ machinery is the substrate for.
 
 from .transformer import (  # noqa: F401
     TransformerConfig,
+    generate,
     init_params,
     forward,
+    make_sharded_generate,
     make_sharded_train_step,
     make_sharded_forward,
+    prefill,
 )
 from .ring_attention import ring_attention, reference_attention  # noqa: F401
 from ..ops.pallas.attention import (  # noqa: F401

@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import dataclasses
 from functools import partial
-from typing import Dict
+from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -125,27 +125,37 @@ def _attention(q, k, v):
     return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, axis=-1), v)
 
 
-def _block(x, lp, n_heads_local, tp_axis):
+def _mlp(x, lp, tp_axis):
+    """The block's MLP half (shared by train and decode paths): ln2 ->
+    column-parallel up, row-parallel down -> tp-allreduce, residual."""
+    h = _layernorm(x, lp["ln2"])
+    partial_f = jax.nn.gelu(h @ lp["w1"]) @ lp["w2"]
+    if tp_axis is not None:
+        partial_f = collectives.allreduce(partial_f, tp_axis, ReduceFunction.SUM)
+    return x + partial_f
+
+
+def _block(x, lp, n_heads_local, tp_axis, return_kv=False):
     """One transformer block on tp-sharded weights.  ``lp['wqkv']`` etc. are
     the *local shards*; the tp-allreduce after each row-parallel matmul is
-    the reference's fused-allreduce hot path in model form."""
+    the reference's fused-allreduce hot path in model form.
+
+    ``return_kv=True`` additionally returns the (k, v) head tensors
+    (B, H_local, T, hd) — the prefill path of the KV-cache decode."""
     B, T, D = x.shape
     h = _layernorm(x, lp["ln1"])
     q, k, v = h @ lp["wq"], h @ lp["wk"], h @ lp["wv"]  # column-parallel
     hd = q.shape[-1] // n_heads_local
     reshape = lambda t: t.reshape(B, T, n_heads_local, hd).transpose(0, 2, 1, 3)
-    attn = _attention(reshape(q), reshape(k), reshape(v))
+    q, k, v = reshape(q), reshape(k), reshape(v)
+    attn = _attention(q, k, v)
     attn = attn.transpose(0, 2, 1, 3).reshape(B, T, -1)
     partial_o = attn @ lp["wo"]  # row-parallel: partial sums
     if tp_axis is not None:
         partial_o = collectives.allreduce(partial_o, tp_axis, ReduceFunction.SUM)
     x = x + partial_o
-    h = _layernorm(x, lp["ln2"])
-    up = jax.nn.gelu(h @ lp["w1"])  # column-parallel
-    partial_f = up @ lp["w2"]  # row-parallel: partial sums
-    if tp_axis is not None:
-        partial_f = collectives.allreduce(partial_f, tp_axis, ReduceFunction.SUM)
-    return x + partial_f
+    out = _mlp(x, lp, tp_axis)
+    return (out, (k, v)) if return_kv else out
 
 
 def forward(params, tokens, cfg: TransformerConfig, tp_axis=None, tp_size=1):
@@ -168,6 +178,138 @@ def loss_fn(params, tokens, targets, cfg, tp_axis=None, tp_size=1):
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)
     return nll.mean()
+
+
+# ---------------------------------------------------------------------------
+# KV-cache decode (autoregressive generation)
+# ---------------------------------------------------------------------------
+
+
+def _block_decode(x_t, lp, cache_k, cache_v, pos, n_heads_local, tp_axis):
+    """One block for a single decode position: write this step's k/v into
+    the cache at ``pos`` (dynamic_update_slice keeps shapes static under
+    jit/scan), attend over positions <= pos, same tp collectives as the
+    training block.  Returns (x_out, cache_k, cache_v)."""
+    B, _, D = x_t.shape
+    h = _layernorm(x_t, lp["ln1"])
+    q, k, v = h @ lp["wq"], h @ lp["wk"], h @ lp["wv"]
+    hd = q.shape[-1] // n_heads_local
+    rs = lambda t: t.reshape(B, 1, n_heads_local, hd).transpose(0, 2, 1, 3)
+    q, k, v = rs(q), rs(k), rs(v)  # (B, Hl, 1, hd)
+    cache_k = jax.lax.dynamic_update_slice(cache_k, k, (0, 0, pos, 0))
+    cache_v = jax.lax.dynamic_update_slice(cache_v, v, (0, 0, pos, 0))
+    S = cache_k.shape[2]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, cache_k) / np.sqrt(hd)
+    mask = jnp.arange(S)[None, None, None, :] <= pos
+    scores = jnp.where(mask, scores, -1e30)
+    attn = jnp.einsum(
+        "bhqk,bhkd->bhqd", jax.nn.softmax(scores, axis=-1), cache_v
+    )
+    attn = attn.transpose(0, 2, 1, 3).reshape(B, 1, -1)
+    partial_o = attn @ lp["wo"]
+    if tp_axis is not None:
+        partial_o = collectives.allreduce(partial_o, tp_axis, ReduceFunction.SUM)
+    x = x_t + partial_o
+    return _mlp(x, lp, tp_axis), cache_k, cache_v
+
+
+def prefill(
+    params,
+    tokens,
+    cfg: TransformerConfig,
+    tp_axis=None,
+    tp_size=1,
+    cache_len: Optional[int] = None,
+):
+    """Run the prompt through the model once, building the KV cache.
+    Returns (last-position logits, caches) where caches is a list of
+    (k, v) arrays (B, H_local, cache_len, hd).  ``cache_len`` defaults to
+    ``cfg.max_seq``; size it to the exact prompt+steps length to avoid
+    attending over (and masking) dead cache positions."""
+    B, T = tokens.shape
+    S = cfg.max_seq if cache_len is None else int(cache_len)
+    x = params["embed"][tokens] + params["pos"][:T]
+    heads_local = cfg.n_heads // tp_size
+    hd = cfg.d_model // cfg.n_heads
+    caches = []
+    for lp in params["layers"]:
+        x, (k, v) = _block(x, lp, heads_local, tp_axis, return_kv=True)
+        shape = (B, heads_local, S, hd)
+        ck = jnp.zeros(shape, x.dtype).at[:, :, :T].set(k)
+        cv = jnp.zeros(shape, x.dtype).at[:, :, :T].set(v)
+        caches.append((ck, cv))
+    x = _layernorm(x, params["ln_f"])
+    return x[:, -1] @ params["embed"].T, caches
+
+
+def generate(
+    params,
+    prompt,
+    steps: int,
+    cfg: TransformerConfig,
+    tp_axis=None,
+    tp_size=1,
+):
+    """Greedy autoregressive decode: prefill the prompt, then ``steps``
+    single-token steps through the KV cache under one ``lax.scan`` (static
+    shapes, ONE compiled step body regardless of length).  Returns the
+    (B, steps) generated token ids."""
+    B, T = prompt.shape
+    if T + steps > cfg.max_seq:
+        raise ValueError(
+            f"prompt {T} + steps {steps} exceeds max_seq {cfg.max_seq}"
+        )
+    heads_local = cfg.n_heads // tp_size
+    logits, caches = prefill(
+        params, prompt, cfg, tp_axis, tp_size, cache_len=T + steps
+    )
+    first = jnp.argmax(logits, axis=-1).astype(prompt.dtype)  # (B,)
+
+    def step(carry, _):
+        caches, tok, pos = carry
+        pos_emb = jax.lax.dynamic_slice_in_dim(params["pos"], pos, 1, 0)
+        x = params["embed"][tok][:, None, :] + pos_emb[None, 0:1]
+        new_caches = []
+        for lp, (ck, cv) in zip(params["layers"], caches):
+            x, ck, cv = _block_decode(
+                x, lp, ck, cv, pos, heads_local, tp_axis
+            )
+            new_caches.append((ck, cv))
+        x = _layernorm(x, params["ln_f"])
+        logits = x[:, 0] @ params["embed"].T
+        nxt = jnp.argmax(logits, axis=-1).astype(tok.dtype)
+        return (new_caches, nxt, pos + 1), tok
+
+    (_, _, _), toks = jax.lax.scan(
+        step, (caches, first, jnp.asarray(T)), None, length=steps
+    )
+    # each iteration emits the token it fed: [g_0 .. g_{steps-1}]
+    return toks.T  # (B, steps)
+
+
+def make_sharded_generate(
+    cfg: TransformerConfig, mesh: Mesh, steps: int
+):
+    """Jitted dp/tp-sharded greedy generation over the mesh: the KV cache
+    lives head-sharded on the tp axis (each chip holds its heads' cache),
+    the batch dp-sharded — the serving-side layout of the training
+    parallelism plan.  Returns (fn, shard_fn)."""
+    specs = param_specs(cfg)
+    tp = mesh.shape["tp"]
+
+    def gen(params, prompt):
+        return generate(params, prompt, steps, cfg, "tp", tp)
+
+    fn = jax.jit(
+        shard_map(
+            gen,
+            mesh=mesh,
+            in_specs=(specs, P("dp", None)),
+            out_specs=P("dp", None),
+            check_vma=False,
+        )
+    )
+    return fn, partial(_shard_params, specs=specs, mesh=mesh)
 
 
 # ---------------------------------------------------------------------------

@@ -1,2 +1,9 @@
 from .timing import Timer  # noqa: F401
 from .logging import Log, LogLevel  # noqa: F401
+from .profiling import (  # noqa: F401
+    annotate,
+    device_memory_profile,
+    device_scope,
+    start_server,
+    trace,
+)

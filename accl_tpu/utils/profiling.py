@@ -1,0 +1,53 @@
+"""XLA profiler (xprof) hooks — the device-side tracing surface.
+
+The reference's tracing story is a free-running hardware counter copied
+into exchange memory per call (`ccl_offload_control.c:2279-2303`) plus
+host timers; the TPU-native equivalents layer up:
+
+* per-call ns: ``Request.get_duration_ns`` (already on every tier);
+* host spans: :func:`annotate` marks facade calls so they appear as
+  named ranges in the xprof timeline;
+* device spans: :func:`device_scope` names a region *inside* a jitted
+  program (XLA op metadata), so kernels show up attributed in the trace
+  viewer;
+* whole-program capture: :func:`trace` / :func:`start_server` drive
+  ``jax.profiler`` — open the result in xprof/tensorboard or perfetto.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+import jax
+
+# host-side named range (shows on the Python/host rows of the trace)
+annotate = jax.profiler.TraceAnnotation
+
+# in-program named scope (attaches XLA op metadata; shows on device rows)
+device_scope = jax.named_scope
+
+
+@contextlib.contextmanager
+def trace(logdir: str, *, host_tracer_level: int = 2) -> Iterator[None]:
+    """Capture a profiler trace of everything inside the block into
+    ``logdir`` (xprof format; load with tensorboard or xprof)."""
+    options = jax.profiler.ProfileOptions()
+    options.host_tracer_level = host_tracer_level
+    jax.profiler.start_trace(logdir, profiler_options=options)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def start_server(port: int = 9012):
+    """Live capture endpoint: run once, then point
+    ``tensorboard --logdir`` profile capture (or xprof) at this port."""
+    return jax.profiler.start_server(port)
+
+
+def device_memory_profile(backend: Optional[str] = None) -> bytes:
+    """pprof-format snapshot of live device allocations (the memory side
+    of the reference's exchange-memory/buffer dumps)."""
+    return jax.profiler.device_memory_profile(backend)

@@ -218,3 +218,46 @@ def test_hybrid_mesh_runs_two_level_collective():
     )
     out = np.asarray(fn(jnp.ones((n,), jnp.float32)))
     np.testing.assert_allclose(out, float(n))
+
+
+def test_profiler_trace_capture(tmp_path):
+    """utils.profiling.trace captures an xprof trace of facade calls (the
+    per-call span role of the reference's device perf counter, §5)."""
+    import os
+
+    import numpy as np
+
+    from accl_tpu import utils
+    from accl_tpu.core import xla_group
+
+    logdir = str(tmp_path / "trace")
+    g = xla_group(2)
+    try:
+        bufs = [
+            (a.create_buffer_from(np.full(64, float(r), np.float32)),
+             a.create_buffer(64, np.float32))
+            for r, a in enumerate(g)
+        ]
+        with utils.trace(logdir):
+            with utils.annotate("test-span"):
+                from helpers import run_parallel
+
+                run_parallel(
+                    g, lambda a, r: a.allreduce(bufs[r][0], bufs[r][1], 64)
+                )
+    finally:
+        for a in g:
+            a.deinit()
+    captured = [
+        os.path.join(root, f)
+        for root, _, files in os.walk(logdir)
+        for f in files
+    ]
+    assert captured, "trace produced no files"
+
+
+def test_device_memory_profile():
+    from accl_tpu import utils
+
+    blob = utils.device_memory_profile()
+    assert isinstance(blob, bytes) and len(blob) > 0
