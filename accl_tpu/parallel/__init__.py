@@ -11,3 +11,10 @@ from .multihost import (  # noqa: F401
     dp_over_dcn_mesh,
     hybrid_mesh,
 )
+from .zero import (  # noqa: F401
+    AdamConfig,
+    init_zero_state,
+    make_zero_train_step,
+    zero_adam_update,
+    zero_state_specs,
+)
