@@ -39,8 +39,13 @@ def train(
     seed: int = 0,
     log_every: int = 5,
     platform: Optional[str] = None,
+    optimizer: str = "sgd",
 ):
     """Train the flagship transformer.
+
+    ``optimizer="zero_adam"`` switches the step to the ZeRO-sharded Adam
+    (fp32 moments living 1/dp per chip, ``parallel/zero.py``); its
+    optimizer state checkpoints and resumes alongside the params.
 
     Returns ``(steps_completed, final_loss)``; ``final_loss`` is ``None``
     when a restored checkpoint already covers the requested ``steps``
@@ -58,6 +63,7 @@ def train(
         init_params,
         make_sharded_train_step,
     )
+    from ..parallel import AdamConfig, make_zero_train_step
 
     devs = jax.devices()
     tp = min(tp, len(devs))  # a 1-device host runs with tp=1, not a ValueError
@@ -71,8 +77,26 @@ def train(
         vocab=128, d_model=16 * heads, n_heads=heads, n_layers=2,
         d_ff=32 * heads, max_seq=32,
     )
-    step_fn, shard = make_sharded_train_step(cfg, mesh, lr=0.1)
-    params = shard(init_params(jax.random.PRNGKey(seed), cfg))
+    use_zero = optimizer == "zero_adam"
+    params0 = init_params(jax.random.PRNGKey(seed), cfg)
+    if use_zero:
+        step_fn, shard, init_state = make_zero_train_step(
+            cfg, mesh, AdamConfig(lr=0.01)
+        )
+        params = shard(params0)
+        opt_state = init_state(params0)
+    else:
+        step_fn, shard = make_sharded_train_step(cfg, mesh, lr=0.1)
+        params = shard(params0)
+        opt_state = None
+    def ckpt_tree():
+        # ONE definition of the checkpoint layout: the restore reference
+        # and every save must agree or orbax restore breaks
+        return (
+            {"params": params, "opt_state": opt_state}
+            if use_zero else params
+        )
+
     start_step = 0
 
     ckptr = None
@@ -88,10 +112,20 @@ def train(
         if latest is not None:
             # restore with the sharded structure as the reference tree so
             # arrays come back on-mesh
-            restored = ckptr.restore(
-                latest, args=ocp.args.StandardRestore(params)
-            )
-            params = restored
+            try:
+                restored = ckptr.restore(
+                    latest, args=ocp.args.StandardRestore(ckpt_tree())
+                )
+            except Exception as e:
+                raise ValueError(
+                    f"failed to restore {ckpt_dir} at step {latest} with "
+                    f"optimizer={optimizer!r}; was the checkpoint saved "
+                    f"with a different --optimizer?"
+                ) from e
+            if use_zero:
+                params, opt_state = restored["params"], restored["opt_state"]
+            else:
+                params = restored
             start_step = latest + 1
             print(f"resumed from step {latest} in {ckpt_dir}")
 
@@ -114,14 +148,19 @@ def train(
             rng.integers(0, cfg.vocab, (2 * dp, cfg.max_seq)), jnp.int32
         )
         targets = jnp.roll(tokens, -1, axis=1)
-        params, loss = step_fn(params, tokens, targets)
+        if use_zero:
+            params, opt_state, loss = step_fn(
+                params, opt_state, tokens, targets
+            )
+        else:
+            params, loss = step_fn(params, tokens, targets)
         loss = float(loss)
         if log_every and (it + 1) % log_every == 0:
             print(f"step {it + 1}/{steps} loss {loss:.4f}", flush=True)
         if ckptr is not None and (it + 1) % save_every == 0:
-            ckptr.save(it, args=_ocp().args.StandardSave(params))
+            ckptr.save(it, args=_ocp().args.StandardSave(ckpt_tree()))
     if ckptr is not None:
-        ckptr.save(steps - 1, args=_ocp().args.StandardSave(params))
+        ckptr.save(steps - 1, args=_ocp().args.StandardSave(ckpt_tree()))
         ckptr.wait_until_finished()
         ckptr.close()
     return steps, loss  # loss is the last completed step's global loss
@@ -135,11 +174,14 @@ def main(argv=None) -> int:
     ap.add_argument("--tp", type=int, default=2)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--platform", default=None)
+    ap.add_argument(
+        "--optimizer", default="sgd", choices=["sgd", "zero_adam"]
+    )
     args = ap.parse_args(argv)
     train(
         steps=args.steps, ckpt_dir=args.ckpt_dir,
         save_every=args.save_every, tp=args.tp, seed=args.seed,
-        platform=args.platform,
+        platform=args.platform, optimizer=args.optimizer,
     )
     return 0
 

@@ -92,12 +92,20 @@ def init_zero_state(params, specs, mesh: Mesh, dp_axis: str = "dp"):
         local_n = int(np.prod(p.shape)) // div
         glen = _padded(local_n, dp) * div
         sharding = NamedSharding(mesh, _state_spec(pspec, dp_axis))
-        return jax.device_put(jnp.zeros((glen,), jnp.float32), sharding)
+        # allocate DIRECTLY sharded: materializing the full array on one
+        # device first would transiently hold dp x the steady-state
+        # footprint — the exact memory this module exists to avoid
+        return jnp.zeros((glen,), jnp.float32, device=sharding)
 
     return {
         "m": jax.tree.map(zeros_for, params, specs),
         "v": jax.tree.map(zeros_for, params, specs),
-        "step": jnp.zeros((), jnp.int32),
+        # committed replicated (not left uncommitted): checkpoint restore
+        # reproduces the sharding it sees, and an uncommitted scalar would
+        # come back single-device, clashing with the mesh-wide params
+        "step": jax.device_put(
+            jnp.zeros((), jnp.int32), NamedSharding(mesh, P())
+        ),
     }
 
 

@@ -109,3 +109,30 @@ def test_zero_loss_decreases(cfg, mesh42):
         params, state, loss = step(params, state, tokens, targets)
         losses.append(float(loss))
     assert losses[-1] < losses[0], losses
+
+
+def test_zero_trainer_checkpoint_resume(tmp_path):
+    """The trainer example with optimizer=zero_adam checkpoints and
+    resumes the SHARDED optimizer state alongside the params."""
+    from accl_tpu.examples.train import train
+
+    ckpt = str(tmp_path / "ckpt")
+    done, loss1 = train(
+        steps=6, ckpt_dir=ckpt, save_every=3, log_every=0,
+        optimizer="zero_adam",
+    )
+    assert done == 6 and np.isfinite(loss1)
+    done, loss2 = train(
+        steps=8, ckpt_dir=ckpt, save_every=3, log_every=0,
+        optimizer="zero_adam",
+    )
+    assert done == 8 and np.isfinite(loss2)
+
+
+def test_optimizer_mismatch_diagnosable(tmp_path):
+    from accl_tpu.examples.train import train
+    ckpt = str(tmp_path / "ck")
+    train(steps=3, ckpt_dir=ckpt, save_every=2, log_every=0)  # sgd tree
+    with pytest.raises(ValueError, match="different --optimizer"):
+        train(steps=5, ckpt_dir=ckpt, save_every=2, log_every=0,
+              optimizer="zero_adam")
