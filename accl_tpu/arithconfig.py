@@ -67,6 +67,21 @@ DEFAULT_ARITH_CONFIG: Dict[Tuple[DataType, DataType], ArithConfig] = {
     (DataType.FLOAT32, DataType.BFLOAT16): ArithConfig(
         DataType.FLOAT32, DataType.BFLOAT16
     ),
+    # fp8 wire pairs (beyond the reference's f16 lane): this TPU
+    # generation moves and computes fp8 natively, so the compression
+    # surface exposes both formats — e4m3 (precision) and e5m2 (range)
+    (DataType.FLOAT32, DataType.FLOAT8_E4M3): ArithConfig(
+        DataType.FLOAT32, DataType.FLOAT8_E4M3
+    ),
+    (DataType.FLOAT32, DataType.FLOAT8_E5M2): ArithConfig(
+        DataType.FLOAT32, DataType.FLOAT8_E5M2
+    ),
+    (DataType.BFLOAT16, DataType.FLOAT8_E4M3): ArithConfig(
+        DataType.BFLOAT16, DataType.FLOAT8_E4M3
+    ),
+    (DataType.BFLOAT16, DataType.FLOAT8_E5M2): ArithConfig(
+        DataType.BFLOAT16, DataType.FLOAT8_E5M2
+    ),
 }
 
 

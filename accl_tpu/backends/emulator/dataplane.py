@@ -174,19 +174,26 @@ def reduce_inplace(
         raise ValueError(f"unsupported reduce function {fn}")
 
 
-_NATIVE_CAST_NAMES = {DataType.FLOAT16: "float16", DataType.BFLOAT16: "bfloat16"}
+#: DataType -> (native lane name, wire bit-pattern dtype)
+_NATIVE_CAST_NAMES = {
+    DataType.FLOAT16: ("float16", np.uint16),
+    DataType.BFLOAT16: ("bfloat16", np.uint16),
+    DataType.FLOAT8_E4M3: ("float8_e4m3", np.uint8),
+    DataType.FLOAT8_E5M2: ("float8_e5m2", np.uint8),
+}
 
 
 def cast_array(arr: np.ndarray, dst_dt: DataType) -> np.ndarray:
     """Elementwise dtype cast (wire compression/decompression stage); the
-    f32<->f16/bf16 pairs go through the native hp_compression-role lanes."""
+    f32<->f16/bf16/fp8 pairs go through the native hp_compression-role
+    lanes."""
     npdt = dtype_to_numpy(dst_dt)
     if arr.dtype == npdt:
         return arr
     if _native is not None and _native.available() and arr.flags.c_contiguous:
-        wire = _NATIVE_CAST_NAMES.get(dst_dt)
-        if wire is not None and arr.dtype == np.float32:
-            return _native.cast_f32(arr, wire).view(npdt)
+        lane = _NATIVE_CAST_NAMES.get(dst_dt)
+        if lane is not None and arr.dtype == np.float32:
+            return _native.cast_f32(arr, lane[0]).view(npdt)
         from ...constants import numpy_to_dtype
 
         try:
@@ -194,9 +201,8 @@ def cast_array(arr: np.ndarray, dst_dt: DataType) -> np.ndarray:
         except ValueError:
             src_dt = None
         if dst_dt == DataType.FLOAT32 and src_dt in _NATIVE_CAST_NAMES:
-            return _native.uncast_f32(
-                arr.view(np.uint16), _NATIVE_CAST_NAMES[src_dt]
-            )
+            wire, bits = _NATIVE_CAST_NAMES[src_dt]
+            return _native.uncast_f32(arr.view(bits), wire)
     return arr.astype(npdt)
 
 

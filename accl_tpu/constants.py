@@ -136,14 +136,22 @@ class DataType(enum.IntEnum):
     INT64 = 5
     BFLOAT16 = 6
     INT8 = 7
+    # fp8 wire formats (beyond the reference's f16-only lane): the TPU
+    # generation this targets computes and transports fp8 natively
+    FLOAT8_E4M3 = 8
+    FLOAT8_E5M2 = 9
 
 
-try:  # ml_dtypes ships with jax; bfloat16 numpy dtype lives there.
+try:  # ml_dtypes ships with jax; bfloat16/fp8 numpy dtypes live there.
     import ml_dtypes
 
     _BFLOAT16 = np.dtype(ml_dtypes.bfloat16)
+    _F8_E4M3 = np.dtype(ml_dtypes.float8_e4m3fn)
+    _F8_E5M2 = np.dtype(ml_dtypes.float8_e5m2)
 except ImportError:  # pragma: no cover - ml_dtypes is bundled with jax
     _BFLOAT16 = np.dtype(np.float32)
+    _F8_E4M3 = None  # fp8 requires ml_dtypes; aliasing another dtype
+    _F8_E5M2 = None  # would corrupt the inverted numpy->DataType map
 
 _DTYPE_TO_NUMPY = {
     DataType.FLOAT16: np.dtype(np.float16),
@@ -154,6 +162,9 @@ _DTYPE_TO_NUMPY = {
     DataType.BFLOAT16: _BFLOAT16,
     DataType.INT8: np.dtype(np.int8),
 }
+if _F8_E4M3 is not None:
+    _DTYPE_TO_NUMPY[DataType.FLOAT8_E4M3] = _F8_E4M3
+    _DTYPE_TO_NUMPY[DataType.FLOAT8_E5M2] = _F8_E5M2
 
 _NUMPY_TO_DTYPE = {v: k for k, v in _DTYPE_TO_NUMPY.items()}
 

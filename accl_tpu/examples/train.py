@@ -117,11 +117,17 @@ def train(
                     latest, args=ocp.args.StandardRestore(ckpt_tree())
                 )
             except Exception as e:
-                raise ValueError(
-                    f"failed to restore {ckpt_dir} at step {latest} with "
-                    f"optimizer={optimizer!r}; was the checkpoint saved "
-                    f"with a different --optimizer?"
-                ) from e
+                # only tree-structure mismatches suggest the optimizer
+                # flag; anything else (corrupt file, sharding change,
+                # orbax skew) must surface as itself
+                msg = str(e).lower()
+                if "structure" in msg or "tree" in msg or "pytree" in msg:
+                    raise ValueError(
+                        f"failed to restore {ckpt_dir} at step {latest} "
+                        f"with optimizer={optimizer!r}; was the checkpoint "
+                        f"saved with a different --optimizer?"
+                    ) from e
+                raise
             if use_zero:
                 params, opt_state = restored["params"], restored["opt_state"]
             else:

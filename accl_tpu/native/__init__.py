@@ -55,7 +55,8 @@ def _bind(lib):
     ]
     for name in (
         "accl_f32_to_f16", "accl_f32_to_bf16", "accl_f16_to_f32",
-        "accl_bf16_to_f32",
+        "accl_bf16_to_f32", "accl_f32_to_f8e4m3", "accl_f8e4m3_to_f32",
+        "accl_f32_to_f8e5m2", "accl_f8e5m2_to_f32",
     ):
         fn = getattr(lib, name)
         fn.restype = None
@@ -133,27 +134,36 @@ def reduce_inplace(fn: ReduceFunction, dst: np.ndarray, src: np.ndarray) -> bool
     return rc == 0
 
 
+_CAST_FNS = {
+    "float16": ("accl_f32_to_f16", "accl_f16_to_f32", np.uint16),
+    "bfloat16": ("accl_f32_to_bf16", "accl_bf16_to_f32", np.uint16),
+    "float8_e4m3": ("accl_f32_to_f8e4m3", "accl_f8e4m3_to_f32", np.uint8),
+    "float8_e5m2": ("accl_f32_to_f8e5m2", "accl_f8e5m2_to_f32", np.uint8),
+}
+
+
 def cast_f32(src: np.ndarray, wire: str) -> np.ndarray:
-    """f32 -> f16/bf16 wire compression (returns uint16 bit patterns)."""
+    """f32 -> f16/bf16/fp8 wire compression (returns the wire's bit
+    patterns: uint16 for the 16-bit lanes, uint8 for fp8)."""
     lib = _load()
     if lib is None:
         raise RuntimeError("native library unavailable")
+    name, _, bits = _CAST_FNS[wire]
     src = np.ascontiguousarray(src, np.float32)
-    out = np.empty(src.size, np.uint16)
-    fn = lib.accl_f32_to_f16 if wire == "float16" else lib.accl_f32_to_bf16
-    fn(src.ctypes.data, out.ctypes.data, src.size)
+    out = np.empty(src.size, bits)
+    getattr(lib, name)(src.ctypes.data, out.ctypes.data, src.size)
     return out
 
 
 def uncast_f32(src: np.ndarray, wire: str) -> np.ndarray:
-    """f16/bf16 bit patterns -> f32."""
+    """Wire bit patterns -> f32."""
     lib = _load()
     if lib is None:
         raise RuntimeError("native library unavailable")
-    src = np.ascontiguousarray(src, np.uint16)
+    _, name, bits = _CAST_FNS[wire]
+    src = np.ascontiguousarray(src, bits)
     out = np.empty(src.size, np.float32)
-    fn = lib.accl_f16_to_f32 if wire == "float16" else lib.accl_bf16_to_f32
-    fn(src.ctypes.data, out.ctypes.data, src.size)
+    getattr(lib, name)(src.ctypes.data, out.ctypes.data, src.size)
     return out
 
 

@@ -180,19 +180,26 @@ def compressed_allreduce(
     wire — the TPU-native form of the reference's fp32->fp16 'ethernet
     compression' (hp_compression kernels + ETH_COMPRESSED): reduce-scatter
     in wire dtype, accumulate locally in the original dtype, allgather the
-    narrow result."""
+    narrow result.  Counts that don't divide the axis size are padded
+    (statically) around the scatter/gather pair."""
     orig = x.dtype
+    n = x.shape[0]
+    size = lax.axis_size(axis_name)
+    pad = (-n) % size
     narrow = x.astype(wire_dtype)
+    if pad:
+        narrow = jnp.concatenate(
+            [narrow, jnp.zeros((pad,) + x.shape[1:], wire_dtype)]
+        )
     if function == ReduceFunction.SUM:
         partial = lax.psum_scatter(
             narrow, axis_name, scatter_dimension=0, tiled=True
         ).astype(orig)
     else:
         partial_full = _REDUCERS[function](narrow, axis_name).astype(orig)
-        size = lax.axis_size(axis_name)
-        block = x.shape[0] // size
+        block = (n + pad) // size
         partial = lax.dynamic_slice_in_dim(
             partial_full, lax.axis_index(axis_name) * block, block, axis=0
         )
     gathered = lax.all_gather(partial.astype(wire_dtype), axis_name, tiled=True)
-    return gathered.astype(orig)
+    return gathered[:n].astype(orig)

@@ -93,6 +93,24 @@ void accl_bf16_to_f32(const uint16_t* src, float* dst, size_t n) {
   for (size_t i = 0; i < n; ++i) dst[i] = accl_fp::bf2f(src[i]);
 }
 
+// fp8 lanes (e4m3fn saturating-to-NaN, e5m2 with inf) — semantics match
+// ml_dtypes bit-for-bit so every tier agrees on the wire format
+void accl_f32_to_f8e4m3(const float* src, uint8_t* dst, size_t n) {
+  for (size_t i = 0; i < n; ++i) dst[i] = accl_fp::f2e4m3(src[i]);
+}
+
+void accl_f8e4m3_to_f32(const uint8_t* src, float* dst, size_t n) {
+  for (size_t i = 0; i < n; ++i) dst[i] = accl_fp::e4m32f(src[i]);
+}
+
+void accl_f32_to_f8e5m2(const float* src, uint8_t* dst, size_t n) {
+  for (size_t i = 0; i < n; ++i) dst[i] = accl_fp::f2e5m2(src[i]);
+}
+
+void accl_f8e5m2_to_f32(const uint8_t* src, float* dst, size_t n) {
+  for (size_t i = 0; i < n; ++i) dst[i] = accl_fp::e5m22f(src[i]);
+}
+
 // ---------------------------------------------------------------------------
 // RX signature matcher: the rxbuf_seek role.  A fixed pool of slots holding
 // {comm, src, tag, seqn} signatures; fill() parks an arriving segment's

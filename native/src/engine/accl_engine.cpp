@@ -36,6 +36,8 @@ size_t dtype_size(int32_t dt) {
     case DT_I64:
       return 8;
     case DT_I8:
+    case DT_F8E4M3:
+    case DT_F8E5M2:
       return 1;
     default:
       return 0;
@@ -72,6 +74,10 @@ double load_elem(const uint8_t* p, int32_t dt) {
       return (double)*(const int64_t*)p;
     case DT_I8:
       return (double)*(const int8_t*)p;
+    case DT_F8E4M3:
+      return accl_fp::e4m32f(*p);
+    case DT_F8E5M2:
+      return accl_fp::e5m22f(*p);
     default:
       return 0.0;
   }
@@ -100,6 +106,12 @@ void store_elem(uint8_t* p, int32_t dt, double v) {
     case DT_I8:
       *(int8_t*)p = (int8_t)v;
       break;
+    case DT_F8E4M3:
+      *p = accl_fp::f2e4m3((float)v);
+      break;
+    case DT_F8E5M2:
+      *p = accl_fp::f2e5m2((float)v);
+      break;
     default:
       break;
   }
@@ -121,14 +133,17 @@ bool reduce_inplace(int32_t rfunc, int32_t dt, void* dst, const void* src,
     case DT_I8:
       return reduce_typed(rfunc, (int8_t*)dst, (const int8_t*)src, n);
     case DT_F16:
-    case DT_BF16: {
+    case DT_BF16:
+    case DT_F8E4M3:
+    case DT_F8E5M2: {
+      size_t es = dtype_size(dt);
       uint8_t* d = (uint8_t*)dst;
       const uint8_t* s = (const uint8_t*)src;
       for (size_t i = 0; i < n; ++i) {
-        double a = load_elem(d + 2 * i, dt), b = load_elem(s + 2 * i, dt);
+        double a = load_elem(d + es * i, dt), b = load_elem(s + es * i, dt);
         double r = rfunc == RF_SUM ? a + b : (a > b ? a : b);
         if (rfunc != RF_SUM && rfunc != RF_MAX) return false;
-        store_elem(d + 2 * i, dt, r);
+        store_elem(d + es * i, dt, r);
       }
       return true;
     }

@@ -75,6 +75,8 @@ enum DType : int32_t {
   DT_I64 = 5,
   DT_BF16 = 6,
   DT_I8 = 7,
+  DT_F8E4M3 = 8,  // fp8 wire formats, ml_dtypes-compatible
+  DT_F8E5M2 = 9,
 };
 
 enum ReduceFunc : int32_t { RF_SUM = 0, RF_MAX = 1 };

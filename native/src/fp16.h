@@ -81,4 +81,78 @@ inline uint16_t f2bf(float f) {
   return (uint16_t)((bits + rounding) >> 16);
 }
 
+// ---------------------------------------------------------------------------
+// fp8 wire formats (beyond the reference's f16-only lane; semantics match
+// ml_dtypes so the native tier agrees bit-for-bit with the JAX tiers):
+//   e4m3fn: bias 7, NO inf — overflow and every non-finite become NaN 0x7f
+//   e5m2:   bias 15 (f16's exponent), inf 0x7c, NaN 0x7e
+// ---------------------------------------------------------------------------
+
+// direct f32 -> fp8 with MBITS mantissa bits, bias BIAS, round-nearest-even
+// (single rounding; an f16 intermediate could double-round across a tie);
+// FN selects the no-inf/saturate-to-NaN overflow rule.
+inline uint8_t f2f8(float f, unsigned MBITS, int BIAS, bool FN) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  uint8_t sign = (uint8_t)((bits >> 24) & 0x80u);
+  int32_t aexp = (int32_t)((bits >> 23) & 0xffu);
+  uint32_t man = bits & 0x7fffffu;
+  unsigned EBITS = 7 - MBITS;
+  uint32_t inf_code = (uint32_t)(((1u << EBITS) - 1) << MBITS);
+  if (aexp == 0xff) {
+    if (man || FN) return (uint8_t)(sign | (FN ? 0x7fu : 0x7eu));  // NaN
+    return (uint8_t)(sign | inf_code);                             // inf
+  }
+  int32_t e = aexp - 127 + BIAS;  // target biased exponent
+  uint32_t full = (aexp ? (man | 0x800000u) : man);
+  uint32_t shift = 23 - MBITS;
+  if (e <= 0) {  // subnormal target: shift further, exponent field 0
+    shift += (uint32_t)(1 - e);
+    if (shift > 31) return sign;  // far underflow -> signed zero
+  }
+  uint32_t q = full >> shift;
+  uint32_t rem = full & ((1u << shift) - 1u);
+  uint32_t halfway = 1u << (shift - 1);
+  if (rem > halfway || (rem == halfway && (q & 1u))) ++q;
+  uint32_t code;
+  if (e <= 0) {
+    code = q;  // rounding into 1<<MBITS lands on the first normal
+  } else {
+    // q in [1<<MBITS, 1<<(MBITS+1)]: the +q carries rounding overflow
+    // into the exponent automatically
+    code = ((uint32_t)(e - 1) << MBITS) + q;
+  }
+  uint32_t max_code = FN ? inf_code + ((1u << MBITS) - 2u)  // 0x7e for e4m3fn
+                         : inf_code - 1u;                   // 0x7b for e5m2
+  if (code > max_code) return (uint8_t)(sign | (FN ? 0x7fu : inf_code));
+  return (uint8_t)(sign | code);
+}
+
+inline float f82f(uint8_t v, unsigned MBITS, int BIAS, bool FN) {
+  uint8_t sign = v & 0x80u;
+  uint32_t mag = v & 0x7fu;
+  unsigned EBITS = 7 - MBITS;
+  uint32_t expf = mag >> MBITS;
+  uint32_t man = mag & ((1u << MBITS) - 1u);
+  float out;
+  if (FN && mag == 0x7fu) {
+    out = __builtin_nanf("");
+  } else if (!FN && expf == (1u << EBITS) - 1u) {
+    out = man ? __builtin_nanf("") : __builtin_inff();
+  } else if (expf == 0) {
+    out = (float)man;
+    // subnormal: man * 2^(1 - BIAS - MBITS)
+    for (int i = 0; i < BIAS + (int)MBITS - 1; ++i) out *= 0.5f;
+  } else {
+    uint32_t bits = ((expf - BIAS + 127u) << 23) | (man << (23 - MBITS));
+    std::memcpy(&out, &bits, 4);
+  }
+  return sign ? -out : out;
+}
+
+inline uint8_t f2e4m3(float f) { return f2f8(f, 3, 7, true); }
+inline float e4m32f(uint8_t v) { return f82f(v, 3, 7, true); }
+inline uint8_t f2e5m2(float f) { return f2f8(f, 2, 15, false); }
+inline float e5m22f(uint8_t v) { return f82f(v, 2, 15, false); }
+
 }  // namespace accl_fp

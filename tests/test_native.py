@@ -115,3 +115,36 @@ def test_native_cast_wired_into_dataplane(rng):
     np.testing.assert_array_equal(h, a.astype(np.float16))
     back = cast_array(h, DataType.FLOAT32)
     np.testing.assert_array_equal(back, h.astype(np.float32))
+
+
+@pytest.mark.parametrize("wire,mdt_name", [
+    ("float8_e4m3", "float8_e4m3fn"), ("float8_e5m2", "float8_e5m2"),
+])
+def test_native_fp8_casts_match_ml_dtypes(wire, mdt_name):
+    """The C++ fp8 lanes agree with ml_dtypes BIT-FOR-BIT (random values,
+    overflow/NaN/inf boundaries, every decode code) so all tiers share one
+    wire format."""
+    import ml_dtypes
+
+    from accl_tpu.native import available, cast_f32, uncast_f32
+
+    if not available():
+        pytest.skip("native library unavailable")
+    mdt = getattr(ml_dtypes, mdt_name)
+    rng = np.random.default_rng(5)
+    vals = np.concatenate([
+        (rng.standard_normal(50000) * rng.choice(
+            [1e-3, 1.0, 100.0, 1e5], 50000)).astype(np.float32),
+        np.asarray([0.0, -0.0, np.inf, -np.inf, np.nan,
+                    448.0, 449.0, 464.0, 465.0, 480.0,
+                    57344.0, 61440.0, 2**-9, 2**-10, 2**-16, 2**-17],
+                   np.float32),
+    ])
+    got = cast_f32(vals, wire)
+    ref = vals.astype(mdt).view(np.uint8)
+    np.testing.assert_array_equal(got, ref)
+    codes = np.arange(256, dtype=np.uint8)
+    dec = uncast_f32(codes, wire)
+    ref_dec = codes.view(mdt).astype(np.float32)
+    both_nan = np.isnan(dec) & np.isnan(ref_dec)
+    np.testing.assert_array_equal(dec[~both_nan], ref_dec[~both_nan])
