@@ -121,7 +121,7 @@ def train(
                 # flag; anything else (corrupt file, sharding change,
                 # orbax skew) must surface as itself
                 msg = str(e).lower()
-                if "structure" in msg or "tree" in msg or "pytree" in msg:
+                if "structure" in msg or "tree" in msg:
                     raise ValueError(
                         f"failed to restore {ckpt_dir} at step {latest} "
                         f"with optimizer={optimizer!r}; was the checkpoint "

@@ -6,7 +6,7 @@
 #include <mutex>
 #include <vector>
 
-#include "accl_engine.h"
+#include "capi.h"
 
 namespace {
 

@@ -1,0 +1,32 @@
+// C ABI of the native engine — the single source of truth for every
+// consumer (ctypes in accl_tpu/native/engine.py binds by name; C++ hosts
+// like selftest.cpp include this so signature drift breaks the BUILD, not
+// the stack at runtime).
+
+#pragma once
+
+#include <cstdint>
+
+#include "accl_engine.h"
+
+extern "C" {
+
+// returns engine handle, or -1 when the transport failed to open
+int accl_ng_engine_new(const char* address, int transport, int rx_count,
+                       int rx_size);
+void accl_ng_engine_shutdown(int h);
+int accl_ng_add_comm(int h, uint32_t comm_id, int local_rank, int nranks,
+                     const char** addresses, const uint32_t* seg_sizes);
+uint64_t accl_ng_start(int h, const accl::CallArgs* args);
+int accl_ng_wait(int h, uint64_t req, double timeout_s);
+int accl_ng_test(int h, uint64_t req);
+uint32_t accl_ng_retcode(int h, uint64_t req);
+int64_t accl_ng_duration_ns(int h, uint64_t req);
+void accl_ng_free_request(int h, uint64_t req);
+void accl_ng_stream_push(int h, int stream_id, const void* data, int64_t n);
+int64_t accl_ng_stream_pop(int h, int stream_id, void* out, int64_t cap,
+                           double timeout_s);
+int accl_ng_rx_occupancy(int h);
+int accl_ng_rx_capacity(int h);
+
+}  // extern "C"

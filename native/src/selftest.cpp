@@ -1,0 +1,177 @@
+// Pure-C++ host selftest: drives the native collective engine end-to-end
+// with NO Python anywhere in the process — the reference's C++ host driver
+// role (driver/xrt test binaries run the CCLO from C++ the same way; ref
+// test/host/xrt/src/test.cpp).  Four ranks on the in-process transport,
+// each driven from its own host thread exactly like an application would:
+// allreduce, rooted bcast, tag-matched send/recv, MAX reduce, bf16- and
+// fp8-compressed allreduce, barrier.
+//
+// Build + run:  make -C native selftest && native/build/accl_selftest
+
+#include <atomic>
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "engine/capi.h"
+
+namespace {
+
+constexpr int kWorld = 4;
+constexpr int64_t kCount = 1500;  // straddles the 4 KiB segment boundary
+
+std::atomic<int> g_failures{0};
+
+#define CHECK(cond, ...)                         \
+  do {                                           \
+    if (!(cond)) {                               \
+      std::fprintf(stderr, "FAIL %s:%d: ", __FILE__, __LINE__); \
+      std::fprintf(stderr, __VA_ARGS__);         \
+      std::fprintf(stderr, "\n");                \
+      ++g_failures;                              \
+    }                                            \
+  } while (0)
+
+uint32_t run(int h, accl::CallArgs a) {
+  uint64_t req = accl_ng_start(h, &a);
+  int ok = accl_ng_wait(h, req, 30.0);
+  uint32_t rc = ok ? accl_ng_retcode(h, req) : accl::E_RECEIVE_TIMEOUT;
+  accl_ng_free_request(h, req);
+  return rc;
+}
+
+void drive_rank(int h, int rank) {
+  using accl::CallArgs;
+
+  // --- allreduce SUM: every rank contributes rank+1 -> sum 10 ------------
+  std::vector<float> send((size_t)kCount, (float)(rank + 1));
+  std::vector<float> recv((size_t)kCount, 0.0f);
+  CallArgs ar;
+  ar.op = accl::OP_ALLREDUCE;
+  ar.count = kCount;
+  ar.rfunc = accl::RF_SUM;
+  ar.op0 = send.data();
+  ar.res = recv.data();
+  ar.op0_dtype = ar.res_dtype = ar.acc_dtype = ar.cmp_dtype = accl::DT_F32;
+  CHECK(run(h, ar) == 0, "rank %d allreduce rc", rank);
+  for (auto v : recv) CHECK(v == 10.0f, "rank %d allreduce value %f", rank, v);
+
+  // --- bcast from root 1 -------------------------------------------------
+  std::vector<float> bc((size_t)kCount,
+                        rank == 1 ? 7.5f : 0.0f);
+  CallArgs b;
+  b.op = accl::OP_BCAST;
+  b.count = kCount;
+  b.root_src = 1;
+  b.op0 = bc.data();
+  b.res = bc.data();
+  b.op0_dtype = b.res_dtype = b.acc_dtype = b.cmp_dtype = accl::DT_F32;
+  CHECK(run(h, b) == 0, "rank %d bcast rc", rank);
+  for (auto v : bc) CHECK(v == 7.5f, "rank %d bcast value %f", rank, v);
+
+  // --- tag-matched send/recv pair 0 -> 3 ----------------------------------
+  if (rank == 0) {
+    std::vector<float> payload((size_t)kCount, 3.25f);
+    CallArgs s;
+    s.op = accl::OP_SEND;
+    s.count = kCount;
+    s.root_dst = 3;
+    s.tag = 42;
+    s.op0 = payload.data();
+    s.op0_dtype = s.acc_dtype = s.cmp_dtype = accl::DT_F32;
+    CHECK(run(h, s) == 0, "rank 0 send rc");
+  } else if (rank == 3) {
+    std::vector<float> in((size_t)kCount, 0.0f);
+    CallArgs r;
+    r.op = accl::OP_RECV;
+    r.count = kCount;
+    r.root_src = 0;
+    r.tag = 42;
+    r.res = in.data();
+    r.res_dtype = r.acc_dtype = r.cmp_dtype = accl::DT_F32;
+    CHECK(run(h, r) == 0, "rank 3 recv rc");
+    for (auto v : in) CHECK(v == 3.25f, "rank 3 recv value %f", v);
+  }
+
+  // --- MAX reduce to root 2 ----------------------------------------------
+  std::vector<float> mx((size_t)kCount, (float)rank);
+  std::vector<float> mxout((size_t)kCount, -1.0f);
+  CallArgs m;
+  m.op = accl::OP_REDUCE;
+  m.count = kCount;
+  m.root_dst = 2;
+  m.rfunc = accl::RF_MAX;
+  m.op0 = mx.data();
+  m.res = rank == 2 ? mxout.data() : nullptr;
+  m.op0_dtype = m.acc_dtype = m.cmp_dtype = accl::DT_F32;
+  m.res_dtype = rank == 2 ? accl::DT_F32 : accl::DT_NONE;
+  CHECK(run(h, m) == 0, "rank %d reduce rc", rank);
+  if (rank == 2)
+    for (auto v : mxout) CHECK(v == 3.0f, "reduce max value %f", v);
+
+  // --- compressed allreduce: bf16 then fp8-e4m3 on the wire ---------------
+  for (int wire : {accl::DT_BF16, accl::DT_F8E4M3}) {
+    std::vector<float> cs((size_t)kCount, 0.25f * (float)(rank + 1));
+    std::vector<float> cr((size_t)kCount, 0.0f);
+    CallArgs c;
+    c.op = accl::OP_ALLREDUCE;
+    c.count = kCount;
+    c.rfunc = accl::RF_SUM;
+    c.compression = accl::CF_ETH;
+    c.op0 = cs.data();
+    c.res = cr.data();
+    c.op0_dtype = c.res_dtype = c.acc_dtype = accl::DT_F32;
+    c.cmp_dtype = wire;
+    CHECK(run(h, c) == 0, "rank %d compressed(%d) allreduce rc", rank, wire);
+    for (auto v : cr)
+      CHECK(std::fabs(v - 2.5f) < 0.2f,
+            "rank %d compressed(%d) value %f", rank, wire, v);
+  }
+
+  // --- barrier ------------------------------------------------------------
+  CallArgs bar;
+  bar.op = accl::OP_BARRIER;
+  bar.acc_dtype = bar.cmp_dtype = accl::DT_F32;
+  CHECK(run(h, bar) == 0, "rank %d barrier rc", rank);
+}
+
+}  // namespace
+
+int main() {
+  std::vector<std::string> addrs;
+  std::vector<const char*> addr_ptrs;
+  std::vector<uint32_t> segs((size_t)kWorld, 4096);
+  for (int r = 0; r < kWorld; ++r)
+    addrs.push_back("selftest:" + std::to_string(r));
+  for (auto& a : addrs) addr_ptrs.push_back(a.c_str());
+
+  std::vector<int> handles;
+  for (int r = 0; r < kWorld; ++r) {
+    int h = accl_ng_engine_new(addrs[(size_t)r].c_str(), accl::TR_INPROC,
+                               16, 4096);
+    CHECK(h >= 0, "engine_new rank %d", r);
+    handles.push_back(h);
+  }
+  for (int r = 0; r < kWorld; ++r)
+    CHECK(accl_ng_add_comm(handles[(size_t)r], 0, r, kWorld,
+                           addr_ptrs.data(), segs.data()) == 0,
+          "add_comm rank %d", r);
+
+  std::vector<std::thread> threads;
+  for (int r = 0; r < kWorld; ++r)
+    threads.emplace_back(drive_rank, handles[(size_t)r], r);
+  for (auto& t : threads) t.join();
+
+  for (int h : handles) accl_ng_engine_shutdown(h);
+
+  if (g_failures.load() == 0) {
+    std::printf("accl_selftest: all checks passed (pure C++ host, %d ranks)\n",
+                kWorld);
+    return 0;
+  }
+  std::printf("accl_selftest: %d FAILURES\n", g_failures.load());
+  return 1;
+}

@@ -577,3 +577,20 @@ def test_ring_allreduce_wire_compression(bidirectional):
     # and it must NOT be bit-identical to the uncompressed path (the wire
     # really was narrowed)
     assert not np.array_equal(out[0], expect)
+
+
+@pytest.mark.parametrize("mdt_name", ["float8_e4m3fn", "float8_e5m2"])
+def test_cast_fp8(mdt_name):
+    """Kernel-tier fp8 compression lane (beyond the reference's f16-only
+    hp_compression): tiled cast down to fp8 and back."""
+    import ml_dtypes
+
+    mdt = getattr(ml_dtypes, mdt_name)
+    x = jnp.asarray(
+        np.random.default_rng(9).standard_normal(1000).astype(np.float32)
+    )
+    down = pk.cast(x, mdt)
+    assert down.dtype == np.dtype(mdt)
+    up = pk.cast(down, jnp.float32)
+    expect = np.asarray(x).astype(mdt).astype(np.float32)
+    np.testing.assert_array_equal(np.asarray(up), expect)
