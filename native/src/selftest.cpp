@@ -56,8 +56,15 @@ void drive_rank(int h, int rank) {
   ar.op0 = send.data();
   ar.res = recv.data();
   ar.op0_dtype = ar.res_dtype = ar.acc_dtype = ar.cmp_dtype = accl::DT_F32;
-  CHECK(run(h, ar) == 0, "rank %d allreduce rc", rank);
-  for (auto v : recv) CHECK(v == 10.0f, "rank %d allreduce value %f", rank, v);
+  if (run(h, ar) == 0) {
+    for (auto v : recv)
+      if (v != 10.0f) {
+        CHECK(false, "rank %d allreduce value %f", rank, v);
+        break;
+      }
+  } else {
+    CHECK(false, "rank %d allreduce rc", rank);
+  }
 
   // --- bcast from root 1 -------------------------------------------------
   std::vector<float> bc((size_t)kCount,
@@ -69,8 +76,15 @@ void drive_rank(int h, int rank) {
   b.op0 = bc.data();
   b.res = bc.data();
   b.op0_dtype = b.res_dtype = b.acc_dtype = b.cmp_dtype = accl::DT_F32;
-  CHECK(run(h, b) == 0, "rank %d bcast rc", rank);
-  for (auto v : bc) CHECK(v == 7.5f, "rank %d bcast value %f", rank, v);
+  if (run(h, b) == 0) {
+    for (auto v : bc)
+      if (v != 7.5f) {
+        CHECK(false, "rank %d bcast value %f", rank, v);
+        break;
+      }
+  } else {
+    CHECK(false, "rank %d bcast rc", rank);
+  }
 
   // --- tag-matched send/recv pair 0 -> 3 ----------------------------------
   if (rank == 0) {
@@ -92,8 +106,15 @@ void drive_rank(int h, int rank) {
     r.tag = 42;
     r.res = in.data();
     r.res_dtype = r.acc_dtype = r.cmp_dtype = accl::DT_F32;
-    CHECK(run(h, r) == 0, "rank 3 recv rc");
-    for (auto v : in) CHECK(v == 3.25f, "rank 3 recv value %f", v);
+    if (run(h, r) == 0) {
+      for (auto v : in)
+        if (v != 3.25f) {
+          CHECK(false, "rank 3 recv value %f", v);
+          break;
+        }
+    } else {
+      CHECK(false, "rank 3 recv rc");
+    }
   }
 
   // --- MAX reduce to root 2 ----------------------------------------------
@@ -108,9 +129,16 @@ void drive_rank(int h, int rank) {
   m.res = rank == 2 ? mxout.data() : nullptr;
   m.op0_dtype = m.acc_dtype = m.cmp_dtype = accl::DT_F32;
   m.res_dtype = rank == 2 ? accl::DT_F32 : accl::DT_NONE;
-  CHECK(run(h, m) == 0, "rank %d reduce rc", rank);
-  if (rank == 2)
-    for (auto v : mxout) CHECK(v == 3.0f, "reduce max value %f", v);
+  if (run(h, m) == 0) {
+    if (rank == 2)
+      for (auto v : mxout)
+        if (v != 3.0f) {
+          CHECK(false, "reduce max value %f", v);
+          break;
+        }
+  } else {
+    CHECK(false, "rank %d reduce rc", rank);
+  }
 
   // --- compressed allreduce: bf16 then fp8-e4m3 on the wire ---------------
   for (int wire : {accl::DT_BF16, accl::DT_F8E4M3}) {
@@ -125,10 +153,15 @@ void drive_rank(int h, int rank) {
     c.res = cr.data();
     c.op0_dtype = c.res_dtype = c.acc_dtype = accl::DT_F32;
     c.cmp_dtype = wire;
-    CHECK(run(h, c) == 0, "rank %d compressed(%d) allreduce rc", rank, wire);
-    for (auto v : cr)
-      CHECK(std::fabs(v - 2.5f) < 0.2f,
-            "rank %d compressed(%d) value %f", rank, wire, v);
+    if (run(h, c) == 0) {
+      for (auto v : cr)
+        if (std::fabs(v - 2.5f) >= 0.2f) {
+          CHECK(false, "rank %d compressed(%d) value %f", rank, wire, v);
+          break;
+        }
+    } else {
+      CHECK(false, "rank %d compressed(%d) allreduce rc", rank, wire);
+    }
   }
 
   // --- barrier ------------------------------------------------------------

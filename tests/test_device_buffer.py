@@ -495,3 +495,20 @@ def test_compressed_allreduce_odd_count(dgroup4):
     for r in range(4):
         recv[r].sync_from_device()
         np.testing.assert_allclose(recv[r].data, 10.0, rtol=1e-2)
+
+
+@pytest.mark.parametrize("src_host,dst_host", [
+    (False, True), (True, False), (True, True),
+])
+def test_copy_host_memory_matrix(dgroup4, src_host, dst_host):
+    """The reference's test_copy d2h / h2d / h2h variants (test.cpp:30-165,
+    hostFlags OP0_HOST/RES_HOST): copy between device-resident and
+    host-only buffers in every direction."""
+    a = dgroup4[0]
+    n = 256
+    data = np.arange(n, dtype=np.float32)
+    src = a.create_buffer_from(data, host_only=src_host)
+    dst = a.create_buffer(n, np.float32, host_only=dst_host)
+    a.copy(src, dst, n)
+    dst.sync_from_device()
+    np.testing.assert_array_equal(dst.data, data)

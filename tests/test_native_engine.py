@@ -154,9 +154,12 @@ def test_pure_cpp_selftest():
     import shutil
     import subprocess
 
+    import os
+
     native = pathlib.Path(__file__).resolve().parent.parent / "native"
-    if shutil.which("make") is None or shutil.which("g++") is None:
-        pytest.skip("no C++ toolchain")
+    cxx = os.environ.get("CXX", "g++")
+    if shutil.which("make") is None or shutil.which(cxx) is None:
+        pytest.skip(f"no C++ toolchain (make + {cxx})")
     build = subprocess.run(
         ["make", "-C", str(native), "selftest"],
         capture_output=True, text=True, timeout=180,
