@@ -1,5 +1,6 @@
 from .timing import Timer  # noqa: F401
 from .logging import Log, LogLevel  # noqa: F401
+from .platform import mirror_platform_env  # noqa: F401
 from .profiling import (  # noqa: F401
     annotate,
     device_memory_profile,

@@ -1,0 +1,27 @@
+"""Platform selection that actually sticks.
+
+Site-installed PJRT hooks can initialize their own platform during
+backend discovery even when ``JAX_PLATFORMS`` is set in the environment
+— and if that platform's transport is unreachable, the first
+``jax.devices()`` hangs.  Only the CONFIG path reliably wins, so every
+standalone entry point mirrors the env var through
+:func:`mirror_platform_env` before its first backend use (the test
+conftest does the equivalent inline).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def mirror_platform_env(explicit: Optional[str] = None) -> Optional[str]:
+    """Apply ``explicit`` (or the JAX_PLATFORMS env var) via
+    ``jax.config`` — call BEFORE the first ``jax.devices()``.  Returns
+    the platform string applied, or None if nothing was requested."""
+    platform = explicit or os.environ.get("JAX_PLATFORMS")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+    return platform or None

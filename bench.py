@@ -502,9 +502,21 @@ def main() -> None:
 
     # honor an explicit platform request via config as well as env: some
     # site PJRT hooks only respect the config path
-    platforms = os.environ.get("JAX_PLATFORMS")
-    if platforms:
-        jax.config.update("jax_platforms", platforms)
+    from accl_tpu.utils import mirror_platform_env
+
+    mirror_platform_env()
+    # persistent compilation cache: first compiles here run 20-40s; repeat
+    # bench invocations (and wedge-guard reruns) hit the disk cache
+    cache_dir = os.environ.get(
+        "ACCL_COMPILE_CACHE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+    )
+    if cache_dir:
+        try:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        except Exception:
+            pass  # older jax without the knobs
 
     ndev = len(jax.devices())
     on_tpu = jax.default_backend() == "tpu"

@@ -217,10 +217,11 @@ def main(argv=None) -> int:
     )
     args = ap.parse_args(argv)
 
-    if args.platform:
-        import jax
+    from accl_tpu.utils import mirror_platform_env
 
-        jax.config.update("jax_platforms", args.platform)
+    # the CONFIG path, before any jax.devices(): env alone doesn't stop
+    # site PJRT hooks from initializing their own platform
+    mirror_platform_env(args.platform)
 
     sizes = [2**e for e in range(args.min_exp, args.max_exp + 1)]
     out = sys.stdout if args.csv == "-" else open(args.csv, "w", newline="")

@@ -43,6 +43,23 @@ uint32_t run(int h, accl::CallArgs a) {
   return rc;
 }
 
+// one failed op prints ONE line: the rc, or the first bad value's index
+template <typename Pred>
+void check_op(uint32_t rc, const std::vector<float>& vals, Pred value_ok,
+              int rank, const char* what) {
+  if (rc != 0) {
+    CHECK(false, "rank %d %s rc=0x%x", rank, what, rc);
+    return;
+  }
+  for (size_t i = 0; i < vals.size(); ++i) {
+    if (!value_ok(vals[i])) {
+      CHECK(false, "rank %d %s value[%zu]=%f", rank, what, i,
+            (double)vals[i]);
+      return;
+    }
+  }
+}
+
 void drive_rank(int h, int rank) {
   using accl::CallArgs;
 
@@ -56,15 +73,8 @@ void drive_rank(int h, int rank) {
   ar.op0 = send.data();
   ar.res = recv.data();
   ar.op0_dtype = ar.res_dtype = ar.acc_dtype = ar.cmp_dtype = accl::DT_F32;
-  if (run(h, ar) == 0) {
-    for (auto v : recv)
-      if (v != 10.0f) {
-        CHECK(false, "rank %d allreduce value %f", rank, v);
-        break;
-      }
-  } else {
-    CHECK(false, "rank %d allreduce rc", rank);
-  }
+  check_op(run(h, ar), recv, [](float v) { return v == 10.0f; }, rank,
+           "allreduce");
 
   // --- bcast from root 1 -------------------------------------------------
   std::vector<float> bc((size_t)kCount,
@@ -76,15 +86,8 @@ void drive_rank(int h, int rank) {
   b.op0 = bc.data();
   b.res = bc.data();
   b.op0_dtype = b.res_dtype = b.acc_dtype = b.cmp_dtype = accl::DT_F32;
-  if (run(h, b) == 0) {
-    for (auto v : bc)
-      if (v != 7.5f) {
-        CHECK(false, "rank %d bcast value %f", rank, v);
-        break;
-      }
-  } else {
-    CHECK(false, "rank %d bcast rc", rank);
-  }
+  check_op(run(h, b), bc, [](float v) { return v == 7.5f; }, rank,
+           "bcast");
 
   // --- tag-matched send/recv pair 0 -> 3 ----------------------------------
   if (rank == 0) {
@@ -106,15 +109,8 @@ void drive_rank(int h, int rank) {
     r.tag = 42;
     r.res = in.data();
     r.res_dtype = r.acc_dtype = r.cmp_dtype = accl::DT_F32;
-    if (run(h, r) == 0) {
-      for (auto v : in)
-        if (v != 3.25f) {
-          CHECK(false, "rank 3 recv value %f", v);
-          break;
-        }
-    } else {
-      CHECK(false, "rank 3 recv rc");
-    }
+    check_op(run(h, r), in, [](float v) { return v == 3.25f; }, rank,
+             "recv");
   }
 
   // --- MAX reduce to root 2 ----------------------------------------------
@@ -129,16 +125,9 @@ void drive_rank(int h, int rank) {
   m.res = rank == 2 ? mxout.data() : nullptr;
   m.op0_dtype = m.acc_dtype = m.cmp_dtype = accl::DT_F32;
   m.res_dtype = rank == 2 ? accl::DT_F32 : accl::DT_NONE;
-  if (run(h, m) == 0) {
-    if (rank == 2)
-      for (auto v : mxout)
-        if (v != 3.0f) {
-          CHECK(false, "reduce max value %f", v);
-          break;
-        }
-  } else {
-    CHECK(false, "rank %d reduce rc", rank);
-  }
+  uint32_t mrc = run(h, m);  // sequence BEFORE copying mxout for the check
+  check_op(mrc, rank == 2 ? mxout : std::vector<float>{},
+           [](float v) { return v == 3.0f; }, rank, "reduce-max");
 
   // --- compressed allreduce: bf16 then fp8-e4m3 on the wire ---------------
   for (int wire : {accl::DT_BF16, accl::DT_F8E4M3}) {
@@ -153,22 +142,16 @@ void drive_rank(int h, int rank) {
     c.res = cr.data();
     c.op0_dtype = c.res_dtype = c.acc_dtype = accl::DT_F32;
     c.cmp_dtype = wire;
-    if (run(h, c) == 0) {
-      for (auto v : cr)
-        if (std::fabs(v - 2.5f) >= 0.2f) {
-          CHECK(false, "rank %d compressed(%d) value %f", rank, wire, v);
-          break;
-        }
-    } else {
-      CHECK(false, "rank %d compressed(%d) allreduce rc", rank, wire);
-    }
+    check_op(run(h, c), cr,
+             [](float v) { return std::fabs(v - 2.5f) < 0.2f; }, rank,
+             wire == accl::DT_BF16 ? "allreduce-bf16" : "allreduce-fp8");
   }
 
   // --- barrier ------------------------------------------------------------
   CallArgs bar;
   bar.op = accl::OP_BARRIER;
   bar.acc_dtype = bar.cmp_dtype = accl::DT_F32;
-  CHECK(run(h, bar) == 0, "rank %d barrier rc", rank);
+  check_op(run(h, bar), {}, [](float) { return true; }, rank, "barrier");
 }
 
 }  // namespace

@@ -155,9 +155,10 @@ def test_pure_cpp_selftest():
     import subprocess
 
     import os
+    import shlex
 
     native = pathlib.Path(__file__).resolve().parent.parent / "native"
-    cxx = os.environ.get("CXX", "g++")
+    cxx = shlex.split(os.environ.get("CXX", "g++"))[0]
     if shutil.which("make") is None or shutil.which(cxx) is None:
         pytest.skip(f"no C++ toolchain (make + {cxx})")
     build = subprocess.run(
