@@ -51,6 +51,13 @@ class TransformerConfig:
     # longer scales with n_layers — the standard TPU recipe for fitting
     # larger models/batches (HBM is the bottleneck, MXU has headroom)
     remat: bool = False
+    # Megatron-style sequence parallelism: between blocks, activations
+    # live SEQUENCE-sharded over tp (T/tp per chip), the row-parallel
+    # allreduce becomes a reduce-scatter, and an all-gather precedes each
+    # column-parallel matmul — same wire bytes as the two allreduces
+    # (AR = RS + AG), but layernorm/residual compute and inter-block
+    # activation memory drop by the tp factor
+    seq_parallel: bool = False
 
 
 # parameter partition specs over ('dp', 'tp'): column-parallel weights shard
@@ -135,6 +142,20 @@ def _mlp(x, lp, tp_axis):
     return x + partial_f
 
 
+def _attn_partial(h, lp, n_heads_local):
+    """Column-parallel attention on a full-sequence activation: returns
+    the row-parallel PARTIAL output (pre-reduction) and the (k, v) head
+    tensors (B, H_local, T, hd) for KV-cache prefill."""
+    B, T, _ = h.shape
+    q, k, v = h @ lp["wq"], h @ lp["wk"], h @ lp["wv"]  # column-parallel
+    hd = q.shape[-1] // n_heads_local
+    reshape = lambda t: t.reshape(B, T, n_heads_local, hd).transpose(0, 2, 1, 3)
+    q, k, v = reshape(q), reshape(k), reshape(v)
+    attn = _attention(q, k, v)
+    attn = attn.transpose(0, 2, 1, 3).reshape(B, T, -1)
+    return attn @ lp["wo"], (k, v)
+
+
 def _block(x, lp, n_heads_local, tp_axis, return_kv=False):
     """One transformer block on tp-sharded weights.  ``lp['wqkv']`` etc. are
     the *local shards*; the tp-allreduce after each row-parallel matmul is
@@ -142,35 +163,74 @@ def _block(x, lp, n_heads_local, tp_axis, return_kv=False):
 
     ``return_kv=True`` additionally returns the (k, v) head tensors
     (B, H_local, T, hd) — the prefill path of the KV-cache decode."""
-    B, T, D = x.shape
     h = _layernorm(x, lp["ln1"])
-    q, k, v = h @ lp["wq"], h @ lp["wk"], h @ lp["wv"]  # column-parallel
-    hd = q.shape[-1] // n_heads_local
-    reshape = lambda t: t.reshape(B, T, n_heads_local, hd).transpose(0, 2, 1, 3)
-    q, k, v = reshape(q), reshape(k), reshape(v)
-    attn = _attention(q, k, v)
-    attn = attn.transpose(0, 2, 1, 3).reshape(B, T, -1)
-    partial_o = attn @ lp["wo"]  # row-parallel: partial sums
+    partial_o, kv = _attn_partial(h, lp, n_heads_local)
     if tp_axis is not None:
         partial_o = collectives.allreduce(partial_o, tp_axis, ReduceFunction.SUM)
     x = x + partial_o
     out = _mlp(x, lp, tp_axis)
-    return (out, (k, v)) if return_kv else out
+    return (out, kv) if return_kv else out
+
+
+def _block_sp(x_sp, lp, n_heads_local, tp_axis):
+    """Sequence-parallel block (Megatron-SP): ``x_sp`` is (B, T/tp, D),
+    sequence-sharded over ``tp``.  All-gather restores the full sequence
+    in front of each column-parallel matmul; the row-parallel reduction
+    becomes a reduce-scatter back onto the sequence shards — the same
+    wire bytes as _block's two allreduces (AR = RS + AG), with layernorm,
+    residuals, and inter-block activations at 1/tp the memory."""
+    from jax import lax
+
+    h = _layernorm(x_sp, lp["ln1"])
+    h_full = lax.all_gather(h, tp_axis, axis=1, tiled=True)
+    partial_o, _ = _attn_partial(h_full, lp, n_heads_local)
+    o_sp = lax.psum_scatter(
+        partial_o, tp_axis, scatter_dimension=1, tiled=True
+    )
+    x_sp = x_sp + o_sp
+    h = _layernorm(x_sp, lp["ln2"])
+    h_full = lax.all_gather(h, tp_axis, axis=1, tiled=True)
+    partial_f = jax.nn.gelu(h_full @ lp["w1"]) @ lp["w2"]
+    f_sp = lax.psum_scatter(
+        partial_f, tp_axis, scatter_dimension=1, tiled=True
+    )
+    return x_sp + f_sp
 
 
 def forward(params, tokens, cfg: TransformerConfig, tp_axis=None, tp_size=1):
     """Logits for a token batch.  With tp_axis set, runs on weight shards
     inside shard_map; without, a plain single-device forward."""
+    from jax import lax
+
     B, T = tokens.shape
     x = params["embed"][tokens] + params["pos"][:T]
     heads_local = cfg.n_heads // tp_size
-    block = partial(_block, n_heads_local=heads_local, tp_axis=tp_axis)
+    sp = cfg.seq_parallel and tp_axis is not None and tp_size > 1
+    if sp:
+        if T % tp_size:
+            raise ValueError(
+                f"seq_parallel needs T ({T}) divisible by tp ({tp_size})"
+            )
+        # enter the sequence-sharded regime: this rank keeps its T/tp slice
+        Tl = T // tp_size
+        idx = lax.axis_index(tp_axis)
+        x = lax.dynamic_slice_in_dim(x, idx * Tl, Tl, axis=1)
+        block = partial(_block_sp, n_heads_local=heads_local, tp_axis=tp_axis)
+    else:
+        block = partial(_block, n_heads_local=heads_local, tp_axis=tp_axis)
     if cfg.remat:
         block = jax.checkpoint(block)
     for lp in params["layers"]:
         x = block(x, lp)
     x = _layernorm(x, params["ln_f"])
-    return x @ params["embed"].T
+    logits = x @ params["embed"].T
+    if sp:
+        # leave the sharded regime: gather the sequence back (invariant
+        # form — the caller may claim tp-replicated outputs)
+        logits = collectives.allgather_invariant(
+            logits, tp_axis, axis=1
+        )
+    return logits
 
 
 def loss_fn(params, tokens, targets, cfg, tp_axis=None, tp_size=1):

@@ -96,6 +96,47 @@ def allgather(x: jax.Array, axis_name: str, tiled: bool = True) -> jax.Array:
     return lax.all_gather(x, axis_name, tiled=tiled)
 
 
+try:  # Varying -> Invariant allgather (not yet re-exported publicly)
+    from jax._src.lax.parallel import all_gather_invariant as _ag_invariant
+except ImportError:  # pragma: no cover - older jax
+    _ag_invariant = None
+
+
+def allgather_invariant(
+    x: jax.Array, axis_name: str, axis: int = 0, tiled: bool = True
+) -> jax.Array:
+    """Allgather whose output shard_map's replication checker accepts as
+    axis-invariant — required whenever the gathered value flows to a
+    replicated (``P(None)``) output.  Falls back to a psum of scattered
+    slices (provably invariant, 2x the wire bytes) on jax versions
+    without ``all_gather_invariant``."""
+    if _ag_invariant is not None:
+        return _ag_invariant(x, axis_name, axis=axis, tiled=tiled)
+    # pragma: no cover - older-jax fallback.  The scatter+psum assembly
+    # needs the STATIC axis size for its shapes; a jax old enough to lack
+    # both the private op and lax.axis_size gets a clear error instead of
+    # a trace-time mystery.
+    if not hasattr(lax, "axis_size"):
+        raise RuntimeError(
+            "allgather_invariant needs jax with lax.axis_size or "
+            "all_gather_invariant"
+        )
+    size = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    block = x.shape[axis]
+    full_shape = list(x.shape)
+    full_shape[axis] = block * size
+    contrib = lax.dynamic_update_slice_in_dim(
+        jnp.zeros(tuple(full_shape), x.dtype), x, idx * block, axis=axis
+    )
+    out = lax.psum(contrib, axis_name)
+    if tiled:
+        return out
+    return out.reshape(
+        x.shape[:axis] + (size, block) + x.shape[axis + 1:]
+    )
+
+
 def bcast(x: jax.Array, axis_name: str, root: int = 0) -> jax.Array:
     """ref ``ACCL::bcast`` — root's block everywhere.
 

@@ -37,12 +37,7 @@ try:
 except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map  # type: ignore
 
-try:  # varying -> invariant allgather: exactly the ZeRO reassembly op.
-    # Not yet re-exported publicly; fall back to a psum-of-scattered-slices
-    # assembly (2x the wire bytes) on jax versions without it.
-    from jax._src.lax.parallel import all_gather_invariant as _ag_invariant
-except ImportError:  # pragma: no cover
-    _ag_invariant = None
+from ..ops.collectives import allgather_invariant
 
 
 class AdamConfig(NamedTuple):
@@ -168,18 +163,9 @@ def zero_adam_update(params, grads, state, dp_axis: str, cfg: AdamConfig):
         # rebuild the full parameter from the slices.  The plain
         # lax.all_gather can't be used: its output is conservatively
         # dp-varying, which shard_map's replication checker rejects for a
-        # P(None)-spec'd output.  all_gather_invariant is the
-        # Varying->Invariant form (allgather wire volume, N*(P-1)/P); the
-        # fallback psum of scattered slices is provably invariant too but
-        # moves 2x the bytes (a full ring allreduce of N).
-        if _ag_invariant is not None:
-            new_flat = _ag_invariant(new_shard, dp_axis, tiled=True)
-        else:  # pragma: no cover - older jax
-            contrib = lax.dynamic_update_slice_in_dim(
-                jnp.zeros((padded,), p.dtype), new_shard,
-                idx * (padded // dp), axis=0,
-            )
-            new_flat = lax.psum(contrib, dp_axis)
+        # P(None)-spec'd output; allgather_invariant is the
+        # Varying->Invariant form at allgather wire volume.
+        new_flat = allgather_invariant(new_shard, dp_axis)
         return new_flat[:n].reshape(p.shape), m, v
 
     out = jax.tree.map(leaf, params, grads, state["m"], state["v"])

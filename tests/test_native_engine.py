@@ -158,7 +158,7 @@ def test_pure_cpp_selftest():
     import shlex
 
     native = pathlib.Path(__file__).resolve().parent.parent / "native"
-    cxx = shlex.split(os.environ.get("CXX", "g++"))[0]
+    cxx = shlex.split(os.environ.get("CXX") or "g++")[0]
     if shutil.which("make") is None or shutil.which(cxx) is None:
         pytest.skip(f"no C++ toolchain (make + {cxx})")
     build = subprocess.run(
