@@ -39,8 +39,9 @@ def moe_ffn(
     params: dict,
     ep_axis: str | None = None,
     capacity_factor: float = 1.5,
+    k: int = 1,
 ) -> jax.Array:
-    """Top-1 gated MoE FFN.
+    """Top-k gated MoE FFN (k=1 is Switch routing, k=2 the classic MoE).
 
     ``x``: (B, T, D) local tokens.  Without ``ep_axis``: every expert is
     local (single-device reference semantics).  With ``ep_axis`` (inside
@@ -48,8 +49,13 @@ def moe_ffn(
     (E_local = E/ep leading dim) while ``params['gate']`` is replicated;
     dispatch and combine are all-to-alls over the axis.
 
+    Each token routes to its top-k experts with the gate probabilities
+    renormalized over the chosen k; every (token, choice) pair is an
+    independent routing entry through the same fixed-capacity dispatch,
+    so the layer stays static-shaped for any k.
+
     Returns (B, T, D): expert outputs weighted by the gate probability;
-    over-capacity tokens contribute zero (callers add the residual).
+    over-capacity entries contribute zero (callers add the residual).
     """
     B, T, D = x.shape
     N = B * T
@@ -62,21 +68,28 @@ def moe_ffn(
     # --- routing (replicated math: identical on every member rank) -------
     logits = flat @ params["gate"]  # (N, E)
     probs = jax.nn.softmax(logits, axis=-1)
-    expert = jnp.argmax(probs, axis=-1)  # (N,) top-1
-    gate_p = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+    topk_p, topk_e = lax.top_k(probs, k)  # (N, k)
+    if k > 1:
+        # classic top-k MoE renormalizes over the chosen experts; k=1
+        # keeps the RAW softmax prob — Switch routing scales by it so the
+        # router keeps a gradient (p/p == 1 would zero d/d(gate))
+        topk_p = topk_p / jnp.sum(topk_p, axis=-1, keepdims=True)
+    expert = topk_e.reshape(-1)  # (N*k,) routing entries
+    gate_p = topk_p.reshape(-1)
+    entry_tok = jnp.repeat(jnp.arange(N), k)  # entry -> source token
 
-    # fixed capacity per expert (static shape); position of each token in
+    # fixed capacity per expert (static shape); position of each entry in
     # its expert's send buffer via a cumulative count
-    cap = max(1, int(capacity_factor * N / E))
-    onehot = jax.nn.one_hot(expert, E, dtype=jnp.int32)  # (N, E)
-    pos = jnp.cumsum(onehot, axis=0) * onehot  # 1-based slot per token
-    slot = jnp.sum(pos, axis=1) - 1  # (N,) 0-based; -1 if unrouted
+    cap = max(1, int(capacity_factor * N * k / E))
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.int32)  # (N*k, E)
+    pos = jnp.cumsum(onehot, axis=0) * onehot  # 1-based slot per entry
+    slot = jnp.sum(pos, axis=1) - 1  # (N*k,) 0-based; -1 if unrouted
     keep = (slot >= 0) & (slot < cap)
 
     # --- dispatch: (E, cap, D) send buffer, scattered by (expert, slot) --
     disp = jnp.zeros((E, cap, D), x.dtype)
     disp = disp.at[expert, jnp.clip(slot, 0, cap - 1)].add(
-        flat * keep[:, None].astype(x.dtype)
+        flat[entry_tok] * keep[:, None].astype(x.dtype)
     )
 
     if ep_axis is not None:
@@ -108,7 +121,9 @@ def moe_ffn(
     else:
         combined = out
 
-    # --- combine: gather each token's expert output, weight by gate ------
-    got = combined[expert, jnp.clip(slot, 0, cap - 1)]  # (N, D)
-    y = got * (gate_p * keep.astype(x.dtype))[:, None]
+    # --- combine: gather each entry's expert output, weight by gate, and
+    # sum a token's k contributions ---------------------------------------
+    got = combined[expert, jnp.clip(slot, 0, cap - 1)]  # (N*k, D)
+    weighted = got * (gate_p * keep.astype(x.dtype))[:, None]
+    y = weighted.reshape(N, k, D).sum(axis=1)
     return y.reshape(B, T, D)

@@ -179,20 +179,18 @@ def _block_sp(x_sp, lp, n_heads_local, tp_axis):
     becomes a reduce-scatter back onto the sequence shards — the same
     wire bytes as _block's two allreduces (AR = RS + AG), with layernorm,
     residuals, and inter-block activations at 1/tp the memory."""
-    from jax import lax
-
     h = _layernorm(x_sp, lp["ln1"])
-    h_full = lax.all_gather(h, tp_axis, axis=1, tiled=True)
+    h_full = collectives.allgather(h, tp_axis, axis=1)
     partial_o, _ = _attn_partial(h_full, lp, n_heads_local)
-    o_sp = lax.psum_scatter(
-        partial_o, tp_axis, scatter_dimension=1, tiled=True
+    o_sp = collectives.reduce_scatter(
+        partial_o, tp_axis, tiled=True, axis=1
     )
     x_sp = x_sp + o_sp
     h = _layernorm(x_sp, lp["ln2"])
-    h_full = lax.all_gather(h, tp_axis, axis=1, tiled=True)
+    h_full = collectives.allgather(h, tp_axis, axis=1)
     partial_f = jax.nn.gelu(h_full @ lp["w1"]) @ lp["w2"]
-    f_sp = lax.psum_scatter(
-        partial_f, tp_axis, scatter_dimension=1, tiled=True
+    f_sp = collectives.reduce_scatter(
+        partial_f, tp_axis, tiled=True, axis=1
     )
     return x_sp + f_sp
 

@@ -71,19 +71,25 @@ def reduce_scatter(
     axis_name: str,
     function: ReduceFunction = ReduceFunction.SUM,
     tiled: bool = False,
+    axis: int = 0,
 ) -> jax.Array:
-    """ref ``ACCL::reduce_scatter`` — rank i gets block i of the reduction.
+    """ref ``ACCL::reduce_scatter`` — rank i gets block i of the reduction
+    along ``axis``.
 
     SUM lowers to a single XLA reduce-scatter (``psum_scatter``); MAX is
     composed as pmax + local slice (XLA fuses the slice)."""
     if function == ReduceFunction.SUM:
-        return lax.psum_scatter(x, axis_name, scatter_dimension=0, tiled=tiled)
+        return lax.psum_scatter(
+            x, axis_name, scatter_dimension=axis, tiled=tiled
+        )
     full = allreduce(x, axis_name, function)
     size = lax.axis_size(axis_name)
-    block = x.shape[0] // size
+    block = x.shape[axis] // size
     start = lax.axis_index(axis_name) * block
-    out = lax.dynamic_slice_in_dim(full, start, block, axis=0)
-    return out if tiled else out.reshape((block,) + x.shape[1:])
+    out = lax.dynamic_slice_in_dim(full, start, block, axis=axis)
+    if tiled:
+        return out
+    return out.reshape(x.shape[:axis] + (block,) + x.shape[axis + 1:])
 
 
 # ---------------------------------------------------------------------------
@@ -91,9 +97,12 @@ def reduce_scatter(
 # ---------------------------------------------------------------------------
 
 
-def allgather(x: jax.Array, axis_name: str, tiled: bool = True) -> jax.Array:
-    """ref ``ACCL::allgather`` — concatenation of every rank's block."""
-    return lax.all_gather(x, axis_name, tiled=tiled)
+def allgather(
+    x: jax.Array, axis_name: str, tiled: bool = True, axis: int = 0
+) -> jax.Array:
+    """ref ``ACCL::allgather`` — concatenation of every rank's block
+    along ``axis``."""
+    return lax.all_gather(x, axis_name, tiled=tiled, axis=axis)
 
 
 try:  # Varying -> Invariant allgather (not yet re-exported publicly)

@@ -174,3 +174,57 @@ def test_pipeline_loss_and_grads():
     np.testing.assert_allclose(
         np.asarray(g_pp), np.asarray(g_seq), rtol=1e-4, atol=1e-6
     )
+
+
+def test_moe_top2_matches_dense_reference():
+    """Top-2 routing == explicit dense computation: each token gets the
+    renormalized-gate-weighted sum of its two best experts' FFN outputs
+    (no-drop capacity)."""
+    B, T, D, F, E = 2, 8, 16, 32, 8
+    params = init_moe_params(jax.random.PRNGKey(2), D, F, E)
+    x = jax.random.normal(jax.random.PRNGKey(3), (B, T, D), jnp.float32)
+
+    out = moe_ffn(x, params, None, capacity_factor=float(E), k=2)
+
+    flat = x.reshape(-1, D)
+    probs = jax.nn.softmax(flat @ params["gate"], axis=-1)
+    topk_p, topk_e = jax.lax.top_k(probs, 2)
+    topk_p = topk_p / topk_p.sum(-1, keepdims=True)
+    expect = np.zeros_like(np.asarray(flat))
+    for i in range(flat.shape[0]):
+        for j in range(2):
+            e = int(topk_e[i, j])
+            h = np.asarray(jax.nn.gelu(flat[i] @ params["w1"][e]))
+            expect[i] += float(topk_p[i, j]) * (h @ np.asarray(params["w2"][e]))
+    np.testing.assert_allclose(
+        np.asarray(out).reshape(-1, D), expect, rtol=2e-4, atol=2e-5
+    )
+
+
+def test_moe_top2_expert_parallel_matches_local():
+    """Top-2 over the ep axis == top-2 with all experts local."""
+    ep, B, T, D, F, E = 4, 1, 8, 16, 32, 8
+    params = init_moe_params(jax.random.PRNGKey(4), D, F, E)
+    x = jax.random.normal(jax.random.PRNGKey(5), (ep, B, T, D), jnp.float32)
+
+    ref = jnp.stack([
+        moe_ffn(x[r], params, None, capacity_factor=float(E), k=2)
+        for r in range(ep)
+    ])
+    mesh = _mesh(ep, "ep")
+    fn = jax.jit(
+        shard_map(
+            lambda xl, g, w1, w2: moe_ffn(
+                xl[0], {"gate": g, "w1": w1, "w2": w2}, "ep",
+                capacity_factor=float(E), k=2,
+            )[None],
+            mesh=mesh,
+            in_specs=(P("ep"), P(), P("ep"), P("ep")),
+            out_specs=P("ep"),
+            check_vma=False,
+        )
+    )
+    out = fn(x, params["gate"], params["w1"], params["w2"])
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
