@@ -311,7 +311,11 @@ def generate(
     """Greedy autoregressive decode: prefill the prompt, then ``steps``
     single-token steps through the KV cache under one ``lax.scan`` (static
     shapes, ONE compiled step body regardless of length).  Returns the
-    (B, steps) generated token ids."""
+    (B, steps) generated token ids.
+
+    ``cfg.seq_parallel`` is ignored here: decode works position-at-a-time,
+    so there is no sequence dimension to shard — the replicated-activation
+    math is used regardless (and is exact either way)."""
     B, T = prompt.shape
     if T + steps > cfg.max_seq:
         raise ValueError(
