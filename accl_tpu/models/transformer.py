@@ -300,6 +300,18 @@ def prefill(
     return x[:, -1] @ params["embed"].T, caches
 
 
+def _select_token(logits, key, temperature: float, top_k: Optional[int]):
+    """Next-token selection: greedy at temperature 0, else temperature-
+    scaled (optionally top-k-truncated) categorical sampling."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    if top_k is not None:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
 def generate(
     params,
     prompt,
@@ -307,11 +319,20 @@ def generate(
     cfg: TransformerConfig,
     tp_axis=None,
     tp_size=1,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    rng=None,
 ):
-    """Greedy autoregressive decode: prefill the prompt, then ``steps``
+    """Autoregressive decode: prefill the prompt, then ``steps``
     single-token steps through the KV cache under one ``lax.scan`` (static
     shapes, ONE compiled step body regardless of length).  Returns the
     (B, steps) generated token ids.
+
+    ``temperature=0`` (default) is greedy; ``temperature > 0`` samples
+    from the temperature-scaled distribution, truncated to ``top_k``
+    logits when given, with one PRNG split per step from ``rng`` — inside
+    shard_map the same replicated key yields identical samples on every
+    rank, so the tp gang never diverges.
 
     ``cfg.seq_parallel`` is ignored here: decode works position-at-a-time,
     so there is no sequence dimension to shard — the replicated-activation
@@ -321,14 +342,23 @@ def generate(
         raise ValueError(
             f"prompt {T} + steps {steps} exceeds max_seq {cfg.max_seq}"
         )
+    if temperature > 0.0 and rng is None:
+        raise ValueError("sampling (temperature > 0) requires rng")
+    if top_k is not None and not 0 < top_k <= cfg.vocab:
+        raise ValueError(
+            f"top_k must be in [1, vocab={cfg.vocab}], got {top_k}"
+        )
+    if rng is None:
+        rng = jax.random.PRNGKey(0)  # carried but unused on the greedy path
     heads_local = cfg.n_heads // tp_size
     logits, caches = prefill(
         params, prompt, cfg, tp_axis, tp_size, cache_len=T + steps
     )
-    first = jnp.argmax(logits, axis=-1).astype(prompt.dtype)  # (B,)
+    rng, sub = jax.random.split(rng)
+    first = _select_token(logits, sub, temperature, top_k).astype(prompt.dtype)
 
     def step(carry, _):
-        caches, tok, pos = carry
+        caches, tok, pos, key = carry
         pos_emb = jax.lax.dynamic_slice_in_dim(params["pos"], pos, 1, 0)
         x = params["embed"][tok][:, None, :] + pos_emb[None, 0:1]
         new_caches = []
@@ -339,34 +369,57 @@ def generate(
             new_caches.append((ck, cv))
         x = _layernorm(x, params["ln_f"])
         logits = x[:, 0] @ params["embed"].T
-        nxt = jnp.argmax(logits, axis=-1).astype(tok.dtype)
-        return (new_caches, nxt, pos + 1), tok
+        key, sub = jax.random.split(key)
+        nxt = _select_token(logits, sub, temperature, top_k).astype(tok.dtype)
+        return (new_caches, nxt, pos + 1, key), tok
 
-    (_, _, _), toks = jax.lax.scan(
-        step, (caches, first, jnp.asarray(T)), None, length=steps
+    (_, _, _, _), toks = jax.lax.scan(
+        step, (caches, first, jnp.asarray(T), rng), None, length=steps
     )
     # each iteration emits the token it fed: [g_0 .. g_{steps-1}]
     return toks.T  # (B, steps)
 
 
 def make_sharded_generate(
-    cfg: TransformerConfig, mesh: Mesh, steps: int
+    cfg: TransformerConfig,
+    mesh: Mesh,
+    steps: int,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
 ):
-    """Jitted dp/tp-sharded greedy generation over the mesh: the KV cache
-    lives head-sharded on the tp axis (each chip holds its heads' cache),
-    the batch dp-sharded — the serving-side layout of the training
-    parallelism plan.  Returns (fn, shard_fn)."""
+    """Jitted dp/tp-sharded generation over the mesh: the KV cache lives
+    head-sharded on the tp axis (each chip holds its heads' cache), the
+    batch dp-sharded — the serving-side layout of the training
+    parallelism plan.  Returns (fn, shard_fn); with ``temperature > 0``
+    the returned fn takes (params, prompt, rng) — the key is replicated,
+    then folded with the dp index so each batch shard draws its own
+    stream while a tp gang stays in lockstep."""
     specs = param_specs(cfg)
     tp = mesh.shape["tp"]
 
-    def gen(params, prompt):
-        return generate(params, prompt, steps, cfg, "tp", tp)
+    if temperature > 0.0:
+        from jax import lax
+
+        def gen(params, prompt, rng):
+            rng = jax.random.fold_in(rng, lax.axis_index("dp"))
+            return generate(
+                params, prompt, steps, cfg, "tp", tp,
+                temperature=temperature, top_k=top_k, rng=rng,
+            )
+
+        in_specs = (specs, P("dp", None), P())
+    else:
+
+        def gen(params, prompt):
+            return generate(params, prompt, steps, cfg, "tp", tp)
+
+        in_specs = (specs, P("dp", None))
 
     fn = jax.jit(
         shard_map(
             gen,
             mesh=mesh,
-            in_specs=(specs, P("dp", None)),
+            in_specs=in_specs,
             out_specs=P("dp", None),
             check_vma=False,
         )
