@@ -22,7 +22,7 @@ The ``driver`` module wraps both in host-level helpers that take global
 arrays and a Mesh and run the jitted SPMD program.
 """
 
-from . import collectives, pallas, ring  # noqa: F401
+from . import collectives, overlap, pallas, ring  # noqa: F401
 from .driver import (  # noqa: F401
     make_mesh,
     run_allgather,

@@ -166,3 +166,111 @@ def test_sendrecv_shift(mesh, stacked):
     out = np.asarray(fn(jnp.asarray(stacked)))
     for r in range(P):
         np.testing.assert_array_equal(out[r], stacked[(r - 1) % P])
+
+
+# ---------------------------------------------------------------------------
+# overlap primitives (ring-scheduled matmul + reduction)
+# ---------------------------------------------------------------------------
+
+
+def _smap_overlap(fn, mesh):
+    from jax.sharding import PartitionSpec as PS
+
+    try:
+        from jax import shard_map
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+
+    return jax.jit(
+        shard_map(
+            fn, mesh=mesh, in_specs=(PS("ranks"), PS("ranks")),
+            out_specs=PS("ranks"), check_vma=False,
+        )
+    )
+
+
+def test_matmul_reduce_scatter_exact(mesh):
+    """Ring-scheduled fused matmul+reduce_scatter == matmul then
+    reduce_scatter (the decomposition only reorders a sum)."""
+    from accl_tpu.ops import overlap
+
+    size = P
+    B, K, N = 4, 16, 32  # K_local = K per rank (already sharded)
+    rng = np.random.default_rng(0)
+    xs = rng.standard_normal((size, B, K)).astype(np.float32)
+    ws = rng.standard_normal((size, K, N)).astype(np.float32)
+
+    full = np.einsum("rbk,rkn->bn", xs, ws)  # summed over ranks
+    blk = N // size
+
+    fn = _smap_overlap(
+        lambda x, w: overlap.matmul_reduce_scatter(x[0], w[0], "ranks")[None],
+        mesh,
+    )
+    out = np.asarray(fn(jnp.asarray(xs), jnp.asarray(ws)))
+    for r in range(size):
+        np.testing.assert_allclose(
+            out[r], full[:, r * blk : (r + 1) * blk], rtol=2e-4, atol=2e-4
+        )
+
+
+def test_matmul_allreduce_exact(mesh):
+    from accl_tpu.ops import overlap
+
+    size = P
+    B, K, N = 2, 8, 16
+    rng = np.random.default_rng(1)
+    xs = rng.standard_normal((size, B, K)).astype(np.float32)
+    ws = rng.standard_normal((size, K, N)).astype(np.float32)
+    full = np.einsum("rbk,rkn->bn", xs, ws)
+
+    fn = _smap_overlap(
+        lambda x, w: overlap.matmul_allreduce(x[0], w[0], "ranks")[None],
+        mesh,
+    )
+    out = np.asarray(fn(jnp.asarray(xs), jnp.asarray(ws)))
+    for r in range(size):
+        np.testing.assert_allclose(out[r], full, rtol=2e-4, atol=2e-4)
+
+
+def test_matmul_reduce_scatter_rejects_ragged(mesh):
+    from accl_tpu.ops import overlap
+
+    with pytest.raises(ValueError, match="divide"):
+        _smap_overlap(
+            lambda x, w: overlap.matmul_reduce_scatter(
+                x[0], w[0], "ranks"
+            )[None],
+            mesh,
+        )(jnp.ones((P, 2, 4)), jnp.ones((P, 4, 12)))  # 12 % 8 != 0
+
+
+def test_matmul_allreduce_replicated_outspec(mesh):
+    """The fused TP-layer exit under check_vma=True with a REPLICATED
+    out_spec: the invariant allgather makes the replication claim
+    provable, the exact scenario row-parallel layers need."""
+    from jax.sharding import PartitionSpec as PS
+
+    try:
+        from jax import shard_map
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+
+    from accl_tpu.ops import overlap
+
+    tp = P
+    B, K, N = 3, 8, 32
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal((B, tp * K)).astype(np.float32)
+    w = rng.standard_normal((tp * K, N)).astype(np.float32)
+
+    fused = jax.jit(
+        shard_map(
+            lambda xl, wl: overlap.matmul_allreduce(xl, wl, "ranks"),
+            mesh=mesh,
+            in_specs=(PS(None, "ranks"), PS("ranks", None)),
+            out_specs=PS(None, None),  # replicated: demands invariance
+        )
+    )
+    out = np.asarray(fused(jnp.asarray(x), jnp.asarray(w)))
+    np.testing.assert_allclose(out, x @ w, rtol=2e-4, atol=2e-3)
