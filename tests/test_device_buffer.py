@@ -512,3 +512,28 @@ def test_copy_host_memory_matrix(dgroup4, src_host, dst_host):
     a.copy(src, dst, n)
     dst.sync_from_device()
     np.testing.assert_array_equal(dst.data, data)
+
+
+def test_assembly_cache_evicts_with_buffers(dgroup4):
+    """The gang's assembled-global cache must die with its buffers: after
+    the application drops them, the weakref callbacks evict the entries
+    so cached globals can't pin freed HBM."""
+    import gc
+
+    gang = dgroup4[0].engine.gang
+    n = 64
+    send = [
+        a.create_buffer_from(np.full(n, float(r), np.float32))
+        for r, a in enumerate(dgroup4)
+    ]
+    recv = [a.create_buffer(n, np.float32) for a in dgroup4]
+
+    def work(a, r):
+        a.allreduce(send[r], recv[r], n)
+
+    run_parallel(dgroup4, work)
+    assert len(gang._asm_cache) >= 1  # the run populated it
+    before = len(gang._asm_cache)
+    del send, work
+    gc.collect()
+    assert len(gang._asm_cache) < before, "entries must evict on buffer gc"
