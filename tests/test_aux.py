@@ -134,12 +134,26 @@ def test_debug_dumps(group2):
 
 
 def test_launcher_multiprocess():
-    """The mpirun-analog: N OS processes over the socket fabric."""
+    """The mpirun-analog: N OS processes over the socket fabric.  Ports
+    are randomized with retries: a fixed port flakes under parallel test
+    runs (TIME_WAIT / contention)."""
+    import random
+
     from accl_tpu.launch import launch_processes
     from tests_launch_target import allreduce_main  # see module below
 
-    results = launch_processes(allreduce_main, world=2, base_port=47411)
-    assert results == [3.0, 3.0]
+    last = None
+    for _ in range(3):
+        base = random.randint(30000, 55000)
+        try:
+            results = launch_processes(
+                allreduce_main, world=2, base_port=base
+            )
+            assert results == [3.0, 3.0]
+            return
+        except RuntimeError as e:  # port clash: retry elsewhere
+            last = e
+    raise last
 
 
 def test_stress_short(group2):
