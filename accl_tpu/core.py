@@ -751,6 +751,48 @@ class ACCL:
     def dump_communicator(self, comm: Optional[Communicator] = None) -> str:
         return (comm or self._world).dump()
 
+    def capabilities(self) -> dict:
+        """Capability report — the role of the reference's HWID idcode
+        (``parse_hwid``, accl.cpp:1050-1064, bits baked by
+        rebuild_bd.tcl:114): what this handle's engine/tier can do.
+        Feature bits are runtime-detected instead of build-baked."""
+        import sys
+
+        try:
+            from .native import available as native_available
+        except Exception:  # pragma: no cover
+            def native_available() -> bool:
+                return False
+        wire_dtypes = sorted(
+            f"{u.name}->{c.name}" for (u, c) in self._arith if u != c
+        )
+        engine = type(self.engine).__name__
+        caps = {
+            "engine": engine,
+            # by NAME: importing the class would pull jax into jax-free
+            # emulator/native-tier processes just to render a report
+            "device_tier": engine in ("XLAEngine", "DistEngine"),
+            "native_dataplane": bool(native_available()),
+            "wire_compression": wire_dtypes,
+            "arithmetic": [f.name for f in ReduceFunction],
+            "streams": True,
+            "rendezvous": True,
+            "world_size": self._world.size,
+        }
+        # platform only when a jax BACKEND is already initialized: first
+        # backend discovery is a side effect a read-only report must not
+        # trigger (it can hang on unreachable site PJRT platforms)
+        caps["platform"] = None
+        if "jax" in sys.modules:
+            try:
+                from jax._src import xla_bridge
+
+                if xla_bridge._backends:  # discovery already happened
+                    caps["platform"] = sys.modules["jax"].default_backend()
+            except Exception:  # pragma: no cover - private-API drift
+                pass
+        return caps
+
     def deinit(self) -> None:
         if self._initialized:
             self.engine.shutdown()

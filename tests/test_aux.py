@@ -275,3 +275,15 @@ def test_device_memory_profile():
 
     blob = utils.device_memory_profile()
     assert isinstance(blob, bytes) and len(blob) > 0
+
+
+def test_capabilities_report(group2):
+    """The parse_hwid role: a runtime capability report per handle."""
+    caps = group2[0].capabilities()
+    assert caps["world_size"] == 2
+    assert "SUM" in caps["arithmetic"] and "MAX" in caps["arithmetic"]
+    assert any("FLOAT16" in w for w in caps["wire_compression"])
+    assert any("FLOAT8" in w for w in caps["wire_compression"])
+    assert caps["streams"] and caps["rendezvous"]
+    assert isinstance(caps["device_tier"], bool)
+    assert caps["platform"] == "cpu"
