@@ -606,6 +606,22 @@ class XLAGangContext:
             Operation.REDUCE, Operation.BCAST, Operation.SCATTER,
             Operation.GATHER,
         ):
+            if op == Operation.BCAST:
+                # The donating bcast consumes shard arrays that may also
+                # back cached assembled globals from earlier ops on the
+                # same buffers.  JAX copy-on-donate keeps those entries
+                # readable, but evict them anyway so no cache hit can ever
+                # observe a donated (possibly aliased) array.
+                donors = {
+                    id(c.op0) for c in calls
+                    if c.op0 is not None and not c.op0.is_dummy
+                }
+                stale = [
+                    k for k, v in self._asm_cache.items()
+                    if any(id(ref()) in donors for ref in v[2])
+                ]
+                for k in stale:
+                    self._asm_cache.pop(k, None)
             out = self._run_rooted(op, global_arr, mesh, lead, donate=True)
         elif op == Operation.ALLGATHER:
             out = opdriver.run_allgather(global_arr, mesh)

@@ -84,12 +84,27 @@ def reduce_scatter(
         )
     full = allreduce(x, axis_name, function)
     size = lax.axis_size(axis_name)
+    if not tiled:
+        # match psum_scatter(tiled=False): the scatter dimension must
+        # equal the axis size and is squeezed from the result
+        if x.shape[axis] != size:
+            raise ValueError(
+                f"reduce_scatter: tiled=False requires axis {axis} length "
+                f"{x.shape[axis]} == axis size {size}"
+            )
+        out = lax.dynamic_slice_in_dim(
+            full, lax.axis_index(axis_name), 1, axis=axis
+        )
+        return lax.squeeze(out, (axis,))
+    if x.shape[axis] % size != 0:
+        raise ValueError(
+            f"reduce_scatter: axis {axis} length {x.shape[axis]} is not "
+            f"divisible by axis size {size} (non-SUM path has no padding; "
+            "pad the operand or use a divisible count)"
+        )
     block = x.shape[axis] // size
     start = lax.axis_index(axis_name) * block
-    out = lax.dynamic_slice_in_dim(full, start, block, axis=axis)
-    if tiled:
-        return out
-    return out.reshape(x.shape[:axis] + (block,) + x.shape[axis + 1:])
+    return lax.dynamic_slice_in_dim(full, start, block, axis=axis)
 
 
 # ---------------------------------------------------------------------------
@@ -121,10 +136,19 @@ def allgather_invariant(
     without ``all_gather_invariant``."""
     if _ag_invariant is not None:
         return _ag_invariant(x, axis_name, axis=axis, tiled=tiled)
-    # pragma: no cover - older-jax fallback.  The scatter+psum assembly
-    # needs the STATIC axis size for its shapes; a jax old enough to lack
-    # both the private op and lax.axis_size gets a clear error instead of
-    # a trace-time mystery.
+    return _allgather_invariant_fallback(x, axis_name, axis=axis, tiled=tiled)
+
+
+def _allgather_invariant_fallback(
+    x: jax.Array, axis_name: str, axis: int = 0, tiled: bool = True
+) -> jax.Array:
+    """Psum-of-scattered-slices allgather: provably axis-invariant on any
+    jax, at 2x the wire bytes.  Kept directly testable (tests force
+    ``_ag_invariant=None``) so a jax upgrade that drops the private op
+    cannot silently change semantics."""
+    # The assembly needs the STATIC axis size for its shapes; a jax old
+    # enough to lack both the private op and lax.axis_size gets a clear
+    # error instead of a trace-time mystery.
     if not hasattr(lax, "axis_size"):
         raise RuntimeError(
             "allgather_invariant needs jax with lax.axis_size or "

@@ -59,11 +59,15 @@ def combine(
     fuses the result-lane compression cast.
 
     ``accumulate=True`` is the in-place form (``a <- f(a, b)``): the output
-    aliases ``a``'s HBM (``input_output_aliases``), so the result lands in
-    the pages just read — on v5e this roughly doubles the streaming rate
-    versus a third distinct stream (measured ~830 vs ~410 GB/s) and beats
-    XLA's fused elementwise (~700).  ``a`` is DONATED: the caller's array
-    is invalidated, exactly like the reference's in-place device BOs.
+    aliases the PACKED operand's HBM (``input_output_aliases``), so the
+    result lands in the pages just read — on v5e this roughly doubles the
+    streaming rate versus a third distinct stream (measured ~830 vs ~410
+    GB/s) and beats XLA's fused elementwise (~700).  The alias is on the
+    lane-packed intermediate: when ``a`` is already lane-packed
+    ((rows, 128), no padding) and the call runs under jit, ``a`` itself is
+    donated and invalidated like the reference's in-place device BOs;
+    otherwise ``pack_lanes`` reshapes/pads into a copy and the caller's
+    array is left untouched.
     """
     if a.shape != b.shape or a.dtype != b.dtype:
         raise ValueError("combine operands must match in shape and dtype")
