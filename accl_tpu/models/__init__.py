@@ -26,4 +26,9 @@ from ..ops.pallas.attention import (  # noqa: F401
 )
 from .ulysses_attention import ulysses_attention  # noqa: F401
 from .moe import init_moe_params, moe_ffn  # noqa: F401
-from .pipeline import pipeline_apply, pipeline_loss  # noqa: F401
+from .pipeline import (  # noqa: F401
+    pipeline_apply,
+    pipeline_loss,
+    pipeline_loss_and_grads,
+    pipeline_loss_and_grads_1f1b,
+)

@@ -103,3 +103,166 @@ def pipeline_loss(
     per_mb = jax.vmap(loss_fn)(outs, targets)  # (M,)
     local = jnp.where(me == S - 1, per_mb.mean(), 0.0)
     return lax.psum(local, pp_axis)
+
+
+def pipeline_loss_and_grads_1f1b(
+    stage_params,
+    microbatches: jax.Array,
+    targets: jax.Array,
+    pp_axis: str,
+    stage_fn: Callable,
+    loss_fn: Callable,
+):
+    """One-forward-one-backward (PipeDream-flush) schedule: same bubble
+    fraction as GPipe for equal-cost phases ((S-1)/(M+S-1)) but the
+    activation stash holds only ``min(S, M)`` in-flight microbatches
+    instead of all ``M`` — the memory profile that makes large-M
+    gradient accumulation affordable on HBM.
+
+    Returns ``(loss, stage_grads)``: the same scalar ``pipeline_loss``
+    yields (every rank), and THIS rank's stage-parameter gradients,
+    computed by a hand-written backward interleaved with the forward.
+
+    Schedule (tick ``t``, stage ``s``, 0-based): forward of microbatch
+    ``f`` at ``t = s + f`` during warmup (``f < S - s``) and
+    ``t = s + 2f`` in steady state; backward of microbatch ``b`` at
+    ``t = 2S - 1 - s + 2b``.  Forward and backward ticks of one stage
+    never coincide (parity), so each tick runs exactly one of
+    {forward, backward, idle} under a per-device ``lax.switch`` —
+    divergent control flow is fine because ALL communication (the fwd
+    activation edge, the reverse gradient edge, and their validity
+    flags) happens unconditionally every tick, keeping the XLA
+    collective schedule uniform and deadlock-free.
+
+    The backward recomputes the stage forward from the stashed INPUT
+    (``jax.vjp`` at use time) — activation rematerialization, the same
+    FLOPs-for-HBM trade ``jax.checkpoint`` makes, which is what bounds
+    the stash at one microbatch input per in-flight stage.
+    """
+    S = lax.axis_size(pp_axis)
+    me = lax.axis_index(pp_axis)
+    M = microbatches.shape[0]
+    mb_shape = microbatches.shape[1:]
+    K = min(S, M)  # ring-stash slots: the max in-flight forwards anywhere
+
+    fwd_edges = [(i, i + 1) for i in range(S - 1)]
+    bwd_edges = [(i + 1, i) for i in range(S - 1)]
+    warm = jnp.minimum(M, S - me)
+
+    def fwd_index(t):
+        off = t - me
+        is_warm = (off >= 0) & (off < warm)
+        f_steady = off // 2
+        is_steady = (
+            (off >= 0) & (off % 2 == 0)
+            & (f_steady >= S - me) & (f_steady < M)
+        )
+        f = jnp.where(is_warm, off, f_steady)
+        return jnp.clip(f, 0, M - 1), is_warm | is_steady
+
+    def bwd_index(t):
+        q = t - (2 * S - 1 - me)
+        b = q // 2
+        return jnp.clip(b, 0, M - 1), (q >= 0) & (q % 2 == 0) & (b < M)
+
+    zero_mb = jnp.zeros(mb_shape, microbatches.dtype)
+    zero_grads = jax.tree_util.tree_map(jnp.zeros_like, stage_params)
+
+    def tick(t, state):
+        fwd_carry, bwd_carry, stash, grads, loss_acc = state
+        f, do_f = fwd_index(t)
+        b, do_b = bwd_index(t)
+
+        x_f = jnp.where(
+            me == 0,
+            lax.dynamic_index_in_dim(microbatches, f, 0, False),
+            fwd_carry,
+        )
+        x_b = lax.dynamic_index_in_dim(stash, b % K, 0, False)
+        tgt_b = lax.dynamic_index_in_dim(targets, b, 0, False)
+
+        def idle_branch(_):
+            return zero_mb, zero_mb, stash, grads, loss_acc
+
+        def fwd_branch(_):
+            act = stage_fn(stage_params, x_f)
+            new_stash = lax.dynamic_update_index_in_dim(stash, x_f, f % K, 0)
+            return act, zero_mb, new_stash, grads, loss_acc
+
+        def bwd_branch(_):
+            y, vjp = jax.vjp(stage_fn, stage_params, x_b)
+            # last stage seeds the cotangent from the loss (the 1/M is
+            # pipeline_loss's per-microbatch mean); upstream stages use
+            # the gradient handed back on the reverse edge
+            g_last = jax.grad(lambda yy: loss_fn(yy, tgt_b))(y) / M
+            g_y = jnp.where(me == S - 1, g_last, bwd_carry)
+            dp, dx = vjp(g_y)
+            new_grads = jax.tree_util.tree_map(jnp.add, grads, dp)
+            lb = jnp.where(me == S - 1, loss_fn(y, tgt_b), 0.0)
+            return zero_mb, dx, stash, new_grads, loss_acc + lb
+
+        branch = jnp.where(do_f, 1, jnp.where(do_b, 2, 0))
+        act, dx, stash, grads, loss_acc = lax.switch(
+            branch, [idle_branch, fwd_branch, bwd_branch], None
+        )
+
+        # uniform communication: both edges + validity flags every tick;
+        # a carry only adopts a VALID arrival (stage s+1 may not consume
+        # an activation until several ticks after s produced it, and the
+        # in-between permutes carry invalid zeros)
+        got_act = lax.ppermute(act, pp_axis, fwd_edges)
+        act_ok = lax.ppermute(do_f.astype(jnp.int32), pp_axis, fwd_edges)
+        got_dx = lax.ppermute(dx, pp_axis, bwd_edges)
+        dx_ok = lax.ppermute(do_b.astype(jnp.int32), pp_axis, bwd_edges)
+        fwd_carry = jnp.where(act_ok > 0, got_act, fwd_carry)
+        bwd_carry = jnp.where(dx_ok > 0, got_dx, bwd_carry)
+        return fwd_carry, bwd_carry, stash, grads, loss_acc
+
+    state = (
+        zero_mb,  # fwd_carry: activation arriving from the previous stage
+        zero_mb,  # bwd_carry: gradient arriving from the next stage
+        jnp.zeros((K,) + mb_shape, microbatches.dtype),
+        zero_grads,
+        jnp.zeros((), jnp.float32),
+    )
+    _, _, _, grads, loss_acc = lax.fori_loop(
+        0, 2 * (M + S - 1), tick, state, unroll=False
+    )
+    loss = lax.psum(jnp.where(me == S - 1, loss_acc / M, 0.0), pp_axis)
+    return loss, grads
+
+
+def pipeline_loss_and_grads(
+    stage_params,
+    microbatches: jax.Array,
+    targets: jax.Array,
+    pp_axis: str,
+    stage_fn: Callable,
+    loss_fn: Callable,
+    schedule: str = "gpipe",
+):
+    """Config-selectable pipeline backward: ``schedule="gpipe"`` is
+    ``jax.grad`` through :func:`pipeline_loss` (autodiff stores one
+    residual set per loop step, O(M) activations); ``"1f1b"`` is the
+    hand-scheduled interleave (O(min(S, M)) stash + recompute).  Both
+    return the identical ``(loss, stage_grads)``."""
+    if schedule == "1f1b":
+        return pipeline_loss_and_grads_1f1b(
+            stage_params, microbatches, targets, pp_axis, stage_fn, loss_fn
+        )
+    if schedule != "gpipe":
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
+    S = lax.axis_size(pp_axis)
+    me = lax.axis_index(pp_axis)
+
+    # differentiate the LOCAL (pre-psum) loss: inside shard_map the
+    # psum's transpose re-sums the replicated cotangent, inflating every
+    # gradient by S.  The last stage's masked scalar still backpropagates
+    # to every stage through the transposed ppermute edges.
+    def local_loss(p):
+        outs = pipeline_apply(p, microbatches, pp_axis, stage_fn)
+        per_mb = jax.vmap(loss_fn)(outs, targets)
+        return jnp.where(me == S - 1, per_mb.mean(), 0.0)
+
+    local, grads = jax.value_and_grad(local_loss)(stage_params)
+    return lax.psum(local, pp_axis), grads

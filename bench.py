@@ -378,6 +378,9 @@ def _bench_ring_allreduce(ndev: int, algo: str = "xla") -> float:
 _SKIP = {
     k for k in os.environ.get("ACCL_BENCH_SKIP", "").split(",") if k
 }
+_DONE: list = []  # _try keys that completed in THIS child (checkpointed:
+# the resume skip-list needs call keys, not extras keys — dict-returning
+# benches like train_mfu emit extras under different names)
 
 
 def _try(extras: dict, errors: dict, key: str, fn):
@@ -394,6 +397,7 @@ def _try(extras: dict, errors: dict, key: str, fn):
             extras.update(val)
         else:
             extras[key] = round(val, 2)
+        _DONE.append(key)
         _checkpoint(extras, errors)
         return val
     except Exception as e:  # noqa: BLE001 - reported, not swallowed
@@ -439,7 +443,7 @@ def _checkpoint(extras: dict, errors: dict, current: str = None) -> None:
     if _CHECKPOINT_PATH:
         # atomic replace: a kill can land mid-write, and the parent must
         # never find a truncated file
-        state = {"extras": extras, "errors": errors}
+        state = {"extras": extras, "errors": errors, "done": list(_DONE)}
         if current is not None:
             state["current"] = current
         tmp = _CHECKPOINT_PATH + ".tmp"
@@ -635,8 +639,9 @@ def _emit_fallback(extras: dict, errors: dict, reason: str) -> None:
 
 def _run_child(budget: float, skip: set) -> tuple:
     """One guarded bench attempt.  Returns (result_or_None, extras,
-    errors, reason, attempted) — ``attempted`` is the metric in flight
-    when the child died, so a resume can skip past it."""
+    errors, done, reason, attempted) — ``done`` is the completed _try
+    keys and ``attempted`` the metric in flight when the child died, so
+    a resume can skip past both."""
     import tempfile
 
     with tempfile.NamedTemporaryFile(mode="r", suffix=".json") as ckpt:
@@ -678,7 +683,10 @@ def _run_child(budget: float, skip: set) -> tuple:
     except json.JSONDecodeError:
         partial = {"extras": {}, "errors": {"checkpoint": "unreadable"}}
     attempted = partial.get("current") if reason else None
-    return result, partial["extras"], partial["errors"], reason, attempted
+    return (
+        result, partial["extras"], partial["errors"],
+        partial.get("done") or [], reason, attempted,
+    )
 
 
 def _run_guarded() -> None:
@@ -696,48 +704,45 @@ def _run_guarded() -> None:
         )
         return
 
-    skip: set = set()
+    # resume skip-list: the operator's own ACCL_BENCH_SKIP stays in force
+    # on every attempt; completed and in-flight keys accumulate on top.
+    # Metrics that merely FAILED are retried — a transient device error
+    # deserves the second attempt the harness exists to provide.
+    skip: set = set(_SKIP)
+    device = None
     reason = "no bench attempt ran"
     for attempt in range(attempts):
-        result, a_extras, a_errors, reason, attempted = _run_child(
-            budget, skip
+        result, a_extras, a_errors, a_done, a_reason, attempted = (
+            _run_child(budget, skip)
         )
-        # fresh attempt's metrics layer over older partials
+        # fresh attempt's metrics layer over older partials; a metric
+        # that succeeded THIS attempt clears its stale earlier error
         extras.update(a_extras)
+        for k in a_done:
+            errors.pop(k, None)
         errors.update(a_errors)
+        skip |= set(a_done)
         if result is not None:
-            # merge earlier-attempt partials into the final report, then
-            # RECOMPUTE the headline from the merged set: on a resumed
-            # run the child only saw its post-skip extras, so its own
-            # headline can understate (attempt 1's winning number was
-            # skipped, not lost)
-            merged = dict(extras)
-            merged.update(result.get("extras") or {})
-            all_errors = dict(errors)
-            all_errors.update(result.get("errors") or {})
-            if attempt > 0 or extras:
-                fresh = _headline(merged)
-                fresh.update(
-                    {
-                        k: v for k, v in result.items()
-                        if k not in fresh
-                        and k not in ("extras", "errors", "impl")
-                    }
-                )
-                result = fresh
-            result["extras"] = merged
-            if result.get("value") is None:
-                _emit_fallback(
-                    merged, all_errors, "bench ran but headline was null"
-                )
+            device = result.get("device", device)
+            # RECOMPUTE the headline from the merged extras: on a
+            # resumed run the child only saw its post-skip metrics, so
+            # its own headline can understate (attempt 1's winning
+            # number was skipped, not lost)
+            fresh = _headline(extras)
+            if fresh.get("value") is not None:
+                if device is not None:
+                    fresh["device"] = device
+                fresh["extras"] = extras
+                if errors:
+                    fresh["errors"] = errors
+                _save_lkg(fresh)
+                print(json.dumps(fresh))
                 return
-            if all_errors:
-                result["errors"] = all_errors
-            _save_lkg(result)
-            print(json.dumps(result))
-            return
+            # clean exit, null headline (e.g. transient failure in every
+            # headline bench): worth the remaining retry attempts
+            a_reason = "bench ran but headline was null"
+        reason = a_reason
         print(f"bench attempt {attempt + 1} failed: {reason}", file=sys.stderr)
-        skip |= set(a_extras)
         if attempted:
             skip.add(attempted)
             errors[attempted] = (

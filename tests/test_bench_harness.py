@@ -142,12 +142,12 @@ def test_run_guarded_resumes_past_wedged_metric(bench, monkeypatch, capsys):
         seen_skips.append(set(skip))
         if len(seen_skips) == 1:
             return (
-                None, {"combine_xla": 650.0}, {}, "child exceeded 2400s",
-                "combine_pallas",
+                None, {"combine_xla": 650.0}, {}, ["combine_xla"],
+                "child exceeded 2400s", "combine_pallas",
             )
         return (
-            {**_tpu_result(500.0), "extras": {"cast_pallas": 900.0}},
-            {}, {}, None, None,
+            _tpu_result(500.0), {"cast_pallas": 900.0}, {},
+            ["cast_pallas"], None, None,
         )
 
     monkeypatch.setattr(bench, "_run_child", fake_child)
@@ -161,6 +161,95 @@ def test_run_guarded_resumes_past_wedged_metric(bench, monkeypatch, capsys):
     assert r["extras"]["combine_xla"] == 650.0  # attempt-1 partial kept
     assert r["extras"]["cast_pallas"] == 900.0
     assert "in flight" in r["errors"]["combine_pallas"]
+
+
+def test_run_guarded_preserves_operator_skip_list(bench, monkeypatch):
+    """An operator ACCL_BENCH_SKIP must stay in force on EVERY attempt,
+    not just the first (it marks benches known to wedge the device)."""
+    monkeypatch.setenv("ACCL_BENCH_ATTEMPTS", "2")
+    bench._SKIP = {"decode_tokens_per_s"}
+    monkeypatch.setattr(bench, "_probe_with_idle_retry", lambda errors: True)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    seen_skips = []
+
+    def fake_child(budget, skip):
+        seen_skips.append(set(skip))
+        if len(seen_skips) == 1:
+            return None, {}, {}, [], "child exceeded budget", None
+        return _tpu_result(500.0), {"combine_xla": 500.0}, {}, \
+            ["combine_xla"], None, None
+
+    monkeypatch.setattr(bench, "_run_child", fake_child)
+    bench._run_guarded()
+    assert all("decode_tokens_per_s" in s for s in seen_skips)
+
+
+def test_run_guarded_retries_failed_metric_and_clears_stale_error(
+    bench, monkeypatch, capsys
+):
+    """A metric that FAILED (not completed) in attempt 1 is re-run in
+    attempt 2; when the re-run succeeds the stale error must not
+    contradict the fresh number in the final report."""
+    monkeypatch.setenv("ACCL_BENCH_ATTEMPTS", "2")
+    monkeypatch.setattr(bench, "_probe_with_idle_retry", lambda errors: True)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    calls = []
+
+    def fake_child(budget, skip):
+        calls.append(set(skip))
+        if len(calls) == 1:
+            return (
+                None, {},
+                {"combine_pallas": "UNAVAILABLE: transient"},
+                [], "child wedged later", "cast_pallas",
+            )
+        return (
+            _tpu_result(768.0), {"combine_pallas": 768.0}, {},
+            ["combine_pallas"], None, None,
+        )
+
+    monkeypatch.setattr(bench, "_run_child", fake_child)
+    bench._run_guarded()
+    assert "combine_pallas" not in calls[1]  # failed != done: retried
+    assert "cast_pallas" in calls[1]  # in-flight at death: skipped
+    r = _capture_json_line(capsys)
+    assert r["value"] == 768.0
+    assert "combine_pallas" not in r.get("errors", {})
+
+
+def test_run_guarded_null_headline_uses_remaining_attempts(
+    bench, monkeypatch, capsys
+):
+    """A clean-exit child whose headline benches all transiently failed
+    must consume the remaining retry attempts before falling back."""
+    monkeypatch.setenv("ACCL_BENCH_ATTEMPTS", "2")
+    monkeypatch.setattr(bench, "_probe_with_idle_retry", lambda errors: True)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    calls = []
+
+    def fake_child(budget, skip):
+        calls.append(set(skip))
+        if len(calls) == 1:
+            # clean exit, but the headline benches failed transiently
+            return (
+                {"metric": "combine_datapath_bandwidth", "value": None,
+                 "unit": "GB/s", "vs_baseline": None, "device": "TPU v5",
+                 "extras": {}},
+                {"facade_call_overhead_us": 95.0},
+                {"combine_xla": "UNAVAILABLE"}, ["facade_call_overhead_us"],
+                None, None,
+            )
+        return (
+            _tpu_result(700.0), {"combine_xla": 700.0}, {},
+            ["combine_xla"], None, None,
+        )
+
+    monkeypatch.setattr(bench, "_run_child", fake_child)
+    bench._run_guarded()
+    assert len(calls) == 2  # the null headline did NOT short-circuit
+    r = _capture_json_line(capsys)
+    assert r["value"] == 700.0 and "provenance" not in r
+    assert r["extras"]["facade_call_overhead_us"] == 95.0
 
 
 def test_run_guarded_falls_back_when_probe_never_passes(
@@ -187,7 +276,10 @@ def test_run_guarded_success_stashes_lkg(bench, monkeypatch, capsys):
     monkeypatch.setattr(bench, "_probe_with_idle_retry", lambda errors: True)
     monkeypatch.setattr(
         bench, "_run_child",
-        lambda budget, skip: (_tpu_result(512.0), {}, {}, None, None),
+        lambda budget, skip: (
+            _tpu_result(512.0), {"combine_pallas": 512.0}, {},
+            ["combine_pallas"], None, None,
+        ),
     )
     bench._run_guarded()
     r = _capture_json_line(capsys)
@@ -271,13 +363,17 @@ def test_run_guarded_recomputes_headline_on_resume(
     def fake_child(budget, skip):
         calls.append(set(skip))
         if len(calls) == 1:
-            return None, {"combine_xla": 700.0}, {}, "child timed out", None
+            return (
+                None, {"combine_xla": 700.0}, {}, ["combine_xla"],
+                "child timed out", None,
+            )
         child_result = {
             "metric": "combine_datapath_bandwidth", "value": 600.0,
             "unit": "GB/s", "vs_baseline": 37.5, "impl": "pallas",
             "device": "TPU v5 lite", "extras": {"combine_pallas": 600.0},
         }
-        return child_result, {}, {}, None, None
+        return child_result, {"combine_pallas": 600.0}, {}, \
+            ["combine_pallas"], None, None
 
     monkeypatch.setattr(bench, "_run_child", fake_child)
     bench._run_guarded()
