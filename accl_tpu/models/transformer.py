@@ -172,16 +172,21 @@ def _block(x, lp, n_heads_local, tp_axis, return_kv=False):
     return (out, kv) if return_kv else out
 
 
-def _block_sp(x_sp, lp, n_heads_local, tp_axis):
+def _block_sp(x_sp, lp, n_heads_local, tp_axis, return_kv=False):
     """Sequence-parallel block (Megatron-SP): ``x_sp`` is (B, T/tp, D),
     sequence-sharded over ``tp``.  All-gather restores the full sequence
     in front of each column-parallel matmul; the row-parallel reduction
     becomes a reduce-scatter back onto the sequence shards — the same
     wire bytes as _block's two allreduces (AR = RS + AG), with layernorm,
-    residuals, and inter-block activations at 1/tp the memory."""
+    residuals, and inter-block activations at 1/tp the memory.
+
+    ``return_kv=True`` additionally returns the (k, v) head tensors —
+    FULL-sequence per local head (B, H_local, T, hd), because attention
+    inside the block already runs on the gathered sequence; this is the
+    sequence-parallel prefill path of the KV-cache decode."""
     h = _layernorm(x_sp, lp["ln1"])
     h_full = collectives.allgather(h, tp_axis, axis=1)
-    partial_o, _ = _attn_partial(h_full, lp, n_heads_local)
+    partial_o, kv = _attn_partial(h_full, lp, n_heads_local)
     o_sp = collectives.reduce_scatter(
         partial_o, tp_axis, tiled=True, axis=1
     )
@@ -192,7 +197,8 @@ def _block_sp(x_sp, lp, n_heads_local, tp_axis):
     f_sp = collectives.reduce_scatter(
         partial_f, tp_axis, tiled=True, axis=1
     )
-    return x_sp + f_sp
+    out = x_sp + f_sp
+    return (out, kv) if return_kv else out
 
 
 def forward(params, tokens, cfg: TransformerConfig, tp_axis=None, tp_size=1):
@@ -283,21 +289,55 @@ def prefill(
     Returns (last-position logits, caches) where caches is a list of
     (k, v) arrays (B, H_local, cache_len, hd).  ``cache_len`` defaults to
     ``cfg.max_seq``; size it to the exact prompt+steps length to avoid
-    attending over (and masking) dead cache positions."""
+    attending over (and masking) dead cache positions.
+
+    With ``cfg.seq_parallel`` (and a tp axis), the prompt runs under the
+    SAME sequence-sharded layout the training forward uses — activations
+    between blocks are (B, T/tp, D) per chip — so a seq-parallel-trained
+    config keeps its memory/parallelism plan at serving time instead of
+    silently reverting to replicated activations.  The cache it builds is
+    identical (head-sharded, full sequence): attention inside the SP
+    block already runs on the gathered sequence."""
+    from jax import lax
+
     B, T = tokens.shape
     S = cfg.max_seq if cache_len is None else int(cache_len)
     x = params["embed"][tokens] + params["pos"][:T]
     heads_local = cfg.n_heads // tp_size
     hd = cfg.d_model // cfg.n_heads
+    sp = cfg.seq_parallel and tp_axis is not None and tp_size > 1
+    if sp:
+        if T % tp_size:
+            raise ValueError(
+                f"seq_parallel prefill needs prompt length ({T}) "
+                f"divisible by tp ({tp_size})"
+            )
+        Tl = T // tp_size
+        idx = lax.axis_index(tp_axis)
+        x = lax.dynamic_slice_in_dim(x, idx * Tl, Tl, axis=1)
+        block_kv = partial(
+            _block_sp, n_heads_local=heads_local, tp_axis=tp_axis,
+            return_kv=True,
+        )
+    else:
+        block_kv = partial(
+            _block, n_heads_local=heads_local, tp_axis=tp_axis,
+            return_kv=True,
+        )
     caches = []
     for lp in params["layers"]:
-        x, (k, v) = _block(x, lp, heads_local, tp_axis, return_kv=True)
+        x, (k, v) = block_kv(x, lp)
         shape = (B, heads_local, S, hd)
         ck = jnp.zeros(shape, x.dtype).at[:, :, :T].set(k)
         cv = jnp.zeros(shape, x.dtype).at[:, :, :T].set(v)
         caches.append((ck, cv))
     x = _layernorm(x, params["ln_f"])
-    return x[:, -1] @ params["embed"].T, caches
+    last = x[:, -1]
+    if sp:
+        # the prompt's final position lives on the LAST sequence shard;
+        # broadcast its activation to the gang for the shared logits
+        last = collectives.bcast(last, tp_axis, root=tp_size - 1)
+    return last @ params["embed"].T, caches
 
 
 def _select_token(logits, key, temperature: float, top_k: Optional[int]):
@@ -334,9 +374,13 @@ def generate(
     shard_map the same replicated key yields identical samples on every
     rank, so the tp gang never diverges.
 
-    ``cfg.seq_parallel`` is ignored here: decode works position-at-a-time,
-    so there is no sequence dimension to shard — the replicated-activation
-    math is used regardless (and is exact either way)."""
+    ``cfg.seq_parallel`` is honored where a sequence dimension exists:
+    the PREFILL runs sequence-sharded exactly like the training forward
+    (see :func:`prefill`), producing the same head-sharded cache.  The
+    per-token decode steps have no sequence dimension to shard, so they
+    run the head-parallel math on that cache — the cache layout (and
+    therefore the serving plan) is identical to what the SP training
+    layout implies, not a silent strategy switch."""
     B, T = prompt.shape
     if T + steps > cfg.max_seq:
         raise ValueError(
