@@ -127,11 +127,23 @@ class ACCL:
         from .constants import AllreduceAlgorithm, TuningKey
 
         if isinstance(key, str):
-            key = TuningKey[key.upper()]
+            try:
+                key = TuningKey[key.upper()]
+            except KeyError:
+                raise ValueError(
+                    f"unknown tuning key {key!r}; valid: "
+                    f"{[k.name for k in TuningKey]}"
+                ) from None
         else:
             key = TuningKey(key)
         if isinstance(value, str):
-            value = AllreduceAlgorithm[value.upper()]
+            try:
+                value = AllreduceAlgorithm[value.upper()]
+            except KeyError:
+                raise ValueError(
+                    f"unknown algorithm {value!r}; valid: "
+                    f"{[a.name.lower() for a in AllreduceAlgorithm]}"
+                ) from None
         self._config(ConfigFunction.SET_TUNING, float(value), key=int(key))
 
     # -- buffer factories (ref ACCL::create_buffer family) -------------------

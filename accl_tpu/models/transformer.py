@@ -201,27 +201,43 @@ def _block_sp(x_sp, lp, n_heads_local, tp_axis, return_kv=False):
     return (out, kv) if return_kv else out
 
 
+def _enter_block_layout(x, cfg, tp_axis, tp_size, return_kv=False):
+    """Enter the block stack's activation layout and pick the block fn.
+
+    Under Megatron-SP (``cfg.seq_parallel`` with a real tp axis) the
+    sequence dim is sharded over tp — this rank keeps its T/tp slice and
+    blocks run :func:`_block_sp`; otherwise activations stay replicated
+    and blocks run :func:`_block`.  Shared by the training forward and
+    the serving prefill so the two paths cannot diverge on the entry
+    invariant.  Returns (x, block_fn, sp)."""
+    from jax import lax
+
+    heads_local = cfg.n_heads // tp_size
+    sp = cfg.seq_parallel and tp_axis is not None and tp_size > 1
+    kw = dict(n_heads_local=heads_local, tp_axis=tp_axis)
+    if return_kv:
+        kw["return_kv"] = True
+    if not sp:
+        return x, partial(_block, **kw), False
+    T = x.shape[1]
+    if T % tp_size:
+        raise ValueError(
+            f"seq_parallel needs sequence length ({T}) divisible by "
+            f"tp ({tp_size})"
+        )
+    # enter the sequence-sharded regime: this rank keeps its T/tp slice
+    Tl = T // tp_size
+    idx = lax.axis_index(tp_axis)
+    x = lax.dynamic_slice_in_dim(x, idx * Tl, Tl, axis=1)
+    return x, partial(_block_sp, **kw), True
+
+
 def forward(params, tokens, cfg: TransformerConfig, tp_axis=None, tp_size=1):
     """Logits for a token batch.  With tp_axis set, runs on weight shards
     inside shard_map; without, a plain single-device forward."""
-    from jax import lax
-
     B, T = tokens.shape
     x = params["embed"][tokens] + params["pos"][:T]
-    heads_local = cfg.n_heads // tp_size
-    sp = cfg.seq_parallel and tp_axis is not None and tp_size > 1
-    if sp:
-        if T % tp_size:
-            raise ValueError(
-                f"seq_parallel needs T ({T}) divisible by tp ({tp_size})"
-            )
-        # enter the sequence-sharded regime: this rank keeps its T/tp slice
-        Tl = T // tp_size
-        idx = lax.axis_index(tp_axis)
-        x = lax.dynamic_slice_in_dim(x, idx * Tl, Tl, axis=1)
-        block = partial(_block_sp, n_heads_local=heads_local, tp_axis=tp_axis)
-    else:
-        block = partial(_block, n_heads_local=heads_local, tp_axis=tp_axis)
+    x, block, sp = _enter_block_layout(x, cfg, tp_axis, tp_size)
     if cfg.remat:
         block = jax.checkpoint(block)
     for lp in params["layers"]:
@@ -298,32 +314,14 @@ def prefill(
     silently reverting to replicated activations.  The cache it builds is
     identical (head-sharded, full sequence): attention inside the SP
     block already runs on the gathered sequence."""
-    from jax import lax
-
     B, T = tokens.shape
     S = cfg.max_seq if cache_len is None else int(cache_len)
     x = params["embed"][tokens] + params["pos"][:T]
     heads_local = cfg.n_heads // tp_size
     hd = cfg.d_model // cfg.n_heads
-    sp = cfg.seq_parallel and tp_axis is not None and tp_size > 1
-    if sp:
-        if T % tp_size:
-            raise ValueError(
-                f"seq_parallel prefill needs prompt length ({T}) "
-                f"divisible by tp ({tp_size})"
-            )
-        Tl = T // tp_size
-        idx = lax.axis_index(tp_axis)
-        x = lax.dynamic_slice_in_dim(x, idx * Tl, Tl, axis=1)
-        block_kv = partial(
-            _block_sp, n_heads_local=heads_local, tp_axis=tp_axis,
-            return_kv=True,
-        )
-    else:
-        block_kv = partial(
-            _block, n_heads_local=heads_local, tp_axis=tp_axis,
-            return_kv=True,
-        )
+    x, block_kv, sp = _enter_block_layout(
+        x, cfg, tp_axis, tp_size, return_kv=True
+    )
     caches = []
     for lp in params["layers"]:
         x, (k, v) = block_kv(x, lp)

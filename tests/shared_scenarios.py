@@ -1,0 +1,838 @@
+"""THE shared suite: one set of test bodies, every execution tier.
+
+The reference's testing thesis is a single gtest binary run against the
+emulator, the RTL simulation, and hardware (``test/host/xrt/include/
+utility.hpp:29-51`` — the ``--hardware`` flag swaps the tier, never the
+tests).  This module is that suite in scenario form: each scenario is a
+pair of module-level picklable functions
+
+    work_<name>(accl, rank, world) -> per-rank result
+    check_<name>(results, world)   -> asserts on the gathered results
+
+run three ways:
+
+* emulator tier   — one thread per rank over ``emulated_group``
+* native C++ tier — same, over ``native_group``
+* xla_dist tier   — one OS process per rank via ``launch_processes``,
+  batched into a single spawn per world size (test_dist_shared.py)
+
+Scenario data is derived deterministically from per-scenario seeds so
+every process (and the checker) reconstructs identical arrays without
+shipping them through pickle.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from accl_tpu import ReduceFunction
+
+# name -> (work, check, tiers); tiers is a subset of {"emu","native","dist"}
+SCENARIOS = {}
+_ALL = ("emu", "native", "dist")
+
+
+def _register(name, work, check, tiers=_ALL):
+    SCENARIOS[name] = (work, check, tuple(tiers))
+
+
+def names_for_tier(tier: str):
+    return sorted(n for n, (_, _, t) in SCENARIOS.items() if tier in t)
+
+
+def _rng(seed):
+    return np.random.default_rng(seed)
+
+
+def _data(seed, n, dtype=np.float32):
+    if np.dtype(dtype).kind == "f":
+        return _rng(seed).standard_normal(n).astype(dtype)
+    return _rng(seed).integers(-50, 50, n).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# bcast (all roots, eager + rendezvous-tree + compressed)
+# ---------------------------------------------------------------------------
+
+
+def work_bcast_roots(accl, rank, world):
+    out = []
+    for root in range(world):
+        for count in (1, 1024, 3000):
+            data = _data(100 + root * 7 + count, count)
+            if rank == root:
+                buf = accl.create_buffer_from(data)
+            else:
+                buf = accl.create_buffer(count, np.float32)
+            accl.bcast(buf, count, root=root)
+            buf.sync_from_device()
+            out.append(buf.data.copy())
+    return out
+
+
+def check_bcast_roots(results, world):
+    i = 0
+    for root in range(world):
+        for count in (1, 1024, 3000):
+            data = _data(100 + root * 7 + count, count)
+            for got in results:
+                np.testing.assert_array_equal(got[i], data)
+            i += 1
+
+
+_register("bcast_roots", work_bcast_roots, check_bcast_roots)
+
+
+def work_bcast_rendezvous_tree(accl, rank, world):
+    count = 32 * 1024  # > rendezvous threshold, tree path
+    data = _data(201, count)
+    buf = (
+        accl.create_buffer_from(data)
+        if rank == 1
+        else accl.create_buffer(count, np.float32)
+    )
+    accl.bcast(buf, count, root=1)
+    buf.sync_from_device()
+    return buf.data.copy()
+
+
+def check_bcast_rendezvous_tree(results, world):
+    data = _data(201, 32 * 1024)
+    for got in results:
+        np.testing.assert_array_equal(got, data)
+
+
+_register(
+    "bcast_rendezvous_tree", work_bcast_rendezvous_tree,
+    check_bcast_rendezvous_tree,
+)
+
+
+def work_bcast_compressed(accl, rank, world):
+    count = 2000
+    data = _data(202, count)
+    buf = (
+        accl.create_buffer_from(data)
+        if rank == 0
+        else accl.create_buffer(count, np.float32)
+    )
+    accl.bcast(buf, count, root=0, compress_dtype=np.float16)
+    buf.sync_from_device()
+    return buf.data.copy()
+
+
+def check_bcast_compressed(results, world):
+    data = _data(202, 2000)
+    for got in results:
+        np.testing.assert_allclose(got, data, rtol=1e-3, atol=1e-3)
+
+
+_register("bcast_compressed", work_bcast_compressed, check_bcast_compressed)
+
+
+# ---------------------------------------------------------------------------
+# scatter / gather
+# ---------------------------------------------------------------------------
+
+
+def work_scatter_roots(accl, rank, world):
+    out = []
+    for root in range(world):
+        count = 1024
+        data = _data(300 + root, world * count)
+        send = accl.create_buffer_from(data) if rank == root else None
+        recv = accl.create_buffer(count, np.float32)
+        accl.scatter(send, recv, count, root=root)
+        recv.sync_from_device()
+        out.append(recv.data.copy())
+    return out
+
+
+def check_scatter_roots(results, world):
+    count = 1024
+    for root in range(world):
+        data = _data(300 + root, world * count)
+        for r, got in enumerate(results):
+            np.testing.assert_array_equal(
+                got[root], data[r * count : (r + 1) * count]
+            )
+
+
+_register("scatter_roots", work_scatter_roots, check_scatter_roots)
+
+
+def work_gather_roots(accl, rank, world):
+    out = []
+    for root, count in ((0, 1024), (world - 1, 16 * 1024)):
+        chunk = _data(400 + rank, count)
+        send = accl.create_buffer_from(chunk)
+        recv = (
+            accl.create_buffer(world * count, np.float32)
+            if rank == root else None
+        )
+        accl.gather(send, recv, count, root=root)
+        if rank == root:
+            recv.sync_from_device()
+            out.append(recv.data.copy())
+        else:
+            out.append(None)
+    return out
+
+
+def check_gather_roots(results, world):
+    for i, (root, count) in enumerate(((0, 1024), (world - 1, 16 * 1024))):
+        expected = np.concatenate(
+            [_data(400 + r, count) for r in range(world)]
+        )
+        np.testing.assert_array_equal(results[root][i], expected)
+
+
+_register("gather_roots", work_gather_roots, check_gather_roots)
+
+
+# ---------------------------------------------------------------------------
+# allgather
+# ---------------------------------------------------------------------------
+
+
+def work_allgather(accl, rank, world):
+    out = []
+    for count, wire in ((1, None), (3000, None), (1500, np.float16)):
+        chunk = _data(500 + rank * 11 + count, count)
+        send = accl.create_buffer_from(chunk)
+        recv = accl.create_buffer(world * count, np.float32)
+        accl.allgather(send, recv, count, compress_dtype=wire)
+        recv.sync_from_device()
+        out.append(recv.data.copy())
+    return out
+
+
+def check_allgather(results, world):
+    for i, (count, wire) in enumerate(
+        ((1, None), (3000, None), (1500, np.float16))
+    ):
+        expected = np.concatenate(
+            [_data(500 + r * 11 + count, count) for r in range(world)]
+        )
+        for got in results:
+            if wire is None:
+                np.testing.assert_array_equal(got[i], expected)
+            else:
+                np.testing.assert_allclose(
+                    got[i], expected, rtol=2e-2, atol=2e-2
+                )
+
+
+_register("allgather", work_allgather, check_allgather)
+
+
+# ---------------------------------------------------------------------------
+# reduce / allreduce / reduce_scatter
+# ---------------------------------------------------------------------------
+
+
+def work_reduce_roots(accl, rank, world):
+    out = []
+    for root in range(world):
+        for fn in (ReduceFunction.SUM, ReduceFunction.MAX):
+            count = 2000
+            chunk = _data(600 + rank, count)
+            send = accl.create_buffer_from(chunk)
+            recv = (
+                accl.create_buffer(count, np.float32)
+                if rank == root else None
+            )
+            accl.reduce(send, recv, count, root=root, function=fn)
+            if rank == root:
+                recv.sync_from_device()
+                out.append(recv.data.copy())
+            else:
+                out.append(None)
+    return out
+
+
+def check_reduce_roots(results, world):
+    count = 2000
+    chunks = [_data(600 + r, count) for r in range(world)]
+    i = 0
+    for root in range(world):
+        for fn in (ReduceFunction.SUM, ReduceFunction.MAX):
+            expected = (
+                np.sum(chunks, axis=0)
+                if fn == ReduceFunction.SUM
+                else np.max(chunks, axis=0)
+            )
+            np.testing.assert_allclose(
+                results[root][i], expected, rtol=1e-4, atol=1e-5
+            )
+            i += 1
+
+
+_register("reduce_roots", work_reduce_roots, check_reduce_roots)
+
+
+def work_allreduce(accl, rank, world):
+    out = []
+    cases = (
+        (1, ReduceFunction.SUM, None),
+        (1024, ReduceFunction.SUM, None),
+        (3000, ReduceFunction.MAX, None),
+        (64 * 1024, ReduceFunction.SUM, None),  # rendezvous size
+        (3000, ReduceFunction.SUM, np.float16),
+    )
+    for count, fn, wire in cases:
+        chunk = _data(700 + rank * 13 + count, count)
+        send = accl.create_buffer_from(chunk)
+        recv = accl.create_buffer(count, np.float32)
+        accl.allreduce(send, recv, count, function=fn, compress_dtype=wire)
+        recv.sync_from_device()
+        out.append(recv.data.copy())
+    return out
+
+
+def check_allreduce(results, world):
+    cases = (
+        (1, ReduceFunction.SUM, None),
+        (1024, ReduceFunction.SUM, None),
+        (3000, ReduceFunction.MAX, None),
+        (64 * 1024, ReduceFunction.SUM, None),
+        (3000, ReduceFunction.SUM, np.float16),
+    )
+    for i, (count, fn, wire) in enumerate(cases):
+        chunks = [_data(700 + r * 13 + count, count) for r in range(world)]
+        expected = (
+            np.sum(chunks, axis=0)
+            if fn == ReduceFunction.SUM
+            else np.max(chunks, axis=0)
+        )
+        tol = (
+            dict(rtol=2e-2, atol=2e-2)
+            if wire is not None
+            else dict(rtol=1e-4, atol=1e-5)
+        )
+        for got in results:
+            np.testing.assert_allclose(got[i], expected, **tol)
+
+
+_register("allreduce", work_allreduce, check_allreduce)
+
+
+def work_allreduce_int_dtypes(accl, rank, world):
+    # int32 only: the device tiers run without jax x64, so int64 wire
+    # operands are an emu/native-only surface (covered by the per-tier
+    # dtype tests); the shared body stays identical on every tier
+    count = 600
+    out = []
+    for dtype in (np.int32,):
+        chunk = _data(800 + rank, count, dtype)
+        send = accl.create_buffer_from(chunk)
+        recv = accl.create_buffer(count, dtype)
+        accl.allreduce(send, recv, count)
+        recv.sync_from_device()
+        out.append(recv.data.copy())
+    return out
+
+
+def check_allreduce_int_dtypes(results, world):
+    count = 600
+    for i, dtype in enumerate((np.int32,)):
+        chunks = [_data(800 + r, count, dtype) for r in range(world)]
+        expected = np.sum(np.stack(chunks), axis=0).astype(dtype)
+        for got in results:
+            np.testing.assert_array_equal(got[i], expected)
+
+
+_register(
+    "allreduce_int_dtypes", work_allreduce_int_dtypes,
+    check_allreduce_int_dtypes,
+)
+
+
+def work_allreduce_fp8_wire(accl, rank, world):
+    import ml_dtypes
+
+    count = 1024
+    chunk = (_rng(900 + rank).standard_normal(count) * 0.5).astype(np.float32)
+    send = accl.create_buffer_from(chunk)
+    recv = accl.create_buffer(count, np.float32)
+    accl.allreduce(send, recv, count, compress_dtype=ml_dtypes.float8_e4m3fn)
+    recv.sync_from_device()
+    return recv.data.copy()
+
+
+def check_allreduce_fp8_wire(results, world):
+    count = 1024
+    chunks = [
+        (_rng(900 + r).standard_normal(count) * 0.5).astype(np.float32)
+        for r in range(world)
+    ]
+    expected = np.sum(chunks, axis=0)
+    for got in results:
+        np.testing.assert_allclose(got, expected, rtol=0.15, atol=0.3)
+
+
+_register(
+    "allreduce_fp8_wire", work_allreduce_fp8_wire, check_allreduce_fp8_wire
+)
+
+
+def work_reduce_scatter(accl, rank, world):
+    out = []
+    for count, wire in ((1024, None), (1500, np.float16)):
+        full = _data(1000 + rank * 3 + count, world * count)
+        send = accl.create_buffer_from(full)
+        recv = accl.create_buffer(count, np.float32)
+        accl.reduce_scatter(send, recv, count, compress_dtype=wire)
+        recv.sync_from_device()
+        out.append(recv.data.copy())
+    return out
+
+
+def check_reduce_scatter(results, world):
+    for i, (count, wire) in enumerate(((1024, None), (1500, np.float16))):
+        full = [
+            _data(1000 + r * 3 + count, world * count) for r in range(world)
+        ]
+        expected = np.sum(full, axis=0)
+        tol = (
+            dict(rtol=5e-2, atol=5e-2)
+            if wire is not None
+            else dict(rtol=1e-4, atol=1e-5)
+        )
+        for r, got in enumerate(results):
+            np.testing.assert_allclose(
+                got[i], expected[r * count : (r + 1) * count], **tol
+            )
+
+
+_register("reduce_scatter", work_reduce_scatter, check_reduce_scatter)
+
+
+# ---------------------------------------------------------------------------
+# alltoall / barrier
+# ---------------------------------------------------------------------------
+
+
+def work_alltoall(accl, rank, world):
+    count = 1024
+    mat = _data(1100 + rank, world * count)
+    send = accl.create_buffer_from(mat)
+    recv = accl.create_buffer(world * count, np.float32)
+    accl.alltoall(send, recv, count)
+    recv.sync_from_device()
+    return recv.data.copy()
+
+
+def check_alltoall(results, world):
+    count = 1024
+    mats = [_data(1100 + r, world * count) for r in range(world)]
+    for r, got in enumerate(results):
+        expected = np.concatenate(
+            [mats[p][r * count : (r + 1) * count] for p in range(world)]
+        )
+        np.testing.assert_array_equal(got, expected)
+
+
+_register("alltoall", work_alltoall, check_alltoall)
+
+
+def work_barrier_then_allreduce(accl, rank, world):
+    import time
+
+    if rank == 0:
+        time.sleep(0.2)  # rank 0 arrives late; others must wait
+    accl.barrier()
+    t = time.monotonic()
+    n = 16
+    send = accl.create_buffer_from(np.full(n, float(rank + 1), np.float32))
+    recv = accl.create_buffer(n, np.float32)
+    accl.allreduce(send, recv, n)
+    recv.sync_from_device()
+    return (t, float(recv.data[0]))
+
+
+def check_barrier_then_allreduce(results, world):
+    times = [t for t, _ in results]
+    assert max(times) - min(times) < 1.0  # everyone left the barrier together
+    total = float(sum(range(1, world + 1)))
+    for _, v in results:
+        assert v == total
+
+
+_register(
+    "barrier_then_allreduce", work_barrier_then_allreduce,
+    check_barrier_then_allreduce,
+)
+
+
+# ---------------------------------------------------------------------------
+# communicators (subset, split, concurrent disjoint)
+# ---------------------------------------------------------------------------
+
+
+def work_subset_comm_allgather(accl, rank, world):
+    count = 128
+    comm = accl.create_communicator([1, 2])
+    if comm is None:
+        return None
+    chunk = _data(1200 + comm.local_rank, count)
+    send = accl.create_buffer_from(chunk)
+    recv = accl.create_buffer(2 * count, np.float32)
+    accl.allgather(send, recv, count, comm=comm)
+    recv.sync_from_device()
+    return recv.data.copy()
+
+
+def check_subset_comm_allgather(results, world):
+    count = 128
+    expected = np.concatenate([_data(1200 + i, count) for i in range(2)])
+    for r, got in enumerate(results):
+        if r in (1, 2):
+            np.testing.assert_array_equal(got, expected)
+        else:
+            assert got is None
+
+
+_register(
+    "subset_comm_allgather", work_subset_comm_allgather,
+    check_subset_comm_allgather,
+)
+
+
+def work_split_comm_allreduce(accl, rank, world):
+    count = 256
+    half = list(range(world // 2)) if rank < world // 2 else list(
+        range(world // 2, world)
+    )
+    comm = accl.create_communicator(half)
+    chunk = _data(1300 + rank, count)
+    send = accl.create_buffer_from(chunk)
+    recv = accl.create_buffer(count, np.float32)
+    accl.allreduce(send, recv, count, comm=comm)
+    recv.sync_from_device()
+    return recv.data.copy()
+
+
+def check_split_comm_allreduce(results, world):
+    count = 256
+    chunks = [_data(1300 + r, count) for r in range(world)]
+    lo = np.sum(chunks[: world // 2], axis=0)
+    hi = np.sum(chunks[world // 2 :], axis=0)
+    for r, got in enumerate(results):
+        np.testing.assert_allclose(
+            got, lo if r < world // 2 else hi, rtol=1e-4, atol=1e-5
+        )
+
+
+_register(
+    "split_comm_allreduce", work_split_comm_allreduce,
+    check_split_comm_allreduce,
+)
+
+
+# ---------------------------------------------------------------------------
+# send / recv
+# ---------------------------------------------------------------------------
+
+
+def work_sendrecv(accl, rank, world):
+    """Pairs (0->1) exercise eager, segmented, rendezvous, compressed,
+    and tag-ordered transfers; other ranks idle (but must still be in
+    the batch so the SPMD tiers stay aligned)."""
+    import ml_dtypes
+
+    out = {}
+    cases = [
+        ("eager", 1401, 64, None),
+        ("segmented", 1402, 3000, None),
+        ("rendezvous", 1403, 48 * 1024, None),
+        ("compressed", 1404, 512, np.float16),
+        ("fp8", 1405, 512, ml_dtypes.float8_e4m3fn),
+    ]
+    for name, seed, count, wire in cases:
+        data = _data(seed, count)
+        if rank == 0:
+            send = accl.create_buffer_from(data)
+            accl.send(send, count, dst=1, tag=5, compress_dtype=wire)
+        elif rank == 1:
+            recv = accl.create_buffer(count, np.float32)
+            accl.recv(recv, count, src=0, tag=5, compress_dtype=wire)
+            recv.sync_from_device()
+            out[name] = recv.data.copy()
+    # two back-to-back transfers, distinct tags, matched in issue order
+    # (per-peer sequence-number semantics — tags are metadata, not a
+    # reorder key)
+    if rank == 0:
+        a = accl.create_buffer_from(_data(1500, 32))
+        b = accl.create_buffer_from(_data(1501, 32))
+        accl.send(a, 32, dst=1, tag=7)
+        accl.send(b, 32, dst=1, tag=8)
+    elif rank == 1:
+        ra = accl.create_buffer(32, np.float32)
+        accl.recv(ra, 32, src=0, tag=7)
+        ra.sync_from_device()
+        out["tag7"] = ra.data.copy()
+        rb = accl.create_buffer(32, np.float32)
+        accl.recv(rb, 32, src=0, tag=8)
+        rb.sync_from_device()
+        out["tag8"] = rb.data.copy()
+    return out
+
+
+def check_sendrecv(results, world):
+    import ml_dtypes
+
+    got = results[1]
+    for name, seed, count in [
+        ("eager", 1401, 64),
+        ("segmented", 1402, 3000),
+        ("rendezvous", 1403, 48 * 1024),
+    ]:
+        data = _data(seed, count)
+        np.testing.assert_array_equal(got[name], data)
+    data = _data(1404, 512)
+    np.testing.assert_allclose(
+        got["compressed"],
+        data.astype(np.float16).astype(np.float32),
+        rtol=1e-6, atol=1e-6,
+    )
+    data = _data(1405, 512)
+    np.testing.assert_allclose(
+        got["fp8"],
+        data.astype(ml_dtypes.float8_e4m3fn).astype(np.float32),
+        rtol=1e-6, atol=1e-6,
+    )
+    np.testing.assert_array_equal(got["tag7"], _data(1500, 32))
+    np.testing.assert_array_equal(got["tag8"], _data(1501, 32))
+
+
+_register("sendrecv", work_sendrecv, check_sendrecv)
+
+
+# ---------------------------------------------------------------------------
+# streams: local ports on every tier; remote ports are a documented
+# dist-tier hole (backends/dist/engine.py docstring) asserted as such
+# ---------------------------------------------------------------------------
+
+
+def work_streams_local(accl, rank, world):
+    data = _data(1600 + rank, 32)
+    accl.stream_push(data, stream_id=3)
+    buf = accl.create_buffer(32, np.float32)
+    accl.copy_from_stream(buf, 32, stream_id=3)
+    buf.sync_from_device()
+    a = buf.data.copy()
+
+    buf2 = accl.create_buffer_from(data * 2.0)
+    accl.copy_to_stream(buf2, 32, stream_id=4)
+    b = accl.stream_pop(32, np.float32, stream_id=4)
+
+    accl.stream_push(data * 3.0, stream_id=5)
+    accl.copy_from_to_stream(np.float32, 32, stream_id=5)
+    c = accl.stream_pop(32, np.float32, stream_id=5)
+    return a, b, c
+
+
+def check_streams_local(results, world):
+    for rank, (a, b, c) in enumerate(results):
+        data = _data(1600 + rank, 32)
+        np.testing.assert_allclose(a, data, rtol=1e-6)
+        np.testing.assert_allclose(b, data * 2.0, rtol=1e-6)
+        np.testing.assert_allclose(c, data * 3.0, rtol=1e-6)
+
+
+_register("streams_local", work_streams_local, check_streams_local)
+
+
+def work_stream_put_remote(accl, rank, world):
+    """0 pushes into 1's stream port (the device-kernel handoff)."""
+    data = _data(1700, 24)
+    if rank == 0:
+        buf = accl.create_buffer_from(data)
+        accl.stream_put(buf, 24, dst=1, stream_id=6)
+        return None
+    if rank == 1:
+        return accl.stream_pop(24, np.float32, stream_id=6, timeout=30.0)
+    return None
+
+
+def check_stream_put_remote(results, world):
+    np.testing.assert_allclose(results[1], _data(1700, 24), rtol=1e-6)
+
+
+_register(
+    "stream_put_remote", work_stream_put_remote, check_stream_put_remote,
+    tiers=("emu", "native"),
+)
+
+
+def work_remote_stream_hole(accl, rank, world):
+    """xla_dist documents remote stream ports as unreachable (a device
+    kernel's stream lives in its owner process): the call must fail
+    LOUDLY with COLLECTIVE_NOT_IMPLEMENTED, not hang or misroute."""
+    from accl_tpu import ACCLError
+    from accl_tpu.constants import ErrorCode
+
+    if rank != 0:
+        return True
+    buf = accl.create_buffer_from(_data(1700, 24))
+    try:
+        accl.stream_put(buf, 24, dst=1, stream_id=6)
+    except ACCLError as e:
+        return bool(e.code & ErrorCode.COLLECTIVE_NOT_IMPLEMENTED)
+    return False
+
+
+def check_remote_stream_hole(results, world):
+    assert results[0] is True
+
+
+_register(
+    "remote_stream_hole", work_remote_stream_hole, check_remote_stream_hole,
+    tiers=("dist",),
+)
+
+
+# ---------------------------------------------------------------------------
+# tuning registers
+# ---------------------------------------------------------------------------
+
+
+def work_tuning_allreduce_algorithm(accl, rank, world):
+    """Runtime algorithm registers on the device tier: xla psum vs the
+    explicit ring pipeline must agree (SET_TUNING role)."""
+    from accl_tpu.constants import TuningKey
+
+    n = 1024
+    chunk = _data(1800 + rank, n)
+    send = accl.create_buffer_from(chunk)
+    recv = accl.create_buffer(n, np.float32)
+    out = {}
+    for algo in ("xla", "ring"):
+        accl.set_tuning(TuningKey.ALLREDUCE_ALGORITHM, algo)
+        accl.allreduce(send, recv, n)
+        recv.sync_from_device()
+        out[algo] = recv.data.copy()
+    accl.set_tuning(TuningKey.ALLREDUCE_ALGORITHM, "xla")
+    return out
+
+
+def check_tuning_allreduce_algorithm(results, world):
+    expected = np.sum([_data(1800 + r, 1024) for r in range(world)], axis=0)
+    for got in results:
+        np.testing.assert_allclose(got["xla"], expected, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(got["ring"], expected, rtol=1e-4, atol=1e-5)
+
+
+_register(
+    "tuning_allreduce_algorithm", work_tuning_allreduce_algorithm,
+    check_tuning_allreduce_algorithm, tiers=("dist",),
+)
+
+
+def work_tuning_flat_vs_tree(accl, rank, world):
+    """Emulator/native tuning registers: force flat vs tree bcast at
+    runtime; results identical either way."""
+    from accl_tpu.constants import TuningKey
+
+    n = 2048
+    data = _data(1900, n)
+    out = []
+    try:
+        for flat_max in (world + 1, 0):  # force flat, then force tree
+            accl.set_tuning(TuningKey.BCAST_FLAT_TREE_MAX_RANKS, flat_max)
+            buf = (
+                accl.create_buffer_from(data)
+                if rank == 0
+                else accl.create_buffer(n, np.float32)
+            )
+            accl.bcast(buf, n, root=0)
+            buf.sync_from_device()
+            out.append(buf.data.copy())
+    finally:
+        # restore the engine default (constants.DEFAULT_TUNING) so later
+        # scenarios on the shared group see the stock flat/tree policy
+        accl.set_tuning(TuningKey.BCAST_FLAT_TREE_MAX_RANKS, 3)
+    return out
+
+
+def check_tuning_flat_vs_tree(results, world):
+    data = _data(1900, 2048)
+    for got in results:
+        np.testing.assert_array_equal(got[0], data)
+        np.testing.assert_array_equal(got[1], data)
+
+
+_register(
+    "tuning_flat_vs_tree", work_tuning_flat_vs_tree,
+    check_tuning_flat_vs_tree, tiers=("emu", "native"),
+)
+
+
+def work_tuning_invalid(accl, rank, world):
+    from accl_tpu import ACCLError
+    from accl_tpu.constants import TuningKey
+
+    try:
+        accl.set_tuning(TuningKey.ALLREDUCE_ALGORITHM, "not_an_algorithm")
+    except (ACCLError, ValueError):
+        return True
+    return False
+
+
+def check_tuning_invalid(results, world):
+    assert all(results)
+
+
+_register(
+    "tuning_invalid", work_tuning_invalid, check_tuning_invalid,
+    tiers=("dist",),
+)
+
+
+# ---------------------------------------------------------------------------
+# batch driver (used by the dist tier; also runnable on any group)
+# ---------------------------------------------------------------------------
+
+
+def run_scenario_batch(accl, rank, world, names):
+    """Run ``names`` in order on this rank; stop at the first failure
+    (a failed collective desynchronizes the SPMD program order, so
+    continuing would cascade into timeouts)."""
+    import traceback
+
+    out = {}
+    for name in names:
+        work = SCENARIOS[name][0]
+        try:
+            out[name] = ("ok", work(accl, rank, world))
+        except BaseException:  # noqa: BLE001 - reported to the parent
+            out[name] = ("error", traceback.format_exc())
+            break
+    return out
+
+
+def check_scenario_batch(per_rank_batches, names, world):
+    """Validate every scenario's gathered results; report per scenario."""
+    failures = []
+    for name in names:
+        rank_results = []
+        for r, batch in enumerate(per_rank_batches):
+            entry = batch.get(name)
+            if entry is None:
+                failures.append(f"{name}: rank {r} never ran it")
+                break
+            status, value = entry
+            if status != "ok":
+                failures.append(f"{name}: rank {r} failed:\n{value}")
+                break
+            rank_results.append(value)
+        else:
+            try:
+                SCENARIOS[name][1](rank_results, world)
+            except AssertionError as e:
+                failures.append(f"{name}: check failed: {e}")
+    if failures:
+        raise AssertionError(
+            f"{len(failures)} scenario(s) failed:\n" + "\n".join(failures)
+        )

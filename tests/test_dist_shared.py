@@ -1,0 +1,56 @@
+"""The shared scenario suite on the xla_dist tier (VERDICT r2 item 3).
+
+The same test bodies that run threaded over the emulator and native C++
+groups (test_shared_scenarios.py) here run across real OS processes —
+one rank per process over jax.distributed — batched into a single spawn
+per world size to amortize process startup (the reference's analog is
+one mpirun invocation running the whole gtest suite, utility.hpp:29-51).
+
+The documented remote-stream hole is covered by its own scenario
+(``remote_stream_hole``) asserting the loud COLLECTIVE_NOT_IMPLEMENTED,
+per the dist engine's contract (backends/dist/engine.py docstring).
+"""
+
+import random
+from functools import partial
+
+from accl_tpu.launch import launch_processes
+from shared_scenarios import (
+    check_scenario_batch,
+    names_for_tier,
+    run_scenario_batch,
+)
+
+
+def _launch_batch(names, world):
+    """Randomized ports with retry — a fixed port flakes under parallel
+    test runs (TIME_WAIT / contention), the test_aux launcher lesson."""
+    last = None
+    for _ in range(3):
+        base = random.randint(30000, 55000)
+        try:
+            return launch_processes(
+                partial(run_scenario_batch, names=names),
+                world=world, base_port=base, design="xla_dist",
+                timeout=600.0,
+            )
+        except RuntimeError as e:  # port clash: retry elsewhere
+            last = e
+    raise last
+
+
+def test_dist_shared_suite_world4():
+    names = names_for_tier("dist")
+    results = _launch_batch(names, world=4)
+    check_scenario_batch(results, names, 4)
+
+
+def test_dist_shared_suite_world2():
+    # the 2-process shape: pairwise p2p is the whole world, subset
+    # communicators degenerate — run the subset-independent scenarios
+    names = [
+        n for n in names_for_tier("dist")
+        if n not in ("subset_comm_allgather",)
+    ]
+    results = _launch_batch(names, world=2)
+    check_scenario_batch(results, names, 2)

@@ -118,10 +118,12 @@ def test_tuning_register_state_visible(group2):
 
 def test_tuning_invalid_inputs(group2):
     a = group2[0]
-    with pytest.raises(KeyError):
+    with pytest.raises(ValueError, match="unknown tuning key"):
         a.set_tuning("no_such_register", 1)
     with pytest.raises(ValueError):
         a.set_tuning(99, 1)
+    with pytest.raises(ValueError, match="unknown algorithm"):
+        a.set_tuning(TuningKey.ALLREDUCE_ALGORITHM, "not_an_algorithm")
     with pytest.raises(ACCLError) as ei:
         a.set_tuning(TuningKey.GATHER_FLAT_TREE_MAX_FANIN, -1)
     assert ei.value.code == ErrorCode.CONFIG_ERROR
