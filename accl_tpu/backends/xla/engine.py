@@ -390,6 +390,29 @@ class XLAGangContext:
         elif arm:
             self._arm_watchdog(slot_key, slot)
 
+    def soft_reset(self) -> None:
+        """ref ``ACCL`` soft-reset recovery (accl.cpp:57-89): abandon all
+        stale gang state so a world that lost a collective (e.g. one rank
+        timed out while a peer never submitted) can realign.
+
+        Collective by contract, like the reference's: every rank handle
+        issues CONFIG/RESET with no new collectives in flight; each call
+        idempotently clears the shared tables, so after the last rank's
+        reset all per-communicator sequence counters restart at 0 and the
+        next collective matches at a fresh slot.  Any still-parked call is
+        completed with RECEIVE_TIMEOUT (its gang never assembled)."""
+        with self._lock:
+            slots = list(self._slots.values())
+            self._slots.clear()
+            self._seq.clear()
+            self._asm_cache.clear()
+        for slot in slots:
+            if slot.watchdog is not None:
+                slot.watchdog.cancel()
+            for _, req in slot.calls.values():
+                if not req.test():
+                    req.complete(ErrorCode.RECEIVE_TIMEOUT)
+
     def _arm_watchdog(self, slot_key, slot: _GangSlot) -> None:
         def fire():
             with self._lock:
@@ -1143,7 +1166,9 @@ class XLAEngine(StreamPortMixin, BaseEngine):
     def _apply_config(self, options: CallOptions) -> ErrorCode:
         fn = ConfigFunction(options.cfg_function)
         val = options.cfg_value
-        if fn == ConfigFunction.SET_TIMEOUT:
+        if fn == ConfigFunction.RESET:
+            self.gang.soft_reset()
+        elif fn == ConfigFunction.SET_TIMEOUT:
             if val <= 0:
                 return ErrorCode.CONFIG_ERROR
             self.timeout_s = float(val)

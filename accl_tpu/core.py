@@ -104,6 +104,18 @@ class ACCL:
         return self._world.size
 
     # -- config surface ------------------------------------------------------
+    def soft_reset(self) -> None:
+        """Abandon stale engine state after a failed/timed-out collective
+        (ref ``ACCL::soft_reset``, accl.cpp:57-89).  Collective by
+        contract: every rank handle of the group must call it, with no
+        new collectives in flight, before any rank resumes work —
+        afterwards gang sequence counters are realigned and the engine is
+        fully usable.  Mirrors the init sequence: RESET clears transport
+        state on the engine tiers, so it is re-enabled here the same way
+        ``_initialize`` does."""
+        self._config(ConfigFunction.RESET, 0)
+        self._config(ConfigFunction.ENABLE_TRANSPORT, 1)
+
     def set_timeout(self, seconds: float) -> None:
         self._config(ConfigFunction.SET_TIMEOUT, seconds)
         self._timeout_s = float(seconds)

@@ -101,11 +101,15 @@ def launch_processes(
                 errors.append(f"rank {r}: worker exited without a result")
     finally:
         # never leak rank processes, even when one died mid-collective and
-        # the rest are blocked waiting for it
+        # the rest are blocked waiting for it; a rank stuck inside a C++
+        # collective (gloo) can shrug off SIGTERM, so escalate to SIGKILL
         for p in procs:
             p.join(5)
             if p.is_alive():
                 p.terminate()
+                p.join(5)
+            if p.is_alive():
+                p.kill()
                 p.join(5)
     if errors:
         raise RuntimeError("launch failed:\n" + "\n".join(errors))

@@ -33,3 +33,27 @@ def run_parallel(group: Sequence, fn: Callable, timeout: float = 60.0) -> List:
         if e is not None:
             raise e
     return results
+
+
+def launch_with_port_retry(fn, world, attempts=3, retry_if=None, **kwargs):
+    """``launch_processes`` on a randomized base port, retrying clashes:
+    a fixed port flakes under parallel test runs (TIME_WAIT/contention).
+
+    ``retry_if(exc) -> bool`` narrows which RuntimeErrors are retried —
+    tests that EXPECT a launch failure pass a predicate that excludes it
+    so the expected error surfaces immediately instead of being retried
+    as if it were a port clash."""
+    import random
+
+    from accl_tpu.launch import launch_processes
+
+    last = None
+    for _ in range(attempts):
+        base = random.randint(30000, 55000)
+        try:
+            return launch_processes(fn, world, base_port=base, **kwargs)
+        except RuntimeError as e:  # port clash: retry elsewhere
+            if retry_if is not None and not retry_if(e):
+                raise
+            last = e
+    raise last

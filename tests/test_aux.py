@@ -137,23 +137,11 @@ def test_launcher_multiprocess():
     """The mpirun-analog: N OS processes over the socket fabric.  Ports
     are randomized with retries: a fixed port flakes under parallel test
     runs (TIME_WAIT / contention)."""
-    import random
-
-    from accl_tpu.launch import launch_processes
+    from helpers import launch_with_port_retry
     from tests_launch_target import allreduce_main  # see module below
 
-    last = None
-    for _ in range(3):
-        base = random.randint(30000, 55000)
-        try:
-            results = launch_processes(
-                allreduce_main, world=2, base_port=base
-            )
-            assert results == [3.0, 3.0]
-            return
-        except RuntimeError as e:  # port clash: retry elsewhere
-            last = e
-    raise last
+    results = launch_with_port_retry(allreduce_main, 2)
+    assert results == [3.0, 3.0]
 
 
 def test_stress_short(group2):

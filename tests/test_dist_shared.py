@@ -11,10 +11,9 @@ The documented remote-stream hole is covered by its own scenario
 per the dist engine's contract (backends/dist/engine.py docstring).
 """
 
-import random
 from functools import partial
 
-from accl_tpu.launch import launch_processes
+from helpers import launch_with_port_retry
 from shared_scenarios import (
     check_scenario_batch,
     names_for_tier,
@@ -23,20 +22,10 @@ from shared_scenarios import (
 
 
 def _launch_batch(names, world):
-    """Randomized ports with retry — a fixed port flakes under parallel
-    test runs (TIME_WAIT / contention), the test_aux launcher lesson."""
-    last = None
-    for _ in range(3):
-        base = random.randint(30000, 55000)
-        try:
-            return launch_processes(
-                partial(run_scenario_batch, names=names),
-                world=world, base_port=base, design="xla_dist",
-                timeout=600.0,
-            )
-        except RuntimeError as e:  # port clash: retry elsewhere
-            last = e
-    raise last
+    return launch_with_port_retry(
+        partial(run_scenario_batch, names=names),
+        world, design="xla_dist", timeout=600.0,
+    )
 
 
 def test_dist_shared_suite_world4():
