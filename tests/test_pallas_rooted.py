@@ -24,7 +24,8 @@ pytestmark = pytest.mark.pallas
 
 def _mesh(n):
     devs = jax.devices()[:n]
-    assert len(devs) == n
+    if len(devs) < n:
+        pytest.skip(f"needs {n} devices")  # single-chip TPU tier
     return Mesh(devs, ("x",))
 
 
