@@ -221,3 +221,118 @@ def ring_attention(
     )(qf, kf, vf)
     out = out.reshape(B, H, T, Dp)
     return out[..., :D] if pad else out
+
+
+# ---------------------------------------------------------------------------
+# single-chip flash attention (no ring): the local fused forward
+# ---------------------------------------------------------------------------
+
+
+def _flash_kernel(causal, scale, bq, bk, nkb, t_real):
+    """One grid step computes one (bq, D) output block: fold the visiting
+    k/v blocks with online softmax.  Outputs are written exactly once per
+    grid step (blocked o spec) — no grid-revisited outputs, the construct
+    this box's tunnel cannot tolerate."""
+
+    def kernel(q_ref, k_ref, v_ref, o_ref):
+        iq = pl.program_id(1)
+        q = q_ref[0].astype(jnp.float32) * scale  # (bq, D)
+        q_pos = iq * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+
+        def fold(j, carry):
+            m, l, acc = carry
+            kb = k_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+            vb = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+            s = jax.lax.dot_general(
+                q, kb,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            k_pos = j * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            mask = k_pos < t_real
+            if causal:
+                mask &= q_pos >= k_pos
+            s = jnp.where(mask, s, _NEG)
+            m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m - m_new)
+            l_new = l * alpha + p.sum(axis=-1, keepdims=True)
+            acc_new = acc * alpha + jax.lax.dot_general(
+                p, vb,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return m_new, l_new, acc_new
+
+        init = (
+            jnp.full((bq, 1), _NEG, jnp.float32),
+            jnp.zeros((bq, 1), jnp.float32),
+            jnp.zeros(q.shape, jnp.float32),
+        )
+        # causal early exit: with bq == bk, q block iq only sees k blocks
+        # 0..iq (dynamic trip count — Mosaic lowers it to a while loop)
+        hi = jnp.minimum(iq + 1, nkb) if causal else nkb
+        m, l, acc = lax.fori_loop(0, hi, fold, init)
+        o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+    return kernel
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    *,
+    block: int = 256,
+    interpret: InterpretArg = None,
+) -> jax.Array:
+    """Local (single-chip) fused attention: ``(B, H, T, D) -> same`` with
+    the (T, T) score matrix never leaving VMEM — the kernel-owned form of
+    ``ops.attention.blockwise_attention`` (which is the trainable XLA
+    fold; this one hand-owns the schedule like the ring kernels own
+    theirs).  Forward-only: serving/prefill paths; training uses the
+    differentiable XLA form.
+
+    K/V live whole in VMEM per (batch*head) grid step — sized for
+    serving sequence lengths (T <= ~8K at 128 lanes); the ring kernel
+    covers longer sequences across chips."""
+    B, H, T, D = q.shape
+    if k.shape != q.shape or v.shape != q.shape:
+        raise ValueError(
+            f"q/k/v shapes must match, got {q.shape}/{k.shape}/{v.shape}"
+        )
+    scale = 1.0 / (D ** 0.5)
+    bq = bk = min(block, max(8, T))
+    padT = (-T) % bq
+    padD = (-D) % LANES
+    if padT or padD:
+        padding = [(0, 0), (0, 0), (0, padT), (0, padD)]
+        q, k, v = (jnp.pad(a, padding) for a in (q, k, v))
+    Tp, Dp = T + padT, D + padD
+    nq, nkb = Tp // bq, Tp // bk
+
+    qf = q.reshape(B * H, Tp, Dp)
+    kf = k.reshape(B * H, Tp, Dp)
+    vf = v.reshape(B * H, Tp, Dp)
+
+    out = pl.pallas_call(
+        _flash_kernel(causal, scale, bq, bk, nkb, T),
+        grid=(B * H, nq),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tp, Dp), q.dtype),
+        in_specs=[
+            pl.BlockSpec((1, bq, Dp), lambda bh, iq: (bh, iq, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Tp, Dp), lambda bh, iq: (bh, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Tp, Dp), lambda bh, iq: (bh, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, bq, Dp), lambda bh, iq: (bh, iq, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        interpret=default_interpret(interpret),
+    )(qf, kf, vf)
+    out = out.reshape(B, H, Tp, Dp)
+    return out[:, :, :T, :D]
