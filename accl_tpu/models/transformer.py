@@ -544,6 +544,12 @@ def make_sharded_train_step(cfg: TransformerConfig, mesh: Mesh, lr: float = 1e-2
     weights keep local shard grads, replicated weights get the cross-shard
     psum — the dp gradient allreduce of classic data parallelism falls out
     of the same machinery."""
+    if cfg.attention == "flash":
+        raise ValueError(
+            'attention="flash" is forward-only (the Pallas kernel has no '
+            'transpose rule); train with "blockwise", its differentiable '
+            "XLA twin"
+        )
     specs = param_specs(cfg)
     tp = mesh.shape["tp"]
     dp = mesh.shape["dp"]

@@ -22,6 +22,7 @@ from .combine import combine  # noqa: F401
 from .compression import cast, dequantize_int8, quantize_int8  # noqa: F401
 from .put import fused_shift  # noqa: F401
 from .ring import (  # noqa: F401
+    int8_allreduce,
     ring_allgather,
     ring_allreduce,
     ring_reduce_scatter,

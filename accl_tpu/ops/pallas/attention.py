@@ -297,13 +297,22 @@ def flash_attention(
     K/V live whole in VMEM per (batch*head) grid step — sized for
     serving sequence lengths (T <= ~8K at 128 lanes); the ring kernel
     covers longer sequences across chips."""
+    from ._common import sublanes_for
+
     B, H, T, D = q.shape
     if k.shape != q.shape or v.shape != q.shape:
         raise ValueError(
             f"q/k/v shapes must match, got {q.shape}/{k.shape}/{v.shape}"
         )
     scale = 1.0 / (D ** 0.5)
-    bq = bk = min(block, max(8, T))
+    # block height must be a sublane multiple (f32 8 / bf16 16 / int8 32)
+    # or Mosaic rejects the VMEM tile; short sequences round T UP to the
+    # sublane grid and pad, they don't shrink the tile below it
+    sub = sublanes_for(q.dtype)
+    bq = bk = min(
+        max(block // sub * sub, sub),
+        (T + sub - 1) // sub * sub,
+    )
     padT = (-T) % bq
     padD = (-D) % LANES
     if padT or padD:

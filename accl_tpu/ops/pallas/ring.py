@@ -399,3 +399,55 @@ def ring_allgather(
     )
     blocks = out.reshape(size, -1)[:, :n]
     return blocks.reshape((size * x.shape[0],) + x.shape[1:])
+
+
+def int8_allreduce(
+    x: jax.Array,
+    axis_name: str,
+    num_segments: int = 1,
+    *,
+    collective_id: int = 0,
+    interpret: InterpretArg = None,
+) -> jax.Array:
+    """Allreduce with blockwise-int8 wire compression on the Pallas ring
+    tier — the ``hp_compression`` role at its narrowest lane.
+
+    A plain dtype cast (the ``wire_dtype`` path of :func:`ring_allreduce`)
+    cannot express int8: blockwise quantization needs a per-tile scale
+    riding with the payload.  So the composition is quantize-once /
+    gather / dequantize-reduce: each rank quantizes its full operand with
+    the Pallas quant kernel (one fp32 scale per ~32 KiB tile), the int8
+    payload AND the scale vector ride the Pallas ring allgather
+    (store-and-relay remote DMAs), and every rank dequantizes each peer
+    block with the Pallas dequant kernel and reduces locally.
+
+    Wire cost: ``(P-1) * n`` int8 bytes per rank (plus ~n/8192 scale
+    bytes) versus the f32 ring's ``2(P-1)/P * 4n`` — ~2x fewer wire
+    bytes at P=4 and, unlike a reduce-scatter ring in int8, the payload
+    is quantized exactly ONCE, so the error bound is the sum of each
+    rank's own tile scales (asserted in the e2e test), not a per-hop
+    requantization cascade.
+    """
+    from .compression import dequantize_int8, quantize_int8
+
+    size = lax.axis_size(axis_name)
+    if size == 1:
+        return x
+    values, scales, n = quantize_int8(x, interpret=interpret)
+    rows = values.shape[0]
+    nblk = scales.shape[0]
+    all_v = ring_allgather(
+        values.reshape(-1), axis_name, num_segments,
+        collective_id=collective_id, interpret=interpret,
+    ).reshape(size, rows, LANES)
+    all_s = ring_allgather(
+        scales.reshape(-1), axis_name,
+        collective_id=collective_id, interpret=interpret,
+    ).reshape(size, nblk, 1)
+    acc = jnp.zeros(x.shape, jnp.float32)
+    for r in range(size):
+        acc = acc + dequantize_int8(
+            all_v[r], all_s[r], n, x.shape, jnp.float32,
+            interpret=interpret,
+        )
+    return acc.astype(x.dtype)

@@ -195,6 +195,13 @@ def make_zero_train_step(
     from ..models.transformer import loss_fn, param_specs, _shard_params
     from ..ops import collectives
 
+    if getattr(model_cfg, "attention", None) == "flash":
+        raise ValueError(
+            'attention="flash" is forward-only (the Pallas kernel has no '
+            'transpose rule); train with "blockwise", its differentiable '
+            "XLA twin"
+        )
+
     specs = param_specs(model_cfg)
     sspecs = zero_state_specs(specs)
     tp = mesh.shape["tp"]

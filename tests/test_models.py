@@ -421,3 +421,17 @@ def test_unknown_attention_impl_raises(cfg):
             params, jnp.zeros((1, 8), jnp.int32),
             dataclasses.replace(cfg, attention="dave"),
         )
+
+
+def test_flash_training_rejected_upfront(cfg, mesh22):
+    """attention="flash" is forward-only: the train-step builders must
+    reject it with a clear error, not an opaque autodiff failure."""
+    import dataclasses
+
+    from accl_tpu.parallel import AdamConfig, make_zero_train_step
+
+    c = dataclasses.replace(cfg, attention="flash")
+    with pytest.raises(ValueError, match="forward-only"):
+        make_sharded_train_step(c, mesh22)
+    with pytest.raises(ValueError, match="forward-only"):
+        make_zero_train_step(c, mesh22, AdamConfig())

@@ -634,9 +634,83 @@ def test_flash_attention_ragged_and_padded():
     )
 
 
+def test_flash_attention_ragged_default_block():
+    """T below the default block and NOT a sublane multiple: the block
+    height must round up to the sublane grid (f32: 8), not shrink to an
+    unalignable tile (Mosaic would reject (1, 50, D) f32 tiles)."""
+    rng = np.random.default_rng(23)
+    B, H, T, D = 1, 2, 50, 16
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((B, H, T, D)), jnp.float32)
+        for _ in range(3)
+    )
+    got = pk.flash_attention(q, k, v)  # default block=256
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+    s = jnp.where(jnp.tril(jnp.ones((T, T), bool)), s, -1e30)
+    expect = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(expect), rtol=2e-5, atol=2e-5
+    )
+
+
 def test_flash_attention_validates():
     with pytest.raises(ValueError, match="must match"):
         pk.flash_attention(
             jnp.zeros((1, 1, 8, 8)), jnp.zeros((1, 1, 8, 8)),
             jnp.zeros((1, 1, 16, 8)),
         )
+
+
+def test_int8_allreduce_error_bound():
+    """End-to-end: blockwise-int8 wire compression over the Pallas ring
+    transport (VERDICT r2 item 6).  The result must respect the ANALYTIC
+    quantization bound: each rank's contribution errs at most scale/2 per
+    element (round-to-nearest with its own tile scale), so the sum errs
+    at most sum_r(scale_r)/2 — quantized exactly once, no per-hop
+    cascade."""
+    mesh = _mesh(4)
+    n = 4 * 8 * 128
+    rng = np.random.default_rng(33)
+    data = jnp.asarray(rng.normal(size=(4, n)) * 3.0, jnp.float32)
+
+    fn = jax.jit(
+        shard_map(
+            lambda x: pk.int8_allreduce(x[0], "x")[None],
+            mesh=mesh, in_specs=P("x"), out_specs=P("x"), check_vma=False,
+        )
+    )
+    out = np.asarray(fn(data))
+    expect = np.asarray(data).sum(0)
+
+    # analytic bound: every rank quantizes its full operand with one
+    # scale per tile; this shape fits one tile per rank, so the bound is
+    # sum over ranks of (absmax_r / 127) / 2 (+ f32 summation slack)
+    scales = np.abs(np.asarray(data)).max(axis=1) / 127.0
+    bound = scales.sum() / 2.0 + 1e-4
+    err = np.abs(out[0] - expect).max()
+    assert err <= bound, (err, bound)
+    # all ranks agree (it is an ALLreduce)
+    for r in range(1, 4):
+        np.testing.assert_array_equal(out[r], out[0])
+    # and the wire really was narrowed: int8 cannot be bit-exact here
+    assert not np.array_equal(out[0], expect)
+
+
+def test_int8_allreduce_matches_sum_tolerance():
+    """Looser sanity at a larger, multi-tile size: relative agreement
+    with the true sum at int8 precision."""
+    mesh = _mesh(4)
+    # 544 packed rows per rank > block_rows' want of 512 -> nblk = 2:
+    # the multi-tile scale gather/reshape path is actually exercised
+    n = 544 * 128
+    rng = np.random.default_rng(34)
+    data = jnp.asarray(rng.normal(size=(4, n)), jnp.float32)
+    fn = jax.jit(
+        shard_map(
+            lambda x: pk.int8_allreduce(x[0], "x", num_segments=2)[None],
+            mesh=mesh, in_specs=P("x"), out_specs=P("x"), check_vma=False,
+        )
+    )
+    out = np.asarray(fn(data))
+    expect = np.asarray(data).sum(0)
+    np.testing.assert_allclose(out[0], expect, atol=0.1, rtol=0.1)
