@@ -535,6 +535,18 @@ def make_sharded_forward(cfg: TransformerConfig, mesh: Mesh):
     return fn, partial(_shard_params, specs=specs, mesh=mesh)
 
 
+def _reject_untrainable_attention(cfg) -> None:
+    """Train-step builders share this guard: the Pallas flash kernel is
+    forward-only, and the failure must be a clear up-front rejection,
+    not an opaque autodiff transpose error."""
+    if getattr(cfg, "attention", None) == "flash":
+        raise ValueError(
+            'attention="flash" is forward-only (the Pallas kernel has no '
+            'transpose rule); train with "blockwise", its differentiable '
+            "XLA twin"
+        )
+
+
 def make_sharded_train_step(cfg: TransformerConfig, mesh: Mesh, lr: float = 1e-2):
     """One SGD train step as a single shard_map program over ('dp','tp').
 
@@ -544,12 +556,7 @@ def make_sharded_train_step(cfg: TransformerConfig, mesh: Mesh, lr: float = 1e-2
     weights keep local shard grads, replicated weights get the cross-shard
     psum — the dp gradient allreduce of classic data parallelism falls out
     of the same machinery."""
-    if cfg.attention == "flash":
-        raise ValueError(
-            'attention="flash" is forward-only (the Pallas kernel has no '
-            'transpose rule); train with "blockwise", its differentiable '
-            "XLA twin"
-        )
+    _reject_untrainable_attention(cfg)
     specs = param_specs(cfg)
     tp = mesh.shape["tp"]
     dp = mesh.shape["dp"]

@@ -407,6 +407,7 @@ def int8_allreduce(
     num_segments: int = 1,
     *,
     collective_id: int = 0,
+    scale_collective_id: int = 4,
     interpret: InterpretArg = None,
 ) -> jax.Array:
     """Allreduce with blockwise-int8 wire compression on the Pallas ring
@@ -427,6 +428,11 @@ def int8_allreduce(
     is quantized exactly ONCE, so the error bound is the sum of each
     rank's own tile scales (asserted in the e2e test), not a per-hop
     requantization cascade.
+
+    CONSUMES TWO collective ids: ``collective_id`` for the payload ring
+    and ``scale_collective_id`` for the scale ring (the module
+    namespace holds 0=ring, 1=put, 2=attention, 3=alltoall, 4=this
+    scale leg) — compose with other collective kernels accordingly.
     """
     from .compression import dequantize_int8, quantize_int8
 
@@ -436,18 +442,28 @@ def int8_allreduce(
     values, scales, n = quantize_int8(x, interpret=interpret)
     rows = values.shape[0]
     nblk = scales.shape[0]
+    # two ring kernels in one program get DISTINCT collective ids so
+    # their barrier semaphores can never alias (id-namespace hygiene;
+    # note the size=8 interpreter slowness investigated alongside this
+    # turned out to be the single-core busy-spin convoy below, not id
+    # aliasing — distinct ids are kept as correct composition anyway)
     all_v = ring_allgather(
         values.reshape(-1), axis_name, num_segments,
         collective_id=collective_id, interpret=interpret,
     ).reshape(size, rows, LANES)
     all_s = ring_allgather(
         scales.reshape(-1), axis_name,
-        collective_id=collective_id, interpret=interpret,
+        collective_id=scale_collective_id, interpret=interpret,
     ).reshape(size, nblk, 1)
-    acc = jnp.zeros(x.shape, jnp.float32)
-    for r in range(size):
-        acc = acc + dequantize_int8(
-            all_v[r], all_s[r], n, x.shape, jnp.float32,
-            interpret=interpret,
-        )
-    return acc.astype(x.dtype)
+    # ONE batched dequant kernel over all ranks' blocks (the per-tile
+    # scale arithmetic is position-independent), then trim each rank's
+    # lane padding and reduce — P kernel launches would otherwise stack
+    # up on the collective hot path
+    flat = dequantize_int8(
+        all_v.reshape(size * rows, LANES),
+        all_s.reshape(size * nblk, 1),
+        size * rows * LANES, (size, rows * LANES), jnp.float32,
+        interpret=interpret,
+    )
+    acc = flat[:, :n].sum(axis=0)
+    return acc.reshape(x.shape).astype(x.dtype)

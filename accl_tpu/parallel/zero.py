@@ -192,15 +192,15 @@ def make_zero_train_step(
     targets) -> (params, state, loss)``.  Donates params AND state (both
     update in place on device)."""
     from ..constants import ReduceFunction
-    from ..models.transformer import loss_fn, param_specs, _shard_params
+    from ..models.transformer import (
+        _reject_untrainable_attention,
+        _shard_params,
+        loss_fn,
+        param_specs,
+    )
     from ..ops import collectives
 
-    if getattr(model_cfg, "attention", None) == "flash":
-        raise ValueError(
-            'attention="flash" is forward-only (the Pallas kernel has no '
-            'transpose rule); train with "blockwise", its differentiable '
-            "XLA twin"
-        )
+    _reject_untrainable_attention(model_cfg)
 
     specs = param_specs(model_cfg)
     sspecs = zero_state_specs(specs)

@@ -700,8 +700,9 @@ def test_int8_allreduce_matches_sum_tolerance():
     """Looser sanity at a larger, multi-tile size: relative agreement
     with the true sum at int8 precision."""
     mesh = _mesh(4)
-    # 544 packed rows per rank > block_rows' want of 512 -> nblk = 2:
-    # the multi-tile scale gather/reshape path is actually exercised
+    # 544 packed rows per rank: 544 = 2^5 * 17 has no 32-multiple
+    # divisor in [64, 512], so block_rows falls to 32 -> nblk = 17 —
+    # the multi-tile scale gather/reshape path is heavily exercised
     n = 544 * 128
     rng = np.random.default_rng(34)
     data = jnp.asarray(rng.normal(size=(4, n)), jnp.float32)
