@@ -26,6 +26,12 @@ from ..ops.pallas.attention import (  # noqa: F401
 )
 from .ulysses_attention import ulysses_attention  # noqa: F401
 from .moe import init_moe_params, moe_ffn  # noqa: F401
+from .composed import (  # noqa: F401
+    make_pp_train_step,
+    stack_params,
+    stacked_param_specs,
+    unstack_params,
+)
 from .pipeline import (  # noqa: F401
     pipeline_apply,
     pipeline_loss,

@@ -1,0 +1,213 @@
+"""Composed 3-axis parallelism: pipeline x data x tensor in ONE program.
+
+The parallelism layers each exist standalone — Megatron-TP blocks
+(``transformer.py``), GPipe/1F1B microbatch pipelining (``pipeline.py``),
+dp gradient averaging — and the point of the substrate is that they
+compose (SURVEY.md §5: the long-context/parallelism machinery is a
+composable layer over the collectives engine, not special cases).  This
+module is the composition: a mesh ``('pp', 'dp', 'tp')`` where
+
+* each ``pp`` rank owns a contiguous span of transformer blocks, stored
+  STACKED (leading layer axis sharded over ``pp``) and walked with one
+  ``lax.scan`` — O(1) program size in depth;
+* inside a stage, every block runs the Megatron-TP math (column/row
+  parallel matmuls, tp-allreduce exits) over the ``tp`` axis;
+* the batch is sharded over ``dp`` and split into microbatches that
+  stream through the stages (``pipeline_apply``'s uniform schedule, the
+  activation handoff one ``ppermute`` hop per boundary);
+* embeddings / final layernorm are replicated across ``pp``; their
+  gradients (stage-0 consumption + last-stage loss head contributions)
+  come out of shard_map's varying-axis tracking, which transposes the
+  forward's collectives into exactly the right cotangent psums — the
+  same machinery ``make_sharded_train_step`` relies on, extended by one
+  mesh axis.
+
+Gradients come from autodiff through the pipeline loop (the GPipe
+schedule; the hand-scheduled 1F1B backward lives at the pipeline-layer
+API with its stage-local-grads contract).  The whole step — forward
+pipeline, loss, backward through transposed ppermute edges, SGD — is
+one jitted shard_map program over the 3-D mesh.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+from .pipeline import pipeline_apply
+from .transformer import (
+    TransformerConfig,
+    _block,
+    _layernorm,
+    _reject_untrainable_attention,
+    init_params,
+)
+
+
+def stacked_param_specs(cfg: TransformerConfig) -> Dict:
+    """Partition specs for the STACKED parameter tree: per-layer leaves
+    gain a leading layer axis sharded over ``pp``; within a layer the
+    Megatron column/row specs shard over ``tp`` as in
+    ``transformer.param_specs``; embeddings/final-ln replicate."""
+    layer = {
+        "wq": P("pp", None, "tp"),
+        "wk": P("pp", None, "tp"),
+        "wv": P("pp", None, "tp"),
+        "wo": P("pp", "tp", None),
+        "w1": P("pp", None, "tp"),
+        "w2": P("pp", "tp", None),
+        "ln1": P("pp", None),
+        "ln2": P("pp", None),
+    }
+    return {
+        "embed": P(None, None),
+        "pos": P(None, None),
+        "ln_f": P(None),
+        "layers": layer,
+    }
+
+
+def stack_params(params: Dict) -> Dict:
+    """``transformer.init_params``' per-layer list -> stacked arrays with
+    a leading layer axis (the pp shard dim)."""
+    layers = params["layers"]
+    stacked = {
+        k: jnp.stack([lp[k] for lp in layers]) for k in layers[0]
+    }
+    return {**{k: v for k, v in params.items() if k != "layers"},
+            "layers": stacked}
+
+
+def unstack_params(params: Dict) -> Dict:
+    """Inverse of :func:`stack_params` (for comparisons/checkpoints)."""
+    L = params["layers"]["wq"].shape[0]
+    layers = [
+        {k: v[i] for k, v in params["layers"].items()} for i in range(L)
+    ]
+    return {**{k: v for k, v in params.items() if k != "layers"},
+            "layers": layers}
+
+
+def make_pp_train_step(
+    cfg: TransformerConfig,
+    mesh: Mesh,
+    num_microbatches: int,
+    lr: float = 1e-2,
+):
+    """One SGD step over the ('pp', 'dp', 'tp') mesh.
+
+    Returns ``(step, shard)``: ``step(params, tokens, targets) ->
+    (params, loss)`` with ``params`` in stacked form committed to the
+    mesh by ``shard``; ``tokens/targets`` are the GLOBAL batch,
+    dp-sharded on the batch dim.  The per-dp-rank batch must divide into
+    ``num_microbatches``; ``cfg.n_layers`` must divide by the pp size.
+    """
+    _reject_untrainable_attention(cfg)
+    if cfg.seq_parallel:
+        raise ValueError(
+            "make_pp_train_step does not compose with seq_parallel yet: "
+            "the pipeline streams full-sequence microbatch activations "
+            "between stages (sequence-shard them with the standalone "
+            "Megatron-SP train step, or request the composition)"
+        )
+    pp = mesh.shape["pp"]
+    dp = mesh.shape["dp"]
+    tp = mesh.shape["tp"]
+    if cfg.n_layers % pp:
+        raise ValueError(
+            f"n_layers ({cfg.n_layers}) must divide by pp ({pp})"
+        )
+    if cfg.n_heads % tp:
+        raise ValueError(
+            f"n_heads ({cfg.n_heads}) must divide by tp ({tp})"
+        )
+    M = num_microbatches
+    heads_local = cfg.n_heads // tp
+    specs = stacked_param_specs(cfg)
+
+    def stage_fn(stage_layers, x):
+        """This rank's layer span, walked with one scan; each block is
+        the Megatron-TP block over the 'tp' axis.  ``cfg.remat``
+        checkpoints each block (recompute on backward) exactly like the
+        plain forward does."""
+        def body(h, lp):
+            blk = partial(
+                _block, n_heads_local=heads_local, tp_axis="tp",
+                attn_impl=cfg.attention,
+            )
+            if cfg.remat:
+                blk = jax.checkpoint(blk)
+            return blk(h, lp), None
+
+        out, _ = lax.scan(body, x, stage_layers)
+        return out
+
+    def loss_head(final_act, tgt_mb, p):
+        """Last stage's head: final layernorm + tied unembed + CE."""
+        h = _layernorm(final_act, p["ln_f"])
+        logits = h @ p["embed"].T
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(
+            logp, tgt_mb[..., None], axis=-1
+        ).squeeze(-1)
+        return nll.mean()
+
+    def step(params, tokens, targets):
+        B, T = tokens.shape  # per-dp-rank batch
+        if B % M:
+            raise ValueError(
+                f"per-dp-rank batch ({B}) must divide into "
+                f"num_microbatches ({M})"
+            )
+        me_pp = lax.axis_index("pp")
+
+        def global_loss(p):
+            x = p["embed"][tokens] + p["pos"][:T]
+            mbs = x.reshape(M, B // M, T, cfg.d_model)
+            tgts = targets.reshape(M, B // M, T)
+            outs = pipeline_apply(p["layers"], mbs, "pp", stage_fn)
+            per_mb = jax.vmap(lambda o, t: loss_head(o, t, p))(outs, tgts)
+            # last stage's mean, summed over pp (one nonzero term) and
+            # averaged over dp — differentiated as the GLOBAL quantity,
+            # so the varying-axis transpose places every cotangent psum
+            local = jnp.where(me_pp == pp - 1, per_mb.mean(), 0.0)
+            return lax.psum(lax.psum(local, "pp"), "dp") / dp
+
+        loss, grads = jax.value_and_grad(global_loss)(params)
+        params = jax.tree.map(lambda p_, g: p_ - lr * g, params, grads)
+        return params, loss
+
+    fn = jax.jit(
+        shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(specs, P("dp", None), P("dp", None)),
+            out_specs=(specs, P()),
+        ),
+        donate_argnums=(0,),
+    )
+
+    def shard(params):
+        stacked = stack_params(params)
+        # map over SPECS first: PartitionSpec is a tuple subclass, so it
+        # must be the is_leaf-guarded tree or jax flattens it
+        return jax.tree.map(
+            lambda s, p_: jax.device_put(
+                jnp.array(p_, copy=True), NamedSharding(mesh, s)
+            ),
+            specs, stacked,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
+    return fn, shard

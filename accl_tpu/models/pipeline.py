@@ -23,6 +23,16 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def _pvary(x, axis_name):
+    """Mark ``x`` varying over ``axis_name`` for shard_map's replication
+    checker (loop carries initialized from constants are invariant, but
+    the loop body makes them varying — the types must match up front).
+    No-op data-wise; compat across jax pvary/pcast spellings."""
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, (axis_name,), to="varying")
+    return lax.pvary(x, (axis_name,))  # pragma: no cover - older jax
+
+
 def pipeline_apply(
     stage_params,
     microbatches: jax.Array,
@@ -47,7 +57,6 @@ def pipeline_apply(
     S = lax.axis_size(pp_axis)
     me = lax.axis_index(pp_axis)
     M = microbatches.shape[0]
-    mb_shape = microbatches.shape[1:]
 
     fwd = [(i, i + 1) for i in range(S - 1)]  # stage s -> s+1 edges
 
@@ -69,8 +78,13 @@ def pipeline_apply(
         # step; invalid lanes carry zeros)
         return lax.ppermute(act, pp_axis, fwd), outputs
 
-    carry = jnp.zeros(mb_shape, microbatches.dtype)  # activation entering me
-    outputs = jnp.zeros((M,) + mb_shape, microbatches.dtype)
+    # inits derive from the operand (vma inherited) and are additionally
+    # marked pp-varying: the loop body's activations depend on this
+    # rank's stage params, and the carry types must match up front
+    carry = _pvary(
+        jnp.zeros_like(microbatches[0]), pp_axis
+    )  # activation entering me
+    outputs = _pvary(jnp.zeros_like(microbatches), pp_axis)
     # the schedule is step-index-uniform, so the whole pipeline is ONE
     # compiled loop body (O(1) program size in M and S, differentiable)
     _, outputs = lax.fori_loop(
