@@ -26,6 +26,12 @@ from ..ops.pallas.attention import (  # noqa: F401
 )
 from .ulysses_attention import ulysses_attention  # noqa: F401
 from .moe import init_moe_params, moe_ffn  # noqa: F401
+from .encoder import (  # noqa: F401
+    encode,
+    encoder_forward,
+    make_sharded_encoder_step,
+    mlm_loss,
+)
 from .composed import (  # noqa: F401
     make_pp_train_step,
     stack_params,

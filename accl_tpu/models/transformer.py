@@ -130,8 +130,9 @@ def _layernorm(x, scale):
     return (x - mu) * jax.lax.rsqrt(var + 1e-5) * scale
 
 
-def _attention(q, k, v, impl: str = "naive"):
-    """Causal attention; q,k,v: (B, H, T, hd).
+def _attention(q, k, v, impl: str = "naive", causal: bool = True):
+    """Attention; q,k,v: (B, H, T, hd); ``causal=False`` is the
+    bidirectional (encoder) form.
 
     ``impl="blockwise"`` runs the fused online-softmax fold (no (T, T)
     score matrix in HBM — the flagship's MFU lever); ``"naive"`` is the
@@ -139,20 +140,21 @@ def _attention(q, k, v, impl: str = "naive"):
     if impl == "blockwise":
         from ..ops.attention import blockwise_attention
 
-        return blockwise_attention(q, k, v, causal=True)
+        return blockwise_attention(q, k, v, causal=causal)
     if impl == "flash":
         # the Pallas kernel owns the fold schedule (forward-only: use
         # for serving/prefill; train with "blockwise", its autodiffable
         # XLA twin)
         from ..ops.pallas.attention import flash_attention
 
-        return flash_attention(q, k, v, causal=True)
+        return flash_attention(q, k, v, causal=causal)
     if impl != "naive":
         raise ValueError(f"unknown attention impl {impl!r}")
     T = q.shape[2]
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(q.shape[-1])
-    mask = jnp.tril(jnp.ones((T, T), bool))
-    scores = jnp.where(mask, scores, -1e30)
+    if causal:
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        scores = jnp.where(mask, scores, -1e30)
     return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, axis=-1), v)
 
 
@@ -166,7 +168,7 @@ def _mlp(x, lp, tp_axis):
     return x + partial_f
 
 
-def _attn_partial(h, lp, n_heads_local, attn_impl="naive"):
+def _attn_partial(h, lp, n_heads_local, attn_impl="naive", causal=True):
     """Column-parallel attention on a full-sequence activation: returns
     the row-parallel PARTIAL output (pre-reduction) and the (k, v) head
     tensors (B, H_local, T, hd) for KV-cache prefill."""
@@ -175,13 +177,13 @@ def _attn_partial(h, lp, n_heads_local, attn_impl="naive"):
     hd = q.shape[-1] // n_heads_local
     reshape = lambda t: t.reshape(B, T, n_heads_local, hd).transpose(0, 2, 1, 3)
     q, k, v = reshape(q), reshape(k), reshape(v)
-    attn = _attention(q, k, v, impl=attn_impl)
+    attn = _attention(q, k, v, impl=attn_impl, causal=causal)
     attn = attn.transpose(0, 2, 1, 3).reshape(B, T, -1)
     return attn @ lp["wo"], (k, v)
 
 
 def _block(x, lp, n_heads_local, tp_axis, return_kv=False,
-           attn_impl="naive"):
+           attn_impl="naive", causal=True):
     """One transformer block on tp-sharded weights.  ``lp['wqkv']`` etc. are
     the *local shards*; the tp-allreduce after each row-parallel matmul is
     the reference's fused-allreduce hot path in model form.
@@ -189,7 +191,7 @@ def _block(x, lp, n_heads_local, tp_axis, return_kv=False,
     ``return_kv=True`` additionally returns the (k, v) head tensors
     (B, H_local, T, hd) — the prefill path of the KV-cache decode."""
     h = _layernorm(x, lp["ln1"])
-    partial_o, kv = _attn_partial(h, lp, n_heads_local, attn_impl)
+    partial_o, kv = _attn_partial(h, lp, n_heads_local, attn_impl, causal)
     if tp_axis is not None:
         partial_o = collectives.allreduce(partial_o, tp_axis, ReduceFunction.SUM)
     x = x + partial_o
@@ -198,7 +200,7 @@ def _block(x, lp, n_heads_local, tp_axis, return_kv=False,
 
 
 def _block_sp(x_sp, lp, n_heads_local, tp_axis, return_kv=False,
-              attn_impl="naive"):
+              attn_impl="naive", causal=True):
     """Sequence-parallel block (Megatron-SP): ``x_sp`` is (B, T/tp, D),
     sequence-sharded over ``tp``.  All-gather restores the full sequence
     in front of each column-parallel matmul; the row-parallel reduction
@@ -212,7 +214,9 @@ def _block_sp(x_sp, lp, n_heads_local, tp_axis, return_kv=False,
     sequence-parallel prefill path of the KV-cache decode."""
     h = _layernorm(x_sp, lp["ln1"])
     h_full = collectives.allgather(h, tp_axis, axis=1)
-    partial_o, kv = _attn_partial(h_full, lp, n_heads_local, attn_impl)
+    partial_o, kv = _attn_partial(
+        h_full, lp, n_heads_local, attn_impl, causal
+    )
     o_sp = collectives.reduce_scatter(
         partial_o, tp_axis, tiled=True, axis=1
     )
@@ -227,7 +231,8 @@ def _block_sp(x_sp, lp, n_heads_local, tp_axis, return_kv=False,
     return (out, kv) if return_kv else out
 
 
-def _enter_block_layout(x, cfg, tp_axis, tp_size, return_kv=False):
+def _enter_block_layout(x, cfg, tp_axis, tp_size, return_kv=False,
+                        causal=True):
     """Enter the block stack's activation layout and pick the block fn.
 
     Under Megatron-SP (``cfg.seq_parallel`` with a real tp axis) the
@@ -242,7 +247,7 @@ def _enter_block_layout(x, cfg, tp_axis, tp_size, return_kv=False):
     sp = cfg.seq_parallel and tp_axis is not None and tp_size > 1
     kw = dict(
         n_heads_local=heads_local, tp_axis=tp_axis,
-        attn_impl=cfg.attention,
+        attn_impl=cfg.attention, causal=causal,
     )
     if return_kv:
         kw["return_kv"] = True
