@@ -20,7 +20,13 @@ from .transformer import (  # noqa: F401
     make_sharded_forward,
     prefill,
 )
-from .ring_attention import ring_attention, reference_attention  # noqa: F401
+from .ring_attention import (  # noqa: F401
+    reference_attention,
+    ring_attention,
+    stripe_sequence,
+    striped_attention,
+    unstripe_sequence,
+)
 from ..ops.pallas.attention import (  # noqa: F401
     ring_attention as ring_attention_pallas,
 )

@@ -42,36 +42,19 @@ def _fold_block(q, k_blk, v_blk, o, m, l, block_mask):
     return o, m_new, l
 
 
-def ring_attention(
-    q: jax.Array,
-    k: jax.Array,
-    v: jax.Array,
-    axis_name: str,
-    causal: bool = True,
-) -> jax.Array:
-    """Attention over the full (sharded) sequence.  q,k,v: (B,H,T_local,D)
-    per device; returns (B,H,T_local,D) — this device's query rows attended
-    over every device's keys."""
+def _ring_scan(q, k, v, axis_name, mask_for):
+    """The shared rotation: fold the own block, then rotate K/V around
+    the ring P-1 times, folding each visiting block under
+    ``mask_for(origin)``.  Both sequence layouts (contiguous and
+    striped) are this scan with different mask functions."""
     size = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
-    Tq, Tk = q.shape[2], k.shape[2]
     perm = [(i, (i + 1) % size) for i in range(size)]
-
-    tri = jnp.tril(jnp.ones((Tq, Tk), bool))
-    full = jnp.ones((Tq, Tk), bool)
-
-    def mask_for(origin):
-        if not causal:
-            return full
-        return jnp.where(
-            origin == idx, tri, jnp.where(origin < idx, full, jnp.zeros_like(full))
-        )
 
     o = jnp.zeros_like(q)
     m = jnp.full(q.shape[:3] + (1,), -jnp.inf, q.dtype)
     l = jnp.zeros(q.shape[:3] + (1,), q.dtype)
 
-    # fold own block first, then rotate K/V around the ring P-1 times
     o, m, l = _fold_block(q, k, v, o, m, l, mask_for(idx))
 
     def body(s, carry):
@@ -87,6 +70,31 @@ def ring_attention(
     return o / jnp.maximum(l, 1e-30)
 
 
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    causal: bool = True,
+) -> jax.Array:
+    """Attention over the full (sharded) sequence.  q,k,v: (B,H,T_local,D)
+    per device; returns (B,H,T_local,D) — this device's query rows attended
+    over every device's keys."""
+    idx = lax.axis_index(axis_name)
+    Tq, Tk = q.shape[2], k.shape[2]
+    tri = jnp.tril(jnp.ones((Tq, Tk), bool))
+    full = jnp.ones((Tq, Tk), bool)
+
+    def mask_for(origin):
+        if not causal:
+            return full
+        return jnp.where(
+            origin == idx, tri, jnp.where(origin < idx, full, jnp.zeros_like(full))
+        )
+
+    return _ring_scan(q, k, v, axis_name, mask_for)
+
+
 def reference_attention(q, k, v, causal: bool = True) -> jax.Array:
     """Single-device ground truth for tests: q,k,v (B,H,T,D) full sequence."""
     T = q.shape[2]
@@ -94,3 +102,72 @@ def reference_attention(q, k, v, causal: bool = True) -> jax.Array:
     if causal:
         scores = jnp.where(jnp.tril(jnp.ones((T, T), bool)), scores, -1e30)
     return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, axis=-1), v)
+
+
+# ---------------------------------------------------------------------------
+# striped layout: load-balanced causal ring attention
+# ---------------------------------------------------------------------------
+
+
+def stripe_sequence(x: jax.Array, size: int, axis: int = 2) -> jax.Array:
+    """Reorder a full sequence so CONTIGUOUS sharding over ``size`` ranks
+    yields the STRIPED (round-robin) assignment: shard ``r``'s local
+    position ``t`` holds global token ``t * size + r``.
+
+    Under causal masking the striped layout makes every (rank, visiting
+    block) pair's mask triangular — each ring hop does equal work on
+    every rank, where the contiguous layout leaves rank 0 idle for all
+    but its own block (the Striped Attention load-balance argument)."""
+    T = x.shape[axis]
+    if T % size:
+        raise ValueError(f"sequence length {T} must divide by ring size {size}")
+    Tl = T // size
+    j = jnp.arange(T)
+    perm = (j % Tl) * size + (j // Tl)  # position j holds token perm[j]
+    return jnp.take(x, perm, axis=axis)
+
+
+def unstripe_sequence(x: jax.Array, size: int, axis: int = 2) -> jax.Array:
+    """Inverse of :func:`stripe_sequence` (restore token order)."""
+    T = x.shape[axis]
+    if T % size:
+        raise ValueError(f"sequence length {T} must divide by ring size {size}")
+    Tl = T // size
+    j = jnp.arange(T)
+    inv = (j % size) * Tl + (j // size)  # token j sits at position inv[j]
+    return jnp.take(x, inv, axis=axis)
+
+
+def striped_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    causal: bool = True,
+) -> jax.Array:
+    """Ring attention over STRIPED sequence shards (see
+    :func:`stripe_sequence`): same rotation, same online-softmax fold,
+    but the causal mask for a block from ``origin`` is triangular for
+    every (rank, origin) pair —
+
+        global q pos = tq * P + idx,  global k pos = tk * P + origin
+        attend  <=>  tq > tk  or  (tq == tk and idx >= origin)
+
+    so no rank ever folds a fully-masked (wasted) or fully-dense
+    (bottleneck) block: the causal work is balanced across the ring,
+    ~2x effective throughput at large P versus the contiguous layout.
+    q, k, v: (B, H, T_local, D) striped shards; returns striped shards.
+    """
+    idx = lax.axis_index(axis_name)
+    Tq, Tk = q.shape[2], k.shape[2]
+    tri = jnp.tril(jnp.ones((Tq, Tk), bool))
+    tri_strict = jnp.tril(jnp.ones((Tq, Tk), bool), k=-1)
+    full = jnp.ones((Tq, Tk), bool)
+
+    def mask_for(origin):
+        if not causal:
+            return full
+        # diagonal ties break by rank order: idx >= origin attends
+        return jnp.where(idx >= origin, tri, tri_strict)
+
+    return _ring_scan(q, k, v, axis_name, mask_for)
