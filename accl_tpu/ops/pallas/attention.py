@@ -64,7 +64,7 @@ def _fold(bh, q_ref, k_blk_ref, v_blk_ref, o_acc, m_ref, l_ref, mask, scale):
     m_ref[bh] = jnp.broadcast_to(m_new, m_ref[bh].shape)
 
 
-def _attention_kernel(axis_name, size, causal, scale):
+def _attention_kernel(axis_name, size, causal, scale, striped=False):
     total_hops = size - 1
 
     def kernel(q_ref, k_ref, v_ref, o_ref,
@@ -77,11 +77,18 @@ def _attention_kernel(axis_name, size, causal, scale):
         rows = lax.broadcasted_iota(jnp.int32, (T, T), 0)
         cols = lax.broadcasted_iota(jnp.int32, (T, T), 1)
         tri = rows >= cols
+        tri_strict = rows > cols
         ones = jnp.ones((T, T), jnp.bool_)
 
         def mask_for(origin):
             if not causal:
                 return ones
+            if striped:
+                # round-robin token layout (models.stripe_sequence):
+                # global q pos = tq*P + me, k pos = tk*P + origin, so the
+                # mask is triangular for EVERY (rank, origin) pair — the
+                # causal work balances across the ring
+                return jnp.where(me >= origin, tri, tri_strict)
             return jnp.where(
                 origin == me, tri,
                 jnp.where(origin < me, ones, jnp.zeros((T, T), jnp.bool_)),
@@ -166,6 +173,7 @@ def ring_attention(
     axis_name: str,
     causal: bool = True,
     *,
+    striped: bool = False,
     collective_id: int = 2,
     interpret: InterpretArg = None,
 ) -> jax.Array:
@@ -174,6 +182,11 @@ def ring_attention(
     q, k, v: ``(B, H, T_local, D)`` per device inside ``shard_map`` over a
     1-D mesh axis (sequence axis sharded).  Returns ``(B, H, T_local, D)``.
     D is padded to 128 lanes internally; T_local must be a multiple of 8.
+
+    ``striped=True`` expects round-robin (striped) sequence shards
+    (``models.stripe_sequence``): every hop's causal mask is then
+    triangular, balancing the causal work across the ring instead of
+    idling early ranks (Striped Attention) — same wire, same fold.
     """
     B, H, T, D = q.shape
     if k.shape != q.shape or v.shape != q.shape:
@@ -201,7 +214,7 @@ def ring_attention(
     vf = v.reshape(B * H, T, Dp)
 
     out = pl.pallas_call(
-        _attention_kernel(axis_name, size, causal, scale),
+        _attention_kernel(axis_name, size, causal, scale, striped),
         out_shape=jax.ShapeDtypeStruct((B * H, T, Dp), q.dtype),
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 3,
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
