@@ -344,3 +344,39 @@ def test_composed_validates_divisibility():
         make_pp_train_step(
             TransformerConfig(n_layers=3), mesh3d, num_microbatches=2
         )
+
+
+def test_composed_deep_pipeline_matches_plain():
+    """pp=4 (one layer per stage) x tp=2: the deep-pipeline shape where
+    scheduling bugs hide — must still equal the plain step exactly."""
+    from jax.sharding import Mesh
+    from accl_tpu.models import (
+        TransformerConfig, init_params, make_sharded_train_step,
+    )
+    from accl_tpu.models.composed import make_pp_train_step, unstack_params
+
+    cfg = TransformerConfig(
+        vocab=32, d_model=16, n_heads=2, n_layers=4, d_ff=32, max_seq=16,
+        attention="naive",
+    )
+    params0 = init_params(jax.random.PRNGKey(5), cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(6), (4, 8), 0, cfg.vocab)
+    tgts = jnp.roll(toks, -1, axis=1)
+
+    mesh2d = Mesh(np.array(jax.devices()[:8]).reshape(4, 2), ("dp", "tp"))
+    pstep, pshard = make_sharded_train_step(cfg, mesh2d, lr=0.05)
+    p_params, p_loss = pstep(pshard(params0), toks, tgts)
+
+    mesh3d = Mesh(
+        np.array(jax.devices()[:8]).reshape(4, 1, 2), ("pp", "dp", "tp")
+    )
+    cstep, cshard = make_pp_train_step(cfg, mesh3d, num_microbatches=4,
+                                       lr=0.05)
+    c_params, c_loss = cstep(cshard(params0), toks, tgts)
+
+    assert float(c_loss) == pytest.approx(float(p_loss), rel=1e-5)
+    for a, b in zip(
+        jax.tree.leaves(jax.tree.map(np.asarray, p_params)),
+        jax.tree.leaves(unstack_params(jax.tree.map(np.asarray, c_params))),
+    ):
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5)
