@@ -291,11 +291,22 @@ def test_pipeline_unknown_schedule_raises():
         )
 
 
-def test_composed_pp_dp_tp_matches_plain_train_step():
+@pytest.mark.parametrize(
+    "shape3d,n_layers,microbatches,batch,seqlen",
+    [
+        ((2, 2, 2), 2, 2, 8, 16),  # balanced composition
+        ((4, 1, 2), 4, 4, 4, 8),   # deep pipeline: one layer per stage
+    ],
+    ids=["pp2xdp2xtp2", "pp4xdp1xtp2"],
+)
+def test_composed_pp_dp_tp_matches_plain_train_step(
+    shape3d, n_layers, microbatches, batch, seqlen
+):
     """The 3-axis composition (pipeline stages of tp-sharded blocks,
     dp-sharded microbatched batch) computes the SAME loss and SAME
     updated parameters as the plain dp x tp train step on the identical
-    global batch — parallelism layout, not math."""
+    global batch — parallelism layout, not math.  The deep-pipeline
+    shape (one layer per stage) is where scheduling bugs hide."""
     from jax.sharding import Mesh
     from accl_tpu.models import (
         TransformerConfig, init_params, make_sharded_train_step,
@@ -303,11 +314,13 @@ def test_composed_pp_dp_tp_matches_plain_train_step():
     from accl_tpu.models.composed import make_pp_train_step, unstack_params
 
     cfg = TransformerConfig(
-        vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64, max_seq=32,
-        attention="naive",
+        vocab=64, d_model=32, n_heads=4, n_layers=n_layers, d_ff=64,
+        max_seq=32, attention="naive",
     )
     params0 = init_params(jax.random.PRNGKey(0), cfg)
-    toks = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, cfg.vocab)
+    toks = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, seqlen), 0, cfg.vocab
+    )
     tgts = jnp.roll(toks, -1, axis=1)
 
     # plain dp x tp over the same 8 devices
@@ -317,10 +330,11 @@ def test_composed_pp_dp_tp_matches_plain_train_step():
 
     # composed pp x dp x tp
     mesh3d = Mesh(
-        np.array(jax.devices()[:8]).reshape(2, 2, 2), ("pp", "dp", "tp")
+        np.array(jax.devices()[:8]).reshape(*shape3d), ("pp", "dp", "tp")
     )
-    cstep, cshard = make_pp_train_step(cfg, mesh3d, num_microbatches=2,
-                                       lr=0.05)
+    cstep, cshard = make_pp_train_step(
+        cfg, mesh3d, num_microbatches=microbatches, lr=0.05
+    )
     c_params, c_loss = cstep(cshard(params0), toks, tgts)
 
     assert float(c_loss) == pytest.approx(float(p_loss), rel=1e-5)
@@ -345,38 +359,3 @@ def test_composed_validates_divisibility():
             TransformerConfig(n_layers=3), mesh3d, num_microbatches=2
         )
 
-
-def test_composed_deep_pipeline_matches_plain():
-    """pp=4 (one layer per stage) x tp=2: the deep-pipeline shape where
-    scheduling bugs hide — must still equal the plain step exactly."""
-    from jax.sharding import Mesh
-    from accl_tpu.models import (
-        TransformerConfig, init_params, make_sharded_train_step,
-    )
-    from accl_tpu.models.composed import make_pp_train_step, unstack_params
-
-    cfg = TransformerConfig(
-        vocab=32, d_model=16, n_heads=2, n_layers=4, d_ff=32, max_seq=16,
-        attention="naive",
-    )
-    params0 = init_params(jax.random.PRNGKey(5), cfg)
-    toks = jax.random.randint(jax.random.PRNGKey(6), (4, 8), 0, cfg.vocab)
-    tgts = jnp.roll(toks, -1, axis=1)
-
-    mesh2d = Mesh(np.array(jax.devices()[:8]).reshape(4, 2), ("dp", "tp"))
-    pstep, pshard = make_sharded_train_step(cfg, mesh2d, lr=0.05)
-    p_params, p_loss = pstep(pshard(params0), toks, tgts)
-
-    mesh3d = Mesh(
-        np.array(jax.devices()[:8]).reshape(4, 1, 2), ("pp", "dp", "tp")
-    )
-    cstep, cshard = make_pp_train_step(cfg, mesh3d, num_microbatches=4,
-                                       lr=0.05)
-    c_params, c_loss = cstep(cshard(params0), toks, tgts)
-
-    assert float(c_loss) == pytest.approx(float(p_loss), rel=1e-5)
-    for a, b in zip(
-        jax.tree.leaves(jax.tree.map(np.asarray, p_params)),
-        jax.tree.leaves(unstack_params(jax.tree.map(np.asarray, c_params))),
-    ):
-        np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5)
