@@ -40,12 +40,18 @@ def train(
     log_every: int = 5,
     platform: Optional[str] = None,
     optimizer: str = "sgd",
+    parallelism: str = "dp_tp",
 ):
     """Train the flagship transformer.
 
     ``optimizer="zero_adam"`` switches the step to the ZeRO-sharded Adam
     (fp32 moments living 1/dp per chip, ``parallel/zero.py``); its
     optimizer state checkpoints and resumes alongside the params.
+
+    ``parallelism="pipeline"`` trains over the composed pp x dp x tp mesh
+    (``models/composed.py``: pipeline stages of tp-sharded blocks,
+    microbatched dp-sharded batch — pp=2, microbatches=2); params
+    checkpoint in stacked form.  SGD only.
 
     Returns ``(steps_completed, final_loss)``; ``final_loss`` is ``None``
     when a restored checkpoint already covers the requested ``steps``
@@ -66,10 +72,27 @@ def train(
     from ..parallel import AdamConfig, make_zero_train_step
 
     devs = jax.devices()
-    tp = min(tp, len(devs))  # a 1-device host runs with tp=1, not a ValueError
+    use_pp = parallelism == "pipeline"
+    if parallelism not in ("dp_tp", "pipeline"):
+        raise ValueError(f"unknown parallelism {parallelism!r}")
+    if use_pp and optimizer != "sgd":
+        raise ValueError("parallelism='pipeline' supports optimizer='sgd'")
+    pp = 2 if use_pp else 1
+    if use_pp and len(devs) < 2:
+        raise ValueError(
+            "parallelism='pipeline' needs >= 2 devices (pp=2); this host "
+            f"exposes {len(devs)}"
+        )
+    tp = min(tp, max(len(devs) // pp, 1))  # 1-device hosts degrade to tp=1
     if dp is None:
-        dp = max(len(devs) // tp, 1)
-    mesh = Mesh(np.array(devs[: dp * tp]).reshape(dp, tp), ("dp", "tp"))
+        dp = max(len(devs) // (pp * tp), 1)
+    if use_pp:
+        mesh = Mesh(
+            np.array(devs[: pp * dp * tp]).reshape(pp, dp, tp),
+            ("pp", "dp", "tp"),
+        )
+    else:
+        mesh = Mesh(np.array(devs[: dp * tp]).reshape(dp, tp), ("dp", "tp"))
 
     heads = max(4, tp)
     heads += (-heads) % tp  # tp must divide heads (and so d_model/d_ff)
@@ -79,7 +102,15 @@ def train(
     )
     use_zero = optimizer == "zero_adam"
     params0 = init_params(jax.random.PRNGKey(seed), cfg)
-    if use_zero:
+    if use_pp:
+        from ..models import make_pp_train_step
+
+        step_fn, shard = make_pp_train_step(
+            cfg, mesh, num_microbatches=2, lr=0.1
+        )
+        params = shard(params0)
+        opt_state = None
+    elif use_zero:
         step_fn, shard, init_state = make_zero_train_step(
             cfg, mesh, AdamConfig(lr=0.01)
         )
@@ -124,8 +155,11 @@ def train(
                 if "structure" in msg or "tree" in msg:
                     raise ValueError(
                         f"failed to restore {ckpt_dir} at step {latest} "
-                        f"with optimizer={optimizer!r}; was the checkpoint "
-                        f"saved with a different --optimizer?"
+                        f"with optimizer={optimizer!r}, "
+                        f"parallelism={parallelism!r}; was the checkpoint "
+                        "saved with a different --optimizer or "
+                        "--parallelism? (pipeline mode stores layers "
+                        "STACKED, dp_tp stores them as a list)"
                     ) from e
                 raise
             if use_zero:
@@ -150,6 +184,8 @@ def train(
         # the exact token stream an uninterrupted run would, so losses stay
         # bit-comparable across restarts
         rng = np.random.default_rng([seed, it])
+        # per-dp-rank batch of 2 — which also divides the pipeline
+        # mode's num_microbatches=2 exactly
         tokens = jnp.asarray(
             rng.integers(0, cfg.vocab, (2 * dp, cfg.max_seq)), jnp.int32
         )
@@ -183,11 +219,15 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--optimizer", default="sgd", choices=["sgd", "zero_adam"]
     )
+    ap.add_argument(
+        "--parallelism", default="dp_tp", choices=["dp_tp", "pipeline"]
+    )
     args = ap.parse_args(argv)
     train(
         steps=args.steps, ckpt_dir=args.ckpt_dir,
         save_every=args.save_every, tp=args.tp, seed=args.seed,
         platform=args.platform, optimizer=args.optimizer,
+        parallelism=args.parallelism,
     )
     return 0
 

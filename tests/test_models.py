@@ -594,3 +594,35 @@ def test_stripe_roundtrip():
     )
     with pytest.raises(ValueError, match="divide"):
         stripe_sequence(x, 5)
+
+
+def test_trainer_pipeline_parallelism(tmp_path):
+    """The trainer example over the composed pp x dp x tp mesh: trains,
+    checkpoints stacked params, resumes, and rejects the unsupported
+    optimizer combination loudly."""
+    from accl_tpu.examples.train import train
+
+    ckpt = str(tmp_path / "ckpt")
+    done, loss1 = train(
+        steps=4, ckpt_dir=ckpt, save_every=2, log_every=0,
+        parallelism="pipeline",
+    )
+    assert done == 4 and np.isfinite(loss1)
+    done, loss2 = train(
+        steps=6, ckpt_dir=ckpt, save_every=2, log_every=0,
+        parallelism="pipeline",
+    )
+    assert done == 6 and np.isfinite(loss2)
+
+    with pytest.raises(ValueError, match="supports optimizer"):
+        train(steps=2, parallelism="pipeline", optimizer="zero_adam")
+
+
+def test_trainer_parallelism_mismatch_diagnosable(tmp_path):
+    from accl_tpu.examples.train import train
+
+    ckpt = str(tmp_path / "ck2")
+    train(steps=3, ckpt_dir=ckpt, save_every=2, log_every=0)  # dp_tp layout
+    with pytest.raises(ValueError, match="--parallelism"):
+        train(steps=5, ckpt_dir=ckpt, save_every=2, log_every=0,
+              parallelism="pipeline")
