@@ -177,6 +177,37 @@ def _bench_quant_int8_pallas() -> float:
     return moved / per_iter / 1e9
 
 
+def _bench_attention() -> dict:
+    """Forward attention latency, naive vs blockwise vs flash at a
+    serving-ish shape — the per-op record behind the train_mfu delta
+    (and the direct number for the flash kernel's Mosaic lowering)."""
+    import jax
+    import jax.numpy as jnp
+
+    from accl_tpu.models.transformer import _attention
+
+    if _SMALL or jax.default_backend() != "tpu":
+        B, H, T, D, iters = 1, 2, 256, 64, 3
+    else:
+        B, H, T, D, iters = 4, 16, 2048, 128, 20
+    rng = jax.random.PRNGKey(0)
+    q = jax.random.normal(rng, (B, H, T, D), jnp.bfloat16)
+    flops = 4.0 * B * H * T * T * D  # qk^T + pv, causal halves both
+
+    out = {}
+    for impl in ("naive", "blockwise", "flash"):
+        fn = jax.jit(lambda a, b, c, i=impl: _attention(a, b, c, impl=i))
+        fn(q, q, q).block_until_ready()  # compile
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            r = fn(q, q, q)
+        r.block_until_ready()
+        dt = (time.perf_counter() - t0) / iters
+        out[f"attn_{impl}_us"] = round(dt * 1e6, 1)
+        out[f"attn_{impl}_tflops"] = round(flops / 2 / dt / 1e12, 2)
+    return out
+
+
 def _bench_train_mfu(small: bool = False, attention: str = "blockwise") -> dict:
     """Flagship train-step MFU on the local devices: one dp x tp=1 sharded
     SGD step on the bf16 transformer; FLOPs from XLA's own cost analysis
@@ -858,6 +889,9 @@ def main() -> None:
     _try(
         extras, errors, "facade_call_overhead_us", _bench_facade_overhead
     )
+
+    if on_tpu or _SMALL:
+        _try(extras, errors, "attention", _bench_attention)
 
     # flagship train-step MFU (small shapes off-TPU so CI smoke runs
     # fast); on the chip, also the naive-attention comparison point
