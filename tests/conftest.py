@@ -78,6 +78,17 @@ def group4(request):
         a.deinit()
 
 
+@pytest.fixture(scope="module")
+def gang4():
+    """Four rank handles over the single-process XLA gang backend."""
+    from accl_tpu.core import xla_group
+
+    g = xla_group(4)
+    yield g
+    for a in g:
+        a.deinit()
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)

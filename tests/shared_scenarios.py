@@ -9,10 +9,12 @@ pair of module-level picklable functions
     work_<name>(accl, rank, world) -> per-rank result
     check_<name>(results, world)   -> asserts on the gathered results
 
-run three ways:
+run four ways:
 
 * emulator tier   — one thread per rank over ``emulated_group``
 * native C++ tier — same, over ``native_group``
+* XLA gang tier   — same, over ``core.xla_group`` (HBM DeviceBuffers,
+  gang-scheduled shard_map programs)
 * xla_dist tier   — one OS process per rank via ``launch_processes``,
   batched into a single spawn per world size (test_dist_shared.py)
 
@@ -27,9 +29,11 @@ import numpy as np
 
 from accl_tpu import ReduceFunction
 
-# name -> (work, check, tiers); tiers is a subset of {"emu","native","dist"}
+# name -> (work, check, tiers); tiers is a subset of
+# {"emu", "native", "gang", "dist"} — gang is the single-process XLA
+# device tier (core.xla_group), driven threaded like emu/native
 SCENARIOS = {}
-_ALL = ("emu", "native", "dist")
+_ALL = ("emu", "native", "gang", "dist")
 
 
 def _register(name, work, check, tiers=_ALL):
@@ -662,7 +666,7 @@ def check_stream_put_remote(results, world):
 
 _register(
     "stream_put_remote", work_stream_put_remote, check_stream_put_remote,
-    tiers=("emu", "native"),
+    tiers=("emu", "native", "gang"),
 )
 
 
@@ -726,7 +730,7 @@ def check_tuning_allreduce_algorithm(results, world):
 
 _register(
     "tuning_allreduce_algorithm", work_tuning_allreduce_algorithm,
-    check_tuning_allreduce_algorithm, tiers=("dist",),
+    check_tuning_allreduce_algorithm, tiers=("gang", "dist"),
 )
 
 
@@ -786,7 +790,7 @@ def check_tuning_invalid(results, world):
 
 _register(
     "tuning_invalid", work_tuning_invalid, check_tuning_invalid,
-    tiers=("dist",),
+    tiers=("gang", "dist"),
 )
 
 

@@ -1,27 +1,42 @@
-"""The shared scenario suite on the in-process tiers (emulator + native
-C++), one thread per rank — the same bodies test_dist_shared.py runs
-across OS processes.  One suite, three tiers (utility.hpp:29-51)."""
+"""The shared scenario suite on the in-process tiers (emulator, native
+C++, and the XLA gang device tier), one thread per rank — the same
+bodies test_dist_shared.py runs across OS processes.  One suite, FOUR
+tiers (utility.hpp:29-51)."""
 
 import pytest
 
 from helpers import run_parallel
 from shared_scenarios import SCENARIOS, names_for_tier
 
-# union of both in-process tiers' scenario lists; per-tier membership is
+# union of the in-process tiers' scenario lists; per-tier membership is
 # re-checked inside the test against the group fixture's actual tier
-_INPROC_NAMES = sorted(set(names_for_tier("emu")) | set(names_for_tier("native")))
+_INPROC_NAMES = sorted(
+    set(names_for_tier("emu"))
+    | set(names_for_tier("native"))
+    | set(names_for_tier("gang"))
+)
+
+
+def _run_scenario(group, tier, name):
+    work, check, tiers = SCENARIOS[name]
+    if tier not in tiers:
+        pytest.skip(f"scenario {name} not registered for tier {tier}")
+    world = len(group)
+    results = run_parallel(
+        group, lambda accl, rank: work(accl, rank, world), timeout=120.0
+    )
+    check(results, world)
 
 
 @pytest.mark.parametrize("name", _INPROC_NAMES)
 def test_scenario(group4, name, request):
     # group4 is parameterized over emu AND native by conftest — the same
     # scenario bodies run on both in-process tiers
-    tier = request.node.callspec.params["group4"]
-    work, check, tiers = SCENARIOS[name]
-    if tier not in tiers:
-        pytest.skip(f"scenario {name} not registered for tier {tier}")
-    world = len(group4)
-    results = run_parallel(
-        group4, lambda accl, rank: work(accl, rank, world), timeout=120.0
-    )
-    check(results, world)
+    _run_scenario(group4, request.node.callspec.params["group4"], name)
+
+
+@pytest.mark.parametrize("name", _INPROC_NAMES)
+def test_scenario_gang(gang4, name):
+    # the same bodies over the single-process XLA device tier (HBM
+    # DeviceBuffers, gang-scheduled shard_map programs)
+    _run_scenario(gang4, "gang", name)

@@ -125,18 +125,8 @@ def test_combine_max_function(group2, rng):
 # ---------------------------------------------------------------------------
 
 
-@pytest.fixture(scope="module")
-def xgroup4s():
-    from accl_tpu.core import xla_group
-
-    g = xla_group(4)
-    yield g
-    for a in g:
-        a.deinit()
-
-
-def test_xla_copy_stream_variants(xgroup4s, rng):
-    a = xgroup4s[0]
+def test_xla_copy_stream_variants(gang4, rng):
+    a = gang4[0]
     data = rng.standard_normal(16).astype(np.float32)
     a.stream_push(data, stream_id=3)
     buf = a.create_buffer(16, np.float32)
@@ -156,10 +146,10 @@ def test_xla_copy_stream_variants(xgroup4s, rng):
     )
 
 
-def test_xla_reduce_from_stream(xgroup4s, rng):
+def test_xla_reduce_from_stream(gang4, rng):
     n = 8
     rows = [rng.standard_normal(n).astype(np.float32) for _ in range(4)]
-    rb = xgroup4s[0].create_buffer(n, np.float32)
+    rb = gang4[0].create_buffer(n, np.float32)
 
     def work(a, r):
         a.stream_push(rows[r], stream_id=7)
@@ -168,23 +158,23 @@ def test_xla_reduce_from_stream(xgroup4s, rng):
             from_stream=True, stream_id=7, dtype=np.float32,
         )
 
-    run_parallel(xgroup4s, work)
+    run_parallel(gang4, work)
     rb.sync_from_device()
     np.testing.assert_allclose(
         rb.host_view(), np.sum(rows, axis=0), rtol=1e-4, atol=1e-5
     )
 
 
-def test_xla_reduce_to_stream(xgroup4s, rng):
+def test_xla_reduce_to_stream(gang4, rng):
     n = 8
     rows = [rng.standard_normal(n).astype(np.float32) for _ in range(4)]
-    sb = [a.create_buffer_from(rows[r]) for r, a in enumerate(xgroup4s)]
+    sb = [a.create_buffer_from(rows[r]) for r, a in enumerate(gang4)]
 
     def work(a, r):
         a.reduce(sb[r], None, n, root=3, to_stream=True, stream_id=8)
 
-    run_parallel(xgroup4s, work)
-    out = xgroup4s[3].stream_pop(n, np.float32, stream_id=8)
+    run_parallel(gang4, work)
+    out = gang4[3].stream_pop(n, np.float32, stream_id=8)
     np.testing.assert_allclose(
         out, np.sum(rows, axis=0), rtol=1e-4, atol=1e-5
     )

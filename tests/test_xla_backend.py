@@ -12,17 +12,9 @@ from accl_tpu import ReduceFunction
 from accl_tpu.core import xla_group
 
 
-@pytest.fixture(scope="module")
-def xgroup4():
-    g = xla_group(4)
-    yield g
-    for a in g:
-        a.deinit()
-
-
-def test_xla_allreduce(xgroup4, rng):
+def test_xla_allreduce(gang4, rng):
     count = 1000
-    chunks = [rng.standard_normal(count).astype(np.float32) for _ in xgroup4]
+    chunks = [rng.standard_normal(count).astype(np.float32) for _ in gang4]
     expected = np.sum(chunks, axis=0)
 
     def work(accl, rank):
@@ -32,13 +24,13 @@ def test_xla_allreduce(xgroup4, rng):
         recv.sync_from_device()
         return recv.data.copy()
 
-    for got in run_parallel(xgroup4, work):
+    for got in run_parallel(gang4, work):
         np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
 
 
-def test_xla_allreduce_max(xgroup4, rng):
+def test_xla_allreduce_max(gang4, rng):
     count = 500
-    chunks = [rng.standard_normal(count).astype(np.float32) for _ in xgroup4]
+    chunks = [rng.standard_normal(count).astype(np.float32) for _ in gang4]
     expected = np.max(chunks, axis=0)
 
     def work(accl, rank):
@@ -48,12 +40,12 @@ def test_xla_allreduce_max(xgroup4, rng):
         recv.sync_from_device()
         return recv.data.copy()
 
-    for got in run_parallel(xgroup4, work):
+    for got in run_parallel(gang4, work):
         np.testing.assert_array_equal(got, expected)
 
 
 @pytest.mark.parametrize("root", [0, 2])
-def test_xla_bcast(xgroup4, rng, root):
+def test_xla_bcast(gang4, rng, root):
     count = 700
     data = rng.standard_normal(count).astype(np.float32)
 
@@ -67,12 +59,12 @@ def test_xla_bcast(xgroup4, rng, root):
         buf.sync_from_device()
         return buf.data.copy()
 
-    for got in run_parallel(xgroup4, work):
+    for got in run_parallel(gang4, work):
         np.testing.assert_array_equal(got, data)
 
 
-def test_xla_scatter_gather(xgroup4, rng):
-    size = len(xgroup4)
+def test_xla_scatter_gather(gang4, rng):
+    size = len(gang4)
     count = 64
     data = rng.standard_normal(size * count).astype(np.float32)
 
@@ -90,16 +82,16 @@ def test_xla_scatter_gather(xgroup4, rng):
             return got_chunk, gbuf.data.copy()
         return got_chunk, None
 
-    res = run_parallel(xgroup4, work)
+    res = run_parallel(gang4, work)
     for r, (chunk, _) in enumerate(res):
         np.testing.assert_array_equal(chunk, data[r * count : (r + 1) * count])
     np.testing.assert_array_equal(res[3][1], data)
 
 
-def test_xla_allgather(xgroup4, rng):
-    size = len(xgroup4)
+def test_xla_allgather(gang4, rng):
+    size = len(gang4)
     count = 50
-    chunks = [rng.standard_normal(count).astype(np.float32) for _ in xgroup4]
+    chunks = [rng.standard_normal(count).astype(np.float32) for _ in gang4]
 
     def work(accl, rank):
         send = accl.create_buffer_from(chunks[rank])
@@ -108,14 +100,14 @@ def test_xla_allgather(xgroup4, rng):
         recv.sync_from_device()
         return recv.data.copy()
 
-    for got in run_parallel(xgroup4, work):
+    for got in run_parallel(gang4, work):
         np.testing.assert_array_equal(got, np.concatenate(chunks))
 
 
-def test_xla_reduce_scatter(xgroup4, rng):
-    size = len(xgroup4)
+def test_xla_reduce_scatter(gang4, rng):
+    size = len(gang4)
     count = 32
-    full = [rng.standard_normal(size * count).astype(np.float32) for _ in xgroup4]
+    full = [rng.standard_normal(size * count).astype(np.float32) for _ in gang4]
     expected = np.sum(full, axis=0)
 
     def work(accl, rank):
@@ -125,17 +117,17 @@ def test_xla_reduce_scatter(xgroup4, rng):
         recv.sync_from_device()
         return recv.data.copy()
 
-    res = run_parallel(xgroup4, work)
+    res = run_parallel(gang4, work)
     for r, got in enumerate(res):
         np.testing.assert_allclose(
             got, expected[r * count : (r + 1) * count], rtol=1e-5, atol=1e-6
         )
 
 
-def test_xla_alltoall(xgroup4, rng):
-    size = len(xgroup4)
+def test_xla_alltoall(gang4, rng):
+    size = len(gang4)
     count = 16
-    mats = [rng.standard_normal(size * count).astype(np.float32) for _ in xgroup4]
+    mats = [rng.standard_normal(size * count).astype(np.float32) for _ in gang4]
 
     def work(accl, rank):
         send = accl.create_buffer_from(mats[rank])
@@ -144,7 +136,7 @@ def test_xla_alltoall(xgroup4, rng):
         recv.sync_from_device()
         return recv.data.copy()
 
-    res = run_parallel(xgroup4, work)
+    res = run_parallel(gang4, work)
     for r, got in enumerate(res):
         expected = np.concatenate(
             [mats[p][r * count : (r + 1) * count] for p in range(size)]
@@ -152,7 +144,7 @@ def test_xla_alltoall(xgroup4, rng):
         np.testing.assert_array_equal(got, expected)
 
 
-def test_xla_sendrecv(xgroup4, rng):
+def test_xla_sendrecv(gang4, rng):
     data = rng.standard_normal(333).astype(np.float32)
 
     def work(accl, rank):
@@ -167,11 +159,11 @@ def test_xla_sendrecv(xgroup4, rng):
             return buf.data.copy()
         return None
 
-    res = run_parallel(xgroup4, work)
+    res = run_parallel(gang4, work)
     np.testing.assert_array_equal(res[2], data)
 
 
-def test_xla_stream_put(xgroup4, rng):
+def test_xla_stream_put(gang4, rng):
     data = rng.standard_normal(64).astype(np.float32)
 
     def work(accl, rank):
@@ -183,13 +175,13 @@ def test_xla_stream_put(xgroup4, rng):
             return accl.stream_pop(64, np.float32, stream_id=5)
         return None
 
-    res = run_parallel(xgroup4, work)
+    res = run_parallel(gang4, work)
     np.testing.assert_array_equal(res[3], data)
 
 
-def test_xla_compressed_allreduce(xgroup4, rng):
+def test_xla_compressed_allreduce(gang4, rng):
     count = 512
-    chunks = [rng.standard_normal(count).astype(np.float32) for _ in xgroup4]
+    chunks = [rng.standard_normal(count).astype(np.float32) for _ in gang4]
     expected = np.sum(chunks, axis=0)
 
     def work(accl, rank):
@@ -199,13 +191,13 @@ def test_xla_compressed_allreduce(xgroup4, rng):
         recv.sync_from_device()
         return recv.data.copy()
 
-    for got in run_parallel(xgroup4, work):
+    for got in run_parallel(gang4, work):
         np.testing.assert_allclose(got, expected, rtol=5e-2, atol=5e-2)
 
 
-def test_xla_reduce(xgroup4, rng):
+def test_xla_reduce(gang4, rng):
     count = 128
-    chunks = [rng.standard_normal(count).astype(np.float32) for _ in xgroup4]
+    chunks = [rng.standard_normal(count).astype(np.float32) for _ in gang4]
 
     def work(accl, rank):
         send = accl.create_buffer_from(chunks[rank])
@@ -216,11 +208,11 @@ def test_xla_reduce(xgroup4, rng):
             return recv.data.copy()
         return None
 
-    res = run_parallel(xgroup4, work)
+    res = run_parallel(gang4, work)
     np.testing.assert_allclose(res[1], np.sum(chunks, axis=0), rtol=1e-5, atol=1e-6)
 
 
-def test_xla_barrier_and_copy(xgroup4, rng):
+def test_xla_barrier_and_copy(gang4, rng):
     def work(accl, rank):
         src = accl.create_buffer_from(np.full(8, rank, np.float32))
         dst = accl.create_buffer(8, np.float32)
@@ -229,11 +221,11 @@ def test_xla_barrier_and_copy(xgroup4, rng):
         dst.sync_from_device()
         return dst.data[0]
 
-    res = run_parallel(xgroup4, work)
+    res = run_parallel(gang4, work)
     assert res == [0.0, 1.0, 2.0, 3.0]
 
 
-def test_xla_send_from_stream(xgroup4, rng):
+def test_xla_send_from_stream(gang4, rng):
     """OP0_STREAM send: operand pulled from the local stream port, then a
     normal tag-matched transfer (regression: was misrouted as stream_put)."""
     data = rng.standard_normal(32).astype(np.float32)
@@ -250,11 +242,11 @@ def test_xla_send_from_stream(xgroup4, rng):
             return buf.data.copy()
         return None
 
-    res = run_parallel(xgroup4, work)
+    res = run_parallel(gang4, work)
     np.testing.assert_array_equal(res[1], data)
 
 
-def test_xla_recv_to_stream(xgroup4, rng):
+def test_xla_recv_to_stream(gang4, rng):
     """RES_STREAM recv: matched payload lands in the local stream port
     (regression: DummyBuffer deref deadlocked both ranks)."""
     data = rng.standard_normal(48).astype(np.float32)
@@ -269,11 +261,11 @@ def test_xla_recv_to_stream(xgroup4, rng):
             return accl.stream_pop(48, np.float32, stream_id=9)
         return None
 
-    res = run_parallel(xgroup4, work)
+    res = run_parallel(gang4, work)
     np.testing.assert_array_equal(res[3], data)
 
 
-def test_xla_stream_put_subcommunicator(xgroup4, rng):
+def test_xla_stream_put_subcommunicator(gang4, rng):
     """stream_put with a comm-relative dst must reach the right WORLD rank
     (regression: delivered to the sender's own port)."""
     data = rng.standard_normal(16).astype(np.float32)
@@ -288,7 +280,7 @@ def test_xla_stream_put_subcommunicator(xgroup4, rng):
             return "sent"
         return accl.stream_pop(16, np.float32, stream_id=11)  # world rank 2
 
-    res = run_parallel(xgroup4, work)
+    res = run_parallel(gang4, work)
     assert res[1] == "sent"
     np.testing.assert_array_equal(res[2], data)
 
