@@ -15,7 +15,7 @@ origin == self -> triangular.
 
 from __future__ import annotations
 
-import numpy as np
+import math
 
 import jax
 import jax.numpy as jnp
@@ -25,10 +25,15 @@ from jax import lax
 def _fold_block(q, k_blk, v_blk, o, m, l, block_mask):
     """Online-softmax accumulation of one K/V block.
 
-    q: (B,H,Tq,D); k_blk/v_blk: (B,H,Tk,D); o: (B,H,Tq,D) running numerator;
-    m: (B,H,Tq,1) running max; l: (B,H,Tq,1) running denominator.
-    block_mask: (Tq,Tk) bool, True = attend."""
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk) / np.sqrt(q.shape[-1])
+    q: (B,H,Tq,D); k_blk/v_blk: (B,H,Tk,D); o: (B,H,Tq,D) f32 running
+    numerator; m: (B,H,Tq,1) f32 running max; l: (B,H,Tq,1) f32 running
+    denominator.  block_mask: (Tq,Tk) bool, True = attend.
+
+    Matmuls stay in the operand dtype (bf16 on the MXU fast path) with
+    f32 accumulation; the online-softmax state is f32."""
+    scores = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k_blk, preferred_element_type=jnp.float32
+    ) * (1.0 / math.sqrt(q.shape[-1]))
     scores = jnp.where(block_mask[None, None], scores, -jnp.inf)
     m_blk = scores.max(axis=-1, keepdims=True)
     m_new = jnp.maximum(m, m_blk)
@@ -37,7 +42,10 @@ def _fold_block(q, k_blk, v_blk, o, m, l, block_mask):
     p = jnp.exp(scores - m_safe)
     p = jnp.where(jnp.isneginf(scores), 0.0, p)
     alpha = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_safe))
-    o = o * alpha + jnp.einsum("bhqk,bhkd->bhqd", p, v_blk)
+    o = o * alpha + jnp.einsum(
+        "bhqk,bhkd->bhqd", p.astype(v_blk.dtype), v_blk,
+        preferred_element_type=jnp.float32,
+    )
     l = l * alpha + p.sum(axis=-1, keepdims=True)
     return o, m_new, l
 
@@ -51,9 +59,9 @@ def _ring_scan(q, k, v, axis_name, mask_for):
     idx = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % size) for i in range(size)]
 
-    o = jnp.zeros_like(q)
-    m = jnp.full(q.shape[:3] + (1,), -jnp.inf, q.dtype)
-    l = jnp.zeros(q.shape[:3] + (1,), q.dtype)
+    o = jnp.zeros_like(q, dtype=jnp.float32)
+    m = jnp.full(q.shape[:3] + (1,), -jnp.inf, jnp.float32)
+    l = jnp.zeros(q.shape[:3] + (1,), jnp.float32)
 
     o, m, l = _fold_block(q, k, v, o, m, l, mask_for(idx))
 
@@ -67,7 +75,7 @@ def _ring_scan(q, k, v, axis_name, mask_for):
 
     if size > 1:
         o, m, l, _, _ = lax.fori_loop(0, size - 1, body, (o, m, l, k, v))
-    return o / jnp.maximum(l, 1e-30)
+    return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 
 def ring_attention(
@@ -98,10 +106,13 @@ def ring_attention(
 def reference_attention(q, k, v, causal: bool = True) -> jax.Array:
     """Single-device ground truth for tests: q,k,v (B,H,T,D) full sequence."""
     T = q.shape[2]
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(q.shape[-1])
+    scores = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * (1.0 / math.sqrt(q.shape[-1]))
     if causal:
         scores = jnp.where(jnp.tril(jnp.ones((T, T), bool)), scores, -1e30)
-    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, axis=-1), v)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
 # ---------------------------------------------------------------------------

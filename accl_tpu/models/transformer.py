@@ -20,12 +20,12 @@ the CCLO.
 from __future__ import annotations
 
 import dataclasses
+import math
 from functools import partial
 from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 try:
@@ -58,13 +58,16 @@ class TransformerConfig:
     # (AR = RS + AG), but layernorm/residual compute and inter-block
     # activation memory drop by the tp factor
     seq_parallel: bool = False
-    # attention lowering: "blockwise" (default) folds (block_q x block_k)
-    # tiles with online softmax — no (T, T) score matrix ever reaches
-    # HBM (ops/attention.py); "flash" is the Pallas kernel form of the
-    # same fold (forward-only: serving/prefill); "naive" materializes
-    # scores through jax.nn.softmax (the reference-shaped baseline, and
-    # the comparison point bench.py records)
-    attention: str = "blockwise"
+    # attention lowering: "auto" (default) picks per sequence length —
+    # measured on v5e, the materialized-scores form wins below ~4K tokens
+    # (XLA fuses it well and the blockwise fold's per-tile softmax state
+    # costs more than the score traffic saves: 61% vs 46% train MFU at
+    # T=1024) while the blockwise fold is the only form that fits above
+    # it (score memory grows as T^2).  "blockwise" forces the online-
+    # softmax tile fold (no (T, T) matrix in HBM, ops/attention.py);
+    # "flash" is its Pallas kernel form (forward-only: serving/prefill);
+    # "naive" forces materialized scores through jax.nn.softmax.
+    attention: str = "auto"
 
 
 # parameter partition specs over ('dp', 'tp'): column-parallel weights shard
@@ -130,13 +133,22 @@ def _layernorm(x, scale):
     return (x - mu) * jax.lax.rsqrt(var + 1e-5) * scale
 
 
+# measured crossover on v5e (see TransformerConfig.attention): at and
+# below this sequence length the fused fold is SLOWER than XLA's fused
+# naive form; above it, score memory/traffic dominates and blockwise wins
+_AUTO_BLOCKWISE_MIN_T = 4096
+
+
 def _attention(q, k, v, impl: str = "naive", causal: bool = True):
     """Attention; q,k,v: (B, H, T, hd); ``causal=False`` is the
     bidirectional (encoder) form.
 
-    ``impl="blockwise"`` runs the fused online-softmax fold (no (T, T)
-    score matrix in HBM — the flagship's MFU lever); ``"naive"`` is the
-    materialized-scores baseline."""
+    ``impl="auto"`` resolves by sequence length (naive under
+    ``_AUTO_BLOCKWISE_MIN_T``, blockwise at/above); ``"blockwise"`` runs
+    the fused online-softmax fold (no (T, T) score matrix in HBM);
+    ``"naive"`` is the materialized-scores baseline."""
+    if impl == "auto":
+        impl = "blockwise" if q.shape[2] >= _AUTO_BLOCKWISE_MIN_T else "naive"
     if impl == "blockwise":
         from ..ops.attention import blockwise_attention
 
@@ -151,11 +163,19 @@ def _attention(q, k, v, impl: str = "naive", causal: bool = True):
     if impl != "naive":
         raise ValueError(f"unknown attention impl {impl!r}")
     T = q.shape[2]
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(q.shape[-1])
+    # matmuls stay in the input dtype (bf16 on the MXU's fast path) with
+    # f32 accumulation; softmax statistics run in f32 and the probs cast
+    # back down for the second matmul.  The scale is a PYTHON float — a
+    # NumPy scalar (np.sqrt) is strongly typed and would silently promote
+    # bf16 activations to f32 through the rest of the block.
+    scores = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * (1.0 / math.sqrt(q.shape[-1]))
     if causal:
         mask = jnp.tril(jnp.ones((T, T), bool))
         scores = jnp.where(mask, scores, -1e30)
-    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, axis=-1), v)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
 def _mlp(x, lp, tp_axis):
@@ -313,11 +333,19 @@ def _block_decode(x_t, lp, cache_k, cache_v, pos, n_heads_local, tp_axis):
     cache_k = jax.lax.dynamic_update_slice(cache_k, k, (0, 0, pos, 0))
     cache_v = jax.lax.dynamic_update_slice(cache_v, v, (0, 0, pos, 0))
     S = cache_k.shape[2]
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, cache_k) / np.sqrt(hd)
+    # f32 scores/softmax, value-dtype matmuls (see _attention): a strong
+    # NumPy sqrt scalar here once promoted the whole residual stream to
+    # f32 and broke the bf16 cache update (dynamic_update_slice dtype
+    # mismatch on the next layer)
+    scores = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, cache_k, preferred_element_type=jnp.float32
+    ) * (1.0 / math.sqrt(hd))
     mask = jnp.arange(S)[None, None, None, :] <= pos
     scores = jnp.where(mask, scores, -1e30)
     attn = jnp.einsum(
-        "bhqk,bhkd->bhqd", jax.nn.softmax(scores, axis=-1), cache_v
+        "bhqk,bhkd->bhqd",
+        jax.nn.softmax(scores, axis=-1).astype(cache_v.dtype),
+        cache_v,
     )
     attn = attn.transpose(0, 2, 1, 3).reshape(B, 1, -1)
     partial_o = attn @ lp["wo"]

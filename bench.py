@@ -192,6 +192,17 @@ def _bench_attention() -> dict:
         B, H, T, D, iters = 4, 16, 2048, 128, 20
     rng = jax.random.PRNGKey(0)
     q = jax.random.normal(rng, (B, H, T, D), jnp.bfloat16)
+    # VARIED inputs per dispatch: the device tunnel has been observed to
+    # serve byte-identical (executable, args) executions from a cache —
+    # timing loops that reuse one input report physically impossible
+    # rates (>10x chip peak).  One DISTINCT operand per timed iteration
+    # (not a short cycle) is what actually defeats it; the multiplier
+    # step is 1/128 = 2^-7, exactly representable in bf16's 8 mantissa
+    # bits, so every operand differs in CONTENT as well as buffer
+    # identity (1 + 0.001*i would round back to a handful of values)
+    qs = [q * (1.0 + (i + 1) / 128.0) for i in range(iters)]
+    for x in qs:
+        x.block_until_ready()
     flops = 4.0 * B * H * T * T * D  # qk^T + pv, causal halves both
 
     out = {}
@@ -199,8 +210,8 @@ def _bench_attention() -> dict:
         fn = jax.jit(lambda a, b, c, i=impl: _attention(a, b, c, impl=i))
         fn(q, q, q).block_until_ready()  # compile
         t0 = time.perf_counter()
-        for _ in range(iters):
-            r = fn(q, q, q)
+        for it in range(iters):
+            r = fn(qs[it], q, q)
         r.block_until_ready()
         dt = (time.perf_counter() - t0) / iters
         out[f"attn_{impl}_us"] = round(dt * 1e6, 1)
@@ -208,12 +219,13 @@ def _bench_attention() -> dict:
     return out
 
 
-def _bench_train_mfu(small: bool = False, attention: str = "blockwise") -> dict:
+def _bench_train_mfu(small: bool = False, attention: str = "auto") -> dict:
     """Flagship train-step MFU on the local devices: one dp x tp=1 sharded
     SGD step on the bf16 transformer; FLOPs from XLA's own cost analysis
-    of the compiled step.  ``attention`` picks the lowering — "blockwise"
-    (the fused online-softmax fold, default) vs "naive" (materialized
-    (T, T) scores), the with/without record VERDICT r2 item 4 asks for."""
+    of the compiled step.  ``attention`` picks the lowering — "auto" (the
+    flagship default: resolves naive at T=1024, blockwise >= 4K) vs an
+    explicit "blockwise"/"naive", the with/without record VERDICT r2
+    item 4 asks for."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh
@@ -233,11 +245,11 @@ def _bench_train_mfu(small: bool = False, attention: str = "blockwise") -> dict:
         batch, seq = 2 * ndev, 64
     else:
         # big-matmul regime: d_model 4096 keeps the MXU fed (61% MFU on
-        # v5e vs 30% at d_model 1024).  cfg.remat stays off; note the
-        # default attention="blockwise" embeds a per-q-block checkpoint,
-        # so cost-analysis FLOPs include its backward recompute (~1% at
-        # T=1024) — compare against train_mfu_naive (recompute-free)
-        # when reading the number (BENCH_NOTES caveat)
+        # v5e vs 30% at d_model 1024).  cfg.remat stays off; with an
+        # explicit attention="blockwise" the per-q-block checkpoint makes
+        # cost-analysis FLOPs include its backward recompute (~1% at
+        # T=1024) — compare against the recompute-free forms when
+        # reading the number (BENCH_NOTES caveat)
         cfg = TransformerConfig(
             vocab=32768, d_model=4096, n_heads=32, n_layers=6, d_ff=16384,
             max_seq=1024, dtype=jnp.bfloat16, attention=attention,
@@ -280,7 +292,7 @@ def _bench_train_mfu(small: bool = False, attention: str = "blockwise") -> dict:
     dt = (time.perf_counter() - t0) / iters
 
     achieved_per_dev = flops_per_dev / dt
-    suffix = "" if attention == "blockwise" else f"_{attention}"
+    suffix = "" if attention == "auto" else f"_{attention}"
     out = {f"train_tflops{suffix}": round(achieved_per_dev * ndev / 1e12, 2)}
     peak = _peak_flops(jax.devices()[0].device_kind)
     if peak is not None:
@@ -900,9 +912,12 @@ def main() -> None:
         lambda: _bench_train_mfu(small=_SMALL or not on_tpu),
     )
     if on_tpu:
+        # the with/without-fusion record: the default "auto" resolves to
+        # naive at the bench's T=1024 (its measured crossover is ~4K), so
+        # the explicit blockwise run is the comparison point
         _try(
-            extras, errors, "train_mfu_naive",
-            lambda: _bench_train_mfu(small=_SMALL, attention="naive"),
+            extras, errors, "train_mfu_blockwise",
+            lambda: _bench_train_mfu(small=_SMALL, attention="blockwise"),
         )
     _try(extras, errors, "decode_tokens_per_s", _bench_decode_throughput)
 

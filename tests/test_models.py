@@ -230,6 +230,28 @@ def test_sharded_generate_matches_single_device(cfg, mesh22):
     np.testing.assert_array_equal(got, expected)
 
 
+def test_generate_bfloat16(cfg):
+    """bf16 decode must trace and match the full-forward oracle in the
+    SAME dtype.  Regression: a strongly-typed NumPy sqrt scalar in the
+    decode block once promoted the residual stream to f32, breaking the
+    bf16 KV-cache update on the second layer (dynamic_update_slice dtype
+    mismatch) — caught only on-chip because the bench decode config is
+    the only bf16 decode user."""
+    import dataclasses
+
+    from accl_tpu.models import generate
+
+    bcfg = dataclasses.replace(cfg, dtype=jnp.bfloat16)
+    params = init_params(jax.random.PRNGKey(7), bcfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(8), (2, 5), 0, bcfg.vocab)
+    steps = 6
+
+    got = np.asarray(generate(params, prompt, steps, bcfg))
+    np.testing.assert_array_equal(
+        got, _naive_greedy(params, prompt, steps, bcfg)
+    )
+
+
 def test_seq_parallel_forward_matches(cfg, mesh22):
     """Megatron-SP: sequence-sharded activations between blocks produce
     the SAME logits as the replicated-activation form."""
