@@ -121,6 +121,12 @@ class RxBufferPool:
         return None
 
     def release(self, buf: RxBuffer) -> None:
+        if buf.index < 0:
+            # overflow-consumed message (Engine.rx_seek_overflow): never
+            # occupied a pool slot, nothing to recycle
+            buf.status = RxStatus.IDLE
+            buf.msg = None
+            return
         with self._cv:
             if self._matcher is not None:
                 self._matcher.release(buf.index)

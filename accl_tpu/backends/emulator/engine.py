@@ -37,7 +37,7 @@ from ...constants import (
 from ...request import CommandQueue, Request
 from ..base import BaseEngine, CallOptions
 from . import algorithms
-from .dataplane import RxBufferPool, StreamPorts
+from .dataplane import RxBuffer, RxBufferPool, RxStatus, StreamPorts
 from .engine_conditions import WaitCondition
 from .fabric import Endpoint, Fabric, Message, MsgType
 
@@ -127,6 +127,34 @@ class EmuEngine(BaseEngine):
                 if pred(m):
                     return self._rndzv_done.pop(i)
         return None
+
+    def rx_seek_overflow(self, comm_id: int, src: int, tag: int, seqn: int):
+        """Head-of-line escape for a fully parked pool.  When every rx slot
+        holds eager segments for OTHER signatures — e.g. a rank that isn't
+        a member of the current subcommunicator op racing ahead into the
+        next collective and fire-hosing its segments first — the segment
+        the CURRENT op needs waits in the unbounded inbox and could never
+        be parked: a deadlock the multi-process soak caught.  Consume it
+        straight from the inbox instead.  The pool stays the normal path
+        (the gate below) so slot-lifecycle accounting keeps meaning; the
+        reference's single shared link cannot reorder like this, but its
+        seek loop + retry queue serve the same role of decoupling match
+        order from arrival order (rxbuf_seek, dma_mover.cpp:587-611)."""
+        used, total = self.rx_pool.occupancy()
+        if used < total:
+            return None  # pool has room: routing will park it normally
+        msg = self.endpoint.take_matching(
+            lambda m: (
+                m.msg_type == MsgType.EAGER
+                and m.comm_id == comm_id
+                and m.src == src
+                and m.tag == tag
+                and m.seqn == seqn
+            )
+        )
+        if msg is None:
+            return None
+        return RxBuffer(-1, len(msg.payload), RxStatus.CLAIMED, msg)
 
     # -- debug dumps (ref ACCL::dump_eager_rx_buffers) -----------------------
     def dump_rx_buffers(self) -> str:

@@ -40,6 +40,12 @@ class SeekRx(WaitCondition):
     def poll(self, engine):
         seqn = self.comm.peek_inbound_seq(self.src)
         buf = engine.rx_pool.seek(self.comm.id, self.src, self.tag, seqn)
+        if buf is None:
+            # pool fully parked with other signatures: emergency inbox
+            # consume (head-of-line escape; see Engine.rx_seek_overflow)
+            buf = engine.rx_seek_overflow(
+                self.comm.id, self.src, self.tag, seqn
+            )
         if buf is not None:
             self.comm.advance_inbound_seq(self.src)
         return buf

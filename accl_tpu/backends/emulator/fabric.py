@@ -26,6 +26,7 @@ import enum
 import pickle
 import socket
 import struct
+import sys
 import threading
 from typing import Callable, Dict, List, Optional
 
@@ -163,6 +164,13 @@ class SocketFabric(Fabric):
         self._listener.listen(64)
         self._conns: Dict[str, socket.socket] = {}
         self._conn_lock = threading.Lock()
+        # peers' dials succeed the moment listen() is up — BEFORE this
+        # rank's engine exists.  Messages that land in that window must
+        # be parked and replayed at attach(), not dropped (a dropped
+        # first eager chunk wedges the whole ring: every rank times out
+        # in its first collective — caught by the multi-process soak)
+        self._attach_lock = threading.Lock()
+        self._pre_attach: list = []
         self._closing = False
         self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
         self._accept_thread.start()
@@ -170,7 +178,15 @@ class SocketFabric(Fabric):
     def attach(self, address: str, endpoint: Endpoint) -> None:
         if address != self._bind_address:
             raise ValueError("socket fabric serves exactly its bind address")
-        self._endpoint = endpoint
+        with self._attach_lock:
+            # replay the backlog while still holding the lock: a message
+            # arriving concurrently must not overtake a parked one (stream
+            # bytes are order-sensitive; deliver only appends to the
+            # endpoint inbox, so holding the lock here cannot deadlock)
+            self._endpoint = endpoint
+            backlog, self._pre_attach = self._pre_attach, []
+            for msg in backlog:
+                endpoint.deliver(msg)
 
     def _accept_loop(self) -> None:
         while not self._closing:
@@ -193,8 +209,29 @@ class SocketFabric(Fabric):
                 if body is None:
                     return
                 msg: Message = pickle.loads(body)
-                if self._endpoint is not None:
-                    self._endpoint.deliver(msg)
+                with self._attach_lock:
+                    endpoint = self._endpoint
+                    if endpoint is None:
+                        self._pre_attach.append(msg)
+                if endpoint is not None:
+                    try:
+                        endpoint.deliver(msg)
+                    except Exception:
+                        # a poisoned message must not kill this link: the
+                        # recv thread owns the peer's ONLY path in, and
+                        # its death silently drops every later message
+                        # (wedging collectives ranks downstream).  Log
+                        # loudly, keep receiving.
+                        import traceback
+
+                        print(
+                            f"[accl fabric {self._bind_address}] deliver "
+                            f"failed for {msg.msg_type!r} src={msg.src} "
+                            f"comm={msg.comm_id} seqn={msg.seqn} "
+                            f"vaddr={msg.vaddr:#x}:",
+                            file=sys.stderr,
+                        )
+                        traceback.print_exc()
         finally:
             conn.close()
 

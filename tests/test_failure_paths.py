@@ -186,3 +186,65 @@ def test_dist_rank_exit_reported_no_orphans():
         if p.name != "SyncManager-1"
     ]
     assert leftover == [], [p.name for p in leftover]
+
+
+def test_subcomm_recv_with_fully_parked_pool():
+    """Head-of-line regression (caught by the multi-process soak): a rank
+    that is NOT a member of the current subcommunicator op can race ahead
+    into the next collective and fill the receiver's ENTIRE eager rx pool
+    with parked segments; the subcommunicator segment then waits in the
+    inbox with no slot ever becoming free — a deadlock unless the seek
+    path can consume straight from the inbox (the native engine's
+    overflow-queue match has the same role, ops.cpp seek_rx)."""
+    group = emulated_group(3, rx_buffer_count=4)
+    a0, a1, a2 = group
+    try:
+        for a in group:
+            a.set_timeout(20.0)
+        # rank 2 parks 4 x 4 KiB eager segments at rank 0 (no recv posted):
+        # the pool is now 100% occupied by {world comm, src 2} signatures
+        filler = a2.create_buffer_from(
+            np.arange(4096, dtype=np.float32)  # 16 KiB, eager
+        )
+        a2.send(filler, 4096, dst=0, tag=7)
+        deadline = __import__("time").monotonic() + 10
+        while a0.engine.rx_pool.occupancy()[0] < 4:
+            if __import__("time").monotonic() > deadline:
+                raise AssertionError("filler segments never parked")
+            __import__("time").sleep(0.01)
+
+        # subcommunicator op between ranks 0 and 1 must still complete
+        comm0 = a0.create_communicator([0, 1])
+        comm1 = a1.create_communicator([0, 1])
+        assert a2.create_communicator([0, 1]) is None
+
+        payload = np.full(8, 5.0, np.float32)
+        err = []
+
+        def sender():
+            try:
+                sb = a1.create_buffer_from(payload)
+                a1.send(sb, 8, dst=0, tag=9, comm=comm1)
+            except Exception as e:  # pragma: no cover - surfaced below
+                err.append(e)
+
+        t = threading.Thread(target=sender, daemon=True)
+        t.start()
+        rb = a0.create_buffer(8, np.float32)
+        a0.recv(rb, 8, src=1, tag=9, comm=comm0)  # deadlocked before fix
+        t.join(10)
+        assert not err
+        rb.sync_from_device()
+        np.testing.assert_array_equal(rb.data, payload)
+
+        # drain the filler; every slot must return to IDLE (no leaks)
+        fb = a0.create_buffer(4096, np.float32)
+        a0.recv(fb, 4096, src=2, tag=7)
+        fb.sync_from_device()
+        np.testing.assert_array_equal(
+            fb.data, np.arange(4096, dtype=np.float32)
+        )
+        assert a0.engine.rx_pool.occupancy()[0] == 0
+    finally:
+        for a in group:
+            a.deinit()

@@ -28,6 +28,11 @@ def _soak_worker(accl, rank, world, seconds, seed):
 
     import numpy as np
 
+    # the soak targets slot lifecycle/leaks, not latency: on a starved
+    # box (CI hosts here expose ONE core for 4 rank processes) the
+    # default 30 s per-call deadline can fire on an unlucky schedule —
+    # raise it so only a real hang, not scheduling noise, fails the soak
+    accl.set_timeout(180.0)
     rng = np.random.default_rng(seed)  # SHARED schedule: same on all ranks
     deadline = time.monotonic() + seconds
     iters = 0
