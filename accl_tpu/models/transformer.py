@@ -46,6 +46,13 @@ class TransformerConfig:
     d_ff: int = 256
     max_seq: int = 128
     dtype: jnp.dtype = jnp.float32
+    # grouped-query attention: number of K/V heads (None = n_heads, the
+    # classic MHA form; 1 = MQA).  Query heads share kv head h // G with
+    # G = n_heads // n_kv_heads.  Shrinks the decode KV cache (the
+    # serving memory ceiling) and the wk/wv params by the same factor;
+    # under tp, n_kv_heads must stay divisible by the tp size so every
+    # chip owns whole kv heads.
+    n_kv_heads: Optional[int] = None
     # rematerialize each block on the backward pass (jax.checkpoint):
     # trades ~30% more FLOPs in exchange for activation memory that no
     # longer scales with n_layers — the standard TPU recipe for fitting
@@ -58,16 +65,27 @@ class TransformerConfig:
     # (AR = RS + AG), but layernorm/residual compute and inter-block
     # activation memory drop by the tp factor
     seq_parallel: bool = False
-    # attention lowering: "auto" (default) picks per sequence length —
-    # measured on v5e, the materialized-scores form wins below ~4K tokens
-    # (XLA fuses it well and the blockwise fold's per-tile softmax state
-    # costs more than the score traffic saves: 61% vs 46% train MFU at
-    # T=1024) while the blockwise fold is the only form that fits above
-    # it (score memory grows as T^2).  "blockwise" forces the online-
-    # softmax tile fold (no (T, T) matrix in HBM, ops/attention.py);
-    # "flash" is its Pallas kernel form (forward-only: serving/prefill);
-    # "naive" forces materialized scores through jax.nn.softmax.
+    # attention lowering: "auto" (default) picks per sequence length and
+    # backend — measured on v5e, the materialized-scores form wins below
+    # ~4K tokens (XLA fuses it well and a fused fold's per-tile softmax
+    # state costs more than the score traffic saves: 61% vs 46% train MFU
+    # at T=1024) while a fused form is the only one that fits above it
+    # (score memory grows as T^2); at/above the crossover auto picks the
+    # Pallas "flash" kernel on TPU (fwd 4368 µs vs blockwise's 8498 at
+    # T=2048) and "blockwise" elsewhere.  "blockwise" forces the XLA
+    # online-softmax tile fold (no (T, T) matrix in HBM, ops/attention.py);
+    # "flash" forces the Pallas kernel — trainable via its custom_vjp
+    # backward kernels (ops/pallas/attention.py); "naive" forces
+    # materialized scores through jax.nn.softmax.
     attention: str = "auto"
+
+    def kv_heads(self) -> int:
+        n_kv = self.n_heads if self.n_kv_heads is None else self.n_kv_heads
+        if n_kv <= 0 or self.n_heads % n_kv:
+            raise ValueError(
+                f"n_kv_heads ({n_kv}) must divide n_heads ({self.n_heads})"
+            )
+        return n_kv
 
 
 # parameter partition specs over ('dp', 'tp'): column-parallel weights shard
@@ -100,6 +118,7 @@ def init_params(key, cfg: TransformerConfig) -> Dict:
         "ln_f": jnp.ones((cfg.d_model,), cfg.dtype),
         "layers": [],
     }
+    d_kv = cfg.kv_heads() * (cfg.d_model // cfg.n_heads)
     for i in range(cfg.n_layers):
         kk = k[2 + 4 * i : 6 + 4 * i]
         params["layers"].append(
@@ -107,11 +126,11 @@ def init_params(key, cfg: TransformerConfig) -> Dict:
                 "wq": jax.random.normal(kk[0], (cfg.d_model, cfg.d_model), cfg.dtype)
                 * scale,
                 "wk": jax.random.normal(
-                    jax.random.fold_in(kk[0], 1), (cfg.d_model, cfg.d_model), cfg.dtype
+                    jax.random.fold_in(kk[0], 1), (cfg.d_model, d_kv), cfg.dtype
                 )
                 * scale,
                 "wv": jax.random.normal(
-                    jax.random.fold_in(kk[0], 2), (cfg.d_model, cfg.d_model), cfg.dtype
+                    jax.random.fold_in(kk[0], 2), (cfg.d_model, d_kv), cfg.dtype
                 )
                 * scale,
                 "wo": jax.random.normal(kk[1], (cfg.d_model, cfg.d_model), cfg.dtype)
@@ -133,49 +152,70 @@ def _layernorm(x, scale):
     return (x - mu) * jax.lax.rsqrt(var + 1e-5) * scale
 
 
-# measured crossover on v5e (see TransformerConfig.attention): at and
-# below this sequence length the fused fold is SLOWER than XLA's fused
-# naive form; above it, score memory/traffic dominates and blockwise wins
-_AUTO_BLOCKWISE_MIN_T = 4096
+# measured crossover on v5e (see TransformerConfig.attention): BELOW this
+# sequence length a fused fold is slower than XLA's fused naive form, so
+# auto resolves to naive; at/above it score memory/traffic dominates and
+# auto picks a fused form (the Pallas flash kernel on TPU while it fits
+# VMEM, the XLA blockwise fold otherwise)
+_AUTO_FUSED_MIN_T = 4096
+# flash holds whole K/V (and whole Q/dO in its backward kernels) in VMEM
+# per batch-head: past this length its tiles outgrow the ~16 MB budget,
+# so auto falls back to the streaming XLA fold
+_AUTO_FLASH_MAX_T = 8192
 
 
 def _attention(q, k, v, impl: str = "naive", causal: bool = True):
     """Attention; q,k,v: (B, H, T, hd); ``causal=False`` is the
     bidirectional (encoder) form.
 
-    ``impl="auto"`` resolves by sequence length (naive under
-    ``_AUTO_BLOCKWISE_MIN_T``, blockwise at/above); ``"blockwise"`` runs
-    the fused online-softmax fold (no (T, T) score matrix in HBM);
-    ``"naive"`` is the materialized-scores baseline."""
+    ``impl="auto"`` resolves by sequence length and backend (naive under
+    ``_AUTO_FUSED_MIN_T``; at/above it the Pallas flash kernel on
+    TPU while it fits VMEM — T <= ``_AUTO_FLASH_MAX_T`` — and the XLA
+    blockwise fold elsewhere); ``"blockwise"`` runs the fused
+    online-softmax fold (no (T, T) score matrix in HBM); ``"naive"`` is
+    the materialized-scores baseline."""
     if impl == "auto":
-        impl = "blockwise" if q.shape[2] >= _AUTO_BLOCKWISE_MIN_T else "naive"
+        if q.shape[2] < _AUTO_FUSED_MIN_T:
+            impl = "naive"
+        elif (
+            jax.default_backend() == "tpu"
+            and q.shape[2] <= _AUTO_FLASH_MAX_T
+        ):
+            impl = "flash"  # Mosaic-compiled; trainable via custom_vjp
+        else:
+            impl = "blockwise"
     if impl == "blockwise":
         from ..ops.attention import blockwise_attention
 
         return blockwise_attention(q, k, v, causal=causal)
     if impl == "flash":
-        # the Pallas kernel owns the fold schedule (forward-only: use
-        # for serving/prefill; train with "blockwise", its autodiffable
-        # XLA twin)
+        # the Pallas kernel owns the fold schedule; its custom_vjp
+        # backward kernels make it trainable (rebuild probability tiles
+        # from the saved logsumexp — no (T, T) residual)
         from ..ops.pallas.attention import flash_attention
 
         return flash_attention(q, k, v, causal=causal)
     if impl != "naive":
         raise ValueError(f"unknown attention impl {impl!r}")
-    T = q.shape[2]
+    B, H, T, hd = q.shape
+    Hkv = k.shape[1]
+    # grouped-query attention folds the group into the einsum (each kv
+    # head broadcasts across its G query heads; k/v are never expanded)
+    qg = q.reshape(B, Hkv, H // Hkv, T, hd)
     # matmuls stay in the input dtype (bf16 on the MXU's fast path) with
     # f32 accumulation; softmax statistics run in f32 and the probs cast
     # back down for the second matmul.  The scale is a PYTHON float — a
     # NumPy scalar (np.sqrt) is strongly typed and would silently promote
     # bf16 activations to f32 through the rest of the block.
     scores = jnp.einsum(
-        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
-    ) * (1.0 / math.sqrt(q.shape[-1]))
+        "bhgqd,bhkd->bhgqk", qg, k, preferred_element_type=jnp.float32
+    ) * (1.0 / math.sqrt(hd))
     if causal:
         mask = jnp.tril(jnp.ones((T, T), bool))
         scores = jnp.where(mask, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
-    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    out = jnp.einsum("bhgqk,bhkd->bhgqd", probs, v)
+    return out.reshape(B, H, T, hd)
 
 
 def _mlp(x, lp, tp_axis):
@@ -191,12 +231,17 @@ def _mlp(x, lp, tp_axis):
 def _attn_partial(h, lp, n_heads_local, attn_impl="naive", causal=True):
     """Column-parallel attention on a full-sequence activation: returns
     the row-parallel PARTIAL output (pre-reduction) and the (k, v) head
-    tensors (B, H_local, T, hd) for KV-cache prefill."""
+    tensors (B, Hkv_local, T, hd) for KV-cache prefill.  The kv head
+    count comes from the wk shard's width (GQA: fewer kv heads than q
+    heads; every attention lowering groups q heads onto kv head h//G)."""
     B, T, _ = h.shape
     q, k, v = h @ lp["wq"], h @ lp["wk"], h @ lp["wv"]  # column-parallel
     hd = q.shape[-1] // n_heads_local
-    reshape = lambda t: t.reshape(B, T, n_heads_local, hd).transpose(0, 2, 1, 3)
-    q, k, v = reshape(q), reshape(k), reshape(v)
+    n_kv_local = k.shape[-1] // hd
+    heads = lambda t, n: t.reshape(B, T, n, hd).transpose(0, 2, 1, 3)
+    q, k, v = (
+        heads(q, n_heads_local), heads(k, n_kv_local), heads(v, n_kv_local)
+    )
     attn = _attention(q, k, v, impl=attn_impl, causal=causal)
     attn = attn.transpose(0, 2, 1, 3).reshape(B, T, -1)
     return attn @ lp["wo"], (k, v)
@@ -264,6 +309,11 @@ def _enter_block_layout(x, cfg, tp_axis, tp_size, return_kv=False,
     from jax import lax
 
     heads_local = cfg.n_heads // tp_size
+    if tp_size > 1 and cfg.kv_heads() % tp_size:
+        raise ValueError(
+            f"n_kv_heads ({cfg.kv_heads()}) must be divisible by tp "
+            f"({tp_size}) so every chip owns whole kv heads"
+        )
     sp = cfg.seq_parallel and tp_axis is not None and tp_size > 1
     kw = dict(
         n_heads_local=heads_local, tp_axis=tp_axis,
@@ -323,30 +373,39 @@ def _block_decode(x_t, lp, cache_k, cache_v, pos, n_heads_local, tp_axis):
     """One block for a single decode position: write this step's k/v into
     the cache at ``pos`` (dynamic_update_slice keeps shapes static under
     jit/scan), attend over positions <= pos, same tp collectives as the
-    training block.  Returns (x_out, cache_k, cache_v)."""
+    training block.  Returns (x_out, cache_k, cache_v).
+
+    The cache is (B, Hkv_local, S, hd) — under GQA it carries only the kv
+    heads, the factor-G serving-memory saving that motivates GQA; query
+    heads group onto kv head h // G in the einsum."""
     B, _, D = x_t.shape
     h = _layernorm(x_t, lp["ln1"])
     q, k, v = h @ lp["wq"], h @ lp["wk"], h @ lp["wv"]
     hd = q.shape[-1] // n_heads_local
-    rs = lambda t: t.reshape(B, 1, n_heads_local, hd).transpose(0, 2, 1, 3)
-    q, k, v = rs(q), rs(k), rs(v)  # (B, Hl, 1, hd)
+    n_kv_local = k.shape[-1] // hd
+    rs = lambda t, n: t.reshape(B, 1, n, hd).transpose(0, 2, 1, 3)
+    q = rs(q, n_heads_local)  # (B, Hl, 1, hd)
+    k, v = rs(k, n_kv_local), rs(v, n_kv_local)  # (B, Hkv_l, 1, hd)
     cache_k = jax.lax.dynamic_update_slice(cache_k, k, (0, 0, pos, 0))
     cache_v = jax.lax.dynamic_update_slice(cache_v, v, (0, 0, pos, 0))
     S = cache_k.shape[2]
+    qg = q.reshape(B, n_kv_local, n_heads_local // n_kv_local, 1, hd)
     # f32 scores/softmax, value-dtype matmuls (see _attention): a strong
     # NumPy sqrt scalar here once promoted the whole residual stream to
     # f32 and broke the bf16 cache update (dynamic_update_slice dtype
     # mismatch on the next layer)
     scores = jnp.einsum(
-        "bhqd,bhkd->bhqk", q, cache_k, preferred_element_type=jnp.float32
+        "bhgqd,bhkd->bhgqk", qg, cache_k,
+        preferred_element_type=jnp.float32,
     ) * (1.0 / math.sqrt(hd))
-    mask = jnp.arange(S)[None, None, None, :] <= pos
+    mask = jnp.arange(S)[None, None, None, None, :] <= pos
     scores = jnp.where(mask, scores, -1e30)
     attn = jnp.einsum(
-        "bhqk,bhkd->bhqd",
+        "bhgqk,bhkd->bhgqd",
         jax.nn.softmax(scores, axis=-1).astype(cache_v.dtype),
         cache_v,
     )
+    attn = attn.reshape(B, n_heads_local, 1, hd)
     attn = attn.transpose(0, 2, 1, 3).reshape(B, 1, -1)
     partial_o = attn @ lp["wo"]
     if tp_axis is not None:
@@ -365,7 +424,8 @@ def prefill(
 ):
     """Run the prompt through the model once, building the KV cache.
     Returns (last-position logits, caches) where caches is a list of
-    (k, v) arrays (B, H_local, cache_len, hd).  ``cache_len`` defaults to
+    (k, v) arrays (B, Hkv_local, cache_len, hd) — kv heads only under
+    GQA, the factor-G cache saving.  ``cache_len`` defaults to
     ``cfg.max_seq``; size it to the exact prompt+steps length to avoid
     attending over (and masking) dead cache positions.
 
@@ -379,7 +439,7 @@ def prefill(
     B, T = tokens.shape
     S = cfg.max_seq if cache_len is None else int(cache_len)
     x = params["embed"][tokens] + params["pos"][:T]
-    heads_local = cfg.n_heads // tp_size
+    kv_local = cfg.kv_heads() // tp_size  # GQA: cache holds kv heads only
     hd = cfg.d_model // cfg.n_heads
     x, block_kv, sp = _enter_block_layout(
         x, cfg, tp_axis, tp_size, return_kv=True
@@ -387,7 +447,7 @@ def prefill(
     caches = []
     for lp in params["layers"]:
         x, (k, v) = block_kv(x, lp)
-        shape = (B, heads_local, S, hd)
+        shape = (B, kv_local, S, hd)
         ck = jnp.zeros(shape, x.dtype).at[:, :, :T].set(k)
         cv = jnp.zeros(shape, x.dtype).at[:, :, :T].set(v)
         caches.append((ck, cv))
@@ -569,15 +629,14 @@ def make_sharded_forward(cfg: TransformerConfig, mesh: Mesh):
 
 
 def _reject_untrainable_attention(cfg) -> None:
-    """Train-step builders share this guard: the Pallas flash kernel is
-    forward-only, and the failure must be a clear up-front rejection,
-    not an opaque autodiff transpose error."""
-    if getattr(cfg, "attention", None) == "flash":
-        raise ValueError(
-            'attention="flash" is forward-only (the Pallas kernel has no '
-            'transpose rule); train with "blockwise", its differentiable '
-            "XLA twin"
-        )
+    """Historical guard shared by the train-step builders: the Pallas
+    flash kernel used to be forward-only.  Its custom_vjp backward
+    kernels (ops/pallas/attention.py) made every lowering trainable, so
+    this now only rejects unknown names up front (instead of deep inside
+    a traced forward)."""
+    impl = getattr(cfg, "attention", None)
+    if impl not in (None, "auto", "naive", "blockwise", "flash"):
+        raise ValueError(f"unknown attention impl {impl!r}")
 
 
 def make_sharded_train_step(cfg: TransformerConfig, mesh: Mesh, lr: float = 1e-2):

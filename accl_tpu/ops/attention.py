@@ -108,8 +108,26 @@ def blockwise_attention(
     matches the naive softmax form to float tolerance.
 
     Block sizes clamp to the (padded) sequence length; T is padded to a
-    block multiple internally and the pad keys are masked out."""
+    block multiple internally and the pad keys are masked out.
+
+    Grouped-query attention: k/v may carry fewer heads (``H % Hkv == 0``);
+    they are expanded logically (broadcast per group) before the fold —
+    the XLA form pays the expansion in activation reads, the Pallas flash
+    kernel's index-map sharing avoids it."""
     B, H, T, Dh = q.shape
+    Hkv = k.shape[1]
+    if Hkv != H:
+        if Hkv <= 0 or H % Hkv:
+            raise ValueError(
+                f"q heads ({H}) must be a multiple of kv heads ({Hkv})"
+            )
+        G = H // Hkv
+        k = jnp.broadcast_to(
+            k[:, :, None], (B, Hkv, G, T, Dh)
+        ).reshape(B, H, T, Dh)
+        v = jnp.broadcast_to(
+            v[:, :, None], (B, Hkv, G, T, Dh)
+        ).reshape(B, H, T, Dh)
     bq = min(block_q, T) if T > 0 else block_q
     bk = min(block_k, T) if T > 0 else block_k
     pad = (-T) % max(bq, bk)

@@ -19,6 +19,8 @@ scratch in float32 regardless of input dtype.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -242,17 +244,21 @@ def ring_attention(
 
 
 # ---------------------------------------------------------------------------
-# single-chip flash attention (no ring): the local fused forward
+# single-chip flash attention (no ring): fused forward + custom backward
 # ---------------------------------------------------------------------------
 
 
-def _flash_kernel(causal, scale, bq, bk, nkb, t_real):
+def _flash_kernel(causal, scale, bq, bk, nkb, t_real, with_lse=False):
     """One grid step computes one (bq, D) output block: fold the visiting
     k/v blocks with online softmax.  Outputs are written exactly once per
     grid step (blocked o spec) — no grid-revisited outputs, the construct
-    this box's tunnel cannot tolerate."""
+    this box's tunnel cannot tolerate.
 
-    def kernel(q_ref, k_ref, v_ref, o_ref):
+    ``with_lse`` adds a per-row logsumexp output (the softmax normalizer,
+    ``m + log l``) — the residual the backward kernels need to rebuild
+    the probabilities tile by tile without ever storing them."""
+
+    def kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse):
         iq = pl.program_id(1)
         # operands stay in the input dtype (bf16 MXU fast path); the
         # scale folds into the f32 scores, the softmax state is f32
@@ -294,8 +300,306 @@ def _flash_kernel(causal, scale, bq, bk, nkb, t_real):
         hi = jnp.minimum(iq + 1, nkb) if causal else nkb
         m, l, acc = lax.fori_loop(0, hi, fold, init)
         o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        if with_lse:
+            # (bq, 1) sublane vector -> (bq,) lane vector: an explicit
+            # relayout Mosaic supports; rows beyond t_real carry ~-1e30
+            # and are masked out by the backward kernels
+            maybe_lse[0][0, 0, 0] = (
+                m + jnp.log(jnp.maximum(l, 1e-30))
+            ).reshape(bq)
 
     return kernel
+
+
+def _flash_block(T: int, dtype, block: int) -> int:
+    """Block height for the flash kernels: a sublane multiple (f32 8 /
+    bf16 16 / int8 32 — Mosaic rejects smaller VMEM tiles); short
+    sequences round T UP to the sublane grid and pad, they don't shrink
+    the tile below it.  Forward and backward must agree on this."""
+    from ._common import sublanes_for
+
+    sub = sublanes_for(dtype)
+    return min(max(block // sub * sub, sub), (T + sub - 1) // sub * sub)
+
+
+def _flash_struct(shape, dtype, *ops):
+    """ShapeDtypeStruct inheriting the union of the operands' varying
+    mesh axes — required for pallas_call outputs inside a
+    ``check_vma=True`` shard_map (the sharded train steps)."""
+    vma = frozenset().union(*(jax.typeof(o).vma for o in ops))
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _flash_kv_map(H: int, Hkv: int, blocked: bool = False):
+    """Grid index -> flattened K/V head.  For grouped-query attention
+    (Hkv < H) q head ``h`` reads kv head ``h // G`` — sharing happens in
+    the BlockSpec index map, so the smaller K/V never get materialized
+    at H heads anywhere (the whole point of GQA's cache savings).
+    ``blocked=True`` returns the (head, block-i, 0) form for specs whose
+    second dim follows the grid's block index."""
+    if H == Hkv:
+        head = lambda bh: bh  # noqa: E731
+    else:
+        G = H // Hkv
+        head = lambda bh: (bh // H) * Hkv + (bh % H) // G  # noqa: E731
+    if blocked:
+        return lambda bh, i: (head(bh), i, 0)
+    return lambda bh, i: (head(bh), 0, 0)
+
+
+def _flash_fwd_impl(q, k, v, causal, block, interpret, with_lse):
+    B, H, T, D = q.shape
+    Hkv = k.shape[1]
+    scale = 1.0 / (D ** 0.5)
+    b = _flash_block(T, q.dtype, block)
+    padT = (-T) % b
+    padD = (-D) % LANES
+    if padT or padD:
+        padding = [(0, 0), (0, 0), (0, padT), (0, padD)]
+        q, k, v = (jnp.pad(a, padding) for a in (q, k, v))
+    Tp, Dp = T + padT, D + padD
+    nq = nkb = Tp // b
+
+    qf = q.reshape(B * H, Tp, Dp)
+    kf = k.reshape(B * Hkv, Tp, Dp)
+    vf = v.reshape(B * Hkv, Tp, Dp)
+    kv_map = _flash_kv_map(H, Hkv)
+
+    out_shape = [_flash_struct((B * H, Tp, Dp), q.dtype, q, k, v)]
+    out_specs = [
+        pl.BlockSpec((1, b, Dp), lambda bh, iq: (bh, iq, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    if with_lse:
+        # row-stat layout: (B*H, nq, 1, b) so the block (1, 1, 1, b) has
+        # its last two dims EQUAL to the array's — the only tile shape
+        # Mosaic accepts for a lane vector shorter than 128
+        out_shape.append(
+            _flash_struct((B * H, nq, 1, b), jnp.float32, q, k, v)
+        )
+        out_specs.append(
+            pl.BlockSpec((1, 1, 1, b), lambda bh, iq: (bh, iq, 0, 0),
+                         memory_space=pltpu.VMEM)
+        )
+
+    res = pl.pallas_call(
+        _flash_kernel(causal, scale, b, b, nkb, T, with_lse=with_lse),
+        grid=(B * H, nq),
+        out_shape=out_shape,
+        in_specs=[
+            pl.BlockSpec((1, b, Dp), lambda bh, iq: (bh, iq, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Tp, Dp), kv_map, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Tp, Dp), kv_map, memory_space=pltpu.VMEM),
+        ],
+        out_specs=out_specs,
+        interpret=default_interpret(interpret),
+    )(qf, kf, vf)
+    out = res[0].reshape(B, H, Tp, Dp)[:, :, :T, :D]
+    if not with_lse:
+        return out, None
+    lse = res[1].reshape(B, H, Tp)[:, :, :T]  # (B*H, nq, 1, b) -> rows
+    return out, lse
+
+
+def _flash_bwd_dq_kernel(causal, scale, bq, bk, nkb, t_real):
+    """dQ: grid step (bh, iq) owns one (bq, D) dq block, folding the k/v
+    blocks it attended to.  Probabilities are rebuilt from the saved
+    logsumexp (p = exp(s - lse)), never stored — the same FLOPs-for-HBM
+    trade the forward makes [FlashAttention-2 backward split: the dq pass
+    grids over q blocks so every output is written exactly once]."""
+
+    def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref):
+        iq = pl.program_id(1)
+        q = q_ref[0]
+        do = do_ref[0]
+        # (bq,) lane vectors -> (bq, 1) sublane vectors for row broadcast
+        lse = lse_ref[0, 0, 0].reshape(bq, 1)
+        delta = dl_ref[0, 0, 0].reshape(bq, 1)
+        q_pos = iq * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+
+        def fold(j, acc):
+            kb = k_ref[0, pl.ds(j * bk, bk), :]
+            vb = v_ref[0, pl.ds(j * bk, bk), :]
+            s = lax.dot_general(
+                q, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale
+            k_pos = j * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            mask = (k_pos < t_real) & (q_pos < t_real)
+            if causal:
+                mask &= q_pos >= k_pos
+            # explicit where: padded q rows have lse ~ -1e30, where a bare
+            # exp(s - lse) would resurrect them as p = 1
+            p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+            dp = lax.dot_general(
+                do, vb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - delta) * scale
+            return acc + lax.dot_general(
+                ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+        hi = jnp.minimum(iq + 1, nkb) if causal else nkb
+        acc = lax.fori_loop(
+            0, hi, fold, jnp.zeros((bq, q.shape[-1]), jnp.float32)
+        )
+        dq_ref[0] = acc.astype(dq_ref.dtype)
+
+    return kernel
+
+
+def _flash_bwd_dkv_kernel(causal, scale, bq, bk, nq, t_real):
+    """dK/dV: grid step (bh, jk) owns one (bk, D) dk + dv block pair,
+    folding the q blocks that attended to it (causal: q blocks jk..nq-1
+    — a dynamic lower bound, the mirror of the forward's early exit)."""
+
+    def kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dl_ref,
+               dk_ref, dv_ref):
+        jk = pl.program_id(1)
+        kb = k_ref[0]
+        vb = v_ref[0]
+        D = kb.shape[-1]
+        k_pos = jk * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+
+        def fold(i, carry):
+            dk, dv = carry
+            qb = q_ref[0, pl.ds(i * bq, bq), :]
+            dob = do_ref[0, pl.ds(i * bq, bq), :]
+            lse = lse_ref[0, i, 0].reshape(bq, 1)
+            delta = dl_ref[0, i, 0].reshape(bq, 1)
+            s = lax.dot_general(
+                qb, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale
+            q_pos = i * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            mask = (k_pos < t_real) & (q_pos < t_real)
+            if causal:
+                mask &= q_pos >= k_pos
+            p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+            dv = dv + lax.dot_general(
+                p.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dp = lax.dot_general(
+                dob, vb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - delta) * scale
+            dk = dk + lax.dot_general(
+                ds.astype(qb.dtype), qb, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return dk, dv
+
+        lo = jnp.minimum(jk, nq) if causal else 0  # bq == bk
+        dk, dv = lax.fori_loop(
+            lo, nq, fold,
+            (jnp.zeros((bk, D), jnp.float32),
+             jnp.zeros((bk, D), jnp.float32)),
+        )
+        dk_ref[0] = dk.astype(dk_ref.dtype)
+        dv_ref[0] = dv.astype(dv_ref.dtype)
+
+    return kernel
+
+
+def _flash_bwd_impl(q, k, v, o, lse, g, causal, block, interpret):
+    B, H, T, D = q.shape
+    Hkv = k.shape[1]
+    G = H // Hkv
+    scale = 1.0 / (D ** 0.5)
+    b = _flash_block(T, q.dtype, block)
+    padT = (-T) % b
+    padD = (-D) % LANES
+    # delta = rowsum(dO * O): the softmax-transpose correction, a cheap
+    # fused elementwise+reduce XLA does well — no kernel needed
+    delta = (g.astype(jnp.float32) * o.astype(jnp.float32)).sum(-1)
+    if padT or padD:
+        padding = [(0, 0), (0, 0), (0, padT), (0, padD)]
+        q, k, v, g = (jnp.pad(a, padding) for a in (q, k, v, g))
+    if padT:
+        rows = [(0, 0), (0, 0), (0, padT)]
+        lse = jnp.pad(lse, rows, constant_values=_NEG)
+        delta = jnp.pad(delta, rows)
+    Tp, Dp = T + padT, D + padD
+    nq = nkb = Tp // b
+
+    qf = q.reshape(B * H, Tp, Dp)
+    kf = k.reshape(B * Hkv, Tp, Dp)
+    vf = v.reshape(B * Hkv, Tp, Dp)
+    dof = g.reshape(B * H, Tp, Dp)
+    # row-stat layout (see _flash_fwd_impl): block last-two dims == array
+    lsef = lse.reshape(B * H, nq, 1, b)
+    dlf = delta.reshape(B * H, nq, 1, b)
+    kv_whole = pl.BlockSpec((1, Tp, Dp), _flash_kv_map(H, Hkv),
+                            memory_space=pltpu.VMEM)
+    kv_blk = pl.BlockSpec((1, b, Dp), _flash_kv_map(H, Hkv, blocked=True),
+                          memory_space=pltpu.VMEM)
+
+    blk = pl.BlockSpec((1, b, Dp), lambda bh, i: (bh, i, 0),
+                       memory_space=pltpu.VMEM)
+    whole = pl.BlockSpec((1, Tp, Dp), lambda bh, i: (bh, 0, 0),
+                         memory_space=pltpu.VMEM)
+    rows_blk = pl.BlockSpec((1, 1, 1, b), lambda bh, i: (bh, i, 0, 0),
+                            memory_space=pltpu.VMEM)
+    rows_whole = pl.BlockSpec((1, nq, 1, b), lambda bh, i: (bh, 0, 0, 0),
+                              memory_space=pltpu.VMEM)
+
+    grad_struct = _flash_struct((B * H, Tp, Dp), q.dtype, q, k, v, g)
+    dq = pl.pallas_call(
+        _flash_bwd_dq_kernel(causal, scale, b, b, nkb, T),
+        grid=(B * H, nq),
+        out_shape=grad_struct,
+        in_specs=[blk, kv_whole, kv_whole, blk, rows_blk, rows_blk],
+        out_specs=blk,
+        interpret=default_interpret(interpret),
+    )(qf, kf, vf, dof, lsef, dlf)
+
+    # dk/dv come out PER Q-HEAD (every output block still written exactly
+    # once — adding a group grid dim would revisit them); the group sum
+    # is one cheap XLA reduction after the kernel
+    dk, dv = pl.pallas_call(
+        _flash_bwd_dkv_kernel(causal, scale, b, b, nq, T),
+        grid=(B * H, nkb),
+        out_shape=[grad_struct] * 2,
+        in_specs=[kv_blk, kv_blk, whole, whole, rows_whole, rows_whole],
+        out_specs=[blk, blk],
+        interpret=default_interpret(interpret),
+    )(kf, vf, qf, dof, lsef, dlf)
+
+    dq = dq.reshape(B, H, Tp, Dp)[:, :, :T, :D]
+    def group_sum(a):
+        a = a.reshape(B, Hkv, G, Tp, Dp)[:, :, :, :T, :D]
+        if G == 1:
+            return a[:, :, 0]
+        return a.astype(jnp.float32).sum(2).astype(k.dtype)
+    return dq, group_sum(dk), group_sum(dv)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_vjp(q, k, v, causal, block, interpret):
+    out, _ = _flash_fwd_impl(q, k, v, causal, block, interpret,
+                             with_lse=False)
+    return out
+
+
+def _flash_vjp_fwd(q, k, v, causal, block, interpret):
+    out, lse = _flash_fwd_impl(q, k, v, causal, block, interpret,
+                               with_lse=True)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_vjp_bwd(causal, block, interpret, res, g):
+    q, k, v, o, lse = res
+    return _flash_bwd_impl(q, k, v, o, lse, g, causal, block, interpret)
+
+
+_flash_vjp.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
 def flash_attention(
@@ -309,59 +613,29 @@ def flash_attention(
 ) -> jax.Array:
     """Local (single-chip) fused attention: ``(B, H, T, D) -> same`` with
     the (T, T) score matrix never leaving VMEM — the kernel-owned form of
-    ``ops.attention.blockwise_attention`` (which is the trainable XLA
-    fold; this one hand-owns the schedule like the ring kernels own
-    theirs).  Forward-only: serving/prefill paths; training uses the
-    differentiable XLA form.
+    ``ops.attention.blockwise_attention``, and like it fully trainable:
+    a ``custom_vjp`` pairs the forward (which saves only o + per-row
+    logsumexp) with two backward Pallas kernels (dq; dk+dv) that rebuild
+    the probability tiles on the fly.  Every output block is written
+    exactly once per grid step across all three kernels (no
+    grid-revisited outputs, the construct this box's tunnel cannot
+    tolerate).
+
+    Grouped-query attention comes free: pass k/v with FEWER heads
+    (``(B, Hkv, T, D)``, ``H % Hkv == 0``) and q head ``h`` reads kv head
+    ``h // (H // Hkv)`` through the BlockSpec index map — the smaller K/V
+    are never expanded to H heads anywhere (fwd or bwd).
 
     K/V live whole in VMEM per (batch*head) grid step — sized for
-    serving sequence lengths (T <= ~8K at 128 lanes); the ring kernel
-    covers longer sequences across chips."""
-    from ._common import sublanes_for
-
+    serving/training sequence lengths (T <= ~8K at 128 lanes); the ring
+    kernel covers longer sequences across chips."""
+    if k.shape != v.shape:
+        raise ValueError(f"k/v shapes must match, got {k.shape}/{v.shape}")
     B, H, T, D = q.shape
-    if k.shape != q.shape or v.shape != q.shape:
+    Bk, Hkv, Tk, Dk = k.shape
+    if (Bk, Tk, Dk) != (B, T, D) or Hkv <= 0 or H % Hkv:
         raise ValueError(
-            f"q/k/v shapes must match, got {q.shape}/{k.shape}/{v.shape}"
+            f"q/k shapes must match outside the head dim and q heads must "
+            f"be a multiple of kv heads, got {q.shape}/{k.shape}"
         )
-    scale = 1.0 / (D ** 0.5)
-    # block height must be a sublane multiple (f32 8 / bf16 16 / int8 32)
-    # or Mosaic rejects the VMEM tile; short sequences round T UP to the
-    # sublane grid and pad, they don't shrink the tile below it
-    sub = sublanes_for(q.dtype)
-    bq = bk = min(
-        max(block // sub * sub, sub),
-        (T + sub - 1) // sub * sub,
-    )
-    padT = (-T) % bq
-    padD = (-D) % LANES
-    if padT or padD:
-        padding = [(0, 0), (0, 0), (0, padT), (0, padD)]
-        q, k, v = (jnp.pad(a, padding) for a in (q, k, v))
-    Tp, Dp = T + padT, D + padD
-    nq, nkb = Tp // bq, Tp // bk
-
-    qf = q.reshape(B * H, Tp, Dp)
-    kf = k.reshape(B * H, Tp, Dp)
-    vf = v.reshape(B * H, Tp, Dp)
-
-    out = pl.pallas_call(
-        _flash_kernel(causal, scale, bq, bk, nkb, T),
-        grid=(B * H, nq),
-        out_shape=jax.ShapeDtypeStruct((B * H, Tp, Dp), q.dtype),
-        in_specs=[
-            pl.BlockSpec((1, bq, Dp), lambda bh, iq: (bh, iq, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, Tp, Dp), lambda bh, iq: (bh, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, Tp, Dp), lambda bh, iq: (bh, 0, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec(
-            (1, bq, Dp), lambda bh, iq: (bh, iq, 0),
-            memory_space=pltpu.VMEM,
-        ),
-        interpret=default_interpret(interpret),
-    )(qf, kf, vf)
-    out = out.reshape(B, H, Tp, Dp)
-    return out[:, :, :T, :D]
+    return _flash_vjp(q, k, v, causal, block, interpret)

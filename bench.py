@@ -216,16 +216,34 @@ def _bench_attention() -> dict:
         dt = (time.perf_counter() - t0) / iters
         out[f"attn_{impl}_us"] = round(dt * 1e6, 1)
         out[f"attn_{impl}_tflops"] = round(flops / 2 / dt / 1e12, 2)
+        # fwd+bwd (the training cost): flash exercises its custom_vjp
+        # backward kernels, blockwise its rematerialized scan transpose
+        gfn = jax.jit(jax.grad(
+            lambda a, b, c, i=impl: _attention(a, b, c, impl=i)
+            .astype(jnp.float32).sum(),
+            argnums=(0, 1, 2),
+        ))
+        jax.block_until_ready(gfn(q, q, q))  # compile
+        t0 = time.perf_counter()
+        for it in range(iters):
+            r = gfn(qs[it], q, q)
+        jax.block_until_ready(r)
+        dt = (time.perf_counter() - t0) / iters
+        out[f"attn_{impl}_grad_us"] = round(dt * 1e6, 1)
     return out
 
 
-def _bench_train_mfu(small: bool = False, attention: str = "auto") -> dict:
+def _bench_train_mfu(
+    small: bool = False, attention: str = "auto", seq: int = 1024
+) -> dict:
     """Flagship train-step MFU on the local devices: one dp x tp=1 sharded
     SGD step on the bf16 transformer; FLOPs from XLA's own cost analysis
     of the compiled step.  ``attention`` picks the lowering — "auto" (the
-    flagship default: resolves naive at T=1024, blockwise >= 4K) vs an
-    explicit "blockwise"/"naive", the with/without record VERDICT r2
-    item 4 asks for."""
+    flagship default: resolves naive at T=1024, Pallas flash on-chip at
+    T >= 4K) vs an explicit "blockwise"/"naive", the with/without record
+    VERDICT r2 item 4 asks for.  ``seq=4096`` is the long-context
+    record: naive would OOM on score residuals there, so the fused
+    lowerings are the only entrants."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh
@@ -252,9 +270,10 @@ def _bench_train_mfu(small: bool = False, attention: str = "auto") -> dict:
         # reading the number (BENCH_NOTES caveat)
         cfg = TransformerConfig(
             vocab=32768, d_model=4096, n_heads=32, n_layers=6, d_ff=16384,
-            max_seq=1024, dtype=jnp.bfloat16, attention=attention,
+            max_seq=seq, dtype=jnp.bfloat16, attention=attention,
         )
-        batch, seq = 8 * ndev, 1024
+        # keep tokens/step comparable across seq lengths (8K per device)
+        batch = max(8 * 1024 // seq, 1) * ndev
     mesh = Mesh(np.array(jax.devices()).reshape(ndev, 1), ("dp", "tp"))
     step, shard = make_sharded_train_step(cfg, mesh, lr=0.01)
     params = shard(init_params(jax.random.PRNGKey(0), cfg))
@@ -293,6 +312,8 @@ def _bench_train_mfu(small: bool = False, attention: str = "auto") -> dict:
 
     achieved_per_dev = flops_per_dev / dt
     suffix = "" if attention == "auto" else f"_{attention}"
+    if seq != 1024 and not small:
+        suffix = f"_t{seq}{suffix}"
     out = {f"train_tflops{suffix}": round(achieved_per_dev * ndev / 1e12, 2)}
     peak = _peak_flops(jax.devices()[0].device_kind)
     if peak is not None:
@@ -919,6 +940,19 @@ def main() -> None:
             extras, errors, "train_mfu_blockwise",
             lambda: _bench_train_mfu(small=_SMALL, attention="blockwise"),
         )
+        # long-context training record (T=4096, where naive's score
+        # residuals would OOM): "auto" resolves to the Pallas flash
+        # kernel + its custom_vjp backward; blockwise is the XLA
+        # comparison point
+        if not _SMALL:
+            _try(
+                extras, errors, "train_mfu_t4096",
+                lambda: _bench_train_mfu(seq=4096),
+            )
+            _try(
+                extras, errors, "train_mfu_t4096_blockwise",
+                lambda: _bench_train_mfu(seq=4096, attention="blockwise"),
+            )
     _try(extras, errors, "decode_tokens_per_s", _bench_decode_throughput)
 
     result = _headline(extras)

@@ -661,6 +661,70 @@ def test_flash_attention_validates():
         )
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_attention_grads_match_naive(causal):
+    """The custom_vjp backward kernels (dq; dk+dv rebuilt from the saved
+    logsumexp) == autodiff through the materialized-softmax form."""
+    rng = np.random.default_rng(24)
+    B, H, T, D = 2, 2, 96, 32
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((B, H, T, D)), jnp.float32)
+        for _ in range(3)
+    )
+    w = jnp.asarray(rng.standard_normal((B, H, T, D)), jnp.float32)
+
+    def naive(q, k, v):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+        if causal:
+            s = jnp.where(jnp.tril(jnp.ones((T, T), bool)), s, -1e30)
+        return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+
+    def loss(fn):
+        return lambda q, k, v: (fn(q, k, v) * w).sum()
+
+    got = jax.grad(
+        loss(lambda q, k, v: pk.flash_attention(
+            q, k, v, causal=causal, block=32)),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    expect = jax.grad(loss(naive), argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(got, expect, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5,
+            err_msg=f"d{name}",
+        )
+
+
+def test_flash_attention_grads_ragged_and_padded():
+    """Backward with T not a block multiple and D below the lane width:
+    the pad rows/cols must contribute exactly zero gradient."""
+    rng = np.random.default_rng(25)
+    B, H, T, D = 1, 2, 50, 24
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((B, H, T, D)), jnp.float32)
+        for _ in range(3)
+    )
+
+    def naive(q, k, v):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+        s = jnp.where(jnp.tril(jnp.ones((T, T), bool)), s, -1e30)
+        return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+
+    def loss(fn):
+        return lambda q, k, v: (fn(q, k, v) ** 2).sum()
+
+    got = jax.grad(
+        loss(lambda q, k, v: pk.flash_attention(q, k, v, block=16)),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    expect = jax.grad(loss(naive), argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(got, expect, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5,
+            err_msg=f"d{name}",
+        )
+
+
 def test_int8_allreduce_error_bound():
     """End-to-end: blockwise-int8 wire compression over the Pallas ring
     transport (VERDICT r2 item 6).  The result must respect the ANALYTIC
@@ -793,3 +857,48 @@ def test_pallas_striped_matches_model_striped():
         np.asarray(model_fn(qs, ks, vs)),
         rtol=2e-4, atol=2e-5,
     )
+
+
+def test_flash_attention_gqa_fwd_and_grads():
+    """Grouped-query attention through the flash kernel (kv-head sharing
+    via the BlockSpec index map, never expanded) == expanded-kv naive,
+    values AND gradients."""
+    rng = np.random.default_rng(26)
+    B, H, Hkv, T, D = 2, 4, 2, 64, 32
+    G = H // Hkv
+    q = jnp.asarray(rng.standard_normal((B, H, T, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, Hkv, T, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, Hkv, T, D)), jnp.float32)
+
+    def naive(q, k, v):
+        kk = jnp.repeat(k, G, axis=1)
+        vv = jnp.repeat(v, G, axis=1)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, kk) / np.sqrt(D)
+        s = jnp.where(jnp.tril(jnp.ones((T, T), bool)), s, -1e30)
+        return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), vv)
+
+    got = pk.flash_attention(q, k, v, block=32)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(naive(q, k, v)), rtol=2e-5, atol=2e-5
+    )
+
+    loss = lambda fn: lambda q, k, v: (fn(q, k, v) ** 2).sum()
+    g1 = jax.grad(
+        loss(lambda q, k, v: pk.flash_attention(q, k, v, block=32)),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    g2 = jax.grad(loss(naive), argnums=(0, 1, 2))(q, k, v)
+    assert g1[1].shape == (B, Hkv, T, D)  # kv grads at kv-head count
+    for a, b, name in zip(g1, g2, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5,
+            err_msg=f"d{name}",
+        )
+
+
+def test_flash_attention_gqa_validates():
+    with pytest.raises(ValueError, match="multiple of kv heads"):
+        pk.flash_attention(
+            jnp.zeros((1, 4, 16, 8)), jnp.zeros((1, 3, 16, 8)),
+            jnp.zeros((1, 3, 16, 8)),
+        )
