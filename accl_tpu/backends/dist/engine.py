@@ -13,9 +13,9 @@ Differences from the single-process gang (backends/xla):
 * no rendezvous slot machinery — program order IS the match (SPMD);
 * the barrier is a real cross-process device collective, not gang
   assembly;
-* remote stream ports are not reachable (a device kernel's stream lives
-  in its owner process), so RES_STREAM sends to other ranks return
-  ``COLLECTIVE_NOT_IMPLEMENTED``; local stream variants work.
+* remote stream ports ride the distributed runtime's key-value service
+  (one-sided, sequence-ordered — see the "remote stream ports" section
+  below): a control-plane hop sized for kernel handoffs, not bulk data.
 """
 
 from __future__ import annotations
@@ -78,6 +78,11 @@ class DistEngine(StreamPortMixin, BaseEngine):
         self.max_rendezvous_size = MAX_EAGER_SIZE_LIMIT
         self.tuning = {"allreduce_algorithm": "xla", "ring_segments": 1}
         self._init_streams()
+        # per-port consumed counter for remotely-posted stream chunks
+        import threading as _threading
+
+        self._stream_seq: Dict[int, int] = {}
+        self._stream_seq_lock = _threading.Lock()
         self._meshes: Dict[tuple, object] = {}
         # one serialized executor thread (the FPGAQueue role): calls run
         # in submission order — the property SPMD needs — while start()
@@ -329,8 +334,7 @@ class DistEngine(StreamPortMixin, BaseEngine):
 
     def _send(self, options: CallOptions) -> ErrorCode:
         if options.stream & StreamFlags.RES_STREAM:
-            # the destination stream port lives in another process
-            return ErrorCode.COLLECTIVE_NOT_IMPLEMENTED
+            return self._remote_stream_put(options)
         n = options.count
         shard = self._operand_shard(options, n)
         if shard is None:
@@ -394,6 +398,112 @@ class DistEngine(StreamPortMixin, BaseEngine):
         else:
             _write_host_result(res, np.asarray(arr), n)
         return ErrorCode.OK
+
+    # -- remote stream ports over the distributed KV service -------------------
+    # stream_put to another process's port is ONE-SIDED in the reference
+    # (data lands on the remote CCLO's ext-kernel stream with no receiver
+    # call, tag<247 routing accl.cpp:181-183).  SPMD device programs can't
+    # express that (the receiver would have to run a matched program), so
+    # the dist tier rides the distributed runtime's key-value service —
+    # the same control plane that bootstrapped the gang: the sender
+    # atomically takes the destination port's next sequence number and
+    # posts the wire bytes under it; the receiver's stream_pop drains in
+    # sequence order.  A control-plane hop sized for kernel handoffs (the
+    # reference's stream port is a FIFO of 512-bit words, not a bulk
+    # path); bulk data belongs to the collectives.
+
+    @staticmethod
+    def _stream_key(dst: int, sid: int, seq: int) -> str:
+        return f"accl/strm/{dst}/{sid}/{seq}"
+
+    @staticmethod
+    def _stream_ctr(dst: int, sid: int) -> str:
+        return f"accl/strmctr/{dst}/{sid}"
+
+    def _kv(self):
+        from jax._src import distributed
+
+        client = distributed.global_state.client
+        if client is None:  # pragma: no cover - initialize() guarantees it
+            raise RuntimeError("distributed KV service unavailable")
+        return client
+
+    def _remote_stream_put(self, options: CallOptions) -> ErrorCode:
+        n = options.count
+        cfg = options.arithcfg
+        if options.stream & StreamFlags.OP0_STREAM:
+            payload = self._pop_stream_payload(options, n)
+            if payload is None:
+                return ErrorCode.DMA_TIMEOUT
+            data = np.asarray(payload)
+        else:
+            buf = options.op0
+            if buf is None or buf.is_dummy:
+                return ErrorCode.INVALID_OPERATION
+            data = np.asarray(buf.device_view()[:n])
+        data = data.astype(dtype_to_numpy(cfg.uncompressed))
+        if options.compression & CompressionFlags.ETH_COMPRESSED:
+            # wire carries the narrow dtype, same as the gang tier
+            data = data.astype(dtype_to_numpy(cfg.compressed))
+        dst_proc = options.comm.ranks[options.root_dst].session
+        if dst_proc == self.process_id:
+            self.stream_push(options.stream_id, data.tobytes())
+            return ErrorCode.OK
+        try:
+            kv = self._kv()
+            seq = kv.key_value_increment(
+                self._stream_ctr(dst_proc, options.stream_id), 1
+            )
+            kv.key_value_set_bytes(
+                self._stream_key(dst_proc, options.stream_id, seq),
+                data.tobytes(),
+            )
+        except Exception:
+            traceback.print_exc()
+            return ErrorCode.TRANSPORT_ERROR
+        return ErrorCode.OK
+
+    def _drain_remote_stream(self, stream_id: int) -> bool:
+        """Pull this port's next remotely-posted chunk (if any) into the
+        local port; returns True when one landed.  The sequence counter
+        is advanced under its lock so concurrent poppers of one port
+        cannot both fetch (and double-deliver) the same chunk."""
+        with self._stream_seq_lock:
+            nxt = self._stream_seq.get(stream_id, 0) + 1
+            key = self._stream_key(self.process_id, stream_id, nxt)
+            try:
+                data = self._kv().key_value_try_get_bytes(key)
+            except Exception:
+                return False  # NOT_FOUND: nothing posted yet
+            self._stream_seq[stream_id] = nxt
+        try:
+            self._kv().key_value_delete(key)
+        except Exception:  # pragma: no cover - cleanup only
+            pass
+        self.stream_push(stream_id, data)
+        return True
+
+    def stream_pop(self, stream_id: int, timeout: Optional[float] = None) -> bytes:
+        """Local port first (condition-variable fast path, woken
+        immediately by a local push); while empty, poll the KV service
+        non-blockingly for chunks another process stream_put into this
+        port (sequence order, ~20 probes/s)."""
+        budget = self.timeout_s if timeout is None else timeout
+        deadline = time.monotonic() + budget
+        while True:
+            with self._stream_cv:
+                q = self._streams.get(stream_id)
+                if not q:
+                    # a local push lands here instantly; the short wait
+                    # only bounds the remote-probe cadence
+                    self._stream_cv.wait(0.05)
+                    q = self._streams.get(stream_id)
+                if q:
+                    return q.pop(0)
+            if self._drain_remote_stream(stream_id):
+                continue
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"stream {stream_id} empty")
 
     # -- local ops / streams ---------------------------------------------------
     def _local_op(self, options: CallOptions) -> ErrorCode:

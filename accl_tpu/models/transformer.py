@@ -159,9 +159,16 @@ def _layernorm(x, scale):
 # VMEM, the XLA blockwise fold otherwise)
 _AUTO_FUSED_MIN_T = 4096
 # flash holds whole K/V (and whole Q/dO in its backward kernels) in VMEM
-# per batch-head: past this length its tiles outgrow the ~16 MB budget,
-# so auto falls back to the streaming XLA fold
-_AUTO_FLASH_MAX_T = 8192
+# per batch-head: auto uses it only while K+V fit this budget (4 MiB =
+# T 8192 at hd<=128 bf16; the gate scales with the PADDED head dim and
+# dtype width, so wide-head or f32 configs fall back to the streaming
+# XLA fold instead of failing Mosaic's VMEM allocation)
+_AUTO_FLASH_KV_BYTES = 4 * 2**20
+
+
+def _auto_flash_fits(q) -> bool:
+    Dp = -(-q.shape[-1] // 128) * 128  # lane-padded head dim
+    return 2 * q.shape[2] * Dp * q.dtype.itemsize <= _AUTO_FLASH_KV_BYTES
 
 
 def _attention(q, k, v, impl: str = "naive", causal: bool = True):
@@ -169,18 +176,15 @@ def _attention(q, k, v, impl: str = "naive", causal: bool = True):
     bidirectional (encoder) form.
 
     ``impl="auto"`` resolves by sequence length and backend (naive under
-    ``_AUTO_FUSED_MIN_T``; at/above it the Pallas flash kernel on
-    TPU while it fits VMEM — T <= ``_AUTO_FLASH_MAX_T`` — and the XLA
-    blockwise fold elsewhere); ``"blockwise"`` runs the fused
+    ``_AUTO_FUSED_MIN_T``; at/above it the Pallas flash kernel on TPU
+    while its K/V tiles fit VMEM — :func:`_auto_flash_fits` — and the
+    XLA blockwise fold elsewhere); ``"blockwise"`` runs the fused
     online-softmax fold (no (T, T) score matrix in HBM); ``"naive"`` is
     the materialized-scores baseline."""
     if impl == "auto":
         if q.shape[2] < _AUTO_FUSED_MIN_T:
             impl = "naive"
-        elif (
-            jax.default_backend() == "tpu"
-            and q.shape[2] <= _AUTO_FLASH_MAX_T
-        ):
+        elif jax.default_backend() == "tpu" and _auto_flash_fits(q):
             impl = "flash"  # Mosaic-compiled; trainable via custom_vjp
         else:
             impl = "blockwise"

@@ -666,34 +666,10 @@ def check_stream_put_remote(results, world):
 
 _register(
     "stream_put_remote", work_stream_put_remote, check_stream_put_remote,
-    tiers=("emu", "native", "gang"),
-)
-
-
-def work_remote_stream_hole(accl, rank, world):
-    """xla_dist documents remote stream ports as unreachable (a device
-    kernel's stream lives in its owner process): the call must fail
-    LOUDLY with COLLECTIVE_NOT_IMPLEMENTED, not hang or misroute."""
-    from accl_tpu import ACCLError
-    from accl_tpu.constants import ErrorCode
-
-    if rank != 0:
-        return True
-    buf = accl.create_buffer_from(_data(1700, 24))
-    try:
-        accl.stream_put(buf, 24, dst=1, stream_id=6)
-    except ACCLError as e:
-        return bool(e.code & ErrorCode.COLLECTIVE_NOT_IMPLEMENTED)
-    return False
-
-
-def check_remote_stream_hole(results, world):
-    assert results[0] is True
-
-
-_register(
-    "remote_stream_hole", work_remote_stream_hole, check_remote_stream_hole,
-    tiers=("dist",),
+    # on xla_dist the delivery rides the distributed runtime's KV
+    # service (one-sided, sequence-ordered) — the former documented
+    # hole, now the same scenario as every other tier
+    tiers=("emu", "native", "gang", "dist"),
 )
 
 

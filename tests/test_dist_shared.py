@@ -6,9 +6,9 @@ one rank per process over jax.distributed — batched into a single spawn
 per world size to amortize process startup (the reference's analog is
 one mpirun invocation running the whole gtest suite, utility.hpp:29-51).
 
-The documented remote-stream hole is covered by its own scenario
-(``remote_stream_hole``) asserting the loud COLLECTIVE_NOT_IMPLEMENTED,
-per the dist engine's contract (backends/dist/engine.py docstring).
+Remote stream ports (once a documented hole on this tier) now ride the
+distributed runtime's KV service, so ``stream_put_remote`` runs the
+same scenario body here as on every other tier.
 """
 
 from functools import partial
