@@ -49,6 +49,7 @@ from .pipeline import pipeline_apply
 from .transformer import (
     TransformerConfig,
     _block,
+    _embed_tokens,
     _layernorm,
     _reject_untrainable_attention,
     init_params,
@@ -70,12 +71,14 @@ def stacked_param_specs(cfg: TransformerConfig) -> Dict:
         "ln1": P("pp", None),
         "ln2": P("pp", None),
     }
-    return {
+    out = {
         "embed": P(None, None),
-        "pos": P(None, None),
         "ln_f": P(None),
         "layers": layer,
     }
+    if not cfg.uses_rope():
+        out["pos"] = P(None, None)
+    return out
 
 
 def stack_params(params: Dict) -> Dict:
@@ -145,6 +148,7 @@ def make_pp_train_step(
             blk = partial(
                 _block, n_heads_local=heads_local, tp_axis="tp",
                 attn_impl=cfg.attention,
+                rope_base=cfg.rope_base if cfg.uses_rope() else None,
             )
             if cfg.remat:
                 blk = jax.checkpoint(blk)
@@ -173,7 +177,7 @@ def make_pp_train_step(
         me_pp = lax.axis_index("pp")
 
         def global_loss(p):
-            x = p["embed"][tokens] + p["pos"][:T]
+            x = _embed_tokens(p, tokens, cfg)
             mbs = x.reshape(M, B // M, T, cfg.d_model)
             tgts = targets.reshape(M, B // M, T)
             outs = pipeline_apply(p["layers"], mbs, "pp", stage_fn)

@@ -32,6 +32,7 @@ from ..constants import ReduceFunction
 from ..ops import collectives
 from .transformer import (
     TransformerConfig,
+    _embed_tokens,
     _enter_block_layout,
     _layernorm,
     _reject_untrainable_attention,
@@ -53,7 +54,7 @@ def encoder_forward(
     seq_parallel (sequence-sharded activations between blocks, gathered
     back at exit), and the attention lowering."""
     B, T = tokens.shape
-    x = params["embed"][tokens] + params["pos"][:T]
+    x = _embed_tokens(params, tokens, cfg)
     x, block, sp = _enter_block_layout(
         x, cfg, tp_axis, tp_size, causal=False
     )

@@ -53,6 +53,12 @@ class TransformerConfig:
     # under tp, n_kv_heads must stay divisible by the tp size so every
     # chip owns whole kv heads.
     n_kv_heads: Optional[int] = None
+    # position encoding: "learned" (an additive max_seq x d_model table)
+    # or "rope" (rotary embeddings applied to q/k per head — no pos
+    # table, relative-position attention, and no max_seq cliff baked
+    # into the params; requires an even head dim)
+    pos_embedding: str = "learned"
+    rope_base: float = 10000.0
     # rematerialize each block on the backward pass (jax.checkpoint):
     # trades ~30% more FLOPs in exchange for activation memory that no
     # longer scales with n_layers — the standard TPU recipe for fitting
@@ -87,6 +93,15 @@ class TransformerConfig:
             )
         return n_kv
 
+    def uses_rope(self) -> bool:
+        if self.pos_embedding not in ("learned", "rope"):
+            raise ValueError(
+                f"unknown pos_embedding {self.pos_embedding!r}"
+            )
+        if self.pos_embedding == "rope" and (self.d_model // self.n_heads) % 2:
+            raise ValueError("rope needs an even head dim")
+        return self.pos_embedding == "rope"
+
 
 # parameter partition specs over ('dp', 'tp'): column-parallel weights shard
 # their output dim on tp, row-parallel weights their input dim.
@@ -101,12 +116,14 @@ def param_specs(cfg: TransformerConfig) -> Dict:
         "ln1": P(None),
         "ln2": P(None),
     }
-    return {
+    out = {
         "embed": P(None, None),
-        "pos": P(None, None),
         "ln_f": P(None),
         "layers": [dict(layer) for _ in range(cfg.n_layers)],
     }
+    if not cfg.uses_rope():
+        out["pos"] = P(None, None)
+    return out
 
 
 def init_params(key, cfg: TransformerConfig) -> Dict:
@@ -114,10 +131,14 @@ def init_params(key, cfg: TransformerConfig) -> Dict:
     scale = 0.02
     params = {
         "embed": jax.random.normal(k[0], (cfg.vocab, cfg.d_model), cfg.dtype) * scale,
-        "pos": jax.random.normal(k[1], (cfg.max_seq, cfg.d_model), cfg.dtype) * scale,
         "ln_f": jnp.ones((cfg.d_model,), cfg.dtype),
         "layers": [],
     }
+    if not cfg.uses_rope():  # rope has no learned position table
+        params["pos"] = (
+            jax.random.normal(k[1], (cfg.max_seq, cfg.d_model), cfg.dtype)
+            * scale
+        )
     d_kv = cfg.kv_heads() * (cfg.d_model // cfg.n_heads)
     for i in range(cfg.n_layers):
         kk = k[2 + 4 * i : 6 + 4 * i]
@@ -150,6 +171,46 @@ def _layernorm(x, scale):
     mu = x.mean(-1, keepdims=True)
     var = ((x - mu) ** 2).mean(-1, keepdims=True)
     return (x - mu) * jax.lax.rsqrt(var + 1e-5) * scale
+
+
+def _embed_tokens(params, tokens, cfg) -> jax.Array:
+    """Token embeddings, plus the learned position table unless the
+    config uses rotary embeddings (rope encodes position inside
+    attention, so there is no table to add)."""
+    x = params["embed"][tokens]
+    if not cfg.uses_rope():
+        x = x + params["pos"][: tokens.shape[1]]
+    return x
+
+
+def _rope_tables(positions, half: int, base: float):
+    """cos/sin tables for rotary embedding at the given absolute
+    ``positions`` (shape (T,); traced values fine — decode passes its
+    dynamic cursor).  Computed once per attention site and shared by
+    the q and k rotations (and across layers on the decode path), so
+    scanned/rematerialized blocks don't rebuild the pow/cos/sin chain
+    per layer."""
+    freqs = jnp.asarray(base, jnp.float32) ** (
+        -jnp.arange(0, half, dtype=jnp.float32) / half
+    )
+    ang = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # (T, half)
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def _rope_rotate(x, tables):
+    """Rotary position embedding [RoFormer]: rotate each (i, i+half)
+    feature pair of every head by position*freq_i.  ``x`` is
+    (B, H, T, hd) with hd even; ``tables`` from :func:`_rope_tables`.
+    Rotation runs in f32, the result is cast back so bf16 activations
+    stay bf16 (the dtype-discipline rule everywhere in this file)."""
+    cos, sin = tables
+    half = x.shape[-1] // 2
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    )
+    return out.astype(x.dtype)
 
 
 # measured crossover on v5e (see TransformerConfig.attention): BELOW this
@@ -232,12 +293,16 @@ def _mlp(x, lp, tp_axis):
     return x + partial_f
 
 
-def _attn_partial(h, lp, n_heads_local, attn_impl="naive", causal=True):
+def _attn_partial(h, lp, n_heads_local, attn_impl="naive", causal=True,
+                  rope_base=None):
     """Column-parallel attention on a full-sequence activation: returns
     the row-parallel PARTIAL output (pre-reduction) and the (k, v) head
     tensors (B, Hkv_local, T, hd) for KV-cache prefill.  The kv head
     count comes from the wk shard's width (GQA: fewer kv heads than q
-    heads; every attention lowering groups q heads onto kv head h//G)."""
+    heads; every attention lowering groups q heads onto kv head h//G).
+    With ``rope_base`` set, q/k rotate by absolute position BEFORE
+    attention (and before the kv tensors are returned, so the prefill
+    cache stores rotated keys — decode appends consistently)."""
     B, T, _ = h.shape
     q, k, v = h @ lp["wq"], h @ lp["wk"], h @ lp["wv"]  # column-parallel
     hd = q.shape[-1] // n_heads_local
@@ -246,13 +311,17 @@ def _attn_partial(h, lp, n_heads_local, attn_impl="naive", causal=True):
     q, k, v = (
         heads(q, n_heads_local), heads(k, n_kv_local), heads(v, n_kv_local)
     )
+    if rope_base is not None:
+        tables = _rope_tables(jnp.arange(T), hd // 2, rope_base)
+        q = _rope_rotate(q, tables)
+        k = _rope_rotate(k, tables)
     attn = _attention(q, k, v, impl=attn_impl, causal=causal)
     attn = attn.transpose(0, 2, 1, 3).reshape(B, T, -1)
     return attn @ lp["wo"], (k, v)
 
 
 def _block(x, lp, n_heads_local, tp_axis, return_kv=False,
-           attn_impl="naive", causal=True):
+           attn_impl="naive", causal=True, rope_base=None):
     """One transformer block on tp-sharded weights.  ``lp['wqkv']`` etc. are
     the *local shards*; the tp-allreduce after each row-parallel matmul is
     the reference's fused-allreduce hot path in model form.
@@ -260,7 +329,9 @@ def _block(x, lp, n_heads_local, tp_axis, return_kv=False,
     ``return_kv=True`` additionally returns the (k, v) head tensors
     (B, H_local, T, hd) — the prefill path of the KV-cache decode."""
     h = _layernorm(x, lp["ln1"])
-    partial_o, kv = _attn_partial(h, lp, n_heads_local, attn_impl, causal)
+    partial_o, kv = _attn_partial(
+        h, lp, n_heads_local, attn_impl, causal, rope_base
+    )
     if tp_axis is not None:
         partial_o = collectives.allreduce(partial_o, tp_axis, ReduceFunction.SUM)
     x = x + partial_o
@@ -269,7 +340,7 @@ def _block(x, lp, n_heads_local, tp_axis, return_kv=False,
 
 
 def _block_sp(x_sp, lp, n_heads_local, tp_axis, return_kv=False,
-              attn_impl="naive", causal=True):
+              attn_impl="naive", causal=True, rope_base=None):
     """Sequence-parallel block (Megatron-SP): ``x_sp`` is (B, T/tp, D),
     sequence-sharded over ``tp``.  All-gather restores the full sequence
     in front of each column-parallel matmul; the row-parallel reduction
@@ -284,7 +355,7 @@ def _block_sp(x_sp, lp, n_heads_local, tp_axis, return_kv=False,
     h = _layernorm(x_sp, lp["ln1"])
     h_full = collectives.allgather(h, tp_axis, axis=1)
     partial_o, kv = _attn_partial(
-        h_full, lp, n_heads_local, attn_impl, causal
+        h_full, lp, n_heads_local, attn_impl, causal, rope_base
     )
     o_sp = collectives.reduce_scatter(
         partial_o, tp_axis, tiled=True, axis=1
@@ -322,6 +393,7 @@ def _enter_block_layout(x, cfg, tp_axis, tp_size, return_kv=False,
     kw = dict(
         n_heads_local=heads_local, tp_axis=tp_axis,
         attn_impl=cfg.attention, causal=causal,
+        rope_base=cfg.rope_base if cfg.uses_rope() else None,
     )
     if return_kv:
         kw["return_kv"] = True
@@ -344,7 +416,7 @@ def forward(params, tokens, cfg: TransformerConfig, tp_axis=None, tp_size=1):
     """Logits for a token batch.  With tp_axis set, runs on weight shards
     inside shard_map; without, a plain single-device forward."""
     B, T = tokens.shape
-    x = params["embed"][tokens] + params["pos"][:T]
+    x = _embed_tokens(params, tokens, cfg)
     x, block, sp = _enter_block_layout(x, cfg, tp_axis, tp_size)
     if cfg.remat:
         block = jax.checkpoint(block)
@@ -373,7 +445,8 @@ def loss_fn(params, tokens, targets, cfg, tp_axis=None, tp_size=1):
 # ---------------------------------------------------------------------------
 
 
-def _block_decode(x_t, lp, cache_k, cache_v, pos, n_heads_local, tp_axis):
+def _block_decode(x_t, lp, cache_k, cache_v, pos, n_heads_local, tp_axis,
+                  rope_tables=None):
     """One block for a single decode position: write this step's k/v into
     the cache at ``pos`` (dynamic_update_slice keeps shapes static under
     jit/scan), attend over positions <= pos, same tp collectives as the
@@ -390,6 +463,12 @@ def _block_decode(x_t, lp, cache_k, cache_v, pos, n_heads_local, tp_axis):
     rs = lambda t, n: t.reshape(B, 1, n, hd).transpose(0, 2, 1, 3)
     q = rs(q, n_heads_local)  # (B, Hl, 1, hd)
     k, v = rs(k, n_kv_local), rs(v, n_kv_local)  # (B, Hkv_l, 1, hd)
+    if rope_tables is not None:
+        # rotate this step's q/k at the dynamic cursor; cached keys were
+        # rotated at THEIR positions (prefill/prior steps), so scores
+        # depend only on relative offsets — rope's defining property
+        q = _rope_rotate(q, rope_tables)
+        k = _rope_rotate(k, rope_tables)
     cache_k = jax.lax.dynamic_update_slice(cache_k, k, (0, 0, pos, 0))
     cache_v = jax.lax.dynamic_update_slice(cache_v, v, (0, 0, pos, 0))
     S = cache_k.shape[2]
@@ -442,7 +521,7 @@ def prefill(
     block already runs on the gathered sequence."""
     B, T = tokens.shape
     S = cfg.max_seq if cache_len is None else int(cache_len)
-    x = params["embed"][tokens] + params["pos"][:T]
+    x = _embed_tokens(params, tokens, cfg)
     kv_local = cfg.kv_heads() // tp_size  # GQA: cache holds kv heads only
     hd = cfg.d_model // cfg.n_heads
     x, block_kv, sp = _enter_block_layout(
@@ -506,7 +585,9 @@ def generate(
     therefore the serving plan) is identical to what the SP training
     layout implies, not a silent strategy switch."""
     B, T = prompt.shape
-    if T + steps > cfg.max_seq:
+    if T + steps > cfg.max_seq and not cfg.uses_rope():
+        # rope has no position table, so max_seq is not a serving cliff:
+        # the cache below is sized to exactly T + steps either way
         raise ValueError(
             f"prompt {T} + steps {steps} exceeds max_seq {cfg.max_seq}"
         )
@@ -525,14 +606,24 @@ def generate(
     rng, sub = jax.random.split(rng)
     first = _select_token(logits, sub, temperature, top_k).astype(prompt.dtype)
 
+    rope = cfg.rope_base if cfg.uses_rope() else None
+    hd = cfg.d_model // cfg.n_heads
+
     def step(carry, _):
         caches, tok, pos, key = carry
-        pos_emb = jax.lax.dynamic_slice_in_dim(params["pos"], pos, 1, 0)
-        x = params["embed"][tok][:, None, :] + pos_emb[None, 0:1]
+        x = params["embed"][tok][:, None, :]
+        tables = None
+        if rope is None:
+            pos_emb = jax.lax.dynamic_slice_in_dim(params["pos"], pos, 1, 0)
+            x = x + pos_emb[None, 0:1]
+        else:
+            # one table for the step, shared across all layers
+            tables = _rope_tables(jnp.asarray(pos)[None], hd // 2, rope)
         new_caches = []
         for lp, (ck, cv) in zip(params["layers"], caches):
             x, ck, cv = _block_decode(
-                x, lp, ck, cv, pos, heads_local, tp_axis
+                x, lp, ck, cv, pos, heads_local, tp_axis,
+                rope_tables=tables,
             )
             new_caches.append((ck, cv))
         x = _layernorm(x, params["ln_f"])

@@ -747,3 +747,150 @@ def test_gqa_rejects_kv_heads_below_tp(gqa_cfg, mesh22):
     prompt = jnp.zeros((2, 8), jnp.int32)
     with pytest.raises(ValueError, match="divisible by tp"):
         fn(shard(init_params(jax.random.PRNGKey(0), c)), prompt)
+
+
+# ---------------------------------------------------------------------------
+# rotary position embeddings (RoPE)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def rope_cfg():
+    return TransformerConfig(
+        vocab=64, d_model=64, n_heads=8, n_kv_heads=4, n_layers=2,
+        d_ff=96, max_seq=48, pos_embedding="rope",
+    )
+
+
+def test_rope_has_no_pos_table(rope_cfg):
+    import dataclasses
+
+    params = init_params(jax.random.PRNGKey(0), rope_cfg)
+    assert "pos" not in params
+    from accl_tpu.models.transformer import param_specs
+
+    assert "pos" not in param_specs(rope_cfg)
+    with pytest.raises(ValueError, match="even head dim"):
+        dataclasses.replace(
+            rope_cfg, d_model=40, n_heads=8  # head dim 5
+        ).uses_rope()
+    with pytest.raises(ValueError, match="unknown pos_embedding"):
+        dataclasses.replace(rope_cfg, pos_embedding="alibi").uses_rope()
+
+
+@pytest.mark.parametrize("impl", ["blockwise", "flash"])
+def test_rope_attention_impls_match_naive(rope_cfg, impl):
+    """Rotation happens before the lowering, so every attention impl
+    must agree under rope too."""
+    import dataclasses
+
+    params = init_params(jax.random.PRNGKey(13), rope_cfg)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(14), (2, 20), 0, rope_cfg.vocab
+    )
+    base = forward(
+        params, tokens, dataclasses.replace(rope_cfg, attention="naive")
+    )
+    got = forward(
+        params, tokens, dataclasses.replace(rope_cfg, attention=impl)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(base), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_rope_decode_token_exact(rope_cfg):
+    """Decode rotates q/k at the dynamic cursor against a cache of keys
+    rotated at THEIR positions: must reproduce the full forward exactly
+    (the relative-position property, end to end)."""
+    from accl_tpu.models import generate
+
+    params = init_params(jax.random.PRNGKey(15), rope_cfg)
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(16), (2, 11), 0, rope_cfg.vocab
+    )
+    got = generate(params, prompt, 7, rope_cfg)
+    cur = prompt
+    for _ in range(7):
+        lg = forward(params, cur, rope_cfg)
+        nxt = lg[:, -1].argmax(-1)[:, None].astype(cur.dtype)
+        cur = jnp.concatenate([cur, nxt], axis=1)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(cur[:, 11:]))
+
+
+def test_rope_relative_position_invariance(rope_cfg):
+    """The defining rope property: with no position table, attention
+    depends only on RELATIVE offsets — feeding the same embeddings at a
+    shifted absolute position changes nothing about causal attention
+    among them.  Compare hidden states of a window decoded at offset 0
+    vs the same window after a shared prefix of repeated tokens is
+    dropped from the cache... realized here as: rotating q/k by
+    positions p and p+s gives identical scores."""
+    from accl_tpu.models.transformer import _rope_rotate, _rope_tables
+
+    rng = np.random.default_rng(17)
+    q = jnp.asarray(rng.standard_normal((1, 2, 8, 16)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, 2, 8, 16)), jnp.float32)
+    base = rope_cfg.rope_base
+    t0 = _rope_tables(jnp.arange(8), 8, base)
+    t1 = _rope_tables(jnp.arange(8) + 1000, 8, base)
+    s0 = jnp.einsum(
+        "bhqd,bhkd->bhqk", _rope_rotate(q, t0), _rope_rotate(k, t0)
+    )
+    s1 = jnp.einsum(
+        "bhqd,bhkd->bhqk", _rope_rotate(q, t1), _rope_rotate(k, t1)
+    )
+    np.testing.assert_allclose(
+        np.asarray(s0), np.asarray(s1), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_rope_generates_past_max_seq(rope_cfg):
+    """rope has no position table, so max_seq is not a serving cliff:
+    prompt + steps may exceed it (the cache sizes to T + steps)."""
+    from accl_tpu.models import generate
+
+    params = init_params(jax.random.PRNGKey(21), rope_cfg)
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(22), (1, 40), 0, rope_cfg.vocab
+    )
+    out = generate(params, prompt, 16, rope_cfg)  # 56 > max_seq=48
+    assert np.asarray(out).shape == (1, 16)
+
+
+def test_rope_sharded_train_matches_sp(rope_cfg, mesh22):
+    import dataclasses
+
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(18), (4, 16), 0, rope_cfg.vocab
+    )
+    targets = jnp.roll(tokens, -1, axis=1)
+    losses = []
+    for sp in (False, True):
+        c = dataclasses.replace(rope_cfg, seq_parallel=sp)
+        step, shard = make_sharded_train_step(c, mesh22, lr=0.05)
+        params = shard(init_params(jax.random.PRNGKey(0), c))
+        _, loss = step(params, tokens, targets)
+        losses.append(float(loss))
+    assert losses[0] == pytest.approx(losses[1], rel=1e-6)
+
+
+def test_rope_encoder_forward(rope_cfg):
+    """The encoder family shares the block path: rope must flow through
+    causal=False blocks too (and change with token positions)."""
+    from accl_tpu.models import encoder_forward
+
+    params = init_params(jax.random.PRNGKey(19), rope_cfg)
+    toks = jax.random.randint(
+        jax.random.PRNGKey(20), (2, 12), 0, rope_cfg.vocab
+    )
+    h = encoder_forward(params, toks, rope_cfg)
+    assert h.shape == (2, 12, rope_cfg.d_model)
+    # position sensitivity: the same token repeated inside a VARIED
+    # sequence must get different hidden states at its two positions
+    # (position enters via q/k rotation; note an all-identical sequence
+    # would NOT show this — every value vector is identical, so any
+    # score pattern averages to the same output)
+    varied = jnp.asarray([[7, 1, 2, 7, 3, 4, 5, 6, 8, 9, 10, 11]], toks.dtype)
+    h2 = np.asarray(encoder_forward(params, varied, rope_cfg))
+    assert not np.allclose(h2[0, 0], h2[0, 3], atol=1e-5)
