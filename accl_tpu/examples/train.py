@@ -41,8 +41,17 @@ def train(
     platform: Optional[str] = None,
     optimizer: str = "sgd",
     parallelism: str = "dp_tp",
+    data: Optional[str] = None,
 ):
     """Train the flagship transformer.
+
+    ``data`` points at an ``ACCLTOK1`` token file (see
+    ``accl_tpu.data.write_token_file``): batches then come from the
+    native prefetching loader — deterministic per (file, seed, step), so
+    checkpoint resume consumes the exact stream an uninterrupted run
+    would (the loader seeks to the resumed step).  Without ``data``,
+    synthetic random tokens keyed by (seed, step) keep the same
+    resume-exactness property.
 
     ``optimizer="zero_adam"`` switches the step to the ZeRO-sharded Adam
     (fp32 moments living 1/dp per chip, ``parallel/zero.py``); its
@@ -179,17 +188,40 @@ def train(
         return start_step, None
 
     loss = None
-    for it in range(start_step, steps):
-        # per-step data stream keyed by (seed, step): a resumed run consumes
-        # the exact token stream an uninterrupted run would, so losses stay
-        # bit-comparable across restarts
-        rng = np.random.default_rng([seed, it])
-        # per-dp-rank batch of 2 — which also divides the pipeline
-        # mode's num_microbatches=2 exactly
-        tokens = jnp.asarray(
-            rng.integers(0, cfg.vocab, (2 * dp, cfg.max_seq)), jnp.int32
+    loader = None
+    if data is not None:
+        from ..data import TokenLoader
+
+        # single-controller: one loader feeds the whole dp-sharded batch
+        # (multi-process deployments shard via shard/num_shards instead)
+        loader = TokenLoader(
+            data, batch=2 * dp, seq=cfg.max_seq, seed=seed,
+            start_step=start_step,
         )
-        targets = jnp.roll(tokens, -1, axis=1)
+    try:
+      for it in range(start_step, steps):
+        if loader is not None:
+            t_np, g_np, got_step = loader.next()
+            assert got_step == it, (got_step, it)
+            # validate the WHOLE window: targets carry one position the
+            # tokens array doesn't (the shifted-off last column)
+            if max(int(t_np.max()), int(g_np.max())) >= cfg.vocab:
+                raise ValueError(
+                    f"token file carries ids >= vocab ({cfg.vocab})"
+                )
+            tokens = jnp.asarray(t_np)
+            targets = jnp.asarray(g_np)
+        else:
+            # per-step data stream keyed by (seed, step): a resumed run
+            # consumes the exact token stream an uninterrupted run would,
+            # so losses stay bit-comparable across restarts
+            rng = np.random.default_rng([seed, it])
+            # per-dp-rank batch of 2 — which also divides the pipeline
+            # mode's num_microbatches=2 exactly
+            tokens = jnp.asarray(
+                rng.integers(0, cfg.vocab, (2 * dp, cfg.max_seq)), jnp.int32
+            )
+            targets = jnp.roll(tokens, -1, axis=1)
         if use_zero:
             params, opt_state, loss = step_fn(
                 params, opt_state, tokens, targets
@@ -201,6 +233,9 @@ def train(
             print(f"step {it + 1}/{steps} loss {loss:.4f}", flush=True)
         if ckptr is not None and (it + 1) % save_every == 0:
             ckptr.save(it, args=_ocp().args.StandardSave(ckpt_tree()))
+    finally:
+      if loader is not None:
+        loader.close()  # even when a step raises: stop the prefetch thread
     if ckptr is not None:
         ckptr.save(steps - 1, args=_ocp().args.StandardSave(ckpt_tree()))
         ckptr.wait_until_finished()
@@ -222,12 +257,17 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--parallelism", default="dp_tp", choices=["dp_tp", "pipeline"]
     )
+    ap.add_argument(
+        "--data", default=None,
+        help="ACCLTOK1 token file (native prefetching loader); "
+        "default: synthetic tokens",
+    )
     args = ap.parse_args(argv)
     train(
         steps=args.steps, ckpt_dir=args.ckpt_dir,
         save_every=args.save_every, tp=args.tp, seed=args.seed,
         platform=args.platform, optimizer=args.optimizer,
-        parallelism=args.parallelism,
+        parallelism=args.parallelism, data=args.data,
     )
     return 0
 

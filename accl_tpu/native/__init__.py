@@ -25,6 +25,7 @@ _LOAD_ATTEMPTED = False
 _NATIVE_DIR = pathlib.Path(__file__).resolve().parent.parent.parent / "native"
 _SO_PATH = _NATIVE_DIR / "build" / "libaccl_dataplane.so"
 _ENGINE_SO_PATH = _NATIVE_DIR / "build" / "libaccl_engine.so"
+_DATALOADER_SO_PATH = _NATIVE_DIR / "build" / "libaccl_dataloader.so"
 
 
 def _try_build() -> None:
@@ -36,7 +37,11 @@ def _try_build() -> None:
         _NATIVE_DIR.mkdir(exist_ok=True)
         with open(_NATIVE_DIR / ".build.lock", "w") as lock:
             fcntl.flock(lock, fcntl.LOCK_EX)
-            if not _SO_PATH.exists() or not _ENGINE_SO_PATH.exists():
+            if (
+                not _SO_PATH.exists()
+                or not _ENGINE_SO_PATH.exists()
+                or not _DATALOADER_SO_PATH.exists()
+            ):
                 subprocess.run(
                     ["make", "-C", str(_NATIVE_DIR)],
                     capture_output=True,
