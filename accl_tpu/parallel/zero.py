@@ -24,7 +24,7 @@ with tensor parallelism: everything here acts on the tp-local shard.
 from __future__ import annotations
 
 from functools import partial
-from typing import Dict, NamedTuple
+from typing import Dict, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -45,6 +45,40 @@ class AdamConfig(NamedTuple):
     b1: float = 0.9
     b2: float = 0.999
     eps: float = 1e-8
+    # AdamW: decoupled weight decay applied to the parameter slice (not
+    # the gradient), skipped for 1-D leaves (layernorm scales / biases)
+    # per standard practice
+    weight_decay: float = 0.0
+    # LR schedule: linear warmup over ``warmup_steps``, then (when
+    # ``decay_steps`` is set) cosine decay from the peak to
+    # ``min_lr_ratio * lr`` by step ``decay_steps``; constant otherwise
+    warmup_steps: int = 0
+    decay_steps: Optional[int] = None
+    min_lr_ratio: float = 0.0
+
+
+def schedule_lr(cfg: AdamConfig, step):
+    """Learning rate at ``step`` (1-based, traced ok): warmup-cosine.
+
+    The serving trainer composes this inside the jitted step, so the
+    schedule costs nothing and checkpoints implicitly (step lives in the
+    optimizer state)."""
+    t = jnp.asarray(step, jnp.float32)
+    lr = jnp.asarray(cfg.lr, jnp.float32)
+    if cfg.decay_steps is not None and cfg.decay_steps <= cfg.warmup_steps:
+        raise ValueError(
+            f"decay_steps ({cfg.decay_steps}) must exceed warmup_steps "
+            f"({cfg.warmup_steps})"
+        )
+    if cfg.warmup_steps:
+        lr = lr * jnp.minimum(1.0, t / float(cfg.warmup_steps))
+    if cfg.decay_steps:
+        span = cfg.decay_steps - cfg.warmup_steps
+        prog = jnp.clip((t - cfg.warmup_steps) / span, 0.0, 1.0)
+        cos = 0.5 * (1.0 + jnp.cos(jnp.pi * prog))
+        floor = cfg.min_lr_ratio
+        lr = lr * (floor + (1.0 - floor) * cos)
+    return lr
 
 
 def _padded(n: int, dp: int) -> int:
@@ -131,6 +165,7 @@ def zero_adam_update(params, grads, state, dp_axis: str, cfg: AdamConfig):
     step = state["step"] + 1
     bc1 = 1.0 - cfg.b1 ** step.astype(jnp.float32)
     bc2 = 1.0 - cfg.b2 ** step.astype(jnp.float32)
+    lr_t = schedule_lr(cfg, step)
 
     def pad_flat(x, padded, dtype):
         flat = x.reshape(-1).astype(dtype)
@@ -152,14 +187,18 @@ def zero_adam_update(params, grads, state, dp_axis: str, cfg: AdamConfig):
         v = cfg.b2 * v + (1.0 - cfg.b2) * gs * gs
         mhat = m / bc1
         vhat = v / bc2
-        upd = cfg.lr * mhat / (jnp.sqrt(vhat) + cfg.eps)
         # this rank's parameter slice (of the PADDED flat, so the last
         # rank's slice never clamps into its neighbor's), updated locally
         shard = lax.dynamic_slice_in_dim(
             pad_flat(p, padded, jnp.float32), idx * (padded // dp),
             padded // dp,
         )
-        new_shard = (shard - upd).astype(p.dtype)
+        upd = mhat / (jnp.sqrt(vhat) + cfg.eps)
+        if cfg.weight_decay and p.ndim > 1:
+            # AdamW decoupled decay on the param slice itself; 1-D
+            # leaves (ln scales, biases) are conventionally exempt
+            upd = upd + cfg.weight_decay * shard
+        new_shard = (shard - lr_t * upd).astype(p.dtype)
         # rebuild the full parameter from the slices.  The plain
         # lax.all_gather can't be used: its output is conservatively
         # dp-varying, which shard_map's replication checker rejects for a
