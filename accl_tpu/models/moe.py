@@ -40,7 +40,8 @@ def moe_ffn(
     ep_axis: str | None = None,
     capacity_factor: float = 1.5,
     k: int = 1,
-) -> jax.Array:
+    return_aux: bool = False,
+):
     """Top-k gated MoE FFN (k=1 is Switch routing, k=2 the classic MoE).
 
     ``x``: (B, T, D) local tokens.  Without ``ep_axis``: every expert is
@@ -56,6 +57,17 @@ def moe_ffn(
 
     Returns (B, T, D): expert outputs weighted by the gate probability;
     over-capacity entries contribute zero (callers add the residual).
+
+    ``return_aux=True`` additionally returns the router health terms
+    computed over THIS rank's tokens (average across dp/ep in the loss):
+
+    * ``load_balance`` — the Switch-Transformer auxiliary,
+      ``E * sum_e f_e * P_e`` (f = dispatch fraction, P = mean router
+      probability): 1.0 at perfect balance, up to E when the router
+      collapses onto one expert; add ``~0.01 * load_balance`` to the
+      loss to keep experts utilized.
+    * ``router_z`` — the ST-MoE z-loss, ``mean(logsumexp(logits)^2)``,
+      which keeps router logits small/stable in bf16.
     """
     B, T, D = x.shape
     N = B * T
@@ -126,4 +138,16 @@ def moe_ffn(
     got = combined[expert, jnp.clip(slot, 0, cap - 1)]  # (N*k, D)
     weighted = got * (gate_p * keep.astype(x.dtype))[:, None]
     y = weighted.reshape(N, k, D).sum(axis=1)
-    return y.reshape(B, T, D)
+    y = y.reshape(B, T, D)
+    if not return_aux:
+        return y
+    # Switch load-balance: E * sum_e (dispatch fraction)_e * (mean router
+    # prob)_e — differentiable through P (f's argmax is a constant), so
+    # its gradient pushes probability mass toward under-used experts
+    f = onehot.astype(jnp.float32).mean(axis=0)  # (E,) entry fraction
+    P = probs.astype(jnp.float32).mean(axis=0)
+    load_balance = jnp.asarray(E, jnp.float32) * jnp.sum(f * P)
+    router_z = jnp.mean(
+        jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1) ** 2
+    )
+    return y, {"load_balance": load_balance, "router_z": router_z}

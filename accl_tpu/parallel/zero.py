@@ -240,6 +240,7 @@ def make_zero_train_step(
     from ..ops import collectives
 
     _reject_untrainable_attention(model_cfg)
+    schedule_lr(adam, 1)  # fail fast on decay/warmup misconfiguration
 
     specs = param_specs(model_cfg)
     sspecs = zero_state_specs(specs)

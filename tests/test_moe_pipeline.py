@@ -359,3 +359,71 @@ def test_composed_validates_divisibility():
             TransformerConfig(n_layers=3), mesh3d, num_microbatches=2
         )
 
+
+
+def test_moe_aux_losses():
+    """Router health terms: the Switch load-balance aux is ~1 at perfect
+    balance and approaches E when the router collapses; the z-loss
+    penalizes large logits; both carry router gradients."""
+    import jax.numpy as jnp
+
+    D, F, E = 16, 32, 4
+    params = init_moe_params(jax.random.PRNGKey(5), D, F, E)
+    # positive activations so a positive gate column dominates every row
+    x = jnp.abs(jax.random.normal(jax.random.PRNGKey(6), (2, 16, D)))
+
+    y, aux = moe_ffn(x, params, return_aux=True)
+    assert y.shape == x.shape
+    # random small gates route near-uniformly: aux near its 1.0 optimum
+    assert 0.9 < float(aux["load_balance"]) < 1.5
+
+    collapsed = dict(
+        params, gate=jnp.zeros((D, E)).at[:, 0].set(50.0)
+    )
+    _, aux_c = moe_ffn(x, collapsed, return_aux=True)
+    assert float(aux_c["load_balance"]) > 0.9 * E  # ~E when collapsed
+    assert float(aux_c["router_z"]) > float(aux["router_z"])
+
+    g = jax.grad(
+        lambda p: moe_ffn(x, p, return_aux=True)[1]["load_balance"]
+    )(params)
+    assert float(jnp.abs(g["gate"]).max()) > 0
+
+
+def test_moe_aux_under_expert_parallelism():
+    """return_aux composes with ep sharding: per-rank terms average to
+    the dense layer's value when every rank sees the same tokens."""
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    D, F, E, ep = 8, 16, 4, 4
+    devs = jax.devices()[:ep]
+    if len(devs) < ep:
+        pytest.skip(f"needs {ep} devices")
+    params = init_moe_params(jax.random.PRNGKey(7), D, F, E)
+    x = jax.random.normal(jax.random.PRNGKey(8), (2, 8, D))
+
+    _, aux_dense = moe_ffn(x, params, None, capacity_factor=float(E),
+                           return_aux=True)
+
+    mesh = Mesh(np.array(devs), ("ep",))
+
+    def run(xl, g, w1, w2):
+        y, aux = moe_ffn(
+            xl, {"gate": g, "w1": w1, "w2": w2}, "ep",
+            capacity_factor=float(E), return_aux=True,
+        )
+        return y, aux["load_balance"]
+
+    fn = jax.jit(
+        shard_map(
+            run, mesh=mesh,
+            in_specs=(P(), P(), P("ep"), P("ep")),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+    )
+    _, lb = fn(x, params["gate"], params["w1"], params["w2"])
+    np.testing.assert_allclose(
+        float(lb), float(aux_dense["load_balance"]), rtol=1e-5
+    )

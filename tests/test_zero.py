@@ -220,3 +220,10 @@ def test_schedule_rejects_decay_before_warmup():
 
     with pytest.raises(ValueError, match="must exceed warmup"):
         schedule_lr(AdamConfig(warmup_steps=100, decay_steps=50), 1)
+
+
+def test_step_builder_rejects_bad_schedule(cfg, mesh42):
+    with pytest.raises(ValueError, match="must exceed warmup"):
+        make_zero_train_step(
+            cfg, mesh42, AdamConfig(warmup_steps=100, decay_steps=50)
+        )
