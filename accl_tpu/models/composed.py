@@ -135,6 +135,11 @@ def make_pp_train_step(
         raise ValueError(
             f"n_heads ({cfg.n_heads}) must divide by tp ({tp})"
         )
+    if cfg.vocab_parallel:
+        raise ValueError(
+            "vocab_parallel is supported on the decoder flagship only "
+            "(forward/loss_fn/generate), not the composed pipeline"
+        )
     M = num_microbatches
     heads_local = cfg.n_heads // tp
     specs = stacked_param_specs(cfg)

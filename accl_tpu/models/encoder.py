@@ -53,6 +53,11 @@ def encoder_forward(
     the full config surface via the shared entry path: remat,
     seq_parallel (sequence-sharded activations between blocks, gathered
     back at exit), and the attention lowering."""
+    if cfg.vocab_parallel:
+        raise ValueError(
+            "vocab_parallel is supported on the decoder flagship only "
+            "(forward/loss_fn/generate), not the encoder family"
+        )
     B, T = tokens.shape
     x = _embed_tokens(params, tokens, cfg)
     x, block, sp = _enter_block_layout(

@@ -59,6 +59,13 @@ class TransformerConfig:
     # into the params; requires an even head dim)
     pos_embedding: str = "learned"
     rope_base: float = 10000.0
+    # Megatron vocab parallelism: the embedding table shards its VOCAB
+    # rows over tp.  Lookup becomes mask + tp-allreduce; the LM loss
+    # computes a fused vocab-parallel cross-entropy on the SHARDED
+    # logits (pmax/psum over tp) so the (B, T, vocab) logits matrix —
+    # the last replicated memory hog — never materializes in the train
+    # step.  Requires vocab divisible by tp.
+    vocab_parallel: bool = False
     # rematerialize each block on the backward pass (jax.checkpoint):
     # trades ~30% more FLOPs in exchange for activation memory that no
     # longer scales with n_layers — the standard TPU recipe for fitting
@@ -117,7 +124,10 @@ def param_specs(cfg: TransformerConfig) -> Dict:
         "ln2": P(None),
     }
     out = {
-        "embed": P(None, None),
+        # vocab parallelism shards the table's VOCAB rows over tp (the
+        # pos table and everything fed by the tp-allreduced lookup stay
+        # replicated)
+        "embed": P("tp", None) if cfg.vocab_parallel else P(None, None),
         "ln_f": P(None),
         "layers": [dict(layer) for _ in range(cfg.n_layers)],
     }
@@ -173,14 +183,54 @@ def _layernorm(x, scale):
     return (x - mu) * jax.lax.rsqrt(var + 1e-5) * scale
 
 
-def _embed_tokens(params, tokens, cfg) -> jax.Array:
+def _vp_active(cfg, tp_axis) -> bool:
+    return bool(cfg.vocab_parallel) and tp_axis is not None
+
+
+def _vp_local_ids(ids, vl: int, vocab: int, tp_axis):
+    """Map global ids onto this rank's vocab shard of ``vl`` rows.
+    Returns ``(local, mine)``: in-shard row indices and the ownership
+    mask.  Ids are clipped to ``[0, vocab)`` FIRST so out-of-range ids
+    resolve to the last vocab row on exactly one shard — the same clamp
+    semantics as the replicated ``embed[ids]`` gather."""
+    from jax import lax
+
+    ids = jnp.clip(ids, 0, vocab - 1)
+    local = ids - lax.axis_index(tp_axis) * vl
+    mine = (local >= 0) & (local < vl)
+    return jnp.clip(local, 0, vl - 1), mine
+
+
+def _embed_rows(embed, ids, cfg, tp_axis) -> jax.Array:
+    """Embedding lookup that understands a vocab-row-sharded table: each
+    rank looks up the ids it owns (masked) and a tp-allreduce assembles
+    the rest — the Megatron vocab-parallel embedding."""
+    if not _vp_active(cfg, tp_axis):
+        return embed[ids]
+    local, mine = _vp_local_ids(ids, embed.shape[0], cfg.vocab, tp_axis)
+    out = embed[local] * mine[..., None].astype(embed.dtype)
+    return collectives.allreduce(out, tp_axis, ReduceFunction.SUM)
+
+
+def _embed_tokens(params, tokens, cfg, tp_axis=None) -> jax.Array:
     """Token embeddings, plus the learned position table unless the
     config uses rotary embeddings (rope encodes position inside
     attention, so there is no table to add)."""
-    x = params["embed"][tokens]
+    x = _embed_rows(params["embed"], tokens, cfg, tp_axis)
     if not cfg.uses_rope():
         x = x + params["pos"][: tokens.shape[1]]
     return x
+
+
+def _lm_logits(x, embed, cfg, tp_axis, gather: bool = True) -> jax.Array:
+    """Tied LM head ``x @ embed.T``.  Under vocab parallelism the product
+    is VOCAB-SHARDED ``(..., vocab/tp)``; ``gather=True`` (the forward()
+    API contract) reassembles the full vocab axis, ``gather=False``
+    leaves the shards for the fused loss."""
+    z = x @ embed.T
+    if _vp_active(cfg, tp_axis) and gather:
+        z = collectives.allgather_invariant(z, tp_axis, axis=z.ndim - 1)
+    return z
 
 
 def _rope_tables(positions, half: int, base: float):
@@ -384,6 +434,11 @@ def _enter_block_layout(x, cfg, tp_axis, tp_size, return_kv=False,
     from jax import lax
 
     heads_local = cfg.n_heads // tp_size
+    if cfg.vocab_parallel and tp_size > 1 and cfg.vocab % tp_size:
+        raise ValueError(
+            f"vocab_parallel needs vocab ({cfg.vocab}) divisible by tp "
+            f"({tp_size})"
+        )
     if tp_size > 1 and cfg.kv_heads() % tp_size:
         raise ValueError(
             f"n_kv_heads ({cfg.kv_heads()}) must be divisible by tp "
@@ -412,18 +467,33 @@ def _enter_block_layout(x, cfg, tp_axis, tp_size, return_kv=False,
     return x, partial(_block_sp, **kw), True
 
 
-def forward(params, tokens, cfg: TransformerConfig, tp_axis=None, tp_size=1):
-    """Logits for a token batch.  With tp_axis set, runs on weight shards
-    inside shard_map; without, a plain single-device forward."""
-    B, T = tokens.shape
-    x = _embed_tokens(params, tokens, cfg)
+def _final_hidden(params, tokens, cfg, tp_axis=None, tp_size=1):
+    """Embed -> blocks -> final layernorm.  Returns ``(x, sp)`` where
+    ``sp`` flags that ``x`` is still sequence-sharded over tp (the
+    Megatron-SP regime) — shared by forward() and the fused loss."""
+    x = _embed_tokens(params, tokens, cfg, tp_axis)
     x, block, sp = _enter_block_layout(x, cfg, tp_axis, tp_size)
     if cfg.remat:
         block = jax.checkpoint(block)
     for lp in params["layers"]:
         x = block(x, lp)
-    x = _layernorm(x, params["ln_f"])
-    logits = x @ params["embed"].T
+    return _layernorm(x, params["ln_f"]), sp
+
+
+def forward(params, tokens, cfg: TransformerConfig, tp_axis=None, tp_size=1):
+    """Logits for a token batch.  With tp_axis set, runs on weight shards
+    inside shard_map; without, a plain single-device forward.  Always
+    returns the FULL-vocab logits (vocab-parallel shards are gathered —
+    use :func:`loss_fn` for the fused form that never materializes
+    them)."""
+    x, sp = _final_hidden(params, tokens, cfg, tp_axis, tp_size)
+    if sp and _vp_active(cfg, tp_axis):
+        # vocab-parallel head under SP: gather the sequence FIRST (every
+        # rank needs every row to score its vocab shard — the Megatron
+        # layout; gathering hidden is vocab/d_model cheaper than logits)
+        x = collectives.allgather_invariant(x, tp_axis, axis=1)
+        sp = False
+    logits = _lm_logits(x, params["embed"], cfg, tp_axis)
     if sp:
         # leave the sharded regime: gather the sequence back (invariant
         # form — the caller may claim tp-replicated outputs)
@@ -434,9 +504,60 @@ def forward(params, tokens, cfg: TransformerConfig, tp_axis=None, tp_size=1):
 
 
 def loss_fn(params, tokens, targets, cfg, tp_axis=None, tp_size=1):
-    logits = forward(params, tokens, cfg, tp_axis, tp_size)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)
+    """Mean next-token NLL.  Under ``cfg.vocab_parallel`` (with a tp
+    axis) the cross-entropy is computed FUSED on the vocab-sharded
+    logits — per-rank max/sum-exp/target-logit combined with tp
+    collectives (the Megatron vocab-parallel loss) — so the full
+    (B, T, vocab) logits never exist; under seq-parallel the hidden is
+    gathered out of the SP regime first (the Megatron layout — every
+    rank scores every row against its vocab shard)."""
+    if not _vp_active(cfg, tp_axis):
+        logits = forward(params, tokens, cfg, tp_axis, tp_size)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(
+            logp, targets[..., None], axis=-1
+        ).squeeze(-1)
+        return nll.mean()
+
+    from jax import lax
+
+    x, sp = _final_hidden(params, tokens, cfg, tp_axis, tp_size)
+    if sp:
+        # exit sequence parallelism BEFORE the vocab-parallel head (the
+        # Megatron layout): every rank needs every row's hidden state to
+        # score its vocab shard.  Gathering the (B, T, d_model) hidden
+        # costs vocab/d_model LESS wire+memory than gathering logits —
+        # the saving the fused loss exists for.
+        x = collectives.allgather_invariant(x, tp_axis, axis=1)
+    z = _lm_logits(x, params["embed"], cfg, tp_axis, gather=False)
+    # f32 softmax statistics (bf16 logits overflow exp quickly)
+    z = z.astype(jnp.float32)
+    tgt = targets
+    # stable logsumexp across the vocab shards: global max, then psum of
+    # the local exp-sums.  The max is a gather of the tp per-shard maxes
+    # rather than a pmax: under value_and_grad the pmax primitive has no
+    # differentiation rule (even stop_gradient'ed, linearization still
+    # traverses it), and the INVARIANT gather form keeps the loss
+    # tp-replicated for shard_map's checker
+    zmax = lax.stop_gradient(
+        collectives.allgather_invariant(
+            z.max(axis=-1), tp_axis, axis=0, tiled=False
+        ).max(axis=0)
+    )
+    sumexp = collectives.allreduce(
+        jnp.exp(z - zmax[..., None]).sum(axis=-1),
+        tp_axis,
+        ReduceFunction.SUM,
+    )
+    # the target's logit: owned by exactly one vocab shard
+    local_t, mine = _vp_local_ids(tgt, z.shape[-1], cfg.vocab, tp_axis)
+    zt_local = jnp.take_along_axis(
+        z, local_t[..., None], axis=-1
+    ).squeeze(-1)
+    zt = collectives.allreduce(
+        jnp.where(mine, zt_local, 0.0), tp_axis, ReduceFunction.SUM
+    )
+    nll = jnp.log(sumexp) + zmax - zt
     return nll.mean()
 
 
@@ -521,7 +642,7 @@ def prefill(
     block already runs on the gathered sequence."""
     B, T = tokens.shape
     S = cfg.max_seq if cache_len is None else int(cache_len)
-    x = _embed_tokens(params, tokens, cfg)
+    x = _embed_tokens(params, tokens, cfg, tp_axis)
     kv_local = cfg.kv_heads() // tp_size  # GQA: cache holds kv heads only
     hd = cfg.d_model // cfg.n_heads
     x, block_kv, sp = _enter_block_layout(
@@ -540,7 +661,7 @@ def prefill(
         # the prompt's final position lives on the LAST sequence shard;
         # broadcast its activation to the gang for the shared logits
         last = collectives.bcast(last, tp_axis, root=tp_size - 1)
-    return last @ params["embed"].T, caches
+    return _lm_logits(last, params["embed"], cfg, tp_axis), caches
 
 
 def _select_token(logits, key, temperature: float, top_k: Optional[int]):
@@ -611,7 +732,7 @@ def generate(
 
     def step(carry, _):
         caches, tok, pos, key = carry
-        x = params["embed"][tok][:, None, :]
+        x = _embed_rows(params["embed"], tok, cfg, tp_axis)[:, None, :]
         tables = None
         if rope is None:
             pos_emb = jax.lax.dynamic_slice_in_dim(params["pos"], pos, 1, 0)
@@ -627,7 +748,7 @@ def generate(
             )
             new_caches.append((ck, cv))
         x = _layernorm(x, params["ln_f"])
-        logits = x[:, 0] @ params["embed"].T
+        logits = _lm_logits(x[:, 0], params["embed"], cfg, tp_axis)
         key, sub = jax.random.split(key)
         nxt = _select_token(logits, sub, temperature, top_k).astype(tok.dtype)
         return (new_caches, nxt, pos + 1, key), tok
