@@ -140,6 +140,11 @@ def make_pp_train_step(
             "vocab_parallel is supported on the decoder flagship only "
             "(forward/loss_fn/generate), not the composed pipeline"
         )
+    if cfg.context_parallel:
+        raise ValueError(
+            "context_parallel is supported on the decoder flagship only "
+            "(forward/loss_fn), not the composed pipeline"
+        )
     M = num_microbatches
     heads_local = cfg.n_heads // tp
     specs = stacked_param_specs(cfg)

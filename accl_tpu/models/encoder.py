@@ -58,6 +58,12 @@ def encoder_forward(
             "vocab_parallel is supported on the decoder flagship only "
             "(forward/loss_fn/generate), not the encoder family"
         )
+    if cfg.context_parallel:
+        raise ValueError(
+            "context_parallel is causal/decoder-only (the striped ring's "
+            "load balance argument is the causal mask) — not the encoder "
+            "family"
+        )
     B, T = tokens.shape
     x = _embed_tokens(params, tokens, cfg)
     x, block, sp = _enter_block_layout(

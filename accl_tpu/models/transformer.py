@@ -66,6 +66,20 @@ class TransformerConfig:
     # the last replicated memory hog — never materializes in the train
     # step.  Requires vocab divisible by tp.
     vocab_parallel: bool = False
+    # ring/context parallelism (long-context training): the tp mesh axis
+    # becomes a SEQUENCE ring — weights are fully replicated over it,
+    # activations stay sequence-sharded (T/cp per chip) through the
+    # whole stack in the STRIPED (round-robin) layout, and attention is
+    # striped causal ring attention (K/V blocks rotate by neighbor
+    # ppermute — ICI hops — folding into each rank's online-softmax
+    # state; under GQA the UNEXPANDED kv heads rotate, G x less wire).
+    # The loss is computed on the local shard and psum-averaged, so no
+    # rank ever materializes full-sequence activations: per-chip memory
+    # for T scales as T/cp — the long-context axis.  Training-only
+    # (decode serves with context_parallel=False: the params are
+    # replicated, so they re-shard directly); incompatible with
+    # seq_parallel and vocab_parallel, which give the tp axis other jobs.
+    context_parallel: bool = False
     # rematerialize each block on the backward pass (jax.checkpoint):
     # trades ~30% more FLOPs in exchange for activation memory that no
     # longer scales with n_layers — the standard TPU recipe for fitting
@@ -110,19 +124,40 @@ class TransformerConfig:
         return self.pos_embedding == "rope"
 
 
+def _check_axis_compat(cfg) -> None:
+    """context_parallel turns the tp axis into the sequence ring —
+    it cannot share that axis with the strategies that give tp other
+    jobs (head-sharded weights + sequence/vocab sharding)."""
+    if cfg.context_parallel and (cfg.seq_parallel or cfg.vocab_parallel):
+        raise ValueError(
+            "context_parallel is incompatible with seq_parallel and "
+            "vocab_parallel: the tp mesh axis becomes the sequence ring "
+            "(weights replicated over it)"
+        )
+
+
 # parameter partition specs over ('dp', 'tp'): column-parallel weights shard
 # their output dim on tp, row-parallel weights their input dim.
 def param_specs(cfg: TransformerConfig) -> Dict:
-    layer = {
-        "wq": P(None, "tp"),  # (d_model, d_model/tp): heads sharded
-        "wk": P(None, "tp"),
-        "wv": P(None, "tp"),
-        "wo": P("tp", None),  # (d_model/tp, d_model)
-        "w1": P(None, "tp"),  # (d_model, d_ff/tp)
-        "w2": P("tp", None),  # (d_ff/tp, d_model)
-        "ln1": P(None),
-        "ln2": P(None),
-    }
+    _check_axis_compat(cfg)
+    if cfg.context_parallel:
+        # context parallelism: the tp axis carries the SEQUENCE ring, so
+        # every weight is replicated over it (dp still shards the batch)
+        layer = {
+            k: P(None, None) if k[0] == "w" else P(None)
+            for k in ("wq", "wk", "wv", "wo", "w1", "w2", "ln1", "ln2")
+        }
+    else:
+        layer = {
+            "wq": P(None, "tp"),  # (d_model, d_model/tp): heads sharded
+            "wk": P(None, "tp"),
+            "wv": P(None, "tp"),
+            "wo": P("tp", None),  # (d_model/tp, d_model)
+            "w1": P(None, "tp"),  # (d_model, d_ff/tp)
+            "w2": P("tp", None),  # (d_ff/tp, d_model)
+            "ln1": P(None),
+            "ln2": P(None),
+        }
     out = {
         # vocab parallelism shards the table's VOCAB rows over tp (the
         # pos table and everything fed by the tp-allreduced lookup stay
@@ -187,6 +222,19 @@ def _vp_active(cfg, tp_axis) -> bool:
     return bool(cfg.vocab_parallel) and tp_axis is not None
 
 
+def _cp_active(cfg, tp_axis) -> bool:
+    return bool(cfg.context_parallel) and tp_axis is not None
+
+
+def _cp_positions(t_local: int, axis):
+    """Global token positions of this rank's STRIPED sequence shard:
+    local position ``t`` holds global token ``t * ring_size + rank``
+    (see :func:`ring_attention.stripe_sequence`)."""
+    from jax import lax
+
+    return jnp.arange(t_local) * lax.axis_size(axis) + lax.axis_index(axis)
+
+
 def _vp_local_ids(ids, vl: int, vocab: int, tp_axis):
     """Map global ids onto this rank's vocab shard of ``vl`` rows.
     Returns ``(local, mine)``: in-shard row indices and the ownership
@@ -215,11 +263,26 @@ def _embed_rows(embed, ids, cfg, tp_axis) -> jax.Array:
 def _embed_tokens(params, tokens, cfg, tp_axis=None) -> jax.Array:
     """Token embeddings, plus the learned position table unless the
     config uses rotary embeddings (rope encodes position inside
-    attention, so there is no table to add)."""
+    attention, so there is no table to add).  Under context parallelism
+    ``tokens`` is this rank's STRIPED shard, so the pos rows are
+    gathered at the shard's global positions."""
     x = _embed_rows(params["embed"], tokens, cfg, tp_axis)
     if not cfg.uses_rope():
-        x = x + params["pos"][: tokens.shape[1]]
+        if _cp_active(cfg, tp_axis):
+            x = x + params["pos"][_cp_positions(tokens.shape[1], tp_axis)]
+        else:
+            x = x + params["pos"][: tokens.shape[1]]
     return x
+
+
+def _token_nll(logits, targets) -> jax.Array:
+    """Per-token next-token NLL from full-vocab logits.  Softmax
+    statistics run in f32 (bf16 logits overflow exp quickly — the same
+    dtype policy as the fused vocab-parallel form)."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return -jnp.take_along_axis(
+        logp, targets[..., None], axis=-1
+    ).squeeze(-1)
 
 
 def _lm_logits(x, embed, cfg, tp_axis, gather: bool = True) -> jax.Array:
@@ -344,7 +407,7 @@ def _mlp(x, lp, tp_axis):
 
 
 def _attn_partial(h, lp, n_heads_local, attn_impl="naive", causal=True,
-                  rope_base=None):
+                  rope_base=None, positions=None, attention_fn=None):
     """Column-parallel attention on a full-sequence activation: returns
     the row-parallel PARTIAL output (pre-reduction) and the (k, v) head
     tensors (B, Hkv_local, T, hd) for KV-cache prefill.  The kv head
@@ -352,7 +415,12 @@ def _attn_partial(h, lp, n_heads_local, attn_impl="naive", causal=True,
     heads; every attention lowering groups q heads onto kv head h//G).
     With ``rope_base`` set, q/k rotate by absolute position BEFORE
     attention (and before the kv tensors are returned, so the prefill
-    cache stores rotated keys — decode appends consistently)."""
+    cache stores rotated keys — decode appends consistently).
+
+    ``positions`` overrides the rope positions (context parallelism
+    passes its shard's global token positions); ``attention_fn``
+    replaces the dense :func:`_attention` lowering (context parallelism
+    passes the striped ring)."""
     B, T, _ = h.shape
     q, k, v = h @ lp["wq"], h @ lp["wk"], h @ lp["wv"]  # column-parallel
     hd = q.shape[-1] // n_heads_local
@@ -362,10 +430,14 @@ def _attn_partial(h, lp, n_heads_local, attn_impl="naive", causal=True,
         heads(q, n_heads_local), heads(k, n_kv_local), heads(v, n_kv_local)
     )
     if rope_base is not None:
-        tables = _rope_tables(jnp.arange(T), hd // 2, rope_base)
+        pos = jnp.arange(T) if positions is None else positions
+        tables = _rope_tables(pos, hd // 2, rope_base)
         q = _rope_rotate(q, tables)
         k = _rope_rotate(k, tables)
-    attn = _attention(q, k, v, impl=attn_impl, causal=causal)
+    if attention_fn is not None:
+        attn = attention_fn(q, k, v)
+    else:
+        attn = _attention(q, k, v, impl=attn_impl, causal=causal)
     attn = attn.transpose(0, 2, 1, 3).reshape(B, T, -1)
     return attn @ lp["wo"], (k, v)
 
@@ -387,6 +459,48 @@ def _block(x, lp, n_heads_local, tp_axis, return_kv=False,
     x = x + partial_o
     out = _mlp(x, lp, tp_axis)
     return (out, kv) if return_kv else out
+
+
+def _cp_block_k(t_local: int, attn_impl: str):
+    """Within-hop sub-tiling for the ring fold, honoring the config's
+    attention memory contract: "naive" folds whole visiting blocks
+    ((Tq, T_local) score tiles); "blockwise"/"flash" always sub-tile
+    (the (Tq, block_k) tile is those lowerings' whole point); "auto"
+    sub-tiles at/above the measured fused crossover, like the dense
+    auto lowering."""
+    if attn_impl == "naive":
+        return None
+    if attn_impl == "auto" and t_local < _AUTO_FUSED_MIN_T:
+        return None
+    for b in (512, 256, 128, 64):
+        if t_local % b == 0 and b < t_local:
+            return b
+    return None  # tiny/ragged shard: whole-hop fold is already small
+
+
+def _block_cp(x, lp, n_heads, cp_axis, rope_base=None, attn_impl="auto"):
+    """Context-parallel block: ``x`` is (B, T/cp, D), this rank's STRIPED
+    sequence shard over ``cp_axis``; weights are full (replicated over
+    the axis).  QKV/MLP matmuls are purely local; attention is striped
+    causal ring attention — K/V blocks (unexpanded kv heads under GQA)
+    rotate around the ring folding into the local online-softmax state —
+    so nothing in the block ever materializes the full sequence.  Rope
+    rotates by the shard's GLOBAL token positions; ``attn_impl`` maps to
+    the fold's within-hop sub-tiling (:func:`_cp_block_k`)."""
+    from .ring_attention import striped_attention
+
+    positions = _cp_positions(x.shape[1], cp_axis)
+    block_k = _cp_block_k(x.shape[1], attn_impl)
+    ring = lambda q, k, v: striped_attention(
+        q, k, v, cp_axis, causal=True, block_k=block_k
+    )
+    h = _layernorm(x, lp["ln1"])
+    o, _ = _attn_partial(
+        h, lp, n_heads, rope_base=rope_base,
+        positions=positions, attention_fn=ring,
+    )
+    x = x + o
+    return _mlp(x, lp, None)
 
 
 def _block_sp(x_sp, lp, n_heads_local, tp_axis, return_kv=False,
@@ -427,12 +541,35 @@ def _enter_block_layout(x, cfg, tp_axis, tp_size, return_kv=False,
 
     Under Megatron-SP (``cfg.seq_parallel`` with a real tp axis) the
     sequence dim is sharded over tp — this rank keeps its T/tp slice and
-    blocks run :func:`_block_sp`; otherwise activations stay replicated
+    blocks run :func:`_block_sp`; under context parallelism ``x`` is
+    ALREADY this rank's striped shard (the makers shard the tokens) and
+    blocks run :func:`_block_cp`; otherwise activations stay replicated
     and blocks run :func:`_block`.  Shared by the training forward and
     the serving prefill so the two paths cannot diverge on the entry
-    invariant.  Returns (x, block_fn, sp)."""
+    invariant.  Returns ``(x, block_fn, layout)`` with layout one of
+    ``""`` (replicated), ``"sp"``, ``"cp"`` — truthy means x is
+    sequence-sharded."""
     from jax import lax
 
+    _check_axis_compat(cfg)
+    if _cp_active(cfg, tp_axis):
+        if return_kv:
+            raise ValueError(
+                "context_parallel has no serving path: decode with "
+                "dataclasses.replace(cfg, context_parallel=False) — cp "
+                "params are replicated over tp and re-shard directly"
+            )
+        if not causal:
+            raise ValueError(
+                "context_parallel is causal/decoder-only (the striped "
+                "ring's load balance argument is the causal mask)"
+            )
+        block = partial(
+            _block_cp, n_heads=cfg.n_heads, cp_axis=tp_axis,
+            rope_base=cfg.rope_base if cfg.uses_rope() else None,
+            attn_impl=cfg.attention,
+        )
+        return x, block, "cp"
     heads_local = cfg.n_heads // tp_size
     if cfg.vocab_parallel and tp_size > 1 and cfg.vocab % tp_size:
         raise ValueError(
@@ -453,7 +590,7 @@ def _enter_block_layout(x, cfg, tp_axis, tp_size, return_kv=False,
     if return_kv:
         kw["return_kv"] = True
     if not sp:
-        return x, partial(_block, **kw), False
+        return x, partial(_block, **kw), ""
     T = x.shape[1]
     if T % tp_size:
         raise ValueError(
@@ -464,7 +601,7 @@ def _enter_block_layout(x, cfg, tp_axis, tp_size, return_kv=False,
     Tl = T // tp_size
     idx = lax.axis_index(tp_axis)
     x = lax.dynamic_slice_in_dim(x, idx * Tl, Tl, axis=1)
-    return x, partial(_block_sp, **kw), True
+    return x, partial(_block_sp, **kw), "sp"
 
 
 def _final_hidden(params, tokens, cfg, tp_axis=None, tp_size=1):
@@ -485,8 +622,15 @@ def forward(params, tokens, cfg: TransformerConfig, tp_axis=None, tp_size=1):
     inside shard_map; without, a plain single-device forward.  Always
     returns the FULL-vocab logits (vocab-parallel shards are gathered —
     use :func:`loss_fn` for the fused form that never materializes
-    them)."""
+    them).
+
+    Exception: under context parallelism the return value is this
+    rank's striped (B, T/cp, vocab) logits shard — the makers'
+    ``out_specs`` reassemble the sequence with zero inner wire instead
+    of replicating full-sequence logits on every ring rank."""
     x, sp = _final_hidden(params, tokens, cfg, tp_axis, tp_size)
+    if sp == "cp":
+        return _lm_logits(x, params["embed"], cfg, tp_axis)
     if sp and _vp_active(cfg, tp_axis):
         # vocab-parallel head under SP: gather the sequence FIRST (every
         # rank needs every row to score its vocab shard — the Megatron
@@ -510,14 +654,24 @@ def loss_fn(params, tokens, targets, cfg, tp_axis=None, tp_size=1):
     collectives (the Megatron vocab-parallel loss) — so the full
     (B, T, vocab) logits never exist; under seq-parallel the hidden is
     gathered out of the SP regime first (the Megatron layout — every
-    rank scores every row against its vocab shard)."""
+    rank scores every row against its vocab shard).
+
+    Under ``cfg.context_parallel`` ``tokens``/``targets`` are this
+    rank's STRIPED sequence shards: the cross-entropy stays local
+    ((B, T/cp, vocab) logits only) and the ring-mean of the equal-sized
+    shard means is the global mean — full-sequence activations never
+    exist on any rank."""
+    if _cp_active(cfg, tp_axis):
+        x, _ = _final_hidden(params, tokens, cfg, tp_axis, tp_size)
+        z = _lm_logits(x, params["embed"], cfg, tp_axis, gather=False)
+        nll = _token_nll(z, targets)
+        return (
+            collectives.allreduce(nll.mean(), tp_axis, ReduceFunction.SUM)
+            / tp_size
+        )
     if not _vp_active(cfg, tp_axis):
         logits = forward(params, tokens, cfg, tp_axis, tp_size)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(
-            logp, targets[..., None], axis=-1
-        ).squeeze(-1)
-        return nll.mean()
+        return _token_nll(logits, targets).mean()
 
     from jax import lax
 
@@ -774,6 +928,12 @@ def make_sharded_generate(
     the returned fn takes (params, prompt, rng) — the key is replicated,
     then folded with the dp index so each batch shard draws its own
     stream while a tp gang stays in lockstep."""
+    if cfg.context_parallel:
+        raise ValueError(
+            "context_parallel has no serving path: decode with "
+            "dataclasses.replace(cfg, context_parallel=False) — cp "
+            "params are replicated over tp and re-shard directly"
+        )
     specs = param_specs(cfg)
     tp = mesh.shape["tp"]
 
@@ -812,6 +972,21 @@ def make_sharded_generate(
 # ---------------------------------------------------------------------------
 
 
+def _reshard(x, mesh, spec):
+    """Constrain ``x`` to ``spec`` on ``mesh``, working under BOTH mesh
+    axis modes: explicit axes take :func:`jax.sharding.reshard`,
+    auto axes take ``with_sharding_constraint``."""
+    s = NamedSharding(mesh, spec)
+    try:
+        from jax.sharding import AxisType
+
+        if AxisType.Explicit in mesh.axis_types:
+            return jax.sharding.reshard(x, s)
+    except ImportError:  # pragma: no cover - older jax: auto-only meshes
+        pass
+    return jax.lax.with_sharding_constraint(x, s)
+
+
 def _shard_params(params, specs, mesh):
     # copy before committing: device_put may ALIAS the source buffer (it
     # does on CPU), and the train step donates its params — without the
@@ -825,22 +1000,53 @@ def _shard_params(params, specs, mesh):
 
 
 def make_sharded_forward(cfg: TransformerConfig, mesh: Mesh):
-    """Jitted tp/dp-sharded forward over the mesh; returns (fn, shard_fn)."""
+    """Jitted tp/dp-sharded forward over the mesh; returns (fn, shard_fn).
+
+    Under ``cfg.context_parallel`` the tokens are striped and
+    sequence-sharded over tp on the way in and the logits unstriped on
+    the way out, so the caller-facing contract (full-sequence tokens in
+    token order -> full logits in token order) is unchanged."""
     specs = param_specs(cfg)
     tp = mesh.shape["tp"]
 
     def fwd(params, tokens):
         return forward(params, tokens, cfg, tp_axis="tp", tp_size=tp)
 
-    fn = jax.jit(
-        shard_map(
+    if cfg.context_parallel:
+        from .ring_attention import stripe_sequence, unstripe_sequence
+
+        # each rank emits its striped (B, T/cp, vocab) shard; the
+        # out_specs concatenation IS the striped full sequence (stripe =
+        # contiguous sharding of the striped order) — no inner gather,
+        # no replicated full-logits buffer
+        smapped = shard_map(
             fwd,
             mesh=mesh,
-            in_specs=(specs, P("dp", None)),
-            out_specs=P("dp", None, None),
+            in_specs=(specs, P("dp", "tp")),
+            out_specs=P("dp", "tp", None),
             check_vma=False,
         )
-    )
+
+        def outer(params, tokens):
+            out = smapped(params, stripe_sequence(tokens, tp, axis=1))
+            # the API contract returns full logits: reassemble the
+            # sequence once at the program's exit edge (under explicit
+            # mesh axes the unstripe permutation cannot run on a
+            # sequence-sharded operand, so reshard first)
+            out = _reshard(out, mesh, P("dp", None, None))
+            return unstripe_sequence(out, tp, axis=1)
+
+        fn = jax.jit(outer)
+    else:
+        fn = jax.jit(
+            shard_map(
+                fwd,
+                mesh=mesh,
+                in_specs=(specs, P("dp", None)),
+                out_specs=P("dp", None, None),
+                check_vma=False,
+            )
+        )
     return fn, partial(_shard_params, specs=specs, mesh=mesh)
 
 
@@ -878,13 +1084,33 @@ def make_sharded_train_step(cfg: TransformerConfig, mesh: Mesh, lr: float = 1e-2
         params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
         return params, loss
 
+    # context parallelism: tokens/targets are striped (outside shard_map
+    # — a global permutation) and sequence-sharded over tp; the loss's
+    # ring-mean keeps the differentiated quantity the global mean, so
+    # the replicated weights' grads get the tp-psum from shard_map's
+    # transpose machinery exactly like dp's
+    seq_spec = P("dp", "tp") if cfg.context_parallel else P("dp", None)
+    smapped = shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(specs, seq_spec, seq_spec),
+        out_specs=(specs, P()),
+    )
+    if cfg.context_parallel:
+        from .ring_attention import stripe_sequence
+
+        def outer(params, tokens, targets):
+            return smapped(
+                params,
+                stripe_sequence(tokens, tp, axis=1),
+                stripe_sequence(targets, tp, axis=1),
+            )
+
+        body = outer
+    else:
+        body = smapped
     fn = jax.jit(
-        shard_map(
-            step,
-            mesh=mesh,
-            in_specs=(specs, P("dp", None), P("dp", None)),
-            out_specs=(specs, P()),
-        ),
+        body,
         # the old params' HBM is dead the moment the SGD update exists:
         # donating it lets XLA update in place (ref: in-place device BOs)
         donate_argnums=(0,),
