@@ -42,6 +42,8 @@ def train(
     optimizer: str = "sgd",
     parallelism: str = "dp_tp",
     data: Optional[str] = None,
+    accum_steps: int = 1,
+    clip_grad_norm: Optional[float] = None,
 ):
     """Train the flagship transformer.
 
@@ -56,6 +58,8 @@ def train(
     ``optimizer="zero_adam"`` switches the step to the ZeRO-sharded Adam
     (fp32 moments living 1/dp per chip, ``parallel/zero.py``); its
     optimizer state checkpoints and resumes alongside the params.
+    ``accum_steps``/``clip_grad_norm`` (zero_adam only) enable gradient
+    accumulation and global-L2-norm clipping.
 
     ``parallelism="pipeline"`` trains over the composed pp x dp x tp mesh
     (``models/composed.py``: pipeline stages of tp-sharded blocks,
@@ -86,6 +90,12 @@ def train(
         raise ValueError(f"unknown parallelism {parallelism!r}")
     if use_pp and optimizer != "sgd":
         raise ValueError("parallelism='pipeline' supports optimizer='sgd'")
+    if (accum_steps != 1 or clip_grad_norm is not None) and not (
+        optimizer == "zero_adam"
+    ):
+        raise ValueError(
+            "accum_steps/clip_grad_norm require optimizer='zero_adam'"
+        )
     pp = 2 if use_pp else 1
     if use_pp and len(devs) < 2:
         raise ValueError(
@@ -110,6 +120,9 @@ def train(
         d_ff=32 * heads, max_seq=32,
     )
     use_zero = optimizer == "zero_adam"
+    # per-dp-rank batch: 2 samples per MICRObatch, so accumulation grows
+    # the effective batch (its purpose) instead of shrinking microbatches
+    per_rank_b = 2 * accum_steps
     params0 = init_params(jax.random.PRNGKey(seed), cfg)
     if use_pp:
         from ..models import make_pp_train_step
@@ -121,7 +134,8 @@ def train(
         opt_state = None
     elif use_zero:
         step_fn, shard, init_state = make_zero_train_step(
-            cfg, mesh, AdamConfig(lr=0.01)
+            cfg, mesh, AdamConfig(lr=0.01, clip_grad_norm=clip_grad_norm),
+            accum_steps=accum_steps,
         )
         params = shard(params0)
         opt_state = init_state(params0)
@@ -195,7 +209,7 @@ def train(
         # single-controller: one loader feeds the whole dp-sharded batch
         # (multi-process deployments shard via shard/num_shards instead)
         loader = TokenLoader(
-            data, batch=2 * dp, seq=cfg.max_seq, seed=seed,
+            data, batch=per_rank_b * dp, seq=cfg.max_seq, seed=seed,
             start_step=start_step,
         )
     try:
@@ -216,10 +230,11 @@ def train(
             # consumes the exact token stream an uninterrupted run would,
             # so losses stay bit-comparable across restarts
             rng = np.random.default_rng([seed, it])
-            # per-dp-rank batch of 2 — which also divides the pipeline
-            # mode's num_microbatches=2 exactly
+            # per-dp-rank batch of 2 per microbatch — which also divides
+            # the pipeline mode's num_microbatches=2 exactly
             tokens = jnp.asarray(
-                rng.integers(0, cfg.vocab, (2 * dp, cfg.max_seq)), jnp.int32
+                rng.integers(0, cfg.vocab, (per_rank_b * dp, cfg.max_seq)),
+                jnp.int32,
             )
             targets = jnp.roll(tokens, -1, axis=1)
         if use_zero:
@@ -262,12 +277,21 @@ def main(argv=None) -> int:
         help="ACCLTOK1 token file (native prefetching loader); "
         "default: synthetic tokens",
     )
+    ap.add_argument(
+        "--accum-steps", type=int, default=1,
+        help="gradient accumulation microbatches per step (zero_adam)",
+    )
+    ap.add_argument(
+        "--clip-grad-norm", type=float, default=None,
+        help="global-L2-norm gradient clipping (zero_adam)",
+    )
     args = ap.parse_args(argv)
     train(
         steps=args.steps, ckpt_dir=args.ckpt_dir,
         save_every=args.save_every, tp=args.tp, seed=args.seed,
         platform=args.platform, optimizer=args.optimizer,
         parallelism=args.parallelism, data=args.data,
+        accum_steps=args.accum_steps, clip_grad_norm=args.clip_grad_norm,
     )
     return 0
 

@@ -13,6 +13,7 @@ from .multihost import (  # noqa: F401
 )
 from .zero import (  # noqa: F401
     AdamConfig,
+    clip_by_global_norm,
     schedule_lr,
     init_zero_state,
     make_zero_train_step,

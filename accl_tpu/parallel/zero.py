@@ -55,6 +55,11 @@ class AdamConfig(NamedTuple):
     warmup_steps: int = 0
     decay_steps: Optional[int] = None
     min_lr_ratio: float = 0.0
+    # global-L2-norm gradient clipping (None = off): the norm is the
+    # GLOBAL one — model-parallel shards psum their squared sums over
+    # tp, so every rank scales by the same factor and sharded/unsharded
+    # training see the identical clipped update
+    clip_grad_norm: Optional[float] = None
 
 
 def schedule_lr(cfg: AdamConfig, step):
@@ -153,6 +158,39 @@ def zero_state_specs(specs, dp_axis: str = "dp"):
     }
 
 
+def clip_by_global_norm(grads, specs, max_norm: float, tp_axis=None):
+    """Scale ``grads`` so their GLOBAL L2 norm is at most ``max_norm`` —
+    inside shard_map.  Leaves whose spec shards over ``tp_axis`` hold
+    disjoint slices (their local squared sums psum across tp to the
+    global contribution exactly once); replicated leaves already carry
+    the full gradient on every rank.  Grads are dp-replicated by the
+    time this runs (the loss mean's transpose placed the dp psum), so
+    no dp exchange is needed.  Returns ``(clipped_grads, global_norm)``."""
+    is_leaf = lambda x: isinstance(x, P)
+    gleaves = jax.tree.leaves(grads)
+    sleaves = jax.tree.leaves(specs, is_leaf=is_leaf)
+    sharded_sq = jnp.zeros((), jnp.float32)
+    repl_sq = jnp.zeros((), jnp.float32)
+    for g, s in zip(gleaves, sleaves):
+        ss = jnp.sum(jnp.square(g.astype(jnp.float32)))
+        if tp_axis is not None and tp_axis in _spec_axes(s):
+            sharded_sq = sharded_sq + ss
+        else:
+            repl_sq = repl_sq + ss
+    total = repl_sq
+    if tp_axis is not None:
+        total = total + lax.psum(sharded_sq, tp_axis)
+    else:
+        total = total + sharded_sq
+    norm = jnp.sqrt(total)
+    # scale = 1 when norm <= max_norm, else max_norm / norm
+    scale = (max_norm / jnp.maximum(norm, max_norm)).astype(jnp.float32)
+    clipped = jax.tree.map(
+        lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads
+    )
+    return clipped, norm
+
+
 def zero_adam_update(params, grads, state, dp_axis: str, cfg: AdamConfig):
     """One sharded Adam step — runs INSIDE shard_map.
 
@@ -225,11 +263,20 @@ def make_zero_train_step(
     model_cfg,
     mesh: Mesh,
     adam: AdamConfig = AdamConfig(),
+    accum_steps: int = 1,
 ):
     """dp x tp train step with ZeRO-sharded Adam: returns
     ``(step, shard_params, init_state)``; ``step(params, state, tokens,
     targets) -> (params, state, loss)``.  Donates params AND state (both
-    update in place on device)."""
+    update in place on device).
+
+    ``accum_steps > 1`` runs gradient accumulation: each rank's local
+    batch is split into that many microbatches, scanned with one
+    forward/backward each, and the AVERAGED gradient feeds a single
+    optimizer step — the effective batch grows by the factor while
+    activation memory stays at one microbatch (HBM, not FLOPs, is the
+    TPU ceiling).  ``adam.clip_grad_norm`` applies global-L2-norm
+    clipping to the (accumulated) gradient before the update."""
     from ..constants import ReduceFunction
     from ..models.transformer import (
         _reject_untrainable_attention,
@@ -247,15 +294,78 @@ def make_zero_train_step(
     tp = mesh.shape["tp"]
     dp = mesh.shape["dp"]
 
-    def step(params, state, tokens, targets):
-        def global_loss(p):
-            local = loss_fn(p, tokens, targets, model_cfg, "tp", tp)
-            return collectives.allreduce(local, "dp", ReduceFunction.SUM) / dp
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps ({accum_steps}) must be >= 1")
 
+    def step(params, state, tokens, targets):
         # varying-axis tracking places every gradient psum (tp AND dp)
         # exactly where replication demands — manual placement under
         # check_vma=False gets mixed replicated/sharded params wrong
-        loss, grads = jax.value_and_grad(global_loss)(params)
+        if accum_steps == 1:
+
+            def global_loss(p):
+                local = loss_fn(p, tokens, targets, model_cfg, "tp", tp)
+                return (
+                    collectives.allreduce(local, "dp", ReduceFunction.SUM)
+                    / dp
+                )
+
+            loss, grads = jax.value_and_grad(global_loss)(params)
+        else:
+            b = tokens.shape[0]
+            if b % accum_steps:
+                raise ValueError(
+                    f"per-rank batch ({b}) must divide by accum_steps "
+                    f"({accum_steps})"
+                )
+            mb = b // accum_steps
+            # differentiate at dp-VARYING params: a dp-varying microbatch
+            # loss would otherwise force the vma transpose to psum every
+            # microbatch's gradient back to the params' dp-invariance —
+            # pvary'd primals keep each microbatch's gradient dp-LOCAL,
+            # so the whole step pays ONE gradient psum after the scan
+            # (accum_steps x less cross-dp wire, identical math)
+            try:
+                _pvary = partial(lax.pcast, to="varying")
+            except AttributeError:  # pragma: no cover - older jax
+                _pvary = lax.pvary
+            params_v = jax.tree.map(lambda x: _pvary(x, ("dp",)), params)
+
+            def micro(tok, tgt):
+                return jax.value_and_grad(
+                    lambda p: loss_fn(p, tok, tgt, model_cfg, "tp", tp)
+                )(params_v)
+
+            def body(carry, tt):
+                acc_l, acc_g = carry
+                l, g = micro(tt[0], tt[1])
+                acc_g = jax.tree.map(
+                    lambda a, x: a + x.astype(jnp.float32), acc_g, g
+                )
+                return (acc_l + l, acc_g), None
+
+            toks = tokens.reshape(accum_steps, mb, -1)
+            tgts = targets.reshape(accum_steps, mb, -1)
+            # seed the carry with microbatch 0 (a fresh-zeros carry has
+            # unvarying axis types, which scan would reject against the
+            # dp/tp-varying gradients), then fold the rest
+            l0, g0 = micro(toks[0], tgts[0])
+            g0 = jax.tree.map(lambda x: x.astype(jnp.float32), g0)
+            (lsum, gsum), _ = lax.scan(body, (l0, g0), (toks[1:], tgts[1:]))
+            # the step's ONE cross-dp exchange
+            loss = (
+                collectives.allreduce(lsum, "dp", ReduceFunction.SUM)
+                / (dp * accum_steps)
+            )
+            grads = jax.tree.map(
+                lambda g: collectives.allreduce(g, "dp", ReduceFunction.SUM)
+                / (dp * accum_steps),
+                gsum,
+            )
+        if adam.clip_grad_norm is not None:
+            grads, _ = clip_by_global_norm(
+                grads, specs, adam.clip_grad_norm, "tp"
+            )
         new_params, new_state = zero_adam_update(
             params, grads, state, "dp", adam
         )
