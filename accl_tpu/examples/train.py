@@ -44,6 +44,8 @@ def train(
     data: Optional[str] = None,
     accum_steps: int = 1,
     clip_grad_norm: Optional[float] = None,
+    master_weights: bool = False,
+    dtype: str = "float32",
 ):
     """Train the flagship transformer.
 
@@ -58,8 +60,11 @@ def train(
     ``optimizer="zero_adam"`` switches the step to the ZeRO-sharded Adam
     (fp32 moments living 1/dp per chip, ``parallel/zero.py``); its
     optimizer state checkpoints and resumes alongside the params.
-    ``accum_steps``/``clip_grad_norm`` (zero_adam only) enable gradient
-    accumulation and global-L2-norm clipping.
+    ``accum_steps``/``clip_grad_norm``/``master_weights`` (zero_adam
+    only) enable gradient accumulation, global-L2-norm clipping, and the
+    fp32 master-weight track; ``dtype="bfloat16"`` trains bf16 params
+    (pair with master_weights — bf16's ulp otherwise swallows small
+    updates).
 
     ``parallelism="pipeline"`` trains over the composed pp x dp x tp mesh
     (``models/composed.py``: pipeline stages of tp-sharded blocks,
@@ -90,12 +95,15 @@ def train(
         raise ValueError(f"unknown parallelism {parallelism!r}")
     if use_pp and optimizer != "sgd":
         raise ValueError("parallelism='pipeline' supports optimizer='sgd'")
-    if (accum_steps != 1 or clip_grad_norm is not None) and not (
-        optimizer == "zero_adam"
-    ):
+    if (
+        accum_steps != 1 or clip_grad_norm is not None or master_weights
+    ) and optimizer != "zero_adam":
         raise ValueError(
-            "accum_steps/clip_grad_norm require optimizer='zero_adam'"
+            "accum_steps/clip_grad_norm/master_weights require "
+            "optimizer='zero_adam'"
         )
+    if dtype not in ("float32", "bfloat16"):
+        raise ValueError(f"unknown dtype {dtype!r}")
     pp = 2 if use_pp else 1
     if use_pp and len(devs) < 2:
         raise ValueError(
@@ -118,6 +126,7 @@ def train(
     cfg = TransformerConfig(
         vocab=128, d_model=16 * heads, n_heads=heads, n_layers=2,
         d_ff=32 * heads, max_seq=32,
+        dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32,
     )
     use_zero = optimizer == "zero_adam"
     # per-dp-rank batch: 2 samples per MICRObatch, so accumulation grows
@@ -134,7 +143,11 @@ def train(
         opt_state = None
     elif use_zero:
         step_fn, shard, init_state = make_zero_train_step(
-            cfg, mesh, AdamConfig(lr=0.01, clip_grad_norm=clip_grad_norm),
+            cfg, mesh,
+            AdamConfig(
+                lr=0.01, clip_grad_norm=clip_grad_norm,
+                master_weights=master_weights,
+            ),
             accum_steps=accum_steps,
         )
         params = shard(params0)
@@ -179,10 +192,13 @@ def train(
                     raise ValueError(
                         f"failed to restore {ckpt_dir} at step {latest} "
                         f"with optimizer={optimizer!r}, "
-                        f"parallelism={parallelism!r}; was the checkpoint "
-                        "saved with a different --optimizer or "
-                        "--parallelism? (pipeline mode stores layers "
-                        "STACKED, dp_tp stores them as a list)"
+                        f"parallelism={parallelism!r}, "
+                        f"master_weights={master_weights}; was the "
+                        "checkpoint saved with a different --optimizer, "
+                        "--parallelism, or --master-weights? (pipeline "
+                        "mode stores layers STACKED, dp_tp stores them "
+                        "as a list; master weights add a 'w' subtree to "
+                        "the optimizer state)"
                     ) from e
                 raise
             if use_zero:
@@ -285,6 +301,14 @@ def main(argv=None) -> int:
         "--clip-grad-norm", type=float, default=None,
         help="global-L2-norm gradient clipping (zero_adam)",
     )
+    ap.add_argument(
+        "--master-weights", action="store_true",
+        help="fp32 master-weight track in the optimizer state (zero_adam)",
+    )
+    ap.add_argument(
+        "--dtype", default="float32", choices=["float32", "bfloat16"],
+        help="parameter/activation dtype",
+    )
     args = ap.parse_args(argv)
     train(
         steps=args.steps, ckpt_dir=args.ckpt_dir,
@@ -292,6 +316,7 @@ def main(argv=None) -> int:
         platform=args.platform, optimizer=args.optimizer,
         parallelism=args.parallelism, data=args.data,
         accum_steps=args.accum_steps, clip_grad_norm=args.clip_grad_norm,
+        master_weights=args.master_weights, dtype=args.dtype,
     )
     return 0
 

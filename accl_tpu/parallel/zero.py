@@ -60,6 +60,14 @@ class AdamConfig(NamedTuple):
     # tp, so every rank scales by the same factor and sharded/unsharded
     # training see the identical clipped update
     clip_grad_norm: Optional[float] = None
+    # mixed precision: keep an fp32 MASTER copy of each rank's 1/dp
+    # parameter slice in the optimizer state (alongside the fp32
+    # moments) and update THAT; the working params are its cast.  With
+    # bf16 params this is the standard TPU recipe — bf16's ~3 decimal
+    # digits silently swallow updates below the param's ulp, while the
+    # master track accumulates them exactly.  Costs 4 extra bytes per
+    # param per dp group (sharded 1/dp like the moments).
+    master_weights: bool = False
 
 
 def schedule_lr(cfg: AdamConfig, step):
@@ -90,6 +98,28 @@ def _padded(n: int, dp: int) -> int:
     return -(-n // dp) * dp
 
 
+def _pad_flat(x, padded: int, dtype):
+    """Row-major flatten + zero-pad to ``padded`` — the shared layout
+    rule for every flat dp-sliced array (moments, master weights)."""
+    flat = x.reshape(-1).astype(dtype)
+    if padded != flat.shape[0]:
+        flat = jnp.concatenate(
+            [flat, jnp.zeros((padded - flat.shape[0],), dtype)]
+        )
+    return flat
+
+
+def _dp_slice(x, dp: int, idx):
+    """This rank's 1/dp slice of ``x`` flattened-and-padded in fp32 —
+    THE slice program: master-weight init and the Adam update both call
+    exactly this, so their layouts cannot desynchronize."""
+    padded = _padded(int(np.prod(x.shape)), dp)
+    return lax.dynamic_slice_in_dim(
+        _pad_flat(x, padded, jnp.float32), idx * (padded // dp),
+        padded // dp,
+    )
+
+
 def _spec_axes(spec) -> tuple:
     """Mesh axes a PartitionSpec shards over, flattened in order."""
     axes = []
@@ -112,11 +142,15 @@ def _state_spec(pspec, dp_axis: str):
     return P(tuple(axes) + (dp_axis,)) if axes else P(dp_axis)
 
 
-def init_zero_state(params, specs, mesh: Mesh, dp_axis: str = "dp"):
+def init_zero_state(params, specs, mesh: Mesh, dp_axis: str = "dp",
+                    master_weights: bool = False):
     """Sharded (m, v) fp32 moments + step counter: per leaf, a flat array
     whose sharding nests the param's own model-parallel axes around the
     dp slice axis, so every rank materializes exactly its 1/dp of its
-    parameter shard's moments."""
+    parameter shard's moments.  ``master_weights`` adds ``w``: the fp32
+    master copy of each rank's parameter slice, laid out identically —
+    built by the SAME pad/slice program the update uses, so the two can
+    never disagree on layout."""
     dp = mesh.shape[dp_axis]
 
     def zeros_for(p, pspec):
@@ -131,7 +165,7 @@ def init_zero_state(params, specs, mesh: Mesh, dp_axis: str = "dp"):
         # footprint — the exact memory this module exists to avoid
         return jnp.zeros((glen,), jnp.float32, device=sharding)
 
-    return {
+    state = {
         "m": jax.tree.map(zeros_for, params, specs),
         "v": jax.tree.map(zeros_for, params, specs),
         # committed replicated (not left uncommitted): checkpoint restore
@@ -141,9 +175,33 @@ def init_zero_state(params, specs, mesh: Mesh, dp_axis: str = "dp"):
             jnp.zeros((), jnp.int32), NamedSharding(mesh, P())
         ),
     }
+    if master_weights:
+        is_leaf = lambda x: isinstance(x, P)
+        wspecs = jax.tree.map(
+            lambda sp: _state_spec(sp, dp_axis), specs, is_leaf=is_leaf
+        )
+
+        def slices(p_tree):
+            dp_ = lax.axis_size(dp_axis)
+            idx = lax.axis_index(dp_axis)
+            return jax.tree.map(lambda p: _dp_slice(p, dp_, idx), p_tree)
+
+        sharded = jax.tree.map(
+            lambda p, sp: jax.device_put(
+                jnp.asarray(p), NamedSharding(mesh, sp)
+            ),
+            params, specs,
+        )
+        state["w"] = jax.jit(
+            shard_map(
+                slices, mesh=mesh, in_specs=(specs,), out_specs=wspecs
+            )
+        )(sharded)
+    return state
 
 
-def zero_state_specs(specs, dp_axis: str = "dp"):
+def zero_state_specs(specs, dp_axis: str = "dp",
+                     master_weights: bool = False):
     """PartitionSpec pytree matching :func:`init_zero_state` (for use as
     shard_map in/out specs).  ``specs`` is the PARAM spec tree
     (PartitionSpec is a tuple subclass, so it is treated as a leaf)."""
@@ -151,11 +209,14 @@ def zero_state_specs(specs, dp_axis: str = "dp"):
     leafmap = lambda t: jax.tree.map(
         lambda s: _state_spec(s, dp_axis), t, is_leaf=is_leaf
     )
-    return {
+    out = {
         "m": leafmap(specs),
         "v": leafmap(specs),
         "step": P(),
     }
+    if master_weights:
+        out["w"] = leafmap(specs)
+    return out
 
 
 def clip_by_global_norm(grads, specs, max_norm: float, tp_axis=None):
@@ -205,58 +266,58 @@ def zero_adam_update(params, grads, state, dp_axis: str, cfg: AdamConfig):
     bc2 = 1.0 - cfg.b2 ** step.astype(jnp.float32)
     lr_t = schedule_lr(cfg, step)
 
-    def pad_flat(x, padded, dtype):
-        flat = x.reshape(-1).astype(dtype)
-        if padded != flat.shape[0]:
-            flat = jnp.concatenate(
-                [flat, jnp.zeros((padded - flat.shape[0],), dtype)]
-            )
-        return flat
+    master = state.get("w")
 
-    def leaf(p, g, m, v):
+    def leaf(p, g, m, v, w):
         n = int(np.prod(p.shape))
-        padded = _padded(n, dp)
         # this rank's slice of the (already dp-reduced) mean gradient
-        gs = lax.dynamic_slice_in_dim(
-            pad_flat(g, padded, jnp.float32), idx * (padded // dp),
-            padded // dp,
-        )
+        gs = _dp_slice(g, dp, idx)
         m = cfg.b1 * m + (1.0 - cfg.b1) * gs
         v = cfg.b2 * v + (1.0 - cfg.b2) * gs * gs
         mhat = m / bc1
         vhat = v / bc2
         # this rank's parameter slice (of the PADDED flat, so the last
-        # rank's slice never clamps into its neighbor's), updated locally
-        shard = lax.dynamic_slice_in_dim(
-            pad_flat(p, padded, jnp.float32), idx * (padded // dp),
-            padded // dp,
-        )
+        # rank's slice never clamps into its neighbor's), updated
+        # locally.  With master weights the fp32 slice in the state IS
+        # the source of truth (the bf16 param is its lossy cast — slicing
+        # p instead would re-quantize every step and lose the small
+        # updates the master track exists to keep).
+        shard = _dp_slice(p, dp, idx) if w is None else w
         upd = mhat / (jnp.sqrt(vhat) + cfg.eps)
         if cfg.weight_decay and p.ndim > 1:
             # AdamW decoupled decay on the param slice itself; 1-D
             # leaves (ln scales, biases) are conventionally exempt
             upd = upd + cfg.weight_decay * shard
-        new_shard = (shard - lr_t * upd).astype(p.dtype)
+        new_w = shard - lr_t * upd
+        new_shard = new_w.astype(p.dtype)
         # rebuild the full parameter from the slices.  The plain
         # lax.all_gather can't be used: its output is conservatively
         # dp-varying, which shard_map's replication checker rejects for a
         # P(None)-spec'd output; allgather_invariant is the
         # Varying->Invariant form at allgather wire volume.
         new_flat = allgather_invariant(new_shard, dp_axis)
-        return new_flat[:n].reshape(p.shape), m, v
+        return new_flat[:n].reshape(p.shape), m, v, new_w
 
-    out = jax.tree.map(leaf, params, grads, state["m"], state["v"])
+    if master is None:
+        out = jax.tree.map(
+            lambda p, g, m, v: leaf(p, g, m, v, None),
+            params, grads, state["m"], state["v"],
+        )
+    else:
+        out = jax.tree.map(
+            leaf, params, grads, state["m"], state["v"], master
+        )
     flat_out = jax.tree.leaves(out, is_leaf=lambda x: isinstance(x, tuple))
-    new_params = jax.tree.unflatten(
-        jax.tree.structure(params), [t[0] for t in flat_out]
-    )
-    new_m = jax.tree.unflatten(
-        jax.tree.structure(params), [t[1] for t in flat_out]
-    )
-    new_v = jax.tree.unflatten(
-        jax.tree.structure(params), [t[2] for t in flat_out]
-    )
-    return new_params, {"m": new_m, "v": new_v, "step": step}
+    st = jax.tree.structure(params)
+    new_params = jax.tree.unflatten(st, [t[0] for t in flat_out])
+    new_state = {
+        "m": jax.tree.unflatten(st, [t[1] for t in flat_out]),
+        "v": jax.tree.unflatten(st, [t[2] for t in flat_out]),
+        "step": step,
+    }
+    if master is not None:
+        new_state["w"] = jax.tree.unflatten(st, [t[3] for t in flat_out])
+    return new_params, new_state
 
 
 def make_zero_train_step(
@@ -290,7 +351,7 @@ def make_zero_train_step(
     schedule_lr(adam, 1)  # fail fast on decay/warmup misconfiguration
 
     specs = param_specs(model_cfg)
-    sspecs = zero_state_specs(specs)
+    sspecs = zero_state_specs(specs, master_weights=adam.master_weights)
     tp = mesh.shape["tp"]
     dp = mesh.shape["dp"]
 
@@ -383,5 +444,8 @@ def make_zero_train_step(
     return (
         fn,
         partial(_shard_params, specs=specs, mesh=mesh),
-        partial(init_zero_state, specs=specs, mesh=mesh),
+        partial(
+            init_zero_state, specs=specs, mesh=mesh,
+            master_weights=adam.master_weights,
+        ),
     )

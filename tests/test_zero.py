@@ -309,3 +309,126 @@ def test_zero_accumulation_rejects_ragged_batch(cfg, mesh42):
     )
     with pytest.raises(Exception, match="divide|accum"):
         step(shard(params), init_state(params), tokens, jnp.roll(tokens, -1, 1))
+
+
+# ---------------------------------------------------------------------------
+# fp32 master weights (mixed-precision training)
+# ---------------------------------------------------------------------------
+
+
+def test_master_weights_state_and_f32_noop(cfg, mesh42):
+    """With f32 params the master track is exact, so master_weights=True
+    must produce the identical trajectory to the plain step; the state
+    gains sharded fp32 'w' slices."""
+    params = init_params(jax.random.PRNGKey(6), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(7), (8, 16), 0, cfg.vocab)
+    targets = jnp.roll(tokens, -1, axis=1)
+
+    s1, sh1, i1 = make_zero_train_step(cfg, mesh42, AdamConfig(lr=0.01))
+    s2, sh2, i2 = make_zero_train_step(
+        cfg, mesh42, AdamConfig(lr=0.01, master_weights=True)
+    )
+    st2 = i2(params)
+    assert "w" in st2 and st2["w"]["embed"].dtype == jnp.float32
+    # master slices are dp-sharded like the moments
+    assert st2["w"]["embed"].sharding.spec == P("dp")
+
+    p1, st1, l1 = s1(sh1(params), i1(params), tokens, targets)
+    p2, st2, l2 = s2(sh2(params), st2, tokens, targets)
+    assert float(l1) == float(l2)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_master_weights_bf16_matches_f32_track(mesh42):
+    """bf16 params + master weights == the reference mixed-precision
+    loop: an exact fp32 weight track whose bf16 cast feeds each forward.
+    Run several steps so update accumulation matters."""
+    cfg16 = TransformerConfig(
+        vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64, max_seq=32,
+        attention="naive", dtype=jnp.bfloat16,
+    )
+    params = init_params(jax.random.PRNGKey(8), cfg16)
+    tokens = jax.random.randint(jax.random.PRNGKey(9), (8, 16), 0, 64)
+    targets = jnp.roll(tokens, -1, axis=1)
+    adam = AdamConfig(lr=1e-3, eps=1e-3, master_weights=True)
+
+    # reference: fp32 master w; grads at bf16(w); exact fp32 Adam update
+    w = jax.tree.map(lambda p: p.astype(jnp.float32), params)
+    m = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+    v = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+    for t in range(1, 4):
+        p16 = jax.tree.map(lambda x: x.astype(jnp.bfloat16), w)
+        grads = jax.grad(loss_fn)(p16, tokens, targets, cfg16)
+        bc1, bc2 = 1.0 - adam.b1**t, 1.0 - adam.b2**t
+
+        def upd(w_, g, m_, v_):
+            g = g.astype(jnp.float32)
+            m_ = adam.b1 * m_ + (1 - adam.b1) * g
+            v_ = adam.b2 * v_ + (1 - adam.b2) * g * g
+            return (
+                w_ - adam.lr * (m_ / bc1) / (jnp.sqrt(v_ / bc2) + adam.eps),
+                m_, v_,
+            )
+
+        out = jax.tree.map(upd, w, grads, m, v)
+        leaves = jax.tree.leaves(out, is_leaf=lambda x: isinstance(x, tuple))
+        st = jax.tree.structure(params)
+        w = jax.tree.unflatten(st, [x[0] for x in leaves])
+        m = jax.tree.unflatten(st, [x[1] for x in leaves])
+        v = jax.tree.unflatten(st, [x[2] for x in leaves])
+    expected = jax.tree.map(lambda x: x.astype(jnp.bfloat16), w)
+
+    step, shard, init_state = make_zero_train_step(cfg16, mesh42, adam)
+    p, s = shard(params), init_state(params)
+    for _ in range(3):
+        p, s, _ = step(p, s, tokens, targets)
+    for a, b in zip(jax.tree.leaves(expected), jax.tree.leaves(p)):
+        # ulp-level f32-track noise (bf16 grads, reduction order) flips
+        # the bf16 cast by one ulp where the track sits on a rounding
+        # boundary — allow exactly that much
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=2e-2, atol=5e-4,
+        )
+
+
+def test_master_weights_keep_sub_ulp_updates(mesh42):
+    """The motivating property: updates far below bf16's ulp accumulate
+    on the master track (and eventually surface in the bf16 cast), while
+    the plain bf16 step loses them forever."""
+    cfg16 = TransformerConfig(
+        vocab=64, d_model=32, n_heads=4, n_layers=1, d_ff=64, max_seq=32,
+        attention="naive", dtype=jnp.bfloat16,
+    )
+    params = init_params(jax.random.PRNGKey(10), cfg16)
+    tokens = jax.random.randint(jax.random.PRNGKey(11), (8, 16), 0, 64)
+    targets = jnp.roll(tokens, -1, axis=1)
+    # lr so small each update is ~1e-6 — far below bf16 ulp (~3e-3 of
+    # magnitude-0.4 values, i.e. ~0.4*2^-8)
+    adam_m = AdamConfig(lr=3e-7, master_weights=True)
+    adam_p = AdamConfig(lr=3e-7)
+
+    sm, shm, im = make_zero_train_step(cfg16, mesh42, adam_m)
+    sp, shp, ip = make_zero_train_step(cfg16, mesh42, adam_p)
+    pm, stm = shm(params), im(params)
+    pp, stp = shp(params), ip(params)
+    for _ in range(5):
+        pm, stm, _ = sm(pm, stm, tokens, targets)
+        pp, stp, _ = sp(pp, stp, tokens, targets)
+    # plain bf16: updates rounded away wherever the element's half-ulp
+    # exceeds the ~3e-7 update (|p| > 0.01 -> ulp/2 ~ 2e-5); near-zero
+    # elements have proportionally tiny ulps and may legitimately move
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(pp)):
+        a = np.asarray(a, np.float32)
+        b = np.asarray(b, np.float32)
+        big = np.abs(a) > 0.01
+        np.testing.assert_array_equal(a[big], b[big])
+    # master track: the fp32 slices moved even though the bf16 cast
+    # hasn't crossed an ulp boundary yet
+    w0 = jax.tree.leaves(im(params)["w"])
+    w5 = jax.tree.leaves(stm["w"])
+    moved = max(
+        float(jnp.abs(a - b).max()) for a, b in zip(w0, w5)
+    )
+    assert moved > 1e-7, moved
