@@ -145,6 +145,11 @@ def make_pp_train_step(
             "context_parallel is supported on the decoder flagship only "
             "(forward/loss_fn), not the composed pipeline"
         )
+    if cfg.n_experts:
+        raise ValueError(
+            "n_experts (MoE) is supported on the decoder flagship only "
+            "(forward/loss_fn/generate), not the composed pipeline"
+        )
     M = num_microbatches
     heads_local = cfg.n_heads // tp
     specs = stacked_param_specs(cfg)

@@ -64,6 +64,11 @@ def encoder_forward(
             "load balance argument is the causal mask) — not the encoder "
             "family"
         )
+    if cfg.n_experts:
+        raise ValueError(
+            "n_experts (MoE) is supported on the decoder flagship only "
+            "(forward/loss_fn/generate), not the encoder family"
+        )
     B, T = tokens.shape
     x = _embed_tokens(params, tokens, cfg)
     x, block, sp = _enter_block_layout(

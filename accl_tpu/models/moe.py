@@ -41,6 +41,7 @@ def moe_ffn(
     capacity_factor: float = 1.5,
     k: int = 1,
     return_aux: bool = False,
+    tp_axis: str | None = None,
 ):
     """Top-k gated MoE FFN (k=1 is Switch routing, k=2 the classic MoE).
 
@@ -57,6 +58,13 @@ def moe_ffn(
 
     Returns (B, T, D): expert outputs weighted by the gate probability;
     over-capacity entries contribute zero (callers add the residual).
+
+    ``tp_axis``: tensor parallelism WITHIN each expert — ``w1``/``w2``
+    carry the d_ff dim tp-sharded (column/row-parallel per expert, the
+    Megatron split), and the expert outputs are partial sums allreduced
+    over tp after the combine.  Routing uses the replicated gate, so
+    every tp peer dispatches identically and the FFN FLOPs/weights
+    shard by the tp factor instead of replicating.
 
     ``return_aux=True`` additionally returns the router health terms
     computed over THIS rank's tokens (average across dp/ep in the loss):
@@ -139,6 +147,11 @@ def moe_ffn(
     weighted = got * (gate_p * keep.astype(x.dtype))[:, None]
     y = weighted.reshape(N, k, D).sum(axis=1)
     y = y.reshape(B, T, D)
+    if tp_axis is not None:
+        # w2's input dim was tp-sharded: the combined outputs are
+        # partial sums — one allreduce on the (B, T, D) result (smaller
+        # than the per-expert buffers) completes the row-parallel form
+        y = lax.psum(y, tp_axis)
     if not return_aux:
         return y
     # Switch load-balance: E * sum_e (dispatch fraction)_e * (mean router

@@ -92,6 +92,21 @@ class TransformerConfig:
     # (AR = RS + AG), but layernorm/residual compute and inter-block
     # activation memory drop by the tp factor
     seq_parallel: bool = False
+    # Mixture-of-Experts: n_experts > 0 replaces every block's dense FFN
+    # with a top-k routed expert FFN (models/moe.py — Switch routing at
+    # k=1, fixed capacity, static shapes).  Expert parallelism rides the
+    # DP mesh axis: each dp rank owns n_experts/dp experts and tokens
+    # travel to their expert's chip through the all-to-all (dispatch +
+    # return), the fourth parallelism axis composed into the flagship.
+    # loss_fn adds the router health terms (Switch load-balance aux +
+    # ST-MoE z-loss) averaged over layers.  Requires n_experts divisible
+    # by dp; decoder train/forward/decode paths (not encoder/pipeline,
+    # and not combined with seq_parallel/context_parallel yet).
+    n_experts: int = 0
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 1.5
+    moe_aux_weight: float = 0.01
+    moe_router_z_weight: float = 1e-3
     # attention lowering: "auto" (default) picks per sequence length and
     # backend — measured on v5e, the materialized-scores form wins below
     # ~4K tokens (XLA fuses it well and a fused fold's per-tile softmax
@@ -134,6 +149,31 @@ def _check_axis_compat(cfg) -> None:
             "vocab_parallel: the tp mesh axis becomes the sequence ring "
             "(weights replicated over it)"
         )
+    if cfg.n_experts and (cfg.seq_parallel or cfg.context_parallel):
+        raise ValueError(
+            "n_experts (MoE) does not compose with seq_parallel or "
+            "context_parallel yet — expert parallelism rides the dp axis "
+            "on the dense dp x tp layout"
+        )
+
+
+def _check_moe_mesh(cfg, mesh) -> None:
+    """Friendly divisibility errors for the MoE sharding (the generic
+    device_put failure names neither n_experts nor the axis)."""
+    if not cfg.n_experts:
+        return
+    dp = mesh.shape["dp"]
+    tp = mesh.shape["tp"]
+    if cfg.n_experts % dp:
+        raise ValueError(
+            f"n_experts ({cfg.n_experts}) must divide by dp ({dp}) — "
+            "expert parallelism shards the expert bank over the dp axis"
+        )
+    if cfg.d_ff % tp:
+        raise ValueError(
+            f"d_ff ({cfg.d_ff}) must divide by tp ({tp}) — each "
+            "expert's FFN is column/row-split over tp"
+        )
 
 
 # parameter partition specs over ('dp', 'tp'): column-parallel weights shard
@@ -157,6 +197,21 @@ def param_specs(cfg: TransformerConfig) -> Dict:
             "w2": P("tp", None),  # (d_ff/tp, d_model)
             "ln1": P(None),
             "ln2": P(None),
+        }
+    if cfg.n_experts:
+        # MoE: the dense FFN pair is replaced by the expert bank — the
+        # EXPERT dim shards over dp (expert parallelism; each dp rank
+        # owns n_experts/dp experts), the router gate is replicated
+        for k_ in ("w1", "w2"):
+            layer.pop(k_, None)
+        # experts shard over dp (expert parallelism) AND each expert's
+        # d_ff over tp (Megatron column/row split within the expert), so
+        # MoE keeps the dense layout's tp FLOP/memory sharding instead
+        # of replicating expert compute across tp
+        layer["moe"] = {
+            "gate": P(None, None),
+            "w1": P("dp", None, "tp"),
+            "w2": P("dp", "tp", None),
         }
     out = {
         # vocab parallelism shards the table's VOCAB rows over tp (the
@@ -187,28 +242,38 @@ def init_params(key, cfg: TransformerConfig) -> Dict:
     d_kv = cfg.kv_heads() * (cfg.d_model // cfg.n_heads)
     for i in range(cfg.n_layers):
         kk = k[2 + 4 * i : 6 + 4 * i]
-        params["layers"].append(
-            {
-                "wq": jax.random.normal(kk[0], (cfg.d_model, cfg.d_model), cfg.dtype)
-                * scale,
-                "wk": jax.random.normal(
-                    jax.random.fold_in(kk[0], 1), (cfg.d_model, d_kv), cfg.dtype
-                )
-                * scale,
-                "wv": jax.random.normal(
-                    jax.random.fold_in(kk[0], 2), (cfg.d_model, d_kv), cfg.dtype
-                )
-                * scale,
-                "wo": jax.random.normal(kk[1], (cfg.d_model, cfg.d_model), cfg.dtype)
-                * scale,
-                "w1": jax.random.normal(kk[2], (cfg.d_model, cfg.d_ff), cfg.dtype)
-                * scale,
-                "w2": jax.random.normal(kk[3], (cfg.d_ff, cfg.d_model), cfg.dtype)
-                * scale,
-                "ln1": jnp.ones((cfg.d_model,), cfg.dtype),
-                "ln2": jnp.ones((cfg.d_model,), cfg.dtype),
-            }
-        )
+        layer = {
+            "wq": jax.random.normal(kk[0], (cfg.d_model, cfg.d_model), cfg.dtype)
+            * scale,
+            "wk": jax.random.normal(
+                jax.random.fold_in(kk[0], 1), (cfg.d_model, d_kv), cfg.dtype
+            )
+            * scale,
+            "wv": jax.random.normal(
+                jax.random.fold_in(kk[0], 2), (cfg.d_model, d_kv), cfg.dtype
+            )
+            * scale,
+            "wo": jax.random.normal(kk[1], (cfg.d_model, cfg.d_model), cfg.dtype)
+            * scale,
+            "ln1": jnp.ones((cfg.d_model,), cfg.dtype),
+            "ln2": jnp.ones((cfg.d_model,), cfg.dtype),
+        }
+        if cfg.n_experts:
+            from .moe import init_moe_params
+
+            layer["moe"] = init_moe_params(
+                kk[2], cfg.d_model, cfg.d_ff, cfg.n_experts, cfg.dtype
+            )
+        else:
+            layer["w1"] = (
+                jax.random.normal(kk[2], (cfg.d_model, cfg.d_ff), cfg.dtype)
+                * scale
+            )
+            layer["w2"] = (
+                jax.random.normal(kk[3], (cfg.d_ff, cfg.d_model), cfg.dtype)
+                * scale
+            )
+        params["layers"].append(layer)
     return params
 
 
@@ -273,6 +338,17 @@ def _embed_tokens(params, tokens, cfg, tp_axis=None) -> jax.Array:
         else:
             x = x + params["pos"][: tokens.shape[1]]
     return x
+
+
+def _moe_penalty(cfg, aux) -> jax.Array:
+    """The router health penalty loss_fn adds for MoE configs: Switch
+    load-balance aux + ST-MoE z-loss, averaged over layers (``aux``
+    carries the layer SUMS from :func:`_final_hidden`)."""
+    n = float(cfg.n_layers)
+    return (
+        cfg.moe_aux_weight * aux["load_balance"] / n
+        + cfg.moe_router_z_weight * aux["router_z"] / n
+    )
 
 
 def _token_nll(logits, targets) -> jax.Array:
@@ -396,14 +472,46 @@ def _attention(q, k, v, impl: str = "naive", causal: bool = True):
     return out.reshape(B, H, T, hd)
 
 
-def _mlp(x, lp, tp_axis):
+def _mlp(x, lp, tp_axis, ep_axis=None, moe_cfg=None, with_aux=False,
+         moe_no_drop=False):
     """The block's MLP half (shared by train and decode paths): ln2 ->
-    column-parallel up, row-parallel down -> tp-allreduce, residual."""
+    column-parallel up, row-parallel down -> tp-allreduce, residual.
+
+    When the layer carries an expert bank (``lp["moe"]``) the dense pair
+    is replaced by the top-k routed expert FFN: tokens dispatch to their
+    expert's dp rank through the all-to-all over ``ep_axis`` and the
+    outputs return the same way (models/moe.py).  ``with_aux=True``
+    (training) additionally returns the router health terms; serving
+    paths leave it off."""
     h = _layernorm(x, lp["ln2"])
+    if "moe" in lp:
+        from .moe import moe_ffn
+
+        # decode steps route a handful of tokens at a time: a training
+        # capacity_factor there could drop a token the full forward
+        # would have kept (decode-vs-forward divergence), so serving
+        # uses the no-drop capacity (cf = E covers even an all-tokens-
+        # to-one-expert step at trivial memory)
+        cf = (
+            float(moe_cfg.n_experts)
+            if moe_no_drop
+            else moe_cfg.moe_capacity_factor
+        )
+        out = moe_ffn(
+            h, lp["moe"], ep_axis=ep_axis,
+            capacity_factor=cf,
+            k=moe_cfg.moe_top_k,
+            return_aux=with_aux,
+            tp_axis=tp_axis,
+        )
+        if with_aux:
+            y, aux = out
+            return x + y, aux
+        return x + out
     partial_f = jax.nn.gelu(h @ lp["w1"]) @ lp["w2"]
     if tp_axis is not None:
         partial_f = collectives.allreduce(partial_f, tp_axis, ReduceFunction.SUM)
-    return x + partial_f
+    return (x + partial_f, None) if with_aux else x + partial_f
 
 
 def _attn_partial(h, lp, n_heads_local, attn_impl="naive", causal=True,
@@ -443,13 +551,16 @@ def _attn_partial(h, lp, n_heads_local, attn_impl="naive", causal=True,
 
 
 def _block(x, lp, n_heads_local, tp_axis, return_kv=False,
-           attn_impl="naive", causal=True, rope_base=None):
+           attn_impl="naive", causal=True, rope_base=None,
+           ep_axis=None, moe_cfg=None, with_aux=False):
     """One transformer block on tp-sharded weights.  ``lp['wqkv']`` etc. are
     the *local shards*; the tp-allreduce after each row-parallel matmul is
     the reference's fused-allreduce hot path in model form.
 
     ``return_kv=True`` additionally returns the (k, v) head tensors
-    (B, H_local, T, hd) — the prefill path of the KV-cache decode."""
+    (B, H_local, T, hd) — the prefill path of the KV-cache decode.
+    ``with_aux=True`` (MoE training) returns ``(out, aux)`` with the
+    layer's router health terms."""
     h = _layernorm(x, lp["ln1"])
     partial_o, kv = _attn_partial(
         h, lp, n_heads_local, attn_impl, causal, rope_base
@@ -457,7 +568,7 @@ def _block(x, lp, n_heads_local, tp_axis, return_kv=False,
     if tp_axis is not None:
         partial_o = collectives.allreduce(partial_o, tp_axis, ReduceFunction.SUM)
     x = x + partial_o
-    out = _mlp(x, lp, tp_axis)
+    out = _mlp(x, lp, tp_axis, ep_axis, moe_cfg, with_aux)
     return (out, kv) if return_kv else out
 
 
@@ -589,6 +700,13 @@ def _enter_block_layout(x, cfg, tp_axis, tp_size, return_kv=False,
     )
     if return_kv:
         kw["return_kv"] = True
+    if cfg.n_experts:
+        # expert parallelism rides the dp axis: the sharded makers always
+        # run over a ('dp', 'tp') mesh, so a live tp_axis implies dp
+        # exists; single-device calls keep every expert local
+        kw["ep_axis"] = "dp" if tp_axis is not None else None
+        kw["moe_cfg"] = cfg
+        kw["with_aux"] = not return_kv  # serving paths skip router aux
     if not sp:
         return x, partial(_block, **kw), ""
     T = x.shape[1]
@@ -605,16 +723,27 @@ def _enter_block_layout(x, cfg, tp_axis, tp_size, return_kv=False,
 
 
 def _final_hidden(params, tokens, cfg, tp_axis=None, tp_size=1):
-    """Embed -> blocks -> final layernorm.  Returns ``(x, sp)`` where
-    ``sp`` flags that ``x`` is still sequence-sharded over tp (the
-    Megatron-SP regime) — shared by forward() and the fused loss."""
+    """Embed -> blocks -> final layernorm.  Returns ``(x, layout, aux)``:
+    ``layout`` flags how ``x`` is sequence-sharded ("" / "sp" / "cp");
+    ``aux`` is None for dense FFNs or the layer-summed MoE router health
+    terms ({"load_balance", "router_z"}) — shared by forward() and the
+    fused loss."""
     x = _embed_tokens(params, tokens, cfg, tp_axis)
     x, block, sp = _enter_block_layout(x, cfg, tp_axis, tp_size)
     if cfg.remat:
         block = jax.checkpoint(block)
+    if not cfg.n_experts:
+        for lp in params["layers"]:
+            x = block(x, lp)
+        return _layernorm(x, params["ln_f"]), sp, None
+    lb = jnp.zeros((), jnp.float32)
+    rz = jnp.zeros((), jnp.float32)
     for lp in params["layers"]:
-        x = block(x, lp)
-    return _layernorm(x, params["ln_f"]), sp
+        x, aux = block(x, lp)
+        lb = lb + aux["load_balance"]
+        rz = rz + aux["router_z"]
+    aux = {"load_balance": lb, "router_z": rz}
+    return _layernorm(x, params["ln_f"]), sp, aux
 
 
 def forward(params, tokens, cfg: TransformerConfig, tp_axis=None, tp_size=1):
@@ -628,7 +757,7 @@ def forward(params, tokens, cfg: TransformerConfig, tp_axis=None, tp_size=1):
     rank's striped (B, T/cp, vocab) logits shard — the makers'
     ``out_specs`` reassemble the sequence with zero inner wire instead
     of replicating full-sequence logits on every ring rank."""
-    x, sp = _final_hidden(params, tokens, cfg, tp_axis, tp_size)
+    x, sp, _ = _final_hidden(params, tokens, cfg, tp_axis, tp_size)
     if sp == "cp":
         return _lm_logits(x, params["embed"], cfg, tp_axis)
     if sp and _vp_active(cfg, tp_axis):
@@ -661,8 +790,9 @@ def loss_fn(params, tokens, targets, cfg, tp_axis=None, tp_size=1):
     ((B, T/cp, vocab) logits only) and the ring-mean of the equal-sized
     shard means is the global mean — full-sequence activations never
     exist on any rank."""
+    _check_axis_compat(cfg)
     if _cp_active(cfg, tp_axis):
-        x, _ = _final_hidden(params, tokens, cfg, tp_axis, tp_size)
+        x, _, _ = _final_hidden(params, tokens, cfg, tp_axis, tp_size)
         z = _lm_logits(x, params["embed"], cfg, tp_axis, gather=False)
         nll = _token_nll(z, targets)
         return (
@@ -670,12 +800,19 @@ def loss_fn(params, tokens, targets, cfg, tp_axis=None, tp_size=1):
             / tp_size
         )
     if not _vp_active(cfg, tp_axis):
+        if cfg.n_experts:
+            # one shared trunk pass: hidden AND the router aux terms
+            # (moe rejects sp/cp above, so x is the full sequence)
+            x, _, aux = _final_hidden(params, tokens, cfg, tp_axis, tp_size)
+            logits = _lm_logits(x, params["embed"], cfg, tp_axis)
+            nll = _token_nll(logits, targets).mean()
+            return nll + _moe_penalty(cfg, aux)
         logits = forward(params, tokens, cfg, tp_axis, tp_size)
         return _token_nll(logits, targets).mean()
 
     from jax import lax
 
-    x, sp = _final_hidden(params, tokens, cfg, tp_axis, tp_size)
+    x, sp, moe_aux = _final_hidden(params, tokens, cfg, tp_axis, tp_size)
     if sp:
         # exit sequence parallelism BEFORE the vocab-parallel head (the
         # Megatron layout): every rank needs every row's hidden state to
@@ -712,7 +849,10 @@ def loss_fn(params, tokens, targets, cfg, tp_axis=None, tp_size=1):
         jnp.where(mine, zt_local, 0.0), tp_axis, ReduceFunction.SUM
     )
     nll = jnp.log(sumexp) + zmax - zt
-    return nll.mean()
+    loss = nll.mean()
+    if moe_aux is not None:
+        loss = loss + _moe_penalty(cfg, moe_aux)
+    return loss
 
 
 # ---------------------------------------------------------------------------
@@ -721,7 +861,7 @@ def loss_fn(params, tokens, targets, cfg, tp_axis=None, tp_size=1):
 
 
 def _block_decode(x_t, lp, cache_k, cache_v, pos, n_heads_local, tp_axis,
-                  rope_tables=None):
+                  rope_tables=None, ep_axis=None, moe_cfg=None):
     """One block for a single decode position: write this step's k/v into
     the cache at ``pos`` (dynamic_update_slice keeps shapes static under
     jit/scan), attend over positions <= pos, same tp collectives as the
@@ -769,7 +909,11 @@ def _block_decode(x_t, lp, cache_k, cache_v, pos, n_heads_local, tp_axis,
     if tp_axis is not None:
         partial_o = collectives.allreduce(partial_o, tp_axis, ReduceFunction.SUM)
     x = x_t + partial_o
-    return _mlp(x, lp, tp_axis), cache_k, cache_v
+    return (
+        _mlp(x, lp, tp_axis, ep_axis, moe_cfg, moe_no_drop=True),
+        cache_k,
+        cache_v,
+    )
 
 
 def prefill(
@@ -899,6 +1043,8 @@ def generate(
             x, ck, cv = _block_decode(
                 x, lp, ck, cv, pos, heads_local, tp_axis,
                 rope_tables=tables,
+                ep_axis="dp" if (tp_axis and cfg.n_experts) else None,
+                moe_cfg=cfg if cfg.n_experts else None,
             )
             new_caches.append((ck, cv))
         x = _layernorm(x, params["ln_f"])
@@ -934,6 +1080,7 @@ def make_sharded_generate(
             "dataclasses.replace(cfg, context_parallel=False) — cp "
             "params are replicated over tp and re-shard directly"
         )
+    _check_moe_mesh(cfg, mesh)
     specs = param_specs(cfg)
     tp = mesh.shape["tp"]
 
@@ -1006,6 +1153,7 @@ def make_sharded_forward(cfg: TransformerConfig, mesh: Mesh):
     sequence-sharded over tp on the way in and the logits unstriped on
     the way out, so the caller-facing contract (full-sequence tokens in
     token order -> full logits in token order) is unchanged."""
+    _check_moe_mesh(cfg, mesh)
     specs = param_specs(cfg)
     tp = mesh.shape["tp"]
 
@@ -1071,6 +1219,7 @@ def make_sharded_train_step(cfg: TransformerConfig, mesh: Mesh, lr: float = 1e-2
     psum — the dp gradient allreduce of classic data parallelism falls out
     of the same machinery."""
     _reject_untrainable_attention(cfg)
+    _check_moe_mesh(cfg, mesh)
     specs = param_specs(cfg)
     tp = mesh.shape["tp"]
     dp = mesh.shape["dp"]

@@ -137,8 +137,14 @@ def _state_spec(pspec, dp_axis: str):
     """Sharding for one leaf's flat moment array: the dp slice axis
     nested inside whatever model-parallel axes shard the param itself —
     each (model-shard, dp-rank) pair owns a distinct 1/dp slice of ITS
-    parameter shard's moments."""
+    parameter shard's moments.
+
+    A param ALREADY sharded over dp (expert-parallel MoE banks: each dp
+    rank owns its experts outright) has no further dp split to take —
+    its moments simply live with the expert shard."""
     axes = _spec_axes(pspec)
+    if dp_axis in axes:
+        return P(tuple(axes))
     return P(tuple(axes) + (dp_axis,)) if axes else P(dp_axis)
 
 
@@ -154,11 +160,16 @@ def init_zero_state(params, specs, mesh: Mesh, dp_axis: str = "dp",
     dp = mesh.shape[dp_axis]
 
     def zeros_for(p, pspec):
+        axes = _spec_axes(pspec)
         div = 1
-        for ax in _spec_axes(pspec):
+        for ax in axes:
             div *= mesh.shape[ax]
         local_n = int(np.prod(p.shape)) // div
-        glen = _padded(local_n, dp) * div
+        # dp-sharded params (expert banks) take no further dp split:
+        # the rank's moments cover its whole expert shard
+        glen = (
+            local_n * div if dp_axis in axes else _padded(local_n, dp) * div
+        )
         sharding = NamedSharding(mesh, _state_spec(pspec, dp_axis))
         # allocate DIRECTLY sharded: materializing the full array on one
         # device first would transiently hold dp x the steady-state
@@ -184,7 +195,18 @@ def init_zero_state(params, specs, mesh: Mesh, dp_axis: str = "dp",
         def slices(p_tree):
             dp_ = lax.axis_size(dp_axis)
             idx = lax.axis_index(dp_axis)
-            return jax.tree.map(lambda p: _dp_slice(p, dp_, idx), p_tree)
+            is_p = lambda x: isinstance(x, P)
+            pl, treedef = jax.tree.flatten(p_tree)
+            sl = jax.tree.leaves(specs, is_leaf=is_p)
+            out = [
+                # dp-sharded leaves (expert banks): the rank's whole
+                # shard IS its slice — flatten, no dp sub-slice
+                p.reshape(-1).astype(jnp.float32)
+                if dp_axis in _spec_axes(sp_)
+                else _dp_slice(p, dp_, idx)
+                for p, sp_ in zip(pl, sl)
+            ]
+            return jax.tree.unflatten(treedef, out)
 
         sharded = jax.tree.map(
             lambda p, sp: jax.device_put(
@@ -219,30 +241,34 @@ def zero_state_specs(specs, dp_axis: str = "dp",
     return out
 
 
-def clip_by_global_norm(grads, specs, max_norm: float, tp_axis=None):
+def clip_by_global_norm(grads, specs, max_norm: float, tp_axis=None,
+                        dp_axis=None):
     """Scale ``grads`` so their GLOBAL L2 norm is at most ``max_norm`` —
-    inside shard_map.  Leaves whose spec shards over ``tp_axis`` hold
-    disjoint slices (their local squared sums psum across tp to the
-    global contribution exactly once); replicated leaves already carry
-    the full gradient on every rank.  Grads are dp-replicated by the
-    time this runs (the loss mean's transpose placed the dp psum), so
-    no dp exchange is needed.  Returns ``(clipped_grads, global_norm)``."""
+    inside shard_map.  Leaves whose spec shards over ``tp_axis`` (or
+    ``dp_axis`` — expert-parallel MoE banks) hold disjoint slices: their
+    local squared sums psum across those axes so each element counts
+    exactly once; replicated leaves already carry the full gradient on
+    every rank.  Dp-REPLICATED grads are dp-reduced by the time this
+    runs (the loss mean's transpose placed that psum), so they need no
+    dp exchange.  Returns ``(clipped_grads, global_norm)``."""
     is_leaf = lambda x: isinstance(x, P)
     gleaves = jax.tree.leaves(grads)
     sleaves = jax.tree.leaves(specs, is_leaf=is_leaf)
-    sharded_sq = jnp.zeros((), jnp.float32)
-    repl_sq = jnp.zeros((), jnp.float32)
+    # bucket leaves by which mesh axes shard them: each bucket's local
+    # squared sum psums over exactly its axes
+    buckets: dict = {}
     for g, s in zip(gleaves, sleaves):
+        axes = tuple(
+            a for a in (tp_axis, dp_axis)
+            if a is not None and a in _spec_axes(s)
+        )
         ss = jnp.sum(jnp.square(g.astype(jnp.float32)))
-        if tp_axis is not None and tp_axis in _spec_axes(s):
-            sharded_sq = sharded_sq + ss
-        else:
-            repl_sq = repl_sq + ss
-    total = repl_sq
-    if tp_axis is not None:
-        total = total + lax.psum(sharded_sq, tp_axis)
-    else:
-        total = total + sharded_sq
+        buckets[axes] = buckets.get(axes, 0.0) + ss
+    total = jnp.zeros((), jnp.float32)
+    for axes, ss in buckets.items():
+        for a in axes:
+            ss = lax.psum(ss, a)
+        total = total + ss
     norm = jnp.sqrt(total)
     # scale = 1 when norm <= max_norm, else max_norm / norm
     scale = (max_norm / jnp.maximum(norm, max_norm)).astype(jnp.float32)
@@ -252,11 +278,17 @@ def clip_by_global_norm(grads, specs, max_norm: float, tp_axis=None):
     return clipped, norm
 
 
-def zero_adam_update(params, grads, state, dp_axis: str, cfg: AdamConfig):
+def zero_adam_update(params, grads, state, dp_axis: str, cfg: AdamConfig,
+                     specs=None):
     """One sharded Adam step — runs INSIDE shard_map.
 
     ``params``/``grads`` are the rank's (tp-)local values, replicated
     across ``dp``; ``state`` leaves are the rank's 1/dp moment slices.
+    ``specs`` (the param PartitionSpec tree) marks leaves ALREADY
+    sharded over dp (expert-parallel MoE banks): those take the
+    rank-local update on the whole shard — no dp slice, no allgather
+    (each rank owns its experts outright, and their gradients arrive
+    fully summed through the dispatch all-to-all's transpose).
     Returns (new_params, new_state).
     """
     dp = lax.axis_size(dp_axis)
@@ -268,8 +300,21 @@ def zero_adam_update(params, grads, state, dp_axis: str, cfg: AdamConfig):
 
     master = state.get("w")
 
-    def leaf(p, g, m, v, w):
+    def leaf(p, g, m, v, w, dp_local):
         n = int(np.prod(p.shape))
+        if dp_local:
+            # expert-bank leaf: the whole local shard updates in place
+            gs = g.reshape(-1).astype(jnp.float32)
+            m = cfg.b1 * m + (1.0 - cfg.b1) * gs
+            v = cfg.b2 * v + (1.0 - cfg.b2) * gs * gs
+            shard = (
+                p.reshape(-1).astype(jnp.float32) if w is None else w
+            )
+            upd = (m / bc1) / (jnp.sqrt(v / bc2) + cfg.eps)
+            if cfg.weight_decay and p.ndim > 1:
+                upd = upd + cfg.weight_decay * shard
+            new_w = shard - lr_t * upd
+            return new_w.astype(p.dtype).reshape(p.shape), m, v, new_w
         # this rank's slice of the (already dp-reduced) mean gradient
         gs = _dp_slice(g, dp, idx)
         m = cfg.b1 * m + (1.0 - cfg.b1) * gs
@@ -298,17 +343,23 @@ def zero_adam_update(params, grads, state, dp_axis: str, cfg: AdamConfig):
         new_flat = allgather_invariant(new_shard, dp_axis)
         return new_flat[:n].reshape(p.shape), m, v, new_w
 
-    if master is None:
-        out = jax.tree.map(
-            lambda p, g, m, v: leaf(p, g, m, v, None),
-            params, grads, state["m"], state["v"],
-        )
+    is_p = lambda x: isinstance(x, P)
+    pl, st = jax.tree.flatten(params)
+    gl = jax.tree.leaves(grads)
+    ml = jax.tree.leaves(state["m"])
+    vl = jax.tree.leaves(state["v"])
+    wl = jax.tree.leaves(master) if master is not None else [None] * len(pl)
+    if specs is None:
+        dl = [False] * len(pl)
     else:
-        out = jax.tree.map(
-            leaf, params, grads, state["m"], state["v"], master
-        )
-    flat_out = jax.tree.leaves(out, is_leaf=lambda x: isinstance(x, tuple))
-    st = jax.tree.structure(params)
+        dl = [
+            dp_axis in _spec_axes(sp_)
+            for sp_ in jax.tree.leaves(specs, is_leaf=is_p)
+        ]
+    flat_out = [
+        leaf(p, g, m, v, w, d)
+        for p, g, m, v, w, d in zip(pl, gl, ml, vl, wl, dl)
+    ]
     new_params = jax.tree.unflatten(st, [t[0] for t in flat_out])
     new_state = {
         "m": jax.tree.unflatten(st, [t[1] for t in flat_out]),
@@ -390,7 +441,15 @@ def make_zero_train_step(
                 _pvary = partial(lax.pcast, to="varying")
             except AttributeError:  # pragma: no cover - older jax
                 _pvary = lax.pvary
-            params_v = jax.tree.map(lambda x: _pvary(x, ("dp",)), params)
+            is_p_ = lambda x: isinstance(x, P)
+            pl_, pd_ = jax.tree.flatten(params)
+            sl_ = jax.tree.leaves(specs, is_leaf=is_p_)
+            # dp-SHARDED leaves (expert banks) are already dp-varying —
+            # only the dp-replicated leaves need the cast
+            params_v = jax.tree.unflatten(pd_, [
+                x if "dp" in _spec_axes(sp_) else _pvary(x, ("dp",))
+                for x, sp_ in zip(pl_, sl_)
+            ])
 
             def micro(tok, tgt):
                 return jax.value_and_grad(
@@ -413,22 +472,30 @@ def make_zero_train_step(
             l0, g0 = micro(toks[0], tgts[0])
             g0 = jax.tree.map(lambda x: x.astype(jnp.float32), g0)
             (lsum, gsum), _ = lax.scan(body, (l0, g0), (toks[1:], tgts[1:]))
-            # the step's ONE cross-dp exchange
+            # the step's ONE cross-dp exchange.  Dp-SHARDED leaves
+            # (expert banks) skip the psum: their gradients arrive
+            # fully summed through the dispatch all-to-all's transpose
+            # even for a dp-local loss
             loss = (
                 collectives.allreduce(lsum, "dp", ReduceFunction.SUM)
                 / (dp * accum_steps)
             )
-            grads = jax.tree.map(
-                lambda g: collectives.allreduce(g, "dp", ReduceFunction.SUM)
-                / (dp * accum_steps),
-                gsum,
-            )
+            is_p = lambda x: isinstance(x, P)
+            gl, gd = jax.tree.flatten(gsum)
+            sl = jax.tree.leaves(specs, is_leaf=is_p)
+            grads = jax.tree.unflatten(gd, [
+                g / (dp * accum_steps)
+                if "dp" in _spec_axes(sp_)
+                else collectives.allreduce(g, "dp", ReduceFunction.SUM)
+                / (dp * accum_steps)
+                for g, sp_ in zip(gl, sl)
+            ])
         if adam.clip_grad_norm is not None:
             grads, _ = clip_by_global_norm(
-                grads, specs, adam.clip_grad_norm, "tp"
+                grads, specs, adam.clip_grad_norm, "tp", "dp"
             )
         new_params, new_state = zero_adam_update(
-            params, grads, state, "dp", adam
+            params, grads, state, "dp", adam, specs=specs
         )
         return new_params, new_state, loss
 
