@@ -46,6 +46,7 @@ def train(
     clip_grad_norm: Optional[float] = None,
     master_weights: bool = False,
     dtype: str = "float32",
+    n_experts: int = 0,
 ):
     """Train the flagship transformer.
 
@@ -65,6 +66,14 @@ def train(
     fp32 master-weight track; ``dtype="bfloat16"`` trains bf16 params
     (pair with master_weights — bf16's ulp otherwise swallows small
     updates).
+
+    ``parallelism="context"`` trains with context parallelism: the tp
+    axis becomes the sequence ring (striped ring attention inside the
+    blocks, activations sequence-sharded end-to-end).
+
+    ``n_experts`` switches every block's FFN to the expert-parallel MoE
+    (experts on dp, router aux in the loss) — on the dense dp_tp layout
+    only (MoE does not combine with parallelism="context").
 
     ``parallelism="pipeline"`` trains over the composed pp x dp x tp mesh
     (``models/composed.py``: pipeline stages of tp-sharded blocks,
@@ -91,7 +100,7 @@ def train(
 
     devs = jax.devices()
     use_pp = parallelism == "pipeline"
-    if parallelism not in ("dp_tp", "pipeline"):
+    if parallelism not in ("dp_tp", "context", "pipeline"):
         raise ValueError(f"unknown parallelism {parallelism!r}")
     if use_pp and optimizer != "sgd":
         raise ValueError("parallelism='pipeline' supports optimizer='sgd'")
@@ -127,6 +136,8 @@ def train(
         vocab=128, d_model=16 * heads, n_heads=heads, n_layers=2,
         d_ff=32 * heads, max_seq=32,
         dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32,
+        context_parallel=parallelism == "context",
+        n_experts=n_experts,
     )
     use_zero = optimizer == "zero_adam"
     # per-dp-rank batch: 2 samples per MICRObatch, so accumulation grows
@@ -193,12 +204,15 @@ def train(
                         f"failed to restore {ckpt_dir} at step {latest} "
                         f"with optimizer={optimizer!r}, "
                         f"parallelism={parallelism!r}, "
-                        f"master_weights={master_weights}; was the "
-                        "checkpoint saved with a different --optimizer, "
-                        "--parallelism, or --master-weights? (pipeline "
-                        "mode stores layers STACKED, dp_tp stores them "
-                        "as a list; master weights add a 'w' subtree to "
-                        "the optimizer state)"
+                        f"master_weights={master_weights}, "
+                        f"n_experts={n_experts}; was the checkpoint "
+                        "saved with a different --optimizer, "
+                        "--parallelism, --master-weights, or "
+                        "--n-experts? (pipeline mode stores layers "
+                        "STACKED, dp_tp stores them as a list; master "
+                        "weights add a 'w' subtree to the optimizer "
+                        "state; MoE replaces w1/w2 with a 'moe' "
+                        "subtree)"
                     ) from e
                 raise
             if use_zero:
@@ -286,7 +300,12 @@ def main(argv=None) -> int:
         "--optimizer", default="sgd", choices=["sgd", "zero_adam"]
     )
     ap.add_argument(
-        "--parallelism", default="dp_tp", choices=["dp_tp", "pipeline"]
+        "--parallelism", default="dp_tp",
+        choices=["dp_tp", "context", "pipeline"],
+    )
+    ap.add_argument(
+        "--n-experts", type=int, default=0,
+        help="MoE: expert count (sharded over dp); 0 = dense FFN",
     )
     ap.add_argument(
         "--data", default=None,
@@ -317,6 +336,7 @@ def main(argv=None) -> int:
         parallelism=args.parallelism, data=args.data,
         accum_steps=args.accum_steps, clip_grad_norm=args.clip_grad_norm,
         master_weights=args.master_weights, dtype=args.dtype,
+        n_experts=args.n_experts,
     )
     return 0
 

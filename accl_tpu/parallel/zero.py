@@ -391,6 +391,7 @@ def make_zero_train_step(
     clipping to the (accumulated) gradient before the update."""
     from ..constants import ReduceFunction
     from ..models.transformer import (
+        _check_moe_mesh,
         _reject_untrainable_attention,
         _shard_params,
         loss_fn,
@@ -399,6 +400,7 @@ def make_zero_train_step(
     from ..ops import collectives
 
     _reject_untrainable_attention(model_cfg)
+    _check_moe_mesh(model_cfg, mesh)
     schedule_lr(adam, 1)  # fail fast on decay/warmup misconfiguration
 
     specs = param_specs(model_cfg)
@@ -499,15 +501,34 @@ def make_zero_train_step(
         )
         return new_params, new_state, loss
 
-    fn = jax.jit(
-        shard_map(
-            step,
-            mesh=mesh,
-            in_specs=(specs, sspecs, P("dp", None), P("dp", None)),
-            out_specs=(specs, sspecs, P()),
-        ),
-        donate_argnums=(0, 1),
+    # context parallelism: tokens/targets stripe (a global permutation,
+    # outside shard_map) and sequence-shard over tp — the same entry
+    # contract as the SGD maker's cp path; loss_fn's cp branch consumes
+    # the rank's striped shard
+    seq_spec = (
+        P("dp", "tp") if model_cfg.context_parallel else P("dp", None)
     )
+    smapped = shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(specs, sspecs, seq_spec, seq_spec),
+        out_specs=(specs, sspecs, P()),
+    )
+    if model_cfg.context_parallel:
+        from ..models.ring_attention import stripe_sequence
+
+        def outer(params, state, tokens, targets):
+            return smapped(
+                params,
+                state,
+                stripe_sequence(tokens, tp, axis=1),
+                stripe_sequence(targets, tp, axis=1),
+            )
+
+        body = outer
+    else:
+        body = smapped
+    fn = jax.jit(body, donate_argnums=(0, 1))
     return (
         fn,
         partial(_shard_params, specs=specs, mesh=mesh),

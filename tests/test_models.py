@@ -1309,3 +1309,40 @@ def test_moe_composes_with_vocab_parallel(moe_cfg, mesh42m):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-5
         )
+
+
+def test_trainer_context_parallelism(tmp_path):
+    """The trainer's parallelism='context' mode trains and resumes (cp
+    params are replicated over tp — same checkpoint tree as dp_tp)."""
+    from accl_tpu.examples.train import train
+
+    ckpt = str(tmp_path / "ckpt")
+    done, loss = train(
+        steps=4, ckpt_dir=ckpt, save_every=2, log_every=0,
+        parallelism="context",
+    )
+    assert done == 4 and np.isfinite(loss)
+    done, loss = train(
+        steps=6, ckpt_dir=ckpt, save_every=2, log_every=0,
+        parallelism="context",
+    )
+    assert done == 6 and np.isfinite(loss)
+
+
+def test_trainer_moe(tmp_path):
+    """--n-experts switches the trainer's blocks to the expert-parallel
+    MoE FFN; the ZeRO optimizer state (expert-shard moments) checkpoints
+    and resumes."""
+    from accl_tpu.examples.train import train
+
+    ckpt = str(tmp_path / "ckpt")
+    done, loss = train(
+        steps=4, ckpt_dir=ckpt, save_every=2, log_every=0,
+        optimizer="zero_adam", n_experts=8,
+    )
+    assert done == 4 and np.isfinite(loss)
+    done, loss = train(
+        steps=6, ckpt_dir=ckpt, save_every=2, log_every=0,
+        optimizer="zero_adam", n_experts=8,
+    )
+    assert done == 6 and np.isfinite(loss)

@@ -507,3 +507,37 @@ def test_zero_moe_matches_unsharded_adam(moe_cfg, mesh42, extras):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5
         )
+
+
+def test_zero_context_parallel_matches_dense(cfg, mesh42):
+    """zero_adam + context_parallel: the ZeRO maker stripes and
+    sequence-shards tokens like the SGD maker, so the cp step's loss
+    and params equal the dense zero_adam step exactly."""
+    import dataclasses
+
+    cp = dataclasses.replace(cfg, context_parallel=True)
+    params = init_params(jax.random.PRNGKey(40), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(41), (8, 16), 0, cfg.vocab)
+    targets = jnp.roll(tokens, -1, axis=1)
+    adam = AdamConfig(lr=0.01, eps=1e-3, clip_grad_norm=1.0)
+
+    s1, sh1, i1 = make_zero_train_step(cfg, mesh42, adam)
+    p1, _, l1 = s1(sh1(params), i1(params), tokens, targets)
+    s2, sh2, i2 = make_zero_train_step(cp, mesh42, adam)
+    p2, _, l2 = s2(sh2(params), i2(params), tokens, targets)
+    assert float(l2) == pytest.approx(float(l1), rel=1e-5)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5
+        )
+
+
+def test_zero_moe_divisibility_diagnostic(mesh42):
+    """The ZeRO maker raises the friendly n_experts/dp error, not a raw
+    sharding failure."""
+    bad = TransformerConfig(
+        vocab=64, d_model=32, n_heads=4, n_layers=1, d_ff=64, max_seq=32,
+        n_experts=6,
+    )
+    with pytest.raises(ValueError, match="n_experts .6. must divide by dp"):
+        make_zero_train_step(bad, mesh42, AdamConfig())
