@@ -1035,10 +1035,26 @@ def test_context_parallel_train_matches_dense(mesh24, pos, remat):
         )
 
 
-def test_context_parallel_forward_matches_dense(mesh24):
+@pytest.mark.parametrize("mesh_kind", ["auto", "explicit"])
+def test_context_parallel_forward_matches_dense(mesh24, mesh_kind):
     """make_sharded_forward under cp stripes in / unstripes out, so the
-    caller sees token-order logits identical to the dense lowering."""
+    caller sees token-order logits identical to the dense lowering — on
+    BOTH mesh axis modes (jax.make_mesh defaults to EXPLICIT sharding
+    axes, where the exit edge must reshard before the unstripe
+    permutation; plain Mesh gives auto axes)."""
     import dataclasses
+
+    if mesh_kind == "explicit":
+        pytest.importorskip("jax.sharding", reason="needs AxisType")
+        try:
+            from jax.sharding import AxisType
+        except ImportError:
+            pytest.skip("jax without explicit sharding axes")
+        mesh = jax.make_mesh((2, 4), ("dp", "tp"))
+        if AxisType.Explicit not in mesh.axis_types:
+            pytest.skip("make_mesh is not explicit-axes on this jax")
+    else:
+        mesh = mesh24
 
     base = TransformerConfig(
         vocab=64, d_model=64, n_heads=8, n_layers=2, d_ff=96, max_seq=32,
@@ -1047,8 +1063,8 @@ def test_context_parallel_forward_matches_dense(mesh24):
     params = init_params(jax.random.PRNGKey(2), base)
     tokens = jax.random.randint(jax.random.PRNGKey(41), (2, 16), 0, base.vocab)
 
-    fwd_b, shard_b = make_sharded_forward(base, mesh24)
-    fwd_c, shard_c = make_sharded_forward(cp, mesh24)
+    fwd_b, shard_b = make_sharded_forward(base, mesh)
+    fwd_c, shard_c = make_sharded_forward(cp, mesh)
     np.testing.assert_allclose(
         np.asarray(fwd_c(shard_c(params), tokens)),
         np.asarray(fwd_b(shard_b(params), tokens)),
