@@ -361,13 +361,44 @@ def _bench_decode_throughput() -> dict:
     return {"decode_tokens_per_s": round(batch * ndev * steps / dt, 1)}
 
 
-def _bench_facade_overhead() -> float:
+def _bench_facade_overhead() -> dict:
     """Per-call latency (us) of a small collective through the full MPI
     facade (buffer -> CallOptions -> gang -> jitted program -> result
     adoption).  The reference's equivalent is the hostctrl kernel-start +
     firmware round trip per call; here it bounds the Python control
-    plane's cost — the data path itself is device-resident."""
+    plane's cost — the data path itself is device-resident.
+
+    Three numbers land in extras so the artifact itself separates
+    architecture cost from transport cost (VERDICT r3 item 4 — the
+    95 us-vs-1579 us round-to-round swing was the tunnel's dispatch
+    floor, but the JSON carried no evidence):
+
+    * ``facade_call_overhead_us`` — the end-to-end per-call figure;
+    * ``facade_dispatch_floor_us`` — the per-call cost of the SAME loop
+      shape (N async dispatches of a trivial jitted program + one
+      drain) with no facade at all: pure jit dispatch + transport;
+    * ``facade_arch_overhead_us`` — the difference: what the facade's
+      Python control plane (buffer resolution, CallOptions, seqn
+      bookkeeping, program-cache lookup) itself costs per call.
+    """
+    import jax
+    import jax.numpy as jnp
+
     from accl_tpu.core import xla_group
+
+    iters = 50 if _SMALL else 300
+
+    # dispatch floor FIRST, same discipline as the facade loop below:
+    # async enqueues, one completion barrier at the end
+    x = jnp.ones((1024,), jnp.float32)
+    trivial = jax.jit(lambda v: v + 1.0)
+    trivial(x).block_until_ready()  # compile
+    t0 = time.perf_counter()
+    out = x
+    for _ in range(iters):
+        out = trivial(out)
+    out.block_until_ready()
+    floor_us = (time.perf_counter() - t0) / iters * 1e6
 
     g = xla_group(1)
     try:
@@ -382,15 +413,19 @@ def _bench_facade_overhead() -> float:
                 arr.block_until_ready()
 
         drain()  # earlier benches must not bill their queued work to us
-        iters = 50 if _SMALL else 300
         t0 = time.perf_counter()
         for _ in range(iters):
             a.allreduce(s, d, 1024)
         drain()  # sustained end-to-end: host control plane + device
-        return (time.perf_counter() - t0) / iters * 1e6
+        call_us = (time.perf_counter() - t0) / iters * 1e6
     finally:
         for x in g:
             x.deinit()
+    return {
+        "facade_call_overhead_us": round(call_us, 1),
+        "facade_dispatch_floor_us": round(floor_us, 1),
+        "facade_arch_overhead_us": round(call_us - floor_us, 1),
+    }
 
 
 def _bench_ring_allreduce(ndev: int, algo: str = "xla") -> float:
@@ -564,9 +599,9 @@ _RETRYABLE_PROBE_ERRORS = (
 def _probe_device(deadline: float) -> tuple:
     """Parent side: run the probe in a short-deadline child.
 
-    Returns (ok, detail, retryable).  Hangs and backend-unavailable
-    crashes are the wedge's signatures (retryable with idle); any other
-    crash is deterministic and fails fast."""
+    Returns (ok, detail, retryable, probe_json).  Hangs and
+    backend-unavailable crashes are the wedge's signatures (retryable
+    with idle); any other crash is deterministic and fails fast."""
     env = dict(os.environ)
     env["ACCL_BENCH_MODE"] = "probe"
     try:
@@ -575,7 +610,10 @@ def _probe_device(deadline: float) -> tuple:
             env=env, timeout=deadline, capture_output=True, text=True,
         )
     except subprocess.TimeoutExpired:
-        return False, f"probe hung >{deadline:.0f}s (backend init wedge)", True
+        return (
+            False, f"probe hung >{deadline:.0f}s (backend init wedge)",
+            True, None,
+        )
     if proc.returncode != 0:
         tail = proc.stderr.strip().splitlines()[-2:]
         retryable = any(
@@ -584,36 +622,78 @@ def _probe_device(deadline: float) -> tuple:
         return (
             False,
             f"probe rc={proc.returncode}: " + "; ".join(tail),
-            retryable,
+            retryable, None,
         )
     try:
         out = json.loads(proc.stdout.strip().splitlines()[-1])
     except (json.JSONDecodeError, IndexError):
-        return False, "probe emitted no JSON", False
+        return False, "probe emitted no JSON", False, None
     if not out.get("ok"):
         return (
             False,
             f"dispatch {out.get('dispatch_ms')} ms (wedge signature)",
-            True,
+            True, out,
         )
     return (
         True,
         f"{out.get('dispatch_ms')} ms/dispatch on {out.get('backend')}",
-        False,
+        False, out,
     )
 
 
-def _probe_with_idle_retry(errors: dict) -> bool:
+# total wall-clock the guarded parent may spend on pre-flight (probes +
+# idles, summed over the WHOLE run incl. resume re-probes).  Round 3's
+# capture was null because the unbounded probe/idle loop (up to 30 min
+# worst case) outlived the driver's external timeout and the fallback
+# never printed; the budget makes the fallback reachable by
+# construction, the SIGTERM handler (below) makes it reachable even when
+# the external timeout fires anyway.  The budget is a SPEND counter
+# (probe + idle seconds), not a deadline from run start: bench-child
+# runtime must not be charged against it, or a long first attempt would
+# starve the resume re-probe and make attempt 2 unreachable.
+_PREFLIGHT_REMAINING = None  # seconds left; set once by _run_guarded
+
+
+def _preflight_remaining() -> float:
+    if _PREFLIGHT_REMAINING is None:
+        return float("inf")
+    return _PREFLIGHT_REMAINING
+
+
+def _preflight_spend(seconds: float) -> None:
+    global _PREFLIGHT_REMAINING
+    if _PREFLIGHT_REMAINING is not None:
+        _PREFLIGHT_REMAINING -= seconds
+
+
+def _probe_with_idle_retry(errors: dict, extras: dict = None) -> bool:
     """Probe; on a wedge-shaped failure idle (the only known cure) and
-    re-probe; on a deterministic crash fail fast."""
+    re-probe; on a deterministic crash fail fast.  Every probe and every
+    idle is clipped to the shared pre-flight budget (ACCL_BENCH_TOTAL):
+    when the budget is spent this returns False immediately, so the
+    caller's fallback always runs with wall-clock to spare."""
     deadline = float(os.environ.get("ACCL_BENCH_PROBE_TIMEOUT", "120"))
     retries = int(os.environ.get("ACCL_BENCH_PROBE_RETRIES", "4"))
     idle = float(os.environ.get("ACCL_BENCH_IDLE", "300"))
     for attempt in range(retries + 1):
-        ok, detail, retryable = _probe_device(deadline)
+        remaining = _preflight_remaining()
+        if remaining <= 5:
+            errors["probe"] = (
+                errors.get("probe", "")
+                + " | pre-flight budget exhausted before probe"
+            )[:400].strip(" |")
+            print("bench pre-flight budget exhausted", file=sys.stderr)
+            return False
+        t_probe = time.monotonic()
+        ok, detail, retryable, out = _probe_device(min(deadline, remaining))
+        _preflight_spend(time.monotonic() - t_probe)
         if ok:
             print(f"bench probe ok: {detail}", file=sys.stderr)
             errors.pop("probe", None)
+            if extras is not None and out and out.get("dispatch_ms") is not None:
+                # evidence for the facade-overhead record: the probe's
+                # dispatch floor travels in the same artifact
+                extras["probe_dispatch_ms"] = out["dispatch_ms"]
             return True
         print(
             f"bench probe failed ({attempt + 1}/{retries + 1}): {detail}",
@@ -627,12 +707,23 @@ def _probe_with_idle_retry(errors: dict) -> bool:
             )
             return False
         if attempt < retries:
+            # an idle that would leave no time for the follow-up probe
+            # is pointless; spend at most what leaves one probe's worth
+            remaining = _preflight_remaining()
+            nap = min(idle, remaining - min(deadline, 60))
+            if nap <= 0:
+                errors["probe"] = (
+                    errors["probe"] + " | pre-flight budget exhausted"
+                )[:400]
+                print("bench pre-flight budget exhausted", file=sys.stderr)
+                return False
             print(
-                f"bench idling {idle:.0f}s before re-probe "
+                f"bench idling {nap:.0f}s before re-probe "
                 "(wedge clears with device idle time)",
                 file=sys.stderr,
             )
-            time.sleep(idle)
+            time.sleep(nap)
+            _preflight_spend(nap)
     return False
 
 
@@ -678,9 +769,58 @@ def _save_lkg(result: dict) -> None:
         print(f"bench lkg stash failed: {e}", file=sys.stderr)
 
 
+# Live state for the signal handler: the guarded parent keeps its
+# accumulated extras/errors (and the in-flight child's checkpoint path)
+# here so an EXTERNAL kill — the driver's own timeout — can still emit
+# the fallback JSON before the process dies.  Round 3's scoreboard was
+# nulled by exactly that kill (BENCH_r03 rc=124, parsed=null).
+_GUARD_STATE = {
+    "extras": None, "errors": None, "checkpoint": None, "emitted": False,
+    "child": None,
+}
+
+
+def _guard_signal_handler(signum, frame):  # pragma: no cover - signal path
+    # kill the in-flight bench child FIRST: exiting without it would
+    # orphan a process that keeps the device busy (or wedged) long after
+    # the driver's timeout tore the parent down
+    child = _GUARD_STATE.get("child")
+    if child is not None:
+        try:
+            child.kill()
+        except OSError:
+            pass
+    extras = _GUARD_STATE["extras"] if _GUARD_STATE["extras"] is not None else {}
+    errors = _GUARD_STATE["errors"] if _GUARD_STATE["errors"] is not None else {}
+    # merge whatever the in-flight child checkpointed before the kill:
+    # fresh partial metrics beat nothing at all
+    path = _GUARD_STATE.get("checkpoint")
+    if path:
+        try:
+            with open(path) as f:
+                partial = json.load(f)
+            merged = dict(extras)
+            merged.update(partial.get("extras") or {})
+            extras = merged
+            for k, v in (partial.get("errors") or {}).items():
+                errors.setdefault(k, v)
+        except (OSError, json.JSONDecodeError):
+            pass
+    _emit_fallback(
+        extras, errors,
+        f"killed by signal {signum} (external timeout) before completion",
+    )
+    os._exit(0)
+
+
 def _emit_fallback(extras: dict, errors: dict, reason: str) -> None:
     """No fresh non-null headline: report the last known good with loud
-    provenance rather than a null that zeroes the scoreboard."""
+    provenance rather than a null that zeroes the scoreboard.  Emits at
+    most once: the normal path and the signal handler share this guard,
+    so a SIGTERM racing the regular emission cannot double-print."""
+    if _GUARD_STATE["emitted"]:
+        return
+    _GUARD_STATE["emitted"] = True
     print(f"bench FAILED: {reason}", file=sys.stderr)
     result = _headline(extras)
     lkg = _load_lkg()
@@ -705,6 +845,7 @@ def _emit_fallback(extras: dict, errors: dict, reason: str) -> None:
     result["extras"] = extras
     result["errors"] = errors
     print(json.dumps(result))
+    sys.stdout.flush()
 
 
 def _run_child(budget: float, skip: set) -> tuple:
@@ -715,6 +856,7 @@ def _run_child(budget: float, skip: set) -> tuple:
     import tempfile
 
     with tempfile.NamedTemporaryFile(mode="r", suffix=".json") as ckpt:
+        _GUARD_STATE["checkpoint"] = ckpt.name
         env = dict(os.environ)
         env["ACCL_BENCH_CHECKPOINT"] = ckpt.name
         env["ACCL_BENCH_GUARDED"] = "0"
@@ -723,12 +865,19 @@ def _run_child(budget: float, skip: set) -> tuple:
             env["ACCL_BENCH_SKIP"] = ",".join(sorted(skip))
         reason = None
         result = None
+        # Popen (not run): the handle is published for the signal
+        # handler, which must be able to kill the child before exiting —
+        # an orphaned bench child would keep the device busy/wedged long
+        # after the driver's timeout tore the parent down
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True,
+        )
+        _GUARD_STATE["child"] = proc
         try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__)],
-                env=env, timeout=budget, capture_output=True, text=True,
-            )
-            tail = proc.stdout.strip().splitlines()
+            out, err = proc.communicate(timeout=budget)
+            tail = out.strip().splitlines()
             if proc.returncode == 0 and tail:
                 try:
                     result = json.loads(tail[-1])
@@ -737,10 +886,14 @@ def _run_child(budget: float, skip: set) -> tuple:
             else:
                 reason = "; ".join(
                     [f"bench child exited rc={proc.returncode}"]
-                    + proc.stderr.strip().splitlines()[-3:]
+                    + err.strip().splitlines()[-3:]
                 )
         except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()
             reason = f"bench child exceeded {budget:.0f}s (device wedge?)"
+        finally:
+            _GUARD_STATE["child"] = None
         # re-open by NAME: the child's atomic os.replace installed a new
         # inode at this path, so the original handle sees only stale bytes
         try:
@@ -748,6 +901,7 @@ def _run_child(budget: float, skip: set) -> tuple:
                 raw = f.read()
         except OSError:
             raw = ""
+        _GUARD_STATE["checkpoint"] = None
     try:
         partial = json.loads(raw) if raw else {"extras": {}, "errors": {}}
     except json.JSONDecodeError:
@@ -760,15 +914,45 @@ def _run_child(budget: float, skip: set) -> tuple:
 
 
 def _run_guarded() -> None:
-    """Parent side: probe, run attempts with idle-retry, fall back."""
+    """Parent side: probe, run attempts with idle-retry, fall back.
+
+    Failure-output guarantees (VERDICT r3 item 1):
+    * pre-flight (probes + idles) is bounded by ACCL_BENCH_TOTAL
+      (default 600 s) — the fallback is reached by construction, never
+      starved by the retry loop;
+    * the whole guarded run is bounded by ACCL_BENCH_WALL (default
+      5400 s) — child budgets and inter-attempt idles are clipped to
+      what remains;
+    * SIGTERM/SIGINT/SIGHUP print the fallback JSON (merging the
+      in-flight child's checkpoint) before dying, so an external kill
+      at ANY point still yields a parseable, non-null scoreboard line.
+    """
+    import signal
+
     budget = float(os.environ.get("ACCL_BENCH_TIMEOUT", "2400"))
     attempts = int(os.environ.get("ACCL_BENCH_ATTEMPTS", "2"))
     idle = float(os.environ.get("ACCL_BENCH_IDLE", "300"))
+    preflight_total = float(os.environ.get("ACCL_BENCH_TOTAL", "600"))
+    wall = float(os.environ.get("ACCL_BENCH_WALL", "5400"))
+
+    global _PREFLIGHT_REMAINING
+    _PREFLIGHT_REMAINING = preflight_total
+    wall_deadline = time.monotonic() + wall
 
     extras: dict = {}
     errors: dict = {}
+    _GUARD_STATE["extras"] = extras
+    _GUARD_STATE["errors"] = errors
+    # ACCL_BENCH_SIGNAL_GUARD=0 lets the unit tests drive _run_guarded
+    # without hijacking the test runner's own signal handlers
+    if os.environ.get("ACCL_BENCH_SIGNAL_GUARD", "1") != "0":
+        for sig in (signal.SIGTERM, signal.SIGINT, signal.SIGHUP):
+            try:
+                signal.signal(sig, _guard_signal_handler)
+            except (OSError, ValueError):  # pragma: no cover - exotic hosts
+                pass
 
-    if not _probe_with_idle_retry(errors):
+    if not _probe_with_idle_retry(errors, extras):
         _emit_fallback(
             extras, errors, "device never passed pre-flight probe"
         )
@@ -782,8 +966,15 @@ def _run_guarded() -> None:
     device = None
     reason = "no bench attempt ran"
     for attempt in range(attempts):
+        # clip this attempt to the remaining wall budget, keeping a
+        # margin for the fallback emission itself; no room means stop
+        # trying and report what exists
+        room = wall_deadline - time.monotonic() - 30
+        if room < 60:
+            reason = f"wall budget ({wall:.0f}s) exhausted"
+            break
         result, a_extras, a_errors, a_done, a_reason, attempted = (
-            _run_child(budget, skip)
+            _run_child(min(budget, room), skip)
         )
         # fresh attempt's metrics layer over older partials; a metric
         # that succeeded THIS attempt clears its stale earlier error
@@ -806,7 +997,9 @@ def _run_guarded() -> None:
                 if errors:
                     fresh["errors"] = errors
                 _save_lkg(fresh)
+                _GUARD_STATE["emitted"] = True
                 print(json.dumps(fresh))
+                sys.stdout.flush()
                 return
             # clean exit, null headline (e.g. transient failure in every
             # headline bench): worth the remaining retry attempts
@@ -819,9 +1012,17 @@ def _run_guarded() -> None:
                 f"in flight when attempt {attempt + 1} died: {reason}"[:400]
             )
         if attempt + 1 < attempts:
-            print(f"bench idling {idle:.0f}s before resume", file=sys.stderr)
-            time.sleep(idle)
-            if not _probe_with_idle_retry(errors):
+            room = wall_deadline - time.monotonic() - 120
+            if room < 0:
+                reason += f"; wall budget ({wall:.0f}s) exhausted"
+                break
+            nap = min(idle, room)
+            if nap > 0:
+                print(
+                    f"bench idling {nap:.0f}s before resume", file=sys.stderr
+                )
+                time.sleep(nap)
+            if not _probe_with_idle_retry(errors, extras):
                 reason += "; device did not recover for resume"
                 break
     errors["bench_harness"] = reason[:400]

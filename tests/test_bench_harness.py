@@ -19,6 +19,7 @@ import pytest
 @pytest.fixture()
 def bench(tmp_path, monkeypatch):
     """A fresh bench module instance with its LKG path redirected."""
+    monkeypatch.setenv("ACCL_BENCH_SIGNAL_GUARD", "0")
     path = os.path.join(os.path.dirname(__file__), "..", "bench.py")
     spec = importlib.util.spec_from_file_location("bench_under_test", path)
     mod = importlib.util.module_from_spec(spec)
@@ -134,7 +135,7 @@ def test_run_guarded_resumes_past_wedged_metric(bench, monkeypatch, capsys):
     partials."""
     monkeypatch.setenv("ACCL_BENCH_ATTEMPTS", "2")
     monkeypatch.setenv("ACCL_BENCH_IDLE", "0")
-    monkeypatch.setattr(bench, "_probe_with_idle_retry", lambda errors: True)
+    monkeypatch.setattr(bench, "_probe_with_idle_retry", lambda errors, extras=None: True)
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
     seen_skips = []
 
@@ -168,7 +169,7 @@ def test_run_guarded_preserves_operator_skip_list(bench, monkeypatch):
     not just the first (it marks benches known to wedge the device)."""
     monkeypatch.setenv("ACCL_BENCH_ATTEMPTS", "2")
     bench._SKIP = {"decode_tokens_per_s"}
-    monkeypatch.setattr(bench, "_probe_with_idle_retry", lambda errors: True)
+    monkeypatch.setattr(bench, "_probe_with_idle_retry", lambda errors, extras=None: True)
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
     seen_skips = []
 
@@ -191,7 +192,7 @@ def test_run_guarded_retries_failed_metric_and_clears_stale_error(
     attempt 2; when the re-run succeeds the stale error must not
     contradict the fresh number in the final report."""
     monkeypatch.setenv("ACCL_BENCH_ATTEMPTS", "2")
-    monkeypatch.setattr(bench, "_probe_with_idle_retry", lambda errors: True)
+    monkeypatch.setattr(bench, "_probe_with_idle_retry", lambda errors, extras=None: True)
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
     calls = []
 
@@ -223,7 +224,7 @@ def test_run_guarded_null_headline_uses_remaining_attempts(
     """A clean-exit child whose headline benches all transiently failed
     must consume the remaining retry attempts before falling back."""
     monkeypatch.setenv("ACCL_BENCH_ATTEMPTS", "2")
-    monkeypatch.setattr(bench, "_probe_with_idle_retry", lambda errors: True)
+    monkeypatch.setattr(bench, "_probe_with_idle_retry", lambda errors, extras=None: True)
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
     calls = []
 
@@ -258,7 +259,7 @@ def test_run_guarded_falls_back_when_probe_never_passes(
     bench._save_lkg(_tpu_result(640.0))
     monkeypatch.setattr(
         bench, "_probe_with_idle_retry",
-        lambda errors: errors.update(probe="wedge") or False,
+        lambda errors, extras=None: errors.update(probe="wedge") or False,
     )
     called = []
     monkeypatch.setattr(
@@ -273,7 +274,7 @@ def test_run_guarded_falls_back_when_probe_never_passes(
 
 
 def test_run_guarded_success_stashes_lkg(bench, monkeypatch, capsys):
-    monkeypatch.setattr(bench, "_probe_with_idle_retry", lambda errors: True)
+    monkeypatch.setattr(bench, "_probe_with_idle_retry", lambda errors, extras=None: True)
     monkeypatch.setattr(
         bench, "_run_child",
         lambda budget, skip: (
@@ -302,9 +303,10 @@ def test_probe_parses_wedge_signature(bench, monkeypatch):
         bench.subprocess, "run", lambda *a, **k: FakeProc(),
         raising=False,
     )
-    ok, detail, retryable = bench._probe_device(10.0)
+    ok, detail, retryable, out = bench._probe_device(10.0)
     assert not ok and "71.3" in detail
     assert retryable  # slow dispatch IS the wedge: idle-retry applies
+    assert out["dispatch_ms"] == 71.3
 
 
 def test_probe_fails_fast_on_deterministic_crash(bench, monkeypatch):
@@ -320,7 +322,7 @@ def test_probe_fails_fast_on_deterministic_crash(bench, monkeypatch):
         bench.subprocess, "run", lambda *a, **k: CrashProc(),
         raising=False,
     )
-    ok, detail, retryable = bench._probe_device(10.0)
+    ok, detail, retryable, _ = bench._probe_device(10.0)
     assert not ok and not retryable
     slept = []
     monkeypatch.setattr(bench.time, "sleep", lambda s: slept.append(s))
@@ -346,8 +348,164 @@ def test_probe_retries_on_backend_unavailable(bench, monkeypatch):
         bench.subprocess, "run", lambda *a, **k: WedgeProc(),
         raising=False,
     )
-    ok, detail, retryable = bench._probe_device(10.0)
+    ok, detail, retryable, _ = bench._probe_device(10.0)
     assert not ok and retryable
+
+
+# -- round-4 hardening: the fallback must be unreachable-proof ---------------
+
+
+def test_signal_handler_emits_fallback_and_merges_checkpoint(
+    bench, monkeypatch, tmp_path, capsys
+):
+    """An external SIGTERM at any point must still print the scoreboard
+    line, folding in whatever the in-flight child had checkpointed."""
+    bench._save_lkg(_tpu_result(640.0))
+    ckpt = tmp_path / "inflight.json"
+    ckpt.write_text(json.dumps(
+        {"extras": {"cast_pallas": 800.0}, "errors": {}, "done": []}
+    ))
+    bench._GUARD_STATE.update(
+        extras={"facade_call_overhead_us": 95.0}, errors={},
+        checkpoint=str(ckpt),
+    )
+    exited = []
+    monkeypatch.setattr(bench.os, "_exit", lambda code: exited.append(code))
+    bench._guard_signal_handler(15, None)
+    assert exited == [0]
+    r = _capture_json_line(capsys)
+    assert r["value"] == 640.0  # LKG headline: no fresh headline metric
+    assert r["provenance"]["source"] == "last_known_good"
+    assert "signal 15" in r["provenance"]["reason"]
+    assert r["extras"]["cast_pallas"] == 800.0  # child checkpoint merged
+    assert r["extras"]["facade_call_overhead_us"] == 95.0
+
+
+def test_emit_fallback_prints_at_most_once(bench, capsys):
+    """The signal handler and the normal path share the emit-once guard:
+    a SIGTERM racing the regular emission cannot double-print and hand
+    the driver two JSON lines."""
+    bench._emit_fallback({}, {}, "first")
+    bench._emit_fallback({"combine_xla": 1.0}, {}, "second")
+    out = [
+        line for line in capsys.readouterr().out.strip().splitlines()
+        if line.startswith("{")
+    ]
+    assert len(out) == 1
+
+
+def test_preflight_budget_bounds_probe_loop(bench, monkeypatch):
+    """With the budget spent, the probe loop must return False right away
+    instead of burning more probe/idle cycles (round 3's 30-minute hole:
+    the driver's external timeout fired before the fallback printed)."""
+    monkeypatch.setenv("ACCL_BENCH_PROBE_RETRIES", "10")
+    monkeypatch.setenv("ACCL_BENCH_IDLE", "300")
+    bench._PREFLIGHT_REMAINING = 1.0  # ~spent
+    probes = []
+    monkeypatch.setattr(
+        bench, "_probe_device",
+        lambda d: probes.append(d) or (False, "hung", True, None),
+    )
+    slept = []
+    monkeypatch.setattr(bench.time, "sleep", lambda s: slept.append(s))
+    errors = {}
+    assert not bench._probe_with_idle_retry(errors)
+    # at most the one clipped probe, and NO 300 s idles
+    assert len(probes) <= 1 and all(d <= 1.0 for d in probes)
+    assert not slept
+    assert "budget exhausted" in errors["probe"]
+
+
+def test_run_guarded_stops_attempts_at_wall_budget(
+    bench, monkeypatch, capsys
+):
+    """When the wall budget is spent the parent must fall back with what
+    it has, not start another multi-kiloseconds child."""
+    monkeypatch.setenv("ACCL_BENCH_ATTEMPTS", "5")
+    monkeypatch.setenv("ACCL_BENCH_WALL", "0")  # already exhausted
+    bench._save_lkg(_tpu_result(640.0))
+    monkeypatch.setattr(
+        bench, "_probe_with_idle_retry", lambda errors, extras=None: True
+    )
+    called = []
+    monkeypatch.setattr(
+        bench, "_run_child", lambda *a: called.append(a) or (_ for _ in ()),
+    )
+    bench._run_guarded()
+    assert not called
+    r = _capture_json_line(capsys)
+    assert r["value"] == 640.0
+    assert "wall budget" in r["errors"]["bench_harness"]
+
+
+def test_child_runtime_not_charged_to_preflight_budget(
+    bench, monkeypatch, capsys
+):
+    """The pre-flight budget counts probe+idle seconds only: a first
+    attempt that runs for hours must NOT starve the resume re-probe
+    (else attempt 2 is unreachable under default settings)."""
+    monkeypatch.setenv("ACCL_BENCH_ATTEMPTS", "2")
+    monkeypatch.setenv("ACCL_BENCH_TOTAL", "10")
+    monkeypatch.setenv("ACCL_BENCH_IDLE", "0")
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    monkeypatch.setattr(
+        bench, "_probe_device", lambda d: (True, "0.1 ms", False, None),
+    )
+    calls = []
+
+    def fake_child(budget, skip):
+        calls.append(set(skip))
+        if len(calls) == 1:
+            # a long wedged child: consumes WALL time, not probe budget
+            return None, {}, {}, [], "child exceeded 2400s", None
+        return (
+            _tpu_result(700.0), {"combine_xla": 700.0}, {},
+            ["combine_xla"], None, None,
+        )
+
+    monkeypatch.setattr(bench, "_run_child", fake_child)
+    bench._run_guarded()
+    assert len(calls) == 2  # the resume attempt ran
+    r = _capture_json_line(capsys)
+    assert r["value"] == 700.0
+
+
+def test_signal_handler_kills_inflight_child(bench, monkeypatch, capsys):
+    """Exiting without killing the bench child would orphan a process
+    that keeps the device busy/wedged after the driver's teardown."""
+
+    class FakeChild:
+        killed = False
+
+        def kill(self):
+            self.killed = True
+
+    child = FakeChild()
+    bench._GUARD_STATE.update(
+        extras={}, errors={}, checkpoint=None, child=child,
+    )
+    monkeypatch.setattr(bench.os, "_exit", lambda code: None)
+    bench._guard_signal_handler(15, None)
+    assert child.killed
+
+
+def test_probe_success_records_dispatch_floor(bench, monkeypatch):
+    """The probe's dispatch_ms must land in extras so the facade-overhead
+    record carries its transport floor in the same artifact."""
+
+    class OkProc:
+        returncode = 0
+        stdout = json.dumps(
+            {"ok": True, "dispatch_ms": 1.42, "backend": "tpu"}
+        )
+        stderr = ""
+
+    monkeypatch.setattr(
+        bench.subprocess, "run", lambda *a, **k: OkProc(), raising=False,
+    )
+    extras, errors = {}, {}
+    assert bench._probe_with_idle_retry(errors, extras)
+    assert extras["probe_dispatch_ms"] == 1.42
 
 
 def test_run_guarded_recomputes_headline_on_resume(
@@ -356,7 +514,7 @@ def test_run_guarded_recomputes_headline_on_resume(
     """Attempt 1's skipped-but-completed winner must be the headline even
     though attempt 2's child never saw it."""
     monkeypatch.setenv("ACCL_BENCH_ATTEMPTS", "2")
-    monkeypatch.setattr(bench, "_probe_with_idle_retry", lambda errors: True)
+    monkeypatch.setattr(bench, "_probe_with_idle_retry", lambda errors, extras=None: True)
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
     calls = []
 
