@@ -473,13 +473,21 @@ class DistEngine(StreamPortMixin, BaseEngine):
             key = self._stream_key(self.process_id, stream_id, nxt)
             try:
                 data = self._kv().key_value_try_get_bytes(key)
-            except Exception:
-                return False  # NOT_FOUND: nothing posted yet
+            except Exception as e:
+                if "NOT_FOUND" in str(e):
+                    return False  # nothing posted yet
+                # a persistent KV/transport failure must not be silently
+                # folded into "nothing posted" — the caller would only
+                # see a generic stream TimeoutError with no cause
+                traceback.print_exc()
+                raise
             self._stream_seq[stream_id] = nxt
-        try:
-            self._kv().key_value_delete(key)
-        except Exception:  # pragma: no cover - cleanup only
-            pass
+            # delete before releasing the seq lock: a crash between get
+            # and delete cannot leak the KV entry to a concurrent popper
+            try:
+                self._kv().key_value_delete(key)
+            except Exception:  # pragma: no cover - cleanup only
+                pass
         self.stream_push(stream_id, data)
         return True
 

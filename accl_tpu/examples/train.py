@@ -246,7 +246,14 @@ def train(
       for it in range(start_step, steps):
         if loader is not None:
             t_np, g_np, got_step = loader.next()
-            assert got_step == it, (got_step, it)
+            if got_step != it:
+                # not an assert: stripped under `python -O`, which would
+                # turn a resume/seek mismatch into silent wrong-data
+                # training
+                raise RuntimeError(
+                    f"loader/step misalignment: loader at {got_step}, "
+                    f"trainer at {it}"
+                )
             # validate the WHOLE window: targets carry one position the
             # tokens array doesn't (the shifted-off last column)
             if max(int(t_np.max()), int(g_np.max())) >= cfg.vocab:

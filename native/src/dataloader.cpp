@@ -166,8 +166,11 @@ int accl_dl_open(const char* path, uint64_t batch, uint64_t seq,
   uint64_t count;
   std::memcpy(&dtype, p + 8, 4);
   std::memcpy(&count, p + 12, 8);
+  // divide instead of multiply: `20 + count*dtype` wraps in uint64 for a
+  // corrupt/hostile header with count near 2^62, passing the bound and
+  // letting token_at() read past the mmap (st_size >= 20 checked above)
   if ((dtype != 2 && dtype != 4) ||
-      20 + count * (uint64_t)dtype > (uint64_t)st.st_size) {
+      count > ((uint64_t)st.st_size - 20) / dtype) {
     munmap(map, st.st_size);
     ::close(fd);
     return DL_ERR_FORMAT;
