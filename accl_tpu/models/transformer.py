@@ -107,6 +107,15 @@ class TransformerConfig:
     moe_capacity_factor: float = 1.5
     moe_aux_weight: float = 0.01
     moe_router_z_weight: float = 1e-3
+    # which mesh axis the expert bank shards over.  "dp" (default) is the
+    # DeepSpeed-MoE welded layout: expert parallelism rides the data
+    # axis.  Naming a DEDICATED axis (conventionally "ep", on a
+    # ('dp', 'ep', 'tp') mesh) un-welds them: experts shard over ep while
+    # the batch shards over (dp x ep) — ep acts as a sub-axis of data
+    # parallelism for the dense params, so ep can be sized to the expert
+    # count independently of how much plain data parallelism dp carries.
+    # The dispatch/return all-to-alls ride this axis either way.
+    moe_mesh_axis: str = "dp"
     # attention lowering: "auto" (default) picks per sequence length and
     # backend — measured on v5e, the materialized-scores form wins below
     # ~4K tokens (XLA fuses it well and a fused fold's per-tile softmax
@@ -149,11 +158,18 @@ def _check_axis_compat(cfg) -> None:
             "vocab_parallel: the tp mesh axis becomes the sequence ring "
             "(weights replicated over it)"
         )
-    if cfg.n_experts and (cfg.seq_parallel or cfg.context_parallel):
+    if cfg.n_experts and cfg.seq_parallel:
         raise ValueError(
-            "n_experts (MoE) does not compose with seq_parallel or "
-            "context_parallel yet — expert parallelism rides the dp axis "
-            "on the dense dp x tp layout"
+            "n_experts (MoE) does not compose with seq_parallel — the "
+            "MLP entry would need a sequence gather in front of every "
+            "routed dispatch; use context_parallel for sequence sharding "
+            "with MoE (experts on the expert axis, ring on tp)"
+        )
+    if cfg.n_experts and cfg.moe_mesh_axis == "tp":
+        raise ValueError(
+            "moe_mesh_axis cannot be 'tp': tp carries the within-expert "
+            "column/row split (and the cp ring) — put experts on 'dp' or "
+            "a dedicated 'ep' mesh axis"
         )
 
 
@@ -162,18 +178,59 @@ def _check_moe_mesh(cfg, mesh) -> None:
     device_put failure names neither n_experts nor the axis)."""
     if not cfg.n_experts:
         return
-    dp = mesh.shape["dp"]
-    tp = mesh.shape["tp"]
-    if cfg.n_experts % dp:
+    ep_ax = cfg.moe_mesh_axis
+    if ep_ax not in mesh.axis_names:
         raise ValueError(
-            f"n_experts ({cfg.n_experts}) must divide by dp ({dp}) — "
-            "expert parallelism shards the expert bank over the dp axis"
+            f"moe_mesh_axis {ep_ax!r} is not an axis of this mesh "
+            f"({mesh.axis_names}) — expert parallelism needs its axis "
+            "in the mesh"
         )
-    if cfg.d_ff % tp:
+    ep = mesh.shape[ep_ax]
+    tp = mesh.shape["tp"]
+    if cfg.n_experts % ep:
+        raise ValueError(
+            f"n_experts ({cfg.n_experts}) must divide by {ep_ax} ({ep}) "
+            "— expert parallelism shards the expert bank over "
+            f"the {ep_ax!r} axis"
+        )
+    if not cfg.context_parallel and cfg.d_ff % tp:
+        # under cp the tp axis is the sequence ring (experts replicated
+        # over it), so there is no within-expert tp split to divide for
         raise ValueError(
             f"d_ff ({cfg.d_ff}) must divide by tp ({tp}) — each "
             "expert's FFN is column/row-split over tp"
         )
+
+
+def _data_axes(cfg, mesh) -> tuple:
+    """Mesh axes the batch (and the loss mean) shards over: always
+    'dp', plus the dedicated expert axis when the mesh carries one —
+    the DeepSpeed-MoE layout where ep is a sub-axis of data parallelism
+    for every non-expert param (dense params replicate over ep and their
+    grads psum over it, exactly like dp)."""
+    ep_ax = getattr(cfg, "moe_mesh_axis", "dp")
+    if cfg.n_experts and ep_ax != "dp" and ep_ax in mesh.axis_names:
+        return ("dp", ep_ax)
+    if "ep" in mesh.axis_names:
+        # a dedicated ep axis on the mesh is extra data parallelism even
+        # for dense configs, so one mesh serves both model kinds
+        return ("dp", "ep")
+    return ("dp",)
+
+
+def _batch_entry(axes: tuple):
+    """PartitionSpec entry for the batch dim over the data axes."""
+    return axes if len(axes) > 1 else axes[0]
+
+
+def _mean_over_axes(local, axes: tuple, denom: int):
+    """Global mean of a per-rank value: sum-allreduce over each data
+    axis, then one divide.  THE shared reduction for every train-step
+    maker (SGD and ZeRO, plain and accumulated) — one definition so the
+    steps cannot diverge on axis handling."""
+    for a in axes:
+        local = collectives.allreduce(local, a, ReduceFunction.SUM)
+    return local / denom
 
 
 # parameter partition specs over ('dp', 'tp'): column-parallel weights shard
@@ -200,19 +257,31 @@ def param_specs(cfg: TransformerConfig) -> Dict:
         }
     if cfg.n_experts:
         # MoE: the dense FFN pair is replaced by the expert bank — the
-        # EXPERT dim shards over dp (expert parallelism; each dp rank
-        # owns n_experts/dp experts), the router gate is replicated
+        # EXPERT dim shards over the expert axis (cfg.moe_mesh_axis:
+        # "dp" welded, or a dedicated "ep"); the router gate is
+        # replicated
         for k_ in ("w1", "w2"):
             layer.pop(k_, None)
-        # experts shard over dp (expert parallelism) AND each expert's
-        # d_ff over tp (Megatron column/row split within the expert), so
-        # MoE keeps the dense layout's tp FLOP/memory sharding instead
-        # of replicating expert compute across tp
-        layer["moe"] = {
-            "gate": P(None, None),
-            "w1": P("dp", None, "tp"),
-            "w2": P("dp", "tp", None),
-        }
+        ep_ax = cfg.moe_mesh_axis
+        if cfg.context_parallel:
+            # under cp the tp axis is the sequence ring: experts (like
+            # every other weight) replicate over it — only the expert
+            # dim shards
+            layer["moe"] = {
+                "gate": P(None, None),
+                "w1": P(ep_ax, None, None),
+                "w2": P(ep_ax, None, None),
+            }
+        else:
+            # experts shard over the expert axis AND each expert's d_ff
+            # over tp (Megatron column/row split within the expert), so
+            # MoE keeps the dense layout's tp FLOP/memory sharding
+            # instead of replicating expert compute across tp
+            layer["moe"] = {
+                "gate": P(None, None),
+                "w1": P(ep_ax, None, "tp"),
+                "w2": P(ep_ax, "tp", None),
+            }
     out = {
         # vocab parallelism shards the table's VOCAB rows over tp (the
         # pos table and everything fed by the tp-allreduced lookup stay
@@ -589,7 +658,8 @@ def _cp_block_k(t_local: int, attn_impl: str):
     return None  # tiny/ragged shard: whole-hop fold is already small
 
 
-def _block_cp(x, lp, n_heads, cp_axis, rope_base=None, attn_impl="auto"):
+def _block_cp(x, lp, n_heads, cp_axis, rope_base=None, attn_impl="auto",
+              ep_axis=None, moe_cfg=None, with_aux=False):
     """Context-parallel block: ``x`` is (B, T/cp, D), this rank's STRIPED
     sequence shard over ``cp_axis``; weights are full (replicated over
     the axis).  QKV/MLP matmuls are purely local; attention is striped
@@ -597,7 +667,14 @@ def _block_cp(x, lp, n_heads, cp_axis, rope_base=None, attn_impl="auto"):
     rotate around the ring folding into the local online-softmax state —
     so nothing in the block ever materializes the full sequence.  Rope
     rotates by the shard's GLOBAL token positions; ``attn_impl`` maps to
-    the fold's within-hop sub-tiling (:func:`_cp_block_k`)."""
+    the fold's within-hop sub-tiling (:func:`_cp_block_k`).
+
+    With an expert bank on the layer (MoE x cp — long-context MoE), the
+    MLP half routes this rank's sequence shard through the expert
+    dispatch all-to-all over ``ep_axis`` while the K/V ring turns over
+    tp: the two communication patterns ride DIFFERENT mesh axes, which
+    is exactly why the composition is legal (tp_axis stays None — under
+    cp the experts, like every weight, are replicated over the ring)."""
     from .ring_attention import striped_attention
 
     positions = _cp_positions(x.shape[1], cp_axis)
@@ -611,7 +688,7 @@ def _block_cp(x, lp, n_heads, cp_axis, rope_base=None, attn_impl="auto"):
         positions=positions, attention_fn=ring,
     )
     x = x + o
-    return _mlp(x, lp, None)
+    return _mlp(x, lp, None, ep_axis, moe_cfg, with_aux)
 
 
 def _block_sp(x_sp, lp, n_heads_local, tp_axis, return_kv=False,
@@ -675,11 +752,16 @@ def _enter_block_layout(x, cfg, tp_axis, tp_size, return_kv=False,
                 "context_parallel is causal/decoder-only (the striped "
                 "ring's load balance argument is the causal mask)"
             )
-        block = partial(
-            _block_cp, n_heads=cfg.n_heads, cp_axis=tp_axis,
+        cp_kw = dict(
+            n_heads=cfg.n_heads, cp_axis=tp_axis,
             rope_base=cfg.rope_base if cfg.uses_rope() else None,
             attn_impl=cfg.attention,
         )
+        if cfg.n_experts:
+            cp_kw["ep_axis"] = cfg.moe_mesh_axis
+            cp_kw["moe_cfg"] = cfg
+            cp_kw["with_aux"] = True
+        block = partial(_block_cp, **cp_kw)
         return x, block, "cp"
     heads_local = cfg.n_heads // tp_size
     if cfg.vocab_parallel and tp_size > 1 and cfg.vocab % tp_size:
@@ -701,10 +783,11 @@ def _enter_block_layout(x, cfg, tp_axis, tp_size, return_kv=False,
     if return_kv:
         kw["return_kv"] = True
     if cfg.n_experts:
-        # expert parallelism rides the dp axis: the sharded makers always
-        # run over a ('dp', 'tp') mesh, so a live tp_axis implies dp
-        # exists; single-device calls keep every expert local
-        kw["ep_axis"] = "dp" if tp_axis is not None else None
+        # expert parallelism rides cfg.moe_mesh_axis ("dp" welded, or a
+        # dedicated "ep"): the sharded makers always run over a mesh
+        # carrying it, so a live tp_axis implies the axis exists;
+        # single-device calls keep every expert local
+        kw["ep_axis"] = cfg.moe_mesh_axis if tp_axis is not None else None
         kw["moe_cfg"] = cfg
         kw["with_aux"] = not return_kv  # serving paths skip router aux
     if not sp:
@@ -792,11 +875,18 @@ def loss_fn(params, tokens, targets, cfg, tp_axis=None, tp_size=1):
     exist on any rank."""
     _check_axis_compat(cfg)
     if _cp_active(cfg, tp_axis):
-        x, _, _ = _final_hidden(params, tokens, cfg, tp_axis, tp_size)
+        x, _, aux = _final_hidden(params, tokens, cfg, tp_axis, tp_size)
         z = _lm_logits(x, params["embed"], cfg, tp_axis, gather=False)
         nll = _token_nll(z, targets)
+        local = nll.mean()
+        if aux is not None:
+            # MoE x cp: the router health terms were computed over this
+            # rank's striped shard; the ring mean below averages them
+            # across the sequence ring together with the nll (the same
+            # per-rank-tokens approximation the dp average makes)
+            local = local + _moe_penalty(cfg, aux)
         return (
-            collectives.allreduce(nll.mean(), tp_axis, ReduceFunction.SUM)
+            collectives.allreduce(local, tp_axis, ReduceFunction.SUM)
             / tp_size
         )
     if not _vp_active(cfg, tp_axis):
@@ -1043,7 +1133,10 @@ def generate(
             x, ck, cv = _block_decode(
                 x, lp, ck, cv, pos, heads_local, tp_axis,
                 rope_tables=tables,
-                ep_axis="dp" if (tp_axis and cfg.n_experts) else None,
+                ep_axis=(
+                    cfg.moe_mesh_axis
+                    if (tp_axis and cfg.n_experts) else None
+                ),
                 moe_cfg=cfg if cfg.n_experts else None,
             )
             new_caches.append((ck, cv))
@@ -1083,31 +1176,36 @@ def make_sharded_generate(
     _check_moe_mesh(cfg, mesh)
     specs = param_specs(cfg)
     tp = mesh.shape["tp"]
+    axes = _data_axes(cfg, mesh)
+    batch = _batch_entry(axes)
 
     if temperature > 0.0:
         from jax import lax
 
         def gen(params, prompt, rng):
-            rng = jax.random.fold_in(rng, lax.axis_index("dp"))
+            # one fold per data axis: every batch shard draws its own
+            # stream while a tp gang stays in lockstep
+            for a in axes:
+                rng = jax.random.fold_in(rng, lax.axis_index(a))
             return generate(
                 params, prompt, steps, cfg, "tp", tp,
                 temperature=temperature, top_k=top_k, rng=rng,
             )
 
-        in_specs = (specs, P("dp", None), P())
+        in_specs = (specs, P(batch, None), P())
     else:
 
         def gen(params, prompt):
             return generate(params, prompt, steps, cfg, "tp", tp)
 
-        in_specs = (specs, P("dp", None))
+        in_specs = (specs, P(batch, None))
 
     fn = jax.jit(
         shard_map(
             gen,
             mesh=mesh,
             in_specs=in_specs,
-            out_specs=P("dp", None),
+            out_specs=P(batch, None),
             check_vma=False,
         )
     )
@@ -1156,6 +1254,7 @@ def make_sharded_forward(cfg: TransformerConfig, mesh: Mesh):
     _check_moe_mesh(cfg, mesh)
     specs = param_specs(cfg)
     tp = mesh.shape["tp"]
+    batch = _batch_entry(_data_axes(cfg, mesh))
 
     def fwd(params, tokens):
         return forward(params, tokens, cfg, tp_axis="tp", tp_size=tp)
@@ -1170,8 +1269,8 @@ def make_sharded_forward(cfg: TransformerConfig, mesh: Mesh):
         smapped = shard_map(
             fwd,
             mesh=mesh,
-            in_specs=(specs, P("dp", "tp")),
-            out_specs=P("dp", "tp", None),
+            in_specs=(specs, P(batch, "tp")),
+            out_specs=P(batch, "tp", None),
             check_vma=False,
         )
 
@@ -1181,7 +1280,7 @@ def make_sharded_forward(cfg: TransformerConfig, mesh: Mesh):
             # sequence once at the program's exit edge (under explicit
             # mesh axes the unstripe permutation cannot run on a
             # sequence-sharded operand, so reshard first)
-            out = _reshard(out, mesh, P("dp", None, None))
+            out = _reshard(out, mesh, P(batch, None, None))
             return unstripe_sequence(out, tp, axis=1)
 
         fn = jax.jit(outer)
@@ -1190,8 +1289,8 @@ def make_sharded_forward(cfg: TransformerConfig, mesh: Mesh):
             shard_map(
                 fwd,
                 mesh=mesh,
-                in_specs=(specs, P("dp", None)),
-                out_specs=P("dp", None, None),
+                in_specs=(specs, P(batch, None)),
+                out_specs=P(batch, None, None),
                 check_vma=False,
             )
         )
@@ -1222,12 +1321,17 @@ def make_sharded_train_step(cfg: TransformerConfig, mesh: Mesh, lr: float = 1e-2
     _check_moe_mesh(cfg, mesh)
     specs = param_specs(cfg)
     tp = mesh.shape["tp"]
-    dp = mesh.shape["dp"]
+    # data axes: 'dp', plus the dedicated expert axis when present (the
+    # batch shards over both; dense-param grads psum over both)
+    axes = _data_axes(cfg, mesh)
+    denom = 1
+    for a in axes:
+        denom *= mesh.shape[a]
 
     def step(params, tokens, targets):
         def global_loss(p):
             local = loss_fn(p, tokens, targets, cfg, "tp", tp)
-            return collectives.allreduce(local, "dp", ReduceFunction.SUM) / dp
+            return _mean_over_axes(local, axes, denom)
 
         loss, grads = jax.value_and_grad(global_loss)(params)
         params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
@@ -1238,7 +1342,8 @@ def make_sharded_train_step(cfg: TransformerConfig, mesh: Mesh, lr: float = 1e-2
     # ring-mean keeps the differentiated quantity the global mean, so
     # the replicated weights' grads get the tp-psum from shard_map's
     # transpose machinery exactly like dp's
-    seq_spec = P("dp", "tp") if cfg.context_parallel else P("dp", None)
+    batch = _batch_entry(axes)
+    seq_spec = P(batch, "tp") if cfg.context_parallel else P(batch, None)
     smapped = shard_map(
         step,
         mesh=mesh,

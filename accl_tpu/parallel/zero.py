@@ -242,15 +242,15 @@ def zero_state_specs(specs, dp_axis: str = "dp",
 
 
 def clip_by_global_norm(grads, specs, max_norm: float, tp_axis=None,
-                        dp_axis=None):
+                        dp_axis=None, ep_axis=None):
     """Scale ``grads`` so their GLOBAL L2 norm is at most ``max_norm`` —
     inside shard_map.  Leaves whose spec shards over ``tp_axis`` (or
-    ``dp_axis`` — expert-parallel MoE banks) hold disjoint slices: their
-    local squared sums psum across those axes so each element counts
-    exactly once; replicated leaves already carry the full gradient on
-    every rank.  Dp-REPLICATED grads are dp-reduced by the time this
-    runs (the loss mean's transpose placed that psum), so they need no
-    dp exchange.  Returns ``(clipped_grads, global_norm)``."""
+    ``dp_axis``/``ep_axis`` — expert-parallel MoE banks) hold disjoint
+    slices: their local squared sums psum across those axes so each
+    element counts exactly once; replicated leaves already carry the
+    full gradient on every rank.  Dp-REPLICATED grads are dp-reduced by
+    the time this runs (the loss mean's transpose placed that psum), so
+    they need no dp exchange.  Returns ``(clipped_grads, global_norm)``."""
     is_leaf = lambda x: isinstance(x, P)
     gleaves = jax.tree.leaves(grads)
     sleaves = jax.tree.leaves(specs, is_leaf=is_leaf)
@@ -259,7 +259,7 @@ def clip_by_global_norm(grads, specs, max_norm: float, tp_axis=None,
     buckets: dict = {}
     for g, s in zip(gleaves, sleaves):
         axes = tuple(
-            a for a in (tp_axis, dp_axis)
+            a for a in (tp_axis, dp_axis, ep_axis)
             if a is not None and a in _spec_axes(s)
         )
         ss = jnp.sum(jnp.square(g.astype(jnp.float32)))
@@ -391,7 +391,10 @@ def make_zero_train_step(
     clipping to the (accumulated) gradient before the update."""
     from ..constants import ReduceFunction
     from ..models.transformer import (
+        _batch_entry,
         _check_moe_mesh,
+        _data_axes,
+        _mean_over_axes,
         _reject_untrainable_attention,
         _shard_params,
         loss_fn,
@@ -406,7 +409,19 @@ def make_zero_train_step(
     specs = param_specs(model_cfg)
     sspecs = zero_state_specs(specs, master_weights=adam.master_weights)
     tp = mesh.shape["tp"]
-    dp = mesh.shape["dp"]
+    # data axes: 'dp' plus the dedicated expert axis when the mesh has
+    # one (batch shards over both; dense grads psum over both).  The
+    # ZeRO moment slices stay dp-sharded (replicated over ep): ep's job
+    # is expert placement, dp's is the optimizer-state split.
+    data_axes = _data_axes(model_cfg, mesh)
+    denom = 1
+    for a in data_axes:
+        denom *= mesh.shape[a]
+    ep_ax = (
+        model_cfg.moe_mesh_axis
+        if model_cfg.n_experts and model_cfg.moe_mesh_axis != "dp"
+        else None
+    )
 
     if accum_steps < 1:
         raise ValueError(f"accum_steps ({accum_steps}) must be >= 1")
@@ -419,10 +434,7 @@ def make_zero_train_step(
 
             def global_loss(p):
                 local = loss_fn(p, tokens, targets, model_cfg, "tp", tp)
-                return (
-                    collectives.allreduce(local, "dp", ReduceFunction.SUM)
-                    / dp
-                )
+                return _mean_over_axes(local, data_axes, denom)
 
             loss, grads = jax.value_and_grad(global_loss)(params)
         else:
@@ -446,10 +458,15 @@ def make_zero_train_step(
             is_p_ = lambda x: isinstance(x, P)
             pl_, pd_ = jax.tree.flatten(params)
             sl_ = jax.tree.leaves(specs, is_leaf=is_p_)
-            # dp-SHARDED leaves (expert banks) are already dp-varying —
-            # only the dp-replicated leaves need the cast
+            # data-axis-SHARDED leaves (expert banks) are already varying
+            # on their axis — only the replicated axes need the cast
+            def _missing(sp_):
+                return tuple(
+                    a for a in data_axes if a not in _spec_axes(sp_)
+                )
+
             params_v = jax.tree.unflatten(pd_, [
-                x if "dp" in _spec_axes(sp_) else _pvary(x, ("dp",))
+                _pvary(x, _missing(sp_)) if _missing(sp_) else x
                 for x, sp_ in zip(pl_, sl_)
             ])
 
@@ -478,23 +495,17 @@ def make_zero_train_step(
             # (expert banks) skip the psum: their gradients arrive
             # fully summed through the dispatch all-to-all's transpose
             # even for a dp-local loss
-            loss = (
-                collectives.allreduce(lsum, "dp", ReduceFunction.SUM)
-                / (dp * accum_steps)
-            )
+            loss = _mean_over_axes(lsum, data_axes, denom * accum_steps)
             is_p = lambda x: isinstance(x, P)
             gl, gd = jax.tree.flatten(gsum)
             sl = jax.tree.leaves(specs, is_leaf=is_p)
             grads = jax.tree.unflatten(gd, [
-                g / (dp * accum_steps)
-                if "dp" in _spec_axes(sp_)
-                else collectives.allreduce(g, "dp", ReduceFunction.SUM)
-                / (dp * accum_steps)
+                _mean_over_axes(g, _missing(sp_), denom * accum_steps)
                 for g, sp_ in zip(gl, sl)
             ])
         if adam.clip_grad_norm is not None:
             grads, _ = clip_by_global_norm(
-                grads, specs, adam.clip_grad_norm, "tp", "dp"
+                grads, specs, adam.clip_grad_norm, "tp", "dp", ep_ax
             )
         new_params, new_state = zero_adam_update(
             params, grads, state, "dp", adam, specs=specs
@@ -505,8 +516,9 @@ def make_zero_train_step(
     # outside shard_map) and sequence-shard over tp — the same entry
     # contract as the SGD maker's cp path; loss_fn's cp branch consumes
     # the rank's striped shard
+    batch = _batch_entry(data_axes)
     seq_spec = (
-        P("dp", "tp") if model_cfg.context_parallel else P("dp", None)
+        P(batch, "tp") if model_cfg.context_parallel else P(batch, None)
     )
     smapped = shard_map(
         step,
