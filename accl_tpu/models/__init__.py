@@ -46,6 +46,8 @@ from .composed import (  # noqa: F401
 )
 from .pipeline import (  # noqa: F401
     pipeline_apply,
+    pipeline_apply_interleaved,
+    pipeline_bubble_fraction,
     pipeline_loss,
     pipeline_loss_and_grads,
     pipeline_loss_and_grads_1f1b,

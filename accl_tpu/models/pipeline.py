@@ -93,6 +93,116 @@ def pipeline_apply(
     return outputs
 
 
+def pipeline_apply_interleaved(
+    stage_params,
+    microbatches: jax.Array,
+    pp_axis: str,
+    stage_fn: Callable,
+    v_stages: int,
+):
+    """Interleaved virtual-stage pipeline forward (Megatron-style): each
+    of the S devices owns ``v_stages`` NON-contiguous chunks, assigned
+    round-robin — global stage ``j`` lives on device ``j % S`` as its
+    chunk ``j // S`` — so a microbatch hops device 0, 1, .., S-1, then
+    WRAPS to device 0 for chunk 1, and so on through ``V*S`` stages.
+
+    Why: the pipeline bubble is the wave-front fill/drain, one warmup
+    tick per stage boundary.  With chunks 1/V the size of a monolithic
+    stage, the absolute bubble shrinks to ``(S-1) * t_stage / V`` —
+    below GPipe's and 1F1B's ``(S-1) * t_stage`` (1F1B flattens the
+    MEMORY profile, not the bubble; interleaving attacks the bubble) —
+    at the price of V x the ppermute handoffs per microbatch.
+
+    The schedule is a per-device work QUEUE: device ``d`` at tick ``t``
+    executes queue item ``q = t - d`` (idle while out of range), where
+    item ``q`` decodes round-robin as round ``r = q // (V*S)``, chunk
+    ``v = (q % (V*S)) // S``, lane ``i = q % S``, microbatch
+    ``m = r*S + i``.  Every producer runs exactly one tick before its
+    consumer on the NEXT ring device, so the handoff is ONE uniform
+    neighbor ppermute per tick (the wrap edge S-1 -> 0 carries the
+    chunk boundary) — same static-shape, validity-in-data discipline as
+    :func:`pipeline_apply`.  Total ticks: ``M*V + S - 1`` of cost
+    ``t_stage / V`` each.
+
+    Requires ``M % S == 0`` (microbatches stream in rounds of S — the
+    standard interleaved-schedule constraint).  ``stage_params`` leaves
+    carry a leading ``(V,)`` chunk dim.  Returns (M, ...) final-stage
+    outputs, valid on the LAST device (zeros elsewhere), like
+    :func:`pipeline_apply`.
+    """
+    S = lax.axis_size(pp_axis)
+    me = lax.axis_index(pp_axis)
+    M = microbatches.shape[0]
+    V = int(v_stages)
+    if M % S:
+        raise ValueError(
+            f"interleaved schedule needs microbatches ({M}) divisible "
+            f"by pipeline stages ({S})"
+        )
+    for leaf in jax.tree_util.tree_leaves(stage_params):
+        if leaf.shape[0] != V:
+            # dynamic_index_in_dim CLAMPS an out-of-range chunk index —
+            # a mismatch would silently skip/duplicate stages
+            raise ValueError(
+                f"stage_params leading chunk dim ({leaf.shape[0]}) must "
+                f"equal v_stages ({V})"
+            )
+
+    ring = [(i, (i + 1) % S) for i in range(S)]  # incl. the wrap edge
+
+    def step(t, state):
+        carry, outputs = state
+        q = t - me
+        valid = (q >= 0) & (q < M * V)
+        qc = jnp.clip(q, 0, M * V - 1)
+        r = qc // (V * S)
+        v = (qc % (V * S)) // S
+        m = r * S + (qc % S)
+        chunk = jax.tree_util.tree_map(
+            lambda p: lax.dynamic_index_in_dim(p, v, 0, False), stage_params
+        )
+        # stage 0 of chunk 0 on device 0 reads the microbatch; everyone
+        # else consumes the ring arrival from the previous tick
+        inp = jnp.where(
+            (me == 0) & (v == 0),
+            lax.dynamic_index_in_dim(microbatches, m, 0, False),
+            carry,
+        )
+        act = stage_fn(chunk, inp)
+        act = jnp.where(valid, act, jnp.zeros_like(act))
+        # the final stage (last chunk on the last device) banks its
+        # result; other lanes write back what the slot already held
+        bank = jnp.where(
+            valid & (me == S - 1) & (v == V - 1), act, outputs[m]
+        )
+        outputs = outputs.at[m].set(bank)
+        return lax.ppermute(act, pp_axis, ring), outputs
+
+    carry = _pvary(jnp.zeros_like(microbatches[0]), pp_axis)
+    outputs = _pvary(jnp.zeros_like(microbatches), pp_axis)
+    _, outputs = lax.fori_loop(
+        0, M * V + S - 1, step, (carry, outputs), unroll=False
+    )
+    return outputs
+
+
+def pipeline_bubble_fraction(
+    schedule: str, n_stages: int, n_microbatches: int, v_stages: int = 1
+) -> float:
+    """Idle fraction of the pipeline's per-device time budget.
+
+    GPipe and 1F1B share the wave-front bubble ``(S-1) / (M + S - 1)``
+    (1F1B bounds the activation STASH, not the bubble); the interleaved
+    schedule's chunk ticks give ``(S-1) / (M*V + S - 1)`` — the same
+    S-1 warmup slots, each 1/V the cost."""
+    S, M, V = n_stages, n_microbatches, v_stages
+    if schedule in ("gpipe", "1f1b"):
+        return (S - 1) / (M + S - 1)
+    if schedule == "interleaved":
+        return (S - 1) / (M * V + S - 1)
+    raise ValueError(f"unknown pipeline schedule {schedule!r}")
+
+
 def pipeline_loss(
     stage_params,
     microbatches: jax.Array,
@@ -254,17 +364,27 @@ def pipeline_loss_and_grads(
     stage_fn: Callable,
     loss_fn: Callable,
     schedule: str = "gpipe",
+    v_stages: int = 1,
 ):
     """Config-selectable pipeline backward: ``schedule="gpipe"`` is
     ``jax.grad`` through :func:`pipeline_loss` (autodiff stores one
     residual set per loop step, O(M) activations); ``"1f1b"`` is the
-    hand-scheduled interleave (O(min(S, M)) stash + recompute).  Both
+    hand-scheduled interleave (O(min(S, M)) stash + recompute);
+    ``"interleaved"`` streams ``v_stages`` round-robin chunks per device
+    (:func:`pipeline_apply_interleaved` — the bubble drops to
+    ``(S-1)/V`` warmup chunk-ticks; see
+    :func:`pipeline_bubble_fraction`) with autodiff backward.  All
     return the identical ``(loss, stage_grads)``."""
+    if schedule != "interleaved" and v_stages != 1:
+        raise ValueError(
+            f"v_stages ({v_stages}) only applies to the interleaved "
+            f"schedule, not {schedule!r}"
+        )
     if schedule == "1f1b":
         return pipeline_loss_and_grads_1f1b(
             stage_params, microbatches, targets, pp_axis, stage_fn, loss_fn
         )
-    if schedule != "gpipe":
+    if schedule not in ("gpipe", "interleaved"):
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
     S = lax.axis_size(pp_axis)
     me = lax.axis_index(pp_axis)
@@ -274,7 +394,12 @@ def pipeline_loss_and_grads(
     # gradient by S.  The last stage's masked scalar still backpropagates
     # to every stage through the transposed ppermute edges.
     def local_loss(p):
-        outs = pipeline_apply(p, microbatches, pp_axis, stage_fn)
+        if schedule == "interleaved":
+            outs = pipeline_apply_interleaved(
+                p, microbatches, pp_axis, stage_fn, v_stages
+            )
+        else:
+            outs = pipeline_apply(p, microbatches, pp_axis, stage_fn)
         per_mb = jax.vmap(loss_fn)(outs, targets)
         return jnp.where(me == S - 1, per_mb.mean(), 0.0)
 
