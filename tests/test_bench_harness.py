@@ -508,6 +508,19 @@ def test_probe_success_records_dispatch_floor(bench, monkeypatch):
     assert extras["probe_dispatch_ms"] == 1.42
 
 
+def test_gang_device_time_invariant(bench, monkeypatch):
+    """The device-time decomposition must satisfy device <= wall and
+    floor = wall - device (VERDICT r3 item 10's artifact contract),
+    live against the real facade on the CPU tier."""
+    monkeypatch.setattr(bench, "_SMALL", True)
+    out = bench._bench_gang_device_time()
+    wall = out["gang_allreduce_wall_us"]
+    dev = out["gang_allreduce_device_us"]
+    floor = out["gang_allreduce_dispatch_floor_us"]
+    assert 0 <= dev <= wall
+    assert floor == pytest.approx(wall - dev, abs=0.2)
+
+
 def test_run_guarded_recomputes_headline_on_resume(
     bench, monkeypatch, capsys
 ):
