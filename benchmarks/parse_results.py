@@ -1,0 +1,126 @@
+"""Regenerate the BENCH_NOTES sweep tables from the committed CSVs.
+
+The committed analog of the reference's ``parse_bench_results.py``
+(``/root/reference/test/host/xrt/parse_bench_results.py``): the sweep
+runners (`sweep.py`) write one CSV row per (collective, size) with the
+warm-run mean duration; this tool folds those CSVs back into the
+markdown summary tables so the numbers in BENCH_NOTES.md are
+regenerable artifacts, not hand-transcription.
+
+Usage::
+
+    python benchmarks/parse_results.py [results_dir]
+
+Prints, per CSV: a per-collective peak-throughput summary and a
+selected-sizes table (the BENCH_NOTES format).  Pure stdlib — no jax,
+no device.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+import sys
+from collections import defaultdict
+
+# sizes (elements per rank) the BENCH_NOTES tables quote; sizes missing
+# from a sweep are skipped
+_TABLE_SIZES = [2**10, 2**16, 2**19, 2**23]
+
+
+def load(path: str) -> dict:
+    """{collective: [(count, bytes, duration_ns, gbps), ...]} sorted by
+    element count."""
+    out: dict = defaultdict(list)
+    with open(path, newline="") as f:
+        for row in csv.DictReader(f):
+            out[row["collective"]].append((
+                int(row["count"]), int(row["bytes"]),
+                float(row["duration_ns"]), float(row["gbps"]),
+            ))
+    for rows in out.values():
+        rows.sort()
+    return dict(out)
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit, div in (("GiB", 2**30), ("MiB", 2**20), ("KiB", 2**10)):
+        if n >= div and n % div == 0:
+            return f"{n // div} {unit}"
+    return f"{n} B"
+
+
+def _fmt_rate(gbps: float) -> str:
+    return f"{gbps:.2f} Gb/s" if gbps >= 0.005 else f"{gbps:.4f} Gb/s"
+
+
+def summarize(path: str) -> str:
+    data = load(path)
+    name = os.path.basename(path)
+    lines = [f"### {name}", ""]
+
+    # peak throughput per collective (the envelope number)
+    lines += [
+        "| collective | sizes | peak | at bytes/rank |",
+        "|---|---|---|---|",
+    ]
+    for coll, rows in sorted(data.items()):
+        peak = max(rows, key=lambda r: r[3])
+        lines.append(
+            f"| {coll} | {len(rows)} | {_fmt_rate(peak[3])} "
+            f"| {_fmt_bytes(peak[1])} |"
+        )
+    lines.append("")
+
+    # the BENCH_NOTES selected-sizes table, one column per collective
+    colls = sorted(data)
+    by_count = {
+        coll: {r[0]: r for r in rows} for coll, rows in data.items()
+    }
+    sizes = [
+        s for s in _TABLE_SIZES
+        if any(s in by_count[c] for c in colls)
+    ]
+    if sizes:
+        lines.append(
+            "| elements/rank | bytes/rank | "
+            + " | ".join(colls) + " |"
+        )
+        lines.append("|---" * (len(colls) + 2) + "|")
+        for s in sizes:
+            nbytes = next(
+                by_count[c][s][1] for c in colls if s in by_count[c]
+            )
+            cells = [
+                _fmt_rate(by_count[c][s][3]) if s in by_count[c] else "—"
+                for c in colls
+            ]
+            exp = s.bit_length() - 1
+            lines.append(
+                f"| 2^{exp} | {_fmt_bytes(nbytes)} | "
+                + " | ".join(cells) + " |"
+            )
+        lines.append("")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> str:
+    argv = sys.argv[1:] if argv is None else argv
+    results = argv[0] if argv else os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "results"
+    )
+    if not os.path.isdir(results):
+        raise SystemExit(f"no such results directory: {results}")
+    paths = sorted(
+        os.path.join(results, p)
+        for p in os.listdir(results) if p.endswith(".csv")
+    )
+    if not paths:
+        raise SystemExit(f"no CSVs in {results}")
+    doc = "\n".join(summarize(p) for p in paths)
+    print(doc)
+    return doc
+
+
+if __name__ == "__main__":
+    main()

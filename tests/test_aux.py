@@ -275,3 +275,27 @@ def test_capabilities_report(group2):
     assert caps["streams"] and caps["rendezvous"]
     assert isinstance(caps["device_tier"], bool)
     assert caps["platform"] == "cpu"
+
+
+def test_parse_results_regenerates_sweep_tables(capsys):
+    """benchmarks/parse_results.py (the parse_bench_results.py analog)
+    folds the committed sweep CSVs into the BENCH_NOTES tables — the
+    quoted 8-rank allreduce numbers must come back out of the CSVs."""
+    import importlib.util
+    import os
+
+    path = os.path.join(
+        os.path.dirname(__file__), "..", "benchmarks", "parse_results.py"
+    )
+    spec = importlib.util.spec_from_file_location("parse_results", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    doc = mod.main([])
+    capsys.readouterr()  # swallow the CLI print
+    assert "sweep_ops_w8.csv" in doc and "sweep_emulator_w4.csv" in doc
+    # the BENCH_NOTES 8-rank allreduce row at 2^19: psum 1.25, ring 0.54
+    row = next(
+        line for line in doc.splitlines()
+        if line.startswith("| 2^19") and "1.25" in line
+    )
+    assert "0.54" in row
