@@ -47,6 +47,7 @@ def train(
     master_weights: bool = False,
     dtype: str = "float32",
     n_experts: int = 0,
+    ep: int = 1,
 ):
     """Train the flagship transformer.
 
@@ -72,8 +73,11 @@ def train(
     blocks, activations sequence-sharded end-to-end).
 
     ``n_experts`` switches every block's FFN to the expert-parallel MoE
-    (experts on dp, router aux in the loss) — on the dense dp_tp layout
-    only (MoE does not combine with parallelism="context").
+    (router aux in the loss).  Experts ride dp by default; ``ep > 1``
+    un-welds them onto a DEDICATED expert axis of a (dp, ep, tp) mesh
+    (the batch shards over dp x ep).  MoE composes with
+    parallelism="context" (long-context MoE: expert a2a + K/V ring on
+    different axes) but not with "pipeline".
 
     ``parallelism="pipeline"`` trains over the composed pp x dp x tp mesh
     (``models/composed.py``: pipeline stages of tp-sharded blocks,
@@ -119,13 +123,23 @@ def train(
             "parallelism='pipeline' needs >= 2 devices (pp=2); this host "
             f"exposes {len(devs)}"
         )
-    tp = min(tp, max(len(devs) // pp, 1))  # 1-device hosts degrade to tp=1
+    if ep > 1 and not n_experts:
+        raise ValueError("--ep > 1 requires --n-experts")
+    if ep > 1 and use_pp:
+        raise ValueError("--ep does not combine with parallelism='pipeline'")
+    tp = min(tp, max(len(devs) // (pp * ep), 1))  # 1-device hosts: tp=1
     if dp is None:
-        dp = max(len(devs) // (pp * tp), 1)
+        dp = max(len(devs) // (pp * ep * tp), 1)
     if use_pp:
         mesh = Mesh(
             np.array(devs[: pp * dp * tp]).reshape(pp, dp, tp),
             ("pp", "dp", "tp"),
+        )
+    elif ep > 1:
+        # dedicated expert axis: experts shard over ep, batch over dp x ep
+        mesh = Mesh(
+            np.array(devs[: dp * ep * tp]).reshape(dp, ep, tp),
+            ("dp", "ep", "tp"),
         )
     else:
         mesh = Mesh(np.array(devs[: dp * tp]).reshape(dp, tp), ("dp", "tp"))
@@ -138,6 +152,7 @@ def train(
         dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32,
         context_parallel=parallelism == "context",
         n_experts=n_experts,
+        moe_mesh_axis="ep" if ep > 1 else "dp",
     )
     use_zero = optimizer == "zero_adam"
     # per-dp-rank batch: 2 samples per MICRObatch, so accumulation grows
@@ -239,7 +254,7 @@ def train(
         # single-controller: one loader feeds the whole dp-sharded batch
         # (multi-process deployments shard via shard/num_shards instead)
         loader = TokenLoader(
-            data, batch=per_rank_b * dp, seq=cfg.max_seq, seed=seed,
+            data, batch=per_rank_b * dp * ep, seq=cfg.max_seq, seed=seed,
             start_step=start_step,
         )
     try:
@@ -270,7 +285,9 @@ def train(
             # per-dp-rank batch of 2 per microbatch — which also divides
             # the pipeline mode's num_microbatches=2 exactly
             tokens = jnp.asarray(
-                rng.integers(0, cfg.vocab, (per_rank_b * dp, cfg.max_seq)),
+                rng.integers(
+                    0, cfg.vocab, (per_rank_b * dp * ep, cfg.max_seq)
+                ),
                 jnp.int32,
             )
             targets = jnp.roll(tokens, -1, axis=1)
@@ -312,7 +329,13 @@ def main(argv=None) -> int:
     )
     ap.add_argument(
         "--n-experts", type=int, default=0,
-        help="MoE: expert count (sharded over dp); 0 = dense FFN",
+        help="MoE: expert count (sharded over dp, or over --ep); "
+        "0 = dense FFN",
+    )
+    ap.add_argument(
+        "--ep", type=int, default=1,
+        help="dedicated expert-parallel mesh axis size (>1 un-welds "
+        "experts from dp onto a (dp, ep, tp) mesh; requires --n-experts)",
     )
     ap.add_argument(
         "--data", default=None,
@@ -343,7 +366,7 @@ def main(argv=None) -> int:
         parallelism=args.parallelism, data=args.data,
         accum_steps=args.accum_steps, clip_grad_norm=args.clip_grad_norm,
         master_weights=args.master_weights, dtype=args.dtype,
-        n_experts=args.n_experts,
+        n_experts=args.n_experts, ep=args.ep,
     )
     return 0
 

@@ -1501,3 +1501,34 @@ def test_trainer_moe(tmp_path):
         optimizer="zero_adam", n_experts=8,
     )
     assert done == 6 and np.isfinite(loss)
+
+
+def test_trainer_moe_dedicated_ep_axis(tmp_path):
+    """--ep 2 un-welds experts onto the dedicated axis of a (dp, ep, tp)
+    mesh; ZeRO state checkpoints and resumes (moments stay dp-sharded)."""
+    from accl_tpu.examples.train import train
+
+    ckpt = str(tmp_path / "ckpt")
+    done, loss = train(
+        steps=3, ckpt_dir=ckpt, save_every=2, log_every=0,
+        optimizer="zero_adam", n_experts=8, ep=2,
+    )
+    assert done == 3 and np.isfinite(loss)
+    done, loss = train(
+        steps=5, ckpt_dir=ckpt, save_every=2, log_every=0,
+        optimizer="zero_adam", n_experts=8, ep=2,
+    )
+    assert done == 5 and np.isfinite(loss)
+    with pytest.raises(ValueError, match="requires --n-experts"):
+        train(steps=1, log_every=0, ep=2)
+
+
+def test_trainer_moe_with_context_parallelism(tmp_path):
+    """Long-context MoE end-to-end in the trainer: --n-experts with
+    --parallelism context (expert a2a on dp, K/V ring on tp)."""
+    from accl_tpu.examples.train import train
+
+    done, loss = train(
+        steps=3, log_every=0, parallelism="context", n_experts=8,
+    )
+    assert done == 3 and np.isfinite(loss)
