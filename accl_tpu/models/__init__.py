@@ -39,6 +39,7 @@ from .encoder import (  # noqa: F401
     mlm_loss,
 )
 from .composed import (  # noqa: F401
+    interleave_layer_order,
     make_pp_train_step,
     stack_params,
     stacked_param_specs,

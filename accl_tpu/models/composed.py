@@ -45,7 +45,7 @@ try:
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map  # type: ignore
 
-from .pipeline import pipeline_apply
+from .pipeline import pipeline_apply, pipeline_apply_interleaved
 from .transformer import (
     TransformerConfig,
     _block,
@@ -102,11 +102,27 @@ def unstack_params(params: Dict) -> Dict:
             "layers": layers}
 
 
+def interleave_layer_order(n_layers: int, pp: int, v_stages: int):
+    """Device-major layer permutation for the interleaved schedule:
+    position k of the permuted stack holds old layer ``perm[k]``, laid
+    out so the contiguous pp shard of the permuted array gives device
+    ``d`` its ``v_stages`` round-robin chunks (global stage
+    ``v*pp + d``) in (chunk, layer-within-stage) order."""
+    ls = n_layers // (v_stages * pp)
+    perm = []
+    for d in range(pp):
+        for v in range(v_stages):
+            j = v * pp + d
+            perm.extend(range(j * ls, (j + 1) * ls))
+    return perm
+
+
 def make_pp_train_step(
     cfg: TransformerConfig,
     mesh: Mesh,
     num_microbatches: int,
     lr: float = 1e-2,
+    v_stages: int = 1,
 ):
     """One SGD step over the ('pp', 'dp', 'tp') mesh.
 
@@ -115,6 +131,15 @@ def make_pp_train_step(
     mesh by ``shard``; ``tokens/targets`` are the GLOBAL batch,
     dp-sharded on the batch dim.  The per-dp-rank batch must divide into
     ``num_microbatches``; ``cfg.n_layers`` must divide by the pp size.
+
+    ``v_stages > 1`` runs the INTERLEAVED virtual-stage schedule: each
+    pp rank owns ``v_stages`` round-robin chunks of the layer stack
+    (global stage ``v*pp + d`` on device ``d`` —
+    :func:`pipeline.pipeline_apply_interleaved`), cutting the pipeline
+    bubble to ``(pp-1)/v_stages`` warmup chunk-ticks.  ``shard``
+    commits the stacked layers PERMUTED into device-major chunk order
+    (:func:`interleave_layer_order`); ``num_microbatches`` must divide
+    by pp and ``n_layers`` by ``v_stages * pp``.
     """
     _reject_untrainable_attention(cfg)
     if cfg.seq_parallel:
@@ -127,10 +152,15 @@ def make_pp_train_step(
     pp = mesh.shape["pp"]
     dp = mesh.shape["dp"]
     tp = mesh.shape["tp"]
-    if cfg.n_layers % pp:
+    V = int(v_stages)
+    if V < 1:
+        raise ValueError(f"v_stages ({V}) must be >= 1")
+    if cfg.n_layers % (V * pp):
         raise ValueError(
-            f"n_layers ({cfg.n_layers}) must divide by pp ({pp})"
+            f"n_layers ({cfg.n_layers}) must divide by v_stages * pp "
+            f"({V} * {pp})"
         )
+    ls = cfg.n_layers // (V * pp)  # layers per (virtual) stage
     if cfg.n_heads % tp:
         raise ValueError(
             f"n_heads ({cfg.n_heads}) must divide by tp ({tp})"
@@ -195,7 +225,18 @@ def make_pp_train_step(
             x = _embed_tokens(p, tokens, cfg)
             mbs = x.reshape(M, B // M, T, cfg.d_model)
             tgts = targets.reshape(M, B // M, T)
-            outs = pipeline_apply(p["layers"], mbs, "pp", stage_fn)
+            if V > 1:
+                # this rank's (V*ls, ...) permuted slice -> V chunks of
+                # ls layers each; stage_fn scans a chunk's layers
+                chunks = jax.tree_util.tree_map(
+                    lambda a: a.reshape((V, ls) + a.shape[1:]),
+                    p["layers"],
+                )
+                outs = pipeline_apply_interleaved(
+                    chunks, mbs, "pp", stage_fn, V
+                )
+            else:
+                outs = pipeline_apply(p["layers"], mbs, "pp", stage_fn)
             per_mb = jax.vmap(lambda o, t: loss_head(o, t, p))(outs, tgts)
             # last stage's mean, summed over pp (one nonzero term) and
             # averaged over dp — differentiated as the GLOBAL quantity,
@@ -219,6 +260,17 @@ def make_pp_train_step(
 
     def shard(params):
         stacked = stack_params(params)
+        if V > 1:
+            # commit the layers in device-major chunk order so the
+            # contiguous pp shard IS each device's round-robin chunks
+            perm = np.asarray(interleave_layer_order(cfg.n_layers, pp, V))
+            stacked = {
+                **stacked,
+                "layers": {
+                    k: jnp.take(a, perm, axis=0)
+                    for k, a in stacked["layers"].items()
+                },
+            }
         # map over SPECS first: PartitionSpec is a tuple subclass, so it
         # must be the is_leaf-guarded tree or jax flattens it
         return jax.tree.map(
