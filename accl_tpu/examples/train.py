@@ -48,6 +48,7 @@ def train(
     dtype: str = "float32",
     n_experts: int = 0,
     ep: int = 1,
+    v_stages: int = 1,
 ):
     """Train the flagship transformer.
 
@@ -82,7 +83,11 @@ def train(
     ``parallelism="pipeline"`` trains over the composed pp x dp x tp mesh
     (``models/composed.py``: pipeline stages of tp-sharded blocks,
     microbatched dp-sharded batch — pp=2, microbatches=2); params
-    checkpoint in stacked form.  SGD only.
+    checkpoint in stacked form.  SGD only.  ``v_stages > 1`` switches to
+    the interleaved virtual-stage schedule (that many round-robin layer
+    chunks per pp rank, 1/v_stages the pipeline bubble; the model grows
+    to 2 * v_stages layers so every chunk holds a layer, and checkpoints
+    are layout-compatible only with the same --v-stages).
 
     Returns ``(steps_completed, final_loss)``; ``final_loss`` is ``None``
     when a restored checkpoint already covers the requested ``steps``
@@ -127,6 +132,8 @@ def train(
         raise ValueError("--ep > 1 requires --n-experts")
     if ep > 1 and use_pp:
         raise ValueError("--ep does not combine with parallelism='pipeline'")
+    if v_stages > 1 and not use_pp:
+        raise ValueError("--v-stages requires parallelism='pipeline'")
     tp = min(tp, max(len(devs) // (pp * ep), 1))  # 1-device hosts: tp=1
     if dp is None:
         dp = max(len(devs) // (pp * ep * tp), 1)
@@ -147,7 +154,9 @@ def train(
     heads = max(4, tp)
     heads += (-heads) % tp  # tp must divide heads (and so d_model/d_ff)
     cfg = TransformerConfig(
-        vocab=128, d_model=16 * heads, n_heads=heads, n_layers=2,
+        vocab=128, d_model=16 * heads, n_heads=heads,
+        # interleaved pipeline: every virtual stage needs a layer
+        n_layers=2 * v_stages if use_pp else 2,
         d_ff=32 * heads, max_seq=32,
         dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32,
         context_parallel=parallelism == "context",
@@ -163,7 +172,7 @@ def train(
         from ..models import make_pp_train_step
 
         step_fn, shard = make_pp_train_step(
-            cfg, mesh, num_microbatches=2, lr=0.1
+            cfg, mesh, num_microbatches=2, lr=0.1, v_stages=v_stages
         )
         params = shard(params0)
         opt_state = None
@@ -338,6 +347,11 @@ def main(argv=None) -> int:
         "experts from dp onto a (dp, ep, tp) mesh; requires --n-experts)",
     )
     ap.add_argument(
+        "--v-stages", type=int, default=1,
+        help="interleaved virtual stages per pipeline rank "
+        "(parallelism=pipeline; bubble drops by this factor)",
+    )
+    ap.add_argument(
         "--data", default=None,
         help="ACCLTOK1 token file (native prefetching loader); "
         "default: synthetic tokens",
@@ -366,7 +380,7 @@ def main(argv=None) -> int:
         parallelism=args.parallelism, data=args.data,
         accum_steps=args.accum_steps, clip_grad_norm=args.clip_grad_norm,
         master_weights=args.master_weights, dtype=args.dtype,
-        n_experts=args.n_experts, ep=args.ep,
+        n_experts=args.n_experts, ep=args.ep, v_stages=args.v_stages,
     )
     return 0
 

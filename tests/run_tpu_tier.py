@@ -1,0 +1,96 @@
+"""Run the real-chip pytest tier and record the verdict machine-readably.
+
+The reference runs ONE suite on emulator, RTL sim, AND hardware
+(``test/host/xrt/include/utility.hpp:29-51`` ``--hardware``); this is
+the hardware leg's launcher with the operational discipline the axon
+tunnel demands (VERDICT r3 item 2):
+
+* PROBE FIRST — a wedged tunnel is detected by the short-deadline probe
+  child (bench.py's machinery) before any test process touches the
+  chip; a failed probe exits WITHOUT writing a verdict (never a false
+  ``passed: false`` from a wedge).
+* NO MID-COMPILE SIGNALS — the pytest child runs WITHOUT an external
+  timeout wrapper (killing a Mosaic compile re-wedges the tunnel for
+  hours; the round-3 incident).  The tier's tests are individually
+  short; a genuinely hung run is the operator's call to abandon, not a
+  timer's.
+* RECORD — on completion, ``TPU_TIER.json`` lands in the repo root with
+  {tpu_tier_passed, tpu_tier_tests, tpu_tier_at, git}; bench.py folds
+  those keys into its extras so the scoreboard carries the hardware
+  verdict.
+
+Usage (from the repo root, with the chip healthy)::
+
+    python tests/run_tpu_tier.py
+"""
+
+from __future__ import annotations
+
+import datetime
+import importlib.util
+import json
+import os
+import re
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_probe", os.path.join(ROOT, "bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main() -> int:
+    bench = _load_bench()
+    ok, detail, _retryable, _out = bench._probe_device(
+        float(os.environ.get("ACCL_BENCH_PROBE_TIMEOUT", "150"))
+    )
+    if not ok:
+        print(f"tpu tier NOT run: probe failed ({detail})", file=sys.stderr)
+        return 2
+    print(f"probe ok: {detail}", file=sys.stderr)
+
+    env = dict(os.environ)
+    env["ACCL_TPU_TIER"] = "1"
+    if os.environ.get("ACCL_TIER_KEEP_PLATFORM") != "1":
+        env.pop("JAX_PLATFORMS", None)  # the tier exists to run on the chip
+    # deliberately NO timeout: an external kill mid-Mosaic-compile
+    # wedges the tunnel (session-3 incident)
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/", "-q", "--no-header"],
+        cwd=ROOT, env=env, capture_output=True, text=True,
+    )
+    tail = proc.stdout.strip().splitlines()[-30:]
+    print("\n".join(tail))
+    m = re.search(r"(\d+) passed", proc.stdout)
+    passed_n = int(m.group(1)) if m else 0
+    record = {
+        "tpu_tier_passed": proc.returncode == 0 and passed_n > 0,
+        "tpu_tier_tests": passed_n,
+        "tpu_tier_at": datetime.datetime.now(datetime.timezone.utc)
+        .isoformat(timespec="seconds"),
+        "rc": proc.returncode,
+        "summary": tail[-1] if tail else "",
+    }
+    try:
+        record["git"] = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], cwd=ROOT,
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip() or None
+    except Exception:
+        record["git"] = None
+    path = os.path.join(ROOT, "TPU_TIER.json")
+    with open(path, "w") as f:
+        json.dump(record, f, indent=1)
+    print(f"wrote {path}: {record}")
+    return 0 if record["tpu_tier_passed"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
