@@ -48,13 +48,14 @@ def _load_bench():
 
 def main() -> int:
     bench = _load_bench()
-    ok, detail, _retryable, _out = bench._probe_device(
+    ok, detail, _retryable, probe_out = bench._probe_device(
         float(os.environ.get("ACCL_BENCH_PROBE_TIMEOUT", "150"))
     )
     if not ok:
         print(f"tpu tier NOT run: probe failed ({detail})", file=sys.stderr)
         return 2
     print(f"probe ok: {detail}", file=sys.stderr)
+    backend = (probe_out or {}).get("backend", "unknown")
 
     env = dict(os.environ)
     env["ACCL_TPU_TIER"] = "1"
@@ -71,7 +72,12 @@ def main() -> int:
     m = re.search(r"(\d+) passed", proc.stdout)
     passed_n = int(m.group(1)) if m else 0
     record = {
-        "tpu_tier_passed": proc.returncode == 0 and passed_n > 0,
+        # a CPU-platform development run must not masquerade as chip
+        # evidence: "passed" asserts hardware execution
+        "tpu_tier_passed": (
+            proc.returncode == 0 and passed_n > 0 and backend == "tpu"
+        ),
+        "tpu_tier_platform": backend,
         "tpu_tier_tests": passed_n,
         "tpu_tier_at": datetime.datetime.now(datetime.timezone.utc)
         .isoformat(timespec="seconds"),
