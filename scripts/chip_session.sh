@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# One healthy-tunnel session, in the order that maximizes captured
+# evidence per unit of wedge risk (the tunnel can re-wedge at any
+# Mosaic compile; never SIGTERM a chip process mid-compile):
+#
+#   1. probe            — cheap health check; abort early if wedged
+#   2. bench.py guarded — the scoreboard capture: headline + T=4096
+#                         flash-attention training record + facade/
+#                         gang decompositions; refreshes .bench_lkg.json
+#   3. chip pytest tier — tests/run_tpu_tier.py writes TPU_TIER.json
+#
+# Run from the repo root. Artifacts to commit afterwards:
+#   .bench_lkg.json  TPU_TIER.json  (+ BENCH_NOTES update)
+set -u
+cd "$(dirname "$0")/.."
+
+echo "== 1/3 probe" >&2
+if ! ACCL_BENCH_MODE=probe timeout 150 python bench.py; then
+  echo "tunnel wedged — aborting before touching the chip" >&2
+  exit 2
+fi
+
+echo "== 2/3 guarded bench (this is the long leg; do not signal it)" >&2
+python bench.py | tee /tmp/bench_chip_session.json
+
+echo "== 3/3 chip pytest tier" >&2
+python tests/run_tpu_tier.py
+
+echo "== done; commit .bench_lkg.json TPU_TIER.json and update BENCH_NOTES" >&2
