@@ -137,6 +137,9 @@ def sweep_ops(world: int, sizes: List[int], writer, extra_algos=()) -> None:
         "reduce_scatter": opdriver.run_reduce_scatter,
         "bcast": opdriver.run_bcast,
         "alltoall": opdriver.run_alltoall,
+        "reduce": opdriver.run_reduce,
+        "scatter": opdriver.run_scatter,
+        "gather": opdriver.run_gather,
     }
     # algorithm-faithful variants (the tuning-register surface): opt-in via
     # --extra-algos since the Pallas kernels run interpreted (slowly) off-TPU
@@ -177,7 +180,13 @@ def sweep_ops(world: int, sizes: List[int], writer, extra_algos=()) -> None:
                     "(interpreter tier)", file=sys.stderr,
                 )
         for n in op_sizes:
-            shape = (world, world * n) if op in ("reduce_scatter", "alltoall") else (world, n)
+            # per-rank operand shapes: scatter's root sends world chunks
+            # (like reduce_scatter/alltoall); everything else holds n
+            shape = (
+                (world, world * n)
+                if op in ("reduce_scatter", "alltoall", "scatter")
+                else (world, n)
+            )
             stacked = jnp.ones(shape, jnp.float32)
             fn(stacked, mesh).block_until_ready()  # compile
             t0 = time.perf_counter()
