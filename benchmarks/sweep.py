@@ -35,6 +35,7 @@ COLLECTIVES = [
     "reduce",
     "reduce_scatter",
     "allreduce",
+    "alltoall",
 ]
 
 
@@ -83,6 +84,10 @@ def _run_group_op(group, op: str, count: int) -> float:
             send = accl.create_buffer_from(np.ones(n, np.float32))
             recv = accl.create_buffer(n, np.float32)
             req = accl.allreduce(send, recv, n, run_async=True)
+        elif op == "alltoall":
+            send = accl.create_buffer_from(np.ones(world * n, np.float32))
+            recv = accl.create_buffer(world * n, np.float32)
+            req = accl.alltoall(send, recv, n, run_async=True)
         else:
             raise ValueError(op)
         assert req.wait(120), f"{op} count={n} rank={i} timed out"
