@@ -102,6 +102,50 @@ def unstack_params(params: Dict) -> Dict:
             "layers": layers}
 
 
+# psum whose TRANSPOSE is identity: the correct vjp when the cotangent
+# arriving at the psum's output is replicated across the axis (it is —
+# everything downstream of the row-parallel allreduce is tp-replicated).
+# The manual 1F1B backward runs without the vma machinery that normally
+# knows this; the naive transpose under check_vma=False would RE-SUM the
+# replicated cotangent and inflate every post-allreduce gradient by tp.
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _psum_identity_bwd(x, axis_name):
+    return lax.psum(x, axis_name)
+
+
+def _psum_identity_fwd(x, axis_name):
+    return lax.psum(x, axis_name), None
+
+
+def _psum_identity_rev(axis_name, _res, g):
+    return (g,)
+
+
+_psum_identity_bwd.defvjp(_psum_identity_fwd, _psum_identity_rev)
+
+
+# the dual: identity forward, psum TRANSPOSE — placed where a replicated
+# activation FANS OUT into tp-sharded branch compute (the q/k/v and w1
+# matmuls).  The true cotangent of the fan-out point is the SUM of every
+# rank's branch contribution; vma places this psum automatically, the
+# manual backward must place it by hand.  The residual paths
+# (replicated compute, counted once) stay outside the wrapper.
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _fanout_psum_bwd(x, axis_name):
+    return x
+
+
+def _fanout_fwd(x, axis_name):
+    return x, None
+
+
+def _fanout_rev(axis_name, _res, g):
+    return (lax.psum(g, axis_name),)
+
+
+_fanout_psum_bwd.defvjp(_fanout_fwd, _fanout_rev)
+
+
 def interleave_layer_order(n_layers: int, pp: int, v_stages: int):
     """Device-major layer permutation for the interleaved schedule:
     position k of the permuted stack holds old layer ``perm[k]``, laid
@@ -123,6 +167,7 @@ def make_pp_train_step(
     num_microbatches: int,
     lr: float = 1e-2,
     v_stages: int = 1,
+    schedule: str = "gpipe",
 ):
     """One SGD step over the ('pp', 'dp', 'tp') mesh.
 
@@ -140,6 +185,18 @@ def make_pp_train_step(
     commits the stacked layers PERMUTED into device-major chunk order
     (:func:`interleave_layer_order`); ``num_microbatches`` must divide
     by pp and ``n_layers`` by ``v_stages * pp``.
+
+    ``schedule="1f1b"`` replaces the autodiff-through-GPipe backward
+    with the hand-scheduled one-forward-one-backward interleave
+    (:func:`pipeline.pipeline_loss_and_grads_1f1b`): the activation
+    stash holds only ``min(pp, M)`` in-flight microbatch INPUTS with
+    recompute-at-use, instead of autodiff's O(M·ticks) residuals — the
+    memory profile that makes large-M accumulation affordable.  The
+    pipeline's 1F1B primitive returns the loss-head parameter grads and
+    the stage-0 input grads; this maker closes the loop through the
+    embedding vjp and places the replicated-param psums (embedding
+    contributions live on pp rank 0, head contributions on the last
+    rank) explicitly.  Not combinable with ``v_stages > 1`` yet.
     """
     _reject_untrainable_attention(cfg)
     if cfg.seq_parallel:
@@ -155,6 +212,12 @@ def make_pp_train_step(
     V = int(v_stages)
     if V < 1:
         raise ValueError(f"v_stages ({V}) must be >= 1")
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown composed pipeline schedule {schedule!r}")
+    if schedule == "1f1b" and V != 1:
+        raise ValueError(
+            "schedule='1f1b' does not compose with v_stages > 1 yet"
+        )
     if cfg.n_layers % (V * pp):
         raise ValueError(
             f"n_layers ({cfg.n_layers}) must divide by v_stages * pp "
@@ -188,12 +251,20 @@ def make_pp_train_step(
         """This rank's layer span, walked with one scan; each block is
         the Megatron-TP block over the 'tp' axis.  ``cfg.remat``
         checkpoints each block (recompute on backward) exactly like the
-        plain forward does."""
+        plain forward does.  Under the manual 1F1B backward the tp
+        reduction is the identity-transpose psum (see
+        :data:`_psum_identity_bwd`)."""
         def body(h, lp):
             blk = partial(
                 _block, n_heads_local=heads_local, tp_axis="tp",
                 attn_impl=cfg.attention,
                 rope_base=cfg.rope_base if cfg.uses_rope() else None,
+                reduce_fn=(
+                    _psum_identity_bwd if schedule == "1f1b" else None
+                ),
+                fanout_fn=(
+                    _fanout_psum_bwd if schedule == "1f1b" else None
+                ),
             )
             if cfg.remat:
                 blk = jax.checkpoint(blk)
@@ -221,6 +292,89 @@ def make_pp_train_step(
             )
         me_pp = lax.axis_index("pp")
 
+        def step_1f1b(p):
+            """Hand-scheduled backward: the pipeline primitive owns the
+            stage interleave; this closes the program around it —
+            embedding vjp in front, loss-head grads behind, explicit
+            psums for the replicated params whose contributions live on
+            single pp ranks."""
+            from ..constants import ReduceFunction
+            from ..ops import collectives
+            from .pipeline import pipeline_loss_and_grads_1f1b
+
+            def embed_mbs(p_):
+                x = _embed_tokens(p_, tokens, cfg)
+                return x.reshape(M, B // M, T, cfg.d_model)
+
+            mbs, embed_vjp = jax.vjp(embed_mbs, p)
+            tgts = targets.reshape(M, B // M, T)
+            head = {"embed": p["embed"], "ln_f": p["ln_f"]}
+            loss_pp, layer_grads, head_grads, in_grads = (
+                pipeline_loss_and_grads_1f1b(
+                    p["layers"], mbs, tgts, "pp", stage_fn,
+                    lambda hp, y, t: loss_head(y, t, hp),
+                    head_params=head, return_input_grads=True,
+                )
+            )
+            # TOTALLY ORDER the post-loop collectives: the manual path
+            # has several independent psum chains (embedding, head,
+            # per-leaf dp averages, the loss), and XLA's CPU in-process
+            # rendezvous deadlocks when independent collective chains
+            # execute concurrently (observed: half the device threads
+            # parked in a loop ppermute, half in a grad allreduce, both
+            # op_id=1).  A token threaded through optimization_barrier
+            # gives every collective a data dependency on its
+            # predecessor — a linear schedule, negligible next to the
+            # pipeline itself.
+            token = loss_pp
+
+            def seq_allreduce(g, *axes):
+                # the barrier's output unions the token's vma into g, so
+                # invariant-destined values (loss, replicated-param
+                # grads) must be sequenced BEFORE the {pp,tp}-varying
+                # layer leaves pollute the token
+                nonlocal token
+                g, _ = lax.optimization_barrier((g, token))
+                for ax in axes:
+                    g = collectives.allreduce(g, ax, ReduceFunction.SUM)
+                token = g.reshape(-1)[0].astype(jnp.float32)
+                return g
+
+            # dp average (the gpipe path gets this from the vma
+            # transpose of the psum'd loss; here it is explicit)
+            loss = seq_allreduce(loss_pp, "dp") / dp
+            # in_grads is valid on pp rank 0 (zeros elsewhere): the pp
+            # psum hands every rank exactly rank 0's values (and the
+            # pp-invariant vma the embedding vjp expects)
+            (embed_path,) = embed_vjp(seq_allreduce(in_grads, "pp"))
+            d_embed = seq_allreduce(
+                embed_path["embed"].astype(jnp.float32)
+                + seq_allreduce(head_grads["embed"], "pp"),
+                "dp",
+            ) / dp
+            d_ln_f = seq_allreduce(
+                seq_allreduce(head_grads["ln_f"], "pp"), "dp"
+            ) / dp
+            grads = {
+                "embed": d_embed.astype(p["embed"].dtype),
+                "ln_f": d_ln_f.astype(p["ln_f"].dtype),
+            }
+            if "pos" in embed_path:
+                grads["pos"] = (
+                    seq_allreduce(
+                        embed_path["pos"].astype(jnp.float32), "dp"
+                    ) / dp
+                ).astype(p["pos"].dtype)
+            # pp-local stage grads, dp-averaged leaf by leaf (LAST: they
+            # are {pp, tp}-varying and the token inherits that)
+            grads["layers"] = jax.tree_util.tree_map(
+                lambda g, p_: (
+                    seq_allreduce(g.astype(jnp.float32), "dp") / dp
+                ).astype(p_.dtype),
+                layer_grads, p["layers"],
+            )
+            return loss, grads
+
         def global_loss(p):
             x = _embed_tokens(p, tokens, cfg)
             mbs = x.reshape(M, B // M, T, cfg.d_model)
@@ -244,17 +398,33 @@ def make_pp_train_step(
             local = jnp.where(me_pp == pp - 1, per_mb.mean(), 0.0)
             return lax.psum(lax.psum(local, "pp"), "dp") / dp
 
-        loss, grads = jax.value_and_grad(global_loss)(params)
+        if schedule == "1f1b":
+            loss, grads = step_1f1b(params)
+        else:
+            loss, grads = jax.value_and_grad(global_loss)(params)
         params = jax.tree.map(lambda p_, g: p_ - lr * g, params, grads)
         return params, loss
 
+    smap_kwargs = dict(
+        mesh=mesh,
+        in_specs=(specs, P("dp", None), P("dp", None)),
+        out_specs=(specs, P()),
+    )
+    if schedule == "1f1b":
+        # the vma checker cannot host the manual backward: the per-tick
+        # lax.switch takes DIFFERENT branches on different devices, and
+        # checked vma auto-inserts transpose collectives inside those
+        # branches — communication inside divergent control flow, the
+        # exact deadlock the 1F1B design rule exists to prevent
+        # (observed: half the devices parked in a loop ppermute, half in
+        # an inserted allreduce).  check_vma=False keeps every
+        # collective at the hand-placed, uniform positions; the tp-psum
+        # transpose the checker would have placed is supplied by
+        # _psum_identity_bwd instead, and correctness is pinned by the
+        # exact-equivalence test against gpipe.
+        smap_kwargs["check_vma"] = False
     fn = jax.jit(
-        shard_map(
-            step,
-            mesh=mesh,
-            in_specs=(specs, P("dp", None), P("dp", None)),
-            out_specs=(specs, P()),
-        ),
+        shard_map(step, **smap_kwargs),
         donate_argnums=(0,),
     )
 

@@ -236,6 +236,8 @@ def pipeline_loss_and_grads_1f1b(
     pp_axis: str,
     stage_fn: Callable,
     loss_fn: Callable,
+    head_params=None,
+    return_input_grads: bool = False,
 ):
     """One-forward-one-backward (PipeDream-flush) schedule: same bubble
     fraction as GPipe for equal-cost phases ((S-1)/(M+S-1)) but the
@@ -262,6 +264,18 @@ def pipeline_loss_and_grads_1f1b(
     (``jax.vjp`` at use time) — activation rematerialization, the same
     FLOPs-for-HBM trade ``jax.checkpoint`` makes, which is what bounds
     the stash at one microbatch input per in-flight stage.
+
+    Two extensions let a REAL model (the composed flagship) use this
+    schedule, where the pipeline is only the middle of the program:
+
+    * ``head_params``: when given, ``loss_fn`` is called as
+      ``loss_fn(head_params, y, tgt)`` and the return grows a third
+      element — the loss head's parameter gradients (final layernorm,
+      unembed), accumulated on the last stage and zeros elsewhere (the
+      caller psums over pp);
+    * ``return_input_grads=True`` appends the (M, ...) gradients of the
+      stage-0 INPUTS (valid on stage 0, zeros elsewhere) — what the
+      caller backpropagates through its embedding.
     """
     S = lax.axis_size(pp_axis)
     me = lax.axis_index(pp_axis)
@@ -289,11 +303,30 @@ def pipeline_loss_and_grads_1f1b(
         b = q // 2
         return jnp.clip(b, 0, M - 1), (q >= 0) & (q % 2 == 0) & (b < M)
 
-    zero_mb = jnp.zeros(mb_shape, microbatches.dtype)
-    zero_grads = jax.tree_util.tree_map(jnp.zeros_like, stage_params)
+    # Loop-state zeros must carry the vma the BODY will give them, or
+    # checked-vma shard_maps reject the carry/branch types — and checked
+    # vma is what keeps the transpose of the stage's tp psums an
+    # identity (under check_vma=False it re-sums the replicated
+    # cotangent, inflating every post-allreduce gradient by tp).  ``z``
+    # is a zero scalar varying exactly like the data (dp etc. on a
+    # composed mesh; nothing on the toy 1-axis mesh); adding/multiplying
+    # it in unions that vma into each constant zero, and _pvary adds the
+    # pp axis the body's stage compute contributes.
+    z = microbatches.reshape(-1)[0] * 0
+    zero_mb = _pvary(
+        jnp.zeros(mb_shape, microbatches.dtype) + z, pp_axis
+    )
+    zero_grads = jax.tree_util.tree_map(
+        lambda a: a * 0 * z.astype(a.dtype), stage_params
+    )
+    with_head = head_params is not None
 
     def tick(t, state):
-        fwd_carry, bwd_carry, stash, grads, loss_acc = state
+        fwd_carry = state["fc"]
+        bwd_carry = state["bc"]
+        stash = state["stash"]
+        grads = state["grads"]
+        loss_acc = state["loss"]
         f, do_f = fwd_index(t)
         b, do_b = bwd_index(t)
 
@@ -306,27 +339,53 @@ def pipeline_loss_and_grads_1f1b(
         tgt_b = lax.dynamic_index_in_dim(targets, b, 0, False)
 
         def idle_branch(_):
-            return zero_mb, zero_mb, stash, grads, loss_acc
+            return {**state, "fc": zero_mb, "bc": zero_mb}
 
         def fwd_branch(_):
             act = stage_fn(stage_params, x_f)
             new_stash = lax.dynamic_update_index_in_dim(stash, x_f, f % K, 0)
-            return act, zero_mb, new_stash, grads, loss_acc
+            return {**state, "fc": act, "bc": zero_mb, "stash": new_stash}
 
         def bwd_branch(_):
             y, vjp = jax.vjp(stage_fn, stage_params, x_b)
             # last stage seeds the cotangent from the loss (the 1/M is
             # pipeline_loss's per-microbatch mean); upstream stages use
             # the gradient handed back on the reverse edge
-            g_last = jax.grad(lambda yy: loss_fn(yy, tgt_b))(y) / M
-            g_y = jnp.where(me == S - 1, g_last, bwd_carry)
+            out = dict(state)
+            if with_head:
+                lval, (dh, g_last) = jax.value_and_grad(
+                    lambda hp, yy: loss_fn(hp, yy, tgt_b), argnums=(0, 1)
+                )(head_params, y)
+                # the head's grads exist only where the head ran: the
+                # last stage (caller psums over pp)
+                out["head"] = jax.tree_util.tree_map(
+                    lambda h, d: h + jnp.where(me == S - 1, d / M, 0.0),
+                    state["head"], dh,
+                )
+            else:
+                lval, g_last = jax.value_and_grad(
+                    lambda yy: loss_fn(yy, tgt_b)
+                )(y)
+            g_y = jnp.where(me == S - 1, g_last / M, bwd_carry)
             dp, dx = vjp(g_y)
-            new_grads = jax.tree_util.tree_map(jnp.add, grads, dp)
-            lb = jnp.where(me == S - 1, loss_fn(y, tgt_b), 0.0)
-            return zero_mb, dx, stash, new_grads, loss_acc + lb
+            out["grads"] = jax.tree_util.tree_map(jnp.add, grads, dp)
+            out["loss"] = loss_acc + jnp.where(me == S - 1, lval, 0.0)
+            if return_input_grads:
+                # stage 0's dx is d(loss)/d(embedded microbatch b): bank
+                # it for the caller's embedding backward
+                out["ibank"] = jnp.where(
+                    me == 0,
+                    lax.dynamic_update_index_in_dim(
+                        state["ibank"], dx, b, 0
+                    ),
+                    state["ibank"],
+                )
+            out["fc"] = zero_mb
+            out["bc"] = dx
+            return out
 
         branch = jnp.where(do_f, 1, jnp.where(do_b, 2, 0))
-        act, dx, stash, grads, loss_acc = lax.switch(
+        state = lax.switch(
             branch, [idle_branch, fwd_branch, bwd_branch], None
         )
 
@@ -334,26 +393,50 @@ def pipeline_loss_and_grads_1f1b(
         # a carry only adopts a VALID arrival (stage s+1 may not consume
         # an activation until several ticks after s produced it, and the
         # in-between permutes carry invalid zeros)
-        got_act = lax.ppermute(act, pp_axis, fwd_edges)
+        got_act = lax.ppermute(state["fc"], pp_axis, fwd_edges)
         act_ok = lax.ppermute(do_f.astype(jnp.int32), pp_axis, fwd_edges)
-        got_dx = lax.ppermute(dx, pp_axis, bwd_edges)
+        got_dx = lax.ppermute(state["bc"], pp_axis, bwd_edges)
         dx_ok = lax.ppermute(do_b.astype(jnp.int32), pp_axis, bwd_edges)
-        fwd_carry = jnp.where(act_ok > 0, got_act, fwd_carry)
-        bwd_carry = jnp.where(dx_ok > 0, got_dx, bwd_carry)
-        return fwd_carry, bwd_carry, stash, grads, loss_acc
+        state["fc"] = jnp.where(act_ok > 0, got_act, fwd_carry)
+        state["bc"] = jnp.where(dx_ok > 0, got_dx, bwd_carry)
+        return state
 
-    state = (
-        zero_mb,  # fwd_carry: activation arriving from the previous stage
-        zero_mb,  # bwd_carry: gradient arriving from the next stage
-        jnp.zeros((K,) + mb_shape, microbatches.dtype),
-        zero_grads,
-        jnp.zeros((), jnp.float32),
-    )
-    _, _, _, grads, loss_acc = lax.fori_loop(
+    state = {
+        "fc": zero_mb,  # activation arriving from the previous stage
+        "bc": zero_mb,  # gradient arriving from the next stage
+        "stash": _pvary(
+            jnp.zeros((K,) + mb_shape, microbatches.dtype) + z, pp_axis
+        ),
+        "grads": zero_grads,
+        "loss": _pvary(
+            jnp.zeros((), jnp.float32) + z.astype(jnp.float32), pp_axis
+        ),
+    }
+    if with_head:
+        state["head"] = jax.tree_util.tree_map(
+            lambda h: _pvary(
+                jnp.zeros(h.shape, jnp.float32)
+                + z.astype(jnp.float32),
+                pp_axis,
+            ),
+            head_params,
+        )
+    if return_input_grads:
+        state["ibank"] = _pvary(
+            jnp.zeros((M,) + mb_shape, microbatches.dtype) + z, pp_axis
+        )
+    state = lax.fori_loop(
         0, 2 * (M + S - 1), tick, state, unroll=False
     )
-    loss = lax.psum(jnp.where(me == S - 1, loss_acc / M, 0.0), pp_axis)
-    return loss, grads
+    loss = lax.psum(
+        jnp.where(me == S - 1, state["loss"] / M, 0.0), pp_axis
+    )
+    out = (loss, state["grads"])
+    if with_head:
+        out = out + (state["head"],)
+    if return_input_grads:
+        out = out + (state["ibank"],)
+    return out
 
 
 def pipeline_loss_and_grads(

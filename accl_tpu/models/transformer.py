@@ -542,7 +542,7 @@ def _attention(q, k, v, impl: str = "naive", causal: bool = True):
 
 
 def _mlp(x, lp, tp_axis, ep_axis=None, moe_cfg=None, with_aux=False,
-         moe_no_drop=False):
+         moe_no_drop=False, reduce_fn=None, fanout_fn=None):
     """The block's MLP half (shared by train and decode paths): ln2 ->
     column-parallel up, row-parallel down -> tp-allreduce, residual.
 
@@ -577,9 +577,16 @@ def _mlp(x, lp, tp_axis, ep_axis=None, moe_cfg=None, with_aux=False,
             y, aux = out
             return x + y, aux
         return x + out
+    if fanout_fn is not None and tp_axis is not None:
+        h = fanout_fn(h, tp_axis)  # see _block: the w1 fan-out point
     partial_f = jax.nn.gelu(h @ lp["w1"]) @ lp["w2"]
     if tp_axis is not None:
-        partial_f = collectives.allreduce(partial_f, tp_axis, ReduceFunction.SUM)
+        if reduce_fn is None:
+            partial_f = collectives.allreduce(
+                partial_f, tp_axis, ReduceFunction.SUM
+            )
+        else:
+            partial_f = reduce_fn(partial_f, tp_axis)
     return (x + partial_f, None) if with_aux else x + partial_f
 
 
@@ -621,7 +628,8 @@ def _attn_partial(h, lp, n_heads_local, attn_impl="naive", causal=True,
 
 def _block(x, lp, n_heads_local, tp_axis, return_kv=False,
            attn_impl="naive", causal=True, rope_base=None,
-           ep_axis=None, moe_cfg=None, with_aux=False):
+           ep_axis=None, moe_cfg=None, with_aux=False,
+           reduce_fn=None, fanout_fn=None):
     """One transformer block on tp-sharded weights.  ``lp['wqkv']`` etc. are
     the *local shards*; the tp-allreduce after each row-parallel matmul is
     the reference's fused-allreduce hot path in model form.
@@ -629,15 +637,29 @@ def _block(x, lp, n_heads_local, tp_axis, return_kv=False,
     ``return_kv=True`` additionally returns the (k, v) head tensors
     (B, H_local, T, hd) — the prefill path of the KV-cache decode.
     ``with_aux=True`` (MoE training) returns ``(out, aux)`` with the
-    layer's router health terms."""
+    layer's router health terms.  ``reduce_fn`` overrides the
+    row-parallel tp reduction (the composed 1F1B backward injects a
+    custom_vjp psum whose transpose is identity — correct for a
+    replicated cotangent — because its hand-written backward runs
+    without the vma machinery that normally places that transpose)."""
+    if reduce_fn is None:
+        reduce_fn = lambda v, ax: collectives.allreduce(
+            v, ax, ReduceFunction.SUM
+        )
     h = _layernorm(x, lp["ln1"])
+    if fanout_fn is not None and tp_axis is not None:
+        # replicated h fans out into the tp-sharded q/k/v matmuls: the
+        # manual-backward mode marks the fan-out so its transpose (a tp
+        # psum of the branch cotangents) lands here and nowhere else
+        h = fanout_fn(h, tp_axis)
     partial_o, kv = _attn_partial(
         h, lp, n_heads_local, attn_impl, causal, rope_base
     )
     if tp_axis is not None:
-        partial_o = collectives.allreduce(partial_o, tp_axis, ReduceFunction.SUM)
+        partial_o = reduce_fn(partial_o, tp_axis)
     x = x + partial_o
-    out = _mlp(x, lp, tp_axis, ep_axis, moe_cfg, with_aux)
+    out = _mlp(x, lp, tp_axis, ep_axis, moe_cfg, with_aux,
+               reduce_fn=reduce_fn, fanout_fn=fanout_fn)
     return (out, kv) if return_kv else out
 
 

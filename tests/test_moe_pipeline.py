@@ -519,6 +519,63 @@ def test_composed_interleaved_matches_plain_train_step():
         np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5)
 
 
+def test_composed_1f1b_matches_gpipe_and_plain():
+    """schedule='1f1b' on the composed flagship step — the hand-
+    scheduled pipeline backward plus the maker's explicit embedding-vjp
+    and head-grad psums — computes the same loss and updated params as
+    the autodiff gpipe composed step AND the plain dp x tp step."""
+    from jax.sharding import Mesh
+    from accl_tpu.models import (
+        TransformerConfig, init_params, make_sharded_train_step,
+    )
+    from accl_tpu.models.composed import make_pp_train_step, unstack_params
+
+    cfg = TransformerConfig(
+        vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_seq=32, attention="naive",
+    )
+    params0 = init_params(jax.random.PRNGKey(0), cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, cfg.vocab)
+    tgts = jnp.roll(toks, -1, axis=1)
+
+    mesh2d = Mesh(np.array(jax.devices()[:8]).reshape(4, 2), ("dp", "tp"))
+    pstep, pshard = make_sharded_train_step(cfg, mesh2d, lr=0.05)
+    p_params, p_loss = pstep(pshard(params0), toks, tgts)
+
+    mesh3d = Mesh(
+        np.array(jax.devices()[:8]).reshape(2, 2, 2), ("pp", "dp", "tp")
+    )
+    g_step, g_shard = make_pp_train_step(
+        cfg, mesh3d, num_microbatches=2, lr=0.05
+    )
+    g_params, g_loss = g_step(g_shard(params0), toks, tgts)
+    f_step, f_shard = make_pp_train_step(
+        cfg, mesh3d, num_microbatches=2, lr=0.05, schedule="1f1b"
+    )
+    f_params, f_loss = f_step(f_shard(params0), toks, tgts)
+
+    assert float(f_loss) == pytest.approx(float(g_loss), rel=1e-5)
+    assert float(f_loss) == pytest.approx(float(p_loss), rel=1e-5)
+    for a, b in zip(
+        jax.tree.leaves(jax.tree.map(np.asarray, g_params)),
+        jax.tree.leaves(jax.tree.map(np.asarray, f_params)),
+    ):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)
+    f_tree = unstack_params(jax.tree.map(np.asarray, f_params))
+    for a, b in zip(
+        jax.tree.leaves(jax.tree.map(np.asarray, p_params)),
+        jax.tree.leaves(f_tree),
+    ):
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5)
+
+    with pytest.raises(ValueError, match="unknown composed"):
+        make_pp_train_step(cfg, mesh3d, num_microbatches=2, schedule="dave")
+    with pytest.raises(ValueError, match="does not compose"):
+        make_pp_train_step(
+            cfg, mesh3d, num_microbatches=2, schedule="1f1b", v_stages=2
+        )
+
+
 def test_composed_validates_divisibility():
     from jax.sharding import Mesh
     from accl_tpu.models import TransformerConfig
