@@ -49,6 +49,7 @@ def train(
     n_experts: int = 0,
     ep: int = 1,
     v_stages: int = 1,
+    pp_schedule: str = "gpipe",
 ):
     """Train the flagship transformer.
 
@@ -134,6 +135,8 @@ def train(
         raise ValueError("--ep does not combine with parallelism='pipeline'")
     if v_stages > 1 and not use_pp:
         raise ValueError("--v-stages requires parallelism='pipeline'")
+    if pp_schedule != "gpipe" and not use_pp:
+        raise ValueError("--pp-schedule requires parallelism='pipeline'")
     tp = min(tp, max(len(devs) // (pp * ep), 1))  # 1-device hosts: tp=1
     if dp is None:
         dp = max(len(devs) // (pp * ep * tp), 1)
@@ -172,7 +175,8 @@ def train(
         from ..models import make_pp_train_step
 
         step_fn, shard = make_pp_train_step(
-            cfg, mesh, num_microbatches=2, lr=0.1, v_stages=v_stages
+            cfg, mesh, num_microbatches=2, lr=0.1, v_stages=v_stages,
+            schedule=pp_schedule,
         )
         params = shard(params0)
         opt_state = None
@@ -352,6 +356,11 @@ def main(argv=None) -> int:
         "(parallelism=pipeline; bubble drops by this factor)",
     )
     ap.add_argument(
+        "--pp-schedule", default="gpipe", choices=["gpipe", "1f1b"],
+        help="composed pipeline backward: autodiff-through-GPipe or the "
+        "hand-scheduled 1F1B (min(pp,M)-input stash + recompute)",
+    )
+    ap.add_argument(
         "--data", default=None,
         help="ACCLTOK1 token file (native prefetching loader); "
         "default: synthetic tokens",
@@ -381,6 +390,7 @@ def main(argv=None) -> int:
         accum_steps=args.accum_steps, clip_grad_norm=args.clip_grad_norm,
         master_weights=args.master_weights, dtype=args.dtype,
         n_experts=args.n_experts, ep=args.ep, v_stages=args.v_stages,
+        pp_schedule=args.pp_schedule,
     )
     return 0
 

@@ -1543,6 +1543,26 @@ def test_trainer_interleaved_pipeline(tmp_path):
         train(steps=1, log_every=0, v_stages=2)
 
 
+def test_trainer_pipeline_1f1b(tmp_path):
+    """--pp-schedule 1f1b trains the composed pipeline with the
+    hand-scheduled backward and resumes."""
+    from accl_tpu.examples.train import train
+
+    ckpt = str(tmp_path / "ckpt")
+    done, loss = train(
+        steps=3, ckpt_dir=ckpt, save_every=2, log_every=0,
+        parallelism="pipeline", pp_schedule="1f1b",
+    )
+    assert done == 3 and np.isfinite(loss)
+    done, loss = train(
+        steps=5, ckpt_dir=ckpt, save_every=2, log_every=0,
+        parallelism="pipeline", pp_schedule="1f1b",
+    )
+    assert done == 5 and np.isfinite(loss)
+    with pytest.raises(ValueError, match="requires parallelism"):
+        train(steps=1, log_every=0, pp_schedule="1f1b")
+
+
 def test_trainer_moe_with_context_parallelism(tmp_path):
     """Long-context MoE end-to-end in the trainer: --n-experts with
     --parallelism context (expert a2a on dp, K/V ring on tp)."""
