@@ -84,7 +84,9 @@ def train(
     ``parallelism="pipeline"`` trains over the composed pp x dp x tp mesh
     (``models/composed.py``: pipeline stages of tp-sharded blocks,
     microbatched dp-sharded batch — pp=2, microbatches=2); params
-    checkpoint in stacked form.  SGD only.  ``v_stages > 1`` switches to
+    checkpoint in stacked form.  Composes with ``optimizer="zero_adam"``
+    (ZeRO-1 moments nested inside the stage sharding, clipping and
+    master weights included).  ``v_stages > 1`` switches to
     the interleaved virtual-stage schedule (that many round-robin layer
     chunks per pp rank, 1/v_stages the pipeline bubble; the model grows
     to 2 * v_stages layers so every chunk holds a layer, and checkpoints
@@ -112,8 +114,11 @@ def train(
     use_pp = parallelism == "pipeline"
     if parallelism not in ("dp_tp", "context", "pipeline"):
         raise ValueError(f"unknown parallelism {parallelism!r}")
-    if use_pp and optimizer != "sgd":
-        raise ValueError("parallelism='pipeline' supports optimizer='sgd'")
+    if use_pp and accum_steps != 1:
+        raise ValueError(
+            "parallelism='pipeline' accumulates through its "
+            "microbatches; accum_steps is a dp_tp/context knob"
+        )
     if (
         accum_steps != 1 or clip_grad_norm is not None or master_weights
     ) and optimizer != "zero_adam":
@@ -174,12 +179,24 @@ def train(
     if use_pp:
         from ..models import make_pp_train_step
 
-        step_fn, shard = make_pp_train_step(
-            cfg, mesh, num_microbatches=2, lr=0.1, v_stages=v_stages,
-            schedule=pp_schedule,
-        )
-        params = shard(params0)
-        opt_state = None
+        if use_zero:
+            step_fn, shard, init_state = make_pp_train_step(
+                cfg, mesh, num_microbatches=2, v_stages=v_stages,
+                schedule=pp_schedule,
+                adam=AdamConfig(
+                    lr=0.01, clip_grad_norm=clip_grad_norm,
+                    master_weights=master_weights,
+                ),
+            )
+            params = shard(params0)
+            opt_state = init_state(params0)
+        else:
+            step_fn, shard = make_pp_train_step(
+                cfg, mesh, num_microbatches=2, lr=0.1, v_stages=v_stages,
+                schedule=pp_schedule,
+            )
+            params = shard(params0)
+            opt_state = None
     elif use_zero:
         step_fn, shard, init_state = make_zero_train_step(
             cfg, mesh,

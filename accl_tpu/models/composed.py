@@ -168,8 +168,19 @@ def make_pp_train_step(
     lr: float = 1e-2,
     v_stages: int = 1,
     schedule: str = "gpipe",
+    adam=None,
 ):
     """One SGD step over the ('pp', 'dp', 'tp') mesh.
+
+    ``adam`` (an :class:`accl_tpu.parallel.AdamConfig`) switches the
+    update from SGD to the ZeRO-1 sharded Adam/AdamW: fp32 moments (and
+    optional master weights) live 1/dp per chip NESTED inside the
+    pp x tp stage sharding, global-norm clipping psums its squared sums
+    over every sharding axis (tp, pp) so pipeline training clips exactly
+    like the flagship.  The return grows to ``(step, shard,
+    init_state)`` with ``step(params, state, tokens, targets) ->
+    (params, state, loss)`` — the same contract as
+    ``make_zero_train_step``.
 
     Returns ``(step, shard)``: ``step(params, tokens, targets) ->
     (params, loss)`` with ``params`` in stacked form committed to the
@@ -283,7 +294,9 @@ def make_pp_train_step(
         ).squeeze(-1)
         return nll.mean()
 
-    def step(params, tokens, targets):
+    def _compute_grads(params, tokens, targets):
+        """(loss, grads) via the selected schedule — shared by the SGD
+        and ZeRO-Adam steps."""
         B, T = tokens.shape  # per-dp-rank batch
         if B % M:
             raise ValueError(
@@ -399,17 +412,48 @@ def make_pp_train_step(
             return lax.psum(lax.psum(local, "pp"), "dp") / dp
 
         if schedule == "1f1b":
-            loss, grads = step_1f1b(params)
-        else:
-            loss, grads = jax.value_and_grad(global_loss)(params)
+            return step_1f1b(params)
+        return jax.value_and_grad(global_loss)(params)
+
+    def step(params, tokens, targets):
+        loss, grads = _compute_grads(params, tokens, targets)
         params = jax.tree.map(lambda p_, g: p_ - lr * g, params, grads)
         return params, loss
 
-    smap_kwargs = dict(
-        mesh=mesh,
-        in_specs=(specs, P("dp", None), P("dp", None)),
-        out_specs=(specs, P()),
-    )
+    def zero_step(params, state, tokens, targets):
+        """ZeRO-Adam variant: same gradient computation, then the
+        dp-sliced sharded update (moments nested inside the pp x tp
+        stage sharding)."""
+        from ..parallel.zero import clip_by_global_norm, zero_adam_update
+
+        loss, grads = _compute_grads(params, tokens, targets)
+        if adam.clip_grad_norm is not None:
+            grads, _ = clip_by_global_norm(
+                grads, specs, adam.clip_grad_norm, "tp", "dp",
+                pp_axis="pp",
+            )
+        params, state = zero_adam_update(
+            params, grads, state, "dp", adam, specs=specs
+        )
+        return params, state, loss
+
+    if adam is not None:
+        from ..parallel.zero import zero_state_specs
+
+        sspecs = zero_state_specs(
+            specs, master_weights=adam.master_weights
+        )
+        smap_kwargs = dict(
+            mesh=mesh,
+            in_specs=(specs, sspecs, P("dp", None), P("dp", None)),
+            out_specs=(specs, sspecs, P()),
+        )
+    else:
+        smap_kwargs = dict(
+            mesh=mesh,
+            in_specs=(specs, P("dp", None), P("dp", None)),
+            out_specs=(specs, P()),
+        )
     if schedule == "1f1b":
         # the vma checker cannot host the manual backward: the per-tick
         # lax.switch takes DIFFERENT branches on different devices, and
@@ -423,12 +467,18 @@ def make_pp_train_step(
         # _psum_identity_bwd instead, and correctness is pinned by the
         # exact-equivalence test against gpipe.
         smap_kwargs["check_vma"] = False
-    fn = jax.jit(
-        shard_map(step, **smap_kwargs),
-        donate_argnums=(0,),
-    )
+    if adam is not None:
+        fn = jax.jit(
+            shard_map(zero_step, **smap_kwargs),
+            donate_argnums=(0, 1),
+        )
+    else:
+        fn = jax.jit(
+            shard_map(step, **smap_kwargs),
+            donate_argnums=(0,),
+        )
 
-    def shard(params):
+    def _stacked(params):
         stacked = stack_params(params)
         if V > 1:
             # commit the layers in device-major chunk order so the
@@ -441,14 +491,30 @@ def make_pp_train_step(
                     for k, a in stacked["layers"].items()
                 },
             }
+        return stacked
+
+    def shard(params):
         # map over SPECS first: PartitionSpec is a tuple subclass, so it
         # must be the is_leaf-guarded tree or jax flattens it
         return jax.tree.map(
             lambda s, p_: jax.device_put(
                 jnp.array(p_, copy=True), NamedSharding(mesh, s)
             ),
-            specs, stacked,
+            specs, _stacked(params),
             is_leaf=lambda x: isinstance(x, P),
         )
 
-    return fn, shard
+    if adam is None:
+        return fn, shard
+
+    from ..parallel.zero import init_zero_state
+
+    def init_state(params):
+        # the state layouts (incl. master-weight slices) follow the SAME
+        # stacked/permuted form the training step sees
+        return init_zero_state(
+            _stacked(params), specs, mesh,
+            master_weights=adam.master_weights,
+        )
+
+    return fn, shard, init_state

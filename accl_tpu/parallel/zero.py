@@ -242,15 +242,16 @@ def zero_state_specs(specs, dp_axis: str = "dp",
 
 
 def clip_by_global_norm(grads, specs, max_norm: float, tp_axis=None,
-                        dp_axis=None, ep_axis=None):
+                        dp_axis=None, ep_axis=None, pp_axis=None):
     """Scale ``grads`` so their GLOBAL L2 norm is at most ``max_norm`` —
     inside shard_map.  Leaves whose spec shards over ``tp_axis`` (or
-    ``dp_axis``/``ep_axis`` — expert-parallel MoE banks) hold disjoint
-    slices: their local squared sums psum across those axes so each
-    element counts exactly once; replicated leaves already carry the
-    full gradient on every rank.  Dp-REPLICATED grads are dp-reduced by
-    the time this runs (the loss mean's transpose placed that psum), so
-    they need no dp exchange.  Returns ``(clipped_grads, global_norm)``."""
+    ``dp_axis``/``ep_axis`` — expert-parallel MoE banks; ``pp_axis`` —
+    pipeline layer stacks) hold disjoint slices: their local squared
+    sums psum across those axes so each element counts exactly once;
+    replicated leaves already carry the full gradient on every rank.
+    Dp-REPLICATED grads are dp-reduced by the time this runs (the loss
+    mean's transpose placed that psum), so they need no dp exchange.
+    Returns ``(clipped_grads, global_norm)``."""
     is_leaf = lambda x: isinstance(x, P)
     gleaves = jax.tree.leaves(grads)
     sleaves = jax.tree.leaves(specs, is_leaf=is_leaf)
@@ -259,7 +260,7 @@ def clip_by_global_norm(grads, specs, max_norm: float, tp_axis=None,
     buckets: dict = {}
     for g, s in zip(gleaves, sleaves):
         axes = tuple(
-            a for a in (tp_axis, dp_axis, ep_axis)
+            a for a in (tp_axis, dp_axis, ep_axis, pp_axis)
             if a is not None and a in _spec_axes(s)
         )
         ss = jnp.sum(jnp.square(g.astype(jnp.float32)))

@@ -637,8 +637,13 @@ def test_trainer_pipeline_parallelism(tmp_path):
     )
     assert done == 6 and np.isfinite(loss2)
 
-    with pytest.raises(ValueError, match="supports optimizer"):
-        train(steps=2, parallelism="pipeline", optimizer="zero_adam")
+    # pipeline + zero_adam is SUPPORTED now; what stays rejected is
+    # accum_steps (the pipeline accumulates through its microbatches)
+    with pytest.raises(ValueError, match="microbatches"):
+        train(
+            steps=2, parallelism="pipeline", optimizer="zero_adam",
+            accum_steps=2,
+        )
 
 
 def test_trainer_parallelism_mismatch_diagnosable(tmp_path):
@@ -1572,3 +1577,24 @@ def test_trainer_moe_with_context_parallelism(tmp_path):
         steps=3, log_every=0, parallelism="context", n_experts=8,
     )
     assert done == 3 and np.isfinite(loss)
+
+
+def test_trainer_pipeline_zero_adam(tmp_path):
+    """optimizer='zero_adam' now composes with parallelism='pipeline':
+    the ZeRO state (moments sharded inside the stage layout) checkpoints
+    and resumes alongside the stacked params."""
+    from accl_tpu.examples.train import train
+
+    ckpt = str(tmp_path / "ckpt")
+    done, loss = train(
+        steps=3, ckpt_dir=ckpt, save_every=2, log_every=0,
+        parallelism="pipeline", optimizer="zero_adam",
+        clip_grad_norm=1.0,
+    )
+    assert done == 3 and np.isfinite(loss)
+    done, loss = train(
+        steps=5, ckpt_dir=ckpt, save_every=2, log_every=0,
+        parallelism="pipeline", optimizer="zero_adam",
+        clip_grad_norm=1.0,
+    )
+    assert done == 5 and np.isfinite(loss)
