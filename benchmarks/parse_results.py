@@ -104,8 +104,37 @@ def summarize(path: str) -> str:
     return "\n".join(lines)
 
 
+def plot(path: str, out_png: str) -> None:
+    """Throughput-vs-size curves, one line per collective (the classic
+    collective-benchmark figure the reference's parse script feeds)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    data = load(path)
+    fig, ax = plt.subplots(figsize=(7, 4.5))
+    for coll, rows in sorted(data.items()):
+        ax.plot(
+            [r[1] for r in rows], [r[3] for r in rows],
+            marker="o", markersize=3, linewidth=1.2, label=coll,
+        )
+    ax.set_xscale("log", base=2)
+    ax.set_yscale("log")
+    ax.set_xlabel("bytes per rank")
+    ax.set_ylabel("per-rank Gb/s")
+    ax.set_title(os.path.basename(path))
+    ax.grid(True, which="both", alpha=0.25)
+    ax.legend(fontsize=7, ncols=2)
+    fig.tight_layout()
+    fig.savefig(out_png, dpi=120)
+    plt.close(fig)
+
+
 def main(argv=None) -> str:
     argv = sys.argv[1:] if argv is None else argv
+    do_plot = "--plot" in argv
+    argv = [a for a in argv if a != "--plot"]
     results = argv[0] if argv else os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "results"
     )
@@ -119,6 +148,11 @@ def main(argv=None) -> str:
         raise SystemExit(f"no CSVs in {results}")
     doc = "\n".join(summarize(p) for p in paths)
     print(doc)
+    if do_plot:
+        for p in paths:
+            png = p[:-4] + ".png"
+            plot(p, png)
+            print(f"wrote {png}", file=sys.stderr)
     return doc
 
 
