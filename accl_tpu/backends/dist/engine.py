@@ -83,6 +83,10 @@ class DistEngine(StreamPortMixin, BaseEngine):
 
         self._stream_seq: Dict[int, int] = {}
         self._stream_seq_lock = _threading.Lock()
+        # learned not-found signature for KV try-get (see _is_notfound)
+        self._nf_sig: Optional[tuple] = None
+        self._nf_probed = False
+        self._nf_probe_tries = 0
         self._meshes: Dict[tuple, object] = {}
         # one serialized executor thread (the FPGAQueue role): calls run
         # in submission order — the property SPMD needs — while start()
@@ -463,6 +467,64 @@ class DistEngine(StreamPortMixin, BaseEngine):
             return ErrorCode.TRANSPORT_ERROR
         return ErrorCode.OK
 
+    def _is_notfound(self, e: Exception) -> bool:
+        """Is this try-get exception 'key absent' (normal while polling)
+        rather than a real KV/transport failure?
+
+        jaxlib renders XlaRuntimeError as a flat string, so the only
+        portable discrimination is the message — but a hardcoded
+        "NOT_FOUND" substring breaks silently if a jaxlib upgrade changes
+        the rendering (every empty poll would then raise out of the
+        polling loop).  So the signature is LEARNED once per engine: ask
+        the KV for a key that cannot exist and record (type, message
+        fragments around the key); a later exception matches if it is the
+        same type and carries the same fragments.  The substring check
+        stays as a belt-and-braces fallback for KV services that render
+        differently between the probe and real keys."""
+        if not self._nf_probed:
+            probe_key = (
+                f"accl/__nf_probe__/{self.process_id}/{id(self)}"
+            )
+            try:
+                self._kv().key_value_try_get_bytes(probe_key)
+                # this KV returns (not raises) on missing keys: nothing
+                # to learn, and nothing the fallback can add
+                self._nf_sig = None
+                self._nf_probed = True
+            except Exception as probe_e:
+                msg = str(probe_e)
+                parts = tuple(p for p in msg.split(probe_key) if p)
+                # only trust a signature that can actually DISCRIMINATE:
+                # it must name the key and carry non-trivial text around
+                # it — a bare-key rendering ("'<key>'") would make every
+                # same-typed exception match vacuously
+                trivial = (
+                    sum(len(p.strip("'\"` :.,()[]{}")) for p in parts) < 4
+                )
+                if probe_key in msg and not trivial:
+                    self._nf_sig = (type(probe_e), parts)
+                    self._nf_probed = True
+                elif probe_key in msg:
+                    # rendering is bare-key: cannot discriminate, and
+                    # re-probing would never improve — substring
+                    # fallback only
+                    self._nf_sig = None
+                    self._nf_probed = True
+                else:
+                    # the KV itself was unreachable (init blip): re-arm
+                    # so a later healthy poll can still learn, but cap
+                    # the retries — each one is an extra KV roundtrip on
+                    # the ~20 Hz polling path
+                    self._nf_sig = None
+                    self._nf_probe_tries += 1
+                    self._nf_probed = self._nf_probe_tries >= 8
+        if self._nf_sig is not None:
+            typ, parts = self._nf_sig
+            msg = str(e)
+            if isinstance(e, typ) and all(p in msg for p in parts):
+                return True
+        return "NOT_FOUND" in str(e)
+
     def _drain_remote_stream(self, stream_id: int) -> bool:
         """Pull this port's next remotely-posted chunk (if any) into the
         local port; returns True when one landed.  The sequence counter
@@ -474,7 +536,7 @@ class DistEngine(StreamPortMixin, BaseEngine):
             try:
                 data = self._kv().key_value_try_get_bytes(key)
             except Exception as e:
-                if "NOT_FOUND" in str(e):
+                if self._is_notfound(e):
                     return False  # nothing posted yet
                 # a persistent KV/transport failure must not be silently
                 # folded into "nothing posted" — the caller would only

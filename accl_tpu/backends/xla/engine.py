@@ -818,41 +818,54 @@ class XLAGangContext:
 # delivery — which may jit the fabric-hop program — runs OUTSIDE the channel
 # lock so unrelated pairs never serialize behind a compile.
 class _P2PChannel:
+    """Tag-matched send/recv rendezvous between rank engines.
+
+    Durations are MEASURED, not sentinels: each post is stamped at entry
+    and each request completes with post->delivery wall-clock ns — the
+    analog of the reference's per-call device-cycle reads that its
+    sendrecv bench is built on (ref xrtdevice.cpp:242-249 get_duration,
+    bench.cpp:25-31).  A parked side therefore reports its true wait
+    (including the partner's late arrival); the late-arriving side
+    reports roughly the delivery/copy cost alone."""
+
     def __init__(self):
         self._lock = threading.Lock()
         self._sends: Dict[tuple, list] = {}
         self._recvs: Dict[tuple, list] = {}
 
     def post_send(self, key, payload, request, timeout_s=None):
+        t0 = time.perf_counter_ns()
         match = None
         with self._lock:
             if self._recvs.get(key):
-                sink, rreq, rtimer = self._recvs[key].pop(0)
+                sink, rreq, rtimer, rt0 = self._recvs[key].pop(0)
                 if rtimer is not None:
                     rtimer.cancel()
-                match = (sink, rreq)
+                match = (sink, rreq, rt0)
             else:
-                self._park(self._sends, key, [payload, request], timeout_s)
+                self._park(self._sends, key, [payload, request], timeout_s, t0)
         if match is not None:
-            self._deliver(match[0], match[1], payload, request)
+            self._deliver(match[0], match[1], payload, request, match[2], t0)
 
     def post_recv(self, key, sink, request, timeout_s=None):
+        t0 = time.perf_counter_ns()
         match = None
         with self._lock:
             if self._sends.get(key):
-                payload, sreq, stimer = self._sends[key].pop(0)
+                payload, sreq, stimer, st0 = self._sends[key].pop(0)
                 if stimer is not None:
                     stimer.cancel()
-                match = (payload, sreq)
+                match = (payload, sreq, st0)
             else:
-                self._park(self._recvs, key, [sink, request], timeout_s)
+                self._park(self._recvs, key, [sink, request], timeout_s, t0)
         if match is not None:
-            self._deliver(sink, request, match[0], match[1])
+            self._deliver(sink, request, match[0], match[1], t0, match[2])
 
-    def _park(self, table, key, entry, timeout_s) -> None:
+    def _park(self, table, key, entry, timeout_s, t0) -> None:
         """Append an unmatched post (caller holds the lock), arming a
         timeout watchdog when requested."""
         entry.append(None)
+        entry.append(t0)
         if timeout_s:
             code = (
                 ErrorCode.SEND_TIMEOUT
@@ -876,18 +889,21 @@ class _P2PChannel:
             if idx is None:
                 return  # matched in the meantime: nothing to do
             del lst[idx]
-        entry[1].complete(code)
+        entry[1].complete(code, time.perf_counter_ns() - entry[3])
 
     @staticmethod
-    def _deliver(sink, rreq: Request, payload: np.ndarray, sreq):
+    def _deliver(sink, rreq: Request, payload: np.ndarray, sreq,
+                 recv_t0: int, send_t0: int):
         try:
             sink(payload)
         except Exception:
-            rreq.complete(ErrorCode.INVALID_OPERATION, 1)
-            sreq.complete(ErrorCode.INVALID_OPERATION, 1)
+            t1 = time.perf_counter_ns()
+            rreq.complete(ErrorCode.INVALID_OPERATION, max(t1 - recv_t0, 1))
+            sreq.complete(ErrorCode.INVALID_OPERATION, max(t1 - send_t0, 1))
             return
-        rreq.complete(ErrorCode.OK, 1)
-        sreq.complete(ErrorCode.OK, 1)
+        t1 = time.perf_counter_ns()
+        rreq.complete(ErrorCode.OK, max(t1 - recv_t0, 1))
+        sreq.complete(ErrorCode.OK, max(t1 - send_t0, 1))
 
 
 class XLAEngine(StreamPortMixin, BaseEngine):
@@ -975,6 +991,7 @@ class XLAEngine(StreamPortMixin, BaseEngine):
         comm = options.comm
 
         def resolve_and_route():
+            t0 = time.perf_counter_ns()
             cfg = options.arithcfg
             if options.stream & StreamFlags.OP0_STREAM:
                 payload = self._pop_stream_payload(options)
@@ -1014,7 +1031,9 @@ class XLAEngine(StreamPortMixin, BaseEngine):
                     req.complete(ErrorCode.TRANSPORT_ERROR)
                 else:
                     peer.stream_push(options.stream_id, payload.tobytes())
-                    req.complete(ErrorCode.OK, 1)
+                    req.complete(
+                        ErrorCode.OK, max(time.perf_counter_ns() - t0, 1)
+                    )
                 return
             key = (comm.id, options.tag, me_world, dst_world)
             self.p2p.post_send(key, payload, req, timeout_s=self.timeout_s)

@@ -142,9 +142,20 @@ def train(
         raise ValueError("--v-stages requires parallelism='pipeline'")
     if pp_schedule != "gpipe" and not use_pp:
         raise ValueError("--pp-schedule requires parallelism='pipeline'")
+    if ep > len(devs):
+        raise ValueError(
+            f"--ep {ep} needs at least that many devices; this host "
+            f"exposes {len(devs)} (the dp x ep x tp mesh cannot fold)"
+        )
     tp = min(tp, max(len(devs) // (pp * ep), 1))  # 1-device hosts: tp=1
     if dp is None:
         dp = max(len(devs) // (pp * ep * tp), 1)
+    if dp * ep * tp * pp > len(devs):
+        raise ValueError(
+            f"pp ({pp}) x dp ({dp}) x ep ({ep}) x tp ({tp}) = "
+            f"{pp * dp * ep * tp} exceeds the {len(devs)} devices this "
+            "host exposes — lower --dp or --ep (tp self-clamps)"
+        )
     if use_pp:
         mesh = Mesh(
             np.array(devs[: pp * dp * tp]).reshape(pp, dp, tp),

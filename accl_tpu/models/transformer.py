@@ -116,6 +116,15 @@ class TransformerConfig:
     # count independently of how much plain data parallelism dp carries.
     # The dispatch/return all-to-alls ride this axis either way.
     moe_mesh_axis: str = "dp"
+    # Opt-in: let a DENSE (or welded-MoE) config treat a mesh axis named
+    # "ep" as extra data parallelism, so one ('dp', 'ep', 'tp') mesh can
+    # serve an unwelded MoE and a dense model side by side.  Off by
+    # default: a caller-built mesh that happens to reuse the name "ep"
+    # for another purpose must not silently get its batch sharded (and
+    # its dense grads psummed) over that axis.  Unwelded MoE configs
+    # (moe_mesh_axis="ep") don't need this — their batch shards over
+    # (dp x ep) by construction.
+    ep_extends_dp: bool = False
     # attention lowering: "auto" (default) picks per sequence length and
     # backend — measured on v5e, the materialized-scores form wins below
     # ~4K tokens (XLA fuses it well and a fused fold's per-tile softmax
@@ -211,9 +220,11 @@ def _data_axes(cfg, mesh) -> tuple:
     ep_ax = getattr(cfg, "moe_mesh_axis", "dp")
     if cfg.n_experts and ep_ax != "dp" and ep_ax in mesh.axis_names:
         return ("dp", ep_ax)
-    if "ep" in mesh.axis_names:
-        # a dedicated ep axis on the mesh is extra data parallelism even
-        # for dense configs, so one mesh serves both model kinds
+    if getattr(cfg, "ep_extends_dp", False) and "ep" in mesh.axis_names:
+        # EXPLICITLY opted in (cfg.ep_extends_dp): the dedicated ep axis
+        # is extra data parallelism for this dense config, so one mesh
+        # serves both model kinds.  Without the flag an axis named "ep"
+        # is left alone — the name is only reserved for configs that ask.
         return ("dp", "ep")
     return ("dp",)
 

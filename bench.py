@@ -739,6 +739,9 @@ def _probe_with_idle_retry(errors: dict, extras: dict = None) -> bool:
             )[:400].strip(" |")
             print("bench pre-flight budget exhausted", file=sys.stderr)
             return False
+        # stamp BEFORE probing: an external kill mid-probe (the wedge's
+        # favorite moment) must still leave this attempt in the artifact
+        _note_probe_attempt(extras)
         t_probe = time.monotonic()
         ok, detail, retryable, out = _probe_device(min(deadline, remaining))
         _preflight_spend(time.monotonic() - t_probe)
@@ -782,6 +785,51 @@ def _probe_with_idle_retry(errors: dict, extras: dict = None) -> bool:
     return False
 
 
+# Impossible-rate gate for the official artifact (VERDICT r4 item 3):
+# bandwidth-like extras above this ceiling mean the measurement under
+# them was a sentinel or a clock bug; they move to `errors` instead of
+# shipping on the scoreboard.  50 TB/s is ~30x the best real number ever
+# captured here (cast_stochastic 1.6 TB/s) and far under the 16.7 Pb/s
+# class of garbage this gate exists to catch.
+_BANDWIDTH_KEY_PREFIXES = ("combine_", "allreduce_", "cast_", "quant_")
+_BANDWIDTH_CEILING_GBS = float(
+    os.environ.get("ACCL_BENCH_GBS_CEILING", "50000")
+)
+
+
+def _sanitize_extras(extras: dict, errors: dict) -> None:
+    """Move physically impossible bandwidth extras into errors, in place.
+    Runs immediately before every emission (fresh, guarded, fallback) so
+    no path can print garbage the headline or the judge would trust."""
+    for k in list(extras):
+        if not k.startswith(_BANDWIDTH_KEY_PREFIXES):
+            continue
+        v = extras[k]
+        if isinstance(v, (int, float)) and v > _BANDWIDTH_CEILING_GBS:
+            errors[k] = (
+                f"implausible {v:.2f} GB/s (> {_BANDWIDTH_CEILING_GBS:.0f} "
+                "GB/s sanity ceiling): dropped from extras"
+            )
+            del extras[k]
+
+
+# probe telemetry (VERDICT r4 item 8): the artifact itself must show
+# whether a wedged round probed and failed or never probed at all
+_PROBE_TELEMETRY = {"attempts": 0, "last_at": None}
+
+
+def _note_probe_attempt(extras) -> None:
+    import datetime
+
+    _PROBE_TELEMETRY["attempts"] += 1
+    _PROBE_TELEMETRY["last_at"] = datetime.datetime.now(
+        datetime.timezone.utc
+    ).isoformat(timespec="seconds")
+    if extras is not None:
+        extras["probe_attempts"] = _PROBE_TELEMETRY["attempts"]
+        extras["probe_last_at"] = _PROBE_TELEMETRY["last_at"]
+
+
 def _load_lkg() -> dict:
     try:
         with open(_LKG_PATH) as f:
@@ -800,10 +848,20 @@ def _save_lkg(result: dict) -> None:
         return
     import datetime
 
+    stash_result = {
+        k: v for k, v in result.items() if k not in ("errors",)
+    }
+    if isinstance(stash_result.get("extras"), dict):
+        # run telemetry is about THE RUN, not the capture: persisting it
+        # would let a later fallback report this run's probe counts as
+        # if they were its own
+        stash_result["extras"] = {
+            k: v for k, v in stash_result["extras"].items()
+            if k not in ("probe_attempts", "probe_last_at")
+        }
     stash = {
-        "result": {
-            k: v for k, v in result.items() if k not in ("errors",)
-        },
+        "schema": _LKG_SCHEMA,
+        "result": stash_result,
         "captured_at": datetime.datetime.now(datetime.timezone.utc)
         .isoformat(timespec="seconds"),
     }
@@ -822,6 +880,22 @@ def _save_lkg(result: dict) -> None:
         os.replace(tmp, _LKG_PATH)
     except OSError as e:
         print(f"bench lkg stash failed: {e}", file=sys.stderr)
+
+
+# LKG schema versioning (VERDICT r4 item 4).  Schema 2 stashes are
+# stamped with the bench-code git rev and this version; when the
+# fallback serves a PRE-schema stash, keys whose semantics drifted since
+# capture are renamed so the artifact is self-describing.  The known
+# drift: before the attention-default flip, `train_mfu`/`train_tflops`
+# measured the then-default FUSED attention — which the shipped
+# `attention="auto"` no longer selects at the bench's T=1024 — so
+# serving them under the current names would misstate the default
+# config's MFU by ~15 points (0.46 fused vs 0.61 naive at 852148a).
+_LKG_SCHEMA = 2
+_LEGACY_LKG_RENAMES = {
+    "train_mfu": "train_mfu@{git}_fused_default",
+    "train_tflops": "train_tflops@{git}_fused_default",
+}
 
 
 # Live state for the signal handler: the guarded parent keeps its
@@ -877,17 +951,49 @@ def _emit_fallback(extras: dict, errors: dict, reason: str) -> None:
         return
     _GUARD_STATE["emitted"] = True
     print(f"bench FAILED: {reason}", file=sys.stderr)
+    _sanitize_extras(extras, errors)
     result = _headline(extras)
     lkg = _load_lkg()
     if result.get("value") is None and lkg and lkg.get("result"):
         stashed = lkg["result"]
         result = {k: v for k, v in stashed.items() if k != "extras"}
+        stash_extras = dict(stashed.get("extras") or {})
+        # never inherit the capture run's probe telemetry (pre-scrub
+        # stashes may carry it): this run's counts — possibly none, when
+        # a kill landed mid-first-probe — are the honest ones
+        for k in ("probe_attempts", "probe_last_at"):
+            stash_extras.pop(k, None)
+        lkg_schema = lkg.get("schema", 1)
+        if lkg_schema < _LKG_SCHEMA:
+            # pre-schema stash: rename the semantics-drifted keys so the
+            # served numbers say WHAT they measured, not just when
+            git = lkg.get("git") or "unversioned"
+            for old, pattern in _LEGACY_LKG_RENAMES.items():
+                if old in stash_extras:
+                    stash_extras[pattern.format(git=git)] = (
+                        stash_extras.pop(old)
+                    )
         # fresh partial metrics beat stashed ones key-by-key
-        merged = dict(stashed.get("extras") or {})
+        merged = stash_extras
         merged.update(extras)
         extras = merged
+        # the stash predates (or could predate) this gate: re-sanitize
+        # the merged set and the stashed headline itself, so "no path
+        # prints garbage" includes the last-known-good path
+        _sanitize_extras(extras, errors)
+        if (
+            isinstance(result.get("value"), (int, float))
+            and result["value"] > _BANDWIDTH_CEILING_GBS
+        ):
+            errors["lkg_headline"] = (
+                f"implausible stashed headline {result['value']:.2f} "
+                f"GB/s (> {_BANDWIDTH_CEILING_GBS:.0f} ceiling): nulled"
+            )
+            result["value"] = None
+            result["vs_baseline"] = None
         result["provenance"] = {
             "source": "last_known_good",
+            "schema": lkg_schema,
             "captured_at": lkg.get("captured_at"),
             "git": lkg.get("git"),
             "reason": reason[:200],
@@ -1044,6 +1150,7 @@ def _run_guarded() -> None:
             # resumed run the child only saw its post-skip metrics, so
             # its own headline can understate (attempt 1's winning
             # number was skipped, not lost)
+            _sanitize_extras(extras, errors)
             fresh = _headline(extras)
             if fresh.get("value") is not None:
                 if device is not None:
@@ -1229,6 +1336,7 @@ def main() -> None:
             )
     _try(extras, errors, "decode_tokens_per_s", _bench_decode_throughput)
 
+    _sanitize_extras(extras, errors)
     result = _headline(extras)
     result["device"] = jax.devices()[0].device_kind
     result["extras"] = extras

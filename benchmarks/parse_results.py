@@ -27,16 +27,33 @@ from collections import defaultdict
 # from a sweep are skipped
 _TABLE_SIZES = [2**10, 2**16, 2**19, 2**23]
 
+# Impossible-rate refusal (VERDICT r4 weak #1): a committed CSV can rot
+# (this parser once printed "sendrecv peak 16,777,216.00 Gb/s" — 16.7
+# Pb/s — into the summary without blinking).  Anything above this
+# per-rank ceiling means the duration under it was a sentinel; refuse to
+# summarize/plot it so the rot is an error, not a table entry.  Same
+# ceiling as benchmarks/sweep.py's writer-side gate.
+SANE_GBPS_CEILING = float(os.environ.get("ACCL_SWEEP_GBPS_CEILING", "10000"))
+
 
 def load(path: str) -> dict:
     """{collective: [(count, bytes, duration_ns, gbps), ...]} sorted by
-    element count."""
+    element count.  Raises ValueError on physically impossible rates."""
     out: dict = defaultdict(list)
     with open(path, newline="") as f:
         for row in csv.DictReader(f):
+            gbps = float(row["gbps"])
+            if gbps > SANE_GBPS_CEILING:
+                raise ValueError(
+                    f"{path}: {row['collective']} count={row['count']} claims "
+                    f"{gbps:.2f} Gb/s (> {SANE_GBPS_CEILING:.0f} Gb/s sanity "
+                    "ceiling) — the CSV carries a sentinel/garbage duration; "
+                    "regenerate it with the fixed engine instead of "
+                    "summarizing garbage"
+                )
             out[row["collective"]].append((
                 int(row["count"]), int(row["bytes"]),
-                float(row["duration_ns"]), float(row["gbps"]),
+                float(row["duration_ns"]), gbps,
             ))
     for rows in out.values():
         rows.sort()

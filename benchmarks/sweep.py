@@ -38,6 +38,41 @@ COLLECTIVES = [
     "alltoall",
 ]
 
+# Physically-impossible-rate gate (VERDICT r4 weak #1): an engine bug —
+# e.g. a sentinel duration_ns — must become an ERROR at the writer, not a
+# committed CSV row ("2 MiB in 1 ns" survived a whole round unnoticed).
+# 10 Tb/s per rank is far above any tier this harness sweeps (ICI is
+# O(100) GB/s per link; the emulator/socket tiers are slower still); the
+# reference never needs this gate because it reads device cycle counters
+# (fixture.hpp:134-152), which cannot emit a sentinel.
+SANE_GBPS_CEILING = float(os.environ.get("ACCL_SWEEP_GBPS_CEILING", "10000"))
+
+
+class ImpossibleRateError(RuntimeError):
+    """A computed rate exceeded the sanity ceiling: the duration under it
+    is garbage (sentinel, clock bug), and writing it would poison the
+    committed artifact chain (CSV -> parse_results -> BENCH_NOTES)."""
+
+
+def write_row(writer, collective: str, count: int, nbytes: int, ns: float):
+    gbps = 8 * nbytes / max(ns, 1) if ns else 0.0
+    if gbps > SANE_GBPS_CEILING:
+        raise ImpossibleRateError(
+            f"{collective} count={count}: {gbps:.2f} Gb/s from "
+            f"duration_ns={ns:.0f} exceeds the {SANE_GBPS_CEILING:.0f} Gb/s "
+            "sanity ceiling — the engine reported a sentinel/garbage "
+            "duration; refusing to write the row"
+        )
+    writer.writerow(
+        {
+            "collective": collective,
+            "count": count,
+            "bytes": nbytes,
+            "duration_ns": int(ns),
+            "gbps": gbps,
+        }
+    )
+
 
 def _run_group_op(group, op: str, count: int) -> float:
     """One synchronized run across all rank handles; returns max engine
@@ -116,15 +151,7 @@ def sweep_group(group, sizes: List[int], collectives: List[str], writer) -> None
     for op in collectives:
         for n in sizes:
             ns = _run_group_op(group, op, n)
-            writer.writerow(
-                {
-                    "collective": op,
-                    "count": n,
-                    "bytes": n * 4,
-                    "duration_ns": ns,
-                    "gbps": 8 * (n * 4) / max(ns, 1) if ns else 0.0,
-                }
-            )
+            write_row(writer, op, n, n * 4, ns)
 
 
 def sweep_ops(world: int, sizes: List[int], writer, extra_algos=()) -> None:
@@ -199,15 +226,7 @@ def sweep_ops(world: int, sizes: List[int], writer, extra_algos=()) -> None:
                 out = fn(stacked, mesh)
             out.block_until_ready()
             ns = (time.perf_counter() - t0) / 5 * 1e9
-            writer.writerow(
-                {
-                    "collective": op,
-                    "count": n,
-                    "bytes": n * 4,
-                    "duration_ns": int(ns),
-                    "gbps": 8 * (n * 4) / max(ns, 1),
-                }
-            )
+            write_row(writer, op, n, n * 4, ns)
 
 
 def main(argv=None) -> int:

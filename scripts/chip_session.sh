@@ -11,7 +11,7 @@
 #
 # Run from the repo root. Artifacts to commit afterwards:
 #   .bench_lkg.json  TPU_TIER.json  (+ BENCH_NOTES update)
-set -u
+set -u -o pipefail
 cd "$(dirname "$0")/.."
 
 echo "== 1/3 probe" >&2
@@ -22,6 +22,27 @@ fi
 
 echo "== 2/3 guarded bench (this is the long leg; do not signal it)" >&2
 python bench.py | tee /tmp/bench_chip_session.json
+# The guarded parent ALWAYS exits 0 (the wedge-proof fallback is the
+# point), so success is judged from the emitted JSON: a fresh capture
+# has no last_known_good provenance and no harness error.  A fallback
+# here means the tunnel re-wedged mid-leg — launching the pytest tier
+# would pile compiles onto a sick device.
+if ! python - <<'PY'
+import json, sys
+line = open("/tmp/bench_chip_session.json").read().strip().splitlines()[-1]
+r = json.loads(line)
+prov = r.get("provenance") or {}
+errors = r.get("errors") or {}
+fresh = prov.get("source") != "last_known_good" and "bench_harness" not in errors
+if not fresh:
+    print(f"bench served a fallback: provenance={prov} "
+          f"harness_error={errors.get('bench_harness')}", file=sys.stderr)
+sys.exit(0 if fresh else 1)
+PY
+then
+  echo "guarded bench fell back — skipping the pytest tier; re-probe later" >&2
+  exit 3
+fi
 
 echo "== 3/3 chip pytest tier" >&2
 python tests/run_tpu_tier.py

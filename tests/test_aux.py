@@ -150,15 +150,7 @@ def test_stress_short(group2):
     """Short randomized stress pass (the reference's stress.cpp loop,
     test/host/xrt/src/stress.cpp:24) against the shared 2-rank fixture —
     integrity-checked send/recv pairs and mixed collectives."""
-    import importlib.util
-    import os
-
-    spec = importlib.util.spec_from_file_location(
-        "stress",
-        os.path.join(os.path.dirname(__file__), "..", "benchmarks", "stress.py"),
-    )
-    stress_mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(stress_mod)
+    stress_mod = _load_bench_module("stress")
     stress_mod.stress(group2, iters=40, max_count=512, report_every=0)
 
 
@@ -283,15 +275,7 @@ def test_parse_results_regenerates_sweep_tables(capsys):
     """benchmarks/parse_results.py (the parse_bench_results.py analog)
     folds the committed sweep CSVs into the BENCH_NOTES tables — the
     quoted 8-rank allreduce numbers must come back out of the CSVs."""
-    import importlib.util
-    import os
-
-    path = os.path.join(
-        os.path.dirname(__file__), "..", "benchmarks", "parse_results.py"
-    )
-    spec = importlib.util.spec_from_file_location("parse_results", path)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
+    mod = _load_bench_module("parse_results")
     doc = mod.main([])
     capsys.readouterr()  # swallow the CLI print
     assert "sweep_ops_w8.csv" in doc and "sweep_emulator_w4.csv" in doc
@@ -344,3 +328,49 @@ def test_flagship_train_step_on_hybrid_mesh():
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
         )
+
+
+def _load_bench_module(name):
+    import importlib.util
+    import os
+
+    path = os.path.join(
+        os.path.dirname(__file__), "..", "benchmarks", f"{name}.py"
+    )
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_sweep_writer_refuses_impossible_rate():
+    """The sweep writer is the first sanity gate: a sentinel duration
+    (the round-4 'duration_ns=1' gang p2p bug) must raise, not become a
+    committed CSV row claiming petabit rates."""
+    mod = _load_bench_module("sweep")
+
+    rows = []
+
+    class Writer:
+        def writerow(self, row):
+            rows.append(row)
+
+    with pytest.raises(mod.ImpossibleRateError):
+        mod.write_row(Writer(), "sendrecv", 2**19, 2**21, 1)
+    assert rows == []
+    # a plausible measurement writes through with the same helper
+    mod.write_row(Writer(), "sendrecv", 2**19, 2**21, 2_000_000)
+    assert rows and rows[0]["gbps"] == pytest.approx(8 * 2**21 / 2e6)
+
+
+def test_parse_results_refuses_poisoned_csv(tmp_path):
+    """The parser is the second gate: a poisoned committed CSV errors
+    out instead of summarizing/plotting 16.7 Pb/s into BENCH_NOTES."""
+    mod = _load_bench_module("parse_results")
+    bad = tmp_path / "sweep_bad.csv"
+    bad.write_text(
+        "collective,count,bytes,duration_ns,gbps\n"
+        "sendrecv,524288,2097152,1,16777216.0\n"
+    )
+    with pytest.raises(ValueError, match="sanity ceiling"):
+        mod.load(str(bad))

@@ -554,3 +554,134 @@ def test_run_guarded_recomputes_headline_on_resume(
     assert r["value"] == 700.0
     assert "impl" not in r
     assert r["device"] == "TPU v5 lite"
+
+
+# -- round-5 hardening: sanity gate, LKG schema, probe telemetry --------------
+
+
+def test_sanitize_extras_moves_impossible_rates(bench):
+    """Bandwidth extras above the plausibility ceiling move to errors —
+    the artifact-side twin of the sweep writer's gate (VERDICT r4: a
+    16.7 Pb/s sentinel reached a committed table unchallenged)."""
+    extras = {"combine_xla": 700.0, "cast_pallas": 16_777_216.0}
+    errors = {}
+    bench._sanitize_extras(extras, errors)
+    assert "cast_pallas" not in extras
+    assert "implausible" in errors["cast_pallas"]
+    assert extras["combine_xla"] == 700.0  # plausible numbers untouched
+
+
+def test_fallback_headline_never_built_from_garbage(bench, capsys):
+    """A sentinel-poisoned fresh metric must not become the scoreboard
+    headline in the fallback path either."""
+    bench._emit_fallback({"combine_xla": 2.0e6}, {}, "wedged mid-run")
+    r = _capture_json_line(capsys)
+    assert r["value"] is None  # garbage dropped; nothing real to report
+    assert "implausible" in r["errors"]["combine_xla"]
+
+
+def test_save_lkg_stamps_schema(bench):
+    bench._save_lkg(_tpu_result())
+    assert bench._load_lkg()["schema"] == bench._LKG_SCHEMA
+
+
+def test_emit_fallback_renames_preschema_drifted_keys(bench, capsys):
+    """Serving a pre-schema stash renames the keys whose semantics
+    drifted since capture (the attention-default flip): the artifact
+    must say WHAT its numbers measured, not imply the current default
+    trains at the old default's MFU."""
+    legacy = {
+        "result": {
+            "metric": "combine_datapath_bandwidth", "value": 640.0,
+            "unit": "GB/s", "vs_baseline": 40.0, "device": "TPU v5 lite",
+            "extras": {
+                "combine_xla": 640.0, "train_mfu": 0.4583,
+                "train_tflops": 90.28, "train_mfu_naive": 0.6099,
+            },
+        },
+        "captured_at": "2026-07-31T01:04:45+00:00", "git": "852148a",
+    }
+    with open(bench._LKG_PATH, "w") as f:
+        json.dump(legacy, f)
+    bench._emit_fallback({}, {}, "probe never passed")
+    r = _capture_json_line(capsys)
+    assert r["provenance"]["schema"] == 1
+    assert "train_mfu" not in r["extras"]
+    assert r["extras"]["train_mfu@852148a_fused_default"] == 0.4583
+    assert r["extras"]["train_tflops@852148a_fused_default"] == 90.28
+    # unchanged-semantics keys keep their names
+    assert r["extras"]["train_mfu_naive"] == 0.6099
+
+
+def test_emit_fallback_keeps_schema2_keys_verbatim(bench, capsys):
+    """A schema-2 stash (captured after the default flip) serves its
+    keys unrenamed — the rename is a legacy-migration path only."""
+    bench._save_lkg({
+        **_tpu_result(500.0),
+        "extras": {"combine_pallas": 500.0, "train_mfu": 0.61},
+    })
+    bench._emit_fallback({}, {}, "wedged")
+    r = _capture_json_line(capsys)
+    assert r["extras"]["train_mfu"] == 0.61
+    assert r["provenance"]["schema"] == bench._LKG_SCHEMA
+
+
+def test_probe_attempts_recorded_in_extras(bench, monkeypatch):
+    """Probe telemetry travels in extras on every run, so a wedged
+    round's artifact distinguishes 'probed N times, all failed' from
+    'never probed' (VERDICT r4 item 8)."""
+    monkeypatch.setattr(
+        bench, "_probe_device",
+        lambda deadline: (False, "ImportError: nope", False, None),
+    )
+    extras, errors = {}, {}
+    assert not bench._probe_with_idle_retry(errors, extras)
+    assert extras["probe_attempts"] == 1
+    assert extras["probe_last_at"]
+
+
+def test_emit_fallback_sanitizes_stashed_garbage(bench, capsys):
+    """The LKG path is not exempt from the sanity gate: a stash captured
+    before the gate existed (or poisoned on disk) must not ship its
+    garbage under last_known_good provenance."""
+    legacy = {
+        "result": {
+            "metric": "combine_datapath_bandwidth", "value": 16_777_216.0,
+            "unit": "GB/s", "vs_baseline": 1_048_576.0,
+            "device": "TPU v5 lite",
+            "extras": {"combine_xla": 640.0, "cast_pallas": 2.0e6},
+        },
+        "captured_at": "2026-07-30T00:00:00+00:00", "git": "deadbee",
+    }
+    with open(bench._LKG_PATH, "w") as f:
+        json.dump(legacy, f)
+    bench._emit_fallback({}, {}, "wedged")
+    r = _capture_json_line(capsys)
+    assert r["value"] is None  # implausible stashed headline nulled
+    assert "implausible" in r["errors"]["lkg_headline"]
+    assert "cast_pallas" not in r["extras"]
+    assert "implausible" in r["errors"]["cast_pallas"]
+    assert r["extras"]["combine_xla"] == 640.0  # plausible stash survives
+
+
+def test_probe_telemetry_never_inherited_from_stash(bench, capsys):
+    """probe_attempts/probe_last_at describe THE RUN: the stash never
+    persists them, and a pre-scrub stash carrying them is scrubbed on
+    merge — a kill mid-first-probe must not report the capture run's
+    probe counts as its own."""
+    bench._save_lkg({
+        **_tpu_result(500.0),
+        "extras": {
+            "combine_pallas": 500.0, "probe_attempts": 7,
+            "probe_last_at": "2026-07-31T01:00:00+00:00",
+        },
+    })
+    assert "probe_attempts" not in bench._load_lkg()["result"]["extras"]
+    # simulate a pre-scrub stash on disk (hand-written with telemetry)
+    lkg = bench._load_lkg()
+    lkg["result"]["extras"]["probe_attempts"] = 9
+    with open(bench._LKG_PATH, "w") as f:
+        json.dump(lkg, f)
+    bench._emit_fallback({}, {}, "killed mid-first-probe")
+    r = _capture_json_line(capsys)
+    assert "probe_attempts" not in r["extras"]  # honest: never probed

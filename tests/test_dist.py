@@ -119,3 +119,93 @@ def test_dist_subcommunicator():
         timeout=300.0,
     )
     assert results == [4.0, None, 4.0]  # ranks 0+2: 1.0 + 3.0
+
+
+def test_dist_notfound_signature_learned_not_hardcoded():
+    """_drain_remote_stream's empty-poll discrimination must survive a
+    jaxlib that renders missing-key errors WITHOUT the literal
+    'NOT_FOUND': the signature is learned once from a known-missing
+    probe key, then matched by type + message fragments (ADVICE r4)."""
+    import types
+
+    from accl_tpu.backends.dist.engine import DistEngine
+
+    class MissingKey(Exception):
+        pass
+
+    class FakeKV:
+        def key_value_try_get_bytes(self, key):
+            raise MissingKey(f"no such key: {key} (renderer v2)")
+
+    eng = types.SimpleNamespace(
+        _nf_probed=False, _nf_sig=None, _nf_probe_tries=0, process_id=0,
+        _kv=lambda: FakeKV(),
+    )
+    is_nf = DistEngine._is_notfound
+    assert is_nf(
+        eng, MissingKey("no such key: accl/stream/0/7/3 (renderer v2)")
+    )
+    assert not is_nf(eng, MissingKey("connection reset by peer"))
+    # same fragments but a different exception type: not the learned
+    # signature, and no NOT_FOUND literal -> treated as a real failure
+    assert not is_nf(eng, RuntimeError("no such key: accl/x (renderer v2)"))
+    # the classic rendering still matches via the substring fallback
+    assert is_nf(eng, RuntimeError("NOT_FOUND: key absent"))
+
+
+def test_dist_notfound_probe_unreachable_kv_not_learned():
+    """If the KV is unreachable at probe time the message names no key —
+    that signature must NOT be learned as 'not found', or every later
+    transport error would be silently folded into 'nothing posted'."""
+    import types
+
+    from accl_tpu.backends.dist.engine import DistEngine
+
+    class KVDown(Exception):
+        pass
+
+    class DeadKV:
+        def key_value_try_get_bytes(self, key):
+            raise KVDown("connection refused")
+
+    eng = types.SimpleNamespace(
+        _nf_probed=False, _nf_sig=None, _nf_probe_tries=0, process_id=0,
+        _kv=lambda: DeadKV(),
+    )
+    assert not DistEngine._is_notfound(eng, KVDown("connection refused"))
+    # the probe re-arms (bounded) so a healthy KV later can still teach
+    # the signature — then learning works and polling stops re-probing
+    assert not eng._nf_probed and eng._nf_probe_tries == 1
+
+    class HealthyKV:
+        def key_value_try_get_bytes(self, key):
+            raise KVDown(f"no such key: {key}")
+
+    eng._kv = lambda: HealthyKV()
+    assert DistEngine._is_notfound(eng, KVDown("no such key: accl/s/0/1/2"))
+    assert eng._nf_probed and eng._nf_sig is not None
+
+
+def test_dist_notfound_bare_key_rendering_not_vacuous():
+    """A KV that renders missing keys as just the quoted key gives a
+    signature with only punctuation around it — matching on that would
+    classify EVERY same-typed exception as 'not found'.  Such a probe
+    must not be learned; only the substring fallback applies."""
+    import types
+
+    from accl_tpu.backends.dist.engine import DistEngine
+
+    class MissingKey(Exception):
+        pass
+
+    class BareKV:
+        def key_value_try_get_bytes(self, key):
+            raise MissingKey(f"'{key}'")
+
+    eng = types.SimpleNamespace(
+        _nf_probed=False, _nf_sig=None, _nf_probe_tries=0, process_id=0,
+        _kv=lambda: BareKV(),
+    )
+    assert not DistEngine._is_notfound(eng, MissingKey("connection reset"))
+    # probed, learned nothing, and will NOT re-probe on the hot path
+    assert eng._nf_probed and eng._nf_sig is None

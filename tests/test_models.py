@@ -1528,6 +1528,45 @@ def test_trainer_moe_dedicated_ep_axis(tmp_path):
         train(steps=1, log_every=0, ep=2)
 
 
+def test_trainer_ep_exceeding_devices_named_error():
+    """--ep larger than the host's devices fails with an error naming
+    --ep, not an opaque numpy reshape error out of Mesh construction."""
+    from accl_tpu.examples.train import train
+
+    with pytest.raises(ValueError, match="--ep 16 needs"):
+        train(steps=1, log_every=0, n_experts=16, ep=16)
+
+
+def test_dense_config_ignores_ep_axis_unless_opted_in():
+    """A caller-built mesh whose axis happens to be named 'ep' must not
+    silently shard a dense config's batch (and psum its grads) over it;
+    cfg.ep_extends_dp is the explicit opt-in for the one-mesh-serves-
+    both-model-kinds layout."""
+    import dataclasses
+
+    from accl_tpu.models.transformer import _data_axes
+
+    cfg = TransformerConfig(d_model=32, n_heads=4, d_ff=64, max_seq=16)
+    mesh = Mesh(
+        np.array(jax.devices()[:8]).reshape(2, 2, 2), ("dp", "ep", "tp")
+    )
+    assert _data_axes(cfg, mesh) == ("dp",)
+    opted = dataclasses.replace(cfg, ep_extends_dp=True)
+    assert _data_axes(opted, mesh) == ("dp", "ep")
+    # the opted-in dense step still computes the single-device math
+    params = init_params(jax.random.PRNGKey(40), opted)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(41), (8, 16), 0, opted.vocab
+    )
+    targets = jnp.roll(tokens, -1, axis=1)
+    from accl_tpu.models.transformer import loss_fn as lf
+
+    loss0 = lf(params, tokens, targets, opted)
+    step, shard = make_sharded_train_step(opted, mesh, lr=0.0)
+    _, loss = step(shard(params), tokens, targets)
+    np.testing.assert_allclose(float(loss), float(loss0), rtol=1e-5)
+
+
 def test_trainer_interleaved_pipeline(tmp_path):
     """--v-stages 2 trains the composed pipeline with interleaved
     virtual stages and resumes from the permuted-stack checkpoint."""

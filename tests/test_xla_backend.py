@@ -163,6 +163,31 @@ def test_xla_sendrecv(gang4, rng):
     np.testing.assert_array_equal(res[2], data)
 
 
+def test_xla_sendrecv_durations_measured(gang4, rng):
+    """p2p requests report measured post->delivery wall-clock ns, never
+    the old duration_ns=1 sentinel (ref bench.cpp:25-31 is literally a
+    get_duration read on send/recv; the sentinel made a committed sweep
+    claim 2 MiB in 1 ns)."""
+    n = 1 << 18  # 1 MiB of f32: delivery alone is safely over a microsecond
+
+    def work(accl, rank):
+        if rank == 0:
+            buf = accl.create_buffer_from(np.ones(n, np.float32))
+            req = accl.send(buf, n, dst=1, tag=9, run_async=True)
+        elif rank == 1:
+            buf = accl.create_buffer(n, np.float32)
+            req = accl.recv(buf, n, src=0, tag=9, run_async=True)
+        else:
+            return None
+        assert req.wait(60)
+        req.check()
+        return req.get_duration_ns()
+
+    res = run_parallel(gang4, work)
+    for ns in (res[0], res[1]):
+        assert 1_000 <= ns < 60 * 10**9, f"implausible p2p duration {ns} ns"
+
+
 def test_xla_stream_put(gang4, rng):
     data = rng.standard_normal(64).astype(np.float32)
 
