@@ -501,20 +501,35 @@ class DistEngine(StreamPortMixin, BaseEngine):
                 trivial = (
                     sum(len(p.strip("'\"` :.,()[]{}")) for p in parts) < 4
                 )
-                if probe_key in msg and not trivial:
+                # ...and it must READ like not-found: a transport error
+                # raised while fetching the probe key also names the key
+                # ("UNAVAILABLE: failed to fetch <key>: connection
+                # refused"), and learning THAT shape would silently fold
+                # every later persistent KV failure into 'nothing
+                # posted'.  Every known coordination-service rendering
+                # of key-absent carries one of these words; a probe
+                # without any is treated as a transport blip.
+                looks_notfound = any(
+                    mk in msg.lower()
+                    for mk in (
+                        "not_found", "not found", "notfound", "no such",
+                        "missing", "does not exist", "absent",
+                    )
+                )
+                if probe_key in msg and not trivial and looks_notfound:
                     self._nf_sig = (type(probe_e), parts)
                     self._nf_probed = True
-                elif probe_key in msg:
+                elif probe_key in msg and trivial:
                     # rendering is bare-key: cannot discriminate, and
                     # re-probing would never improve — substring
                     # fallback only
                     self._nf_sig = None
                     self._nf_probed = True
                 else:
-                    # the KV itself was unreachable (init blip): re-arm
-                    # so a later healthy poll can still learn, but cap
-                    # the retries — each one is an extra KV roundtrip on
-                    # the ~20 Hz polling path
+                    # the KV itself was unreachable or errored (init
+                    # blip): re-arm so a later healthy poll can still
+                    # learn, but cap the retries — each one is an extra
+                    # KV roundtrip on the ~20 Hz polling path
                     self._nf_sig = None
                     self._nf_probe_tries += 1
                     self._nf_probed = self._nf_probe_tries >= 8

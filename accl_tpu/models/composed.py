@@ -169,6 +169,7 @@ def make_pp_train_step(
     v_stages: int = 1,
     schedule: str = "gpipe",
     adam=None,
+    debug_invariants: bool = False,
 ):
     """One SGD step over the ('pp', 'dp', 'tp') mesh.
 
@@ -208,6 +209,26 @@ def make_pp_train_step(
     embedding vjp and places the replicated-param psums (embedding
     contributions live on pp rank 0, head contributions on the last
     rank) explicitly.  Not combinable with ``v_stages > 1`` yet.
+
+    ``debug_invariants=True`` re-arms, at runtime, the guarantee the
+    disabled vma checker would have provided statically (the manual
+    1F1B backward must run ``check_vma=False`` — see the smap_kwargs
+    note below): the step returns an extra replicated scalar, the max
+    |neighbor difference| of the invariant-destined values (loss and
+    the replicated-param grads: embed/ln_f/pos) under a one-step
+    rotation along every mesh axis.  When every transpose is right the
+    scalar sits at the reduction's ROUNDING FLOOR: exactly 0 on
+    power-of-two axes in practice, and at worst a few float32 ulp of
+    the grads (~1e-9 observed on a dp=3 axis, where XLA's lowering of
+    the fused program is not bitwise rank-identical).  A mis-placed
+    hand transpose — the exact bug class ``check_vma=False`` stops the
+    checker from catching — shows up at the GRADIENT's own magnitude
+    (observed ~1e-2, five orders above the floor), so thresholding at
+    ~1e-6 separates them cleanly.  The
+    checks are uniform post-loop collectives (never inside the per-tick
+    switch), token-ordered like every other post-loop psum, so they are
+    deadlock-safe by the same rule the schedule itself follows.  Step
+    returns become ``(params[, state], loss, invariant_err)``.
     """
     _reject_untrainable_attention(cfg)
     if cfg.seq_parallel:
@@ -378,6 +399,46 @@ def make_pp_train_step(
                         embed_path["pos"].astype(jnp.float32), "dp"
                     ) / dp
                 ).astype(p["pos"].dtype)
+            inv_err = None
+            if debug_invariants:
+                # runtime stand-in for the disabled vma checker: the
+                # loss and the replicated-param grads must be IDENTICAL
+                # on every rank (psum hands all participants the same
+                # value; dp averaging divides identically).  The check
+                # is a NEIGHBOR-COMPARE — rotate by one along each axis
+                # with ppermute and diff — which is bitwise-exact for
+                # ANY axis size (a mean-compare would round on
+                # non-power-of-two sizes and report spurious nonzeros).
+                # Token-ordered like every other post-loop collective.
+                def repl_err(v):
+                    nonlocal token
+                    v32 = v.astype(jnp.float32)
+                    v32, _ = lax.optimization_barrier((v32, token))
+                    err = jnp.float32(0)
+                    for ax, size in (("pp", pp), ("tp", tp), ("dp", dp)):
+                        if size == 1:
+                            continue
+                        perm = [(i, (i + 1) % size) for i in range(size)]
+                        shifted = lax.ppermute(v32, ax, perm)
+                        err = jnp.maximum(
+                            err, jnp.max(jnp.abs(v32 - shifted))
+                        )
+                    token = err
+                    return err
+
+                inv_err = repl_err(loss)
+                for k in ("embed", "ln_f", "pos"):
+                    if k in grads:
+                        inv_err = jnp.maximum(inv_err, repl_err(grads[k]))
+                # the verdict itself must be replicated: a VIOLATED
+                # invariant makes |v - m| rank-varying, so max-reduce it
+                # mesh-wide before it leaves the shard_map body
+                inv_err, _ = lax.optimization_barrier((inv_err, token))
+                for ax in ("pp", "tp", "dp"):
+                    inv_err = collectives.allreduce(
+                        inv_err, ax, ReduceFunction.MAX
+                    )
+                token = inv_err
             # pp-local stage grads, dp-averaged leaf by leaf (LAST: they
             # are {pp, tp}-varying and the token inherits that)
             grads["layers"] = jax.tree_util.tree_map(
@@ -386,7 +447,7 @@ def make_pp_train_step(
                 ).astype(p_.dtype),
                 layer_grads, p["layers"],
             )
-            return loss, grads
+            return loss, grads, inv_err
 
         def global_loss(p):
             x = _embed_tokens(p, tokens, cfg)
@@ -413,11 +474,36 @@ def make_pp_train_step(
 
         if schedule == "1f1b":
             return step_1f1b(params)
-        return jax.value_and_grad(global_loss)(params)
+        loss, grads = jax.value_and_grad(global_loss)(params)
+        inv_err = None
+        if debug_invariants:
+            # same neighbor-compare as the 1f1b path (bitwise-exact for
+            # any axis size), minus the token chain the checked-vma
+            # autodiff path does not need
+            def repl_err(v):
+                v32 = v.astype(jnp.float32)
+                err = jnp.float32(0)
+                for ax, size in (("pp", pp), ("tp", tp), ("dp", dp)):
+                    if size == 1:
+                        continue
+                    perm = [(i, (i + 1) % size) for i in range(size)]
+                    shifted = lax.ppermute(v32, ax, perm)
+                    err = jnp.maximum(err, jnp.max(jnp.abs(v32 - shifted)))
+                return err
+
+            inv_err = repl_err(loss)
+            for k in ("embed", "ln_f", "pos"):
+                if k in grads:
+                    inv_err = jnp.maximum(inv_err, repl_err(grads[k]))
+            for ax in ("pp", "tp", "dp"):  # replicate the verdict
+                inv_err = lax.pmax(inv_err, ax)
+        return loss, grads, inv_err
 
     def step(params, tokens, targets):
-        loss, grads = _compute_grads(params, tokens, targets)
+        loss, grads, inv = _compute_grads(params, tokens, targets)
         params = jax.tree.map(lambda p_, g: p_ - lr * g, params, grads)
+        if debug_invariants:
+            return params, loss, inv
         return params, loss
 
     def zero_step(params, state, tokens, targets):
@@ -426,7 +512,7 @@ def make_pp_train_step(
         stage sharding)."""
         from ..parallel.zero import clip_by_global_norm, zero_adam_update
 
-        loss, grads = _compute_grads(params, tokens, targets)
+        loss, grads, inv = _compute_grads(params, tokens, targets)
         if adam.clip_grad_norm is not None:
             grads, _ = clip_by_global_norm(
                 grads, specs, adam.clip_grad_norm, "tp", "dp",
@@ -435,6 +521,8 @@ def make_pp_train_step(
         params, state = zero_adam_update(
             params, grads, state, "dp", adam, specs=specs
         )
+        if debug_invariants:
+            return params, state, loss, inv
         return params, state, loss
 
     if adam is not None:
@@ -446,13 +534,19 @@ def make_pp_train_step(
         smap_kwargs = dict(
             mesh=mesh,
             in_specs=(specs, sspecs, P("dp", None), P("dp", None)),
-            out_specs=(specs, sspecs, P()),
+            out_specs=(
+                (specs, sspecs, P(), P())
+                if debug_invariants
+                else (specs, sspecs, P())
+            ),
         )
     else:
         smap_kwargs = dict(
             mesh=mesh,
             in_specs=(specs, P("dp", None), P("dp", None)),
-            out_specs=(specs, P()),
+            out_specs=(
+                (specs, P(), P()) if debug_invariants else (specs, P())
+            ),
         )
     if schedule == "1f1b":
         # the vma checker cannot host the manual backward: the per-tick

@@ -1,0 +1,51 @@
+#!/usr/bin/env bash
+# Recurring tunnel probe -> at the FIRST healthy window, run the staged
+# chip session (scripts/chip_session.sh: guarded bench, then the chip
+# pytest tier).  Every probe appends one JSON line to
+# benchmarks/results/probe_history_r05.jsonl, so a round that stays
+# wedged is itself machine-readable evidence (VERDICT r4 items 1/8).
+#
+# Safe-by-construction properties:
+#   * the probe is bench.py's own ACCL_BENCH_MODE=probe child (tiny
+#     jitted x+1; the designed health check) under the same 150 s
+#     deadline chip_session uses;
+#   * only ONE loop runs (pidfile), and it exits for good after one
+#     successful session (done-flag) so it can never collide with the
+#     driver's end-of-round bench run;
+#   * the chip session itself is never signalled by this loop.
+set -u
+cd "$(dirname "$0")/.."
+
+LOG=benchmarks/results/probe_history_r05.jsonl
+SESSION_LOG=benchmarks/results/chip_session_r05.log
+DONE=benchmarks/results/.chip_session_done
+PIDFILE=/tmp/accl_probe_loop.pid
+INTERVAL="${ACCL_PROBE_INTERVAL:-2700}"
+
+if [ -e "$PIDFILE" ] && kill -0 "$(cat "$PIDFILE")" 2>/dev/null; then
+  echo "probe loop already running (pid $(cat "$PIDFILE"))" >&2
+  exit 1
+fi
+echo $$ > "$PIDFILE"
+
+while true; do
+  [ -e "$DONE" ] && exit 0
+  ts=$(date -u +%FT%TZ)
+  out=$(ACCL_BENCH_MODE=probe timeout 150 python bench.py 2>/dev/null | tail -1)
+  if echo "$out" | grep -q '"ok": true'; then
+    echo "{\"at\": \"$ts\", \"healthy\": true, \"probe\": $out}" >> "$LOG"
+    bash scripts/chip_session.sh >> "$SESSION_LOG" 2>&1
+    src=$?
+    echo "{\"at\": \"$(date -u +%FT%TZ)\", \"chip_session_rc\": $src}" >> "$LOG"
+    if [ "$src" -eq 0 ]; then
+      touch "$DONE"
+      exit 0
+    fi
+    # a failed session usually means a re-wedge mid-leg: keep probing
+  else
+    probe_json=${out:-null}
+    [ -z "$probe_json" ] && probe_json=null
+    echo "{\"at\": \"$ts\", \"healthy\": false, \"probe\": $probe_json}" >> "$LOG"
+  fi
+  sleep "$INTERVAL"
+done

@@ -209,3 +209,33 @@ def test_dist_notfound_bare_key_rendering_not_vacuous():
     assert not DistEngine._is_notfound(eng, MissingKey("connection reset"))
     # probed, learned nothing, and will NOT re-probe on the hot path
     assert eng._nf_probed and eng._nf_sig is None
+
+
+def test_dist_notfound_transport_error_naming_key_not_learned():
+    """A transport error raised WHILE fetching the probe key also names
+    the key ('failed to fetch <key>: connection refused') — learning
+    that shape would silently fold every later persistent KV failure
+    into 'nothing posted'.  Only messages that read as not-found are
+    learnable; this one re-arms the (capped) probe instead."""
+    import types
+
+    from accl_tpu.backends.dist.engine import DistEngine
+
+    class KVErr(Exception):
+        pass
+
+    class FlakyKV:
+        def key_value_try_get_bytes(self, key):
+            raise KVErr(
+                f"UNAVAILABLE: failed to fetch {key}: connection refused"
+            )
+
+    eng = types.SimpleNamespace(
+        _nf_probed=False, _nf_sig=None, _nf_probe_tries=0, process_id=0,
+        _kv=lambda: FlakyKV(),
+    )
+    assert not DistEngine._is_notfound(
+        eng, KVErr("UNAVAILABLE: failed to fetch accl/s/0/1/2: "
+                   "connection refused")
+    )
+    assert eng._nf_sig is None and not eng._nf_probed

@@ -700,3 +700,151 @@ def test_moe_aux_under_expert_parallelism():
     np.testing.assert_allclose(
         float(lb), float(aux_dense["load_balance"]), rtol=1e-5
     )
+
+
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_composed_debug_invariants_zero_2x2x2(schedule):
+    """debug_invariants re-arms, at runtime, what check_vma=False turned
+    off statically: the returned invariant scalar (max deviation of loss
+    and replicated-param grads from their mesh-wide mean) is exactly 0
+    when every hand-placed 1F1B transpose is right (VERDICT r4 item 5)."""
+    from jax.sharding import Mesh
+    from accl_tpu.models import TransformerConfig, init_params
+    from accl_tpu.models.composed import make_pp_train_step
+
+    cfg = TransformerConfig(
+        vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_seq=32, attention="naive",
+    )
+    params0 = init_params(jax.random.PRNGKey(0), cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, cfg.vocab)
+    tgts = jnp.roll(toks, -1, axis=1)
+    mesh3d = Mesh(
+        np.array(jax.devices()[:8]).reshape(2, 2, 2), ("pp", "dp", "tp")
+    )
+    step, shard = make_pp_train_step(
+        cfg, mesh3d, num_microbatches=2, lr=0.05, schedule=schedule,
+        debug_invariants=True,
+    )
+    params, loss, inv = step(shard(params0), toks, tgts)
+    assert np.isfinite(float(loss))
+    assert float(inv) <= 1e-6  # rounding floor; violations are ~1e-2
+
+
+def test_composed_debug_invariants_catch_missing_transpose(monkeypatch):
+    """The detector test: break the hand-placed fan-out transpose (drop
+    its backward psum) and the invariant scalar must go NONZERO — this
+    is the bug class the disabled vma checker would have caught
+    statically, now caught at runtime instead."""
+    from jax.sharding import Mesh
+    from accl_tpu.models import TransformerConfig, init_params
+    from accl_tpu.models import composed
+
+    # plain identity: backward loses the tp psum the dual wrapper exists
+    # to place, so stage-0 input grads (and thus the embedding grad)
+    # become tp-rank-varying
+    monkeypatch.setattr(composed, "_fanout_psum_bwd", lambda x, ax: x)
+
+    cfg = TransformerConfig(
+        vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_seq=32, attention="naive",
+    )
+    params0 = init_params(jax.random.PRNGKey(0), cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, cfg.vocab)
+    tgts = jnp.roll(toks, -1, axis=1)
+    mesh3d = Mesh(
+        np.array(jax.devices()[:8]).reshape(2, 2, 2), ("pp", "dp", "tp")
+    )
+    step, shard = composed.make_pp_train_step(
+        cfg, mesh3d, num_microbatches=2, lr=0.05, schedule="1f1b",
+        debug_invariants=True,
+    )
+    _, _, inv = step(shard(params0), toks, tgts)
+    assert float(inv) > 1e-4  # gradient-magnitude signal, not noise
+
+
+def test_composed_debug_invariants_4x2x2_subprocess():
+    """The invariant holds as the mesh GROWS past the 8-device fixture:
+    pp=4 x dp=2 x tp=2 on 16 virtual devices, both schedules, equal
+    losses and a zero invariant scalar (VERDICT r4 item 5's 4x2x2 leg)."""
+    import os
+    import subprocess
+    import sys
+    import textwrap
+
+    script = textwrap.dedent("""
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import Mesh
+        from accl_tpu.models import TransformerConfig, init_params
+        from accl_tpu.models.composed import make_pp_train_step
+
+        devs = jax.devices()
+        assert len(devs) == 16, len(devs)
+        cfg = TransformerConfig(
+            vocab=64, d_model=32, n_heads=4, n_layers=4, d_ff=64,
+            max_seq=32, attention="naive",
+        )
+        p0 = init_params(jax.random.PRNGKey(0), cfg)
+        toks = jax.random.randint(
+            jax.random.PRNGKey(1), (8, 16), 0, cfg.vocab
+        )
+        tgts = jnp.roll(toks, -1, axis=1)
+        mesh = Mesh(np.array(devs).reshape(4, 2, 2), ("pp", "dp", "tp"))
+        losses = {}
+        for sched in ("gpipe", "1f1b"):
+            step, shard = make_pp_train_step(
+                cfg, mesh, num_microbatches=4, lr=0.05, schedule=sched,
+                debug_invariants=True,
+            )
+            _, loss, inv = step(shard(p0), toks, tgts)
+            assert float(inv) <= 1e-6, (sched, float(inv))
+            losses[sched] = float(loss)
+        assert abs(losses["gpipe"] - losses["1f1b"]) <= (
+            1e-5 * abs(losses["gpipe"])
+        ), losses
+        print("OK", losses)
+    """)
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=16"
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    proc = subprocess.run(
+        [sys.executable, "-c", script], env=env, cwd=repo,
+        capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "OK" in proc.stdout
+
+
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_composed_debug_invariants_floor_on_non_pow2_axis(schedule):
+    """On a non-power-of-two axis the scalar sits at the rounding floor
+    (~1e-9 float32 ulp of the grads; XLA's fused-program lowering is not
+    bitwise rank-identical on dp=3) — far below the ~1e-2 signal of a
+    real mis-placed transpose, so the 1e-6 threshold separates cleanly.
+    A mean-compare would add rounding of its own; the neighbor-compare
+    keeps the floor at ulp level."""
+    from jax.sharding import Mesh
+    from accl_tpu.models import TransformerConfig, init_params
+    from accl_tpu.models.composed import make_pp_train_step
+
+    cfg = TransformerConfig(
+        vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_seq=32, attention="naive",
+    )
+    params0 = init_params(jax.random.PRNGKey(0), cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (6, 16), 0, cfg.vocab)
+    tgts = jnp.roll(toks, -1, axis=1)
+    mesh = Mesh(
+        np.array(jax.devices()[:6]).reshape(2, 3, 1), ("pp", "dp", "tp")
+    )
+    step, shard = make_pp_train_step(
+        cfg, mesh, num_microbatches=2, lr=0.05, schedule=schedule,
+        debug_invariants=True,
+    )
+    _, loss, inv = step(shard(params0), toks, tgts)
+    assert np.isfinite(float(loss))
+    assert float(inv) <= 1e-6  # rounding floor; violations are ~1e-2
