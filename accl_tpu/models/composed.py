@@ -402,14 +402,14 @@ def make_pp_train_step(
             inv_err = None
             if debug_invariants:
                 # runtime stand-in for the disabled vma checker: the
-                # loss and the replicated-param grads must be IDENTICAL
-                # on every rank (psum hands all participants the same
-                # value; dp averaging divides identically).  The check
-                # is a NEIGHBOR-COMPARE — rotate by one along each axis
-                # with ppermute and diff — which is bitwise-exact for
-                # ANY axis size (a mean-compare would round on
-                # non-power-of-two sizes and report spurious nonzeros).
-                # Token-ordered like every other post-loop collective.
+                # loss and the replicated-param grads should be
+                # identical on every rank.  The check is a NEIGHBOR-
+                # COMPARE — rotate by one along each axis with ppermute
+                # and diff — which adds no rounding of its own (a mean-
+                # compare would); the residual floor is XLA's own fused-
+                # program lowering, ulp-level on non-power-of-two axes
+                # (see the docstring).  Token-ordered like every other
+                # post-loop collective.
                 def repl_err(v):
                     nonlocal token
                     v32 = v.astype(jnp.float32)

@@ -705,9 +705,10 @@ def test_moe_aux_under_expert_parallelism():
 @pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
 def test_composed_debug_invariants_zero_2x2x2(schedule):
     """debug_invariants re-arms, at runtime, what check_vma=False turned
-    off statically: the returned invariant scalar (max deviation of loss
-    and replicated-param grads from their mesh-wide mean) is exactly 0
-    when every hand-placed 1F1B transpose is right (VERDICT r4 item 5)."""
+    off statically: the returned invariant scalar (max neighbor
+    difference of loss and replicated-param grads under a one-step
+    rotation per mesh axis) sits at the rounding floor when every
+    hand-placed 1F1B transpose is right (VERDICT r4 item 5)."""
     from jax.sharding import Mesh
     from accl_tpu.models import TransformerConfig, init_params
     from accl_tpu.models.composed import make_pp_train_step
