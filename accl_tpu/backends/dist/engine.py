@@ -20,6 +20,7 @@ Differences from the single-process gang (backends/xla):
 
 from __future__ import annotations
 
+import functools
 import time
 import traceback
 from typing import Dict, Optional
@@ -27,6 +28,7 @@ from typing import Dict, Optional
 import numpy as np
 
 import jax
+import jax.numpy as jnp
 
 from ...buffer import DeviceBuffer, dev_zeros as _dev_zeros, make_buffer
 from ...communicator import Communicator, Rank
@@ -50,12 +52,58 @@ from ..xla.engine import (
     apply_tuning,
     _cast_program,
     _p2p_hop_program,
-    _prep_program,
-    _trim_program,
     _write_host_result,
     run_allreduce_with_tuning,
     run_rooted_with_tuning,
 )
+
+
+def _bucket_width(n: int) -> int:
+    """Power-of-two wire bucket (floor 8) for a per-chunk element count.
+
+    Every XLA program this engine dispatches is specialized on its
+    operand shapes: without bucketing, a workload sweeping arbitrary
+    counts compiles a FRESH collective program per distinct size (the
+    round-4 soak measured ~3 ops/s on the dist tier for exactly this
+    reason — nearly every op was a cold compile).  Padding each chunk
+    to the next power of two caps the program population at ~log2(max
+    count) per collective and turns the steady state into cached-
+    dispatch latency — the same static-shapes discipline XLA demands of
+    TPU programs generally.  Zero-padding is neutral for every op here:
+    reductions trim the pad before any result is read, and data-movement
+    ops move the pad alongside and trim it at the edge."""
+    if n <= 8:
+        return 8
+    return 1 << (n - 1).bit_length()
+
+
+@functools.lru_cache(maxsize=1024)
+def _pad_chunks_program(chunks: int, n: int, nb: int, wire_name, device):
+    """Device-side re-layout of a (>= chunks*n,) operand into the
+    (1, chunks*nb) padded wire row (big device-resident operands; small
+    ones pad on the host, see _operand_shard)."""
+    from jax.sharding import SingleDeviceSharding
+
+    def f(a):
+        m = a[: chunks * n].reshape(chunks, n)
+        if wire_name is not None:
+            m = m.astype(jnp.dtype(wire_name)).astype(m.dtype)
+        if nb != n:
+            m = jnp.pad(m, ((0, 0), (0, nb - n)))
+        return m.reshape(1, chunks * nb)
+
+    return jax.jit(f, out_shardings=SingleDeviceSharding(device))
+
+
+@functools.lru_cache(maxsize=1024)
+def _unpad_chunks_program(chunks: int, n: int, nb: int, device):
+    """Inverse edge: (1, chunks*nb) padded wire row -> (chunks*n,)."""
+    from jax.sharding import SingleDeviceSharding
+
+    return jax.jit(
+        lambda a: a.reshape(chunks, nb)[:, :n].reshape(-1),
+        out_shardings=SingleDeviceSharding(device),
+    )
 
 
 class DistEngine(StreamPortMixin, BaseEngine):
@@ -233,9 +281,13 @@ class DistEngine(StreamPortMixin, BaseEngine):
         ]
         return shard.data
 
-    def _operand_shard(self, options: CallOptions, in_w: int):
-        """This rank's (1, in_w) committed shard from op0 (device buffers
-        stay on device; host/dummy operands stage once)."""
+    def _operand_shard(self, options: CallOptions, chunks: int, n: int,
+                       nb: int):
+        """This rank's (1, chunks*nb) committed wire shard from op0:
+        ``chunks`` chunks of ``n`` elements, each padded to the ``nb``
+        bucket (see :func:`_bucket_width`).  Small operands stage on the
+        host (free numpy pad, no per-count program); big device-resident
+        operands re-layout on device."""
         buf = options.op0
         npdt = dtype_to_numpy(options.arithcfg.uncompressed)
         compressed = bool(
@@ -246,22 +298,46 @@ class DistEngine(StreamPortMixin, BaseEngine):
             if compressed and options.op != Operation.ALLREDUCE
             else None
         )
+        in_w = chunks * n
         if options.stream & StreamFlags.OP0_STREAM:
-            payload = self._pop_stream_payload(options, in_w)
-            if payload is None:
+            row = self._pop_stream_payload(options, in_w)
+            if row is None:
                 return None
-            arr = jax.device_put(payload.astype(npdt), self.device)
-            return _prep_program(in_w, wire_name, self.device)(arr)
-        if buf is None or buf.is_dummy:
-            return _dev_zeros((1, in_w), npdt, self.device)
-        if isinstance(buf, DeviceBuffer) and buf.device == self.device:
-            return _prep_program(in_w, wire_name, self.device)(
-                buf.device_array()
+            row = np.asarray(row).astype(npdt)[:in_w]
+        elif buf is None or buf.is_dummy:
+            return _dev_zeros((1, chunks * nb), npdt, self.device)
+        elif isinstance(buf, DeviceBuffer) and buf.device == self.device:
+            # eager/rendezvous is decided per CHUNK — the wire message
+            # unit, matching the reference's per-message eager rule (a
+            # scatter of world eager-sized chunks is eager protocol)
+            if n * np.dtype(npdt).itemsize > self.max_eager_size:
+                # RENDEZVOUS domain: zero-host-copy (transfer-guard-
+                # tested) — re-layout on device.  The pad program
+                # retraces per exact count, but the expensive collective
+                # program compiles per BUCKET only.
+                return _pad_chunks_program(
+                    chunks, n, nb, wire_name, self.device
+                )(buf.device_array())
+            # EAGER domain: stage through the host, the reference's own
+            # protocol for small payloads (eager sends land in rx bounce
+            # buffers and are memcpy'd out — zero-copy is a rendezvous-
+            # path property, ref rxbuf_offload).  Numpy pad/trim costs
+            # microseconds and compiles NOTHING per count — the property
+            # that lets a soak sweep arbitrary sizes at cached-dispatch
+            # speed.
+            row = np.asarray(buf.device_view()[:in_w]).astype(npdt)
+        else:
+            row = np.asarray(buf.device_view()[:in_w]).astype(npdt)
+        # already host-side: chunk, wire-round, pad in numpy (free), one
+        # committed put of the bucket-shaped row
+        m = row.reshape(chunks, n)
+        if wire_name is not None:
+            m = m.astype(wire_name).astype(npdt)
+        if nb != n:
+            m = np.concatenate(
+                [m, np.zeros((chunks, nb - n), npdt)], axis=1
             )
-        row = np.asarray(buf.device_view()[:in_w]).astype(npdt)
-        return _prep_program(in_w, wire_name, self.device)(
-            jax.device_put(row, self.device)
-        )
+        return jax.device_put(m.reshape(1, chunks * nb), self.device)
 
     def _collective(self, options: CallOptions) -> ErrorCode:
         comm = options.comm
@@ -270,18 +346,20 @@ class DistEngine(StreamPortMixin, BaseEngine):
         n = options.count
         if n <= 0:
             return ErrorCode.INVALID_COUNT
-        in_w = n * (size if IN_W[op] == "P" else 1)
-        out_w = n * (size if OUT_W[op] == "P" else 1)
+        nb = _bucket_width(n)
+        in_chunks = size if IN_W[op] == "P" else 1
+        out_chunks = size if OUT_W[op] == "P" else 1
+        out_w = n * out_chunks
         mesh = self._comm_mesh(comm)
         fn = options.reduce_function
         if op in (
             Operation.REDUCE, Operation.ALLREDUCE, Operation.REDUCE_SCATTER
         ) and not options.arithcfg.supports(fn):
             return ErrorCode.ARITH_ERROR
-        shard = self._operand_shard(options, in_w)
+        shard = self._operand_shard(options, in_chunks, n, nb)
         if shard is None:
             return ErrorCode.DMA_TIMEOUT
-        global_arr = self._assemble(comm, mesh, shard, in_w)
+        global_arr = self._assemble(comm, mesh, shard, in_chunks * nb)
         compressed = bool(
             options.compression & CompressionFlags.ETH_COMPRESSED
         )
@@ -311,23 +389,40 @@ class DistEngine(StreamPortMixin, BaseEngine):
             writes = comm.local_rank == options.root_dst
         elif op == Operation.GATHER:
             writes = comm.local_rank == options.root_src
-        arr = self._local_shard(out)
+        arr = self._local_shard(out)  # (1, out_chunks*nb) padded wire row
         if not writes:
             return ErrorCode.OK
         res = options.res
         if options.stream & StreamFlags.RES_STREAM:
-            self._push_stream_result(options, np.asarray(arr).reshape(-1))
+            host = np.asarray(arr).reshape(out_chunks, nb)[:, :n]
+            self._push_stream_result(options, host.reshape(-1))
             return ErrorCode.OK
         if res is None or res.is_dummy:
             return ErrorCode.OK
-        arr = _trim_program(out_w, self.device)(arr)
-        if isinstance(res, DeviceBuffer) and res.device == self.device:
+        if (
+            isinstance(res, DeviceBuffer) and res.device == self.device
+            and n * np.dtype(arr.dtype).itemsize > self.max_eager_size
+        ):
+            # rendezvous domain: chunk-trim ON DEVICE (zero-host-copy)
+            arr = _unpad_chunks_program(out_chunks, n, nb, self.device)(arr)
             npdt = dtype_to_numpy(res.dtype)
             if arr.dtype != npdt:
                 arr = _cast_program(npdt, self.device)(arr)
             res.store(arr, out_w)
+        elif isinstance(res, DeviceBuffer) and res.device == self.device:
+            # eager domain: host trim, one committed put (see
+            # _operand_shard's eager note)
+            host = np.asarray(arr).reshape(out_chunks, nb)[:, :n]
+            npdt = dtype_to_numpy(res.dtype)
+            res.store(
+                jax.device_put(
+                    host.reshape(-1).astype(npdt), self.device
+                ),
+                out_w,
+            )
         else:
-            _write_host_result(res, np.asarray(arr), out_w)
+            host = np.asarray(arr).reshape(out_chunks, nb)[:, :n]
+            _write_host_result(res, host.reshape(-1), out_w)
         return ErrorCode.OK
 
     # -- p2p -------------------------------------------------------------------
@@ -340,7 +435,8 @@ class DistEngine(StreamPortMixin, BaseEngine):
         if options.stream & StreamFlags.RES_STREAM:
             return self._remote_stream_put(options)
         n = options.count
-        shard = self._operand_shard(options, n)
+        nb = _bucket_width(n)
+        shard = self._operand_shard(options, 1, n, nb)
         if shard is None:
             return ErrorCode.DMA_TIMEOUT
         if options.compression & CompressionFlags.ETH_COMPRESSED:
@@ -352,10 +448,11 @@ class DistEngine(StreamPortMixin, BaseEngine):
         dst_dev = self._p2p_devices(options, remote_is_dst=True)
         if dst_dev == self.device:
             return ErrorCode.INVALID_RANK  # self-send needs no processes
-        return self._p2p_run(shard, self.device, dst_dev, n)
+        return self._p2p_run(shard, self.device, dst_dev, n, nb)
 
     def _recv(self, options: CallOptions) -> ErrorCode:
         n = options.count
+        nb = _bucket_width(n)
         npdt = dtype_to_numpy(
             options.arithcfg.compressed
             if options.compression & CompressionFlags.ETH_COMPRESSED
@@ -364,21 +461,23 @@ class DistEngine(StreamPortMixin, BaseEngine):
         src_dev = self._p2p_devices(options, remote_is_dst=False)
         if src_dev == self.device:
             return ErrorCode.INVALID_RANK
-        shard = _dev_zeros((1, n), npdt, self.device)
+        shard = _dev_zeros((1, nb), npdt, self.device)
         code = self._p2p_run(
-            shard, src_dev, self.device, n, recv_into=options
+            shard, src_dev, self.device, n, nb, recv_into=options
         )
         return code
 
-    def _p2p_run(self, local_shard, src_dev, dst_dev, n,
+    def _p2p_run(self, local_shard, src_dev, dst_dev, n, nb,
                  recv_into: Optional[CallOptions] = None) -> ErrorCode:
         """Both owning processes execute the same 2-device ppermute
-        program; the receiver adopts its shard."""
+        program over the (2, nb) BUCKETED wire row (so the hop program
+        compiles per bucket, not per exact count); the receiver adopts
+        its shard and trims the pad."""
         from jax.sharding import NamedSharding, PartitionSpec
 
         mesh, prog = _p2p_hop_program(src_dev, dst_dev)
         global_in = jax.make_array_from_single_device_arrays(
-            (2, n),
+            (2, nb),
             NamedSharding(mesh, PartitionSpec("p2p")),
             [local_shard],
         )
@@ -387,20 +486,29 @@ class DistEngine(StreamPortMixin, BaseEngine):
         if recv_into is None:
             return ErrorCode.OK
         options = recv_into
-        arr = _trim_program(n, self.device)(arr)
         if options.stream & StreamFlags.RES_STREAM:
-            self._push_stream_result(options, np.asarray(arr))
+            self._push_stream_result(
+                options, np.asarray(arr).reshape(-1)[:n]
+            )
             return ErrorCode.OK
         res = options.res
         if res is None or res.is_dummy:
             return ErrorCode.OK
-        if isinstance(res, DeviceBuffer) and res.device == self.device:
+        if (
+            isinstance(res, DeviceBuffer) and res.device == self.device
+            and n * np.dtype(arr.dtype).itemsize > self.max_eager_size
+        ):
+            arr = _unpad_chunks_program(1, n, nb, self.device)(arr)
             npdt = dtype_to_numpy(res.dtype)
             if arr.dtype != npdt:
                 arr = _cast_program(npdt, self.device)(arr)
             res.store(arr, n)
+        elif isinstance(res, DeviceBuffer) and res.device == self.device:
+            npdt = dtype_to_numpy(res.dtype)
+            host = np.asarray(arr).reshape(-1)[:n].astype(npdt)
+            res.store(jax.device_put(host, self.device), n)
         else:
-            _write_host_result(res, np.asarray(arr), n)
+            _write_host_result(res, np.asarray(arr).reshape(-1)[:n], n)
         return ErrorCode.OK
 
     # -- remote stream ports over the distributed KV service -------------------

@@ -231,7 +231,16 @@ class DeviceBuffer(BaseBuffer):
         elif array is not None:
             self._dev = array
         else:
-            self._dev = dev_zeros((self._count,), npdt, device)
+            # allocate by committing the freshly-zeroed host shadow: one
+            # H2D put, NO compile.  dev_zeros would jit a zeros program
+            # per distinct count — a workload sweeping sizes (the soak)
+            # pays a fresh XLA compile per allocation, which dominated
+            # the round-4 dist soak.  Allocation is not the data path:
+            # the zero-host-copy contract (transfer-guard-tested) covers
+            # the collective between creation and sync, not creation.
+            import jax
+
+            self._dev = jax.device_put(self._host, device)
 
     # -- introspection ------------------------------------------------------
     @property

@@ -28,6 +28,31 @@ from typing import Callable, List, Optional
 
 def _worker(fn_spec, rank, world, base_port, design_name, conn):
     try:
+        # persistent XLA compilation cache, shared across rank processes
+        # and across runs (same knob bench.py uses): the jax-backed dist
+        # tier compiles one program per (op, wire-bucket, comm) and a
+        # cold cache pays that once per PROCESS per RUN otherwise.  Only
+        # for jax-backed designs — the emulator/socket/native tiers are
+        # numpy/C++ and keep their jax import lazy (an unconditional
+        # import would tax every spawned rank ~1 s for nothing).  Opt
+        # out with ACCL_COMPILE_CACHE="".
+        cache_dir = os.environ.get(
+            "ACCL_COMPILE_CACHE",
+            os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                ".jax_cache",
+            ),
+        )
+        if cache_dir and design_name.startswith("xla"):
+            try:
+                import jax
+
+                jax.config.update("jax_compilation_cache_dir", cache_dir)
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", 0.5
+                )
+            except Exception:
+                pass  # older jax without the knobs
         if isinstance(fn_spec, tuple):  # (script_path, fn_name) from the CLI
             import importlib.util
 

@@ -68,7 +68,13 @@ def _fmt_bytes(n: int) -> str:
 
 
 def _fmt_rate(gbps: float) -> str:
-    return f"{gbps:.2f} Gb/s" if gbps >= 0.005 else f"{gbps:.4f} Gb/s"
+    if gbps >= 0.005:
+        return f"{gbps:.2f} Gb/s"
+    if gbps >= 0.0005:
+        return f"{gbps:.4f} Gb/s"
+    # latency-dominated tiers (e.g. the dist tier's 64-byte rows) have
+    # rates that a fixed-point format would round to a false 0.0000
+    return f"{gbps:.2e} Gb/s"
 
 
 def summarize(path: str) -> str:

@@ -74,6 +74,58 @@ def write_row(writer, collective: str, count: int, nbytes: int, ns: float):
     )
 
 
+def _rank_op(accl, rank: int, world: int, op: str, n: int):
+    """One rank's side of one collective run; returns the engine-reported
+    duration in ns, or None when this rank does not participate.  Shared
+    by the in-process thread sweeps (emulator/xla gang) and the
+    one-OS-process-per-rank dist sweep."""
+    if op == "sendrecv":
+        if rank == 0:
+            buf = accl.create_buffer_from(np.ones(n, np.float32))
+            req = accl.send(buf, n, dst=1, tag=0, run_async=True)
+        elif rank == 1:
+            buf = accl.create_buffer(n, np.float32)
+            req = accl.recv(buf, n, src=0, tag=0, run_async=True)
+        else:
+            return None
+    elif op == "bcast":
+        buf = accl.create_buffer_from(np.ones(n, np.float32))
+        req = accl.bcast(buf, n, root=0, run_async=True)
+    elif op == "scatter":
+        send = accl.create_buffer_from(np.ones(world * n, np.float32))
+        recv = accl.create_buffer(n, np.float32)
+        req = accl.scatter(send, recv, n, root=0, run_async=True)
+    elif op == "gather":
+        send = accl.create_buffer_from(np.ones(n, np.float32))
+        recv = accl.create_buffer(world * n, np.float32)
+        req = accl.gather(send, recv, n, root=0, run_async=True)
+    elif op == "allgather":
+        send = accl.create_buffer_from(np.ones(n, np.float32))
+        recv = accl.create_buffer(world * n, np.float32)
+        req = accl.allgather(send, recv, n, run_async=True)
+    elif op == "reduce":
+        send = accl.create_buffer_from(np.ones(n, np.float32))
+        recv = accl.create_buffer(n, np.float32)
+        req = accl.reduce(send, recv, n, root=0, run_async=True)
+    elif op == "reduce_scatter":
+        send = accl.create_buffer_from(np.ones(world * n, np.float32))
+        recv = accl.create_buffer(n, np.float32)
+        req = accl.reduce_scatter(send, recv, n, run_async=True)
+    elif op == "allreduce":
+        send = accl.create_buffer_from(np.ones(n, np.float32))
+        recv = accl.create_buffer(n, np.float32)
+        req = accl.allreduce(send, recv, n, run_async=True)
+    elif op == "alltoall":
+        send = accl.create_buffer_from(np.ones(world * n, np.float32))
+        recv = accl.create_buffer(world * n, np.float32)
+        req = accl.alltoall(send, recv, n, run_async=True)
+    else:
+        raise ValueError(op)
+    assert req.wait(120), f"{op} count={n} rank={rank} timed out"
+    req.check()
+    return req.get_duration_ns()
+
+
 def _run_group_op(group, op: str, count: int) -> float:
     """One synchronized run across all rank handles; returns max engine
     duration in ns (the reference records device cycle counts per rank)."""
@@ -81,53 +133,9 @@ def _run_group_op(group, op: str, count: int) -> float:
     world = len(group)
 
     def work(i):
-        accl = group[i]
-        n = count
-        if op == "sendrecv":
-            if i == 0:
-                buf = accl.create_buffer_from(np.ones(n, np.float32))
-                req = accl.send(buf, n, dst=1, tag=0, run_async=True)
-            elif i == 1:
-                buf = accl.create_buffer(n, np.float32)
-                req = accl.recv(buf, n, src=0, tag=0, run_async=True)
-            else:
-                return
-        elif op == "bcast":
-            buf = accl.create_buffer_from(np.ones(n, np.float32))
-            req = accl.bcast(buf, n, root=0, run_async=True)
-        elif op == "scatter":
-            send = accl.create_buffer_from(np.ones(world * n, np.float32))
-            recv = accl.create_buffer(n, np.float32)
-            req = accl.scatter(send, recv, n, root=0, run_async=True)
-        elif op == "gather":
-            send = accl.create_buffer_from(np.ones(n, np.float32))
-            recv = accl.create_buffer(world * n, np.float32)
-            req = accl.gather(send, recv, n, root=0, run_async=True)
-        elif op == "allgather":
-            send = accl.create_buffer_from(np.ones(n, np.float32))
-            recv = accl.create_buffer(world * n, np.float32)
-            req = accl.allgather(send, recv, n, run_async=True)
-        elif op == "reduce":
-            send = accl.create_buffer_from(np.ones(n, np.float32))
-            recv = accl.create_buffer(n, np.float32)
-            req = accl.reduce(send, recv, n, root=0, run_async=True)
-        elif op == "reduce_scatter":
-            send = accl.create_buffer_from(np.ones(world * n, np.float32))
-            recv = accl.create_buffer(n, np.float32)
-            req = accl.reduce_scatter(send, recv, n, run_async=True)
-        elif op == "allreduce":
-            send = accl.create_buffer_from(np.ones(n, np.float32))
-            recv = accl.create_buffer(n, np.float32)
-            req = accl.allreduce(send, recv, n, run_async=True)
-        elif op == "alltoall":
-            send = accl.create_buffer_from(np.ones(world * n, np.float32))
-            recv = accl.create_buffer(world * n, np.float32)
-            req = accl.alltoall(send, recv, n, run_async=True)
-        else:
-            raise ValueError(op)
-        assert req.wait(120), f"{op} count={n} rank={i} timed out"
-        req.check()
-        durations[i] = req.get_duration_ns()
+        ns = _rank_op(group[i], i, world, op, count)
+        if ns is not None:
+            durations[i] = ns
 
     errors: List[BaseException] = []
 
@@ -152,6 +160,56 @@ def sweep_group(group, sizes: List[int], collectives: List[str], writer) -> None
         for n in sizes:
             ns = _run_group_op(group, op, n)
             write_row(writer, op, n, n * 4, ns)
+
+
+def _dist_sweep_worker(accl, rank, world):
+    """Per-process body of the dist sweep.  Loaded fresh in each spawned
+    rank via the launcher's (script_path, fn_name) form — this module is
+    file-loaded, so its functions don't survive pickling — with the op
+    list and sizes handed over in ACCL_SWEEP_SPEC (env crosses spawn)."""
+    import json
+
+    spec = json.loads(os.environ["ACCL_SWEEP_SPEC"])
+    # warm-up: the first dist op pays gloo wiring + first-compile, which
+    # would otherwise land entirely in row one's duration
+    warm_s = accl.create_buffer_from(np.ones(16, np.float32))
+    warm_d = accl.create_buffer(16, np.float32)
+    accl.allreduce(warm_s, warm_d, 16)
+    out = []
+    for op in spec["collectives"]:
+        for n in spec["sizes"]:
+            ns = _rank_op(accl, rank, world, op, n)
+            out.append((op, n, ns))
+    return out
+
+
+def sweep_dist(world: int, sizes: List[int], collectives: List[str],
+               writer, base_port: int = 47910) -> None:
+    """Sweep the multi-process dist tier: one OS process per rank over
+    jax.distributed (the deployment shape of real pods), same nine
+    collectives, engine durations gathered to the parent.  The fourth
+    sweep artifact tier next to emulator / xla gang / ops."""
+    import json
+
+    from accl_tpu.launch import launch_processes
+
+    os.environ["ACCL_SWEEP_SPEC"] = json.dumps(
+        {"collectives": list(collectives), "sizes": list(sizes)}
+    )
+    try:
+        results = launch_processes(
+            (os.path.abspath(__file__), "_dist_sweep_worker"),
+            world=world, base_port=base_port, design="xla_dist",
+            timeout=3600.0,
+        )
+    finally:
+        os.environ.pop("ACCL_SWEEP_SPEC", None)
+    for idx in range(len(results[0])):
+        op, n, _ = results[0][idx]
+        ns = max(
+            r[idx][2] for r in results if r[idx][2] is not None
+        )
+        write_row(writer, op, n, n * 4, ns)
 
 
 def sweep_ops(world: int, sizes: List[int], writer, extra_algos=()) -> None:
@@ -231,7 +289,10 @@ def sweep_ops(world: int, sizes: List[int], writer, extra_algos=()) -> None:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--backend", choices=["emulator", "xla", "ops"], default="emulator")
+    ap.add_argument(
+        "--backend", choices=["emulator", "xla", "ops", "dist"],
+        default="emulator",
+    )
     ap.add_argument("--world", type=int, default=4)
     ap.add_argument("--min-exp", type=int, default=4)
     ap.add_argument("--max-exp", type=int, default=19)
@@ -265,6 +326,8 @@ def main(argv=None) -> int:
 
     if args.backend == "ops":
         sweep_ops(args.world, sizes, writer, tuple(args.extra_algos))
+    elif args.backend == "dist":
+        sweep_dist(args.world, sizes, args.collectives, writer)
     else:
         from accl_tpu import core
 

@@ -374,3 +374,24 @@ def test_parse_results_refuses_poisoned_csv(tmp_path):
     )
     with pytest.raises(ValueError, match="sanity ceiling"):
         mod.load(str(bad))
+
+
+def test_sweep_dist_tier_smoke():
+    """The dist sweep tier (one OS process per rank over jax.distributed)
+    produces the same CSV rows as the in-process tiers, with measured —
+    never sentinel — durations."""
+    mod = _load_bench_module("sweep")
+
+    rows = []
+
+    class Writer:
+        def writerow(self, row):
+            rows.append(row)
+
+    mod.sweep_dist(2, [16, 64], ["allreduce", "sendrecv"], Writer(),
+                   base_port=47930)
+    assert [(r["collective"], r["count"]) for r in rows] == [
+        ("allreduce", 16), ("allreduce", 64),
+        ("sendrecv", 16), ("sendrecv", 64),
+    ]
+    assert all(r["duration_ns"] >= 1_000 for r in rows), rows

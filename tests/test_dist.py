@@ -67,15 +67,43 @@ def _dist_worker(accl, rank, world):
     recv.sync_from_device()
     results["allreduce_ring"] = float(recv.data[0])
 
-    # zero-host-copy on this tier too: the collective must not touch the
-    # host between buffer creation and sync_from_device
+    # zero-host-copy on the RENDEZVOUS path: above the eager threshold
+    # the collective must not touch the host between buffer creation and
+    # sync_from_device.  (Eager-domain payloads stage through the host
+    # BY DESIGN — the reference's eager protocol lands in rx bounce
+    # buffers and memcpys out; zero-copy is a rendezvous-path property.)
+    # The guard must be the GLOBAL config, not the thread-local context
+    # manager: the engine executes collectives on its own executor
+    # thread, which a with-block in this thread cannot observe.
     import jax
 
     accl.set_tuning(TuningKey.ALLREDUCE_ALGORITHM, "xla")
-    with jax.transfer_guard("disallow"):
-        accl.allreduce(send, recv, n)
-    recv.sync_from_device()
-    results["allreduce_guarded"] = float(recv.data[0])
+    nr = 16384  # 64 KiB f32 per chunk > the 32 KiB eager threshold
+    rs = accl.create_buffer_from(np.full(nr, float(rank + 1), np.float32))
+    rr = accl.create_buffer(nr, np.float32)
+    es = accl.create_buffer_from(np.full(8, 1.0, np.float32))
+    er = accl.create_buffer(8, np.float32)
+    accl.allreduce(rs, rr, nr)  # warm unguarded: compiles may transfer
+    accl.allreduce(es, er, 8)
+    # "disallow_explicit": the eager path commits via EXPLICIT
+    # device_put (which plain "disallow" permits on purpose), while the
+    # rendezvous path runs only jitted device programs — this level is
+    # the one that separates them
+    jax.config.update("jax_transfer_guard", "disallow_explicit")
+    try:
+        accl.allreduce(rs, rr, nr)  # rendezvous: must stay on device
+        # negative control: an EAGER op host-stages by design, so the
+        # guard must trip on the engine thread — proving the guard can
+        # actually observe it (a vacuous guard would pass both)
+        try:
+            accl.allreduce(es, er, 8)
+            results["eager_guard_tripped"] = False
+        except Exception:
+            results["eager_guard_tripped"] = True
+    finally:
+        jax.config.update("jax_transfer_guard", "allow")
+    rr.sync_from_device()
+    results["allreduce_guarded"] = float(rr.data[0])
     return results
 
 
@@ -95,6 +123,10 @@ def test_dist_two_process_facade(world):
     assert results[1]["p2p"] == 1.0
     for res in results:
         assert res["allreduce_guarded"] == total, res
+        assert res["eager_guard_tripped"], (
+            "eager host-staging did not trip the global transfer guard — "
+            "the rendezvous zero-copy assertion above would be vacuous"
+        )
 
 
 def _subcomm_worker(accl, rank, world):
