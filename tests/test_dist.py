@@ -271,3 +271,36 @@ def test_dist_notfound_transport_error_naming_key_not_learned():
                    "connection refused")
     )
     assert eng._nf_sig is None and not eng._nf_probed
+
+
+def test_dist_bucket_width_and_pad_roundtrip():
+    """Wire-bucket geometry: power-of-two buckets (floor 8), and the
+    device pad/unpad programs are exact inverses for chunked layouts —
+    the edges the bucketed collectives rest on."""
+    import jax
+    import jax.numpy as jnp
+
+    from accl_tpu.backends.dist.engine import (
+        _bucket_width, _pad_chunks_program, _unpad_chunks_program,
+    )
+
+    assert _bucket_width(1) == 8 and _bucket_width(8) == 8
+    assert _bucket_width(9) == 16 and _bucket_width(16) == 16
+    assert _bucket_width(17) == 32 and _bucket_width(2**19) == 2**19
+
+    dev = jax.devices()[0]
+    a = jnp.arange(2 * 5, dtype=jnp.float32)  # 2 chunks of 5 elements
+    padded = _pad_chunks_program(2, 5, 8, None, dev)(a)
+    assert padded.shape == (1, 16)
+    # pad region is zeros (neutral for every reduction before the trim)
+    m = np.asarray(padded).reshape(2, 8)
+    np.testing.assert_array_equal(m[:, 5:], 0.0)
+    out = _unpad_chunks_program(2, 5, 8, dev)(padded)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(a))
+    # exact-bucket count: pure re-layout, no pad
+    b = jnp.arange(16, dtype=jnp.float32)
+    padded_b = _pad_chunks_program(2, 8, 8, None, dev)(b)
+    np.testing.assert_array_equal(
+        np.asarray(_unpad_chunks_program(2, 8, 8, dev)(padded_b)),
+        np.asarray(b),
+    )
