@@ -158,6 +158,11 @@ def _run_group_op(group, op: str, count: int) -> float:
 def sweep_group(group, sizes: List[int], collectives: List[str], writer) -> None:
     for op in collectives:
         for n in sizes:
+            # warm + record the SECOND run: the device tiers jit-compile
+            # per (op, wire shape), and a cold first call would put the
+            # compiler in the table instead of the engine (the reference
+            # records steady-state per-call durations)
+            _run_group_op(group, op, n)
             ns = _run_group_op(group, op, n)
             write_row(writer, op, n, n * 4, ns)
 
@@ -178,6 +183,9 @@ def _dist_sweep_worker(accl, rank, world):
     out = []
     for op in spec["collectives"]:
         for n in spec["sizes"]:
+            # warm + record the second run (steady state, like the
+            # in-process sweeps — see sweep_group)
+            _rank_op(accl, rank, world, op, n)
             ns = _rank_op(accl, rank, world, op, n)
             out.append((op, n, ns))
     return out
