@@ -50,6 +50,32 @@ def default_interpret(interpret: InterpretArg = None):
     return pltpu.InterpretParams()
 
 
+def mosaic_rejects(interpret_resolved, *dtypes) -> bool:
+    """True when ``interpret_resolved`` (the output of
+    :func:`default_interpret`) selects compiled Mosaic and any of
+    ``dtypes`` is float16.  The TPU mosaic dialect has no ``f16``
+    (measured on v5e: the AOT compile rejects the kernel with
+    "Unsupported type in mosaic dialect: 'f16'", and a failed remote
+    compile aborts the whole client session) — so every kernel entry
+    point must reroute to XLA or raise BEFORE ``pallas_call``.  ``None``
+    entries are ignored; the interpreter tier handles f16 fine."""
+    if interpret_resolved:
+        return False
+    f16 = jnp.dtype(jnp.float16)
+    return any(d is not None and jnp.dtype(d) == f16 for d in dtypes)
+
+
+def require_mosaic_dtypes(interpret_resolved, which: str, *dtypes) -> None:
+    """Raise the shared f16 rejection for kernels with no XLA reroute
+    (remote-DMA / fused-compute programs): one message, one rule, every
+    entry point."""
+    if mosaic_rejects(interpret_resolved, *dtypes):
+        raise ValueError(
+            f"float16 operands are not supported by the compiled {which} "
+            "kernel (the TPU mosaic dialect has no f16); use bfloat16"
+        )
+
+
 def pack_lanes(x: jax.Array, min_rows: int = SUBLANES):
     """Flatten ``x`` and pad it into a (rows, LANES) tile-aligned 2-D array.
 

@@ -26,6 +26,7 @@ from ._common import (
     LANES,
     InterpretArg,
     default_interpret,
+    require_mosaic_dtypes,
     sublanes_for,
 )
 
@@ -94,6 +95,8 @@ def alltoall(
         raise ValueError(f"leading dim {n} not divisible by axis size {size}")
     if size == 1:
         return x
+    interp = default_interpret(interpret)
+    require_mosaic_dtypes(interp, "alltoall", x.dtype)
     per_block = n // size
     rest = int(np.prod(x.shape[1:])) if x.ndim > 1 else 1
 
@@ -122,7 +125,7 @@ def alltoall(
         compiler_params=pltpu.CompilerParams(
             has_side_effects=True, collective_id=collective_id
         ),
-        interpret=default_interpret(interpret),
+        interpret=interp,
     )(packed)
     return (
         out.reshape(size, rows * LANES)[:, :m].reshape(x.shape)

@@ -33,8 +33,11 @@ from ._common import (
     ack_gate,
     ack_release,
     default_interpret,
+    require_mosaic_dtypes,
     neighbor_barrier,
 )
+
+
 
 _NEG = -1e30
 
@@ -207,6 +210,8 @@ def ring_attention(
         )
     if T % 8:
         raise ValueError("T_local must be a multiple of 8")
+    require_mosaic_dtypes(default_interpret(interpret), "ring attention",
+                          q.dtype)
     size = lax.axis_size(axis_name)
     scale = 1.0 / (D ** 0.5)  # scale by the *logical* head dim, not padded
 
@@ -638,4 +643,11 @@ def flash_attention(
             f"q/k shapes must match outside the head dim and q heads must "
             f"be a multiple of kv heads, got {q.shape}/{k.shape}"
         )
+    if k.dtype != q.dtype or v.dtype != q.dtype:
+        raise ValueError(
+            f"q/k/v dtypes must match (tiles and accumulators are typed "
+            f"from q), got {q.dtype}/{k.dtype}/{v.dtype}"
+        )
+    require_mosaic_dtypes(default_interpret(interpret), "flash attention",
+                          q.dtype)
     return _flash_vjp(q, k, v, causal, block, interpret)

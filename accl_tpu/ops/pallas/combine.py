@@ -26,6 +26,7 @@ from ._common import (
     InterpretArg,
     block_rows,
     default_interpret,
+    mosaic_rejects,
     pack_lanes,
     unpack_lanes,
 )
@@ -78,6 +79,12 @@ def combine(
     out_dtype = jnp.dtype(out_dtype or a.dtype)
     if accumulate and out_dtype != a.dtype:
         raise ValueError("accumulate=True requires out_dtype == a.dtype")
+    interp = default_interpret(interpret)
+    if mosaic_rejects(interp, a.dtype, out_dtype):
+        # fp16 combines (a reduce_ops lane dtype, reduce_ops.cpp:88-97)
+        # can't lower through Mosaic — same VPU math via XLA instead
+        # (the in-place aliasing perf contract doesn't apply to f16)
+        return op(a, b).astype(out_dtype)
 
     ap, n = pack_lanes(a)
     bp, _ = pack_lanes(b)
@@ -97,6 +104,6 @@ def combine(
         in_specs=[spec, spec],
         out_specs=spec,
         input_output_aliases={0: 0} if accumulate else {},
-        interpret=default_interpret(interpret),
+        interpret=interp,
     )(ap, bp)
     return unpack_lanes(out, n, a.shape)

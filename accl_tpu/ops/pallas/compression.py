@@ -26,6 +26,7 @@ from ._common import (
     InterpretArg,
     block_rows,
     default_interpret,
+    mosaic_rejects,
     pack_lanes,
     unpack_lanes,
 )
@@ -74,15 +75,24 @@ def cast(
     (Note: the Pallas TPU *interpreter* stubs ``prng_random_bits`` to
     zeros, so off-TPU the stochastic path degenerates to truncation —
     randomness is a hardware-tier property.)
+
+    float16 endpoints never reach Mosaic: the TPU mosaic dialect has no
+    ``f16`` (measured on v5e: the AOT compile rejects the kernel, and a
+    failed remote compile aborts the whole client session), so compiled-
+    mode f16 casts ride XLA's convert instead — numerically identical
+    (both round to nearest even), and fp16 is a wire/storage format here,
+    not a compute one.  The interpreter tier still runs the kernel.
     """
     dtype = jnp.dtype(dtype)
+    interp = default_interpret(interpret)
+    if not stochastic and mosaic_rejects(interp, x.dtype, dtype):
+        return x.astype(dtype)
     xp, n = pack_lanes(x)
     rows = xp.shape[0]
     br = block_rows(rows)
     grid = (rows // br,)
     spec = pl.BlockSpec((br, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM)
     out_shape = jax.ShapeDtypeStruct((rows, LANES), dtype)
-    interp = default_interpret(interpret)
 
     if stochastic:
         if x.dtype != jnp.float32 or dtype != jnp.bfloat16:

@@ -31,6 +31,7 @@ from ._common import (
     LANES,
     InterpretArg,
     default_interpret,
+    require_mosaic_dtypes,
     neighbor_barrier,
     pack_lanes,
 )
@@ -84,6 +85,8 @@ def fused_shift(
     if size == 1:
         xp, n = pack_lanes(x)
         return compute(xp).reshape(-1)[:n].reshape(x.shape)
+    interp = default_interpret(interpret)
+    require_mosaic_dtypes(interp, "fused-put", x.dtype)
     xp, n = pack_lanes(x)
     rows = xp.shape[0]
     out = pl.pallas_call(
@@ -99,6 +102,6 @@ def fused_shift(
         compiler_params=pltpu.CompilerParams(
             has_side_effects=True, collective_id=collective_id
         ),
-        interpret=default_interpret(interpret),
+        interpret=interp,
     )(xp)
     return out.reshape(-1)[:n].reshape(x.shape)

@@ -37,6 +37,7 @@ from ._common import (
     ack_gate,
     ack_release,
     default_interpret,
+    require_mosaic_dtypes,
     neighbor_barrier,
     pack_lanes,
     sublanes_for,
@@ -275,6 +276,10 @@ def _allgather_kernel(axis_name, size, num_segments):
 
 
 def _call(kernel, x, out_rows, scratch, collective_id, interpret):
+    interp = default_interpret(interpret)
+    # no XLA reroute here: these are remote-DMA kernels, not math — an
+    # abort-the-session compile failure becomes a usable error
+    require_mosaic_dtypes(interp, "ring collective", x.dtype)
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((out_rows, LANES), x.dtype),
@@ -284,7 +289,7 @@ def _call(kernel, x, out_rows, scratch, collective_id, interpret):
         compiler_params=pltpu.CompilerParams(
             has_side_effects=True, collective_id=collective_id
         ),
-        interpret=default_interpret(interpret),
+        interpret=interp,
     )(x)
 
 
@@ -321,6 +326,9 @@ def ring_allreduce(
     wire = jnp.dtype(wire_dtype) if wire_dtype is not None else None
     if wire is not None and wire == x.dtype:
         wire = None  # no-op compression
+    require_mosaic_dtypes(
+        default_interpret(interpret), "ring allreduce (wire_dtype)", wire
+    )
     xp, n = _pack_ring(x, ndirs * size, num_segments, wire)
     rows = xp.shape[0]
     seg_rows = rows // (ndirs * size * num_segments)

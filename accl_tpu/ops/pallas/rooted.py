@@ -33,26 +33,13 @@ from ...constants import ReduceFunction
 from ._common import (
     LANES,
     InterpretArg,
-    default_interpret,
     neighbor_barrier,
     pack_lanes,
     sublanes_for,
 )
-from .ring import _OPS, _hop, _neighbors, _release, ring_allgather
-
-
-def _call(kernel, x, out_rows, scratch, collective_id, interpret):
-    return pl.pallas_call(
-        kernel,
-        out_shape=jax.ShapeDtypeStruct((out_rows, LANES), x.dtype),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
-        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
-        scratch_shapes=scratch,
-        compiler_params=pltpu.CompilerParams(
-            has_side_effects=True, collective_id=collective_id
-        ),
-        interpret=default_interpret(interpret),
-    )(x)
+# _call carries the shared f16/Mosaic rejection guard — one funnel for
+# every remote-DMA collective entry point in ring.py and here
+from .ring import _OPS, _call, _hop, _neighbors, _release, ring_allgather
 
 
 def _relay_scratch(num_segments, seg_rows, dtype):

@@ -120,6 +120,45 @@ def test_cast_roundtrip(dtype):
     )
 
 
+def test_cast_f16_compiled_mode_rides_xla():
+    """Compiled-mode (interpret=False) f16 casts must never reach Mosaic:
+    the TPU mosaic dialect has no f16 (v5e AOT compile rejects it, and the
+    failed compile aborts the client session — the round-5 chip-tier
+    cascade).  The guard short-circuits to XLA's convert before any Pallas
+    lowering, so this is assertable on every backend."""
+    x = jnp.asarray(np.random.default_rng(3).normal(size=300), jnp.float32)
+    narrow = pk.cast(x, jnp.float16, interpret=False)
+    np.testing.assert_array_equal(
+        np.asarray(narrow), np.asarray(x.astype(jnp.float16))
+    )
+    widened = pk.cast(narrow, jnp.float32, interpret=False)
+    np.testing.assert_array_equal(
+        np.asarray(widened), np.asarray(narrow.astype(jnp.float32))
+    )
+    # combine reroutes the same way (fp16 is a reduce_ops lane dtype)
+    a = jnp.asarray([1.5, 2.25, -3.0], jnp.float16)
+    b = jnp.asarray([0.5, 0.75, 1.0], jnp.float16)
+    out = pk.combine(a, b, interpret=False)
+    assert out.dtype == jnp.float16
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(a + b))
+    # ring kernels reject instead (remote-DMA kernels have no XLA reroute)
+    from accl_tpu.ops.pallas._common import mosaic_rejects
+
+    assert mosaic_rejects(False, jnp.float16)
+    assert mosaic_rejects(False, jnp.float32, "float16")
+    assert not mosaic_rejects(False, jnp.float32, None)
+    assert not mosaic_rejects(pltpu.InterpretParams(), jnp.float16)
+    # mixed q/k/v dtypes can no longer smuggle f16 past the q-dtype guard
+    q = jnp.zeros((1, 1, 8, 32), jnp.bfloat16)
+    kv = jnp.zeros((1, 1, 8, 32), jnp.float16)
+    with pytest.raises(ValueError, match="dtypes must match"):
+        pk.flash_attention(q, kv, kv)
+    with pytest.raises(ValueError, match="use bfloat16"):
+        pk.flash_attention(
+            kv, kv, kv, interpret=False
+        )
+
+
 def test_stochastic_round_unbiased():
     # a value strictly between two bf16 neighbors must round both ways —
     # requires real hardware PRNG: the interpreter stubs prng_random_bits
