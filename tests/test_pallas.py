@@ -176,6 +176,12 @@ def test_stochastic_round_unbiased():
 def test_stochastic_round_interpreter_truncates():
     """Under the interpreter the random bits are zeros: stochastic rounding
     must reduce to truncation toward zero of the low mantissa bits."""
+    if jax.default_backend() == "tpu":
+        # the interpreter is the OFF-chip tier: on the tunnel-attached
+        # chip its per-op dispatch granularity blocks for ~20 min and the
+        # eventual error aborts the client session, cascading ABORTED
+        # through every later test (round-5 chip-tier runs 1-2)
+        pytest.skip("interpreter tier runs off-chip")
     x = jnp.asarray([1.0 + 2.0**-9, -1.0 - 2.0**-9, 2.5], jnp.float32)
     out = pk.cast(
         x, jnp.bfloat16, stochastic=True, seed=0,
