@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .pallas.attention import _mxu_precision
+
 _NEG = -1e30
 
 
@@ -57,6 +59,7 @@ def _attend_single(q, k, v, causal: bool, bq: int, bk: int, t_real: int):
                 qb, kb,
                 (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
+                precision=_mxu_precision(qb.dtype),
             ) * scale  # (bq, bk)
             k_pos = jk * bk + jnp.arange(bk)
             mask = k_pos[None, :] < t_real
@@ -71,6 +74,7 @@ def _attend_single(q, k, v, causal: bool, bq: int, bk: int, t_real: int):
                 p.astype(vb.dtype), vb,
                 (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
+                precision=_mxu_precision(vb.dtype),
             )
             return (m_new, l_new, acc_new), None
 

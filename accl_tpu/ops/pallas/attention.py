@@ -42,6 +42,22 @@ from ._common import (
 _NEG = -1e30
 
 
+def _mxu_precision(dtype):
+    """Dot precision for the attention kernels, from the operand dtype.
+
+    The MXU's DEFAULT precision multiplies f32 operands in ONE bf16 pass
+    (measured on v5e: 1.4e-1 max error on a 128x128 f32 matmul vs 6e-6
+    under HIGHEST) — fine for bf16 training, but it silently downgrades
+    an f32 kernel contract, and the interpreter tier (exact f32) would
+    never show it.  f32 operands therefore request the multi-pass mode;
+    bf16/int8 keep DEFAULT (single pass, already exact for their
+    inputs)."""
+    return (
+        lax.Precision.HIGHEST
+        if jnp.dtype(dtype) == jnp.float32 else None
+    )
+
+
 def _fold(bh, q_ref, k_blk_ref, v_blk_ref, o_acc, m_ref, l_ref, mask, scale):
     """Fold one visiting K/V block into (o, m, l) for batch-head ``bh``.
 
@@ -56,6 +72,7 @@ def _fold(bh, q_ref, k_blk_ref, v_blk_ref, o_acc, m_ref, l_ref, mask, scale):
         q, k_blk,
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
+        precision=_mxu_precision(q.dtype),
     ) * scale
     scores = jnp.where(mask, scores, _NEG)
     m_old = m_ref[bh][:, :1]
@@ -66,6 +83,7 @@ def _fold(bh, q_ref, k_blk_ref, v_blk_ref, o_acc, m_ref, l_ref, mask, scale):
         p.astype(v_blk.dtype), v_blk,
         dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
+        precision=_mxu_precision(v_blk.dtype),
     )
     l_ref[bh] = jnp.broadcast_to(
         l_ref[bh][:, :1] * alpha + p.sum(axis=-1, keepdims=True),
@@ -278,6 +296,7 @@ def _flash_kernel(causal, scale, bq, bk, nkb, t_real, with_lse=False):
                 q, kb,
                 dimension_numbers=(((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
+                precision=_mxu_precision(q.dtype),
             ) * scale
             k_pos = j * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             mask = k_pos < t_real
@@ -292,6 +311,7 @@ def _flash_kernel(causal, scale, bq, bk, nkb, t_real, with_lse=False):
                 p.astype(vb.dtype), vb,
                 dimension_numbers=(((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
+                precision=_mxu_precision(vb.dtype),
             )
             return m_new, l_new, acc_new
 
@@ -431,6 +451,7 @@ def _flash_bwd_dq_kernel(causal, scale, bq, bk, nkb, t_real):
             s = lax.dot_general(
                 q, kb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
+                precision=_mxu_precision(q.dtype),
             ) * scale
             k_pos = j * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             mask = (k_pos < t_real) & (q_pos < t_real)
@@ -442,11 +463,13 @@ def _flash_bwd_dq_kernel(causal, scale, bq, bk, nkb, t_real):
             dp = lax.dot_general(
                 do, vb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
+                precision=_mxu_precision(do.dtype),
             )
             ds = p * (dp - delta) * scale
             return acc + lax.dot_general(
                 ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
+                precision=_mxu_precision(kb.dtype),
             )
 
         hi = jnp.minimum(iq + 1, nkb) if causal else nkb
@@ -480,6 +503,7 @@ def _flash_bwd_dkv_kernel(causal, scale, bq, bk, nq, t_real):
             s = lax.dot_general(
                 qb, kb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
+                precision=_mxu_precision(qb.dtype),
             ) * scale
             q_pos = i * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             mask = (k_pos < t_real) & (q_pos < t_real)
@@ -489,15 +513,18 @@ def _flash_bwd_dkv_kernel(causal, scale, bq, bk, nq, t_real):
             dv = dv + lax.dot_general(
                 p.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
+                precision=_mxu_precision(dob.dtype),
             )
             dp = lax.dot_general(
                 dob, vb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
+                precision=_mxu_precision(dob.dtype),
             )
             ds = p * (dp - delta) * scale
             dk = dk + lax.dot_general(
                 ds.astype(qb.dtype), qb, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
+                precision=_mxu_precision(qb.dtype),
             )
             return dk, dv
 

@@ -26,12 +26,29 @@ from accl_tpu.ops import pallas as pk
 
 pytestmark = pytest.mark.pallas
 
+# Gradient-comparison atol: on real silicon the HIGHEST-precision kernels
+# still disagree with XLA's autodiff by ~1e-4 absolute (different exp
+# approximation + accumulation order; measured max 1.6e-4, mean 3e-6 on
+# v5e) — while the interpreter tier is exact and keeps the tight bound
+# as a regression guard.
+_GRAD_ATOL = 5e-4 if jax.default_backend() == "tpu" else 2e-5
+
 
 def _mesh(n):
     devs = jax.devices()[:n]
     if len(devs) < n:
         pytest.skip(f"needs {n} devices")
     return Mesh(np.array(devs), ("x",))
+
+
+def _interpreter_only():
+    """Tests that force ``pltpu.InterpretParams`` belong to the off-chip
+    tier: on the tunnel-attached chip the interpreter's per-op dispatch
+    granularity blocks for ~20 min and the eventual failure aborts the
+    client session, cascading ABORTED through every later test in the
+    process (round-5 chip-tier runs 1-2)."""
+    if jax.default_backend() == "tpu":
+        pytest.skip("interpreter tier runs off-chip")
 
 
 # ---------------------------------------------------------------------------
@@ -176,12 +193,7 @@ def test_stochastic_round_unbiased():
 def test_stochastic_round_interpreter_truncates():
     """Under the interpreter the random bits are zeros: stochastic rounding
     must reduce to truncation toward zero of the low mantissa bits."""
-    if jax.default_backend() == "tpu":
-        # the interpreter is the OFF-chip tier: on the tunnel-attached
-        # chip its per-op dispatch granularity blocks for ~20 min and the
-        # eventual error aborts the client session, cascading ABORTED
-        # through every later test (round-5 chip-tier runs 1-2)
-        pytest.skip("interpreter tier runs off-chip")
+    _interpreter_only()
     x = jnp.asarray([1.0 + 2.0**-9, -1.0 - 2.0**-9, 2.5], jnp.float32)
     out = pk.cast(
         x, jnp.bfloat16, stochastic=True, seed=0,
@@ -298,6 +310,7 @@ def test_ring_allreduce_race_free(capsys):
     none').  Size 4 with 2 segments so the slot-ack flow-control path
     (ack waits at hop>2, releases through hop 2P-4) actually executes.
     The detector only *prints* findings, so assert on captured stdout."""
+    _interpreter_only()
     mesh = _mesh(4)
     n = 4 * 2 * 8 * 128
     data = jnp.ones((4, n), jnp.float32)
@@ -437,6 +450,7 @@ def test_pallas_ring_attention_race_free(capsys):
     premature-release variant as a write/read race on the comm scratch."""
     from accl_tpu.models.ring_attention import reference_attention
 
+    _interpreter_only()
     if len(jax.devices()) < 5:
         pytest.skip("needs 5 devices")
     # 5 ranks: 4 hops, so BOTH comm slots get reused (gates at hops 3 and
@@ -652,10 +666,14 @@ def test_flash_attention_matches_naive(causal):
     )
     got = pk.flash_attention(q, k, v, causal=causal, block=32)
 
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
-    if causal:
-        s = jnp.where(jnp.tril(jnp.ones((T, T), bool)), s, -1e30)
-    expect = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+    # reference at true-f32 matmul precision: the TPU MXU's DEFAULT
+    # multiplies f32 in one bf16 pass (~1e-1 error), which the 2e-5
+    # comparison against the HIGHEST-precision kernel would expose
+    with jax.default_matmul_precision("highest"):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+        if causal:
+            s = jnp.where(jnp.tril(jnp.ones((T, T), bool)), s, -1e30)
+        expect = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(expect), rtol=2e-5, atol=2e-5
     )
@@ -671,9 +689,10 @@ def test_flash_attention_ragged_and_padded():
         for _ in range(3)
     )
     got = pk.flash_attention(q, k, v, block=16)
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
-    s = jnp.where(jnp.tril(jnp.ones((T, T), bool)), s, -1e30)
-    expect = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+    with jax.default_matmul_precision("highest"):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+        s = jnp.where(jnp.tril(jnp.ones((T, T), bool)), s, -1e30)
+        expect = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(expect), rtol=2e-5, atol=2e-5
     )
@@ -690,9 +709,10 @@ def test_flash_attention_ragged_default_block():
         for _ in range(3)
     )
     got = pk.flash_attention(q, k, v)  # default block=256
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
-    s = jnp.where(jnp.tril(jnp.ones((T, T), bool)), s, -1e30)
-    expect = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+    with jax.default_matmul_precision("highest"):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+        s = jnp.where(jnp.tril(jnp.ones((T, T), bool)), s, -1e30)
+        expect = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(expect), rtol=2e-5, atol=2e-5
     )
@@ -732,10 +752,11 @@ def test_flash_attention_grads_match_naive(causal):
             q, k, v, causal=causal, block=32)),
         argnums=(0, 1, 2),
     )(q, k, v)
-    expect = jax.grad(loss(naive), argnums=(0, 1, 2))(q, k, v)
+    with jax.default_matmul_precision("highest"):
+        expect = jax.grad(loss(naive), argnums=(0, 1, 2))(q, k, v)
     for a, b, name in zip(got, expect, "qkv"):
         np.testing.assert_allclose(
-            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5,
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=_GRAD_ATOL,
             err_msg=f"d{name}",
         )
 
@@ -762,10 +783,11 @@ def test_flash_attention_grads_ragged_and_padded():
         loss(lambda q, k, v: pk.flash_attention(q, k, v, block=16)),
         argnums=(0, 1, 2),
     )(q, k, v)
-    expect = jax.grad(loss(naive), argnums=(0, 1, 2))(q, k, v)
+    with jax.default_matmul_precision("highest"):
+        expect = jax.grad(loss(naive), argnums=(0, 1, 2))(q, k, v)
     for a, b, name in zip(got, expect, "qkv"):
         np.testing.assert_allclose(
-            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5,
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=_GRAD_ATOL,
             err_msg=f"d{name}",
         )
 
@@ -923,8 +945,10 @@ def test_flash_attention_gqa_fwd_and_grads():
         return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), vv)
 
     got = pk.flash_attention(q, k, v, block=32)
+    with jax.default_matmul_precision("highest"):
+        expect = naive(q, k, v)
     np.testing.assert_allclose(
-        np.asarray(got), np.asarray(naive(q, k, v)), rtol=2e-5, atol=2e-5
+        np.asarray(got), np.asarray(expect), rtol=2e-5, atol=2e-5
     )
 
     loss = lambda fn: lambda q, k, v: (fn(q, k, v) ** 2).sum()
@@ -932,11 +956,12 @@ def test_flash_attention_gqa_fwd_and_grads():
         loss(lambda q, k, v: pk.flash_attention(q, k, v, block=32)),
         argnums=(0, 1, 2),
     )(q, k, v)
-    g2 = jax.grad(loss(naive), argnums=(0, 1, 2))(q, k, v)
+    with jax.default_matmul_precision("highest"):
+        g2 = jax.grad(loss(naive), argnums=(0, 1, 2))(q, k, v)
     assert g1[1].shape == (B, Hkv, T, D)  # kv grads at kv-head count
     for a, b, name in zip(g1, g2, "qkv"):
         np.testing.assert_allclose(
-            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5,
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=_GRAD_ATOL,
             err_msg=f"d{name}",
         )
 
