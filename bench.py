@@ -633,6 +633,16 @@ def _try(extras: dict, errors: dict, key: str, fn):
         return val
     except Exception as e:  # noqa: BLE001 - reported, not swallowed
         msg = f"{type(e).__name__}: {e}"
+        if "Ran out of memory" in msg or "Exceeded hbm capacity" in msg:
+            # classify compile-time HBM overflows so the artifact states
+            # the finding, not just an HTTP status (e.g. the T=4096
+            # blockwise train step needs 17.9G of the v5e's 15.75G —
+            # diagnosed 2026-08-01; flash fits because its custom_vjp
+            # saves only (o, lse) per layer)
+            import re as _re
+
+            m = _re.search(r"Used [^.]+\. Exceeded hbm capacity[^.]*\.", msg)
+            msg = f"HBM OOM at compile: {m.group(0) if m else ''} | {msg}"
         errors[key] = msg[:400]
         print(f"bench {key} FAILED: {msg}", file=sys.stderr)
         _checkpoint(extras, errors)

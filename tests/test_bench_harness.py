@@ -60,6 +60,25 @@ def test_try_honors_skip_list(bench):
     assert extras == {"fast_bench": 2.0}
 
 
+def test_try_classifies_hbm_oom(bench):
+    """A compile-time HBM overflow must reach the artifact as a stated
+    finding, not an opaque HTTP status (the T=4096 blockwise train step
+    is a real instance: 17.91G needed vs 15.75G on v5e)."""
+    extras, errors = {}, {}
+
+    def oom():
+        raise RuntimeError(
+            "INTERNAL: http://host/remote_compile: HTTP 500: helper exit 1"
+            " ... XLA:TPU compile permanent error. Ran out of memory in"
+            " memory space hbm. Used 17.91G of 15.75G hbm. Exceeded hbm"
+            " capacity by 2.16G."
+        )
+
+    bench._try(extras, errors, "big_train", oom)
+    assert errors["big_train"].startswith("HBM OOM at compile:")
+    assert "Used 17.91G of 15.75G hbm" in errors["big_train"]
+
+
 def test_checkpoint_records_in_flight_metric(bench, tmp_path):
     ckpt = tmp_path / "ckpt.json"
     bench._CHECKPOINT_PATH = str(ckpt)
