@@ -640,7 +640,7 @@ def flash_attention(
     v: jax.Array,
     causal: bool = True,
     *,
-    block: int = 256,
+    block: int = 512,
     interpret: InterpretArg = None,
 ) -> jax.Array:
     """Local (single-chip) fused attention: ``(B, H, T, D) -> same`` with
@@ -660,7 +660,13 @@ def flash_attention(
 
     K/V live whole in VMEM per (batch*head) grid step — sized for
     serving/training sequence lengths (T <= ~8K at 128 lanes); the ring
-    kernel covers longer sequences across chips."""
+    kernel covers longer sequences across chips.
+
+    ``block=512`` is the measured optimum on v5e at T=4096: vs 256 the
+    forward runs 2.1x faster (40.7 vs 19.6 TFLOPs) and the full T=4096
+    train step gains 6.9 MFU points (62.1% -> 69.0%, A/B on the bench's
+    own step); 1024 regresses (VMEM pressure).  Short sequences clamp
+    the block to T via ``_flash_block``."""
     if k.shape != v.shape:
         raise ValueError(f"k/v shapes must match, got {k.shape}/{v.shape}")
     B, H, T, D = q.shape
