@@ -641,7 +641,11 @@ def _try(extras: dict, errors: dict, key: str, fn):
             # saves only (o, lse) per layer)
             import re as _re
 
-            m = _re.search(r"Used [^.]+\. Exceeded hbm capacity[^.]*\.", msg)
+            m = _re.search(
+                r"Used [\d.]+\w* of [\d.]+\w* hbm"
+                r"(?:\. Exceeded hbm capacity by [\d.]+\w*)?",
+                msg,
+            )
             msg = f"HBM OOM at compile: {m.group(0) if m else ''} | {msg}"
         errors[key] = msg[:400]
         print(f"bench {key} FAILED: {msg}", file=sys.stderr)
