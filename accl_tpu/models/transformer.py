@@ -126,17 +126,18 @@ class TransformerConfig:
     # (dp x ep) by construction.
     ep_extends_dp: bool = False
     # attention lowering: "auto" (default) picks per sequence length and
-    # backend — measured on v5e, the materialized-scores form wins below
-    # ~4K tokens (XLA fuses it well and a fused fold's per-tile softmax
-    # state costs more than the score traffic saves: 61% vs 46% train MFU
-    # at T=1024) while a fused form is the only one that fits above it
-    # (score memory grows as T^2); at/above the crossover auto picks the
-    # Pallas "flash" kernel on TPU (fwd 4368 µs vs blockwise's 8498 at
-    # T=2048) and "blockwise" elsewhere.  "blockwise" forces the XLA
-    # online-softmax tile fold (no (T, T) matrix in HBM, ops/attention.py);
-    # "flash" forces the Pallas kernel — trainable via its custom_vjp
-    # backward kernels (ops/pallas/attention.py); "naive" forces
-    # materialized scores through jax.nn.softmax.
+    # backend — measured on v5e with the block=512 flash kernel: flash
+    # wins the full train step at T=1024 (75.4% vs naive's 69.5% MFU)
+    # and at T=4096 (69.6%; naive OOMs on score residuals there), so
+    # auto picks the Pallas "flash" kernel on TPU from T=1024 up while
+    # its K/V fit VMEM, the XLA "blockwise" fold on other backends, and
+    # the materialized-scores "naive" form only below the crossover
+    # (tiny-T regimes where kernel padding overhead dominates).
+    # "blockwise" forces the XLA online-softmax tile fold (no (T, T)
+    # matrix in HBM, ops/attention.py); "flash" forces the Pallas
+    # kernel — trainable via its custom_vjp backward kernels
+    # (ops/pallas/attention.py); "naive" forces materialized scores
+    # through jax.nn.softmax.
     attention: str = "auto"
 
     def kv_heads(self) -> int:
@@ -482,12 +483,12 @@ def _rope_rotate(x, tables):
     return out.astype(x.dtype)
 
 
-# measured crossover on v5e (see TransformerConfig.attention): BELOW this
-# sequence length a fused fold is slower than XLA's fused naive form, so
-# auto resolves to naive; at/above it score memory/traffic dominates and
-# auto picks a fused form (the Pallas flash kernel on TPU while it fits
-# VMEM, the XLA blockwise fold otherwise)
-_AUTO_FUSED_MIN_T = 4096
+# measured crossover on v5e (see TransformerConfig.attention): with the
+# block=512 flash kernel the fused form wins the full train step from
+# T=1024 up (75.4% vs 69.5% MFU at T=1024; at T=4096 it is the only
+# form that fits HBM), so auto resolves to a fused form at/above this
+# and to naive only below it (tiny-T padding-overhead regime)
+_AUTO_FUSED_MIN_T = 1024
 # flash holds whole K/V (and whole Q/dO in its backward kernels) in VMEM
 # per batch-head: auto uses it only while K+V fit this budget (4 MiB =
 # T 8192 at hd<=128 bf16; the gate scales with the PADDED head dim and

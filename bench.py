@@ -1399,9 +1399,11 @@ def main() -> None:
         lambda: _bench_train_mfu(small=_SMALL or not on_tpu),
     )
     if on_tpu:
-        # the with/without-fusion record: the default "auto" resolves to
-        # naive at the bench's T=1024 (its measured crossover is ~4K), so
-        # the explicit blockwise run is the comparison point
+        # the with/without-fusion record: since the block-512 flash
+        # kernel, "auto" resolves to FLASH at the bench's T=1024 (the
+        # measured crossover moved to 1024: flash 75.4% vs naive 69.5%
+        # train MFU), so the explicit blockwise run is the
+        # without-fusion comparison point
         _try(
             extras, errors, "train_mfu_blockwise",
             lambda: _bench_train_mfu(small=_SMALL, attention="blockwise"),

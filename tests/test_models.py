@@ -1159,7 +1159,7 @@ def test_cp_block_k_honors_attention_contract():
     assert _cp_block_k(8192, "naive") is None
     assert _cp_block_k(8192, "blockwise") == 512
     assert _cp_block_k(8192, "flash") == 512
-    assert _cp_block_k(_AUTO_FUSED_MIN_T - 1024, "auto") is None
+    assert _cp_block_k(_AUTO_FUSED_MIN_T // 2, "auto") is None
     assert _cp_block_k(_AUTO_FUSED_MIN_T, "auto") == 512
     assert _cp_block_k(8, "flash") is None  # tiny shard: nothing to tile
 
