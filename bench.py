@@ -1408,6 +1408,12 @@ def main() -> None:
             extras, errors, "train_mfu_blockwise",
             lambda: _bench_train_mfu(small=_SMALL, attention="blockwise"),
         )
+        # the former default, kept as the third point of the record
+        # (auto measured it until the crossover moved to 1024)
+        _try(
+            extras, errors, "train_mfu_naive",
+            lambda: _bench_train_mfu(small=_SMALL, attention="naive"),
+        )
         # long-context training record (T=4096, where naive's score
         # residuals would OOM): "auto" resolves to the Pallas flash
         # kernel + its custom_vjp backward; blockwise is the XLA
