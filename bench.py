@@ -1427,6 +1427,13 @@ def main() -> None:
                 extras, errors, "train_mfu_t4096_blockwise",
                 lambda: _bench_train_mfu(seq=4096, attention="blockwise"),
             )
+            # 8K-context record: auto->flash exactly fills the VMEM
+            # gate (K+V = 4 MiB at D=128 bf16); batch=1 keeps
+            # tokens/step at the same 8K as every other seq point
+            _try(
+                extras, errors, "train_mfu_t8192",
+                lambda: _bench_train_mfu(seq=8192),
+            )
     _try(extras, errors, "decode_tokens_per_s", _bench_decode_throughput)
 
     _sanitize_extras(extras, errors)
