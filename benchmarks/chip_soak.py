@@ -1,0 +1,116 @@
+"""Real-chip endurance soak: the facade on HBM DeviceBuffers, world=1.
+
+The CPU-tier soaks (tests/test_soak.py, 30-min records in
+BENCH_NOTES.md) prove slot lifecycle over OS processes; this is the
+same discipline on the DEVICE tier — randomized op mix and sizes
+through the gang backend on a real TPU, integrity-checked every
+iteration against numpy, with the rx-accounting dump asserted clean at
+the end (ref stress role: test/host/xrt/src/stress.cpp:24).
+
+Run on a healthy tunnel (chip required)::
+
+    ACCL_SOAK_SECONDS=900 python benchmarks/chip_soak.py
+
+Emits one JSON line: {"iters": N, "ops": M, "seconds": S,
+"ops_per_s": R, "rx_leaks": [...], "device": "..."}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> int:
+    import jax
+
+    if jax.default_backend() != "tpu":
+        print(json.dumps({"error": f"needs a TPU backend, got "
+                          f"{jax.default_backend()}"}))
+        return 2
+    from accl_tpu.core import xla_group
+
+    seconds = float(os.environ.get("ACCL_SOAK_SECONDS", "900"))
+    g = xla_group(1)
+    a = g[0]
+    try:
+        a.set_timeout(180.0)
+        rng = np.random.default_rng(7)
+        # a fixed size set (incl. odd/ragged values) so the gang's
+        # per-(op, shape) programs compile once and the soak then
+        # measures the slot/request lifecycle at cached-dispatch rate,
+        # not the tunnel's compiler (same reasoning as the dist tier's
+        # wire buckets, BENCH_NOTES round 5)
+        sizes = [1, 3, 7, 17, 64, 100, 255, 512, 777, 1024, 2000, 3000,
+                 4095, 4096, 5000, 6001, 8000, 8192, 10000, 12000,
+                 14321, 15000, 16000, 16384]
+        deadline = time.monotonic() + seconds
+        t0 = time.monotonic()
+        iters = 0
+        ops = 0
+        while time.monotonic() < deadline:
+            op = ["allreduce", "bcast", "allgather", "copy",
+                  "combine", "reduce", "alltoall"][int(rng.integers(0, 7))]
+            count = int(sizes[int(rng.integers(0, len(sizes)))])
+            seed_i = int(rng.integers(0, 1 << 31))
+            data = (np.random.default_rng(seed_i)
+                    .standard_normal(count).astype(np.float32))
+            if op == "copy":
+                s = a.create_buffer_from(data)
+                d = a.create_buffer(count, np.float32)
+                a.copy(s, d, count)
+            elif op == "combine":
+                from accl_tpu.constants import ReduceFunction
+
+                s = a.create_buffer_from(data)
+                s2 = a.create_buffer_from(data)
+                d = a.create_buffer(count, np.float32)
+                a.combine(ReduceFunction.SUM, s, s2, d, count)
+                data = data + data
+            elif op == "bcast":
+                d = a.create_buffer_from(data)
+                a.bcast(d, count, root=0)
+            elif op == "reduce":
+                s = a.create_buffer_from(data)
+                d = a.create_buffer(count, np.float32)
+                a.reduce(s, d, count, root=0)
+            elif op == "alltoall":
+                s = a.create_buffer_from(data)
+                d = a.create_buffer(count, np.float32)
+                a.alltoall(s, d, count)
+            elif op == "allgather":
+                s = a.create_buffer_from(data)
+                d = a.create_buffer(count, np.float32)
+                a.allgather(s, d, count)
+            else:
+                s = a.create_buffer_from(data)
+                d = a.create_buffer(count, np.float32)
+                a.allreduce(s, d, count)
+            out = d
+            out.sync_from_device()
+            np.testing.assert_allclose(
+                out.data[:count], data, rtol=1e-5, atol=1e-6
+            )
+            iters += 1
+            ops += 1
+        dt = time.monotonic() - t0
+        rx = a.dump_rx_buffers()
+        leaks = [ln for ln in rx.splitlines()
+                 if "rxbuf" in ln and "IDLE" not in ln]
+        print(json.dumps({
+            "iters": iters, "ops": ops, "seconds": round(dt, 1),
+            "ops_per_s": round(ops / dt, 2), "rx_leaks": leaks,
+            "device": jax.devices()[0].device_kind,
+        }))
+        return 0 if not leaks else 1
+    finally:
+        for x in g:
+            x.deinit()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
