@@ -24,6 +24,10 @@ import time
 
 import numpy as np
 
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
 
 def main() -> int:
     import jax

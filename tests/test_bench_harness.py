@@ -704,3 +704,24 @@ def test_probe_telemetry_never_inherited_from_stash(bench, capsys):
     bench._emit_fallback({}, {}, "killed mid-first-probe")
     r = _capture_json_line(capsys)
     assert "probe_attempts" not in r["extras"]  # honest: never probed
+
+
+def test_chip_soak_requires_tpu(tmp_path):
+    """benchmarks/chip_soak.py must refuse to fake device evidence: on a
+    non-TPU backend it emits an error JSON and a distinct exit code
+    instead of running the soak against the interpreter."""
+    import json
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("ACCL_SOAK_SECONDS", None)
+    root = os.path.join(os.path.dirname(__file__), "..")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "benchmarks", "chip_soak.py")],
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+    assert proc.returncode == 2, proc.stderr[-300:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert "needs a TPU backend" in out["error"]
