@@ -30,6 +30,12 @@ sys.path.insert(
 
 
 def main() -> int:
+    from accl_tpu.utils import mirror_platform_env
+
+    # honor an explicit JAX_PLATFORMS request via the config path — the
+    # env var alone does not stop the site PJRT hook from creating its
+    # client (the tests' cpu-refusal path depends on this)
+    mirror_platform_env()
     import jax
 
     if jax.default_backend() != "tpu":

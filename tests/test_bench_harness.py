@@ -716,7 +716,7 @@ def test_chip_soak_requires_tpu(tmp_path):
 
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
-    env.pop("ACCL_SOAK_SECONDS", None)
+    env["ACCL_SOAK_SECONDS"] = "1"  # belt: even a wrong backend is brief
     root = os.path.join(os.path.dirname(__file__), "..")
     proc = subprocess.run(
         [sys.executable, os.path.join(root, "benchmarks", "chip_soak.py")],
