@@ -48,12 +48,68 @@ class CallOptions:
     cfg_key: int = 0  # tuning register selector for SET_TUNING
 
 
+class InteractionCounter:
+    """Counts *device interactions*: program dispatches and host<->device
+    transfers an engine issues on the data path.  The reference's hostctrl
+    discipline is ONE command per collective (hostctrl.cpp:22-63); on a
+    tunneled host every extra interaction bills a full RTT, so the engines
+    keep an honest running count — exposed via
+    ``ACCL.capabilities()["device_interactions"]`` and asserted by
+    tests/test_dispatch_overhead.py (one collective == one bump on the
+    gang fast path).
+
+    Buffer *creation* (``create_buffer`` staging) is deliberately not
+    counted: the contract covers the collective between creation and
+    sync, matching the zero-host-copy transfer-guard tests.
+
+    Bumps come from every rank thread of a gang (and from deferred
+    adoption running on waiter threads), so the increment is locked —
+    ``+=`` alone is load/add/store and can lose counts across threads,
+    which would break the tests' strict-equality assertions.
+    """
+
+    __slots__ = ("count", "_lock")
+
+    def __init__(self):
+        import threading
+
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def bump(self, n: int = 1) -> None:
+        with self._lock:
+            self.count += n
+
+    def read(self) -> int:
+        return self.count
+
+
 class BaseEngine:
     """One rank's collective engine."""
 
     def start(self, options: CallOptions):
         """Enqueue a call; returns a Request immediately."""
         raise NotImplementedError
+
+    def start_batch(self, items) -> None:
+        """Dispatch a flushed command-queue batch: ``items`` is a list of
+        ``(CallOptions, Request)`` pairs whose Requests were created by
+        the facade at queue time (so ``run_async`` callers already hold
+        them).  Engines that can fuse a batch into one device interaction
+        override this (XLA gang / dist); the default just serializes,
+        bridging each inner engine request onto the caller's."""
+        for options, req in items:
+            inner = self.start(options)
+            inner.add_done_callback(
+                lambda i=inner, r=req: r.complete(
+                    i.get_retcode(), i.get_duration_ns()
+                )
+            )
+
+    def device_interactions(self):
+        """Engine-lifetime device-interaction count, or ``None`` on tiers
+        with no device (emulator/native: the dataplane is host memory)."""
+        return None
 
     def create_buffer(self, count: int, dtype, host_only: bool = False,
                       data=None):

@@ -45,7 +45,7 @@ from ...constants import (
 )
 from ...ops import driver as opdriver
 from ...request import Request
-from ..base import BaseEngine, CallOptions, StreamPortMixin
+from ..base import BaseEngine, CallOptions, InteractionCounter, StreamPortMixin
 from ..xla.engine import (
     IN_W,
     OUT_W,
@@ -96,14 +96,22 @@ def _pad_chunks_program(chunks: int, n: int, nb: int, wire_name, device):
 
 
 @functools.lru_cache(maxsize=1024)
-def _unpad_chunks_program(chunks: int, n: int, nb: int, device):
-    """Inverse edge: (1, chunks*nb) padded wire row -> (chunks*n,)."""
+def _unpad_chunks_program(chunks: int, n: int, nb: int, device,
+                          npdt=None):
+    """Inverse edge: (1, chunks*nb) padded wire row -> (chunks*n,).
+    ``npdt`` fuses the decompress/cast lane into the SAME program (one
+    result-side device interaction instead of the old unpad+cast pair —
+    the single-interaction dispatch discipline applied to this tier's
+    result leg)."""
     from jax.sharding import SingleDeviceSharding
 
-    return jax.jit(
-        lambda a: a.reshape(chunks, nb)[:, :n].reshape(-1),
-        out_shardings=SingleDeviceSharding(device),
-    )
+    def f(a):
+        a = a.reshape(chunks, nb)[:, :n].reshape(-1)
+        if npdt is not None and a.dtype != npdt:
+            a = a.astype(npdt)
+        return a
+
+    return jax.jit(f, out_shardings=SingleDeviceSharding(device))
 
 
 class DistEngine(StreamPortMixin, BaseEngine):
@@ -125,6 +133,7 @@ class DistEngine(StreamPortMixin, BaseEngine):
         self.max_eager_size = 32 * 1024
         self.max_rendezvous_size = MAX_EAGER_SIZE_LIMIT
         self.tuning = {"allreduce_algorithm": "xla", "ring_segments": 1}
+        self.interactions = InteractionCounter()
         self._init_streams()
         # per-port consumed counter for remotely-posted stream chunks
         import threading as _threading
@@ -209,32 +218,72 @@ class DistEngine(StreamPortMixin, BaseEngine):
                 req.complete(ErrorCode.INVALID_OPERATION)
         return req
 
+    def start_batch(self, items) -> None:
+        """A flushed facade batch becomes ONE queue item, so the executor
+        sees the identical batch boundary in every member process (the
+        SPMD contract extended to batches).  Unlike the single-process
+        gang — which sees EVERY rank's buffers centrally and can make one
+        fusion decision for the whole slot — this tier cannot decide
+        fusion SPMD-consistently: the decision would hinge on process-
+        LOCAL buffer aliasing (e.g. a non-root rank legitimately passes a
+        DummyBuffer where the root passes a real one), and divergent
+        fused-vs-sequential choices desynchronize the processes' program
+        streams and wedge the mesh.  So a dist batch executes its items
+        strictly in order; the win here is the facade-side contract
+        (deferred dispatch + one flush point), not program fusion."""
+        try:
+            self._queue.push((
+                [o for o, _ in items], [r for _, r in items]
+            ))
+        except RuntimeError:  # engine shut down
+            for _, req in items:
+                req.mark_executing()
+                req.complete(ErrorCode.INVALID_OPERATION)
+
+    def device_interactions(self) -> int:
+        return self.interactions.read()
+
     def _run(self) -> None:
         while not self._shut:
             item = self._queue.pop(timeout=0.5)
             if item is None:
                 continue  # timeout/spurious wake; re-check shutdown
-            self._execute(*item)
+            if isinstance(item[0], list):
+                self._execute_batch(*item)
+            else:
+                self._execute(*item)
         # drain: abandoned queued requests complete with an error instead
         # of leaving waiters blocked forever
         while True:
             item = self._queue.pop(timeout=0)
             if item is None:
                 return
-            item[1].mark_executing()
-            item[1].complete(ErrorCode.INVALID_OPERATION)
+            reqs = item[1] if isinstance(item[1], list) else [item[1]]
+            for req in reqs:
+                req.mark_executing()
+                req.complete(ErrorCode.INVALID_OPERATION)
 
     def _execute(self, options: CallOptions, req: Request) -> None:
         req.mark_executing()
         t0 = time.perf_counter_ns()
         try:
-            code = self._dispatch(options)
+            code = self._dispatch(options, req)
         except Exception:
             traceback.print_exc()
             code = ErrorCode.INVALID_OPERATION
         req.complete(code, time.perf_counter_ns() - t0)
 
-    def _dispatch(self, options: CallOptions) -> ErrorCode:
+    # -- batched execution ---------------------------------------------------
+    def _execute_batch(self, options_list, reqs) -> None:
+        """Execute one flushed batch strictly in order (see start_batch:
+        cross-process fusion decisions cannot be made SPMD-uniformly on
+        this tier, so the batch boundary is preserved but items run
+        through the ordinary per-call path)."""
+        for options, req in zip(options_list, reqs):
+            self._execute(options, req)
+
+    def _dispatch(self, options: CallOptions,
+                  req: Optional[Request] = None) -> ErrorCode:
         op = options.op
         if op == Operation.CONFIG:
             return self._apply_config(options)
@@ -253,13 +302,14 @@ class DistEngine(StreamPortMixin, BaseEngine):
             # the barrier
             mesh = self._comm_mesh(options.comm)
             shard = _dev_zeros((1, 8), np.float32, self.device)
+            self.interactions.bump(2)  # the zeros shard + barrier psum
             out = opdriver.run_allreduce(
                 self._assemble(options.comm, mesh, shard, 8), mesh
             )
             self._local_shard(out).block_until_ready()
             return ErrorCode.OK
         if op in IN_W:
-            return self._collective(options)
+            return self._collective(options, req)
         return ErrorCode.COLLECTIVE_NOT_IMPLEMENTED
 
     # -- collectives -----------------------------------------------------------
@@ -305,6 +355,7 @@ class DistEngine(StreamPortMixin, BaseEngine):
                 return None
             row = np.asarray(row).astype(npdt)[:in_w]
         elif buf is None or buf.is_dummy:
+            self.interactions.bump()
             return _dev_zeros((1, chunks * nb), npdt, self.device)
         elif isinstance(buf, DeviceBuffer) and buf.device == self.device:
             # eager/rendezvous is decided per CHUNK — the wire message
@@ -315,6 +366,7 @@ class DistEngine(StreamPortMixin, BaseEngine):
                 # tested) — re-layout on device.  The pad program
                 # retraces per exact count, but the expensive collective
                 # program compiles per BUCKET only.
+                self.interactions.bump()
                 return _pad_chunks_program(
                     chunks, n, nb, wire_name, self.device
                 )(buf.device_array())
@@ -325,8 +377,11 @@ class DistEngine(StreamPortMixin, BaseEngine):
             # microseconds and compiles NOTHING per count — the property
             # that lets a soak sweep arbitrary sizes at cached-dispatch
             # speed.
+            self.interactions.bump()  # eager D2H read
             row = np.asarray(buf.device_view()[:in_w]).astype(npdt)
         else:
+            if isinstance(buf, DeviceBuffer):
+                self.interactions.bump()
             row = np.asarray(buf.device_view()[:in_w]).astype(npdt)
         # already host-side: chunk, wire-round, pad in numpy (free), one
         # committed put of the bucket-shaped row
@@ -337,9 +392,11 @@ class DistEngine(StreamPortMixin, BaseEngine):
             m = np.concatenate(
                 [m, np.zeros((chunks, nb - n), npdt)], axis=1
             )
+        self.interactions.bump()  # the committed put
         return jax.device_put(m.reshape(1, chunks * nb), self.device)
 
-    def _collective(self, options: CallOptions) -> ErrorCode:
+    def _collective(self, options: CallOptions,
+                    req: Optional[Request] = None) -> ErrorCode:
         comm = options.comm
         op = options.op
         size = comm.size
@@ -349,7 +406,6 @@ class DistEngine(StreamPortMixin, BaseEngine):
         nb = _bucket_width(n)
         in_chunks = size if IN_W[op] == "P" else 1
         out_chunks = size if OUT_W[op] == "P" else 1
-        out_w = n * out_chunks
         mesh = self._comm_mesh(comm)
         fn = options.reduce_function
         if op in (
@@ -364,6 +420,7 @@ class DistEngine(StreamPortMixin, BaseEngine):
             options.compression & CompressionFlags.ETH_COMPRESSED
         )
 
+        self.interactions.bump()  # the collective program dispatch
         if op == Operation.ALLREDUCE:
             wire = options.arithcfg.compressed if compressed else None
             out = run_allreduce_with_tuning(
@@ -383,6 +440,18 @@ class DistEngine(StreamPortMixin, BaseEngine):
         else:  # pragma: no cover - guarded by IN_W
             return ErrorCode.COLLECTIVE_NOT_IMPLEMENTED
 
+        return self._place_result(options, out, n, nb, out_chunks, req)
+
+    def _place_result(self, options: CallOptions, out, n: int, nb: int,
+                      out_chunks: int, req: Optional[Request]) -> ErrorCode:
+        """Adopt this process's output shard into the result buffer.
+        The rendezvous-domain unpad+cast (one FUSED device program, see
+        ``_unpad_chunks_program``) is parked LAZILY on the buffer/request
+        — materialized at wait()/first data access — so a fire-and-forget
+        chain pays no result-side device interaction at dispatch time."""
+        comm = options.comm
+        op = options.op
+        out_w = n * out_chunks
         # result placement: only ranks the op addresses read their shard
         writes = True
         if op == Operation.REDUCE:
@@ -403,26 +472,41 @@ class DistEngine(StreamPortMixin, BaseEngine):
             isinstance(res, DeviceBuffer) and res.device == self.device
             and n * np.dtype(arr.dtype).itemsize > self.max_eager_size
         ):
-            # rendezvous domain: chunk-trim ON DEVICE (zero-host-copy)
-            arr = _unpad_chunks_program(out_chunks, n, nb, self.device)(arr)
+            # rendezvous domain: chunk-trim + decompress ON DEVICE
+            # (zero-host-copy), one fused program, deferred to the reader
             npdt = dtype_to_numpy(res.dtype)
-            if arr.dtype != npdt:
-                arr = _cast_program(npdt, self.device)(arr)
-            res.store(arr, out_w)
+
+            def adopt(arr=arr, res=res, npdt=npdt, out_w=out_w,
+                      out_chunks=out_chunks, n=n, nb=nb,
+                      ic=self.interactions):
+                trimmed = _unpad_chunks_program(
+                    out_chunks, n, nb, self.device, npdt
+                )(arr)
+                ic.bump()
+                if res.store(trimmed, out_w):
+                    ic.bump()
+
+            res.defer_store(adopt)
+            if req is not None:
+                req.defer_result(res.resolve_pending, handle=arr)
         elif isinstance(res, DeviceBuffer) and res.device == self.device:
             # eager domain: host trim, one committed put (see
             # _operand_shard's eager note)
             host = np.asarray(arr).reshape(out_chunks, nb)[:, :n]
             npdt = dtype_to_numpy(res.dtype)
-            res.store(
+            self.interactions.bump()  # D2H read + H2D put of a tiny row
+            if res.store(
                 jax.device_put(
                     host.reshape(-1).astype(npdt), self.device
                 ),
                 out_w,
-            )
+            ):
+                self.interactions.bump()
         else:
             host = np.asarray(arr).reshape(out_chunks, nb)[:, :n]
-            _write_host_result(res, host.reshape(-1), out_w)
+            _write_host_result(
+                res, host.reshape(-1), out_w, self.interactions
+            )
         return ErrorCode.OK
 
     # -- p2p -------------------------------------------------------------------
@@ -442,6 +526,7 @@ class DistEngine(StreamPortMixin, BaseEngine):
         if options.compression & CompressionFlags.ETH_COMPRESSED:
             # compress lane on the sending chip: the wire carries the
             # narrow dtype (the receiver's zeros shard matches it)
+            self.interactions.bump()
             shard = _cast_program(
                 dtype_to_numpy(options.arithcfg.compressed), self.device
             )(shard)
@@ -461,6 +546,7 @@ class DistEngine(StreamPortMixin, BaseEngine):
         src_dev = self._p2p_devices(options, remote_is_dst=False)
         if src_dev == self.device:
             return ErrorCode.INVALID_RANK
+        self.interactions.bump()
         shard = _dev_zeros((1, nb), npdt, self.device)
         code = self._p2p_run(
             shard, src_dev, self.device, n, nb, recv_into=options
@@ -481,6 +567,7 @@ class DistEngine(StreamPortMixin, BaseEngine):
             NamedSharding(mesh, PartitionSpec("p2p")),
             [local_shard],
         )
+        self.interactions.bump()  # the hop program
         out = prog(global_in)
         arr = self._local_shard(out)
         if recv_into is None:
@@ -498,17 +585,22 @@ class DistEngine(StreamPortMixin, BaseEngine):
             isinstance(res, DeviceBuffer) and res.device == self.device
             and n * np.dtype(arr.dtype).itemsize > self.max_eager_size
         ):
-            arr = _unpad_chunks_program(1, n, nb, self.device)(arr)
+            # fused unpad + decompress: ONE result-side program
             npdt = dtype_to_numpy(res.dtype)
-            if arr.dtype != npdt:
-                arr = _cast_program(npdt, self.device)(arr)
-            res.store(arr, n)
+            self.interactions.bump()
+            arr = _unpad_chunks_program(1, n, nb, self.device, npdt)(arr)
+            if res.store(arr, n):
+                self.interactions.bump()
         elif isinstance(res, DeviceBuffer) and res.device == self.device:
             npdt = dtype_to_numpy(res.dtype)
             host = np.asarray(arr).reshape(-1)[:n].astype(npdt)
-            res.store(jax.device_put(host, self.device), n)
+            self.interactions.bump()
+            if res.store(jax.device_put(host, self.device), n):
+                self.interactions.bump()
         else:
-            _write_host_result(res, np.asarray(arr).reshape(-1)[:n], n)
+            _write_host_result(
+                res, np.asarray(arr).reshape(-1)[:n], n, self.interactions
+            )
         return ErrorCode.OK
 
     # -- remote stream ports over the distributed KV service -------------------

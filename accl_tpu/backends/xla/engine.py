@@ -34,6 +34,10 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 import jax
+
+from ...compat import install as _compat_install
+
+_compat_install()  # legacy-jax shims (shard_map kwargs, lax.axis_size)
 import jax.numpy as jnp
 
 from ...communicator import Communicator
@@ -56,16 +60,20 @@ from ...buffer import (
     make_buffer,
 )
 from ...request import Request
-from ..base import BaseEngine, CallOptions, StreamPortMixin
+from ..base import BaseEngine, CallOptions, InteractionCounter, StreamPortMixin
 from ...ops import driver as opdriver
 
 
-def _np_stack_op0(calls: List[CallOptions], counts: List[int]) -> np.ndarray:
+def _np_stack_op0(
+    calls: List[CallOptions], counts: List[int], ic=None
+) -> np.ndarray:
     """Stack per-rank operands (rank-major) into one (size, n) array."""
     rows = []
     width = max(counts) if counts else 0
     for call, n in zip(calls, counts):
         if call.op0 is not None and not call.op0.is_dummy:
+            if ic is not None and isinstance(call.op0, DeviceBuffer):
+                ic.bump()  # D2H read of the operand (fallback staging)
             row = np.asarray(call.op0.device_view()[:n])
             if row.size < width:
                 row = np.pad(row, (0, width - row.size))
@@ -75,13 +83,15 @@ def _np_stack_op0(calls: List[CallOptions], counts: List[int]) -> np.ndarray:
     return np.stack(rows)
 
 
-def _write_host_result(buf, row, n: int) -> None:
+def _write_host_result(buf, row, n: int, ic=None) -> None:
     """Place a host-computed result row into any buffer type (the fallback
     path's writer; the zero-copy path uses DeviceBuffer.store directly)."""
     if isinstance(buf, DeviceBuffer):
         npdt = dtype_to_numpy(buf.dtype)
         arr = jax.device_put(np.asarray(row)[:n].astype(npdt), buf.device)
-        buf.store(arr, n)
+        dispatched = buf.store(arr, n)
+        if ic is not None:
+            ic.bump(1 + int(dispatched))  # the H2D put (+ writeback)
     else:
         dst = buf.device_view()[:n]
         np.copyto(dst, np.asarray(row)[:n].astype(dst.dtype))
@@ -157,12 +167,16 @@ def _p2p_hop_program(src_dev, dst_dev):
     return mesh, prog
 
 
-def _p2p_device_deliver(payload, res: DeviceBuffer, count: int) -> None:
+def _p2p_device_deliver(payload, res: DeviceBuffer, count: int,
+                        ic=None) -> None:
     """Move a device-resident p2p payload to the receiver's chip with a
     collective-permute and adopt it into the result buffer — no host in
-    the data path."""
+    the data path.  ``ic`` counts each program dispatch (the p2p leg is
+    honestly multi-interaction; the single-interaction contract covers
+    the gang collectives, not the rendezvous hop)."""
     from jax.sharding import NamedSharding, PartitionSpec
 
+    bump = ic.bump if ic is not None else (lambda n=1: None)
     if payload.ndim != 1 or payload.shape[0] < count:
         raise ValueError(
             f"p2p payload of shape {payload.shape} into count {count}"
@@ -173,6 +187,7 @@ def _p2p_device_deliver(payload, res: DeviceBuffer, count: int) -> None:
     if src_dev == dst_dev:
         # self-send: a device-local copy (jit output, distinct array)
         arr = _trim_program(count, dst_dev)(payload)
+        bump()
     else:
         mesh, prog = _p2p_hop_program(src_dev, dst_dev)
         shards = [
@@ -189,10 +204,13 @@ def _p2p_device_deliver(payload, res: DeviceBuffer, count: int) -> None:
             s.data for s in out.addressable_shards if s.device == dst_dev
         )
         arr = _trim_program(count, dst_dev)(arr)
+        bump(4)  # prep + zeros + hop program + trim
     if arr.dtype != res_npdt:
         # wire-compressed payload: decompress lane on the receiving chip
         arr = _cast_program(res_npdt, dst_dev)(arr)
-    res.store(arr, count)
+        bump()
+    if res.store(arr, count):
+        bump()
 
 
 
@@ -211,39 +229,47 @@ OUT_W = {
 }
 
 
-def run_rooted_with_tuning(op, global_arr, mesh, lead, tuning, donate=False):
+def run_rooted_with_tuning(op, global_arr, mesh, lead, tuning, donate=False,
+                           prep=None):
     """Rooted collective with algorithm selection from the tuning
     registers: XLA lowering, or the rooted Pallas ring-relay kernels (the
     algorithm-faithful mode of the reference's rooted trees).  Shared by
-    the single-process gang and the multi-process dist engine."""
+    the single-process gang and the multi-process dist engine.  ``prep``
+    fuses operand staging into the program (opdriver._with_prep)."""
     nseg = int(tuning.get("ring_segments", 1))
     fn = lead.reduce_function
     if op == Operation.REDUCE:
         if tuning.get("reduce_algorithm", "xla") == "pallas_ring":
             return opdriver.run_pallas_reduce(
-                global_arr, mesh, lead.root_dst, fn, nseg
+                global_arr, mesh, lead.root_dst, fn, nseg, prep=prep
             )
-        return opdriver.run_reduce(global_arr, mesh, lead.root_dst, fn)
+        return opdriver.run_reduce(
+            global_arr, mesh, lead.root_dst, fn, prep=prep
+        )
     if op == Operation.BCAST:
         if tuning.get("bcast_algorithm", "xla") == "pallas_ring":
             return opdriver.run_pallas_bcast(
-                global_arr, mesh, lead.root_src, nseg
+                global_arr, mesh, lead.root_src, nseg, prep=prep
             )
         return opdriver.run_bcast(
-            global_arr, mesh, lead.root_src, donate=donate
+            global_arr, mesh, lead.root_src, donate=donate, prep=prep
         )
     if op == Operation.SCATTER:
         if tuning.get("scatter_algorithm", "xla") == "pallas_ring":
             return opdriver.run_pallas_scatter(
-                global_arr, mesh, lead.root_src, nseg
+                global_arr, mesh, lead.root_src, nseg, prep=prep
             )
-        return opdriver.run_scatter(global_arr, mesh, lead.root_src)
+        return opdriver.run_scatter(
+            global_arr, mesh, lead.root_src, prep=prep
+        )
     if op == Operation.GATHER:
         if tuning.get("gather_algorithm", "xla") == "pallas_ring":
             return opdriver.run_pallas_gather(
-                global_arr, mesh, lead.root_src, nseg
+                global_arr, mesh, lead.root_src, nseg, prep=prep
             )
-        return opdriver.run_gather(global_arr, mesh, lead.root_src)
+        return opdriver.run_gather(
+            global_arr, mesh, lead.root_src, prep=prep
+        )
     raise ValueError(op)  # pragma: no cover
 
 
@@ -288,9 +314,11 @@ def apply_tuning(tuning: dict, options) -> ErrorCode:
     return ErrorCode.OK
 
 
-def run_allreduce_with_tuning(global_arr, mesh, fn, wire_dtype, tuning):
+def run_allreduce_with_tuning(global_arr, mesh, fn, wire_dtype, tuning,
+                              prep=None):
     """Allreduce with algorithm + segmentation + wire compression from the
-    tuning registers."""
+    tuning registers; ``prep`` fuses the operand width slice into the
+    program (the wire lane already runs in-program on every algorithm)."""
     algo = tuning.get("allreduce_algorithm", "xla")
     nseg = int(tuning.get("ring_segments", 1))
     bidir = algo == "pallas_ring_bidir"
@@ -300,18 +328,19 @@ def run_allreduce_with_tuning(global_arr, mesh, fn, wire_dtype, tuning):
             # compression lanes run inside the kernel
             return opdriver.run_pallas_allreduce(
                 global_arr, mesh, fn, nseg, wire_dtype=wire_name,
-                bidirectional=bidir,
+                bidirectional=bidir, prep=prep,
             )
         return opdriver.run_compressed_allreduce(
-            global_arr, mesh, fn, wire_dtype=wire_name
+            global_arr, mesh, fn, wire_dtype=wire_name, prep=prep
         )
     if algo == "ring":
-        return opdriver.run_ring_allreduce(global_arr, mesh, fn, nseg)
+        return opdriver.run_ring_allreduce(global_arr, mesh, fn, nseg,
+                                           prep=prep)
     if algo in ("pallas_ring", "pallas_ring_bidir"):
         return opdriver.run_pallas_allreduce(
-            global_arr, mesh, fn, nseg, bidirectional=bidir
+            global_arr, mesh, fn, nseg, bidirectional=bidir, prep=prep
         )
-    return opdriver.run_allreduce(global_arr, mesh, fn)
+    return opdriver.run_allreduce(global_arr, mesh, fn, prep=prep)
 
 
 class _GangSlot:
@@ -344,6 +373,10 @@ class XLAGangContext:
         #   "ring" (explicit ppermute pipeline), "pallas_ring" (the
         #   Pallas remote-DMA kernel)
         self.tuning = {"allreduce_algorithm": "xla", "ring_segments": 1}
+        # device-interaction accounting (single-interaction dispatch):
+        # shared across the gang's rank handles — one collective on the
+        # fast path bumps it exactly once, whatever the world size
+        self.interactions = InteractionCounter()
 
     # -- communicator -> mesh -----------------------------------------------
     def submesh(self, comm: Communicator):
@@ -368,6 +401,23 @@ class XLAGangContext:
 
     # -- gang assembly -------------------------------------------------------
     def submit(self, comm: Communicator, options: CallOptions, request: Request):
+        self._submit_entry(comm, (options, request))
+
+    def submit_batch(
+        self,
+        comm: Communicator,
+        options_list: List[CallOptions],
+        requests: List[Request],
+    ):
+        """A whole flushed command-queue batch as ONE gang event: every
+        rank of the communicator must flush a batch of the same length at
+        the same point of its call sequence (the batched extension of the
+        gang's SPMD ordering contract).  A fully matched batch executes
+        as one fused jitted program — one device interaction for N
+        queued collectives."""
+        self._submit_entry(comm, (list(options_list), list(requests)))
+
+    def _submit_entry(self, comm: Communicator, entry: tuple):
         with self._lock:
             seq_key = (comm.id, comm.local_rank)
             seq = self._seq.get(seq_key, 0)
@@ -379,7 +429,7 @@ class XLAGangContext:
                 slot = _GangSlot(comm.size, self.timeout_s)
                 self._slots[slot_key] = slot
                 arm = True  # exactly one watchdog per slot
-            slot.calls[comm.local_rank] = (options, request)
+            slot.calls[comm.local_rank] = entry
             ready = len(slot.calls) == slot.world
             if ready:
                 del self._slots[slot_key]
@@ -389,6 +439,15 @@ class XLAGangContext:
             self._execute(comm, slot)
         elif arm:
             self._arm_watchdog(slot_key, slot)
+
+    @staticmethod
+    def _slot_requests(slot: "_GangSlot"):
+        """Every request parked in a slot (batch entries hold lists)."""
+        for _, req in slot.calls.values():
+            if isinstance(req, list):
+                yield from req
+            else:
+                yield req
 
     def soft_reset(self) -> None:
         """ref ``ACCL`` soft-reset recovery (accl.cpp:57-89): abandon all
@@ -409,9 +468,23 @@ class XLAGangContext:
         for slot in slots:
             if slot.watchdog is not None:
                 slot.watchdog.cancel()
-            for _, req in slot.calls.values():
-                if not req.test():
+            for req in self._slot_requests(slot):
+                if not req.done():
                     req.complete(ErrorCode.RECEIVE_TIMEOUT)
+
+    def dump_state(self) -> List[str]:
+        """Pending-rendezvous lines for the debug dump: every parked gang
+        slot (a collective some rank posted that never assembled) is a
+        live resource exactly like an occupied reference rx buffer."""
+        lines: List[str] = []
+        with self._lock:
+            for (comm_id, seq), slot in self._slots.items():
+                posted = sorted(slot.calls)
+                lines.append(
+                    f"rxbuf gang-slot comm={comm_id} seq={seq} PENDING "
+                    f"posted_ranks={posted} world={slot.world}"
+                )
+        return lines
 
     def _arm_watchdog(self, slot_key, slot: _GangSlot) -> None:
         def fire():
@@ -420,7 +493,7 @@ class XLAGangContext:
                 if live:
                     del self._slots[slot_key]
             if live:
-                for _, req in slot.calls.values():
+                for req in self._slot_requests(slot):
                     req.complete(ErrorCode.RECEIVE_TIMEOUT)
 
         t = threading.Timer(max(0.01, slot.deadline - time.monotonic()), fire)
@@ -429,17 +502,39 @@ class XLAGangContext:
         t.start()
 
     # -- execution -----------------------------------------------------------
+    @staticmethod
+    def _sig(c: CallOptions) -> tuple:
+        return (
+            c.op, c.count, c.reduce_function, c.root_src, c.root_dst,
+            c.compression,
+        )
+
     def _execute(self, comm: Communicator, slot: _GangSlot) -> None:
+        entries = [slot.calls[r] for r in range(slot.world)]
+        batched = [isinstance(e[0], list) for e in entries]
+        if any(batched) and not all(batched):
+            # one rank flushed a batch where another posted a single call:
+            # the gang sequence is torn — fail the whole slot
+            for req in self._slot_requests(slot):
+                req.complete(ErrorCode.INVALID_OPERATION)
+            return
+        if all(batched) and entries:
+            self._execute_batch(comm, entries)
+            return
+        self._execute_calls(
+            comm, [e[0] for e in entries], [e[1] for e in entries]
+        )
+
+    def _execute_calls(
+        self,
+        comm: Communicator,
+        calls: List[CallOptions],
+        reqs: List[Request],
+    ) -> None:
         t0 = time.perf_counter_ns()
-        calls = [slot.calls[r][0] for r in range(slot.world)]
-        reqs = [slot.calls[r][1] for r in range(slot.world)]
         lead = calls[0]
         try:
-            sig = lambda c: (
-                c.op, c.count, c.reduce_function, c.root_src, c.root_dst,
-                c.compression,
-            )
-            if any(sig(c) != sig(lead) for c in calls[1:]):
+            if any(self._sig(c) != self._sig(lead) for c in calls[1:]):
                 code = ErrorCode.INVALID_OPERATION  # mismatched gang calls
             else:
                 # named range in the xprof timeline (the per-call span the
@@ -447,7 +542,7 @@ class XLAGangContext:
                 with jax.profiler.TraceAnnotation(
                     f"accl::{lead.op.name.lower()}"
                 ):
-                    code = self._run_op(comm, calls, lead)
+                    code = self._run_op(comm, calls, lead, reqs)
         except Exception:
             import traceback
 
@@ -457,8 +552,169 @@ class XLAGangContext:
         for req in reqs:
             req.complete(code, dt)
 
+    # -- batched execution ---------------------------------------------------
+    _BATCH_TUNING_KEYS = (
+        "allreduce_algorithm", "reduce_algorithm", "bcast_algorithm",
+        "scatter_algorithm", "gather_algorithm",
+    )
+
+    def _execute_batch(self, comm: Communicator, entries: List[tuple]) -> None:
+        """Execute a fully matched batch slot: ``entries[r]`` is rank r's
+        ``(options_list, requests_list)``.  The whole batch runs as ONE
+        fused jitted program when every position qualifies for the
+        zero-host-copy device path; otherwise each position executes in
+        order through the ordinary per-call machinery (still correct,
+        just not single-interaction)."""
+        lens = {len(e[0]) for e in entries}
+        if lens != {len(entries[0][0])}:
+            for _, batch_reqs in entries:
+                for req in batch_reqs:
+                    req.complete(ErrorCode.INVALID_OPERATION)
+            return
+        npos = len(entries[0][0])
+        try:
+            # planning is side-effect-free: a False return means "not
+            # fusable", safe to fall back; once dispatch has begun,
+            # _run_batch_fused owns request completion (True) so the
+            # sequential path can never double-execute a position
+            handled = self._run_batch_fused(comm, entries, npos)
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
+            handled = False
+        if handled:
+            return
+        for i in range(npos):
+            self._execute_calls(
+                comm,
+                [e[0][i] for e in entries],
+                [e[1][i] for e in entries],
+            )
+
+    def _run_batch_fused(
+        self, comm: Communicator, entries: List[tuple], npos: int
+    ) -> bool:
+        """Try to run the whole batch as one fused device program (one
+        device interaction for N collectives).  Returns False — having
+        dispatched nothing — when any position disqualifies: non-default
+        tuning algorithms (the fused program composes the plain XLA
+        lowerings), host/mixed operands, streams, or a gang signature
+        mismatch at any position (that position must surface its error
+        through the sequential path)."""
+        mesh = self.submesh(comm)
+        if mesh is None or npos == 0:
+            return False
+        if any(
+            self.tuning.get(k, "xla") != "xla" for k in self._BATCH_TUNING_KEYS
+        ):
+            return False
+        plans = []
+        written: set = set()  # result-buffer roots of earlier positions
+        for i in range(npos):
+            calls = [e[0][i] for e in entries]
+            lead = calls[0]
+            if any(self._sig(c) != self._sig(lead) for c in calls[1:]):
+                return False
+            # (_plan_device_call also enforces the BCAST op0-is-res form)
+            plan = self._plan_device_call(comm, calls, lead, mesh)
+            if plan is None:
+                return False
+            # data-dependency guard: all positions' operands are
+            # assembled BEFORE the single fused dispatch, so a position
+            # reading a buffer an earlier position writes would see the
+            # PRE-batch bytes — only the sequential path orders such
+            # chains; reject fusion (the in-place op0-is-res form of one
+            # position is fine: its own read/write is inside one op)
+            for call in calls:
+                buf = call.op0
+                if (
+                    buf is not None
+                    and not buf.is_dummy
+                    and id(buf._root()) in written
+                ):
+                    return False
+            for r in plan["writers"]:
+                res = calls[r].res
+                if res is not None and not res.is_dummy:
+                    written.add(id(res._root()))
+            plans.append((calls, lead, plan))
+
+        t0 = time.perf_counter_ns()
+        try:
+            return self._dispatch_batch_fused(comm, entries, plans, mesh, t0)
+        except Exception:
+            # dispatch/adoption failed mid-batch: requests already
+            # completed stay completed; the rest fail — NEVER fall back
+            # to sequential re-execution (a collective must not run twice)
+            import traceback
+
+            traceback.print_exc()
+            dt = time.perf_counter_ns() - t0
+            for _, batch_reqs in entries:
+                for req in batch_reqs:
+                    if not req.done():  # side-effect-free engine probe
+                        req.complete(ErrorCode.INVALID_OPERATION, dt)
+            return True
+
+    def _dispatch_batch_fused(
+        self, comm: Communicator, entries, plans, mesh, t0
+    ) -> bool:
+        globals_ = []
+        specs = []
+        for calls, lead, plan in plans:
+            global_arr, prep, _ = self._assemble_flat(calls, plan, mesh)
+            globals_.append(global_arr)
+            op = plan["op"]
+            fn = lead.reduce_function
+            wire_name = (
+                np.dtype(plan["wire_npdt"]).name
+                if plan["wire_npdt"] is not None
+                else None
+            )
+            if op == Operation.ALLREDUCE:
+                if wire_name is not None:
+                    specs.append(
+                        ("compressed_allreduce", fn, wire_name, prep, True)
+                    )
+                else:
+                    specs.append(("allreduce", fn, None, prep, True))
+            elif op == Operation.REDUCE:
+                specs.append(("reduce", fn, lead.root_dst, prep, True))
+            elif op == Operation.BCAST:
+                # non-donating inside a batch: the operand may back other
+                # positions' shards of the same fused program
+                specs.append(("bcast", fn, lead.root_src, prep, True))
+            elif op == Operation.SCATTER:
+                specs.append(("scatter", fn, lead.root_src, prep, True))
+            elif op == Operation.GATHER:
+                specs.append(("gather", fn, lead.root_src, prep, True))
+            elif op == Operation.ALLGATHER:
+                specs.append(("allgather", fn, None, prep, True))
+            elif op == Operation.REDUCE_SCATTER:
+                specs.append(("reduce_scatter", fn, None, prep, True))
+            elif op == Operation.ALLTOALL:
+                specs.append(("alltoall", fn, None, prep, True))
+            else:  # pragma: no cover - _plan_device_call gates on IN_W
+                return False
+
+        self.interactions.bump()  # ONE dispatch for the whole batch
+        with jax.profiler.TraceAnnotation(f"accl::batch[{len(plans)}]"):
+            outs = opdriver.run_batch(globals_, mesh, specs)
+        dt = time.perf_counter_ns() - t0
+        for i, (calls, lead, plan) in enumerate(plans):
+            reqs = [e[1][i] for e in entries]
+            self._adopt_out_shards(outs[i], calls, plan, reqs)
+            for req in reqs:
+                req.complete(ErrorCode.OK, dt)
+        return True
+
     def _run_op(
-        self, comm: Communicator, calls: List[CallOptions], lead: CallOptions
+        self,
+        comm: Communicator,
+        calls: List[CallOptions],
+        lead: CallOptions,
+        reqs: Optional[List[Request]] = None,
     ) -> ErrorCode:
         if lead.op == Operation.BARRIER:
             # gang assembly IS the barrier on this tier: reaching here means
@@ -468,31 +724,22 @@ class XLAGangContext:
             return ErrorCode.OK
         mesh = self.submesh(comm)
         if mesh is not None:
-            code = self._run_op_device(comm, calls, lead, mesh)
+            code = self._run_op_device(comm, calls, lead, mesh, reqs)
             if code is not None:
                 return code
         return self._run_op_host(comm, calls, lead, mesh)
 
     # -- zero-host-copy device path ------------------------------------------
-    def _run_op_device(
+    def _plan_device_call(
         self,
         comm: Communicator,
         calls: List[CallOptions],
         lead: CallOptions,
         mesh,
-    ) -> Optional[ErrorCode]:
-        """Run the collective entirely on device-resident operands.
-
-        Every rank's operand must be a :class:`DeviceBuffer` committed to
-        that rank's mesh device (dummies become on-device zeros); the
-        per-rank arrays are assembled into ONE sharded global array with
-        ``jax.make_array_from_single_device_arrays`` — zero copy — the
-        jitted shard_map program runs over the mesh, and the output shards
-        are adopted back into the result buffers.  The host never touches
-        payload bytes, matching the reference's device-to-device hot path
-        (``accl.cpp:780-826``).  Returns None to fall back to the
-        host-staged path (mixed/host operands, exotic dtypes).
-        """
+    ) -> Optional[dict]:
+        """Validate a gang call for the zero-host-copy path BEFORE any
+        device work; returns the call plan, or None to fall back to the
+        host-staged path (mixed/host operands, exotic dtypes)."""
         op = lead.op
         if op not in IN_W:
             return None
@@ -544,42 +791,91 @@ class XLAGangContext:
         if op == Operation.BCAST and any(
             c.op0 is not c.res for c in calls
         ):
-            # the donating bcast program consumes its operand; only safe for
-            # the facade's in-place form (op0 IS res on every rank)
+            # the device bcast program runs in-place (facade contract:
+            # op0 IS res on every rank); other shapes stage via the host
             return None
+        return {
+            "op": op, "size": size, "in_w": in_w, "out_w": out_w,
+            "devs": devs, "npdt": npdt, "compressed": compressed,
+            "wire_npdt": wire_npdt, "writers": writers,
+        }
 
+    def _assemble_flat(self, calls, plan, mesh) -> tuple:
+        """Assemble the flat 1-D global for a planned device call with as
+        few device interactions as possible.
+
+        Preferred mode (single-interaction dispatch): every rank's shard
+        is its RAW committed HBM array — zero-copy, zero dispatch — at
+        the operands' uniform width ``w >= in_w``; the slice down to the
+        call width and the wire-dtype rounding lane are FUSED into the
+        collective program itself (``prep``), so operand staging never
+        costs a separate device interaction.  Falls back to per-rank prep
+        programs (one dispatch each) only for mixed widths.
+
+        Returns ``(global_arr, prep, raw_bufs)`` where ``prep`` is the
+        (take_w, wire_name) spec for the fused program and ``raw_bufs``
+        is the cache-key buffer list (None when not cacheable).
+        """
         from jax.sharding import NamedSharding, PartitionSpec
 
-        # wire-dtype rounding before the op (the hp_compression lanes);
-        # allreduce keeps this inside its program for a single rounding
+        ic = self.interactions
+        op, size, in_w = plan["op"], plan["size"], plan["in_w"]
+        devs, npdt = plan["devs"], plan["npdt"]
         wire_name = (
-            np.dtype(wire_npdt).name
-            if wire_npdt is not None and op != Operation.ALLREDUCE
+            np.dtype(plan["wire_npdt"]).name
+            if plan["wire_npdt"] is not None and op != Operation.ALLREDUCE
             else None
         )
-        # flat 1-D global: each rank's shard is its raw HBM array whenever
-        # the buffer width matches the call exactly (no per-rank prep
-        # program, the dominant dispatch cost of the old (size, w) layout)
-        shards = []
-        raw_bufs: Optional[list] = []  # root buffers whose _dev went in raw
-        for r, call in enumerate(calls):
+
+        arrs = []
+        for call in calls:
             buf = call.op0
             if buf is None or buf.is_dummy:
-                shards.append(_dev_zeros((in_w,), npdt, devs[r]))
-                raw_bufs = None
-                continue
-            arr = buf.device_array()
-            if (
-                wire_name is None
-                and arr.shape == (in_w,)
-                and getattr(buf, "_parent", None) is None
-            ):
-                shards.append(arr)
-                if raw_bufs is not None:
-                    raw_bufs.append(buf)
+                arrs.append(None)
             else:
-                shards.append(_prep_program(in_w, wire_name, devs[r], True)(arr))
-                raw_bufs = None
+                if buf._parent is not None:
+                    ic.bump()  # child view: slice program dispatch
+                arrs.append(buf.device_array())
+        widths = {a.shape[0] for a in arrs if a is not None}
+        uniform_w = widths.pop() if len(widths) == 1 else None
+
+        shards = []
+        raw_bufs: Optional[list] = []  # root buffers whose _dev went in raw
+        if uniform_w is not None and uniform_w >= in_w:
+            w = uniform_w
+            prep = (
+                (in_w, wire_name)
+                if (w != in_w or wire_name is not None)
+                else None
+            )
+            for r, (call, arr) in enumerate(zip(calls, arrs)):
+                if arr is None:
+                    ic.bump()  # on-device zeros for the dummy operand
+                    shards.append(_dev_zeros((w,), npdt, devs[r]))
+                    raw_bufs = None
+                    continue
+                shards.append(arr)
+                buf = call.op0
+                if raw_bufs is not None and buf._parent is None:
+                    raw_bufs.append(buf)
+                elif buf._parent is not None:
+                    raw_bufs = None
+        else:
+            # mixed widths: per-rank prep programs to the exact call
+            # width (one dispatch each — the legacy staging cost)
+            w = in_w
+            prep = None
+            raw_bufs = None
+            for r, (call, arr) in enumerate(zip(calls, arrs)):
+                if arr is None:
+                    ic.bump()
+                    shards.append(_dev_zeros((in_w,), npdt, devs[r]))
+                else:
+                    ic.bump()
+                    shards.append(
+                        _prep_program(in_w, wire_name, devs[r], True)(arr)
+                    )
+
         # assembled-global reuse: keyed by the BUFFER identities (stable
         # across in-place loops, unlike shard ids), re-validated against
         # each buffer's current _dev; a stale entry is REPLACED under its
@@ -592,10 +888,10 @@ class XLAGangContext:
         global_arr = None
         key = None
         if cacheable:
-            key = (tuple(map(id, raw_bufs)), in_w)
+            key = (tuple(map(id, raw_bufs)), w)
             hit = self._asm_cache.get(key)
             if hit is not None:
-                hit_bufs = [r() for r in hit[2]]
+                hit_bufs = [ref() for ref in hit[2]]
                 if all(
                     b is hb for b, hb in zip(raw_bufs, hit_bufs)
                 ) and all(
@@ -604,7 +900,7 @@ class XLAGangContext:
                     global_arr = hit[0]
         if global_arr is None:
             global_arr = jax.make_array_from_single_device_arrays(
-                (size * in_w,),
+                (size * w,),
                 NamedSharding(mesh, PartitionSpec(opdriver.AXIS)),
                 shards,
             )
@@ -620,16 +916,79 @@ class XLAGangContext:
                     shards,
                     [weakref.ref(b, _evict) for b in raw_bufs],
                 )
+        return global_arr, prep, raw_bufs
+
+    def _adopt_out_shards(self, out, calls, plan, reqs) -> None:
+        """Place output shards into result buffers.  Exact-width root
+        buffers adopt by pointer swap (free); anything needing a
+        writeback/trim program is parked as a LAZY store — the request
+        materializes it on wait()/test(), and any direct buffer access
+        resolves it first — so fire-and-forget chains never pay the
+        result-side device interaction at dispatch time."""
+        devs, writers, out_w = plan["devs"], plan["writers"], plan["out_w"]
+        dev_to_rank = {d: r for r, d in enumerate(devs)}
+        for shard in out.addressable_shards:
+            r = dev_to_rank.get(shard.device)
+            if r is None or r not in writers:
+                continue
+            res = calls[r].res
+            if res is None or res.is_dummy:
+                continue
+            sd = shard.data
+            if res._parent is None and res.count == out_w:
+                res.store(sd, out_w)  # pointer swap — no device program
+                continue
+
+            def adopt(sd=sd, res=res, out_w=out_w, ic=self.interactions):
+                if res.store(sd, out_w):
+                    ic.bump()  # the deferred writeback program
+
+            res.defer_store(adopt)
+            if reqs is not None:
+                reqs[r].defer_result(res.resolve_pending, handle=sd)
+
+    def _run_op_device(
+        self,
+        comm: Communicator,
+        calls: List[CallOptions],
+        lead: CallOptions,
+        mesh,
+        reqs: Optional[List[Request]] = None,
+    ) -> Optional[ErrorCode]:
+        """Run the collective entirely on device-resident operands.
+
+        Every rank's operand must be a :class:`DeviceBuffer` committed to
+        that rank's mesh device (dummies become on-device zeros); the
+        per-rank arrays are assembled into ONE sharded global array with
+        ``jax.make_array_from_single_device_arrays`` — zero copy — the
+        jitted shard_map program (with operand staging FUSED in, see
+        ``_assemble_flat``) runs over the mesh, and the output shards are
+        adopted back into the result buffers lazily.  The host never
+        touches payload bytes, matching the reference's device-to-device
+        hot path (``accl.cpp:780-826``), and the whole call is ONE device
+        interaction — the reference's one-hostctrl-command-per-collective
+        discipline.  Returns None to fall back to the host-staged path.
+        """
+        plan = self._plan_device_call(comm, calls, lead, mesh)
+        if plan is None:
+            return None
+        op = plan["op"]
+        global_arr, prep, raw_bufs = self._assemble_flat(calls, plan, mesh)
 
         fn = lead.reduce_function
+        self.interactions.bump()  # THE dispatch: one fused program
         if op == Operation.ALLREDUCE:
-            wire = lead.arithcfg.compressed if compressed else None
-            out = self._allreduce(global_arr, mesh, fn, wire)
+            wire = lead.arithcfg.compressed if plan["compressed"] else None
+            # allreduce keeps its wire lane inside its own program (a
+            # single rounding); prep carries only the width slice here
+            # (_assemble_flat never sets a prep wire for allreduce)
+            out = self._allreduce(global_arr, mesh, fn, wire, prep=prep)
         elif op in (
             Operation.REDUCE, Operation.BCAST, Operation.SCATTER,
             Operation.GATHER,
         ):
-            if op == Operation.BCAST:
+            donate = op == Operation.BCAST and prep is None
+            if donate:
                 # The donating bcast consumes shard arrays that may also
                 # back cached assembled globals from earlier ops on the
                 # same buffers.  JAX copy-on-donate keeps those entries
@@ -645,32 +1004,26 @@ class XLAGangContext:
                 ]
                 for k in stale:
                     self._asm_cache.pop(k, None)
-            out = self._run_rooted(op, global_arr, mesh, lead, donate=True)
+            out = self._run_rooted(
+                op, global_arr, mesh, lead, donate=donate, prep=prep
+            )
         elif op == Operation.ALLGATHER:
-            out = opdriver.run_allgather(global_arr, mesh)
+            out = opdriver.run_allgather(global_arr, mesh, prep=prep)
         elif op == Operation.REDUCE_SCATTER:
-            out = opdriver.run_reduce_scatter(global_arr, mesh, fn)
+            out = opdriver.run_reduce_scatter(global_arr, mesh, fn, prep=prep)
         elif op == Operation.ALLTOALL:
-            out = opdriver.run_alltoall(global_arr, mesh)
+            out = opdriver.run_alltoall(global_arr, mesh, prep=prep)
         else:  # pragma: no cover - guarded by IN_W
             return None
 
-        dev_to_rank = {d: r for r, d in enumerate(devs)}
-        for shard in out.addressable_shards:
-            r = dev_to_rank.get(shard.device)
-            if r is None or r not in writers:
-                continue
-            res = calls[r].res
-            if res is None or res.is_dummy:
-                continue
-            # flat layout: the (out_w,) shard adopts straight into the
-            # buffer (pointer swap when widths match — no trim program)
-            res.store(shard.data, out_w)
+        self._adopt_out_shards(out, calls, plan, reqs)
         return ErrorCode.OK
 
-    def _run_rooted(self, op, global_arr, mesh, lead, donate=False):
+    def _run_rooted(self, op, global_arr, mesh, lead, donate=False,
+                    prep=None):
         return run_rooted_with_tuning(
-            op, global_arr, mesh, lead, self.tuning, donate=donate
+            op, global_arr, mesh, lead, self.tuning, donate=donate,
+            prep=prep,
         )
 
     # -- host-staged fallback path -------------------------------------------
@@ -695,19 +1048,20 @@ class XLAGangContext:
                 return arr
             return arr.astype(wire_npdt).astype(arr.dtype)
 
+        ic = self.interactions
         if op == Operation.ALLREDUCE:
             # no host-side pre-cast here: the compressed program casts to the
             # requested wire dtype itself (single rounding, on device)
-            stacked = _np_stack_op0(calls, [n] * size)
+            stacked = _np_stack_op0(calls, [n] * size, ic)
             wire = lead.arithcfg.compressed if compressed else None
             out = self._allreduce(stacked, mesh, fn, wire)
             out = np.asarray(out)
             for r, call in enumerate(calls):
-                _write_host_result(call.res, out[r], n)
+                _write_host_result(call.res, out[r], n, ic)
             return ErrorCode.OK
 
         if op == Operation.REDUCE:
-            stacked = wire_cast(_np_stack_op0(calls, [n] * size))
+            stacked = wire_cast(_np_stack_op0(calls, [n] * size, ic))
             out = np.asarray(
                 self._run_rooted(op, stacked, mesh, lead)
                 if mesh is not None
@@ -716,57 +1070,57 @@ class XLAGangContext:
             root = lead.root_dst
             res = calls[root].res
             if res is not None and not res.is_dummy:
-                _write_host_result(res, out[root], n)
+                _write_host_result(res, out[root], n, ic)
             return ErrorCode.OK
 
         if op == Operation.BCAST:
-            stacked = wire_cast(_np_stack_op0(calls, [n] * size))
+            stacked = wire_cast(_np_stack_op0(calls, [n] * size, ic))
             out = np.asarray(
                 self._run_rooted(op, stacked, mesh, lead)
                 if mesh is not None
                 else stacked[lead.root_src][None].repeat(size, 0)
             )
             for r, call in enumerate(calls):
-                _write_host_result(call.res, out[r], n)
+                _write_host_result(call.res, out[r], n, ic)
             return ErrorCode.OK
 
         if op == Operation.ALLGATHER:
-            stacked = wire_cast(_np_stack_op0(calls, [n] * size))
+            stacked = wire_cast(_np_stack_op0(calls, [n] * size, ic))
             out = np.asarray(
                 opdriver.run_allgather(stacked, mesh)
                 if mesh is not None
                 else stacked.reshape(-1)[None].repeat(size, 0)
             )
             for r, call in enumerate(calls):
-                _write_host_result(call.res, out[r], size * n)
+                _write_host_result(call.res, out[r], size * n, ic)
             return ErrorCode.OK
 
         if op == Operation.REDUCE_SCATTER:
-            stacked = wire_cast(_np_stack_op0(calls, [size * n] * size))
+            stacked = wire_cast(_np_stack_op0(calls, [size * n] * size, ic))
             out = np.asarray(
                 opdriver.run_reduce_scatter(stacked, mesh, fn)
                 if mesh is not None
                 else self._host_reduce(stacked, fn).reshape(size, n)
             )
             for r, call in enumerate(calls):
-                _write_host_result(call.res, out[r][:n], n)
+                _write_host_result(call.res, out[r][:n], n, ic)
             return ErrorCode.OK
 
         if op == Operation.SCATTER:
             root = lead.root_src
-            stacked = wire_cast(_np_stack_op0(calls, [size * n] * size))
+            stacked = wire_cast(_np_stack_op0(calls, [size * n] * size, ic))
             out = np.asarray(
                 self._run_rooted(op, stacked, mesh, lead)
                 if mesh is not None
                 else stacked[root].reshape(size, n)
             )
             for r, call in enumerate(calls):
-                _write_host_result(call.res, out[r], n)
+                _write_host_result(call.res, out[r], n, ic)
             return ErrorCode.OK
 
         if op == Operation.GATHER:
             root = lead.root_src
-            stacked = wire_cast(_np_stack_op0(calls, [n] * size))
+            stacked = wire_cast(_np_stack_op0(calls, [n] * size, ic))
             out = np.asarray(
                 self._run_rooted(op, stacked, mesh, lead)
                 if mesh is not None
@@ -774,11 +1128,11 @@ class XLAGangContext:
             )
             res = calls[root].res
             if res is not None and not res.is_dummy:
-                _write_host_result(res, out[root], size * n)
+                _write_host_result(res, out[root], size * n, ic)
             return ErrorCode.OK
 
         if op == Operation.ALLTOALL:
-            stacked = wire_cast(_np_stack_op0(calls, [size * n] * size))
+            stacked = wire_cast(_np_stack_op0(calls, [size * n] * size, ic))
             out = np.asarray(
                 opdriver.run_alltoall(stacked, mesh)
                 if mesh is not None
@@ -787,19 +1141,19 @@ class XLAGangContext:
                 )
             )
             for r, call in enumerate(calls):
-                _write_host_result(call.res, out[r], size * n)
+                _write_host_result(call.res, out[r], size * n, ic)
             return ErrorCode.OK
 
         return ErrorCode.COLLECTIVE_NOT_IMPLEMENTED
 
-    def _allreduce(self, stacked, mesh, fn, wire_dtype):
+    def _allreduce(self, stacked, mesh, fn, wire_dtype, prep=None):
         if mesh is None:
             if wire_dtype is not None:
                 npdt = dtype_to_numpy(wire_dtype)
                 stacked = stacked.astype(npdt).astype(stacked.dtype)
             return self._host_reduce(stacked, fn)[None].repeat(stacked.shape[0], 0)
         return run_allreduce_with_tuning(
-            stacked, mesh, fn, wire_dtype, self.tuning
+            stacked, mesh, fn, wire_dtype, self.tuning, prep=prep
         )
 
     @staticmethod
@@ -832,6 +1186,22 @@ class _P2PChannel:
         self._lock = threading.Lock()
         self._sends: Dict[tuple, list] = {}
         self._recvs: Dict[tuple, list] = {}
+
+    def dump_parked(self) -> list:
+        """Unmatched-post lines for the debug dump (a parked send holds
+        its payload alive — the closest analog of an occupied rx buffer
+        on this tier)."""
+        lines = []
+        with self._lock:
+            for kind, table in (("SEND", self._sends), ("RECV", self._recvs)):
+                for key, entries in table.items():
+                    for _ in entries:
+                        comm_id, tag, src, dst = key
+                        lines.append(
+                            f"rxbuf p2p-{kind} comm={comm_id} tag={tag} "
+                            f"src={src} dst={dst} PARKED"
+                        )
+        return lines
 
     def post_send(self, key, payload, request, timeout_s=None):
         t0 = time.perf_counter_ns()
@@ -933,6 +1303,47 @@ class XLAEngine(StreamPortMixin, BaseEngine):
     def start(self, options: CallOptions) -> Request:
         req = Request(op_name=options.op.name)
         req.mark_executing()
+        self._start_with(options, req)
+        return req
+
+    def start_batch(self, items) -> None:
+        """Dispatch a flushed command-queue batch.  Maximal runs of gang
+        collectives sharing a communicator submit as ONE gang batch event
+        (executed as one fused program when every position qualifies —
+        see ``XLAGangContext._run_batch_fused``); local ops / p2p / config
+        calls break the run and dispatch individually, preserving issue
+        order."""
+        run: list = []
+        run_comm = None
+
+        def flush_run():
+            nonlocal run, run_comm
+            if run:
+                self.gang.submit_batch(
+                    run_comm, [o for o, _ in run], [r for _, r in run]
+                )
+            run, run_comm = [], None
+
+        for options, req in items:
+            req.mark_executing()
+            gang_eligible = (
+                (options.op in IN_W or options.op == Operation.BARRIER)
+                and options.stream == StreamFlags.NO_STREAM
+            )
+            if gang_eligible:
+                if run_comm is not None and options.comm is not run_comm:
+                    flush_run()
+                run_comm = options.comm
+                run.append((options, req))
+            else:
+                flush_run()
+                self._start_with(options, req)
+        flush_run()
+
+    def device_interactions(self) -> int:
+        return self.gang.interactions.read()
+
+    def _start_with(self, options: CallOptions, req: Request) -> None:
         op = options.op
         if op == Operation.CONFIG:
             req.complete(self._apply_config(options))
@@ -968,21 +1379,45 @@ class XLAEngine(StreamPortMixin, BaseEngine):
                 )
             else:
 
-                def sink(payload, call=options):
+                def sink(payload, call=options, req=req):
                     if isinstance(payload, jax.Array) and isinstance(
                         call.res, DeviceBuffer
                     ):
-                        # both ends device-resident: ride the fabric
-                        _p2p_device_deliver(payload, call.res, call.count)
+                        # both ends device-resident: ride the fabric —
+                        # LAZILY.  The hop/trim programs (each a device
+                        # interaction) are parked on the result buffer and
+                        # run at the receiver's wait()/first data access,
+                        # so a fire-and-forget recv chain never pays the
+                        # result RTT at match time.  Shape validation
+                        # stays EAGER so a mismatched pair still fails at
+                        # the channel (INVALID_OPERATION on both sides),
+                        # not at a later wait.
+                        if payload.ndim != 1 or payload.shape[0] < call.count:
+                            raise ValueError(
+                                f"p2p payload of shape {payload.shape} "
+                                f"into count {call.count}"
+                            )
+                        ic = self.gang.interactions
+
+                        def deliver(payload=payload, call=call, ic=ic):
+                            _p2p_device_deliver(
+                                payload, call.res, call.count, ic
+                            )
+
+                        call.res.defer_store(deliver)
+                        req.defer_result(
+                            call.res.resolve_pending, handle=payload
+                        )
                         return
                     if isinstance(payload, jax.Array):
                         payload = np.asarray(payload)  # host-side receiver
-                    _write_host_result(call.res, payload, call.count)
+                    _write_host_result(
+                        call.res, payload, call.count, self.gang.interactions
+                    )
 
             self.p2p.post_recv(key, sink, req, timeout_s=self.timeout_s)
         else:
             self.gang.submit(options.comm, options, req)
-        return req
 
     def _start_send(self, options: CallOptions, req: Request) -> None:
         """SEND with all four operand routings: buffer/local-stream source x
@@ -1009,12 +1444,14 @@ class XLAEngine(StreamPortMixin, BaseEngine):
                 payload = _trim_program(options.count, src_dev)(
                     options.op0.device_array()
                 )
+                self.gang.interactions.bump()  # the payload-copy program
                 if options.compression & CompressionFlags.ETH_COMPRESSED:
                     # compress lane on the sending chip: the wire (and the
                     # ICI hop) carries the narrow dtype
                     payload = _cast_program(
                         dtype_to_numpy(cfg.compressed), src_dev
                     )(payload)
+                    self.gang.interactions.bump()
             else:
                 payload = np.asarray(
                     options.op0.device_view()[: options.count]
@@ -1126,7 +1563,9 @@ class XLAEngine(StreamPortMixin, BaseEngine):
             if options.stream & StreamFlags.RES_STREAM:
                 self._push_stream_result(options, acc)
             else:
-                _write_host_result(options.res, acc, n)
+                _write_host_result(
+                    options.res, acc, n, self.gang.interactions
+                )
             return ErrorCode.OK
         if options.stream & StreamFlags.RES_STREAM:
             src = np.asarray(options.op0.device_view()[:n])
@@ -1166,7 +1605,9 @@ class XLAEngine(StreamPortMixin, BaseEngine):
             res_npdt = dtype_to_numpy(options.res.dtype)
             if out.dtype != res_npdt:
                 out = out.astype(res_npdt)  # cross-dtype copy/combine
-            options.res.store(out, n)
+            self.gang.interactions.bump()  # the eager device compute
+            if options.res.store(out, n):
+                self.gang.interactions.bump()
             return ErrorCode.OK
         src = jnp.asarray(options.op0.device_view()[:n])
         if options.op == Operation.COMBINE:
@@ -1179,7 +1620,9 @@ class XLAEngine(StreamPortMixin, BaseEngine):
                 return ErrorCode.ARITH_ERROR
         else:
             out = src
-        _write_host_result(options.res, np.asarray(out), n)
+        _write_host_result(
+            options.res, np.asarray(out), n, self.gang.interactions
+        )
         return ErrorCode.OK
 
     def _apply_config(self, options: CallOptions) -> ErrorCode:
@@ -1214,6 +1657,33 @@ class XLAEngine(StreamPortMixin, BaseEngine):
         return make_buffer(
             self.device, count, dtype, host_only=host_only, data=data
         )
+
+    def dump_rx_buffers(self) -> str:
+        """Rx-accounting dump for the gang tier (the role of the
+        reference's rx-buffer spare-queue dump, accl.cpp dump_rx_buffers):
+        the live slot state here is parked gang rendezvous slots,
+        unmatched p2p posts, and undrained stream-port chunks.  Lines for
+        occupied state carry the ``rxbuf`` token WITHOUT ``IDLE`` so the
+        soak/stress leak filters (benchmarks/chip_soak.py,
+        tests/test_soak.py) read this tier's dump exactly like the
+        emulator pool's — a clean engine emits no ``rxbuf`` line at all."""
+        lines = [
+            "XLA gang rx state "
+            f"(device={self.device}, "
+            f"device_interactions={self.gang.interactions.read()}):"
+        ]
+        lines += self.gang.dump_state()
+        lines += self.p2p.dump_parked()
+        with self._stream_cv:
+            for sid, chunks in sorted(self._streams.items()):
+                if chunks:
+                    lines.append(
+                        f"rxbuf stream-port {sid} depth={len(chunks)} "
+                        "UNDRAINED"
+                    )
+        if len(lines) == 1:
+            lines.append("all slots IDLE")
+        return "\n".join(lines)
 
     def shutdown(self) -> None:
         pass

@@ -12,6 +12,7 @@ like the reference suite does).
 from __future__ import annotations
 
 import functools
+import threading
 from typing import Optional
 
 import numpy as np
@@ -224,6 +225,19 @@ class DeviceBuffer(BaseBuffer):
         self.device = device
         self._parent = parent
         self._offset = int(offset)
+        # lazy result adoption (single-interaction dispatch): an engine
+        # may park the device program that places a result into this
+        # buffer (writeback/trim — one tunnel RTT each) as a pending
+        # thunk; any data access resolves it first, so fire-and-forget
+        # callers never pay the result leg and readers never see stale
+        # bytes.  Lives on the ROOT buffer (stores write through parents);
+        # the REENTRANT lock makes park/resolve atomic AND ordered: a
+        # concurrent resolver that loses the race blocks until the
+        # winner's thunk has fully landed (so no reader can observe the
+        # pre-store _dev), while the thunk's own store()/device_array()
+        # re-entering resolve_pending on the same thread cannot deadlock.
+        self._pending: Optional[object] = None
+        self._plock = threading.RLock()
         npdt = dtype_to_numpy(dtype)
         self._host = host if host is not None else np.zeros(count, npdt)
         if parent is not None:
@@ -260,20 +274,53 @@ class DeviceBuffer(BaseBuffer):
             buf = buf._parent
         return off
 
+    def defer_store(self, thunk) -> None:
+        """Park a result-placement thunk (engine side).  Chains with any
+        earlier pending store so partial writes land in issue order when
+        the buffer is finally resolved."""
+        root = self._root()
+        with root._plock:
+            prev = root._pending
+            if prev is None:
+                root._pending = thunk
+            else:
+                def chained(prev=prev, thunk=thunk):
+                    prev()
+                    thunk()
+
+                root._pending = chained
+
+    def resolve_pending(self) -> None:
+        """Run any parked result placement (idempotent; re-entrancy safe:
+        the thunk's own ``store()`` sees the slot already cleared).  The
+        thunk runs INSIDE the reentrant lock so a concurrent resolver
+        that loses the swap cannot proceed to read ``_dev`` until the
+        winner's store has landed."""
+        root = self._root()
+        with root._plock:
+            thunk, root._pending = root._pending, None
+            if thunk is not None:
+                thunk()
+
     def device_array(self):
         """The committed ``jax.Array`` (sliced view for child buffers —
         a device-side computation, not a transfer)."""
+        self.resolve_pending()
         root = self._root()
         if root is self:
             return self._dev
         off = self._root_offset()
         return _slice_program(off, off + self._count)(root._dev)
 
-    def store(self, array, count: Optional[int] = None) -> None:
+    def store(self, array, count: Optional[int] = None) -> bool:
         """Engine-side result placement: replace the first ``count`` device
         elements with ``array`` (a jax.Array already on this device).
         Whole-buffer stores on root buffers are free (pointer swap); partial
-        or sliced stores write back with ``.at[...].set``."""
+        or sliced stores write back with ``.at[...].set``.  Returns True
+        when a writeback program was dispatched (a device interaction),
+        False for the free pointer swap — the engines' interaction
+        counters key off this."""
+        self.resolve_pending()
         n = self._count if count is None else int(count)
         if getattr(array, "ndim", 1) != 1 or array.shape[0] < n:
             raise ValueError(
@@ -288,8 +335,9 @@ class DeviceBuffer(BaseBuffer):
         off = self._root_offset()
         if root is self and n == self._count and array.shape[0] == n:
             root._dev = array
-        else:
-            root._dev = _writeback_program(off, n)(root._dev, array)
+            return False
+        root._dev = _writeback_program(off, n)(root._dev, array)
+        return True
 
     # -- data movement ------------------------------------------------------
     def sync_to_device(self) -> None:
@@ -303,9 +351,15 @@ class DeviceBuffer(BaseBuffer):
 
     def free_buffer(self) -> None:
         root = self._root()
-        if root is self and self._dev is not None:
-            self._dev.delete()
-            self._dev = None
+        if root is self:
+            # only the ROOT free drops parked results (they are moot once
+            # the storage dies); freeing a child slice must not discard a
+            # deferred store destined for the root or a sibling slice
+            with root._plock:
+                root._pending = None
+            if self._dev is not None:
+                self._dev.delete()
+                self._dev = None
 
     # -- views --------------------------------------------------------------
     def slice(self, start: int, stop: int) -> "DeviceBuffer":

@@ -64,6 +64,13 @@ class ACCL:
         self._world = Communicator(ranks, local_rank, comm_id=0)
         self._communicators: List[Communicator] = [self._world]
         self._initialized = False
+        # single-interaction batching: while a batch is open, collective
+        # calls queue here and flush() hands them to the engine as ONE
+        # dispatch unit (see CommandQueue / BaseEngine.start_batch).
+        # _batch_depth makes nested batch() contexts safe: only the
+        # outermost exit flushes and closes.
+        self._pending: Optional["CommandQueue"] = None
+        self._batch_depth = 0
         self._initialize(timeout_s, max_eager_size, max_rendezvous_size)
 
     # -- init sequence (ref ACCL::initialize, accl.cpp:1066-1114) ------------
@@ -79,6 +86,7 @@ class ACCL:
         self._initialized = True
 
     def _config(self, fn: ConfigFunction, value: float, key: int = 0) -> None:
+        self.flush()  # config must not overtake queued batch calls
         req = self.engine.start(
             CallOptions(
                 op=Operation.CONFIG,
@@ -231,9 +239,87 @@ class ACCL:
                 flags |= slot
         return flags
 
+    # -- batched dispatch (single-interaction command queue) -----------------
+    def begin_batch(self) -> None:
+        """Open a batch: subsequent calls queue instead of dispatching,
+        until :meth:`flush` (explicit, or automatic on a queued request's
+        ``wait``/a sync call/:meth:`end_batch`).  On the device tiers a
+        flushed batch of N collectives executes as ONE fused program —
+        one device interaction — so a training step that issues its
+        collectives inside ``with accl.batch():`` pays the tunnel RTT
+        once, not N times.  Collective by contract: every rank of the
+        communicator must open/flush batches at the same points of its
+        call sequence (the SPMD ordering contract, extended to batches).
+        """
+        self._batch_depth += 1
+        if self._pending is None:
+            from .request import CommandQueue
+
+            self._pending = CommandQueue()
+
+    def flush(self) -> None:
+        """Dispatch everything queued in the open batch (no-op outside a
+        batch or when empty).  The batch stays open for further calls;
+        :meth:`end_batch` closes it."""
+        q = self._pending
+        if q is None:
+            return
+        items = q.drain()
+        if items:
+            # disarm the auto-flush hooks: once dispatched, a later
+            # wait()/test() on these requests must not flush whatever
+            # UNRELATED batch happens to be open at that point
+            for _, req in items:
+                req._pre_wait = None
+            self.engine.start_batch(items)
+
+    def end_batch(self) -> None:
+        """Close the (outermost) batch: flush queued work and return to
+        immediate dispatch.  Nested ``batch()`` contexts only decrement
+        the depth — the outer batch stays intact."""
+        if self._batch_depth > 1:
+            self._batch_depth -= 1
+            return
+        self._batch_depth = 0
+        self.flush()
+        self._pending = None
+
+    def batch(self):
+        """Context manager form::
+
+            with accl.batch():
+                accl.allreduce(a, b, n, run_async=True)
+                accl.allgather(c, d, n, run_async=True)
+            # exit flushes: both collectives dispatched as one program
+        """
+        import contextlib
+
+        @contextlib.contextmanager
+        def _cm():
+            self.begin_batch()
+            try:
+                yield self
+            finally:
+                self.end_batch()
+
+        return _cm()
+
     def _launch(
         self, options: CallOptions, run_async: bool, context: str
     ) -> Optional[Request]:
+        if self._pending is not None:
+            req = Request(op_name=options.op.name)
+            req._pre_wait = self.flush  # auto-flush when the user waits
+            self._pending.push((options, req))
+            if run_async:
+                return req
+            # a sync call inside a batch flushes the whole run (it cannot
+            # complete before its queued predecessors anyway)
+            self.flush()
+            if not req.wait(timeout=max(60.0, 4 * self._timeout_s)):
+                raise ACCLError(ErrorCode.DEADLOCK_SUSPECTED, context)
+            req.check(context)
+            return req
         req = self.engine.start(options)
         if run_async:
             return req
@@ -802,6 +888,11 @@ class ACCL:
             "streams": True,
             "rendezvous": True,
             "world_size": self._world.size,
+            # engine-lifetime device-interaction count (None on the
+            # device-free tiers): the honest dispatch-cost telemetry of
+            # the single-interaction contract — one collective on the
+            # gang fast path moves this by exactly 1
+            "device_interactions": self.engine.device_interactions(),
         }
         # platform only when a jax BACKEND is already initialized: first
         # backend discovery is a side effect a read-only report must not
@@ -819,6 +910,7 @@ class ACCL:
 
     def deinit(self) -> None:
         if self._initialized:
+            self.end_batch()  # queued work must not die with the handle
             self.engine.shutdown()
             self._initialized = False
 

@@ -16,6 +16,10 @@ from __future__ import annotations
 import numpy as np
 
 import jax
+
+from ..compat import install as _compat_install
+
+_compat_install()  # legacy-jax shims (shard_map kwargs, lax.axis_size)
 import jax.numpy as jnp
 
 from ..backends.base import CallOptions

@@ -25,6 +25,10 @@ from functools import partial
 from typing import Dict, Optional
 
 import jax
+
+from ..compat import install as _compat_install
+
+_compat_install()  # legacy-jax shims (shard_map kwargs, lax.axis_size)
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -498,6 +502,15 @@ _AUTO_FLASH_KV_BYTES = 4 * 2**20
 
 
 def _auto_flash_fits(q) -> bool:
+    import jax.numpy as jnp
+
+    if q.dtype == jnp.float16:
+        # Mosaic's TPU lowering rejects f16 matmul operands (ValueError
+        # at compile, observed as a session abort on the chip tier), so
+        # auto must never route f16 into the flash kernel — it falls
+        # through to the XLA blockwise fold instead.  Explicit
+        # attention="flash" still surfaces the kernel's own f16 error.
+        return False
     Dp = -(-q.shape[-1] // 128) * 128  # lane-padded head dim
     return 2 * q.shape[2] * Dp * q.dtype.itemsize <= _AUTO_FLASH_KV_BYTES
 

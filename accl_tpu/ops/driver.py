@@ -13,6 +13,10 @@ from functools import lru_cache
 from typing import Optional
 
 import jax
+
+from ..compat import install as _compat_install
+
+_compat_install()  # legacy-jax shims (shard_map kwargs, lax.axis_size)
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -51,17 +55,9 @@ def _smap(mesh: Mesh, fn, in_spec, out_spec, donate: bool = False):
     )
 
 
-@lru_cache(maxsize=256)
-def _program(op: str, mesh_id: int, fn: ReduceFunction, extra=None,
-             flat: bool = False):
-    """``flat=False``: operands/results are (size, w) stacked arrays (the
-    host/test convention).  ``flat=True``: 1-D (size*w,) globals whose
-    per-rank shards ARE raw (w,) device arrays — the engine's zero-dispatch
-    path (a rank's HBM buffer plugs in as a shard with no reshape program,
-    and result shards adopt straight into buffers)."""
-    mesh = _MESHES[mesh_id]
-    spec = P(AXIS)
-
+def _shard_fn(op: str, fn: ReduceFunction, extra=None):
+    """Per-shard collective body for ``op`` — the building block both the
+    single-op programs and the fused batch programs are traced from."""
     if op == "allreduce":
         sfn = lambda x: collectives.allreduce(x, AXIS, fn)
     elif op == "ring_allreduce":
@@ -109,8 +105,79 @@ def _program(op: str, mesh_id: int, fn: ReduceFunction, extra=None,
         sfn = lambda x: collectives.alltoall(x, AXIS)
     else:
         raise ValueError(op)
-    body = sfn if flat else (lambda x: sfn(x[0])[None])
+    return sfn
+
+
+def _with_prep(sfn, prep):
+    """Fuse operand staging INTO the collective body (single-interaction
+    dispatch): ``prep = (take_w, wire_name)`` slices a rank's raw (w,)
+    HBM shard down to the call width and applies the wire-dtype rounding
+    lane inside the SAME program, so a width-slack or compressed operand
+    costs no separate staging dispatch (the old ``_prep_program`` hop)."""
+    if prep is None:
+        return sfn
+    take_w, wire_name = prep
+
+    def fused(x):
+        if take_w is not None and take_w != x.shape[0]:
+            x = x[:take_w]
+        if wire_name is not None:
+            x = x.astype(jnp.dtype(wire_name)).astype(x.dtype)
+        return sfn(x)
+
+    return fused
+
+
+@lru_cache(maxsize=256)
+def _program(op: str, mesh_id: int, fn: ReduceFunction, extra=None,
+             flat: bool = False, prep=None):
+    """``flat=False``: operands/results are (size, w) stacked arrays (the
+    host/test convention).  ``flat=True``: 1-D (size*w,) globals whose
+    per-rank shards ARE raw (w,) device arrays — the engine's zero-dispatch
+    path (a rank's HBM buffer plugs in as a shard with no reshape program,
+    and result shards adopt straight into buffers).  ``prep`` (flat only)
+    fuses per-shard staging into the program — see :func:`_with_prep`."""
+    mesh = _MESHES[mesh_id]
+    spec = P(AXIS)
+    sfn = _shard_fn(op, fn, extra)
+    if flat:
+        body = _with_prep(sfn, prep)
+    else:
+        body = lambda x: sfn(x[0])[None]
     return _smap(mesh, body, (spec,), spec, donate=op == "bcast_inplace")
+
+
+@lru_cache(maxsize=128)
+def _batch_program(mesh_id: int, specs: tuple):
+    """ONE jitted shard_map over a whole flushed command-queue batch:
+    ``specs`` is a tuple of per-slot ``(op, fn, extra, prep, flat)``
+    records; the program takes one global per slot and returns one output
+    per slot.  N queued collectives therefore dispatch as a single device
+    interaction — the batched analog of the reference's one-command-per-
+    collective hostctrl discipline, amortized N:1."""
+    mesh = _MESHES[mesh_id]
+    spec = P(AXIS)
+    bodies = []
+    for op, fn, extra, prep, flat in specs:
+        sfn = _shard_fn(op, fn, extra)
+        if flat:
+            bodies.append(_with_prep(sfn, prep))
+        else:
+            bodies.append(lambda x, sfn=sfn: sfn(x[0])[None])
+
+    def body(*xs):
+        return tuple(b(x) for b, x in zip(bodies, xs))
+
+    n = len(specs)
+    return _smap(mesh, body, (spec,) * n, (spec,) * n)
+
+
+def run_batch(globals_, mesh: Mesh, specs) -> tuple:
+    """Run a flushed batch: one global array per spec, one fused program,
+    one dispatch.  ``specs`` as in :func:`_batch_program`."""
+    return _batch_program(_mesh_key(mesh), tuple(specs))(
+        *[_put(g, mesh) for g in globals_]
+    )
 
 
 _MESHES = {}
@@ -134,22 +201,26 @@ def _is_flat(stacked) -> bool:
     return getattr(stacked, "ndim", 2) == 1
 
 
-def run_allreduce(stacked, mesh: Mesh, function=ReduceFunction.SUM):
+def run_allreduce(stacked, mesh: Mesh, function=ReduceFunction.SUM,
+                  prep=None):
     """stacked[r] = rank r's operand; returns stacked results (identical
     rows).  One XLA all-reduce over the mesh axis.  A 1-D operand selects
-    the flat layout (shards are raw per-rank arrays; see _program)."""
+    the flat layout (shards are raw per-rank arrays; see _program);
+    ``prep`` fuses per-shard staging into the program (_with_prep)."""
     return _program(
-        "allreduce", _mesh_key(mesh), function, flat=_is_flat(stacked)
+        "allreduce", _mesh_key(mesh), function, flat=_is_flat(stacked),
+        prep=prep,
     )(_put(stacked, mesh))
 
 
 def run_ring_allreduce(
-    stacked, mesh: Mesh, function=ReduceFunction.SUM, num_segments: int = 1
+    stacked, mesh: Mesh, function=ReduceFunction.SUM, num_segments: int = 1,
+    prep=None,
 ):
     """The explicit segmented-ring pipeline (algorithm-faithful mode)."""
     return _program(
         "ring_allreduce", _mesh_key(mesh), function, num_segments,
-        flat=_is_flat(stacked),
+        flat=_is_flat(stacked), prep=prep,
     )(_put(stacked, mesh))
 
 
@@ -160,6 +231,7 @@ def run_pallas_allreduce(
     num_segments: int = 1,
     wire_dtype: str = None,
     bidirectional: bool = False,
+    prep=None,
 ):
     """The segmented ring as a single Pallas kernel: remote-DMA hops over
     ICI with slot-ack flow control (interpreted off-TPU).  ``wire_dtype``
@@ -170,102 +242,113 @@ def run_pallas_allreduce(
     return _program(
         "pallas_allreduce", _mesh_key(mesh), function,
         (num_segments, wire_dtype, bool(bidirectional)),
-        flat=_is_flat(stacked),
+        flat=_is_flat(stacked), prep=prep,
     )(_put(stacked, mesh))
 
 
 def run_compressed_allreduce(
-    stacked, mesh: Mesh, function=ReduceFunction.SUM, wire_dtype: str = "bfloat16"
+    stacked, mesh: Mesh, function=ReduceFunction.SUM,
+    wire_dtype: str = "bfloat16", prep=None,
 ):
     """Allreduce with operands narrowed to ``wire_dtype`` on the wire (the
     ETH_COMPRESSED analog); ``wire_dtype`` is a dtype name string so it can
     key the program cache."""
     return _program(
         "compressed_allreduce", _mesh_key(mesh), function, str(wire_dtype),
-        flat=_is_flat(stacked),
+        flat=_is_flat(stacked), prep=prep,
     )(_put(stacked, mesh))
 
 
-def run_reduce(stacked, mesh: Mesh, root=0, function=ReduceFunction.SUM):
+def run_reduce(stacked, mesh: Mesh, root=0, function=ReduceFunction.SUM,
+               prep=None):
     return _program(
-        "reduce", _mesh_key(mesh), function, root, flat=_is_flat(stacked)
+        "reduce", _mesh_key(mesh), function, root, flat=_is_flat(stacked),
+        prep=prep,
     )(_put(stacked, mesh))
 
 
 def run_pallas_reduce(
     stacked, mesh: Mesh, root=0, function=ReduceFunction.SUM,
-    num_segments: int = 1,
+    num_segments: int = 1, prep=None,
 ):
     """Reduce-to-root as the rooted Pallas ring pipeline (algorithm-
     faithful mode; only the root row of the result is meaningful)."""
     return _program(
         "pallas_reduce", _mesh_key(mesh), function, (root, num_segments),
-        flat=_is_flat(stacked),
+        flat=_is_flat(stacked), prep=prep,
     )(_put(stacked, mesh))
 
 
-def run_pallas_bcast(stacked, mesh: Mesh, root=0, num_segments: int = 1):
+def run_pallas_bcast(stacked, mesh: Mesh, root=0, num_segments: int = 1,
+                     prep=None):
     return _program(
         "pallas_bcast", _mesh_key(mesh), ReduceFunction.SUM,
-        (root, num_segments), flat=_is_flat(stacked),
+        (root, num_segments), flat=_is_flat(stacked), prep=prep,
     )(_put(stacked, mesh))
 
 
-def run_pallas_scatter(stacked, mesh: Mesh, root=0, num_segments: int = 1):
+def run_pallas_scatter(stacked, mesh: Mesh, root=0, num_segments: int = 1,
+                       prep=None):
     return _program(
         "pallas_scatter", _mesh_key(mesh), ReduceFunction.SUM,
-        (root, num_segments), flat=_is_flat(stacked),
+        (root, num_segments), flat=_is_flat(stacked), prep=prep,
     )(_put(stacked, mesh))
 
 
-def run_pallas_gather(stacked, mesh: Mesh, root=0, num_segments: int = 1):
+def run_pallas_gather(stacked, mesh: Mesh, root=0, num_segments: int = 1,
+                      prep=None):
     """Gather via the ring relay (every row holds the full gather; the
     root's row is the result)."""
     return _program(
         "pallas_gather", _mesh_key(mesh), ReduceFunction.SUM,
-        (root, num_segments), flat=_is_flat(stacked),
+        (root, num_segments), flat=_is_flat(stacked), prep=prep,
     )(_put(stacked, mesh))
 
 
-def run_reduce_scatter(stacked, mesh: Mesh, function=ReduceFunction.SUM):
+def run_reduce_scatter(stacked, mesh: Mesh, function=ReduceFunction.SUM,
+                       prep=None):
     return _program(
-        "reduce_scatter", _mesh_key(mesh), function, flat=_is_flat(stacked)
+        "reduce_scatter", _mesh_key(mesh), function, flat=_is_flat(stacked),
+        prep=prep,
     )(_put(stacked, mesh))
 
 
-def run_allgather(stacked, mesh: Mesh):
+def run_allgather(stacked, mesh: Mesh, prep=None):
     return _program(
         "allgather", _mesh_key(mesh), ReduceFunction.SUM,
-        flat=_is_flat(stacked),
+        flat=_is_flat(stacked), prep=prep,
     )(_put(stacked, mesh))
 
 
-def run_bcast(stacked, mesh: Mesh, root=0, donate: bool = False):
+def run_bcast(stacked, mesh: Mesh, root=0, donate: bool = False,
+              prep=None):
     """``donate=True`` hands the input's HBM to XLA (in-place bcast); only
-    safe when the caller no longer needs the input array."""
-    op = "bcast_inplace" if donate else "bcast"
+    safe when the caller no longer needs the input array — never combined
+    with ``prep`` width slack (the donated operand outlives the sliced
+    result, so callers pass donate=False when prep is active)."""
+    op = "bcast_inplace" if donate and prep is None else "bcast"
     return _program(
         op, _mesh_key(mesh), ReduceFunction.SUM, root,
-        flat=_is_flat(stacked),
+        flat=_is_flat(stacked), prep=prep,
     )(_put(stacked, mesh))
 
 
-def run_scatter(stacked, mesh: Mesh, root=0):
+def run_scatter(stacked, mesh: Mesh, root=0, prep=None):
     return _program(
         "scatter", _mesh_key(mesh), ReduceFunction.SUM, root,
-        flat=_is_flat(stacked),
+        flat=_is_flat(stacked), prep=prep,
     )(_put(stacked, mesh))
 
 
-def run_gather(stacked, mesh: Mesh, root=0):
+def run_gather(stacked, mesh: Mesh, root=0, prep=None):
     return _program(
         "gather", _mesh_key(mesh), ReduceFunction.SUM, root,
-        flat=_is_flat(stacked),
+        flat=_is_flat(stacked), prep=prep,
     )(_put(stacked, mesh))
 
 
-def run_alltoall(stacked, mesh: Mesh):
+def run_alltoall(stacked, mesh: Mesh, prep=None):
     return _program(
         "alltoall", _mesh_key(mesh), ReduceFunction.SUM,
-        flat=_is_flat(stacked),
+        flat=_is_flat(stacked), prep=prep,
     )(_put(stacked, mesh))

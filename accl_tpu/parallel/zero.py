@@ -27,6 +27,10 @@ from functools import partial
 from typing import Dict, NamedTuple, Optional
 
 import jax
+
+from ..compat import install as _compat_install
+
+_compat_install()  # legacy-jax shims (shard_map kwargs, lax.axis_size)
 import jax.numpy as jnp
 import numpy as np
 from jax import lax

@@ -6,6 +6,15 @@ Role model: ``driver/xrt/include/accl/acclrequest.hpp`` — ``BaseRequest``
 operations onto the single offload engine.  Here requests are completed by the
 backend's engine thread(s); ``wait``/``test`` expose the same non-blocking /
 blocking surface.
+
+Single-interaction dispatch additions: a request may complete with an
+*unresolved device handle* — the engine parks the result-adoption work
+(writeback/trim programs, each a device interaction billing a tunnel RTT)
+as a deferred resolver that runs on the first ``wait()``/``test()``/
+``check()``, so fire-and-forget and ``run_async`` chains never pay the
+result leg at dispatch time.  ``CommandQueue`` doubles as the facade's
+batch holder: queued calls ``drain()`` as one flush unit that the device
+engines dispatch as a single fused program.
 """
 
 from __future__ import annotations
@@ -13,7 +22,7 @@ from __future__ import annotations
 import enum
 import itertools
 import threading
-from typing import Any, Optional
+from typing import Any, Callable, List, Optional
 
 from .constants import ACCLError, ErrorCode
 
@@ -37,6 +46,16 @@ class Request:
         self._duration_ns: int = 0
         # backend-private payload (e.g. the engine call record)
         self.payload: Any = None
+        # lazy-adoption state: the unresolved device-side result (e.g. an
+        # output shard / p2p payload) and the thunk that materializes it
+        # into the user's buffer.  Set by the engine BEFORE complete().
+        self.device_handle: Any = None
+        self._resolver: Optional[Callable[[], None]] = None
+        self._cb_lock = threading.Lock()
+        self._callbacks: List[Callable[[], None]] = []
+        # batching: flush hook armed by the facade while this request sits
+        # in an unflushed command-queue batch (auto-flush on wait/sync)
+        self._pre_wait: Optional[Callable[[], None]] = None
 
     # -- engine side --------------------------------------------------------
     def mark_executing(self) -> None:
@@ -46,19 +65,95 @@ class Request:
         self._retcode = ErrorCode(retcode)
         self._duration_ns = int(duration_ns)
         self._status = RequestStatus.COMPLETED
-        self._done.set()
+        with self._cb_lock:
+            self._done.set()
+            callbacks, self._callbacks = self._callbacks, []
+        for cb in callbacks:
+            cb()
+
+    def add_done_callback(self, fn: Callable[[], None]) -> None:
+        """Run ``fn`` when the request completes (immediately if it
+        already has) — the bridge the default ``start_batch`` uses to
+        forward inner engine completions onto facade-created requests."""
+        with self._cb_lock:
+            if not self._done.is_set():
+                self._callbacks.append(fn)
+                return
+        fn()
+
+    def defer_result(
+        self, resolver: Callable[[], None], handle: Any = None
+    ) -> None:
+        """Engine side: park result materialization (the device
+        interaction that adopts the result into the user's buffer) until
+        the user waits or touches the data.  Must be called BEFORE
+        ``complete()`` so the done event publishes it."""
+        self._resolver = resolver
+        self.device_handle = handle
 
     # -- user side ----------------------------------------------------------
+    def materialize(self) -> None:
+        """Run the deferred result adoption, once.  Invoked automatically
+        from ``wait()``/``test()``/``check()`` after completion; safe to
+        call any number of times and from concurrent waiters (the locked
+        swap guarantees the resolver runs exactly once).  A resolver
+        failure (e.g. the deferred writeback program failing to compile)
+        downgrades the request's OK retcode to INVALID_OPERATION so
+        ``check()`` surfaces it as an ACCLError instead of an arbitrary
+        exception escaping a ``wait()`` that already reported success."""
+        with self._cb_lock:
+            resolver, self._resolver = self._resolver, None
+        if resolver is None:
+            return
+        try:
+            resolver()
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
+            if self._retcode == ErrorCode.OK:
+                self._retcode = ErrorCode.INVALID_OPERATION
+        finally:
+            # the handle (an HBM output shard / p2p payload) is dead
+            # weight once adopted — dropping it here keeps long-lived
+            # Request objects from pinning device memory
+            self.device_handle = None
+
     @property
     def status(self) -> RequestStatus:
         return self._status
 
-    def test(self) -> bool:
-        """Non-blocking completion probe."""
+    def done(self) -> bool:
+        """Side-effect-free completion probe for ENGINE-internal code
+        (watchdogs, soft_reset, batch error paths): no batch auto-flush,
+        no deferred-result materialization — calling the user-facing
+        ``test()`` from an engine thread could re-enter the facade's
+        flush mid-failure or drain a batch the user is still building."""
         return self._done.is_set()
 
+    def _auto_flush(self) -> None:
+        hook, self._pre_wait = self._pre_wait, None
+        if hook is not None:
+            hook()  # waiting/polling a queued request flushes its batch
+
+    def test(self) -> bool:
+        """Non-blocking completion probe (materializes the deferred
+        result on a positive answer — a True test() means the user may
+        read the result buffer next).  Also auto-flushes an open batch:
+        polling a queued-but-unflushed request would otherwise spin
+        forever on a call that was never dispatched."""
+        self._auto_flush()
+        if not self._done.is_set():
+            return False
+        self.materialize()
+        return True
+
     def wait(self, timeout: Optional[float] = None) -> bool:
-        return self._done.wait(timeout)
+        self._auto_flush()
+        ok = self._done.wait(timeout)
+        if ok:
+            self.materialize()
+        return ok
 
     def get_retcode(self) -> ErrorCode:
         return self._retcode
@@ -73,6 +168,10 @@ class Request:
         return self._duration_ns
 
     def check(self, context: str = "") -> None:
+        # materialize FIRST: a deferred-adoption failure downgrades the
+        # retcode, and check() must observe that, not the pre-adoption OK
+        if self._done.is_set():
+            self.materialize()
         if self._retcode != ErrorCode.OK:
             raise ACCLError(self._retcode, context or self.op_name)
 
@@ -83,6 +182,15 @@ class CommandQueue:
     The reference needs this because a single CCLO executes one host command
     stream (``acclrequest.hpp:153-211``); we keep it so that the async API has
     deterministic ordering regardless of backend threading.
+
+    It is also the batching unit of single-interaction dispatch: the
+    facade queues calls here between ``begin_batch()`` and ``flush()``,
+    then ``drain()`` hands the whole run to ``engine.start_batch`` as ONE
+    flush — which the device engines execute as one fused program (one
+    device interaction for N queued collectives).  The dist engine's
+    executor likewise pushes a flushed batch as a single queue item so
+    every member process sees the identical batch boundary (the SPMD
+    contract extends to batches).
     """
 
     def __init__(self):
@@ -105,6 +213,13 @@ class CommandQueue:
             if not self._items:
                 return None
             return self._items.pop(0)
+
+    def drain(self) -> list:
+        """Atomically take every queued item (the batch-flush unit);
+        returns [] when empty.  Unlike pop(), never blocks."""
+        with self._cv:
+            items, self._items = self._items, []
+            return items
 
     def close(self) -> None:
         with self._cv:

@@ -265,11 +265,13 @@ def _bench_train_mfu(
     """Flagship train-step MFU on the local devices: one dp x tp=1 sharded
     SGD step on the bf16 transformer; FLOPs from XLA's own cost analysis
     of the compiled step.  ``attention`` picks the lowering — "auto" (the
-    flagship default: resolves naive at T=1024, Pallas flash on-chip at
-    T >= 4K) vs an explicit "blockwise"/"naive", the with/without record
-    VERDICT r2 item 4 asks for.  ``seq=4096`` is the long-context
-    record: naive would OOM on score residuals there, so the fused
-    lowerings are the only entrants."""
+    flagship default: naive below T=1024; from T >= 1024 the Pallas
+    flash kernel on-chip while K/V fit the VMEM gate, measured crossover
+    since the block-512 kernel landed) vs an explicit
+    "blockwise"/"naive", the with/without record VERDICT r2 item 4 asks
+    for.  ``seq=4096`` is the long-context record: naive would OOM on
+    score residuals there, so the fused lowerings are the only
+    entrants."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh
@@ -465,11 +467,43 @@ def _bench_facade_overhead() -> dict:
                 arr.block_until_ready()
 
         drain()  # earlier benches must not bill their queued work to us
+        ic0 = a.engine.device_interactions()
         t0 = time.perf_counter()
         for it in range(iters):
             a.allreduce(sends[it], d, 1024)
         drain()  # sustained end-to-end: host control plane + device
         call_us = (time.perf_counter() - t0) / iters * 1e6
+        # the honest architectural decomposition: device interactions per
+        # call, straight off the engine counter (the single-interaction
+        # contract says 1.0 on this path; anything above it is billed a
+        # tunnel RTT per unit on tunneled hosts)
+        per_call = (a.engine.device_interactions() - ic0) / iters
+
+        # batched dispatch: N queued collectives flush through the
+        # command queue as ONE fused program — the amortized per-call
+        # cost is the facade's floor when a training step batches its
+        # step collectives
+        B = 8
+        nbatches = max(1, iters // B)
+
+        def batched_round(base):
+            with a.batch():
+                reqs = [
+                    a.allreduce(
+                        sends[(base + i) % iters], d, 1024, run_async=True
+                    )
+                    for i in range(B)
+                ]
+            for r in reqs:
+                r.wait()
+
+        batched_round(0)  # warm: compiles the fused batch program
+        drain()
+        t0 = time.perf_counter()
+        for k in range(nbatches):
+            batched_round(k * B)
+        drain()
+        batched_us = (time.perf_counter() - t0) / (nbatches * B) * 1e6
     finally:
         for x in g:
             x.deinit()
@@ -477,6 +511,8 @@ def _bench_facade_overhead() -> dict:
         "facade_call_overhead_us": round(call_us, 1),
         "facade_dispatch_floor_us": round(floor_us, 1),
         "facade_arch_overhead_us": round(call_us - floor_us, 1),
+        "facade_device_interactions_per_call": round(per_call, 2),
+        "facade_batched_call_overhead_us": round(batched_us, 1),
     }
 
 
@@ -562,6 +598,9 @@ def _bench_ring_allreduce(ndev: int, algo: str = "xla") -> float:
     from jax import lax
     from jax.sharding import PartitionSpec as P
 
+    from accl_tpu.compat import install as _compat_install
+
+    _compat_install()  # legacy-jax shims before binding shard_map
     try:
         from jax import shard_map
     except ImportError:  # pragma: no cover
@@ -929,6 +968,8 @@ def _save_lkg(result: dict) -> None:
     never let a CPU/smoke run clobber a real chip capture."""
     if result.get("value") is None or result.get("provenance"):
         return
+    if (result.get("errors") or {}).get("facade_arch_regression"):
+        return  # a regressed arch capture must never become the new LKG
     if _SMALL or "tpu" not in str(result.get("device", "")).lower():
         return
     import datetime
@@ -1436,6 +1477,27 @@ def main() -> None:
             )
     _try(extras, errors, "decode_tokens_per_s", _bench_decode_throughput)
 
+    # dispatch-overhead regression gate (the writer-side guard next to
+    # sweep.py's impossible-rate gate): a fresh capture whose
+    # facade_arch_overhead_us regressed >25% vs the last-known-good is an
+    # ERROR in the artifact — and _save_lkg refuses to make it the new
+    # LKG — so a lost single-interaction win cannot silently become the
+    # new baseline.
+    try:  # import in its OWN try: a failed import must not surface as a
+        # NameError from the gate's except clause below
+        from benchmarks.parse_results import (
+            ArchOverheadRegressionError,
+            check_arch_overhead,
+        )
+    except ImportError:  # pragma: no cover - repo layout changed
+        ArchOverheadRegressionError = None  # type: ignore[assignment]
+    if ArchOverheadRegressionError is not None:
+        try:
+            lkg_gate = _load_lkg() or {}
+            check_arch_overhead(extras, lkg_gate.get("result") or {})
+        except ArchOverheadRegressionError as e:
+            errors["facade_arch_regression"] = str(e)
+
     _sanitize_extras(extras, errors)
     result = _headline(extras)
     result["device"] = jax.devices()[0].device_kind
@@ -1448,6 +1510,13 @@ def main() -> None:
 if __name__ == "__main__":
     if os.environ.get("ACCL_BENCH_MODE") == "probe":
         _probe()
+    elif os.environ.get("ACCL_BENCH_MODE") == "facade_decomp":
+        # local-backend dispatch decomposition (BENCH_NOTES "dispatch
+        # decomposition" section): the facade overhead bench alone, on
+        # whatever backend JAX_PLATFORMS selects — the committed
+        # pod-shaped-host measurement that replaces the old cProfile
+        # extrapolation.  ACCL_BENCH_SMALL=1 shortens the loops.
+        print(json.dumps(_bench_facade_overhead()))
     elif os.environ.get("ACCL_BENCH_GUARDED", "1") != "0":
         _run_guarded()
     else:

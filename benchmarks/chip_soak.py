@@ -59,6 +59,9 @@ def main() -> int:
                  4095, 4096, 5000, 6001, 8000, 8192, 10000, 12000,
                  14321, 15000, 16000, 16384]
         deadline = time.monotonic() + seconds
+        # interaction delta over the TIMED loop only (the counter is
+        # engine-lifetime; lifetime/ops would inflate the per-op figure)
+        di0 = a.engine.device_interactions()
         t0 = time.monotonic()
         iters = 0
         ops = 0
@@ -108,12 +111,23 @@ def main() -> int:
             iters += 1
             ops += 1
         dt = time.monotonic() - t0
+        # The leak filter is REAL on this tier now: XLAEngine's
+        # dump_rx_buffers reports parked gang slots, unmatched p2p posts
+        # and undrained stream ports as non-IDLE ``rxbuf`` lines (it used
+        # to be absent here, which made rx_leaks vacuously []); a clean
+        # run ends with zero such lines.
         rx = a.dump_rx_buffers()
         leaks = [ln for ln in rx.splitlines()
                  if "rxbuf" in ln and "IDLE" not in ln]
+        di = a.engine.device_interactions() - di0
         print(json.dumps({
             "iters": iters, "ops": ops, "seconds": round(dt, 1),
             "ops_per_s": round(ops / dt, 2), "rx_leaks": leaks,
+            # single-interaction telemetry: ~1 interaction per warm
+            # collective on the fast path (buffer staging/sync around
+            # each op is separate and not billed here)
+            "device_interactions": di,
+            "interactions_per_op": round(di / max(ops, 1), 2),
             "device": jax.devices()[0].device_kind,
         }))
         return 0 if not leaks else 1

@@ -35,6 +35,66 @@ _TABLE_SIZES = [2**10, 2**16, 2**19, 2**23]
 # ceiling as benchmarks/sweep.py's writer-side gate.
 SANE_GBPS_CEILING = float(os.environ.get("ACCL_SWEEP_GBPS_CEILING", "10000"))
 
+# Dispatch-overhead regression refusal (single-interaction dispatch PR):
+# facade_arch_overhead_us is the architectural share of the facade's
+# per-call cost (extra device interactions, each a tunnel RTT).  The PR
+# that fused staging/adoption into one dispatch drove it down; a later
+# capture that regresses it by more than this factor vs the committed
+# .bench_lkg.json is refused the same way an impossible rate is — as an
+# ERROR, not a silently-worse artifact.
+ARCH_REGRESSION_TOLERANCE = float(
+    os.environ.get("ACCL_ARCH_REGRESSION_TOLERANCE", "1.25")
+)
+
+
+class ArchOverheadRegressionError(ValueError):
+    """A fresh facade_arch_overhead_us exceeded tolerance x the LKG value:
+    the single-interaction dispatch win regressed; fix the engine (or
+    consciously raise ACCL_ARCH_REGRESSION_TOLERANCE) instead of
+    committing the slower capture."""
+
+
+def check_arch_overhead(extras: dict, lkg_result: dict,
+                        tolerance: float = None) -> None:
+    """Gate a captured ``extras`` dict against the last-known-good one.
+    No-op when either side lacks the key (pre-PR stashes, wedged runs) or
+    the LKG value is non-positive (a sub-floor local measurement has no
+    meaningful ratio)."""
+    tol = ARCH_REGRESSION_TOLERANCE if tolerance is None else tolerance
+    fresh = (extras or {}).get("facade_arch_overhead_us")
+    base = ((lkg_result or {}).get("extras") or {}).get(
+        "facade_arch_overhead_us"
+    )
+    if fresh is None or base is None or base <= 0:
+        return
+    if fresh > tol * base:
+        raise ArchOverheadRegressionError(
+            f"facade_arch_overhead_us {fresh:.1f} us regressed beyond "
+            f"{tol:.2f}x the last-known-good {base:.1f} us — the "
+            "single-interaction dispatch contract broke (extra device "
+            "interactions crept back into the call path); refusing the "
+            "capture"
+        )
+
+
+def check_bench_capture(bench_path: str, lkg_path: str = None) -> None:
+    """CLI form (``--check-bench BENCH_rNN.json``): gate a committed
+    bench capture file against .bench_lkg.json."""
+    import json
+
+    with open(bench_path) as f:
+        doc = json.load(f)
+    result = doc.get("parsed") or doc.get("result") or doc
+    lkg_path = lkg_path or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".bench_lkg.json",
+    )
+    with open(lkg_path) as f:
+        lkg = json.load(f)
+    check_arch_overhead(
+        (result or {}).get("extras") or {}, lkg.get("result") or {}
+    )
+
 
 def load(path: str) -> dict:
     """{collective: [(count, bytes, duration_ns, gbps), ...]} sorted by
@@ -156,6 +216,11 @@ def plot(path: str, out_png: str) -> None:
 
 def main(argv=None) -> str:
     argv = sys.argv[1:] if argv is None else argv
+    if "--check-bench" in argv:
+        i = argv.index("--check-bench")
+        check_bench_capture(argv[i + 1])
+        print(f"{argv[i + 1]}: facade_arch_overhead_us within tolerance")
+        return ""
     do_plot = "--plot" in argv
     argv = [a for a in argv if a != "--plot"]
     results = argv[0] if argv else os.path.join(

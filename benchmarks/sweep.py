@@ -54,6 +54,24 @@ class ImpossibleRateError(RuntimeError):
     committed artifact chain (CSV -> parse_results -> BENCH_NOTES)."""
 
 
+# The second writer-side gate: facade_arch_overhead_us regressions.
+# Defined next to the parser (stdlib-only, no jax) and re-exported here
+# so both artifact writers carry the same refusal surface; bench.py
+# invokes it on every fresh capture before the LKG stash.
+try:
+    from parse_results import (  # running as a script: sibling import
+        ARCH_REGRESSION_TOLERANCE,
+        ArchOverheadRegressionError,
+        check_arch_overhead,
+    )
+except ImportError:  # pragma: no cover - running as a package module
+    from benchmarks.parse_results import (  # noqa: F401
+        ARCH_REGRESSION_TOLERANCE,
+        ArchOverheadRegressionError,
+        check_arch_overhead,
+    )
+
+
 def write_row(writer, collective: str, count: int, nbytes: int, ns: float):
     gbps = 8 * nbytes / max(ns, 1) if ns else 0.0
     if gbps > SANE_GBPS_CEILING:

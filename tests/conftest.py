@@ -25,11 +25,23 @@ if not TPU_TIER:
 
 import jax  # noqa: E402
 
+# Legacy-jax shims (shard_map kwarg drift, lax.axis_size) BEFORE any test
+# module binds those names directly — same surface the library installs.
+from accl_tpu.compat import install as _compat_install  # noqa: E402
+
+_compat_install()
+
 if not TPU_TIER:
     # A site-installed PJRT plugin may force its own platform at
     # interpreter start; the config update below wins over both it and
     # the env var.
     jax.config.update("jax_platforms", "cpu")
+
+# NOTE: no in-process persistent compilation cache here — jaxlib 0.4.x
+# segfaults serving cached executables to some of this suite's programs
+# (observed: the trainer step in test_data).  The dist tests' SPAWNED
+# rank processes keep their cache (accl_tpu/launch.py, 0.5s threshold),
+# which has been stable since it landed.
 else:
     # tier mode keeps the default (TPU) platform — but still honor an
     # explicit JAX_PLATFORMS override via the CONFIG path (env alone

@@ -708,7 +708,7 @@ def test_flash_attention_ragged_default_block():
         jnp.asarray(rng.standard_normal((B, H, T, D)), jnp.float32)
         for _ in range(3)
     )
-    got = pk.flash_attention(q, k, v)  # default block=256
+    got = pk.flash_attention(q, k, v)  # default block=512
     with jax.default_matmul_precision("highest"):
         s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
         s = jnp.where(jnp.tril(jnp.ones((T, T), bool)), s, -1e30)

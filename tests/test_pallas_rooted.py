@@ -16,10 +16,20 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+from accl_tpu.compat import has_pallas_interpret
 from accl_tpu.constants import ReduceFunction
 from accl_tpu.ops import pallas as pk
 
-pytestmark = pytest.mark.pallas
+pytestmark = [
+    pytest.mark.pallas,
+    # off-chip these kernels run under the Pallas TPU interpreter,
+    # which legacy jax does not ship — skip loudly with the environment
+    # reason instead of failing on the missing attribute
+    pytest.mark.skipif(
+        jax.default_backend() != "tpu" and not has_pallas_interpret(),
+        reason="Pallas kernels need Mosaic (TPU) or pltpu.InterpretParams",
+    ),
+]
 
 
 def _mesh(n):

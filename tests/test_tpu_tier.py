@@ -259,4 +259,9 @@ def test_capabilities_report(accl):
 
 def test_dumps(accl):
     assert "rank 0" in accl.dump_communicator()
-    accl.dump_rx_buffers()  # no pool on the gang tier: must not raise
+    # the gang tier's rx dump is real now (parked slots / p2p posts /
+    # stream depths); an idle engine must report clean — no occupied
+    # ``rxbuf`` line for the soak's leak filter to trip on
+    dump = accl.dump_rx_buffers()
+    assert "XLA gang rx state" in dump
+    assert "rxbuf" not in dump
