@@ -30,6 +30,14 @@ from .constants import (  # noqa: F401
     Transport,
 )
 from .arithconfig import ArithConfig, DEFAULT_ARITH_CONFIG  # noqa: F401
+from .faults import (  # noqa: F401
+    FAULT_PLAN_ENV,
+    FaultAction,
+    FaultInjector,
+    FaultPlan,
+    FaultRule,
+    PeerDeadError,
+)
 from .buffer import BaseBuffer, DummyBuffer, EmuBuffer  # noqa: F401
 from .communicator import Communicator, Rank  # noqa: F401
 from .core import ACCL, emulated_group, socket_group_member  # noqa: F401

@@ -102,7 +102,8 @@ class BaseEngine:
             inner = self.start(options)
             inner.add_done_callback(
                 lambda i=inner, r=req: r.complete(
-                    i.get_retcode(), i.get_duration_ns()
+                    i.get_retcode(), i.get_duration_ns(),
+                    context=i.error_context,
                 )
             )
 
@@ -110,6 +111,17 @@ class BaseEngine:
         """Engine-lifetime device-interaction count, or ``None`` on tiers
         with no device (emulator/native: the dataplane is host memory)."""
         return None
+
+    def health_report(self, comm) -> dict:
+        """Per-peer health map for ``comm``, keyed by comm-relative rank
+        (``capabilities()["health"]``).  Engines with timeout/retry
+        accounting (emulator) or a gang watchdog (XLA) override this; the
+        default reports every peer healthy."""
+        return {
+            i: {"state": "ok", "timeouts": 0, "failures": 0, "last_event": ""}
+            for i in range(comm.size)
+            if i != comm.local_rank
+        }
 
     def create_buffer(self, count: int, dtype, host_only: bool = False,
                       data=None):

@@ -132,6 +132,8 @@ class DistEngine(StreamPortMixin, BaseEngine):
         self.timeout_s = DEFAULT_TIMEOUT_S
         self.max_eager_size = 32 * 1024
         self.max_rendezvous_size = MAX_EAGER_SIZE_LIMIT
+        self.retry_limit = 0
+        self.retry_backoff_s = 0.05
         self.tuning = {"allreduce_algorithm": "xla", "ring_segments": 1}
         self.interactions = InteractionCounter()
         self._init_streams()
@@ -836,6 +838,16 @@ class DistEngine(StreamPortMixin, BaseEngine):
             if val <= 0:
                 return ErrorCode.CONFIG_ERROR
             self.max_rendezvous_size = int(val)
+        elif fn == ConfigFunction.SET_RETRY_LIMIT:
+            # SPMD fabric: no host retransmit exists, but the knob is
+            # accepted so set_retry_policy stays portable across tiers
+            if val < 0:
+                return ErrorCode.CONFIG_ERROR
+            self.retry_limit = int(val)
+        elif fn == ConfigFunction.SET_RETRY_BACKOFF:
+            if val <= 0:
+                return ErrorCode.CONFIG_ERROR
+            self.retry_backoff_s = float(val)
         elif fn == ConfigFunction.SET_TUNING:
             return self._apply_tuning(options)
         else:

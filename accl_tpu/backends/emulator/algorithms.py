@@ -108,7 +108,7 @@ def eager_send(
         first = False
         chunk = payload[off : off + seg]
         seqn = comm.next_outbound_seq(peer)
-        eng.post(
+        eng.post_eager(
             comm,
             peer,
             Message(
@@ -120,6 +120,7 @@ def eager_send(
                 seqn=seqn,
                 count=len(chunk),
                 payload=chunk,
+                epoch=comm.epoch,
             ),
         )
         off += seg
@@ -186,7 +187,10 @@ def rndzv_recv_post(
 def rndzv_recv_wait(eng, comm: Communicator, handle: RecvHandle) -> Generator:
     """Wait for the one-sided write completion (ref ``get_completion``
     c:280-339)."""
-    yield WaitRndzvDone(comm.id, handle.peer, handle.tag, handle.vaddr)
+    yield WaitRndzvDone(
+        comm.id, handle.peer, handle.tag, handle.vaddr,
+        peer_addr=comm.ranks[handle.peer].address,
+    )
     return None
 
 
@@ -196,7 +200,9 @@ def rndzv_send(
     """Wait for the peer's address announcement, then perform the one-sided
     write (ref ``send`` rendezvous path c:587-610: ``rendezvous_get_addr`` +
     RDMA WRITE via the packetizer)."""
-    init = yield WaitRndzvInit(comm.id, peer, tag)
+    init = yield WaitRndzvInit(
+        comm.id, peer, tag, peer_addr=comm.ranks[peer].address
+    )
     eng.post(
         comm,
         peer,

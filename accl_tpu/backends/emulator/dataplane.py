@@ -134,6 +134,23 @@ class RxBufferPool:
             buf.msg = None
             self._cv.notify_all()
 
+    def reset(self) -> int:
+        """Force every slot back to IDLE (soft-reset recovery: stale
+        segments from a faulted collective must not leak slots).  Returns
+        the number of slots that were occupied."""
+        with self._cv:
+            n = 0
+            for b in self._buffers:
+                if b.status != RxStatus.IDLE:
+                    n += 1
+                    if self._matcher is not None:
+                        self._matcher.release(b.index)
+                    b.status = RxStatus.IDLE
+                    b.msg = None
+            if n:
+                self._cv.notify_all()
+            return n
+
     def occupancy(self) -> Tuple[int, int]:
         with self._cv:
             used = sum(1 for b in self._buffers if b.status != RxStatus.IDLE)

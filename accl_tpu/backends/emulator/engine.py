@@ -18,22 +18,28 @@ One engine == one rank.  Data lives in numpy "device" memory; the dataplane
 from __future__ import annotations
 
 import itertools
+import sys
 import threading
 import time
 import traceback
-from typing import Callable, List, Optional
+import weakref
+from typing import Callable, Dict, List, Optional
 
 from ...communicator import Communicator
 from ...constants import (
     ConfigFunction,
+    DEFAULT_RETRY_BACKOFF_S,
     DEFAULT_RX_BUFFER_COUNT,
     DEFAULT_RX_BUFFER_SIZE,
     DEFAULT_TIMEOUT_S,
     EAGER_THRESHOLD_DEFAULT,
     ErrorCode,
     MAX_EAGER_SIZE_LIMIT,
+    MAX_RETRY_LIMIT,
+    Operation,
     TUNING_DEFAULTS,
 )
+from ...faults import PeerDeadError, SeqnLedger
 from ...request import CommandQueue, Request
 from ..base import BaseEngine, CallOptions
 from . import algorithms
@@ -41,16 +47,60 @@ from .dataplane import RxBuffer, RxBufferPool, RxStatus, StreamPorts
 from .engine_conditions import WaitCondition
 from .fabric import Endpoint, Fabric, Message, MsgType
 
+# Scheduler threads that outlived their shutdown join: a leak here means an
+# engine wedged mid-call and the process is carrying a zombie scheduler.
+# Registered by EmuEngine.shutdown, reaped as threads actually exit —
+# exposed so soak/churn tests can assert none leaked.
+_leaked_threads: List[weakref.ref] = []
+_leaked_lock = threading.Lock()
+
+
+def leaked_scheduler_threads() -> List[str]:
+    """Names of engine scheduler threads that failed to join at shutdown
+    and are STILL alive."""
+    with _leaked_lock:
+        alive = []
+        live_refs = []
+        for ref in _leaked_threads:
+            t = ref()
+            if t is not None and t.is_alive():
+                alive.append(t.name)
+                live_refs.append(ref)
+        _leaked_threads[:] = live_refs
+        return alive
+
+
+#: operations that talk to peers (fail-fast candidates against a dead rank)
+_COMM_OPS = frozenset((
+    Operation.SEND, Operation.RECV, Operation.BCAST, Operation.SCATTER,
+    Operation.GATHER, Operation.ALLGATHER, Operation.REDUCE,
+    Operation.ALLREDUCE, Operation.REDUCE_SCATTER, Operation.ALLTOALL,
+    Operation.BARRIER,
+))
+
+
+class _RetransEntry:
+    __slots__ = ("msg", "address", "attempts", "due")
+
+    def __init__(self, msg: Message, address: str, due: float):
+        self.msg = msg
+        self.address = address
+        self.attempts = 0
+        self.due = due
+
 
 class _CallTask:
-    __slots__ = ("request", "gen", "cond", "deadline", "started_ns")
+    __slots__ = ("request", "gen", "cond", "deadline", "started_ns",
+                 "options")
 
-    def __init__(self, request: Request, gen, timeout_s: float):
+    def __init__(self, request: Request, gen, timeout_s: float,
+                 options: Optional[CallOptions] = None):
         self.request = request
         self.gen = gen
         self.cond: Optional[WaitCondition] = None
         self.deadline = time.monotonic() + timeout_s
         self.started_ns = time.perf_counter_ns()
+        self.options = options
 
 
 class EmuEngine(BaseEngine):
@@ -72,11 +122,28 @@ class EmuEngine(BaseEngine):
         self.max_rendezvous_size = MAX_EAGER_SIZE_LIMIT
         self.tuning = dict(TUNING_DEFAULTS)
         self.transport_enabled = False
+        # retry policy (ConfigFunction.SET_RETRY_LIMIT / SET_RETRY_BACKOFF,
+        # ACCL.set_retry_policy): limit 0 = the classic fire-and-forget
+        # eager send; limit > 0 arms per-segment ACKs + retransmit with
+        # exponential backoff (receiver-side seqn dedup keeps duplicates
+        # value-correct)
+        self.retry_limit = 0
+        self.retry_backoff_s = DEFAULT_RETRY_BACKOFF_S
 
         self._rndzv_inits: List[Message] = []
         self._rndzv_done: List[Message] = []
         self._notif_lock = threading.Lock()
         self._vaddr_counter = itertools.count(1)
+        # retransmit window (engine-thread only):
+        # (comm, peer, epoch, seqn) -> entry
+        self._retrans: Dict[tuple, _RetransEntry] = {}
+        # receiver-side duplicate detection (engine-thread only)
+        self._ledger = SeqnLedger()
+        # per-peer-address health: timeout/retry accounting feeding the
+        # graceful-degradation map (capabilities()["health"]); a peer
+        # marked "dead" fails new collectives fast at call intake
+        self._health: Dict[str, dict] = {}
+        self.leaked_scheduler_thread = False
 
         self._queue = CommandQueue()
         self._wake = threading.Event()
@@ -94,10 +161,29 @@ class EmuEngine(BaseEngine):
         self._wake.set()
         return req
 
-    def shutdown(self) -> None:
+    def shutdown(self, join_timeout: float = 5.0) -> None:
         self._stop = True
         self._wake.set()
-        self._thread.join(timeout=5)
+        self._thread.join(timeout=join_timeout)
+        if self._thread.is_alive():
+            # the scheduler thread is wedged (a call stuck in non-yielding
+            # work): don't mask it — log loudly and register the zombie so
+            # soak/churn tests can assert no leaked scheduler threads
+            self.leaked_scheduler_thread = True
+            with _leaked_lock:
+                _leaked_threads.append(weakref.ref(self._thread))
+            print(
+                f"[accl engine {self.address}] LEAK: scheduler thread "
+                f"{self._thread.name!r} did not exit within "
+                f"{join_timeout}s of shutdown — a call is wedged; the "
+                "thread is now a daemon zombie",
+                file=sys.stderr,
+            )
+        detach = getattr(self.fabric, "detach", None)
+        if detach is not None:
+            # leave the fabric honestly: later sends to this rank fail
+            # fast with SEND_TIMEOUT instead of being silently dropped
+            detach(self.address)
         self.fabric.close()
 
     def stream_push(self, stream_id: int, data: bytes) -> None:
@@ -112,7 +198,84 @@ class EmuEngine(BaseEngine):
 
     # -- wire helpers used by algorithms ------------------------------------
     def post(self, comm: Communicator, dst: int, msg: Message) -> None:
-        self.fabric.send(comm.ranks[dst].address, msg)
+        addr = comm.ranks[dst].address
+        try:
+            self.fabric.send(addr, msg)
+        except PeerDeadError:
+            self._health_note(addr, "peer_dead", dead=True)
+            raise
+
+    def post_eager(self, comm: Communicator, dst: int, msg: Message) -> None:
+        """Post an eager segment; with a retry policy armed (retry_limit >
+        0) the segment requests an ACK and enters the retransmit window —
+        unacked segments are re-sent with exponential backoff up to the
+        retry limit (the recovery loop the reference's NOT_READY_ERROR
+        stream plays for its transports)."""
+        if self.retry_limit > 0:
+            msg.ack = 1
+            msg.reply_to = self.address
+        self.post(comm, dst, msg)
+        if self.retry_limit > 0:
+            key = (msg.comm_id, dst, msg.epoch, msg.seqn)
+            self._retrans[key] = _RetransEntry(
+                msg,
+                comm.ranks[dst].address,
+                time.monotonic() + self.retry_backoff_s,
+            )
+
+    # -- peer health (graceful degradation) ----------------------------------
+    def _health_note(self, addr: str, event: str, dead: bool = False) -> None:
+        h = self._health.setdefault(
+            addr, {"state": "ok", "timeouts": 0, "failures": 0,
+                   "last_event": ""}
+        )
+        if event == "timeout":
+            h["timeouts"] += 1
+        else:
+            h["failures"] += 1
+        h["last_event"] = event
+        # one timeout makes a peer suspect; repeated timeouts (2 strikes,
+        # matching the XLA gang watchdog policy) or a hard failure mark it
+        # dead — later collectives addressing it fail fast until a
+        # soft_reset clears the verdict
+        if dead or h["timeouts"] >= 2:
+            h["state"] = "dead"
+        elif h["state"] != "dead":
+            h["state"] = "suspect"
+
+    def health_report(self, comm: Communicator) -> Dict[int, dict]:
+        """Per-peer health for ``comm``'s members, keyed by comm-relative
+        rank (the graceful-degradation map of capabilities()["health"])."""
+        report: Dict[int, dict] = {}
+        for i, r in enumerate(comm.ranks):
+            if i == comm.local_rank:
+                continue
+            h = self._health.get(r.address)
+            report[i] = dict(h) if h else {
+                "state": "ok", "timeouts": 0, "failures": 0, "last_event": ""
+            }
+        return report
+
+    def _dead_peer_for(self, options: CallOptions) -> Optional[tuple]:
+        """(rank, address) of a participating peer already marked dead, or
+        None.  Only communicating ops are screened, and only against the
+        peers the op actually addresses — local copy/combine/config must
+        keep working next to a dead neighbor."""
+        comm = options.comm
+        if comm is None or options.op not in _COMM_OPS or not self._health:
+            return None
+        if options.op == Operation.SEND:
+            candidates = [options.root_dst]
+        elif options.op == Operation.RECV:
+            candidates = [options.root_src]
+        else:
+            candidates = [r for r in range(comm.size) if r != comm.local_rank]
+        for r in candidates:
+            addr = comm.ranks[r].address
+            h = self._health.get(addr)
+            if h is not None and h["state"] == "dead":
+                return r, addr
+        return None
 
     def take_rndzv_init(self, pred: Callable[[Message], bool]):
         with self._notif_lock:
@@ -154,7 +317,27 @@ class EmuEngine(BaseEngine):
         )
         if msg is None:
             return None
+        # inbox-consumed segments still join the dedup ledger and get
+        # acked, exactly like the pool path, so retransmits/duplicates of
+        # them are discarded instead of leaking into the pool later
+        self._ledger.seen((msg.comm_id, msg.src, msg.epoch), msg.seqn)
+        self._maybe_ack(msg)
         return RxBuffer(-1, len(msg.payload), RxStatus.CLAIMED, msg)
+
+    def _maybe_ack(self, msg: Message) -> None:
+        """ACK a delivered eager segment when the sender asked for one
+        (retransmit protocol).  Duplicates are re-acked — the original ACK
+        may have been the thing the network lost."""
+        if not msg.ack or not msg.reply_to:
+            return
+        ack = Message(
+            MsgType.ACK, msg.comm_id, msg.dst, msg.src, msg.tag,
+            seqn=msg.seqn, epoch=msg.epoch,
+        )
+        try:
+            self.fabric.send(msg.reply_to, ack)
+        except Exception:
+            pass  # a dead/fault-dropped ack path: the sender's backoff rules
 
     # -- debug dumps (ref ACCL::dump_eager_rx_buffers) -----------------------
     def dump_rx_buffers(self) -> str:
@@ -180,6 +363,12 @@ class EmuEngine(BaseEngine):
                         self._rndzv_done.append(msg)
                 elif msg.msg_type == MsgType.STREAM:
                     self.streams.push(msg.strm, msg.payload)
+                elif msg.msg_type == MsgType.ACK:
+                    # a peer confirmed an eager segment: retire it from
+                    # the retransmit window (ack.src is the acking peer)
+                    self._retrans.pop(
+                        (msg.comm_id, msg.src, msg.epoch, msg.seqn), None
+                    )
             used, total = self.rx_pool.occupancy()
             if used < total:
                 emsg = self.endpoint.take_matching(
@@ -187,9 +376,55 @@ class EmuEngine(BaseEngine):
                 )
                 if emsg is not None:
                     routed_any = True
-                    self.rx_pool.fill(emsg, timeout=0)
+                    self._maybe_ack(emsg)
+                    if not self._ledger.seen(
+                        (emsg.comm_id, emsg.src, emsg.epoch), emsg.seqn
+                    ):
+                        self.rx_pool.fill(emsg, timeout=0)
+                    # else: duplicate (fault-injected or a retransmit whose
+                    # original arrived) — re-acked above, then discarded so
+                    # it can never occupy a pool slot
             if not routed_any:
                 return
+
+    def _task_context(self, task: _CallTask, peer=None, attempts=None) -> dict:
+        """Structured ACCLError context for a failed call (op, comm, peer,
+        attempts, elapsed) — the diagnosable trail the chaos tests assert."""
+        ctx = {
+            "op": task.request.op_name,
+            "elapsed_s": round(
+                (time.perf_counter_ns() - task.started_ns) / 1e9, 3
+            ),
+        }
+        if task.options is not None and task.options.comm is not None:
+            ctx["comm"] = task.options.comm.id
+        if peer is not None:
+            ctx["peer"] = peer
+        if attempts is not None:
+            ctx["attempts"] = attempts
+        return ctx
+
+    def _service_retransmits(self, now: float) -> None:
+        """Re-send unacked eager segments past their backoff deadline;
+        exponential backoff doubles per attempt.  Retry exhaustion marks
+        the peer dead — the graceful-degradation path that turns a
+        blackholed link into fast failures instead of hangs."""
+        if not self._retrans:
+            return
+        for key, ent in list(self._retrans.items()):
+            if now < ent.due:
+                continue
+            if ent.attempts >= self.retry_limit:
+                del self._retrans[key]
+                self._health_note(ent.address, "retry_exhausted", dead=True)
+                continue
+            ent.attempts += 1
+            ent.due = now + self.retry_backoff_s * (2 ** ent.attempts)
+            try:
+                self.fabric.send(ent.address, ent.msg)
+            except (PeerDeadError, KeyError, OSError):
+                del self._retrans[key]
+                self._health_note(ent.address, "peer_dead", dead=True)
 
     def _run(self) -> None:
         active: List[_CallTask] = []
@@ -200,10 +435,30 @@ class EmuEngine(BaseEngine):
                     break
                 req, options = item
                 req.mark_executing()
+                dead = self._dead_peer_for(options)
+                if dead is not None:
+                    # fail fast: the peer is already known dead — don't
+                    # burn the full call deadline discovering it again
+                    rank_d, addr = dead
+                    code = (
+                        ErrorCode.RECEIVE_TIMEOUT
+                        if options.op == Operation.RECV
+                        else ErrorCode.SEND_TIMEOUT
+                    )
+                    h = self._health.get(addr, {})
+                    req.complete(code, 0, context={
+                        "op": options.op.name,
+                        "comm": options.comm.id,
+                        "peer": addr,
+                        "attempts": h.get("failures", 0),
+                        "elapsed_s": 0.0,
+                    })
+                    continue
                 gen = algorithms.dispatch(self, options)
-                active.append(_CallTask(req, gen, self.timeout_s))
+                active.append(_CallTask(req, gen, self.timeout_s, options))
 
             self._route_inbox()
+            self._service_retransmits(time.monotonic())
 
             progressed = False
             now = time.monotonic()
@@ -213,9 +468,13 @@ class EmuEngine(BaseEngine):
                     value = task.cond.poll(self)
                     if value is None:
                         if now > task.deadline:
+                            peer = getattr(task.cond, "peer_addr", None)
+                            if peer is not None:
+                                self._health_note(peer, "timeout")
                             task.request.complete(
                                 task.cond.timeout_code,
                                 time.perf_counter_ns() - task.started_ns,
+                                context=self._task_context(task, peer=peer),
                             )
                             active.remove(task)
                             progressed = True
@@ -231,6 +490,18 @@ class EmuEngine(BaseEngine):
                     )
                     active.remove(task)
                     progressed = True
+                except PeerDeadError as dead_exc:
+                    # a send hit a dead/detached endpoint: fast, diagnosable
+                    # SEND_TIMEOUT (the silent-drop fix of fabric.py:222)
+                    task.request.complete(
+                        ErrorCode.SEND_TIMEOUT,
+                        time.perf_counter_ns() - task.started_ns,
+                        context=self._task_context(
+                            task, peer=dead_exc.address
+                        ),
+                    )
+                    active.remove(task)
+                    progressed = True
                 except Exception:
                     traceback.print_exc()
                     task.request.complete(
@@ -241,7 +512,10 @@ class EmuEngine(BaseEngine):
                     progressed = True
 
             if not progressed:
-                self._wake.wait(timeout=0.001 if active else 0.05)
+                timeout = 0.001 if active else 0.05
+                if self._retrans:
+                    timeout = min(timeout, self.retry_backoff_s / 2)
+                self._wake.wait(timeout=timeout)
                 self._wake.clear()
 
         self._queue.close()
@@ -255,8 +529,27 @@ class EmuEngine(BaseEngine):
                 self._rndzv_inits.clear()
                 self._rndzv_done.clear()
             self.transport_enabled = False
+            if val >= 1:
+                # FULL reset (soft_reset recovery, never plain init — a
+                # flush at init would race the socket tier's pre-attach
+                # replay and drop fast peers' first segments): abandon all
+                # stale wire state so a group that lost a collective to a
+                # fault can realign
+                self.rx_pool.reset()
+                self.endpoint.clear()
+                self._retrans.clear()
+                self._ledger.clear()
+                self._health.clear()
         elif fn == ConfigFunction.ENABLE_TRANSPORT:
             self.transport_enabled = True
+        elif fn == ConfigFunction.SET_RETRY_LIMIT:
+            if not 0 <= val <= MAX_RETRY_LIMIT:
+                return ErrorCode.CONFIG_ERROR
+            self.retry_limit = int(val)
+        elif fn == ConfigFunction.SET_RETRY_BACKOFF:
+            if val <= 0:
+                return ErrorCode.CONFIG_ERROR
+            self.retry_backoff_s = float(val)
         elif fn == ConfigFunction.SET_TIMEOUT:
             if val <= 0:
                 return ErrorCode.CONFIG_ERROR

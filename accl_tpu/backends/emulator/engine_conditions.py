@@ -15,9 +15,14 @@ from .fabric import Message
 
 
 class WaitCondition:
-    """Polled by the scheduler; returns a value when satisfied, None if not."""
+    """Polled by the scheduler; returns a value when satisfied, None if not.
+
+    ``peer_addr`` (when set) names the peer the condition is waiting on —
+    a deadline expiry then feeds that peer's entry in the engine's health
+    map (timeout accounting for graceful degradation)."""
 
     timeout_code = ErrorCode.RECEIVE_TIMEOUT
+    peer_addr: Optional[str] = None
 
     def poll(self, engine):
         raise NotImplementedError
@@ -36,6 +41,7 @@ class SeekRx(WaitCondition):
 
     def __init__(self, comm, src: int, tag: int):
         self.comm, self.src, self.tag = comm, src, tag
+        self.peer_addr = comm.ranks[src].address
 
     def poll(self, engine):
         seqn = self.comm.peek_inbound_seq(self.src)
@@ -58,8 +64,10 @@ class WaitRndzvInit(WaitCondition):
 
     timeout_code = ErrorCode.RENDEZVOUS_TIMEOUT
 
-    def __init__(self, comm_id: int, src: Optional[int], tag: int):
+    def __init__(self, comm_id: int, src: Optional[int], tag: int,
+                 peer_addr: Optional[str] = None):
         self.comm_id, self.src, self.tag = comm_id, src, tag
+        self.peer_addr = peer_addr
 
     def poll(self, engine):
         def pred(m: Message) -> bool:
@@ -78,8 +86,10 @@ class WaitRndzvDone(WaitCondition):
 
     timeout_code = ErrorCode.RENDEZVOUS_TIMEOUT
 
-    def __init__(self, comm_id: int, src: Optional[int], tag: int, vaddr: int):
+    def __init__(self, comm_id: int, src: Optional[int], tag: int, vaddr: int,
+                 peer_addr: Optional[str] = None):
         self.comm_id, self.src, self.tag, self.vaddr = comm_id, src, tag, vaddr
+        self.peer_addr = peer_addr
 
     def poll(self, engine):
         def pred(m: Message) -> bool:

@@ -28,7 +28,10 @@ import socket
 import struct
 import sys
 import threading
+import zlib
 from typing import Callable, Dict, List, Optional
+
+from ...faults import FaultInjector, FaultPlan, PeerDeadError
 
 
 class MsgType(enum.IntEnum):
@@ -37,6 +40,7 @@ class MsgType(enum.IntEnum):
     RNDZV_WR_DONE = 3  # write completed into receiver memory
     RNDZV_DATA = 4  # the one-sided write itself (fabric-internal)
     STREAM = 5  # routed directly to a device stream port
+    ACK = 6  # eager-segment delivery acknowledgment (retransmit protocol)
 
 
 @dataclasses.dataclass
@@ -51,6 +55,10 @@ class Message:
     count: int = 0  # payload bytes (redundant w/ len(payload), kept for parity)
     strm: int = 0  # stream id for MsgType.STREAM
     payload: bytes = b""
+    ack: int = 0  # 1 = sender requests an ACK (retransmit protocol armed)
+    reply_to: str = ""  # sender's fabric address for ACKs
+    csum: int = 0  # crc32 of payload; stamped by the fabric on first send
+    epoch: int = 0  # sender's communicator-instance epoch (seqn dedup scope)
 
 
 class Endpoint:
@@ -67,12 +75,28 @@ class Endpoint:
         self._wr_registry: Dict[int, memoryview] = {}
         self._deliver_cb = deliver_cb
         self.on_activity: Optional[Callable[[], None]] = None
+        # wire-integrity accounting: payloads whose crc32 no longer matches
+        # the stamped csum are discarded here (the rx dataplane's bit-error
+        # detection; the sender's retransmit protocol recovers them)
+        self.corrupt_drops = 0
 
     def register_write_target(self, vaddr: int, mem: memoryview) -> None:
         with self._lock:
             self._wr_registry[vaddr] = mem
 
     def deliver(self, msg: Message) -> None:
+        if msg.payload and msg.csum and zlib.crc32(msg.payload) != msg.csum:
+            with self._lock:
+                self.corrupt_drops += 1
+                if msg.msg_type == MsgType.RNDZV_DATA:
+                    # the one-sided write can never complete now (there is
+                    # no rendezvous retransmit; the receiver will time out)
+                    # — drop the write target so the registry doesn't pin
+                    # the buffer forever
+                    self._wr_registry.pop(msg.vaddr, None)
+            if self.on_activity is not None:
+                self.on_activity()
+            return
         if msg.msg_type == MsgType.RNDZV_DATA:
             with self._lock:
                 mem = self._wr_registry.pop(msg.vaddr)
@@ -110,14 +134,80 @@ class Endpoint:
         with self._lock:
             return len(self._inbox)
 
+    def clear(self) -> int:
+        """Drop every parked message and stale rendezvous write targets
+        (soft-reset recovery); returns the number of messages discarded."""
+        with self._lock:
+            n = len(self._inbox)
+            self._inbox.clear()
+            self._wr_registry.clear()
+            return n
+
 
 class Fabric:
-    """Abstract transport: address -> endpoint delivery."""
+    """Abstract transport: address -> endpoint delivery.
+
+    The base class owns the chaos-plane hook: :meth:`send` stamps the wire
+    checksum, consults the installed :class:`FaultInjector` (drop / delay /
+    duplicate / corrupt / kill / partition), then hands surviving copies to
+    the transport's :meth:`_transmit`."""
+
+    _injector: Optional[FaultInjector] = None
+
+    def install_fault_plan(self, plan: Optional[FaultPlan]) -> Optional[FaultInjector]:
+        """Arm (or with ``None``, disarm) a fault plan on this fabric."""
+        self._injector = FaultInjector(plan) if plan is not None else None
+        return self._injector
+
+    @property
+    def fault_injector(self) -> Optional[FaultInjector]:
+        return self._injector
 
     def attach(self, address: str, endpoint: Endpoint) -> None:
         raise NotImplementedError
 
     def send(self, address: str, msg: Message) -> None:
+        inj = self._injector
+        if inj is None:
+            self._transmit(address, msg)
+            return
+        # checksums only matter when someone can corrupt the wire: the
+        # fault-free hot path skips both the stamp and the verify
+        # (delivery checks csum only when non-zero)
+        if msg.payload and msg.csum == 0:
+            msg.csum = zlib.crc32(msg.payload)
+        v = inj.on_send(msg)
+        if v.dead_dst:
+            raise PeerDeadError(address)
+        if v.drop:
+            return
+        if v.corrupt:
+            # the csum keeps the ORIGINAL digest: the receiving dataplane
+            # detects the bit error and discards the segment
+            msg = dataclasses.replace(
+                msg, payload=inj.corrupt_payload(msg.payload)
+            )
+        copies = 2 if v.duplicate else 1
+        if v.delay_s > 0:
+            t = threading.Timer(
+                v.delay_s, self._transmit_copies, (address, msg, copies, True)
+            )
+            t.daemon = True
+            t.start()
+        else:
+            self._transmit_copies(address, msg, copies, False)
+
+    def _transmit_copies(
+        self, address: str, msg: Message, copies: int, swallow: bool
+    ) -> None:
+        for _ in range(copies):
+            try:
+                self._transmit(address, msg)
+            except Exception:
+                if not swallow:  # delayed delivery has no caller to tell
+                    raise
+
+    def _transmit(self, address: str, msg: Message) -> None:
         raise NotImplementedError
 
     def close(self) -> None:
@@ -127,18 +217,32 @@ class Fabric:
 class InProcFabric(Fabric):
     """All ranks in one process; delivery is a direct endpoint call."""
 
-    def __init__(self):
+    def __init__(self, fault_plan: Optional[FaultPlan] = None):
         self._endpoints: Dict[str, Endpoint] = {}
+        self._dead: set = set()
         self._lock = threading.Lock()
+        if fault_plan is not None:
+            self.install_fault_plan(fault_plan)
 
     def attach(self, address: str, endpoint: Endpoint) -> None:
         with self._lock:
             if address in self._endpoints:
                 raise ValueError(f"address {address} already attached")
+            self._dead.discard(address)
             self._endpoints[address] = endpoint
 
-    def send(self, address: str, msg: Message) -> None:
+    def detach(self, address: str) -> None:
+        """Tear an endpoint out of the fabric (engine shutdown / simulated
+        rank death): later sends to it fail fast with PeerDeadError instead
+        of being silently dropped."""
         with self._lock:
+            self._endpoints.pop(address, None)
+            self._dead.add(address)
+
+    def _transmit(self, address: str, msg: Message) -> None:
+        with self._lock:
+            if address in self._dead:
+                raise PeerDeadError(address)
             ep = self._endpoints.get(address)
         if ep is None:
             raise KeyError(f"no endpoint at {address}")
@@ -157,12 +261,23 @@ class SocketFabric(Fabric):
     def __init__(self, bind_address: str):
         self._bind_address = bind_address
         self._endpoint: Optional[Endpoint] = None
+        # the one-process-per-rank tier inherits its chaos plan from the
+        # environment (FaultPlan.to_env -> ACCL_FAULT_PLAN in the spawner)
+        env_plan = FaultPlan.from_env()
+        if env_plan is not None:
+            self.install_fault_plan(env_plan)
+        # peers that had a live connection and then died: sends fail fast
+        # with PeerDeadError instead of silently vanishing (or re-dialing
+        # through the full startup grace period)
+        self._dead: set = set()
+        self._ever_connected: set = set()
         host, port = bind_address.rsplit(":", 1)
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((host, int(port)))
         self._listener.listen(64)
         self._conns: Dict[str, socket.socket] = {}
+        self._accepted: list = []  # inbound conns; torn down on close()
         self._conn_lock = threading.Lock()
         # peers' dials succeed the moment listen() is up — BEFORE this
         # rank's engine exists.  Messages that land in that window must
@@ -194,6 +309,11 @@ class SocketFabric(Fabric):
                 conn, _ = self._listener.accept()
             except OSError:
                 return
+            with self._conn_lock:
+                if self._closing:
+                    conn.close()
+                    return
+                self._accepted.append(conn)
             threading.Thread(
                 target=self._recv_loop, args=(conn,), daemon=True
             ).start()
@@ -239,20 +359,25 @@ class SocketFabric(Fabric):
     def _recv_exact(conn: socket.socket, n: int) -> Optional[bytes]:
         buf = b""
         while len(buf) < n:
-            chunk = conn.recv(n - len(buf))
+            try:
+                chunk = conn.recv(n - len(buf))
+            except OSError:
+                return None  # connection torn down under us (close())
             if not chunk:
                 return None
             buf += chunk
         return buf
 
-    def _connect(self, address: str) -> socket.socket:
+    def _connect(self, address: str, grace_s: float = 15.0) -> socket.socket:
         """Dial a peer, retrying until its listener is up (peers start
         concurrently; the reference leans on MPI barriers for this,
-        fixture.hpp:124-132 — we self-synchronize instead)."""
+        fixture.hpp:124-132 — we self-synchronize instead).  Re-dials of a
+        peer that was ALREADY connected get no grace period: its process is
+        gone and the caller needs a fast failure, not a 15 s stall."""
         import time as _time
 
         host, port = address.rsplit(":", 1)
-        deadline = _time.monotonic() + 15.0
+        deadline = _time.monotonic() + grace_s
         while True:
             try:
                 conn = socket.create_connection((host, int(port)), 2.0)
@@ -265,21 +390,46 @@ class SocketFabric(Fabric):
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return conn
 
-    def send(self, address: str, msg: Message) -> None:
+    def _mark_dead(self, address: str) -> None:
         with self._conn_lock:
+            self._dead.add(address)
+            conn = self._conns.pop(address, None)
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _transmit(self, address: str, msg: Message) -> None:
+        with self._conn_lock:
+            if address in self._dead:
+                raise PeerDeadError(address)
             conn = self._conns.get(address)
         if conn is None:
             # dial OUTSIDE the lock so a slow-starting peer doesn't stall
             # sends to already-connected peers
-            conn = self._connect(address)
+            try:
+                grace = 0.0 if address in self._ever_connected else 15.0
+                conn = self._connect(address, grace_s=grace)
+            except OSError:
+                self._mark_dead(address)
+                raise PeerDeadError(address) from None
             with self._conn_lock:
+                self._ever_connected.add(address)
                 winner = self._conns.setdefault(address, conn)
             if winner is not conn:
                 conn.close()
                 conn = winner
         body = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
-        with self._conn_lock:
-            conn.sendall(struct.pack("<I", len(body)) + body)
+        try:
+            with self._conn_lock:
+                conn.sendall(struct.pack("<I", len(body)) + body)
+        except OSError:
+            # the peer process died under an established connection: fail
+            # the send fast (the engine converts this to SEND_TIMEOUT)
+            # instead of silently dropping every later message
+            self._mark_dead(address)
+            raise PeerDeadError(address) from None
 
     def close(self) -> None:
         self._closing = True
@@ -288,9 +438,19 @@ class SocketFabric(Fabric):
         except OSError:
             pass
         with self._conn_lock:
+            # accepted (inbound) connections must die too: leaving them
+            # open keeps peers' sends "succeeding" into a rank that no
+            # longer exists — the silent-drop failure mode.  Closing them
+            # gives peers a prompt RST -> PeerDeadError -> SEND_TIMEOUT.
             for c in self._conns.values():
                 try:
                     c.close()
                 except OSError:
                     pass
             self._conns.clear()
+            for c in self._accepted:
+                try:
+                    c.close()
+                except OSError:
+                    pass
+            self._accepted.clear()

@@ -344,11 +344,12 @@ def run_allreduce_with_tuning(global_arr, mesh, fn, wire_dtype, tuning,
 
 
 class _GangSlot:
-    def __init__(self, world: int, timeout_s: float):
+    def __init__(self, world: int, timeout_s: float, comm=None):
         self.calls: Dict[int, Tuple[CallOptions, Request]] = {}
         self.world = world
         self.deadline = time.monotonic() + timeout_s
         self.watchdog: Optional[threading.Timer] = None
+        self.comm = comm  # for absent-rank health attribution on timeout
 
 
 class XLAGangContext:
@@ -377,6 +378,37 @@ class XLAGangContext:
         # shared across the gang's rank handles — one collective on the
         # fast path bumps it exactly once, whatever the world size
         self.interactions = InteractionCounter()
+        # per-GLOBAL-rank (Rank.session) health, fed by the slot watchdog:
+        # a rank absent from a timed-out gang slot is "suspect"; two
+        # strikes make it "dead" and collectives addressing it fail fast
+        # instead of waiting out the watchdog again.  soft_reset clears it.
+        self.health: Dict[int, dict] = {}
+
+    _DEAD_AFTER_TIMEOUTS = 2
+
+    def _health_note_absent(self, session: int) -> None:
+        h = self.health.setdefault(
+            session,
+            {"state": "ok", "timeouts": 0, "failures": 0, "last_event": ""},
+        )
+        h["timeouts"] += 1
+        h["last_event"] = "gang_timeout"
+        h["state"] = (
+            "dead" if h["timeouts"] >= self._DEAD_AFTER_TIMEOUTS else "suspect"
+        )
+
+    def dead_rank_in(self, comm: Communicator) -> Optional[int]:
+        """Comm-relative rank of a member already marked dead (excluding
+        the local rank), or None."""
+        if not self.health:
+            return None
+        for i, r in enumerate(comm.ranks):
+            if i == comm.local_rank:
+                continue
+            h = self.health.get(r.session)
+            if h is not None and h["state"] == "dead":
+                return i
+        return None
 
     # -- communicator -> mesh -----------------------------------------------
     def submesh(self, comm: Communicator):
@@ -419,6 +451,29 @@ class XLAGangContext:
 
     def _submit_entry(self, comm: Communicator, entry: tuple):
         with self._lock:
+            dead = self.dead_rank_in(comm)
+            if dead is not None:
+                # fail fast: a member of this communicator is already
+                # marked dead by the watchdog accounting — assembling a
+                # slot would only burn the full deadline again.  No seq is
+                # consumed; recovery is the collective soft_reset.
+                h = dict(self.health.get(comm.ranks[dead].session, {}))
+        if dead is not None:
+            ctx = {
+                "comm": comm.id,
+                "peer": dead,
+                "attempts": h.get("timeouts", 0),
+                "elapsed_s": 0.0,
+            }
+            reqs = entry[1] if isinstance(entry[1], list) else [entry[1]]
+            opts = entry[0] if isinstance(entry[0], list) else [entry[0]]
+            for o, req in zip(opts, reqs):
+                req.complete(
+                    ErrorCode.RECEIVE_TIMEOUT,
+                    context=dict(ctx, op=o.op.name),
+                )
+            return
+        with self._lock:
             seq_key = (comm.id, comm.local_rank)
             seq = self._seq.get(seq_key, 0)
             self._seq[seq_key] = seq + 1
@@ -426,7 +481,7 @@ class XLAGangContext:
             slot = self._slots.get(slot_key)
             arm = False
             if slot is None:
-                slot = _GangSlot(comm.size, self.timeout_s)
+                slot = _GangSlot(comm.size, self.timeout_s, comm=comm)
                 self._slots[slot_key] = slot
                 arm = True  # exactly one watchdog per slot
             slot.calls[comm.local_rank] = entry
@@ -465,6 +520,7 @@ class XLAGangContext:
             self._slots.clear()
             self._seq.clear()
             self._asm_cache.clear()
+            self.health.clear()  # degradation state is part of the reset
         for slot in slots:
             if slot.watchdog is not None:
                 slot.watchdog.cancel()
@@ -492,9 +548,29 @@ class XLAGangContext:
                 live = self._slots.get(slot_key) is slot
                 if live:
                     del self._slots[slot_key]
+                    # health accounting: every member that never posted to
+                    # this starved slot takes a strike (graceful
+                    # degradation — two strikes mark it dead and later
+                    # collectives fail fast)
+                    absent = []
+                    if slot.comm is not None:
+                        for r in range(slot.world):
+                            if r not in slot.calls:
+                                absent.append(r)
+                                self._health_note_absent(
+                                    slot.comm.ranks[r].session
+                                )
             if live:
+                ctx = {
+                    "comm": slot_key[0],
+                    "peer": absent if len(absent) != 1 else absent[0],
+                    "elapsed_s": round(self.timeout_s, 3),
+                }
                 for req in self._slot_requests(slot):
-                    req.complete(ErrorCode.RECEIVE_TIMEOUT)
+                    req.complete(
+                        ErrorCode.RECEIVE_TIMEOUT,
+                        context=dict(ctx, op=req.op_name),
+                    )
 
         t = threading.Timer(max(0.01, slot.deadline - time.monotonic()), fire)
         t.daemon = True
@@ -1259,7 +1335,16 @@ class _P2PChannel:
             if idx is None:
                 return  # matched in the meantime: nothing to do
             del lst[idx]
-        entry[1].complete(code, time.perf_counter_ns() - entry[3])
+        dt = time.perf_counter_ns() - entry[3]
+        comm_id, _tag, src, dst = key
+        entry[1].complete(code, dt, context={
+            "op": entry[1].op_name,
+            "comm": comm_id,
+            # the absent partner: the sender for a starved recv, the
+            # receiver for a starved send (global rank identities)
+            "peer": src if code == ErrorCode.RECEIVE_TIMEOUT else dst,
+            "elapsed_s": round(dt / 1e9, 3),
+        })
 
     @staticmethod
     def _deliver(sink, rreq: Request, payload: np.ndarray, sreq,
@@ -1298,6 +1383,8 @@ class XLAEngine(StreamPortMixin, BaseEngine):
         self.timeout_s = DEFAULT_TIMEOUT_S
         self.max_eager_size = 32 * 1024
         self.max_rendezvous_size = MAX_EAGER_SIZE_LIMIT
+        self.retry_limit = 0
+        self.retry_backoff_s = 0.05
         self._init_streams()
 
     def start(self, options: CallOptions) -> Request:
@@ -1342,6 +1429,19 @@ class XLAEngine(StreamPortMixin, BaseEngine):
 
     def device_interactions(self) -> int:
         return self.gang.interactions.read()
+
+    def health_report(self, comm: Communicator) -> Dict[int, dict]:
+        """Per-peer health from the gang watchdog accounting, keyed by
+        comm-relative rank (capabilities()["health"] on the gang tier)."""
+        report: Dict[int, dict] = {}
+        for i, r in enumerate(comm.ranks):
+            if i == comm.local_rank:
+                continue
+            h = self.gang.health.get(r.session)
+            report[i] = dict(h) if h else {
+                "state": "ok", "timeouts": 0, "failures": 0, "last_event": ""
+            }
+        return report
 
     def _start_with(self, options: CallOptions, req: Request) -> None:
         op = options.op
@@ -1494,7 +1594,7 @@ class XLAEngine(StreamPortMixin, BaseEngine):
                 import traceback
 
                 traceback.print_exc()
-                if not req.test():
+                if not req.done():  # side-effect-free engine probe
                     req.complete(ErrorCode.INVALID_OPERATION)
 
         threading.Thread(target=run, daemon=True).start()
@@ -1643,6 +1743,16 @@ class XLAEngine(StreamPortMixin, BaseEngine):
             if val <= 0:
                 return ErrorCode.CONFIG_ERROR
             self.max_rendezvous_size = int(val)
+        elif fn == ConfigFunction.SET_RETRY_LIMIT:
+            # no wire retransmit on this tier (XLA owns the fabric); the
+            # knobs are accepted + stored so set_retry_policy is portable
+            if val < 0:
+                return ErrorCode.CONFIG_ERROR
+            self.retry_limit = int(val)
+        elif fn == ConfigFunction.SET_RETRY_BACKOFF:
+            if val <= 0:
+                return ErrorCode.CONFIG_ERROR
+            self.retry_backoff_s = float(val)
         elif fn == ConfigFunction.SET_TUNING:
             return self._apply_tuning(options)
         return ErrorCode.OK

@@ -32,6 +32,14 @@ class Rank:
 
 
 _comm_ids = itertools.count(0)
+# Epochs discriminate message seqn spaces between INSTANCES sharing a
+# deterministic comm id (create_communicator derives ids from membership,
+# so re-creating the same subgroup reuses the id while its sequence
+# counters restart at 0 — without the epoch, receiver-side dedup would
+# discard the fresh instance's traffic as duplicates).  Only uniqueness
+# per (sender process, comm id) matters, so a process-local counter works
+# across the socket tier too.
+_comm_epochs = itertools.count(1)
 
 
 class Communicator:
@@ -46,6 +54,7 @@ class Communicator:
         self.ranks: List[Rank] = list(ranks)
         self.local_rank = int(local_rank)
         self.id = next(_comm_ids) if comm_id is None else comm_id
+        self.epoch = next(_comm_epochs)
         self._lock = threading.Lock()
         # Per-peer monotone sequence numbers: ordering for eager matching.
         # (ref: inbound_seq/outbound_seq words in the exchange-memory comm
@@ -81,6 +90,16 @@ class Communicator:
     def advance_inbound_seq(self, peer: int) -> None:
         with self._lock:
             self._inbound_seq[peer] += 1
+
+    def reset_sequences(self) -> None:
+        """Zero every per-peer sequence counter (soft-reset recovery: after
+        a faulted collective dropped messages, peers' counters disagree —
+        every member resets so eager matching realigns)."""
+        with self._lock:
+            self.epoch = next(_comm_epochs)  # fresh seqn space
+            for i in self._outbound_seq:
+                self._outbound_seq[i] = 0
+                self._inbound_seq[i] = 0
 
     # -- derivation ---------------------------------------------------------
     def split(

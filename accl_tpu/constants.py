@@ -41,7 +41,18 @@ class Operation(enum.IntEnum):
 
 
 class ConfigFunction(enum.IntEnum):
-    """Sub-functions of Operation.CONFIG (ref constants.hpp:179-185)."""
+    """Sub-functions of Operation.CONFIG (ref constants.hpp:179-185).
+
+    ``RESET`` with value 0 is the light init-time reset; value >= 1 is the
+    FULL flush used by soft-reset recovery (rx pool, inbox, retransmit
+    window, dedup ledger, health map are all abandoned).
+
+    ``SET_RETRY_LIMIT`` / ``SET_RETRY_BACKOFF`` configure the emulated
+    tiers' eager retransmit protocol (``ACCL.set_retry_policy``): limit 0
+    disables it (fire-and-forget, the classic wire); limit N arms
+    per-segment ACKs with up to N retransmits at exponentially backed-off
+    intervals starting from the configured backoff seconds.
+    """
 
     RESET = 0
     ENABLE_TRANSPORT = 1
@@ -49,6 +60,8 @@ class ConfigFunction(enum.IntEnum):
     SET_MAX_EAGER_SIZE = 3
     SET_MAX_RENDEZVOUS_SIZE = 4
     SET_TUNING = 5
+    SET_RETRY_LIMIT = 6
+    SET_RETRY_BACKOFF = 7
 
 
 class TuningKey(enum.IntEnum):
@@ -276,13 +289,24 @@ class ACCLError(RuntimeError):
 
     Mirrors the exception surface of the reference host driver
     (``driver/xrt/src/accl.cpp:1210-1234`` check_return_value).
+
+    ``details`` carries structured failure context when the engine
+    recorded it — typically ``op`` (operation name), ``comm``
+    (communicator id), ``peer`` (the peer address/rank implicated),
+    ``attempts`` (retry/failure count) and ``elapsed_s`` — so chaos-plane
+    failures are diagnosable without log spelunking.
     """
 
-    def __init__(self, code: ErrorCode, context: str = ""):
+    def __init__(self, code: ErrorCode, context: str = "", details=None):
         self.code = ErrorCode(code)
+        self.details = dict(details) if details else {}
         msg = f"ACCL call failed [{ErrorCode.describe(self.code)}]"
         if context:
             msg += f" during {context}"
+        if self.details:
+            msg += " (" + ", ".join(
+                f"{k}={v}" for k, v in sorted(self.details.items())
+            ) + ")"
         super().__init__(msg)
 
 
@@ -296,6 +320,8 @@ MAX_EAGER_SIZE_LIMIT = 16 * 1024 * 1024
 DEFAULT_RX_BUFFER_COUNT = 16
 DEFAULT_RX_BUFFER_SIZE = 4 * 1024  # bytes per eager RX buffer / segment
 DEFAULT_TIMEOUT_S = 30.0
+DEFAULT_RETRY_BACKOFF_S = 0.05  # first retransmit delay (doubles per try)
+MAX_RETRY_LIMIT = 64  # sanity ceiling for SET_RETRY_LIMIT
 
 # Tuning-parameter surface (ref ccl_offload_control.h:86-90, accl.cpp:1198-1208):
 # thresholds steering flat-tree vs binary-tree vs ring algorithm selection.

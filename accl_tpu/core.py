@@ -118,10 +118,15 @@ class ACCL:
         contract: every rank handle of the group must call it, with no
         new collectives in flight, before any rank resumes work —
         afterwards gang sequence counters are realigned and the engine is
-        fully usable.  Mirrors the init sequence: RESET clears transport
-        state on the engine tiers, so it is re-enabled here the same way
-        ``_initialize`` does."""
-        self._config(ConfigFunction.RESET, 0)
+        fully usable.  RESET value 1 requests the FULL flush (rx pool,
+        inbox, retransmit window, dedup ledger, health map) on the
+        emulated tiers — the recovery path after injected faults — and
+        the facade realigns its communicators' per-peer sequence counters
+        to match.  Transport is re-enabled the same way ``_initialize``
+        does."""
+        self._config(ConfigFunction.RESET, 1)
+        for comm in self._communicators:
+            comm.reset_sequences()
         self._config(ConfigFunction.ENABLE_TRANSPORT, 1)
 
     def set_timeout(self, seconds: float) -> None:
@@ -133,6 +138,18 @@ class ACCL:
 
     def set_max_rendezvous_size(self, nbytes: int) -> None:
         self._config(ConfigFunction.SET_MAX_RENDEZVOUS_SIZE, nbytes)
+
+    def set_retry_policy(self, limit: int, backoff_s: float = 0.05) -> None:
+        """Arm (or with ``limit=0`` disarm) the eager retransmit protocol
+        on the emulated tiers: each eager segment requests an ACK and is
+        re-sent up to ``limit`` times with exponential backoff starting at
+        ``backoff_s`` while unacked; receiver-side seqn dedup keeps the
+        duplicates value-correct.  Retry exhaustion marks the peer dead in
+        the health map (``capabilities()["health"]``) so later collectives
+        fail fast instead of hanging.  Device tiers accept and store the
+        knobs (their fabric is XLA's; there is no host retransmit)."""
+        self._config(ConfigFunction.SET_RETRY_LIMIT, limit)
+        self._config(ConfigFunction.SET_RETRY_BACKOFF, backoff_s)
 
     def set_tuning(self, key, value) -> None:
         """Write a runtime tuning register (ref configure_tuning_parameters,
@@ -859,7 +876,19 @@ class ACCL:
         return ""
 
     def dump_communicator(self, comm: Optional[Communicator] = None) -> str:
-        return (comm or self._world).dump()
+        comm = comm or self._world
+        out = comm.dump()
+        health = self.engine.health_report(comm)
+        for i in sorted(health):
+            h = health[i]
+            out += (
+                f"\n  health rank {i}: {h.get('state', 'ok')}"
+                f" timeouts={h.get('timeouts', 0)}"
+                f" failures={h.get('failures', 0)}"
+            )
+            if h.get("last_event"):
+                out += f" last={h['last_event']}"
+        return out
 
     def capabilities(self) -> dict:
         """Capability report — the role of the reference's HWID idcode
@@ -893,6 +922,11 @@ class ACCL:
             # the single-interaction contract — one collective on the
             # gang fast path moves this by exactly 1
             "device_interactions": self.engine.device_interactions(),
+            # graceful-degradation map: per-peer state for the world
+            # communicator, keyed by rank — fed by timeout/retry
+            # accounting (emulator tiers) and the gang slot watchdog
+            # (XLA tier); a peer marked "dead" fails collectives fast
+            "health": self.engine.health_report(self._world),
         }
         # platform only when a jax BACKEND is already initialized: first
         # backend discovery is a side effect a read-only report must not

@@ -1,0 +1,371 @@
+"""The chaos plane: deterministic fault injection for the emulated fabrics.
+
+The reference's failure machinery — the ``NOT_READY_ERROR`` retry stream
+(``ccl_offload_control.c:2460-2478``), the error-code bitmask
+(``constants.hpp:355-393``), ``check_return_value`` — exists so a lossy,
+stalling network produces *diagnosable error codes* instead of hangs.  This
+module supplies the lossy network: a serializable, seeded :class:`FaultPlan`
+of :class:`FaultRule` s installed on a fabric (``InProcFabric`` /
+``SocketFabric``), matched against every message on the send path.
+
+Actions:
+
+* ``drop``      — the message vanishes (a lossy link)
+* ``delay``     — delivery postponed by ``delay_s`` (a congested link)
+* ``duplicate`` — the message is transmitted twice (a retransmitting NIC)
+* ``corrupt``   — payload bytes flipped; the wire checksum (``Message.csum``)
+  still carries the ORIGINAL digest, so the receiving dataplane detects and
+  discards it (bit errors on the wire)
+* ``kill_rank`` — the rule's ``rank`` dies: its outbound traffic vanishes
+  and sends addressed to it raise :class:`PeerDeadError` (fast failure, the
+  engine converts it to ``SEND_TIMEOUT``)
+* ``partition`` — the fabric splits into ``groups``; traffic crossing the
+  cut vanishes silently in both directions
+
+Determinism: rule firing is driven purely by per-rule match counters
+(``nth`` / ``count``) and corruption bytes by the plan-seeded RNG, so the
+same plan against the same traffic replays to the same outcome.  Plans
+round-trip through JSON and the ``ACCL_FAULT_PLAN`` environment variable,
+which the one-process-per-rank ``SocketFabric`` tier reads at construction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import os
+import random
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+#: environment variable holding a JSON-serialized FaultPlan; read by
+#: SocketFabric so spawned per-rank processes inherit the plan
+FAULT_PLAN_ENV = "ACCL_FAULT_PLAN"
+
+
+class PeerDeadError(RuntimeError):
+    """A send addressed a dead/detached endpoint.  The engine converts this
+    into a fast SEND_TIMEOUT completion instead of waiting out the call
+    deadline (the silent-drop failure mode noted at fabric.py:222)."""
+
+    def __init__(self, address: str):
+        self.address = address
+        super().__init__(f"peer at {address} is dead/detached")
+
+
+class FaultAction(str, enum.Enum):
+    DROP = "drop"
+    DELAY = "delay"
+    DUPLICATE = "duplicate"
+    CORRUPT = "corrupt"
+    KILL_RANK = "kill_rank"
+    PARTITION = "partition"
+
+
+@dataclasses.dataclass
+class FaultRule:
+    """One matchable fault.
+
+    Match fields (``None`` = wildcard): ``comm`` (communicator id), ``src`` /
+    ``dst`` (comm-relative ranks from the message header), ``tag``,
+    ``msg_type`` (a ``MsgType`` name like ``"EAGER"`` or its int value).
+
+    Firing: the rule counts matching messages; it applies from the
+    ``nth`` matching occurrence on (1-based, default 1) for at most
+    ``count`` applications (``None`` = unlimited).  ``nth=0`` makes
+    ``kill_rank`` / ``partition`` active from installation, with no
+    trigger message required.
+
+    Action parameters: ``delay_s`` (delay), ``rank`` (kill_rank, the
+    comm-relative rank to kill), ``groups`` (partition, a list of rank
+    lists defining the islands).
+    """
+
+    action: FaultAction
+    comm: Optional[int] = None
+    src: Optional[int] = None
+    dst: Optional[int] = None
+    tag: Optional[int] = None
+    msg_type: Optional[object] = None  # MsgType name (str) or int value
+    nth: int = 1
+    count: Optional[int] = None
+    delay_s: float = 0.1
+    rank: Optional[int] = None
+    groups: Optional[List[List[int]]] = None
+
+    def __post_init__(self):
+        self.action = FaultAction(self.action)
+        if self.action == FaultAction.KILL_RANK and self.rank is None:
+            raise ValueError("kill_rank rule needs a rank")
+        if self.action == FaultAction.PARTITION and not self.groups:
+            raise ValueError("partition rule needs groups")
+
+    def matches(self, msg) -> bool:
+        if self.comm is not None and msg.comm_id != self.comm:
+            return False
+        if self.src is not None and msg.src != self.src:
+            return False
+        if self.dst is not None and msg.dst != self.dst:
+            return False
+        if self.tag is not None and msg.tag != self.tag:
+            return False
+        if self.msg_type is not None:
+            mt = msg.msg_type
+            if isinstance(self.msg_type, str):
+                if getattr(mt, "name", str(mt)) != self.msg_type:
+                    return False
+            elif int(mt) != int(self.msg_type):
+                return False
+        return True
+
+    def to_dict(self) -> dict:
+        d = {"action": self.action.value}
+        for f in ("comm", "src", "dst", "tag", "msg_type", "count",
+                  "rank", "groups"):
+            v = getattr(self, f)
+            if v is not None:
+                d[f] = v
+        if self.nth != 1:
+            d["nth"] = self.nth
+        if self.action == FaultAction.DELAY:
+            d["delay_s"] = self.delay_s
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FaultRule":
+        return cls(**d)
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """A seeded, serializable list of fault rules."""
+
+    rules: List[FaultRule] = dataclasses.field(default_factory=list)
+    seed: int = 0
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {"seed": self.seed, "rules": [r.to_dict() for r in self.rules]},
+            sort_keys=True,
+        )
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultPlan":
+        d = json.loads(text)
+        return cls(
+            rules=[FaultRule.from_dict(r) for r in d.get("rules", [])],
+            seed=int(d.get("seed", 0)),
+        )
+
+    def to_env(self) -> str:
+        """The value to place in ``ACCL_FAULT_PLAN`` so one-process-per-rank
+        fabrics pick the plan up at construction."""
+        return self.to_json()
+
+    @classmethod
+    def from_env(cls, environ=None) -> Optional["FaultPlan"]:
+        text = (environ or os.environ).get(FAULT_PLAN_ENV)
+        if not text:
+            return None
+        return cls.from_json(text)
+
+
+class _Verdict:
+    """What the injector decided for one message."""
+
+    __slots__ = ("drop", "dead_dst", "duplicate", "corrupt", "delay_s")
+
+    def __init__(self):
+        self.drop = False
+        self.dead_dst = False
+        self.duplicate = False
+        self.corrupt = False
+        self.delay_s = 0.0
+
+
+class FaultInjector:
+    """Runtime state of an installed :class:`FaultPlan` on one fabric.
+
+    Thread-safe (multiple rank engines share the InProc fabric).  Keeps a
+    bounded log of applied faults for replay/determinism assertions and
+    per-rule fire counters for introspection.
+    """
+
+    _LOG_CAP = 10000
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+        self._lock = threading.Lock()
+        self._disabled = False
+        self._matched = [0] * len(plan.rules)
+        self.applied = [0] * len(plan.rules)
+        self._rng = random.Random(plan.seed)
+        self.log: List[dict] = []
+        # (comm_scope, rank) pairs currently dead; comm_scope is the rule's
+        # comm match (None = any communicator)
+        self._dead: Set[Tuple[Optional[int], int]] = set()
+        # active partitions: (comm_scope, rank -> island index)
+        self._partitions: List[Tuple[Optional[int], Dict[int, int]]] = []
+        for i, rule in enumerate(plan.rules):
+            if rule.nth == 0:
+                if rule.action == FaultAction.KILL_RANK:
+                    self._dead.add((rule.comm, rule.rank))
+                elif rule.action == FaultAction.PARTITION:
+                    self._partitions.append(
+                        (rule.comm, self._island_map(rule.groups))
+                    )
+
+    @staticmethod
+    def _island_map(groups: List[List[int]]) -> Dict[int, int]:
+        return {r: i for i, grp in enumerate(groups) for r in grp}
+
+    # -- queries -------------------------------------------------------------
+    def rank_dead(self, comm_id: int, rank: int) -> bool:
+        with self._lock:
+            return (None, rank) in self._dead or (comm_id, rank) in self._dead
+
+    def clear(self) -> None:
+        """Heal the network: deactivate kills/partitions and stop firing
+        rules (counters keep their history for inspection)."""
+        with self._lock:
+            self._dead.clear()
+            self._partitions.clear()
+            self._disabled = True
+
+    # -- the send-path hook --------------------------------------------------
+    def on_send(self, msg) -> _Verdict:
+        v = _Verdict()
+        with self._lock:
+            if self._disabled:
+                return v
+            # standing network state first: dead ranks and partitions
+            if self._is_dead(msg.comm_id, msg.dst):
+                v.dead_dst = True
+                self._log("dead_dst", None, msg)
+                return v
+            if self._is_dead(msg.comm_id, msg.src):
+                v.drop = True
+                self._log("dead_src_drop", None, msg)
+                return v
+            if self._crosses_partition(msg):
+                v.drop = True
+                self._log("partition_drop", None, msg)
+                return v
+            for i, rule in enumerate(self.plan.rules):
+                if rule.action in (FaultAction.KILL_RANK,
+                                   FaultAction.PARTITION) and rule.nth == 0:
+                    continue  # install-time rules never fire per-message
+                if not rule.matches(msg):
+                    continue
+                self._matched[i] += 1
+                if self._matched[i] < max(rule.nth, 1):
+                    continue
+                if rule.count is not None and self.applied[i] >= rule.count:
+                    continue
+                self.applied[i] += 1
+                self._log(rule.action.value, i, msg)
+                if rule.action == FaultAction.DROP:
+                    v.drop = True
+                    return v
+                if rule.action == FaultAction.DELAY:
+                    v.delay_s = max(v.delay_s, float(rule.delay_s))
+                elif rule.action == FaultAction.DUPLICATE:
+                    v.duplicate = True
+                elif rule.action == FaultAction.CORRUPT:
+                    v.corrupt = True
+                elif rule.action == FaultAction.KILL_RANK:
+                    self._dead.add((rule.comm, rule.rank))
+                    if msg.dst == rule.rank:
+                        v.dead_dst = True
+                        return v
+                elif rule.action == FaultAction.PARTITION:
+                    island = self._island_map(rule.groups)
+                    self._partitions.append((rule.comm, island))
+                    if self._crosses_partition(msg):
+                        v.drop = True
+                        return v
+        return v
+
+    def _is_dead(self, comm_id: int, rank: int) -> bool:
+        return (None, rank) in self._dead or (comm_id, rank) in self._dead
+
+    def _crosses_partition(self, msg) -> bool:
+        for comm_scope, island in self._partitions:
+            if comm_scope is not None and msg.comm_id != comm_scope:
+                continue
+            a, b = island.get(msg.src), island.get(msg.dst)
+            if a is not None and b is not None and a != b:
+                return True
+        return False
+
+    def corrupt_payload(self, payload: bytes) -> bytes:
+        """Flip one byte at a plan-seeded position (deterministic given the
+        same sequence of corruption events)."""
+        if not payload:
+            return payload
+        with self._lock:
+            pos = self._rng.randrange(len(payload))
+            flip = self._rng.randrange(1, 256)
+        out = bytearray(payload)
+        out[pos] ^= flip
+        return bytes(out)
+
+    def _log(self, action: str, rule_index, msg) -> None:
+        if len(self.log) >= self._LOG_CAP:
+            return
+        self.log.append({
+            "action": action,
+            "rule": rule_index,
+            "msg_type": getattr(msg.msg_type, "name", str(msg.msg_type)),
+            "comm": msg.comm_id,
+            "src": msg.src,
+            "dst": msg.dst,
+            "tag": msg.tag,
+            "seqn": msg.seqn,
+        })
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "matched": list(self._matched),
+                "applied": list(self.applied),
+                "events": len(self.log),
+                "dead": sorted(self._dead),
+                "partitions": len(self._partitions),
+            }
+
+
+class SeqnLedger:
+    """Receiver-side duplicate detection for eager segments.
+
+    Sequence numbers are allocated monotonically per (communicator, peer)
+    pair (``Communicator.next_outbound_seq``), so the receiving dataplane
+    can discard any seqn it has already accepted — which makes both the
+    ``duplicate`` fault and sender retransmits value-correct.  Memory is
+    O(out-of-order window): a contiguous floor plus a small ahead-set.
+    """
+
+    def __init__(self):
+        self._floor: Dict[tuple, int] = {}
+        self._ahead: Dict[tuple, set] = {}
+
+    def seen(self, key: tuple, seqn: int) -> bool:
+        """Record ``seqn`` for ``key``; True when it was already recorded
+        (i.e. this message is a duplicate)."""
+        floor = self._floor.get(key, -1)
+        if seqn <= floor:
+            return True
+        ahead = self._ahead.setdefault(key, set())
+        if seqn in ahead:
+            return True
+        ahead.add(seqn)
+        while floor + 1 in ahead:
+            floor += 1
+            ahead.discard(floor)
+        self._floor[key] = floor
+        return False
+
+    def clear(self) -> None:
+        self._floor.clear()
+        self._ahead.clear()

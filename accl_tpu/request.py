@@ -46,6 +46,9 @@ class Request:
         self._duration_ns: int = 0
         # backend-private payload (e.g. the engine call record)
         self.payload: Any = None
+        # structured failure context recorded by the engine at completion
+        # (op/comm/peer/attempts/elapsed) — surfaced via ACCLError.details
+        self.error_context: Optional[dict] = None
         # lazy-adoption state: the unresolved device-side result (e.g. an
         # output shard / p2p payload) and the thunk that materializes it
         # into the user's buffer.  Set by the engine BEFORE complete().
@@ -61,8 +64,15 @@ class Request:
     def mark_executing(self) -> None:
         self._status = RequestStatus.EXECUTING
 
-    def complete(self, retcode: ErrorCode, duration_ns: int = 0) -> None:
+    def complete(
+        self,
+        retcode: ErrorCode,
+        duration_ns: int = 0,
+        context: Optional[dict] = None,
+    ) -> None:
         self._retcode = ErrorCode(retcode)
+        if context is not None:
+            self.error_context = context
         self._duration_ns = int(duration_ns)
         self._status = RequestStatus.COMPLETED
         with self._cb_lock:
@@ -173,7 +183,10 @@ class Request:
         if self._done.is_set():
             self.materialize()
         if self._retcode != ErrorCode.OK:
-            raise ACCLError(self._retcode, context or self.op_name)
+            raise ACCLError(
+                self._retcode, context or self.op_name,
+                details=self.error_context,
+            )
 
 
 class CommandQueue:

@@ -120,6 +120,34 @@ def main() -> int:
         leaks = [ln for ln in rx.splitlines()
                  if "rxbuf" in ln and "IDLE" not in ln]
         di = a.engine.device_interactions() - di0
+
+        # fault-recovery phase: one injected drop-and-recover round.  The
+        # device tier's fault mode is "a peer never arrives", so induce a
+        # recv whose sender does not exist, assert the watchdog converts
+        # it to a FAST structured failure (not a hang), soft-reset, and
+        # verify the engine serves collectives again with a clean rx dump.
+        fault = {"injected": 0, "recovered": False, "rx_leaks": ["unrun"]}
+        a.set_timeout(1.0)
+        probe = a.create_buffer(8, np.float32)
+        t_f = time.monotonic()
+        try:
+            a.recv(probe, 8, src=0, tag=0x7A7A)  # dropped: no sender
+        except Exception as e:
+            fault["injected"] = 1
+            fault["error"] = type(e).__name__
+            fault["details"] = getattr(e, "details", {})
+        fault["fail_seconds"] = round(time.monotonic() - t_f, 2)
+        a.soft_reset()
+        a.set_timeout(180.0)
+        rs = a.create_buffer_from(np.ones(64, np.float32))
+        rd = a.create_buffer(64, np.float32)
+        a.allreduce(rs, rd, 64)
+        rd.sync_from_device()
+        fault["recovered"] = bool(np.allclose(rd.data[:64], 1.0))
+        fault["rx_leaks"] = [
+            ln for ln in a.dump_rx_buffers().splitlines()
+            if "rxbuf" in ln and "IDLE" not in ln
+        ]
         print(json.dumps({
             "iters": iters, "ops": ops, "seconds": round(dt, 1),
             "ops_per_s": round(ops / dt, 2), "rx_leaks": leaks,
@@ -129,8 +157,15 @@ def main() -> int:
             "device_interactions": di,
             "interactions_per_op": round(di / max(ops, 1), 2),
             "device": jax.devices()[0].device_kind,
+            "fault_recovery": fault,
         }))
-        return 0 if not leaks else 1
+        ok = (
+            not leaks
+            and fault["injected"] == 1
+            and fault["recovered"]
+            and fault["rx_leaks"] == []
+        )
+        return 0 if ok else 1
     finally:
         for x in g:
             x.deinit()

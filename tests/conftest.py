@@ -106,6 +106,25 @@ def rng():
     return np.random.default_rng(42)
 
 
+@pytest.fixture
+def fault_plan():
+    """Factory for chaos-plane fault plans: rules as dicts (or FaultRule
+    instances), an optional ``seed`` kwarg; install the result on a fabric
+    with ``engine.fabric.install_fault_plan(plan)``."""
+    from accl_tpu.faults import FaultPlan, FaultRule
+
+    def make(*rules, seed=1234):
+        return FaultPlan(
+            rules=[
+                r if isinstance(r, FaultRule) else FaultRule(**r)
+                for r in rules
+            ],
+            seed=seed,
+        )
+
+    return make
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",
@@ -114,6 +133,14 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "tpu: real-chip tier (opt-in via ACCL_TPU_TIER=1)",
+    )
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection (chaos-plane) tests",
+    )
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running soaks excluded from the tier-1 fast run",
     )
 
 

@@ -4,17 +4,47 @@ Mirrors the reference's error-code/timeout machinery (constants.hpp:355-393,
 check_return_value accl.cpp:1210-1234, HOUSEKEEP_TIMEOUT).
 """
 
+import socket as socketlib
 import threading
 
 import numpy as np
 import pytest
 
-from accl_tpu import ACCLError, ErrorCode, emulated_group
+from accl_tpu import ACCLError, ErrorCode, emulated_group, socket_group_member
 
 
-@pytest.fixture()
-def fresh_group2():
-    g = emulated_group(2)
+def _free_addresses(n):
+    """Pre-pick n free localhost ports for an in-process socket group."""
+    socks, addrs = [], []
+    for _ in range(n):
+        s = socketlib.socket()
+        s.setsockopt(socketlib.SOL_SOCKET, socketlib.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        addrs.append(f"127.0.0.1:{s.getsockname()[1]}")
+    for s in socks:
+        s.close()
+    return addrs
+
+
+@pytest.fixture(params=["inproc", "socket"])
+def fresh_group2(request):
+    """Both emulator transports: the InProc CI tier AND the TCP socket
+    tier (in one process), so the socket fabric's timeout/recovery paths
+    are exercised by the same failure matrix instead of staying untested."""
+    if request.param == "socket":
+        last = None
+        for _ in range(3):  # a pre-picked port can be re-grabbed: retry
+            try:
+                addrs = _free_addresses(2)
+                g = [socket_group_member(i, addrs) for i in range(2)]
+                break
+            except OSError as e:
+                last = e
+        else:
+            raise last
+    else:
+        g = emulated_group(2)
     yield g
     for a in g:
         a.deinit()
@@ -66,6 +96,93 @@ def test_config_validation(fresh_group2):
         a.set_max_eager_size(10**9)
     with pytest.raises(ACCLError):
         a.set_timeout(-1)
+
+
+def test_request_wait_timeout_leaves_request_unpoisoned():
+    """Request.wait(timeout) expiring on an in-flight call returns False,
+    leaves status/retcode untouched, and a later wait() adopts the
+    deferred result exactly once."""
+    import time
+
+    from accl_tpu.request import Request, RequestStatus
+
+    req = Request(op_name="probe")
+    req.mark_executing()
+    adopted = []
+    req.defer_result(lambda: adopted.append(1))
+
+    assert req.wait(0.05) is False
+    assert req.status == RequestStatus.EXECUTING  # not poisoned
+    assert req.get_retcode() == ErrorCode.OK
+    assert adopted == []  # the deferred result must NOT run on a miss
+    assert req.wait(0.05) is False  # repeatable while still in flight
+
+    t = threading.Timer(0.2, lambda: req.complete(ErrorCode.OK, 5))
+    t.start()
+    assert req.wait(5.0) is True
+    assert adopted == [1]  # adopted on the first successful wait
+    assert req.wait() is True
+    req.test()
+    req.check()
+    assert adopted == [1]  # ... and exactly once
+    assert req.get_duration_ns() == 5
+
+
+def test_request_wait_timeout_on_inflight_engine_call(fresh_group2):
+    """The same contract against a real engine call: an expiring wait on a
+    not-yet-matched recv does not disturb the call, which then completes
+    normally once the sender arrives."""
+    a, b = fresh_group2
+    buf = a.create_buffer(10, np.float32)
+    req = a.recv(buf, 10, src=1, tag=11, run_async=True)
+    assert req.wait(0.1) is False  # in flight: no sender yet
+    assert req.get_retcode() == ErrorCode.OK
+
+    sb = b.create_buffer_from(np.full(10, 9.0, np.float32))
+    b.send(sb, 10, dst=0, tag=11)
+    assert req.wait(10.0) is True
+    req.check()
+    buf.sync_from_device()
+    np.testing.assert_array_equal(buf.data, np.full(10, 9.0, np.float32))
+
+
+def test_socket_dead_peer_send_times_out_fast():
+    """Satellite: a socket peer whose process/fabric dies must surface
+    SEND_TIMEOUT promptly on later sends — not silently drop them or wait
+    out the full call deadline (the fabric.py:222 failure mode)."""
+    import time
+
+    addrs = _free_addresses(2)
+    g = [socket_group_member(i, addrs) for i in range(2)]
+    a, b = g
+    try:
+        # a real exchange first, so the connection exists
+        sb = b.create_buffer_from(np.arange(8, dtype=np.float32))
+        t = threading.Thread(
+            target=lambda: b.send(sb, 8, dst=0, tag=1), daemon=True
+        )
+        t.start()
+        rb = a.create_buffer(8, np.float32)
+        a.recv(rb, 8, src=1, tag=1)
+        t.join(10)
+
+        # rank 0 dies (its fabric closes: listener + connections gone)
+        a.deinit()
+        b.set_timeout(30.0)  # the FULL deadline we must NOT wait out
+        t0 = time.monotonic()
+        with pytest.raises(ACCLError) as exc:
+            # one send may land in the OS buffer of the dead connection;
+            # the follow-up hits the reset and must fail fast
+            for i in range(4):
+                b.send(sb, 8, dst=0, tag=2 + i)
+        elapsed = time.monotonic() - t0
+        assert exc.value.code == ErrorCode.SEND_TIMEOUT
+        assert elapsed < 10.0, f"dead-peer send took {elapsed:.1f}s"
+        # the peer is marked dead in the health map
+        assert b.capabilities()["health"][0]["state"] == "dead"
+    finally:
+        for x in g[1:]:
+            x.deinit()
 
 
 def test_engine_survives_errors(fresh_group2):
@@ -131,6 +248,60 @@ def test_xla_gang_recovers_after_soft_reset():
         # recovery protocol: every rank soft-resets, then work resumes
         for x in g:
             x.soft_reset()
+
+        def work(accl, rank):
+            s = accl.create_buffer_from(
+                np.full(16, float(rank + 1), np.float32)
+            )
+            d = accl.create_buffer(16, np.float32)
+            accl.allreduce(s, d, 16)
+            d.sync_from_device()
+            return float(d.data[0])
+
+        assert run_parallel(g, work) == [3.0, 3.0]
+    finally:
+        for x in g:
+            x.deinit()
+
+
+def test_xla_gang_health_degrades_and_fails_fast():
+    """The gang slot watchdog feeds the per-peer health map: an absent
+    rank goes suspect -> dead (two strikes), after which collectives
+    addressing it fail fast instead of re-burning the watchdog deadline;
+    soft_reset clears the verdict."""
+    import time
+
+    from accl_tpu.core import xla_group
+    from helpers import run_parallel
+
+    g = xla_group(2)
+    try:
+        a = g[0]
+        a.set_timeout(0.3)
+        send = a.create_buffer_from(np.ones(16, np.float32))
+        recv = a.create_buffer(16, np.float32)
+        with pytest.raises(ACCLError) as exc:
+            a.allreduce(send, recv, 16)  # strike 1
+        assert exc.value.details["peer"] == 1
+        assert a.capabilities()["health"][1]["state"] == "suspect"
+        with pytest.raises(ACCLError):
+            a.allreduce(send, recv, 16)  # strike 2 -> dead
+        health = a.capabilities()["health"][1]
+        assert health["state"] == "dead" and health["timeouts"] == 2
+        assert "health rank 1: dead" in a.dump_communicator()
+
+        a.set_timeout(10)  # a deadline we must NOT wait out
+        t0 = time.monotonic()
+        with pytest.raises(ACCLError) as exc:
+            a.allreduce(send, recv, 16)
+        assert time.monotonic() - t0 < 2.0
+        assert exc.value.code == ErrorCode.RECEIVE_TIMEOUT
+        assert exc.value.details["elapsed_s"] == 0.0  # failed at intake
+
+        # collective recovery: reset clears the health verdict
+        for x in g:
+            x.soft_reset()
+        assert a.capabilities()["health"][1]["state"] == "ok"
 
         def work(accl, rank):
             s = accl.create_buffer_from(

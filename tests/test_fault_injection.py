@@ -1,0 +1,568 @@
+"""Chaos plane: every fault action either recovers transparently or fails
+fast with the right error code — never a hang (ISSUE 2 acceptance).
+
+The matrix runs on the InProc emulator tier (fast, tier-1); the
+rank-death/partition soak and the socket-tier env-var round trip carry the
+``slow`` marker.  Everything here is marked ``chaos``.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from accl_tpu import (
+    ACCLError,
+    ErrorCode,
+    FAULT_PLAN_ENV,
+    FaultPlan,
+    FaultRule,
+    emulated_group,
+)
+from helpers import run_parallel
+
+pytestmark = pytest.mark.chaos
+
+
+def _deinit(group):
+    for a in group:
+        a.deinit()
+
+
+def _send_recv(a, b, data, tag=3, timeout=10.0):
+    """b sends ``data`` to a; returns the received array."""
+    count = data.size
+    sb = b.create_buffer_from(data)
+    err = []
+
+    def sender():
+        try:
+            b.send(sb, count, dst=0, tag=tag)
+        except Exception as e:  # surfaced by the caller
+            err.append(e)
+
+    t = threading.Thread(target=sender, daemon=True)
+    t.start()
+    rb = a.create_buffer(count, np.float32)
+    a.recv(rb, count, src=1, tag=tag)
+    t.join(timeout)
+    if err:
+        raise err[0]
+    rb.sync_from_device()
+    return rb.data[:count]
+
+
+# ---------------------------------------------------------------------------
+# drop / delay / duplicate / corrupt — the tier-1 fast matrix
+# ---------------------------------------------------------------------------
+
+
+def test_drop_with_retransmit_recovers(fault_plan):
+    """A dropped eager segment is retransmitted after backoff and the
+    transfer completes bit-correct; the rx pool ends clean."""
+    g = emulated_group(2)
+    a, b = g
+    try:
+        inj = a.engine.fabric.install_fault_plan(fault_plan(
+            dict(action="drop", msg_type="EAGER", src=1, dst=0, nth=1,
+                 count=1),
+        ))
+        for x in g:
+            x.set_retry_policy(5, 0.05)
+        data = np.arange(100, dtype=np.float32)
+        out = _send_recv(a, b, data)
+        np.testing.assert_array_equal(out, data)
+        assert [e["action"] for e in inj.log] == ["drop"]
+        assert a.engine.rx_pool.occupancy()[0] == 0
+    finally:
+        _deinit(g)
+
+
+def test_drop_without_retry_times_out_with_code(fault_plan):
+    """No retry policy: the drop surfaces as RECEIVE_TIMEOUT within the
+    configured deadline, with structured ACCLError context."""
+    g = emulated_group(2)
+    a, b = g
+    try:
+        a.engine.fabric.install_fault_plan(fault_plan(
+            dict(action="drop", msg_type="EAGER", src=1, dst=0),
+        ))
+        a.set_timeout(0.3)
+        data = np.arange(16, dtype=np.float32)
+        sb = b.create_buffer_from(data)
+        b.send(sb, 16, dst=0, tag=9)
+        rb = a.create_buffer(16, np.float32)
+        t0 = time.monotonic()
+        with pytest.raises(ACCLError) as exc:
+            a.recv(rb, 16, src=1, tag=9)
+        assert time.monotonic() - t0 < 5.0
+        assert exc.value.code == ErrorCode.RECEIVE_TIMEOUT
+        assert exc.value.details["op"] == "RECV"
+        assert exc.value.details["peer"] == "inproc:1"
+        assert exc.value.details["elapsed_s"] >= 0.3
+    finally:
+        _deinit(g)
+
+
+def test_delay_recovers_transparently(fault_plan):
+    g = emulated_group(2)
+    a, b = g
+    try:
+        a.engine.fabric.install_fault_plan(fault_plan(
+            dict(action="delay", delay_s=0.15, msg_type="EAGER"),
+        ))
+        data = np.arange(64, dtype=np.float32)
+        t0 = time.monotonic()
+        out = _send_recv(a, b, data)
+        np.testing.assert_array_equal(out, data)
+        assert time.monotonic() - t0 >= 0.15  # the delay really happened
+        assert a.engine.rx_pool.occupancy()[0] == 0
+    finally:
+        _deinit(g)
+
+
+def test_duplicate_is_value_correct_and_leak_free(fault_plan):
+    """Every eager segment transmitted twice: seqn dedup discards the
+    copies — bit-correct result, zero slots leaked."""
+    g = emulated_group(2)
+    a, b = g
+    try:
+        inj = a.engine.fabric.install_fault_plan(fault_plan(
+            dict(action="duplicate", msg_type="EAGER"),
+        ))
+        data = np.arange(2048, dtype=np.float32)  # 8 KiB -> 2 segments
+        out = _send_recv(a, b, data)
+        np.testing.assert_array_equal(out, data)
+        assert any(e["action"] == "duplicate" for e in inj.log)
+        # give the scheduler a beat to route the duplicate copies, then
+        # verify they were discarded, not parked
+        deadline = time.monotonic() + 5
+        while a.engine.endpoint.pending() > 0:
+            if time.monotonic() > deadline:
+                break
+            time.sleep(0.01)
+        a.engine._wake.set()
+        time.sleep(0.05)
+        assert a.engine.rx_pool.occupancy()[0] == 0
+        assert a.engine.endpoint.pending() == 0
+    finally:
+        _deinit(g)
+
+
+def test_corrupt_detected_and_retransmitted(fault_plan):
+    """A corrupted payload fails the wire checksum, is discarded by the rx
+    dataplane, and the retransmit delivers a clean copy."""
+    g = emulated_group(2)
+    a, b = g
+    try:
+        a.engine.fabric.install_fault_plan(fault_plan(
+            dict(action="corrupt", msg_type="EAGER", nth=1, count=1),
+            seed=11,
+        ))
+        for x in g:
+            x.set_retry_policy(5, 0.05)
+        data = np.arange(512, dtype=np.float32)
+        out = _send_recv(a, b, data)
+        np.testing.assert_array_equal(out, data)
+        assert a.engine.endpoint.corrupt_drops == 1
+        assert a.engine.rx_pool.occupancy()[0] == 0
+    finally:
+        _deinit(g)
+
+
+def test_corrupt_without_retry_times_out(fault_plan):
+    g = emulated_group(2)
+    a, b = g
+    try:
+        a.engine.fabric.install_fault_plan(fault_plan(
+            dict(action="corrupt", msg_type="EAGER"),
+        ))
+        a.set_timeout(0.3)
+        data = np.arange(16, dtype=np.float32)
+        sb = b.create_buffer_from(data)
+        b.send(sb, 16, dst=0, tag=5)
+        rb = a.create_buffer(16, np.float32)
+        with pytest.raises(ACCLError) as exc:
+            a.recv(rb, 16, src=1, tag=5)
+        assert exc.value.code == ErrorCode.RECEIVE_TIMEOUT
+        assert a.engine.endpoint.corrupt_drops >= 1
+    finally:
+        _deinit(g)
+
+
+def test_retry_exhaustion_degrades_to_dead_peer(fault_plan):
+    """A blackholed link (every segment dropped) exhausts the retransmit
+    budget and marks the peer dead — fast failures thereafter."""
+    g = emulated_group(2)
+    a, b = g
+    try:
+        b.engine.fabric.install_fault_plan(fault_plan(
+            dict(action="drop", msg_type="EAGER", src=1, dst=0),
+        ))
+        b.set_retry_policy(2, 0.02)
+        sb = b.create_buffer_from(np.ones(8, np.float32))
+        b.send(sb, 8, dst=0, tag=2)  # completes (eager is buffered) ...
+        deadline = time.monotonic() + 5
+        while b.capabilities()["health"][0]["state"] != "dead":
+            if time.monotonic() > deadline:
+                raise AssertionError(
+                    f"peer never degraded: {b.capabilities()['health']}"
+                )
+            time.sleep(0.02)
+        # ... but the NEXT collective toward the dead peer fails fast
+        t0 = time.monotonic()
+        with pytest.raises(ACCLError) as exc:
+            b.send(sb, 8, dst=0, tag=3)
+        assert time.monotonic() - t0 < 1.0
+        assert exc.value.code == ErrorCode.SEND_TIMEOUT
+        assert "health rank 0: dead" in b.dump_communicator()
+    finally:
+        _deinit(g)
+
+
+# ---------------------------------------------------------------------------
+# kill_rank / partition
+# ---------------------------------------------------------------------------
+
+
+def test_kill_rank_fast_send_timeout_and_fail_fast(fault_plan):
+    g = emulated_group(3)
+    a = g[0]
+    try:
+        a.engine.fabric.install_fault_plan(fault_plan(
+            dict(action="kill_rank", rank=2, nth=0),
+        ))
+        sb = a.create_buffer_from(np.ones(4, np.float32))
+        t0 = time.monotonic()
+        with pytest.raises(ACCLError) as exc:
+            a.send(sb, 4, dst=2, tag=1)
+        assert time.monotonic() - t0 < 2.0  # fast, not the 30 s deadline
+        assert exc.value.code == ErrorCode.SEND_TIMEOUT
+        assert exc.value.details["peer"] == "inproc:2"
+        # the health map now reports the rank dead ...
+        assert a.capabilities()["health"][2]["state"] == "dead"
+        assert a.capabilities()["health"][1]["state"] == "ok"
+        # ... and a collective addressed at it fails fast at intake
+        rb = a.create_buffer(4, np.float32)
+        t0 = time.monotonic()
+        with pytest.raises(ACCLError) as exc:
+            a.allreduce(sb, rb, 4)
+        assert time.monotonic() - t0 < 1.0
+        assert exc.value.code == ErrorCode.SEND_TIMEOUT
+        assert exc.value.details["op"] == "ALLREDUCE"
+        # local ops keep working next to the dead neighbor
+        dst = a.create_buffer(4, np.float32)
+        a.copy(sb, dst)
+        dst.sync_from_device()
+        np.testing.assert_array_equal(dst.data, np.ones(4, np.float32))
+    finally:
+        _deinit(g)
+
+
+def test_recv_from_killed_rank_fails_fast_once_known(fault_plan):
+    g = emulated_group(2)
+    a = g[0]
+    try:
+        a.engine.fabric.install_fault_plan(fault_plan(
+            dict(action="kill_rank", rank=1, nth=0),
+        ))
+        sb = a.create_buffer_from(np.ones(4, np.float32))
+        with pytest.raises(ACCLError):
+            a.send(sb, 4, dst=1, tag=1)  # discovers the death
+        rb = a.create_buffer(4, np.float32)
+        t0 = time.monotonic()
+        with pytest.raises(ACCLError) as exc:
+            a.recv(rb, 4, src=1, tag=2)
+        assert time.monotonic() - t0 < 1.0
+        assert exc.value.code == ErrorCode.RECEIVE_TIMEOUT
+    finally:
+        _deinit(g)
+
+
+def test_partition_times_out_then_heals(fault_plan):
+    """A partitioned allreduce fails on both sides within the deadline;
+    healing the fabric + collective soft_reset restores service with a
+    clean rx pool."""
+    g = emulated_group(2)
+    a, b = g
+    try:
+        inj = a.engine.fabric.install_fault_plan(fault_plan(
+            dict(action="partition", groups=[[0], [1]], nth=0),
+        ))
+        for x in g:
+            x.set_timeout(0.4)
+
+        def work(accl, rank):
+            s = accl.create_buffer_from(np.full(8, rank + 1.0, np.float32))
+            d = accl.create_buffer(8, np.float32)
+            try:
+                accl.allreduce(s, d, 8)
+                return "ok"
+            except ACCLError as e:
+                return e.code
+
+        t0 = time.monotonic()
+        res = run_parallel(g, work)
+        assert time.monotonic() - t0 < 10.0  # bounded, not a hang
+        assert all(
+            r in (ErrorCode.RECEIVE_TIMEOUT, ErrorCode.SEND_TIMEOUT)
+            for r in res
+        ), res
+
+        inj.clear()  # heal the network
+        for x in g:
+            x.set_timeout(10.0)
+        for x in g:
+            x.soft_reset()  # collective recovery protocol
+        res = run_parallel(g, work)
+        assert res == ["ok", "ok"]
+        assert a.engine.rx_pool.occupancy()[0] == 0
+        assert b.engine.rx_pool.occupancy()[0] == 0
+    finally:
+        _deinit(g)
+
+
+# ---------------------------------------------------------------------------
+# determinism + serialization
+# ---------------------------------------------------------------------------
+
+
+def test_fault_plan_json_round_trip(fault_plan):
+    plan = fault_plan(
+        dict(action="drop", msg_type="EAGER", src=1, dst=0, nth=2, count=3),
+        dict(action="delay", delay_s=0.25, tag=7),
+        dict(action="kill_rank", rank=2, nth=0),
+        dict(action="partition", groups=[[0, 1], [2, 3]], comm=0),
+        seed=99,
+    )
+    clone = FaultPlan.from_json(plan.to_json())
+    assert clone.to_json() == plan.to_json()
+    assert clone.seed == 99
+    assert len(clone.rules) == 4
+    assert clone.rules[0].count == 3 and clone.rules[0].nth == 2
+
+
+def test_same_seed_same_outcome(fault_plan):
+    """The same plan replays to the same per-rank outcome on the InProc
+    tier: identical injector event logs and identical received bytes."""
+    def run_once():
+        g = emulated_group(2)
+        a, b = g
+        try:
+            inj = a.engine.fabric.install_fault_plan(fault_plan(
+                dict(action="corrupt", msg_type="EAGER", nth=2, count=1),
+                dict(action="drop", msg_type="EAGER", nth=5, count=1),
+                seed=1234,
+            ))
+            for x in g:
+                x.set_retry_policy(5, 0.03)
+            data = np.arange(4096, dtype=np.float32)  # 4 segments
+            out = _send_recv(a, b, data)
+            return list(out), [
+                (e["action"], e["seqn"], e["msg_type"]) for e in inj.log
+            ]
+        finally:
+            _deinit(g)
+
+    out1, log1 = run_once()
+    out2, log2 = run_once()
+    assert log1 == log2
+    assert out1 == out2
+
+
+def test_env_var_round_trip_on_socket_tier(fault_plan, monkeypatch):
+    """The plan rides ACCL_FAULT_PLAN into SocketFabric construction (the
+    one-process-per-rank pickup path) and actually injects there."""
+    import socket as socketlib
+
+    from accl_tpu import socket_group_member
+
+    plan = fault_plan(
+        dict(action="drop", msg_type="EAGER", src=1, dst=0, nth=1, count=1),
+        seed=5,
+    )
+    monkeypatch.setenv(FAULT_PLAN_ENV, plan.to_env())
+
+    # pre-pick free ports for the 2-rank address list
+    ports = []
+    socks = []
+    for _ in range(2):
+        s = socketlib.socket()
+        s.setsockopt(socketlib.SOL_SOCKET, socketlib.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        socks.append(s)
+    for s in socks:
+        s.close()
+    addrs = [f"127.0.0.1:{p}" for p in ports]
+    g = [socket_group_member(i, addrs) for i in range(2)]
+    a, b = g
+    try:
+        # each per-rank fabric picked the plan up from the environment
+        for x in g:
+            inj = x.engine.fabric.fault_injector
+            assert inj is not None
+            assert inj.plan.to_json() == plan.to_json()
+        for x in g:
+            x.set_retry_policy(5, 0.05)
+        data = np.arange(64, dtype=np.float32)
+        out = _send_recv(a, b, data)
+        np.testing.assert_array_equal(out, data)
+        # the drop fired on the SENDING rank's fabric (rank 1 -> rank 0)
+        assert any(
+            e["action"] == "drop" for e in b.engine.fabric.fault_injector.log
+        )
+    finally:
+        _deinit(g)
+
+
+# ---------------------------------------------------------------------------
+# shutdown leak detection (satellite: scheduler-thread accounting)
+# ---------------------------------------------------------------------------
+
+
+def test_shutdown_detects_wedged_scheduler_thread(capsys):
+    from accl_tpu.backends.emulator.engine import leaked_scheduler_threads
+
+    g = emulated_group(1)
+    a = g[0]
+    eng = a.engine
+    # wedge the scheduler: every loop iteration stalls in non-yielding work
+    eng._route_inbox = lambda: time.sleep(0.6)
+    time.sleep(0.2)  # let the loop enter the stalled iteration
+    eng.shutdown(join_timeout=0.1)
+    assert eng.leaked_scheduler_thread
+    assert any("accl-engine" in name for name in leaked_scheduler_threads())
+    captured = capsys.readouterr()
+    assert "LEAK" in captured.err
+    # the zombie drains once the stall clears (the registry self-reaps)
+    deadline = time.monotonic() + 10
+    while leaked_scheduler_threads():
+        if time.monotonic() > deadline:
+            raise AssertionError("leaked scheduler thread never exited")
+        time.sleep(0.05)
+    a._initialized = False  # engine already shut down; skip facade deinit
+
+
+def test_clean_shutdown_reports_no_leak():
+    from accl_tpu.backends.emulator.engine import leaked_scheduler_threads
+
+    g = emulated_group(2)
+    for a in g:
+        a.deinit()
+    assert not any(
+        a.engine.leaked_scheduler_thread for a in g
+    )
+    assert leaked_scheduler_threads() == []
+
+
+# ---------------------------------------------------------------------------
+# rank-death / partition soak (slow tier)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_chaos_soak_rank_death_and_partition(fault_plan):
+    """Sustained randomized traffic with a mid-run kill and a late
+    partition: every surviving call either succeeds or fails within its
+    deadline with a timeout code; nothing hangs; no slots leak."""
+    seconds = float(os.environ.get("ACCL_CHAOS_SOAK_SECONDS", "10"))
+    g = emulated_group(4)
+    fabric = g[0].engine.fabric
+    inj = fabric.install_fault_plan(fault_plan(
+        # a lossy, duplicating, jittery fabric throughout
+        dict(action="drop", msg_type="EAGER", nth=7, count=3),
+        dict(action="duplicate", msg_type="EAGER", nth=5, count=10),
+        dict(action="delay", delay_s=0.01, msg_type="EAGER", nth=3,
+             count=20),
+        seed=42,
+    ))
+    try:
+        for x in g:
+            x.set_timeout(3.0)
+            x.set_retry_policy(4, 0.02)
+        rng = np.random.default_rng(7)
+        deadline = time.monotonic() + seconds
+        stats = {"ok": 0, "timeout": 0}
+
+        def one_round(count, tag):
+            def work(accl, rank):
+                s = accl.create_buffer_from(
+                    np.full(count, rank + 1.0, np.float32)
+                )
+                d = accl.create_buffer(count, np.float32)
+                try:
+                    accl.allreduce(s, d, count)
+                    return "ok"
+                except ACCLError as e:
+                    assert e.code in (
+                        ErrorCode.RECEIVE_TIMEOUT, ErrorCode.SEND_TIMEOUT,
+                        ErrorCode.RENDEZVOUS_TIMEOUT,
+                    ), e
+                    return "timeout"
+            # 30 s run_parallel bound: a hang fails the test loudly
+            return run_parallel(g, work, timeout=30.0)
+
+        while time.monotonic() < deadline:
+            res = one_round(int(rng.integers(1, 2048)),
+                            int(rng.integers(0, 1 << 12)))
+            for r in res:
+                stats[r] += 1
+        assert stats["ok"] > 0, stats
+
+        # phase 2: kill rank 3 — survivors must fail fast, not hang
+        inj2 = fabric.install_fault_plan(fault_plan(
+            dict(action="kill_rank", rank=3, nth=0),
+        ))
+        survivors = g[:3]
+
+        def doomed(accl, rank):
+            s = accl.create_buffer_from(np.ones(64, np.float32))
+            d = accl.create_buffer(64, np.float32)
+            t0 = time.monotonic()
+            try:
+                accl.allreduce(s, d, 64)
+                return None
+            except ACCLError as e:
+                return (e.code, time.monotonic() - t0)
+
+        t0 = time.monotonic()
+        res = run_parallel(survivors, doomed, timeout=30.0)
+        assert time.monotonic() - t0 < 15.0
+        for r in res:
+            assert r is not None and r[0] in (
+                ErrorCode.RECEIVE_TIMEOUT, ErrorCode.SEND_TIMEOUT,
+            ), res
+        # repeated rounds converge to fast failure everywhere: strike
+        # accounting marks the dead rank (and the stalled cascade) dead,
+        # so within a few rounds nobody waits out a deadline again
+        for attempt in range(4):
+            t0 = time.monotonic()
+            res = run_parallel(survivors, doomed, timeout=30.0)
+            if all(r is not None and r[1] < 1.0 for r in res):
+                break
+        else:
+            raise AssertionError(f"never converged to fast failure: {res}")
+
+        # heal + recover the survivors on a fresh subcommunicator
+        inj2.clear()
+        for x in survivors:
+            x.set_timeout(10.0)
+        for x in survivors:
+            x.soft_reset()
+        comms = [x.create_communicator([0, 1, 2]) for x in survivors]
+
+        def recovered(accl, rank):
+            s = accl.create_buffer_from(np.full(32, rank + 1.0, np.float32))
+            d = accl.create_buffer(32, np.float32)
+            accl.allreduce(s, d, 32, comm=comms[rank])
+            d.sync_from_device()
+            return float(d.data[0])
+
+        assert run_parallel(survivors, recovered, timeout=30.0) == [6.0] * 3
+        for x in survivors:
+            assert x.engine.rx_pool.occupancy()[0] == 0
+    finally:
+        _deinit(g)

@@ -143,7 +143,22 @@ def _soak_worker(accl, rank, world, seconds, seed, eager_bytes=None):
     leaks = [
         ln for ln in rx.splitlines() if "rxbuf" in ln and "IDLE" not in ln
     ]
-    return {"iters": iters, "churns": churns, "rx_leaks": leaks}
+    # scheduler-thread accounting: churn must not leak engine scheduler
+    # threads — at most this rank's own engine thread may be alive, and
+    # the shutdown leak registry must be empty (a registered entry means
+    # an earlier engine wedged at shutdown and was masked until now)
+    import threading
+
+    from accl_tpu.backends.emulator.engine import leaked_scheduler_threads
+
+    sched = [
+        t.name for t in threading.enumerate()
+        if t.name.startswith("accl-engine-")
+    ]
+    return {
+        "iters": iters, "churns": churns, "rx_leaks": leaks,
+        "sched_threads": sched, "thread_leaks": leaked_scheduler_threads(),
+    }
 
 
 @pytest.mark.parametrize("design", ["socket", "native_socket", "xla_dist"])
@@ -177,6 +192,12 @@ def test_soak_multiprocess(design):
     for rank, r in enumerate(results):
         assert r["rx_leaks"] == [], (
             f"rank {rank} leaked rx slots after {n} iters: {r['rx_leaks']}"
+        )
+        assert r["thread_leaks"] == [], (
+            f"rank {rank} leaked scheduler threads: {r['thread_leaks']}"
+        )
+        assert len(r["sched_threads"]) <= 1, (
+            f"rank {rank} has stray scheduler threads: {r['sched_threads']}"
         )
     print(
         f"soak[{design}]: {n} iterations x {world} ranks, "
