@@ -41,6 +41,8 @@ from .faults import (  # noqa: F401
 from .buffer import BaseBuffer, DummyBuffer, EmuBuffer  # noqa: F401
 from .communicator import Communicator, Rank  # noqa: F401
 from .core import ACCL, emulated_group, socket_group_member  # noqa: F401
+from .plans import CollectivePlan, PlanCache, size_bucket  # noqa: F401
 from .request import Request, RequestStatus  # noqa: F401
+from .tuning import TUNING_PLAN_ENV, TuningPlan, autotune  # noqa: F401
 
 __version__ = "0.1.0"

@@ -46,6 +46,33 @@ class CallOptions:
     cfg_function: int = 0
     cfg_value: float = 0.0
     cfg_key: int = 0  # tuning register selector for SET_TUNING
+    # cached-dispatch state (accl_tpu.plans): the facade's CollectivePlan
+    # for this call (engines park prepared state in plan.engine), and the
+    # per-size-bucket tuning-register overlay from a loaded TuningPlan —
+    # engines overlay it onto their global registers at execution time
+    # via effective_tuning()/eager_limit() below
+    plan: Optional[object] = None
+    tuning: Optional[dict] = None
+
+    def eager_limit(self, default: int) -> int:
+        """The eager-vs-rendezvous threshold steering THIS call: the
+        per-size-bucket TuningPlan overlay's value when present, else
+        the engine's global register.  The single definition every tier
+        reads — divergent copies would skew protocol choice across
+        ranks and break SPMD uniformity."""
+        if self.tuning is not None:
+            return self.tuning.get("max_eager_size", default)
+        return default
+
+    def effective_tuning(self, table: dict) -> dict:
+        """The engine tuning table overlaid with this call's per-bucket
+        registers (identical across ranks when every member loaded the
+        same plan — the SPMD-uniformity contract)."""
+        if not self.tuning:
+            return table
+        eff = dict(table)
+        eff.update(self.tuning)
+        return eff
 
 
 class InteractionCounter:

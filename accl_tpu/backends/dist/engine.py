@@ -362,8 +362,13 @@ class DistEngine(StreamPortMixin, BaseEngine):
         elif isinstance(buf, DeviceBuffer) and buf.device == self.device:
             # eager/rendezvous is decided per CHUNK — the wire message
             # unit, matching the reference's per-message eager rule (a
-            # scatter of world eager-sized chunks is eager protocol)
-            if n * np.dtype(npdt).itemsize > self.max_eager_size:
+            # scatter of world eager-sized chunks is eager protocol).
+            # A TuningPlan's per-size-bucket eager threshold overlays the
+            # global register (every member process loads the same plan,
+            # so the choice stays SPMD-uniform).
+            if n * np.dtype(npdt).itemsize > options.eager_limit(
+                self.max_eager_size
+            ):
                 # RENDEZVOUS domain: zero-host-copy (transfer-guard-
                 # tested) — re-layout on device.  The pad program
                 # retraces per exact count, but the expensive collective
@@ -422,16 +427,21 @@ class DistEngine(StreamPortMixin, BaseEngine):
             options.compression & CompressionFlags.ETH_COMPRESSED
         )
 
+        # per-size-bucket TuningPlan overlay (CallOptions.tuning) over the
+        # global registers — identical in every member process when all
+        # load the same plan, so the SPMD program streams stay uniform
+        tuning = options.effective_tuning(self.tuning)
+
         self.interactions.bump()  # the collective program dispatch
         if op == Operation.ALLREDUCE:
             wire = options.arithcfg.compressed if compressed else None
             out = run_allreduce_with_tuning(
-                global_arr, mesh, fn, wire, self.tuning
+                global_arr, mesh, fn, wire, tuning
             )
         elif op in (Operation.REDUCE, Operation.BCAST, Operation.SCATTER,
                     Operation.GATHER):
             out = run_rooted_with_tuning(
-                op, global_arr, mesh, options, self.tuning
+                op, global_arr, mesh, options, tuning
             )
         elif op == Operation.ALLGATHER:
             out = opdriver.run_allgather(global_arr, mesh)
@@ -472,7 +482,8 @@ class DistEngine(StreamPortMixin, BaseEngine):
             return ErrorCode.OK
         if (
             isinstance(res, DeviceBuffer) and res.device == self.device
-            and n * np.dtype(arr.dtype).itemsize > self.max_eager_size
+            and n * np.dtype(arr.dtype).itemsize
+            > options.eager_limit(self.max_eager_size)
         ):
             # rendezvous domain: chunk-trim + decompress ON DEVICE
             # (zero-host-copy), one fused program, deferred to the reader
@@ -585,7 +596,8 @@ class DistEngine(StreamPortMixin, BaseEngine):
             return ErrorCode.OK
         if (
             isinstance(res, DeviceBuffer) and res.device == self.device
-            and n * np.dtype(arr.dtype).itemsize > self.max_eager_size
+            and n * np.dtype(arr.dtype).itemsize
+            > options.eager_limit(self.max_eager_size)
         ):
             # fused unpad + decompress: ONE result-side program
             npdt = dtype_to_numpy(res.dtype)

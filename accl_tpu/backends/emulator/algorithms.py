@@ -83,9 +83,18 @@ def _seg_size(comm: Communicator, rank: int) -> int:
     return comm.ranks[rank].max_segment_size
 
 
+def _tun(eng, call: CallOptions, name: str):
+    """One tuning-register read, honoring the call's per-size-bucket
+    TuningPlan overlay (CallOptions.tuning) over the engine's global
+    table — per-size algorithm selection at dispatch."""
+    if call.tuning is not None and name in call.tuning:
+        return call.tuning[name]
+    return eng.tuning[name]
+
+
 def _use_rendezvous(eng, call: CallOptions, nbytes: int) -> bool:
     return (
-        nbytes > eng.max_eager_size
+        nbytes > call.eager_limit(eng.max_eager_size)
         and call.compression == CompressionFlags.NO_COMPRESSION
         and call.stream == StreamFlags.NO_STREAM
     )
@@ -450,7 +459,7 @@ def op_bcast(eng, call: CallOptions) -> Generator:
     data_nbytes = call.count * dtype_to_numpy(_acc_dtype(call)).itemsize
     use_tree = (
         _use_rendezvous(eng, call, data_nbytes)
-        and size > eng.tuning["bcast_flat_tree_max_ranks"]
+        and size > _tun(eng, call, "bcast_flat_tree_max_ranks")
     )
     if not use_tree:
         if r == root:
@@ -522,8 +531,8 @@ def op_gather(eng, call: CallOptions) -> Generator:
                 dst_all[root * count : (root + 1) * count], _op0_view(call)
             )
             window = (
-                eng.tuning["gather_flat_tree_max_fanin"]
-                if data_nbytes > eng.tuning["gather_flat_tree_max_count"]
+                _tun(eng, call, "gather_flat_tree_max_fanin")
+                if data_nbytes > _tun(eng, call, "gather_flat_tree_max_count")
                 else size
             )
             peers = [p for p in range(size) if p != root]
@@ -617,8 +626,8 @@ def op_reduce(eng, call: CallOptions) -> Generator:
         return ErrorCode.OK
     data_nbytes = count * npdt.itemsize
     rndzv = _use_rendezvous(eng, call, data_nbytes)
-    flat = size <= eng.tuning["reduce_flat_tree_max_ranks"] or data_nbytes <= (
-        eng.tuning["reduce_flat_tree_max_count"]
+    flat = size <= _tun(eng, call, "reduce_flat_tree_max_ranks") or (
+        data_nbytes <= _tun(eng, call, "reduce_flat_tree_max_count")
     )
     if rndzv and flat:
         # flat tree: root accumulates everyone into spares

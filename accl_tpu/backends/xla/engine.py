@@ -343,6 +343,61 @@ def run_allreduce_with_tuning(global_arr, mesh, fn, wire_dtype, tuning,
     return opdriver.run_allreduce(global_arr, mesh, fn, prep=prep)
 
 
+def effective_tuning(tuning: dict, lead: CallOptions) -> dict:
+    """The register set steering one call — the per-size selection at
+    dispatch that generalizes the reference's flat-tree ``*_MAX_COUNT``
+    thresholds (one definition for every tier: CallOptions)."""
+    return lead.effective_tuning(tuning)
+
+
+def resolve_lowering(op, lead: CallOptions, tuning: dict, wire_npdt):
+    """(driver op name, extra) for the prepared-program handle a plan
+    caches — the same selection run_allreduce_with_tuning /
+    run_rooted_with_tuning make per call, resolved ONCE at plan-prepare
+    time.  BCAST is excluded (its donating form mutates operand arrays,
+    which the prepared fast path must not cache around)."""
+    nseg = int(tuning.get("ring_segments", 1))
+    wire_name = np.dtype(wire_npdt).name if wire_npdt is not None else None
+    if op == Operation.ALLREDUCE:
+        algo = tuning.get("allreduce_algorithm", "xla")
+        bidir = algo == "pallas_ring_bidir"
+        if algo in ("pallas_ring", "pallas_ring_bidir"):
+            return "pallas_allreduce", (nseg, wire_name, bidir)
+        if wire_name is not None:
+            return "compressed_allreduce", wire_name
+        if algo == "ring":
+            return "ring_allreduce", nseg
+        return "allreduce", None
+    if op == Operation.REDUCE:
+        if tuning.get("reduce_algorithm", "xla") == "pallas_ring":
+            return "pallas_reduce", (lead.root_dst, nseg)
+        return "reduce", lead.root_dst
+    if op == Operation.SCATTER:
+        if tuning.get("scatter_algorithm", "xla") == "pallas_ring":
+            return "pallas_scatter", (lead.root_src, nseg)
+        return "scatter", lead.root_src
+    if op == Operation.GATHER:
+        if tuning.get("gather_algorithm", "xla") == "pallas_ring":
+            return "pallas_gather", (lead.root_src, nseg)
+        return "gather", lead.root_src
+    if op == Operation.ALLGATHER:
+        return "allgather", None
+    if op == Operation.REDUCE_SCATTER:
+        return "reduce_scatter", None
+    if op == Operation.ALLTOALL:
+        return "alltoall", None
+    raise ValueError(op)  # pragma: no cover - callers gate on _FAST_OPS
+
+
+#: ops eligible for the prepared-program fast path (pure-functional
+#: lowerings; BCAST stays on the full path — donation semantics)
+_FAST_OPS = frozenset((
+    Operation.ALLREDUCE, Operation.REDUCE, Operation.SCATTER,
+    Operation.GATHER, Operation.ALLGATHER, Operation.REDUCE_SCATTER,
+    Operation.ALLTOALL,
+))
+
+
 class _GangSlot:
     def __init__(self, world: int, timeout_s: float, comm=None):
         self.calls: Dict[int, Tuple[CallOptions, Request]] = {}
@@ -374,6 +429,11 @@ class XLAGangContext:
         #   "ring" (explicit ppermute pipeline), "pallas_ring" (the
         #   Pallas remote-DMA kernel)
         self.tuning = {"allreduce_algorithm": "xla", "ring_segments": 1}
+        # monotone register-write counter: prepared per-plan state
+        # (templates / program handles parked in CollectivePlan.engine)
+        # records the epoch it was built at and dies on mismatch — a
+        # SET_TUNING can never leave a stale prepared program serving
+        self.tuning_epoch = 0
         # device-interaction accounting (single-interaction dispatch):
         # shared across the gang's rank handles — one collective on the
         # fast path bumps it exactly once, whatever the world size
@@ -450,6 +510,14 @@ class XLAGangContext:
         self._submit_entry(comm, (list(options_list), list(requests)))
 
     def _submit_entry(self, comm: Communicator, entry: tuple):
+        if comm.size == 1:
+            # single-member gang (the chip tier's world=1 shape): the
+            # submit IS the assembled slot — no seq/slot bookkeeping, no
+            # watchdog, no dead peers to screen (there are none)
+            slot = _GangSlot(1, 0.0, comm)
+            slot.calls[0] = entry
+            self._execute(comm, slot)
+            return
         with self._lock:
             dead = self.dead_rank_in(comm)
             if dead is not None:
@@ -521,6 +589,7 @@ class XLAGangContext:
             self._seq.clear()
             self._asm_cache.clear()
             self.health.clear()  # degradation state is part of the reset
+            self.tuning_epoch += 1  # prepared plan state dies with the reset
         for slot in slots:
             if slot.watchdog is not None:
                 slot.watchdog.cancel()
@@ -685,6 +754,15 @@ class XLAGangContext:
             self.tuning.get(k, "xla") != "xla" for k in self._BATCH_TUNING_KEYS
         ):
             return False
+        # per-call TuningPlan overlays selecting a non-XLA lowering also
+        # disqualify fusion (the fused program composes plain XLA bodies)
+        for options_list, _ in entries:
+            for c in options_list:
+                if c.tuning and any(
+                    c.tuning.get(k, "xla") != "xla"
+                    for k in self._BATCH_TUNING_KEYS
+                ):
+                    return False
         plans = []
         written: set = set()  # result-buffer roots of earlier positions
         for i in range(npos):
@@ -871,7 +949,7 @@ class XLAGangContext:
             # op0 IS res on every rank); other shapes stage via the host
             return None
         return {
-            "op": op, "size": size, "in_w": in_w, "out_w": out_w,
+            "op": op, "size": size, "n": n, "in_w": in_w, "out_w": out_w,
             "devs": devs, "npdt": npdt, "compressed": compressed,
             "wire_npdt": wire_npdt, "writers": writers,
         }
@@ -965,15 +1043,7 @@ class XLAGangContext:
         key = None
         if cacheable:
             key = (tuple(map(id, raw_bufs)), w)
-            hit = self._asm_cache.get(key)
-            if hit is not None:
-                hit_bufs = [ref() for ref in hit[2]]
-                if all(
-                    b is hb for b, hb in zip(raw_bufs, hit_bufs)
-                ) and all(
-                    s is b._dev for s, b in zip(hit[1], raw_bufs)
-                ):
-                    global_arr = hit[0]
+            global_arr = self._asm_lookup(key, raw_bufs)
         if global_arr is None:
             global_arr = jax.make_array_from_single_device_arrays(
                 (size * w,),
@@ -981,20 +1051,128 @@ class XLAGangContext:
                 shards,
             )
             if cacheable:
-                if len(self._asm_cache) >= 64 and key not in self._asm_cache:
-                    self._asm_cache.clear()
-
-                def _evict(_ref, cache=self._asm_cache, key=key):
-                    cache.pop(key, None)
-
-                self._asm_cache[key] = (
-                    global_arr,
-                    shards,
-                    [weakref.ref(b, _evict) for b in raw_bufs],
-                )
+                self._asm_store(key, global_arr, shards, raw_bufs)
         return global_arr, prep, raw_bufs
 
-    def _adopt_out_shards(self, out, calls, plan, reqs) -> None:
+    def _asm_lookup(self, key, raw_bufs):
+        """Assembled-global cache hit, re-validated against the buffers'
+        live identity AND their current committed arrays (see the cache
+        notes in _assemble_flat); None on miss/stale."""
+        hit = self._asm_cache.get(key)
+        if hit is None:
+            return None
+        hit_bufs = [ref() for ref in hit[2]]
+        if all(b is hb for b, hb in zip(raw_bufs, hit_bufs)) and all(
+            s is b._dev for s, b in zip(hit[1], raw_bufs)
+        ):
+            return hit[0]
+        return None
+
+    def _asm_store(self, key, global_arr, shards, raw_bufs) -> None:
+        if len(self._asm_cache) >= 64 and key not in self._asm_cache:
+            self._asm_cache.clear()
+
+        def _evict(_ref, cache=self._asm_cache, key=key):
+            cache.pop(key, None)
+
+        self._asm_cache[key] = (
+            global_arr,
+            shards,
+            [weakref.ref(b, _evict) for b in raw_bufs],
+        )
+
+    def _run_op_device_prepared(
+        self,
+        calls: List[CallOptions],
+        lead: CallOptions,
+        state: dict,
+        reqs: Optional[List[Request]] = None,
+    ) -> Optional[ErrorCode]:
+        """The warm path of a planned gang collective: the template,
+        sharding, adoption map and jitted program handle all come out of
+        the CollectivePlan's prepared state — per call only the operand
+        buffers are validated, the global assembled, and the ONE program
+        dispatched.  Returns None to fall back to the full path (operand
+        shape drift, dummy/view operands, host buffers)."""
+        tmpl = state["tmpl"]
+        devs, npdt, in_w = tmpl["devs"], tmpl["npdt"], tmpl["in_w"]
+        shards = []
+        raw_bufs = []
+        w = None
+        for r, call in enumerate(calls):
+            buf = call.op0
+            if (
+                buf is None
+                or not isinstance(buf, DeviceBuffer)
+                or buf.is_dummy
+                or buf._parent is not None
+                or buf.device != devs[r]
+                or dtype_to_numpy(buf.dtype) != npdt
+            ):
+                return None
+            arr = buf.device_array()
+            aw = arr.shape[0]
+            if w is None:
+                w = aw
+            elif aw != w:
+                return None
+            shards.append(arr)
+            raw_bufs.append(buf)
+        if w < in_w:
+            return None
+        out_w = tmpl["out_w"]
+        for r in tmpl["writers"]:
+            res = calls[r].res
+            if res is None or res.is_dummy:
+                continue
+            if not (
+                isinstance(res, DeviceBuffer)
+                and res.device == devs[r]
+                and res.count >= out_w
+                and dtype_to_numpy(res.dtype) == npdt
+            ):
+                return None
+
+        key = (tuple(map(id, raw_bufs)), w)
+        global_arr = self._asm_lookup(key, raw_bufs)
+        if global_arr is None:
+            global_arr = jax.make_array_from_single_device_arrays(
+                (tmpl["size"] * w,), state["sharding"], shards
+            )
+            self._asm_store(key, global_arr, shards, raw_bufs)
+
+        prog = state["programs"].get(w)
+        if prog is None:
+            wire_name = (
+                np.dtype(tmpl["wire_npdt"]).name
+                if tmpl["wire_npdt"] is not None
+                and tmpl["op"] != Operation.ALLREDUCE
+                else None
+            )
+            prep = (
+                (in_w, wire_name)
+                if (w != in_w or wire_name is not None)
+                else None
+            )
+            name, extra = resolve_lowering(
+                tmpl["op"], lead,
+                effective_tuning(self.tuning, lead),
+                tmpl["wire_npdt"] if tmpl["compressed"] else None,
+            )
+            prog = opdriver.prepare(
+                name, state["mesh"], lead.reduce_function, extra, prep
+            )
+            state["programs"][w] = prog
+
+        self.interactions.bump()  # THE dispatch: one prepared program
+        out = prog(global_arr)
+        self._adopt_out_shards(
+            out, calls, tmpl, reqs, state["dev_to_rank"]
+        )
+        return ErrorCode.OK
+
+    def _adopt_out_shards(self, out, calls, plan, reqs,
+                          dev_to_rank=None) -> None:
         """Place output shards into result buffers.  Exact-width root
         buffers adopt by pointer swap (free); anything needing a
         writeback/trim program is parked as a LAZY store — the request
@@ -1002,7 +1180,8 @@ class XLAGangContext:
         resolves it first — so fire-and-forget chains never pay the
         result-side device interaction at dispatch time."""
         devs, writers, out_w = plan["devs"], plan["writers"], plan["out_w"]
-        dev_to_rank = {d: r for r, d in enumerate(devs)}
+        if dev_to_rank is None:
+            dev_to_rank = {d: r for r, d in enumerate(devs)}
         for shard in out.addressable_shards:
             r = dev_to_rank.get(shard.device)
             if r is None or r not in writers:
@@ -1045,9 +1224,49 @@ class XLAGangContext:
         interaction — the reference's one-hostctrl-command-per-collective
         discipline.  Returns None to fall back to the host-staged path.
         """
+        fp = lead.plan
+        fast_eligible = fp is not None and lead.op in _FAST_OPS
+        if fast_eligible:
+            # prepared state is keyed by the exact COUNT: the owning
+            # plan is bucket-keyed, and alternating counts within one
+            # bucket must each keep their own template instead of
+            # thrashing a single slot
+            states = fp.engine.get("gang")
+            state = states.get(lead.count) if states else None
+            if (
+                state is not None
+                and state["mesh"] is mesh
+                and state["tuning_epoch"] == self.tuning_epoch
+            ):
+                code = self._run_op_device_prepared(
+                    calls, lead, state, reqs
+                )
+                if code is not None:
+                    return code
         plan = self._plan_device_call(comm, calls, lead, mesh)
         if plan is None:
             return None
+        if fast_eligible:
+            # park the prepared state on the facade's CollectivePlan: the
+            # next warm call on this plan skips re-validation, sharding
+            # construction and program-cache hashing entirely
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            states = fp.engine.setdefault("gang", {})
+            if len(states) > 8 and lead.count not in states:
+                states.clear()  # pathological count churn within a bucket
+            states[lead.count] = {
+                "tmpl": plan,
+                "mesh": mesh,
+                "tuning_epoch": self.tuning_epoch,
+                "sharding": NamedSharding(
+                    mesh, PartitionSpec(opdriver.AXIS)
+                ),
+                "dev_to_rank": {
+                    d: r for r, d in enumerate(plan["devs"])
+                },
+                "programs": {},
+            }
         op = plan["op"]
         global_arr, prep, raw_bufs = self._assemble_flat(calls, plan, mesh)
 
@@ -1058,7 +1277,10 @@ class XLAGangContext:
             # allreduce keeps its wire lane inside its own program (a
             # single rounding); prep carries only the width slice here
             # (_assemble_flat never sets a prep wire for allreduce)
-            out = self._allreduce(global_arr, mesh, fn, wire, prep=prep)
+            out = self._allreduce(
+                global_arr, mesh, fn, wire, prep=prep,
+                tuning=effective_tuning(self.tuning, lead),
+            )
         elif op in (
             Operation.REDUCE, Operation.BCAST, Operation.SCATTER,
             Operation.GATHER,
@@ -1098,8 +1320,8 @@ class XLAGangContext:
     def _run_rooted(self, op, global_arr, mesh, lead, donate=False,
                     prep=None):
         return run_rooted_with_tuning(
-            op, global_arr, mesh, lead, self.tuning, donate=donate,
-            prep=prep,
+            op, global_arr, mesh, lead, effective_tuning(self.tuning, lead),
+            donate=donate, prep=prep,
         )
 
     # -- host-staged fallback path -------------------------------------------
@@ -1130,7 +1352,10 @@ class XLAGangContext:
             # requested wire dtype itself (single rounding, on device)
             stacked = _np_stack_op0(calls, [n] * size, ic)
             wire = lead.arithcfg.compressed if compressed else None
-            out = self._allreduce(stacked, mesh, fn, wire)
+            out = self._allreduce(
+                stacked, mesh, fn, wire,
+                tuning=effective_tuning(self.tuning, lead),
+            )
             out = np.asarray(out)
             for r, call in enumerate(calls):
                 _write_host_result(call.res, out[r], n, ic)
@@ -1222,14 +1447,16 @@ class XLAGangContext:
 
         return ErrorCode.COLLECTIVE_NOT_IMPLEMENTED
 
-    def _allreduce(self, stacked, mesh, fn, wire_dtype, prep=None):
+    def _allreduce(self, stacked, mesh, fn, wire_dtype, prep=None,
+                   tuning=None):
         if mesh is None:
             if wire_dtype is not None:
                 npdt = dtype_to_numpy(wire_dtype)
                 stacked = stacked.astype(npdt).astype(stacked.dtype)
             return self._host_reduce(stacked, fn)[None].repeat(stacked.shape[0], 0)
         return run_allreduce_with_tuning(
-            stacked, mesh, fn, wire_dtype, self.tuning, prep=prep
+            stacked, mesh, fn, wire_dtype,
+            self.tuning if tuning is None else tuning, prep=prep,
         )
 
     @staticmethod
@@ -1758,7 +1985,10 @@ class XLAEngine(StreamPortMixin, BaseEngine):
         return ErrorCode.OK
 
     def _apply_tuning(self, options: CallOptions) -> ErrorCode:
-        return apply_tuning(self.gang.tuning, options)
+        code = apply_tuning(self.gang.tuning, options)
+        if code == ErrorCode.OK:
+            self.gang.tuning_epoch += 1
+        return code
 
     def create_buffer(self, count: int, dtype, host_only: bool = False,
                       data=None):

@@ -13,6 +13,7 @@ overlap calls (the reference's ``run_async`` flag).
 
 from __future__ import annotations
 
+import os
 import zlib
 from typing import List, Optional, Sequence, Union
 
@@ -33,8 +34,10 @@ from .constants import (
     Operation,
     ReduceFunction,
     StreamFlags,
+    dtype_size,
     numpy_to_dtype,
 )
+from .plans import CollectivePlan, PlanCache, size_bucket
 from .request import Request
 
 DTypeLike = Union[DataType, str, np.dtype, type]
@@ -71,19 +74,48 @@ class ACCL:
         # outermost exit flushes and closes.
         self._pending: Optional["CommandQueue"] = None
         self._batch_depth = 0
+        # cached per-call dispatch plans (accl_tpu.plans): warm collective
+        # = pool lookup -> dispatch; invalidated on SET_TUNING/RESET/eager
+        # threshold writes and re-keyed by communicator epoch
+        self._plans = PlanCache()
+        # measurement-driven register selections (accl_tpu.tuning): set by
+        # load_tuning_plan / the ACCL_TUNING_PLAN env; per-size-bucket
+        # register overlays ride the plan cache into CallOptions.tuning
+        self._tuning_plan = None
         self._initialize(timeout_s, max_eager_size, max_rendezvous_size)
+        env_plan = os.environ.get("ACCL_TUNING_PLAN")
+        if env_plan:
+            try:
+                self.load_tuning_plan(env_plan, strict=False)
+            except Exception as e:  # a stale plan must not brick startup
+                import sys
+
+                print(
+                    f"[accl] ignoring ACCL_TUNING_PLAN={env_plan!r}: {e}",
+                    file=sys.stderr,
+                )
 
     # -- init sequence (ref ACCL::initialize, accl.cpp:1066-1114) ------------
     def _initialize(
         self, timeout_s: float, max_eager_size: int, max_rendezvous_size: int
     ) -> None:
         self._timeout_s = float(timeout_s)
+        self._max_eager_size = int(max_eager_size)
         self._config(ConfigFunction.RESET, 0)
         self._config(ConfigFunction.SET_TIMEOUT, timeout_s)
         self._config(ConfigFunction.SET_MAX_EAGER_SIZE, max_eager_size)
         self._config(ConfigFunction.SET_MAX_RENDEZVOUS_SIZE, max_rendezvous_size)
         self._config(ConfigFunction.ENABLE_TRANSPORT, 1)
         self._initialized = True
+
+    # configs whose effect is baked into cached plans: a successful write
+    # drops the whole pool (stale algorithm/protocol choices must never
+    # serve another call)
+    _PLAN_INVALIDATING = frozenset((
+        ConfigFunction.RESET,
+        ConfigFunction.SET_TUNING,
+        ConfigFunction.SET_MAX_EAGER_SIZE,
+    ))
 
     def _config(self, fn: ConfigFunction, value: float, key: int = 0) -> None:
         self.flush()  # config must not overtake queued batch calls
@@ -97,6 +129,8 @@ class ACCL:
         )
         req.wait()
         req.check(f"config {fn.name}")
+        if fn in self._PLAN_INVALIDATING:
+            self._plans.invalidate(fn.name.lower())
 
     # -- introspection -------------------------------------------------------
     @property
@@ -135,6 +169,7 @@ class ACCL:
 
     def set_max_eager_size(self, nbytes: int) -> None:
         self._config(ConfigFunction.SET_MAX_EAGER_SIZE, nbytes)
+        self._max_eager_size = int(nbytes)
 
     def set_max_rendezvous_size(self, nbytes: int) -> None:
         self._config(ConfigFunction.SET_MAX_RENDEZVOUS_SIZE, nbytes)
@@ -182,6 +217,127 @@ class ACCL:
                     f"{[a.name.lower() for a in AllreduceAlgorithm]}"
                 ) from None
         self._config(ConfigFunction.SET_TUNING, float(value), key=int(key))
+
+    def load_tuning_plan(self, plan, strict: bool = True,
+                         apply_defaults: bool = True):
+        """Adopt a measured :class:`~accl_tpu.tuning.TuningPlan` (object
+        or JSON path): plan *defaults* apply immediately through the
+        SET_TUNING / SET_MAX_EAGER_SIZE config path (every engine tier
+        honors those registers), and the per-size-bucket register
+        overrides ride the plan cache — each collective call is
+        dispatched with the register set measured best for its size
+        bucket.  ``strict=False`` (the ``ACCL_TUNING_PLAN`` env path)
+        skips a plan whose world size doesn't match instead of raising.
+        ``apply_defaults=False`` adopts only the per-bucket overlays —
+        no register writes — for callers that know the defaults are
+        already in effect (the paired A/B sweep's weightless flip).
+
+        Returns the adopted plan, or None when skipped."""
+        from .tuning import TuningPlan
+
+        if not isinstance(plan, TuningPlan):
+            plan = TuningPlan.load(os.fspath(plan))
+        if plan.world and plan.world != self._world.size:
+            if strict:
+                raise ValueError(
+                    f"tuning plan is for world={plan.world}, "
+                    f"this group is world={self._world.size}"
+                )
+            return None
+        if apply_defaults:
+            for name, val in sorted((plan.defaults or {}).items()):
+                if name == "max_eager_size":
+                    self.set_max_eager_size(int(val))
+                else:
+                    self.set_tuning(name, val)
+        self._tuning_plan = plan
+        self._plans.invalidate("load_tuning_plan")
+        return plan
+
+    def unload_tuning_plan(self, restore_defaults: bool = True) -> None:
+        """Drop the adopted TuningPlan; by default also put every
+        register it may have touched back to stock.
+        ``restore_defaults=False`` drops only the overlays (the paired
+        A/B sweep's weightless flip)."""
+        if self._tuning_plan is None:
+            return
+        self._tuning_plan = None
+        if restore_defaults:
+            from .tuning import REGISTER_DEFAULTS
+
+            self.set_max_eager_size(REGISTER_DEFAULTS["max_eager_size"])
+            for name, val in sorted(REGISTER_DEFAULTS.items()):
+                if name != "max_eager_size":
+                    self.set_tuning(name, val)
+        self._plans.invalidate("unload_tuning_plan")
+
+    # -- call-plan pool (accl_tpu.plans) -------------------------------------
+    def _algorithm_snapshot(self, op: Operation):
+        """The algorithm-register value steering ``op`` right now, read
+        from whichever tuning table backs this rank's engine (the
+        reference reads its exchange-memory registers per call; we read
+        once per plan)."""
+        tuning = getattr(self.engine, "tuning", None)
+        if tuning is None:
+            gang = getattr(self.engine, "gang", None)
+            tuning = getattr(gang, "tuning", None)
+        if tuning is None:
+            return None
+        if op == Operation.ALLREDUCE:
+            return tuning.get("allreduce_algorithm")
+        return tuning.get(f"{op.name.lower()}_algorithm")
+
+    def _plan_for(
+        self,
+        op: Operation,
+        comm: Communicator,
+        dtype: DataType,
+        count: int,
+        compress_dtype,
+        host: HostFlags,
+        extra: tuple = (),
+    ) -> CollectivePlan:
+        """The cached-dispatch lookup: one :class:`CollectivePlan` per
+        (op, communicator id+epoch, dtype, size bucket, options
+        fingerprint).  A hit returns everything a call previously
+        resolved — arithcfg, compression flags, wire dtype, protocol
+        verdict, algorithm snapshot, per-bucket tuning overlay, engine
+        prepared state — so the warm path constructs CallOptions and
+        dispatches with no re-derivation."""
+        cdt = None if compress_dtype is None else _as_datatype(compress_dtype)
+        bucket = size_bucket(count)
+        key = (op, comm.id, comm.epoch, dtype, bucket, cdt, int(host), extra)
+        plan = self._plans.get(key)
+        if plan is not None:
+            return plan
+        cfg, flags = self._resolve_arithcfg(dtype, cdt)
+        wire = cfg.compressed if flags & CompressionFlags.ETH_COMPRESSED else None
+        overlay = None
+        if self._tuning_plan is not None:
+            overlay = self._tuning_plan.registers_for(
+                op.name.lower(), bucket
+            ) or None
+        eager_limit = (overlay or {}).get(
+            "max_eager_size", self._max_eager_size
+        )
+        # the protocol verdict is only cached when it holds for the WHOLE
+        # bucket (the threshold may fall inside [2^b, 2^(b+1)) bytes);
+        # None = mixed — engines always re-derive per call, this field is
+        # the introspection/debug snapshot
+        lo = (1 << bucket) * dtype_size(dtype)
+        hi = ((1 << (bucket + 1)) - 1) * dtype_size(dtype)
+        eager = True if hi <= eager_limit else (
+            False if lo > eager_limit else None
+        )
+        plan = CollectivePlan(
+            key, cfg, flags,
+            wire_dtype=wire,
+            bucket=bucket,
+            eager=eager,
+            algorithm=self._algorithm_snapshot(op),
+            tuning=overlay,
+        )
+        return self._plans.store(plan)
 
     # -- buffer factories (ref ACCL::create_buffer family) -------------------
     def create_buffer(
@@ -602,17 +758,23 @@ class ACCL:
         comm = comm or self._world
         self._check_rank(comm, root)
         n = self._count_of(buf, count)
-        cfg, flags = self._resolve_arithcfg(buf.dtype, compress_dtype)
+        host = self._host_flags(buf, None, buf)
+        plan = self._plan_for(
+            Operation.BCAST, comm, buf.dtype, n, compress_dtype, host,
+            (root,),
+        )
         opts = CallOptions(
             op=Operation.BCAST,
             comm=comm,
             count=n,
             root_src=root,
-            arithcfg=cfg,
-            compression=flags,
-            host=self._host_flags(buf, None, buf),
+            arithcfg=plan.arithcfg,
+            compression=plan.compression,
+            host=host,
             op0=buf,
             res=buf,
+            plan=plan,
+            tuning=plan.tuning,
         )
         return self._launch(opts, run_async, "bcast")
 
@@ -629,17 +791,23 @@ class ACCL:
         comm = comm or self._world
         self._check_rank(comm, root)
         n = self._count_of(recvbuf, count)
-        cfg, flags = self._resolve_arithcfg(recvbuf.dtype, compress_dtype)
+        host = self._host_flags(sendbuf, None, recvbuf)
+        plan = self._plan_for(
+            Operation.SCATTER, comm, recvbuf.dtype, n, compress_dtype, host,
+            (root,),
+        )
         opts = CallOptions(
             op=Operation.SCATTER,
             comm=comm,
             count=n,
             root_src=root,
-            arithcfg=cfg,
-            compression=flags,
-            host=self._host_flags(sendbuf, None, recvbuf),
+            arithcfg=plan.arithcfg,
+            compression=plan.compression,
+            host=host,
             op0=sendbuf if sendbuf is not None else DummyBuffer(0, recvbuf.dtype),
             res=recvbuf,
+            plan=plan,
+            tuning=plan.tuning,
         )
         return self._launch(opts, run_async, "scatter")
 
@@ -656,17 +824,23 @@ class ACCL:
         comm = comm or self._world
         self._check_rank(comm, root)
         n = self._count_of(sendbuf, count)
-        cfg, flags = self._resolve_arithcfg(sendbuf.dtype, compress_dtype)
+        host = self._host_flags(sendbuf, None, recvbuf)
+        plan = self._plan_for(
+            Operation.GATHER, comm, sendbuf.dtype, n, compress_dtype, host,
+            (root,),
+        )
         opts = CallOptions(
             op=Operation.GATHER,
             comm=comm,
             count=n,
             root_src=root,
-            arithcfg=cfg,
-            compression=flags,
-            host=self._host_flags(sendbuf, None, recvbuf),
+            arithcfg=plan.arithcfg,
+            compression=plan.compression,
+            host=host,
             op0=sendbuf,
             res=recvbuf if recvbuf is not None else DummyBuffer(0, sendbuf.dtype),
+            plan=plan,
+            tuning=plan.tuning,
         )
         return self._launch(opts, run_async, "gather")
 
@@ -681,16 +855,21 @@ class ACCL:
     ):
         comm = comm or self._world
         n = self._count_of(sendbuf, count)
-        cfg, flags = self._resolve_arithcfg(sendbuf.dtype, compress_dtype)
+        host = self._host_flags(sendbuf, None, recvbuf)
+        plan = self._plan_for(
+            Operation.ALLGATHER, comm, sendbuf.dtype, n, compress_dtype, host,
+        )
         opts = CallOptions(
             op=Operation.ALLGATHER,
             comm=comm,
             count=n,
-            arithcfg=cfg,
-            compression=flags,
-            host=self._host_flags(sendbuf, None, recvbuf),
+            arithcfg=plan.arithcfg,
+            compression=plan.compression,
+            host=host,
             op0=sendbuf,
             res=recvbuf,
+            plan=plan,
+            tuning=plan.tuning,
         )
         return self._launch(opts, run_async, "allgather")
 
@@ -739,25 +918,31 @@ class ACCL:
                 )
             else:
                 n = int(count)
-        cfg, flags = self._resolve_arithcfg(op_dtype, compress_dtype)
         stream = StreamFlags.NO_STREAM
         if from_stream:
             stream |= StreamFlags.OP0_STREAM
         if to_stream:
             stream |= StreamFlags.RES_STREAM
+        host = self._host_flags(sendbuf, None, recvbuf)
+        plan = self._plan_for(
+            Operation.REDUCE, comm, op_dtype, n, compress_dtype, host,
+            (root, int(function), int(stream)),
+        )
         opts = CallOptions(
             op=Operation.REDUCE,
             comm=comm,
             count=n,
             root_dst=root,
             reduce_function=function,
-            arithcfg=cfg,
-            compression=flags,
+            arithcfg=plan.arithcfg,
+            compression=plan.compression,
             stream=stream,
             stream_id=stream_id,
-            host=self._host_flags(sendbuf, None, recvbuf),
+            host=host,
             op0=sendbuf if sendbuf is not None else DummyBuffer(n, op_dtype),
             res=recvbuf if recvbuf is not None else DummyBuffer(0, op_dtype),
+            plan=plan,
+            tuning=plan.tuning,
         )
         return self._launch(opts, run_async, "reduce")
 
@@ -773,17 +958,23 @@ class ACCL:
     ):
         comm = comm or self._world
         n = self._count_of(sendbuf, count)
-        cfg, flags = self._resolve_arithcfg(sendbuf.dtype, compress_dtype)
+        host = self._host_flags(sendbuf, None, recvbuf)
+        plan = self._plan_for(
+            Operation.ALLREDUCE, comm, sendbuf.dtype, n, compress_dtype,
+            host, (int(function),),
+        )
         opts = CallOptions(
             op=Operation.ALLREDUCE,
             comm=comm,
             count=n,
             reduce_function=function,
-            arithcfg=cfg,
-            compression=flags,
-            host=self._host_flags(sendbuf, None, recvbuf),
+            arithcfg=plan.arithcfg,
+            compression=plan.compression,
+            host=host,
             op0=sendbuf,
             res=recvbuf,
+            plan=plan,
+            tuning=plan.tuning,
         )
         return self._launch(opts, run_async, "allreduce")
 
@@ -799,17 +990,23 @@ class ACCL:
     ):
         comm = comm or self._world
         n = self._count_of(recvbuf, count)
-        cfg, flags = self._resolve_arithcfg(recvbuf.dtype, compress_dtype)
+        host = self._host_flags(sendbuf, None, recvbuf)
+        plan = self._plan_for(
+            Operation.REDUCE_SCATTER, comm, recvbuf.dtype, n, compress_dtype,
+            host, (int(function),),
+        )
         opts = CallOptions(
             op=Operation.REDUCE_SCATTER,
             comm=comm,
             count=n,
             reduce_function=function,
-            arithcfg=cfg,
-            compression=flags,
-            host=self._host_flags(sendbuf, None, recvbuf),
+            arithcfg=plan.arithcfg,
+            compression=plan.compression,
+            host=host,
             op0=sendbuf,
             res=recvbuf,
+            plan=plan,
+            tuning=plan.tuning,
         )
         return self._launch(opts, run_async, "reduce_scatter")
 
@@ -825,16 +1022,22 @@ class ACCL:
         comm = comm or self._world
         if count is None:
             count = sendbuf.count // comm.size
-        cfg, flags = self._resolve_arithcfg(sendbuf.dtype, compress_dtype)
+        host = self._host_flags(sendbuf, None, recvbuf)
+        plan = self._plan_for(
+            Operation.ALLTOALL, comm, sendbuf.dtype, int(count),
+            compress_dtype, host,
+        )
         opts = CallOptions(
             op=Operation.ALLTOALL,
             comm=comm,
             count=int(count),
-            arithcfg=cfg,
-            compression=flags,
-            host=self._host_flags(sendbuf, None, recvbuf),
+            arithcfg=plan.arithcfg,
+            compression=plan.compression,
+            host=host,
             op0=sendbuf,
             res=recvbuf,
+            plan=plan,
+            tuning=plan.tuning,
         )
         return self._launch(opts, run_async, "alltoall")
 
@@ -922,6 +1125,18 @@ class ACCL:
             # the single-interaction contract — one collective on the
             # gang fast path moves this by exactly 1
             "device_interactions": self.engine.device_interactions(),
+            # cached-dispatch telemetry (accl_tpu.plans): a warm
+            # collective is a hit; SET_TUNING / soft_reset / eager
+            # threshold writes each count one invalidation
+            "plan_cache": self._plans.stats(),
+            # the adopted measurement-driven TuningPlan, if any
+            "tuning_plan": (
+                None if self._tuning_plan is None else {
+                    "tier": self._tuning_plan.tier,
+                    "world": self._tuning_plan.world,
+                    "collectives": sorted(self._tuning_plan.entries),
+                }
+            ),
             # graceful-degradation map: per-peer state for the world
             # communicator, keyed by rank — fed by timeout/retry
             # accounting (emulator tiers) and the gang slot watchdog

@@ -201,6 +201,24 @@ def _is_flat(stacked) -> bool:
     return getattr(stacked, "ndim", 2) == 1
 
 
+def prepare(op: str, mesh: Mesh, function=ReduceFunction.SUM, extra=None,
+            prep=None):
+    """Prepared-program handle for an engine's plan cache: the jitted
+    flat-layout program, to be invoked directly on an already-assembled
+    global array (the caller owns the sharding guarantee).  Resolving it
+    once per plan skips the per-call ``_put`` sharding construction/
+    comparison and the lru key hashing the ``run_*`` entry points pay.
+
+    The ``extra``-omitted call form matches the ``run_*`` entry points'
+    convention exactly: lru_cache keys distinguish positional from
+    keyword args, and a mismatched form would alias the SAME program
+    under a second jit wrapper — a full recompile on the warm path."""
+    if extra is None:
+        return _program(op, _mesh_key(mesh), function, flat=True, prep=prep)
+    return _program(op, _mesh_key(mesh), function, extra, flat=True,
+                    prep=prep)
+
+
 def run_allreduce(stacked, mesh: Mesh, function=ReduceFunction.SUM,
                   prep=None):
     """stacked[r] = rank r's operand; returns stacked results (identical

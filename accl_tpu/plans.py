@@ -1,0 +1,151 @@
+"""Collective call plans: the cached per-call dispatch state.
+
+Role model: the reference steers its collectives with runtime tuning
+registers (``ccl_offload_control.h:86-90``, written by
+``driver/xrt/src/accl.cpp:1198-1208``) and re-reads them per call inside
+the firmware main loop.  Our facade used to re-derive the full call plan
+in Python on every collective — arithmetic-config resolution, wire dtype,
+eager-vs-rendezvous verdict, algorithm selection, host flags — ~271 us of
+pure control plane per call (BENCH_NOTES "Single-interaction dispatch"
+table).  A :class:`CollectivePlan` snapshots all of it once per
+``(op, communicator id+epoch, dtype, size bucket, options fingerprint)``
+so a warm collective goes pool-lookup -> dispatch.
+
+The plan also carries two things the per-call path consumes downstream:
+
+* ``tuning`` — the per-size-bucket register overlay from a loaded
+  :class:`~accl_tpu.tuning.TuningPlan` (measurement-driven algorithm
+  selection, the NCCL-tuner/SCCL shape): engines overlay it onto their
+  global registers at execution, which generalizes the reference's
+  flat-tree ``*_MAX_COUNT`` thresholds into per-size selection at
+  dispatch.
+* ``engine`` — an opaque slot where an engine parks its own prepared
+  state (the XLA gang stores its device-call template, cached
+  ``NamedSharding`` and the prepared jitted program handle here), so the
+  warm path skips re-validation, re-sharding and program-cache hashing.
+
+Invalidation: ``set_tuning`` and ``soft_reset`` clear the whole pool
+(register writes change algorithm selection; reset re-epochs the
+communicators); a communicator epoch change re-keys naturally (the epoch
+is part of the key), so a re-created same-id subcommunicator can never
+reuse a stale plan — the PR 2 seqn-epoch lesson applied to plans.
+Hit/miss/invalidation counters surface through
+``ACCL.capabilities()["plan_cache"]`` next to ``device_interactions``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+__all__ = ["CollectivePlan", "PlanCache", "size_bucket"]
+
+
+def size_bucket(count: int) -> int:
+    """Power-of-two bucket of an element count: ``floor(log2(count))``
+    (0 for counts <= 1).  Counts in ``[2^k, 2^(k+1))`` share a plan —
+    the same bucketing the dist tier's wire shapes ride, so one plan
+    covers one compiled wire shape."""
+    return max(0, int(count).bit_length() - 1)
+
+
+class CollectivePlan:
+    """Everything the facade resolves per collective call, snapshotted.
+
+    Immutable by convention once stored (engines only write the
+    ``engine`` slot, which is keyed/invalidated independently via the
+    engine's own epoch counters)."""
+
+    __slots__ = (
+        "key", "arithcfg", "compression", "wire_dtype", "bucket",
+        "eager", "algorithm", "tuning", "engine",
+    )
+
+    def __init__(self, key, arithcfg, compression, wire_dtype, bucket,
+                 eager, algorithm, tuning=None):
+        self.key = key
+        self.arithcfg = arithcfg          # resolved ArithConfig
+        self.compression = compression    # CompressionFlags
+        self.wire_dtype = wire_dtype      # DataType on the wire (or None)
+        self.bucket = bucket              # power-of-two size bucket (log2)
+        self.eager = eager               # bucket-wide protocol verdict:
+        #   True/False when the whole bucket is eager/rendezvous, None
+        #   when the threshold falls inside the bucket (engines always
+        #   re-derive per call; this is the introspection snapshot)
+        self.algorithm = algorithm        # register snapshot at plan time
+        self.tuning = tuning              # per-bucket register overlay
+        self.engine: Dict[str, Any] = {}  # engine-private prepared state
+
+    def describe(self) -> dict:
+        """Introspection form (tests / debug dumps)."""
+        return {
+            "key": self.key,
+            "bucket": self.bucket,
+            "wire_dtype": getattr(self.wire_dtype, "name", None),
+            "eager": self.eager,
+            "algorithm": self.algorithm,
+            "tuning": dict(self.tuning) if self.tuning else None,
+        }
+
+
+class PlanCache:
+    """Bounded pool of :class:`CollectivePlan`, with honest counters.
+
+    Thread-safe: rank handles are commonly driven from per-rank threads
+    (the test harness) and plans may be built concurrently.  On capacity
+    the pool is cleared wholesale — plans are cheap to rebuild and the
+    bound only guards pathological key churn (epoch-heavy soaks)."""
+
+    def __init__(self, maxsize: int = 256):
+        self.maxsize = int(maxsize)
+        self._plans: Dict[Tuple, CollectivePlan] = {}
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.invalidations = 0
+        self.last_invalidation: Optional[str] = None
+
+    # -- lookup / store ------------------------------------------------------
+    def get(self, key: Tuple) -> Optional[CollectivePlan]:
+        with self._lock:
+            plan = self._plans.get(key)
+            if plan is None:
+                self.misses += 1
+            else:
+                self.hits += 1
+            return plan
+
+    def store(self, plan: CollectivePlan) -> CollectivePlan:
+        with self._lock:
+            if len(self._plans) >= self.maxsize and plan.key not in self._plans:
+                self._plans.clear()
+            self._plans[plan.key] = plan
+            return plan
+
+    # -- invalidation --------------------------------------------------------
+    def invalidate(self, reason: str = "") -> None:
+        """Drop every plan (register writes / soft reset: anything built
+        before the event may embed stale algorithm choices or engine
+        state)."""
+        with self._lock:
+            self._plans.clear()
+            self.invalidations += 1
+            self.last_invalidation = reason or None
+
+    # -- introspection -------------------------------------------------------
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._plans)
+
+    def stats(self) -> dict:
+        """The ``capabilities()["plan_cache"]`` report."""
+        with self._lock:
+            total = self.hits + self.misses
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_rate": round(self.hits / total, 4) if total else 0.0,
+                "size": len(self._plans),
+                "invalidations": self.invalidations,
+                "last_invalidation": self.last_invalidation,
+            }

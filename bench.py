@@ -443,7 +443,12 @@ def _bench_facade_overhead() -> dict:
         a = g[0]
         s = a.create_buffer_from(np.ones(1024, np.float32))
         d = a.create_buffer(1024, np.float32)
-        a.allreduce(s, d, 1024)  # warm: compiles the program
+        # warm TWICE: call 1 builds the CollectivePlan + compiles the
+        # slow-path program; call 2 is the first plan-cache hit, which
+        # prepares (and jit-caches) the plan's program handle — the
+        # steady state every later call runs in
+        a.allreduce(s, d, 1024)
+        a.allreduce(s, d, 1024)
 
         # one DISTINCT send buffer per call: byte-identical dispatches
         # can be cache-served by the tunnel (see _bench_attention),
@@ -468,6 +473,7 @@ def _bench_facade_overhead() -> dict:
 
         drain()  # earlier benches must not bill their queued work to us
         ic0 = a.engine.device_interactions()
+        pc0 = a.capabilities()["plan_cache"]
         t0 = time.perf_counter()
         for it in range(iters):
             a.allreduce(sends[it], d, 1024)
@@ -478,6 +484,11 @@ def _bench_facade_overhead() -> dict:
         # contract says 1.0 on this path; anything above it is billed a
         # tunnel RTT per unit on tunneled hosts)
         per_call = (a.engine.device_interactions() - ic0) / iters
+        # ...and plan-cache hits per call (cached dispatch): 1.0 on the
+        # warm path — anything below it means calls are re-deriving
+        # their plan (invalidation churn / key instability)
+        pc1 = a.capabilities()["plan_cache"]
+        plan_hit_rate = (pc1["hits"] - pc0["hits"]) / iters
 
         # batched dispatch: N queued collectives flush through the
         # command queue as ONE fused program — the amortized per-call
@@ -512,6 +523,7 @@ def _bench_facade_overhead() -> dict:
         "facade_dispatch_floor_us": round(floor_us, 1),
         "facade_arch_overhead_us": round(call_us - floor_us, 1),
         "facade_device_interactions_per_call": round(per_call, 2),
+        "facade_plan_cache_hit_rate": round(plan_hit_rate, 4),
         "facade_batched_call_overhead_us": round(batched_us, 1),
     }
 

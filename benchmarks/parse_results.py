@@ -54,27 +54,83 @@ class ArchOverheadRegressionError(ValueError):
     committing the slower capture."""
 
 
+#: keys the capture gate holds to the LKG: the architectural share AND
+#: the warm-path end-to-end number (the plan-cache win — a capture that
+#: quietly re-derives its plans per call regresses this one first)
+_GATED_OVERHEAD_KEYS = (
+    "facade_arch_overhead_us",
+    "facade_call_overhead_us",
+)
+
+
 def check_arch_overhead(extras: dict, lkg_result: dict,
                         tolerance: float = None) -> None:
-    """Gate a captured ``extras`` dict against the last-known-good one.
-    No-op when either side lacks the key (pre-PR stashes, wedged runs) or
-    the LKG value is non-positive (a sub-floor local measurement has no
-    meaningful ratio)."""
+    """Gate a captured ``extras`` dict against the last-known-good one:
+    each gated key (arch overhead, warm-path call overhead) is checked
+    independently.  No-op per key when either side lacks it (pre-PR
+    stashes, wedged runs) or the LKG value is non-positive (a sub-floor
+    local measurement has no meaningful ratio)."""
     tol = ARCH_REGRESSION_TOLERANCE if tolerance is None else tolerance
-    fresh = (extras or {}).get("facade_arch_overhead_us")
-    base = ((lkg_result or {}).get("extras") or {}).get(
-        "facade_arch_overhead_us"
-    )
-    if fresh is None or base is None or base <= 0:
-        return
-    if fresh > tol * base:
-        raise ArchOverheadRegressionError(
-            f"facade_arch_overhead_us {fresh:.1f} us regressed beyond "
-            f"{tol:.2f}x the last-known-good {base:.1f} us — the "
-            "single-interaction dispatch contract broke (extra device "
-            "interactions crept back into the call path); refusing the "
-            "capture"
+    lkg_extras = (lkg_result or {}).get("extras") or {}
+    for key in _GATED_OVERHEAD_KEYS:
+        fresh = (extras or {}).get(key)
+        base = lkg_extras.get(key)
+        if fresh is None or base is None or base <= 0:
+            continue
+        if fresh > tol * base:
+            raise ArchOverheadRegressionError(
+                f"{key} {fresh:.1f} us regressed beyond "
+                f"{tol:.2f}x the last-known-good {base:.1f} us — the "
+                "cached-dispatch contract broke (extra device "
+                "interactions or per-call re-planning crept back into "
+                "the call path); refusing the capture"
+            )
+
+
+# Autotuned-plan refusal: a TuningPlan only ever *overrides* registers
+# where a candidate measured faster than the defaults, so a tuned sweep
+# should never be meaningfully slower than the default sweep at any
+# committed point.  5% covers host-timer noise on the emulated tiers.
+TUNED_REGRESSION_TOLERANCE = float(
+    os.environ.get("ACCL_TUNED_REGRESSION_TOLERANCE", "1.05")
+)
+
+
+class TunedPlanRegressionError(ValueError):
+    """A tuned sweep point was slower than the default sweep beyond
+    tolerance: the plan embeds a mis-measured winner; re-run the
+    autotuner (more --runs) instead of committing the slower plan."""
+
+
+def check_tuned_not_slower(default_csv: str, tuned_csv: str,
+                           tolerance: float = None) -> int:
+    """Assert every (collective, count) present in BOTH CSVs satisfies
+    ``tuned_ns <= tolerance * default_ns``.  Returns the number of
+    points compared; raises :class:`TunedPlanRegressionError` listing
+    every violating point."""
+    tol = TUNED_REGRESSION_TOLERANCE if tolerance is None else tolerance
+    base = load(default_csv)
+    tuned = load(tuned_csv)
+    compared = 0
+    bad = []
+    for coll, rows in sorted(tuned.items()):
+        base_by_count = {r[0]: r for r in base.get(coll, [])}
+        for count, _nb, ns, _g in rows:
+            ref = base_by_count.get(count)
+            if ref is None:
+                continue
+            compared += 1
+            if ns > tol * ref[2]:
+                bad.append(
+                    f"{coll} count={count}: tuned {ns:.0f} ns vs "
+                    f"default {ref[2]:.0f} ns ({ns / max(ref[2], 1):.2f}x)"
+                )
+    if bad:
+        raise TunedPlanRegressionError(
+            f"autotuned plan slower than defaults beyond {tol:.2f}x at "
+            f"{len(bad)} of {compared} sweep points:\n  " + "\n  ".join(bad)
         )
+    return compared
 
 
 def check_bench_capture(bench_path: str, lkg_path: str = None) -> None:
@@ -219,7 +275,16 @@ def main(argv=None) -> str:
     if "--check-bench" in argv:
         i = argv.index("--check-bench")
         check_bench_capture(argv[i + 1])
-        print(f"{argv[i + 1]}: facade_arch_overhead_us within tolerance")
+        print(f"{argv[i + 1]}: gated facade overhead keys within tolerance")
+        return ""
+    if "--check-tuned" in argv:
+        i = argv.index("--check-tuned")
+        n = check_tuned_not_slower(argv[i + 1], argv[i + 2])
+        print(
+            f"{argv[i + 2]}: tuned plan within "
+            f"{TUNED_REGRESSION_TOLERANCE:.2f}x of {argv[i + 1]} at all "
+            f"{n} shared sweep points"
+        )
         return ""
     do_plot = "--plot" in argv
     argv = [a for a in argv if a != "--plot"]

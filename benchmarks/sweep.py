@@ -18,7 +18,6 @@ import argparse
 import csv
 import os
 import sys
-import threading
 import time
 from typing import List
 
@@ -26,17 +25,11 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-COLLECTIVES = [
-    "sendrecv",
-    "bcast",
-    "scatter",
-    "gather",
-    "allgather",
-    "reduce",
-    "reduce_scatter",
-    "allreduce",
-    "alltoall",
-]
+# The per-rank measurement harness is shared with the autotuner
+# (accl_tpu/tuning.py is its canonical home): the committed sweep CSVs
+# and the TuningPlan winners are measured by the SAME code, so a plan's
+# "not slower than defaults" guarantee is checkable against the CSVs.
+from accl_tpu.tuning import COLLECTIVES, rank_op, run_group_op  # noqa: F401,E402
 
 # Physically-impossible-rate gate (VERDICT r4 weak #1): an engine bug —
 # e.g. a sentinel duration_ns — must become an ERROR at the writer, not a
@@ -57,18 +50,23 @@ class ImpossibleRateError(RuntimeError):
 # The second writer-side gate: facade_arch_overhead_us regressions.
 # Defined next to the parser (stdlib-only, no jax) and re-exported here
 # so both artifact writers carry the same refusal surface; bench.py
-# invokes it on every fresh capture before the LKG stash.
+# invokes it on every fresh capture before the LKG stash.  The tuned
+# not-slower gate rides along for the --tuning-plan sweeps.
 try:
     from parse_results import (  # running as a script: sibling import
         ARCH_REGRESSION_TOLERANCE,
         ArchOverheadRegressionError,
+        TunedPlanRegressionError,
         check_arch_overhead,
+        check_tuned_not_slower,
     )
 except ImportError:  # pragma: no cover - running as a package module
     from benchmarks.parse_results import (  # noqa: F401
         ARCH_REGRESSION_TOLERANCE,
         ArchOverheadRegressionError,
+        TunedPlanRegressionError,
         check_arch_overhead,
+        check_tuned_not_slower,
     )
 
 
@@ -92,97 +90,98 @@ def write_row(writer, collective: str, count: int, nbytes: int, ns: float):
     )
 
 
-def _rank_op(accl, rank: int, world: int, op: str, n: int):
-    """One rank's side of one collective run; returns the engine-reported
-    duration in ns, or None when this rank does not participate.  Shared
-    by the in-process thread sweeps (emulator/xla gang) and the
-    one-OS-process-per-rank dist sweep."""
-    if op == "sendrecv":
-        if rank == 0:
-            buf = accl.create_buffer_from(np.ones(n, np.float32))
-            req = accl.send(buf, n, dst=1, tag=0, run_async=True)
-        elif rank == 1:
-            buf = accl.create_buffer(n, np.float32)
-            req = accl.recv(buf, n, src=0, tag=0, run_async=True)
-        else:
-            return None
-    elif op == "bcast":
-        buf = accl.create_buffer_from(np.ones(n, np.float32))
-        req = accl.bcast(buf, n, root=0, run_async=True)
-    elif op == "scatter":
-        send = accl.create_buffer_from(np.ones(world * n, np.float32))
-        recv = accl.create_buffer(n, np.float32)
-        req = accl.scatter(send, recv, n, root=0, run_async=True)
-    elif op == "gather":
-        send = accl.create_buffer_from(np.ones(n, np.float32))
-        recv = accl.create_buffer(world * n, np.float32)
-        req = accl.gather(send, recv, n, root=0, run_async=True)
-    elif op == "allgather":
-        send = accl.create_buffer_from(np.ones(n, np.float32))
-        recv = accl.create_buffer(world * n, np.float32)
-        req = accl.allgather(send, recv, n, run_async=True)
-    elif op == "reduce":
-        send = accl.create_buffer_from(np.ones(n, np.float32))
-        recv = accl.create_buffer(n, np.float32)
-        req = accl.reduce(send, recv, n, root=0, run_async=True)
-    elif op == "reduce_scatter":
-        send = accl.create_buffer_from(np.ones(world * n, np.float32))
-        recv = accl.create_buffer(n, np.float32)
-        req = accl.reduce_scatter(send, recv, n, run_async=True)
-    elif op == "allreduce":
-        send = accl.create_buffer_from(np.ones(n, np.float32))
-        recv = accl.create_buffer(n, np.float32)
-        req = accl.allreduce(send, recv, n, run_async=True)
-    elif op == "alltoall":
-        send = accl.create_buffer_from(np.ones(world * n, np.float32))
-        recv = accl.create_buffer(world * n, np.float32)
-        req = accl.alltoall(send, recv, n, run_async=True)
-    else:
-        raise ValueError(op)
-    assert req.wait(120), f"{op} count={n} rank={rank} timed out"
-    req.check()
-    return req.get_duration_ns()
+# Back-compat names: _dist_sweep_worker (and any external caller) keeps
+# the underscore form; the implementations live in accl_tpu.tuning.
+_rank_op = rank_op
+_run_group_op = run_group_op
 
 
-def _run_group_op(group, op: str, count: int) -> float:
-    """One synchronized run across all rank handles; returns max engine
-    duration in ns (the reference records device cycle counts per rank)."""
-    durations = [0] * len(group)
-    world = len(group)
-
-    def work(i):
-        ns = _rank_op(group[i], i, world, op, count)
-        if ns is not None:
-            durations[i] = ns
-
-    errors: List[BaseException] = []
-
-    def guarded(i):
-        try:
-            work(i)
-        except BaseException as e:  # noqa: BLE001 - re-raised on the main thread
-            errors.append(e)
-
-    threads = [threading.Thread(target=guarded, args=(i,)) for i in range(world)]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    if errors:
-        raise errors[0]
-    return max(durations)
-
-
-def sweep_group(group, sizes: List[int], collectives: List[str], writer) -> None:
+def sweep_group(group, sizes: List[int], collectives: List[str], writer,
+                best_of: int = 1) -> None:
     for op in collectives:
         for n in sizes:
             # warm + record the SECOND run: the device tiers jit-compile
             # per (op, wire shape), and a cold first call would put the
             # compiler in the table instead of the engine (the reference
-            # records steady-state per-call durations)
+            # records steady-state per-call durations).  --best-of N
+            # takes the min of N measured runs — the noise discipline
+            # the tuned-vs-default 5% gate needs on shared-CPU hosts.
             _run_group_op(group, op, n)
-            ns = _run_group_op(group, op, n)
+            ns = min(
+                _run_group_op(group, op, n) for _ in range(max(1, best_of))
+            )
             write_row(writer, op, n, n * 4, ns)
+
+
+def sweep_group_paired(group, sizes: List[int], collectives: List[str],
+                       writer_default, writer_tuned, plan,
+                       rounds: int = 8, samples: int = 3) -> None:
+    """The tuned-vs-default artifact pair, measured to survive the <=5%
+    not-slower gate on a contended host: ONE group, per point
+    block-interleaved A/B rounds (plan unloaded / loaded), one warm
+    discard after each flip (absorbs the post-flip re-plan), per-side
+    duration = MIN over all rounds' samples (the drift-robust floor —
+    interleaving means both sides sample the same load timeline).  Two
+    separately-captured sweeps cannot do this: on a 2-core container the
+    run-to-run wall-clock drift alone exceeds 5%."""
+    # Weightless A/B flips: the plan's DEFAULTS are applied once up
+    # front (both sides run them — what's being A/B'd is the per-bucket
+    # overlays, the per-size selection this artifact certifies); each
+    # flip then swaps only the facade's plan pointer.  Full register
+    # churn per flip was itself measurable on a 2-core host and biased
+    # whichever side sampled right after it.
+    for a in group:
+        a.load_tuning_plan(plan)
+
+    state = {"side": "tuned"}  # the defaults-application above loaded it
+
+    def flip(side):
+        # a redundant same-side flip MUST be a no-op: unload's early
+        # return makes it free for one side while a re-load would
+        # invalidate the other side's plan pool — that asymmetry hands
+        # the default side warm prepared-path runs the tuned side never
+        # gets (measured as a fake 1.7x "regression" on identical code)
+        if state["side"] == side:
+            return
+        state["side"] = side
+        for a in group:
+            if side == "tuned":
+                a.load_tuning_plan(plan, apply_defaults=False)
+            else:
+                a.unload_tuning_plan(restore_defaults=False)
+
+    try:
+        for op in collectives:
+            for n in sizes:
+                vals = {"default": [], "tuned": []}
+                for side in ("default", "tuned"):  # compile both paths
+                    flip(side)
+                    _run_group_op(group, op, n)
+                # strict run-by-run alternation, with the within-pair
+                # order ROTATING every iteration: any coarser (block)
+                # interleaving — or a fixed pair order — lets load
+                # drift bill one side systematically (measured at
+                # 10-40% on a 2-core host).  gc stays ENABLED: pinning
+                # it off makes allocation pressure grow monotonically
+                # through a point, handing whichever side samples
+                # first a systematic edge; gc pauses are spikes, and
+                # the per-side MIN filters spikes.  The flip is
+                # weightless (plan pointer only), so per-run flipping
+                # costs nothing measurable.
+                for k in range(max(1, rounds) * max(1, samples)):
+                    pair = ("default", "tuned")
+                    if k % 2:
+                        pair = ("tuned", "default")
+                    for side in pair:
+                        flip(side)
+                        vals[side].append(_run_group_op(group, op, n))
+                write_row(writer_default, op, n, n * 4,
+                          min(vals["default"]))
+                write_row(writer_tuned, op, n, n * 4, min(vals["tuned"]))
+    finally:
+        for a in group:  # full unload: registers back to stock
+            a.load_tuning_plan(plan, apply_defaults=False)
+            a.unload_tuning_plan()
 
 
 def _dist_sweep_worker(accl, rank, world):
@@ -193,8 +192,12 @@ def _dist_sweep_worker(accl, rank, world):
     import json
 
     spec = json.loads(os.environ["ACCL_SWEEP_SPEC"])
+    best_of = max(1, int(spec.get("best_of", 1)))
     # warm-up: the first dist op pays gloo wiring + first-compile, which
-    # would otherwise land entirely in row one's duration
+    # would otherwise land entirely in row one's duration.  A tuning
+    # plan arrives via ACCL_TUNING_PLAN (env crosses spawn), loaded by
+    # the ACCL constructor in every rank process identically — the
+    # SPMD-uniformity contract per-call overlays require.
     warm_s = accl.create_buffer_from(np.ones(16, np.float32))
     warm_d = accl.create_buffer(16, np.float32)
     accl.allreduce(warm_s, warm_d, 16)
@@ -204,13 +207,16 @@ def _dist_sweep_worker(accl, rank, world):
             # warm + record the second run (steady state, like the
             # in-process sweeps — see sweep_group)
             _rank_op(accl, rank, world, op, n)
-            ns = _rank_op(accl, rank, world, op, n)
-            out.append((op, n, ns))
+            runs = [
+                _rank_op(accl, rank, world, op, n) for _ in range(best_of)
+            ]
+            vals = [v for v in runs if v is not None]  # non-participants
+            out.append((op, n, min(vals) if vals else None))
     return out
 
 
 def sweep_dist(world: int, sizes: List[int], collectives: List[str],
-               writer, base_port: int = 47910) -> None:
+               writer, base_port: int = 47910, best_of: int = 1) -> None:
     """Sweep the multi-process dist tier: one OS process per rank over
     jax.distributed (the deployment shape of real pods), same nine
     collectives, engine durations gathered to the parent.  The fourth
@@ -220,7 +226,8 @@ def sweep_dist(world: int, sizes: List[int], collectives: List[str],
     from accl_tpu.launch import launch_processes
 
     os.environ["ACCL_SWEEP_SPEC"] = json.dumps(
-        {"collectives": list(collectives), "sizes": list(sizes)}
+        {"collectives": list(collectives), "sizes": list(sizes),
+         "best_of": best_of}
     )
     try:
         results = launch_processes(
@@ -335,6 +342,27 @@ def main(argv=None) -> int:
         help="ops backend only: also sweep explicit ring / Pallas-ring "
              "allreduce (the algorithm-faithful modes)",
     )
+    ap.add_argument(
+        "--tuning-plan", default=None,
+        help="TuningPlan JSON to load into every rank handle before "
+             "sweeping (emulator/xla: ACCL.load_tuning_plan; dist: the "
+             "ACCL_TUNING_PLAN env crosses into the spawned rank "
+             "processes) — the tuned leg of the tuned-vs-default gate",
+    )
+    ap.add_argument(
+        "--best-of", type=int, default=1,
+        help="record the min of N measured runs per point (after the "
+             "warm run); in --paired-tuned-csv mode this is the number "
+             "of interleaved A/B rounds per point",
+    )
+    ap.add_argument(
+        "--paired-tuned-csv", default=None,
+        help="with --tuning-plan on an in-process backend: capture the "
+             "default AND tuned sweeps block-interleaved in one session "
+             "(--csv gets the default rows, this path the tuned rows) — "
+             "the only capture mode whose <=5% not-slower comparison is "
+             "meaningful on a contended host",
+    )
     args = ap.parse_args(argv)
 
     from accl_tpu.utils import mirror_platform_env
@@ -351,9 +379,23 @@ def main(argv=None) -> int:
     writer.writeheader()
 
     if args.backend == "ops":
+        if args.tuning_plan:
+            raise SystemExit(
+                "--tuning-plan applies to the facade tiers "
+                "(emulator/xla/dist), not the raw ops layer"
+            )
         sweep_ops(args.world, sizes, writer, tuple(args.extra_algos))
     elif args.backend == "dist":
-        sweep_dist(args.world, sizes, args.collectives, writer)
+        if args.tuning_plan:
+            os.environ["ACCL_TUNING_PLAN"] = os.path.abspath(
+                args.tuning_plan
+            )
+        try:
+            sweep_dist(args.world, sizes, args.collectives, writer,
+                       best_of=args.best_of)
+        finally:
+            if args.tuning_plan:
+                os.environ.pop("ACCL_TUNING_PLAN", None)
     else:
         from accl_tpu import core
 
@@ -363,7 +405,29 @@ def main(argv=None) -> int:
             else core.xla_group(args.world)
         )
         try:
-            sweep_group(group, sizes, args.collectives, writer)
+            if args.paired_tuned_csv:
+                if not args.tuning_plan:
+                    raise SystemExit("--paired-tuned-csv needs --tuning-plan")
+                from accl_tpu.tuning import TuningPlan
+
+                plan = TuningPlan.load(args.tuning_plan)
+                with open(args.paired_tuned_csv, "w", newline="") as f2:
+                    writer2 = csv.DictWriter(
+                        f2,
+                        fieldnames=["collective", "count", "bytes",
+                                    "duration_ns", "gbps"],
+                    )
+                    writer2.writeheader()
+                    sweep_group_paired(
+                        group, sizes, args.collectives, writer, writer2,
+                        plan, rounds=max(2, args.best_of),
+                    )
+            else:
+                if args.tuning_plan:
+                    for a in group:
+                        a.load_tuning_plan(args.tuning_plan)
+                sweep_group(group, sizes, args.collectives, writer,
+                            best_of=args.best_of)
         finally:
             for a in group:
                 a.deinit()

@@ -8,19 +8,22 @@
 #                         flash-attention training record + facade/
 #                         gang decompositions; refreshes .bench_lkg.json
 #   3. chip pytest tier — tests/run_tpu_tier.py writes TPU_TIER.json
+#   4. autotune         — guarded chip-tier TuningPlan + same-session
+#                         tuned-vs-default CSV pair (benchmarks/results/)
 #
 # Run from the repo root. Artifacts to commit afterwards:
-#   .bench_lkg.json  TPU_TIER.json  (+ BENCH_NOTES update)
+#   .bench_lkg.json  TPU_TIER.json  tuning_plan_chip_w1.json
+#   sweep_chip_w1_tuned{_baseline,}.csv  (+ BENCH_NOTES update)
 set -u -o pipefail
 cd "$(dirname "$0")/.."
 
-echo "== 1/3 probe" >&2
+echo "== 1/4 probe" >&2
 if ! ACCL_BENCH_MODE=probe timeout 150 python bench.py; then
   echo "tunnel wedged — aborting before touching the chip" >&2
   exit 2
 fi
 
-echo "== 2/3 guarded bench (this is the long leg; do not signal it)" >&2
+echo "== 2/4 guarded bench (this is the long leg; do not signal it)" >&2
 python bench.py | tee /tmp/bench_chip_session.json
 # The guarded parent ALWAYS exits 0 (the wedge-proof fallback is the
 # point), so success is judged from the emitted JSON: a fresh capture
@@ -44,7 +47,23 @@ then
   exit 3
 fi
 
-echo "== 3/3 chip pytest tier" >&2
+echo "== 3/4 chip pytest tier" >&2
 python tests/run_tpu_tier.py
 
-echo "== done; commit .bench_lkg.json TPU_TIER.json and update BENCH_NOTES" >&2
+# Guarded autotune leg (after bench: a wedged tunnel already aborted
+# above, and the races pile compiles onto the chip, so it goes LAST).
+# Writes the chip-tier TuningPlan artifact next to the sweep CSVs; a
+# failure here must not discard the bench/tier evidence already
+# captured — hence || true with a loud note.
+echo "== 4/4 autotune (chip tier, world=1)" >&2
+if ! timeout 900 python -m accl_tpu.tuning --backend xla --world 1 \
+    --min-exp 8 --max-exp 20 --step-exp 4 --runs 3 \
+    --out benchmarks/results/tuning_plan_chip_w1.json \
+    --csv-default benchmarks/results/sweep_chip_w1_tuned_baseline.csv \
+    --csv-tuned benchmarks/results/sweep_chip_w1_tuned.csv; then
+  echo "autotune leg failed/timed out — bench + tier artifacts above are" \
+       "still good; re-run the leg alone after a re-probe" >&2
+fi
+
+echo "== done; commit .bench_lkg.json TPU_TIER.json" \
+     "benchmarks/results/tuning_plan_chip_w1.json and update BENCH_NOTES" >&2

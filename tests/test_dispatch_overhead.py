@@ -308,6 +308,147 @@ def test_gang_dump_rx_buffers_reports_parked_state(g4):
 
 
 # ---------------------------------------------------------------------------
+# plan-cache counters (cached per-call dispatch plans, accl_tpu.plans)
+# ---------------------------------------------------------------------------
+
+
+def _plan_stats(a) -> dict:
+    pc = a.capabilities()["plan_cache"]
+    assert isinstance(pc["hits"], int) and isinstance(pc["misses"], int)
+    return pc
+
+
+def test_warm_collective_is_one_interaction_and_plan_hit(g4):
+    """The cached-dispatch contract, counter-asserted both ways: a warm
+    gang collective is EXACTLY 1 device interaction AND >= 1 plan-cache
+    hit (zero misses) — pool-lookup -> dispatch, nothing re-derived."""
+    n = 64
+    send = [
+        a.create_buffer_from(np.full(n, float(r + 1), np.float32))
+        for r, a in enumerate(g4)
+    ]
+    recv = [a.create_buffer(n, np.float32) for a in g4]
+
+    def work(a, r):
+        a.allreduce(send[r], recv[r], n)
+
+    run_parallel(g4, work)  # cold: builds the plan (miss) + template
+    run_parallel(g4, work)  # first hit: prepares the program handle
+    ic0 = _interactions(g4[0])
+    pc0 = _plan_stats(g4[0])
+    run_parallel(g4, work)
+    assert _interactions(g4[0]) - ic0 == 1
+    pc1 = _plan_stats(g4[0])
+    assert pc1["hits"] - pc0["hits"] >= 1, "warm call must hit the pool"
+    assert pc1["misses"] == pc0["misses"], "warm call must not re-plan"
+    for r in range(4):
+        recv[r].sync_from_device()
+        np.testing.assert_allclose(recv[r].data, 10.0)
+
+
+def test_set_tuning_forces_exactly_one_replan(g4):
+    """A register write invalidates the pool: the NEXT call re-plans
+    (exactly one miss), the one after hits again."""
+    n = 32
+    send = [
+        a.create_buffer_from(np.full(n, float(r + 1), np.float32))
+        for r, a in enumerate(g4)
+    ]
+    recv = [a.create_buffer(n, np.float32) for a in g4]
+
+    def work(a, r):
+        a.allreduce(send[r], recv[r], n)
+
+    run_parallel(g4, work)
+    run_parallel(g4, work)
+    for a in g4:  # a write of the DEFAULT value still invalidates
+        a.set_tuning("ring_segments", 1)
+    pc0 = _plan_stats(g4[0])
+    assert pc0["size"] == 0 and pc0["last_invalidation"] == "set_tuning"
+    run_parallel(g4, work)
+    pc1 = _plan_stats(g4[0])
+    assert pc1["misses"] - pc0["misses"] == 1, "exactly one re-plan"
+    run_parallel(g4, work)
+    pc2 = _plan_stats(g4[0])
+    assert pc2["misses"] == pc1["misses"]
+    assert pc2["hits"] - pc1["hits"] >= 1
+
+
+def test_soft_reset_forces_exactly_one_replan(g4):
+    """soft_reset is a full flush: pool cleared AND communicator epochs
+    bumped, so a stale plan can neither be served nor re-keyed."""
+    n = 32
+    send = [
+        a.create_buffer_from(np.full(n, float(r + 1), np.float32))
+        for r, a in enumerate(g4)
+    ]
+    recv = [a.create_buffer(n, np.float32) for a in g4]
+
+    def work(a, r):
+        a.allreduce(send[r], recv[r], n)
+
+    run_parallel(g4, work)
+    run_parallel(g4, work)
+    epoch0 = g4[0].comm.epoch
+    for a in g4:  # collective by contract: every rank, nothing in flight
+        a.soft_reset()
+    assert g4[0].comm.epoch != epoch0, "soft_reset must re-epoch comms"
+    pc0 = _plan_stats(g4[0])
+    assert pc0["size"] == 0
+    run_parallel(g4, work)
+    pc1 = _plan_stats(g4[0])
+    assert pc1["misses"] - pc0["misses"] == 1, "exactly one re-plan"
+    run_parallel(g4, work)
+    assert _plan_stats(g4[0])["misses"] == pc1["misses"]
+    for r in range(4):
+        recv[r].sync_from_device()
+        np.testing.assert_allclose(recv[r].data, 10.0)
+
+
+def test_subcomm_epoch_churn_never_reuses_stale_plan(g4):
+    """The PR 2 seqn-epoch lesson applied to plans: a re-created
+    same-membership subcommunicator reuses the deterministic comm id but
+    carries a fresh epoch, so the first collective on the NEW instance
+    must re-plan (one miss), never serve the old instance's plan."""
+    n = 16
+    sub = [a.create_communicator([0, 1]) for a in g4]
+    assert sub[2] is None and sub[3] is None
+    assert sub[0].id == sub[1].id
+
+    def work(comms):
+        send = [
+            a.create_buffer_from(np.full(n, float(r + 1), np.float32))
+            for r, a in enumerate(g4[:2])
+        ]
+        recv = [a.create_buffer(n, np.float32) for a in g4[:2]]
+
+        def body(a, r):
+            a.allreduce(send[r], recv[r], n, comm=comms[r])
+
+        run_parallel(g4[:2], body)
+        for r in range(2):
+            recv[r].sync_from_device()
+            np.testing.assert_allclose(recv[r].data, 3.0)
+
+    work(sub)   # plan built for (comm id, epoch A)
+    pc0 = _plan_stats(g4[0])
+    work(sub)   # same instance: hit
+    pc1 = _plan_stats(g4[0])
+    assert pc1["hits"] - pc0["hits"] >= 1
+    assert pc1["misses"] == pc0["misses"]
+
+    sub2 = [a.create_communicator([0, 1]) for a in g4]
+    assert sub2[0].id == sub[0].id, "deterministic id must be reused"
+    assert sub2[0].epoch != sub[0].epoch
+    pc2 = _plan_stats(g4[0])
+    work(sub2)  # new instance: MUST re-plan
+    pc3 = _plan_stats(g4[0])
+    assert pc3["misses"] - pc2["misses"] == 1, (
+        "a re-created same-id subcomm must never reuse the stale plan"
+    )
+
+
+# ---------------------------------------------------------------------------
 # capture-regression gate (benchmarks/parse_results.py / sweep.py)
 # ---------------------------------------------------------------------------
 
@@ -331,6 +472,12 @@ def test_arch_overhead_regression_gate():
         {"facade_arch_overhead_us": 50.0},
         {"extras": {"facade_arch_overhead_us": -3.0}},
     )
+    # the warm-path end-to-end number is gated the same way (the plan
+    # cache's win: per-call re-planning creeping back regresses it)
+    lkg_warm = {"extras": {"facade_call_overhead_us": 200.0}}
+    check_arch_overhead({"facade_call_overhead_us": 240.0}, lkg_warm)
+    with pytest.raises(ArchOverheadRegressionError):
+        check_arch_overhead({"facade_call_overhead_us": 260.0}, lkg_warm)
     # sweep.py re-exports the same surface (both artifact writers gate)
     from benchmarks.sweep import check_arch_overhead as via_sweep
 

@@ -22,6 +22,31 @@ from accl_tpu.constants import (
     ErrorCode,
     TuningKey,
 )
+from accl_tpu.tuning import REGISTER_DEFAULTS
+
+
+def _restore_defaults(group):
+    """Put every register a test may have flipped back to stock.  Runs
+    as a fixture FINALIZER so an assertion failure mid-test can no
+    longer leak `max_eager_size=4` / flipped thresholds into sibling
+    tests sharing the module-scoped group."""
+    for a in group:
+        a.set_max_eager_size(REGISTER_DEFAULTS["max_eager_size"])
+        for name, val in REGISTER_DEFAULTS.items():
+            if name != "max_eager_size":
+                a.set_tuning(name, val)
+
+
+@pytest.fixture
+def tuned2(group2):
+    yield group2
+    _restore_defaults(group2)
+
+
+@pytest.fixture
+def tuned4(group4):
+    yield group4
+    _restore_defaults(group4)
 
 
 # ---------------------------------------------------------------------------
@@ -30,10 +55,13 @@ from accl_tpu.constants import (
 
 
 @pytest.mark.parametrize("flat", [True, False])
-def test_bcast_flat_vs_tree_at_runtime(group4, rng, flat):
+def test_bcast_flat_vs_tree_at_runtime(tuned4, rng, flat):
     """BCAST_FLAT_TREE_MAX_RANKS flipped through the facade selects the
     flat fan-out (threshold >= size) or the binomial tree (threshold 0);
-    both must deliver root data everywhere."""
+    both must deliver root data everywhere.  Restoration is the tuned4
+    finalizer's job — a mid-test assertion failure must not leak the
+    flipped registers into sibling tests."""
+    group4 = tuned4
     n = 64
     # rendezvous path so the tree algorithm actually engages
     for a in group4:
@@ -48,13 +76,11 @@ def test_bcast_flat_vs_tree_at_runtime(group4, rng, flat):
     for r in range(4):
         bufs[r].sync_from_device()
         np.testing.assert_allclose(bufs[r].host_view(), data, rtol=1e-6)
-    for a in group4:  # restore defaults for sibling tests
-        a.set_max_eager_size(32 * 1024)
-        a.set_tuning(TuningKey.BCAST_FLAT_TREE_MAX_RANKS, 3)
 
 
 @pytest.mark.parametrize("flat", [True, False])
-def test_reduce_flat_vs_tree_at_runtime(group4, rng, flat):
+def test_reduce_flat_vs_tree_at_runtime(tuned4, rng, flat):
+    group4 = tuned4
     n = 64
     for a in group4:
         a.set_max_eager_size(4)
@@ -74,15 +100,12 @@ def test_reduce_flat_vs_tree_at_runtime(group4, rng, flat):
     np.testing.assert_allclose(
         rb[2].host_view(), np.sum(rows, axis=0), rtol=1e-4, atol=1e-5
     )
-    for a in group4:
-        a.set_max_eager_size(32 * 1024)
-        a.set_tuning(TuningKey.REDUCE_FLAT_TREE_MAX_RANKS, 4)
-        a.set_tuning(TuningKey.REDUCE_FLAT_TREE_MAX_COUNT, 8 * 1024)
 
 
-def test_gather_fanin_register(group4, rng):
+def test_gather_fanin_register(tuned4, rng):
     """Gather's fan-in throttle register is writable and gather stays
     correct with a fan-in of 1 (fully serialized) vs wide."""
+    group4 = tuned4
     n = 16
     for fanin in (1, 8):
         for a in group4:
@@ -102,14 +125,11 @@ def test_gather_fanin_register(group4, rng):
         np.testing.assert_allclose(
             rb0.host_view(), np.concatenate(rows), rtol=1e-6
         )
-    for a in group4:
-        a.set_tuning(TuningKey.GATHER_FLAT_TREE_MAX_FANIN, 2)
-        a.set_tuning(TuningKey.GATHER_FLAT_TREE_MAX_COUNT, 32 * 1024)
 
 
-def test_tuning_register_state_visible(group2):
+def test_tuning_register_state_visible(tuned2):
     """Emulator-tier registers are readable back from the engine table."""
-    a = group2[0]
+    a = tuned2[0]
     if not hasattr(a.engine, "tuning"):
         pytest.skip("native engine state not host-readable")
     a.set_tuning("bcast_flat_tree_max_ranks", 7)
