@@ -43,6 +43,14 @@ from .communicator import Communicator, Rank  # noqa: F401
 from .core import ACCL, emulated_group, socket_group_member  # noqa: F401
 from .plans import CollectivePlan, PlanCache, size_bucket  # noqa: F401
 from .request import Request, RequestStatus  # noqa: F401
+from .telemetry import (  # noqa: F401
+    CallRecord,
+    FlightRecorder,
+    MetricsRegistry,
+    Telemetry,
+    merge_traces,
+    to_prometheus,
+)
 from .tuning import TUNING_PLAN_ENV, TuningPlan, autotune  # noqa: F401
 
 __version__ = "0.1.0"

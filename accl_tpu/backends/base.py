@@ -150,6 +150,19 @@ class BaseEngine:
             if i != comm.local_rank
         }
 
+    def telemetry_report(self) -> dict:
+        """Engine-side counters for ``ACCL.telemetry_snapshot()``: the
+        tier-specific live-resource depths and event counters (rx pool,
+        retransmit window, fault injector, gang slots, stream ports).
+        Each tier overrides with its own facts; the shape is flat
+        scalars/small dicts so the Prometheus exporter can fold the
+        numbers out as gauges.  Must be cheap and side-effect-free —
+        dashboards poll it."""
+        return {
+            "device_interactions": self.device_interactions(),
+            "faults": None,
+        }
+
     def create_buffer(self, count: int, dtype, host_only: bool = False,
                       data=None):
         """Backend-appropriate buffer (ref: ACCL::create_buffer dispatching

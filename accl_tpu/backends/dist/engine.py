@@ -245,6 +245,19 @@ class DistEngine(StreamPortMixin, BaseEngine):
     def device_interactions(self) -> int:
         return self.interactions.read()
 
+    def telemetry_report(self) -> dict:
+        """Dist-tier counters for the telemetry snapshot: executor queue
+        backlog, remote stream-port sequence positions, cached meshes."""
+        with self._stream_seq_lock:
+            stream_seq = dict(self._stream_seq)
+        return {
+            "device_interactions": self.interactions.read(),
+            "executor_queue_depth": len(self._queue),
+            "remote_stream_seq": stream_seq,
+            "cached_meshes": len(self._meshes),
+            "faults": None,
+        }
+
     def _run(self) -> None:
         while not self._shut:
             item = self._queue.pop(timeout=0.5)

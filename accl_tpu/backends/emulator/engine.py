@@ -143,6 +143,10 @@ class EmuEngine(BaseEngine):
         # graceful-degradation map (capabilities()["health"]); a peer
         # marked "dead" fails new collectives fast at call intake
         self._health: Dict[str, dict] = {}
+        # telemetry counters (accl_tpu.telemetry snapshot): recovery-
+        # protocol event totals the metrics registry absorbs
+        self._retransmits_total = 0
+        self._dedup_discards_total = 0
         self.leaked_scheduler_thread = False
 
         self._queue = CommandQueue()
@@ -343,6 +347,23 @@ class EmuEngine(BaseEngine):
     def dump_rx_buffers(self) -> str:
         return "\n".join(self.rx_pool.dump())
 
+    def telemetry_report(self) -> dict:
+        """Emulator-tier counters for the telemetry snapshot: rx-pool
+        depth, inbox backlog, the recovery protocol's live window and
+        event totals, and the armed fault plan's fire counters."""
+        used, total = self.rx_pool.occupancy()
+        inj = getattr(self.fabric, "fault_injector", None)
+        return {
+            "device_interactions": None,
+            "rx_pool": {"used": used, "total": total},
+            "inbox_depth": self.endpoint.pending(),
+            "retransmit_window": len(self._retrans),
+            "retransmits_total": self._retransmits_total,
+            "dedup_discards_total": self._dedup_discards_total,
+            "retry_limit": self.retry_limit,
+            "faults": inj.stats() if inj is not None else None,
+        }
+
     # -- scheduler ----------------------------------------------------------
     def _route_inbox(self) -> None:
         """Move arrived messages to their stations (the rxbuf_enqueue/dequeue
@@ -381,9 +402,11 @@ class EmuEngine(BaseEngine):
                         (emsg.comm_id, emsg.src, emsg.epoch), emsg.seqn
                     ):
                         self.rx_pool.fill(emsg, timeout=0)
-                    # else: duplicate (fault-injected or a retransmit whose
-                    # original arrived) — re-acked above, then discarded so
-                    # it can never occupy a pool slot
+                    else:
+                        # duplicate (fault-injected or a retransmit whose
+                        # original arrived) — re-acked above, then
+                        # discarded so it can never occupy a pool slot
+                        self._dedup_discards_total += 1
             if not routed_any:
                 return
 
@@ -420,6 +443,7 @@ class EmuEngine(BaseEngine):
                 continue
             ent.attempts += 1
             ent.due = now + self.retry_backoff_s * (2 ** ent.attempts)
+            self._retransmits_total += 1
             try:
                 self.fabric.send(ent.address, ent.msg)
             except (PeerDeadError, KeyError, OSError):

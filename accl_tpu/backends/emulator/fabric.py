@@ -32,6 +32,14 @@ import zlib
 from typing import Callable, Dict, List, Optional
 
 from ...faults import FaultInjector, FaultPlan, PeerDeadError
+from ...utils.logging import Log, LogLevel
+
+# Per-message wire tracing (ACCL_DEBUG=TRACE): events route through the
+# telemetry plane's buffered ring (accl_tpu.telemetry.wire_event) instead
+# of synchronous stderr writes, so tracing no longer perturbs the
+# timings being traced; ACCL_TRACE_STDERR=1 opts the stderr sink back in.
+# One level compare per send when tracing is off.
+_WIRE_LOG = Log("wire")
 
 
 class MsgType(enum.IntEnum):
@@ -167,6 +175,12 @@ class Fabric:
         raise NotImplementedError
 
     def send(self, address: str, msg: Message) -> None:
+        if _WIRE_LOG.level >= LogLevel.TRACE:
+            _WIRE_LOG.trace(
+                f"send {msg.msg_type.name} comm={msg.comm_id} "
+                f"src={msg.src} dst={msg.dst} tag={msg.tag} "
+                f"seqn={msg.seqn} bytes={len(msg.payload)} -> {address}"
+            )
         inj = self._injector
         if inj is None:
             self._transmit(address, msg)

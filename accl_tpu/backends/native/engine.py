@@ -285,6 +285,19 @@ class NativeEngine(BaseEngine):
             for i in range(total)
         )
 
+    def telemetry_report(self) -> dict:
+        """Native-tier counters for the telemetry snapshot: the C++
+        engine's rx-pool occupancy over the C ABI (per-call facts ride
+        the shared Request flight-recorder hook like every tier)."""
+        return {
+            "device_interactions": None,
+            "rx_pool": {
+                "used": int(self._lib.accl_ng_rx_occupancy(self._handle)),
+                "total": int(self._lib.accl_ng_rx_capacity(self._handle)),
+            },
+            "faults": None,
+        }
+
 
 # ---------------------------------------------------------------------------
 # group constructors (mirror core.emulated_group / socket_group_member)

@@ -1657,6 +1657,27 @@ class XLAEngine(StreamPortMixin, BaseEngine):
     def device_interactions(self) -> int:
         return self.gang.interactions.read()
 
+    def telemetry_report(self) -> dict:
+        """Gang-tier counters for the telemetry snapshot: pending
+        rendezvous slots, parked p2p posts, undrained stream ports, and
+        the shared interaction counter."""
+        with self.gang._lock:
+            pending_slots = len(self.gang._slots)
+        with self._stream_cv:
+            stream_depths = {
+                sid: len(chunks)
+                for sid, chunks in sorted(self._streams.items())
+                if chunks
+            }
+        return {
+            "device_interactions": self.gang.interactions.read(),
+            "gang_pending_slots": pending_slots,
+            "gang_tuning_epoch": self.gang.tuning_epoch,
+            "p2p_parked": len(self.p2p.dump_parked()),
+            "stream_depths": stream_depths,
+            "faults": None,
+        }
+
     def health_report(self, comm: Communicator) -> Dict[int, dict]:
         """Per-peer health from the gang watchdog accounting, keyed by
         comm-relative rank (capabilities()["health"] on the gang tier)."""

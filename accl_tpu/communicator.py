@@ -126,6 +126,28 @@ class Communicator:
         )
 
     # -- debug --------------------------------------------------------------
+    def as_dict(self) -> dict:
+        """Structured form of :meth:`dump` (the telemetry plane's
+        ``dump_communicator(as_dict=True)`` source; the legacy string is
+        rendered from this dict)."""
+        with self._lock:
+            return {
+                "id": self.id,
+                "epoch": self.epoch,
+                "size": self.size,
+                "local_rank": self.local_rank,
+                "ranks": [
+                    {
+                        "address": r.address,
+                        "session": r.session,
+                        "max_segment_size": r.max_segment_size,
+                        "seq_out": self._outbound_seq[i],
+                        "seq_in": self._inbound_seq[i],
+                    }
+                    for i, r in enumerate(self.ranks)
+                ],
+            }
+
     def dump(self) -> str:
         lines = [f"communicator {self.id}: size={self.size} local={self.local_rank}"]
         with self._lock:

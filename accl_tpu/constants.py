@@ -304,8 +304,14 @@ class ACCLError(RuntimeError):
         if context:
             msg += f" during {context}"
         if self.details:
+            # bulky structured payloads (the telemetry plane's
+            # flight-recorder tail) are summarized by length in the
+            # message; the full records stay in .details for callers
             msg += " (" + ", ".join(
-                f"{k}={v}" for k, v in sorted(self.details.items())
+                f"{k}=<{len(v)} records>"
+                if k == "flight_recorder" and isinstance(v, (list, tuple))
+                else f"{k}={v}"
+                for k, v in sorted(self.details.items())
             ) + ")"
         super().__init__(msg)
 

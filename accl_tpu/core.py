@@ -39,6 +39,7 @@ from .constants import (
 )
 from .plans import CollectivePlan, PlanCache, size_bucket
 from .request import Request
+from .telemetry import Telemetry, chrome_trace, to_json, to_prometheus
 
 DTypeLike = Union[DataType, str, np.dtype, type]
 
@@ -82,6 +83,18 @@ class ACCL:
         # load_tuning_plan / the ACCL_TUNING_PLAN env; per-size-bucket
         # register overlays ride the plan cache into CallOptions.tuning
         self._tuning_plan = None
+        # telemetry plane (accl_tpu.telemetry): flight recorder + metrics
+        # registry, None under the ACCL_TELEMETRY=0 kill switch.  Last
+        # plan-cache verdict (hit/miss) stamped per call by _plan_for —
+        # THREAD-local: _plan_for and _launch run on the caller's
+        # thread, and concurrent async callers on one handle must not
+        # swap each other's verdicts between the two
+        self._telemetry = Telemetry.create(
+            rank=local_rank, tier=type(engine).__name__
+        )
+        import threading
+
+        self._call_tls = threading.local()
         self._initialize(timeout_s, max_eager_size, max_rendezvous_size)
         env_plan = os.environ.get("ACCL_TUNING_PLAN")
         if env_plan:
@@ -307,7 +320,8 @@ class ACCL:
         cdt = None if compress_dtype is None else _as_datatype(compress_dtype)
         bucket = size_bucket(count)
         key = (op, comm.id, comm.epoch, dtype, bucket, cdt, int(host), extra)
-        plan = self._plans.get(key)
+        plan, hit = self._plans.get_with_flag(key)
+        self._call_tls.plan_hit = hit  # stamped onto this call's record
         if plan is not None:
             return plan
         cfg, flags = self._resolve_arithcfg(dtype, cdt)
@@ -477,12 +491,53 @@ class ACCL:
 
         return _cm()
 
+    def _call_meta(self, options: CallOptions) -> dict:
+        """The CallRecord facts known at launch (accl_tpu.telemetry):
+        resolved once per call — a handful of attribute reads, no device
+        work — and carried to Request.complete by Telemetry.attach."""
+        comm = options.comm
+        plan = options.plan
+        dt = options.arithcfg.uncompressed if options.arithcfg else None
+        return {
+            "op": options.op.name.lower(),
+            "comm": comm.id if comm is not None else None,
+            "epoch": comm.epoch if comm is not None else None,
+            "dtype": dt.name if dt is not None else None,
+            "count": options.count,
+            "nbytes": (
+                options.count * dtype_size(dt) if dt is not None else 0
+            ),
+            "bucket": (
+                plan.bucket if plan is not None
+                else size_bucket(options.count)
+            ),
+            "algorithm": plan.algorithm if plan is not None else None,
+            "plan_hit": (
+                getattr(self._call_tls, "plan_hit", None)
+                if plan is not None else None
+            ),
+            "eager": plan.eager if plan is not None else None,
+        }
+
+    def _deadlock_error(self, context: str) -> ACCLError:
+        """DEADLOCK_SUSPECTED with the flight-recorder tail attached —
+        the watchdog/timeout paths ship their recent history too."""
+        details = None
+        if self._telemetry is not None:
+            self._telemetry.metrics.inc("accl_deadlock_suspected_total")
+            details = {"flight_recorder": self._telemetry.tail_dicts()}
+        return ACCLError(ErrorCode.DEADLOCK_SUSPECTED, context,
+                         details=details)
+
     def _launch(
         self, options: CallOptions, run_async: bool, context: str
     ) -> Optional[Request]:
+        tel = self._telemetry
         if self._pending is not None:
             req = Request(op_name=options.op.name)
             req._pre_wait = self.flush  # auto-flush when the user waits
+            if tel is not None:
+                tel.attach(req, self._call_meta(options))
             self._pending.push((options, req))
             if run_async:
                 return req
@@ -490,10 +545,14 @@ class ACCL:
             # complete before its queued predecessors anyway)
             self.flush()
             if not req.wait(timeout=max(60.0, 4 * self._timeout_s)):
-                raise ACCLError(ErrorCode.DEADLOCK_SUSPECTED, context)
+                raise self._deadlock_error(context)
             req.check(context)
             return req
         req = self.engine.start(options)
+        if tel is not None:
+            # attach AFTER start: engines that complete synchronously
+            # inside start() are recorded immediately by attach()
+            tel.attach(req, self._call_meta(options))
         if run_async:
             return req
         # facade-level deadline tracks the configured engine timeout, with a
@@ -501,7 +560,7 @@ class ACCL:
         # first for assembly stalls — and a first-call XLA compile of a large
         # program doesn't spuriously trip the deadlock detector
         if not req.wait(timeout=max(60.0, 4 * self._timeout_s)):
-            raise ACCLError(ErrorCode.DEADLOCK_SUSPECTED, context)
+            raise self._deadlock_error(context)
         req.check(context)
         return req
 
@@ -1072,26 +1131,121 @@ class ACCL:
             out += self.engine.stream_pop(stream_id, timeout=timeout)
         return np.frombuffer(out[:need], dtype=npdt).copy()
 
-    # -- debug ---------------------------------------------------------------
-    def dump_rx_buffers(self) -> str:
-        if hasattr(self.engine, "dump_rx_buffers"):
-            return self.engine.dump_rx_buffers()
-        return ""
+    # -- debug / telemetry ----------------------------------------------------
+    def dump_rx_buffers(self, as_dict: bool = False):
+        """Rx-accounting dump (ref ``ACCL::dump_eager_rx_buffers``).
 
-    def dump_communicator(self, comm: Optional[Communicator] = None) -> str:
+        ``as_dict=True`` returns the structured form backed by the
+        telemetry plane (the engine's ``telemetry_report`` plus the
+        per-slot lines); the legacy string is rendered from that dict's
+        ``lines`` — one source, two views."""
+        text = (
+            self.engine.dump_rx_buffers()
+            if hasattr(self.engine, "dump_rx_buffers")
+            else ""
+        )
+        doc = {
+            "engine": type(self.engine).__name__,
+            "lines": text.splitlines(),
+        }
+        if as_dict:
+            # the telemetry_report is built only for the structured
+            # form: the legacy string path (soak leak scans poll it)
+            # must stay as cheap as the raw engine dump
+            doc["report"] = self.engine.telemetry_report()
+            return doc
+        return "\n".join(doc["lines"])
+
+    def dump_communicator(
+        self, comm: Optional[Communicator] = None, as_dict: bool = False
+    ):
+        """Communicator + per-peer health dump.  ``as_dict=True`` returns
+        the structured form (communicator table + the health map the
+        telemetry snapshot carries); the legacy string is rendered FROM
+        that dict — no hand-maintained parallel format."""
         comm = comm or self._world
-        out = comm.dump()
-        health = self.engine.health_report(comm)
-        for i in sorted(health):
-            h = health[i]
-            out += (
-                f"\n  health rank {i}: {h.get('state', 'ok')}"
+        doc = {
+            "comm": comm.as_dict(),
+            "health": self.engine.health_report(comm),
+        }
+        if as_dict:
+            return doc
+        c = doc["comm"]
+        lines = [
+            f"communicator {c['id']}: size={c['size']} local={c['local_rank']}"
+        ]
+        for i, r in enumerate(c["ranks"]):
+            lines.append(
+                f"  rank {i}: addr={r['address']} session={r['session']} "
+                f"seg={r['max_segment_size']} "
+                f"seq_out={r['seq_out']} seq_in={r['seq_in']}"
+            )
+        for i in sorted(doc["health"]):
+            h = doc["health"][i]
+            line = (
+                f"  health rank {i}: {h.get('state', 'ok')}"
                 f" timeouts={h.get('timeouts', 0)}"
                 f" failures={h.get('failures', 0)}"
             )
             if h.get("last_event"):
-                out += f" last={h['last_event']}"
-        return out
+                line += f" last={h['last_event']}"
+            lines.append(line)
+        return "\n".join(lines)
+
+    def telemetry_snapshot(self) -> dict:
+        """ONE merged telemetry dict for this rank handle: the
+        flight-recorder tail, the metrics registry, the buffered wire
+        trace, and every counter source the earlier PRs scattered
+        (plan cache, per-peer health, engine report incl. fault/
+        retransmit/dedup counts and rx depths, device interactions).
+        Identical shape on all four engine tiers; export with
+        :meth:`telemetry_prometheus` / :meth:`telemetry_json`."""
+        from . import telemetry as _t
+
+        tel = self._telemetry
+        engine_report = self.engine.telemetry_report()
+        return {
+            "telemetry_enabled": tel is not None,
+            "rank": self._world.local_rank,
+            "world": self._world.size,
+            "tier": type(self.engine).__name__,
+            "flight_recorder": tel.tail_dicts(64) if tel else [],
+            "flight_recorder_total": tel.recorder.total if tel else 0,
+            "metrics": tel.metrics.snapshot() if tel else {},
+            "wire_trace": _t.wire_snapshot(),
+            "plan_cache": self._plans.stats(),
+            "health": self.engine.health_report(self._world),
+            "device_interactions": self.engine.device_interactions(),
+            "engine": engine_report,
+            "faults": engine_report.get("faults"),
+        }
+
+    def telemetry_prometheus(self) -> str:
+        """The snapshot in Prometheus text exposition format."""
+        return to_prometheus(self.telemetry_snapshot())
+
+    def telemetry_json(self) -> str:
+        """The snapshot as canonical JSON."""
+        return to_json(self.telemetry_snapshot())
+
+    def telemetry_trace_events(self) -> list:
+        """This rank's flight-recorder records (plus buffered wire
+        events) as Chrome/Perfetto trace events; [] when telemetry is
+        disabled."""
+        if self._telemetry is None:
+            return []
+        return self._telemetry.chrome_events()
+
+    def export_chrome_trace(self, path: Optional[str] = None) -> dict:
+        """Write (or return) this rank's Perfetto-loadable trace.  Merge
+        per-rank files with ``python -m accl_tpu.telemetry merge``."""
+        doc = chrome_trace(self.telemetry_trace_events())
+        if path is not None:
+            import json as _json
+
+            with open(path, "w") as f:
+                _json.dump(doc, f)
+        return doc
 
     def capabilities(self) -> dict:
         """Capability report — the role of the reference's HWID idcode
@@ -1142,6 +1296,9 @@ class ACCL:
             # accounting (emulator tiers) and the gang slot watchdog
             # (XLA tier); a peer marked "dead" fails collectives fast
             "health": self.engine.health_report(self._world),
+            # telemetry plane armed? (ACCL_TELEMETRY kill switch) — the
+            # full merged view is ACCL.telemetry_snapshot()
+            "telemetry": self._telemetry is not None,
         }
         # platform only when a jax BACKEND is already initialized: first
         # backend discovery is a side effect a read-only report must not

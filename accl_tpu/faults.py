@@ -326,10 +326,18 @@ class FaultInjector:
         })
 
     def stats(self) -> dict:
+        """Fire counters for introspection AND the telemetry snapshot
+        (``telemetry_snapshot()["faults"]``): per-rule matched/applied,
+        per-action fire totals, standing network damage."""
         with self._lock:
+            by_action: Dict[str, int] = {}
+            for ev in self.log:
+                by_action[ev["action"]] = by_action.get(ev["action"], 0) + 1
             return {
                 "matched": list(self._matched),
                 "applied": list(self.applied),
+                "fired_total": sum(self.applied),
+                "by_action": by_action,
                 "events": len(self.log),
                 "dead": sorted(self._dead),
                 "partitions": len(self._partitions),

@@ -107,13 +107,20 @@ class PlanCache:
 
     # -- lookup / store ------------------------------------------------------
     def get(self, key: Tuple) -> Optional[CollectivePlan]:
+        return self.get_with_flag(key)[0]
+
+    def get_with_flag(self, key: Tuple):
+        """(plan, hit): the lookup plus its verdict in one locked step —
+        the per-call ``plan_hit`` fact the telemetry flight recorder
+        stamps on every CallRecord (reading the counters before/after
+        would race concurrent rank threads)."""
         with self._lock:
             plan = self._plans.get(key)
             if plan is None:
                 self.misses += 1
             else:
                 self.hits += 1
-            return plan
+            return plan, plan is not None
 
     def store(self, plan: CollectivePlan) -> CollectivePlan:
         with self._lock:

@@ -59,6 +59,11 @@ class Request:
         # batching: flush hook armed by the facade while this request sits
         # in an unflushed command-queue batch (auto-flush on wait/sync)
         self._pre_wait: Optional[Callable[[], None]] = None
+        # telemetry plane (accl_tpu.telemetry): armed by the facade via
+        # Telemetry.attach; complete() appends one CallRecord — the
+        # flight-recorder hook every tier's completion path runs through
+        self._telemetry = None
+        self._tmeta: Optional[dict] = None
 
     # -- engine side --------------------------------------------------------
     def mark_executing(self) -> None:
@@ -78,6 +83,15 @@ class Request:
         with self._cb_lock:
             self._done.set()
             callbacks, self._callbacks = self._callbacks, []
+            tel, meta = self._telemetry, self._tmeta
+        if tel is not None:
+            # flight-recorder append (host-side ring write only; a
+            # telemetry failure must never fail the call it observes)
+            try:
+                tel.record(meta, self._duration_ns, self._retcode,
+                           self.error_context)
+            except Exception:  # pragma: no cover - defensive
+                pass
         for cb in callbacks:
             cb()
 
@@ -123,6 +137,18 @@ class Request:
             traceback.print_exc()
             if self._retcode == ErrorCode.OK:
                 self._retcode = ErrorCode.INVALID_OPERATION
+                if self._telemetry is not None and self._tmeta is not None:
+                    # the completion-time record said OK; amend the
+                    # flight recorder so the failed adoption is visible
+                    # in the history (and counted as an error)
+                    try:
+                        self._telemetry.record(
+                            self._tmeta, self._duration_ns,
+                            self._retcode, self.error_context,
+                            amend=True,
+                        )
+                    except Exception:  # pragma: no cover - defensive
+                        pass
         finally:
             # the handle (an HBM output shard / p2p payload) is dead
             # weight once adopted — dropping it here keeps long-lived
@@ -183,9 +209,16 @@ class Request:
         if self._done.is_set():
             self.materialize()
         if self._retcode != ErrorCode.OK:
+            details = self.error_context
+            if self._telemetry is not None:
+                # a failure ships with its recent history: the last-N
+                # flight-recorder records ride ACCLError.details so a
+                # chip-tier timeout is diagnosable without a live session
+                details = dict(details or {})
+                details["flight_recorder"] = self._telemetry.tail_dicts()
             raise ACCLError(
                 self._retcode, context or self.op_name,
-                details=self.error_context,
+                details=details,
             )
 
 

@@ -1,0 +1,684 @@
+"""The telemetry plane: flight recorder, metrics registry, trace export.
+
+Role model: the reference's observability is a first-class subsystem — a
+free-running hardware perf counter copied into exchange memory per call
+(``ccl_offload_control.c:2279-2303``), ``ACCL::get_duration``, the 27-bit
+per-call error bitmask, and the emulator's leveled event log.  The TPU
+port grew the same signals piecemeal (interaction counters, plan-cache
+stats, health maps, ``Request.get_duration_ns``); this module unifies
+them into one queryable, exportable plane — the NCCL-flight-recorder
+shape every production collectives stack converges on.
+
+Three pillars:
+
+* **Flight recorder** — a bounded ring of structured :class:`CallRecord`
+  s appended at ``Request.complete()`` on every tier (op, comm id+epoch,
+  dtype, byte count, size bucket, algorithm, plan hit/miss, protocol
+  verdict, duration, retcode).  The last N records ride into
+  ``ACCLError.details["flight_recorder"]`` automatically, so a chip-tier
+  failure arrives with its recent history attached.
+* **Metrics registry** — counters and log2-bucketed latency histograms
+  per (op × size bucket), merged with the engines' existing telemetry
+  (``device_interactions``, plan-cache stats, health, fault counters,
+  rx depths) behind ``ACCL.telemetry_snapshot()``; exporters render the
+  snapshot as Prometheus text or JSON.
+* **Trace export** — each rank's records render as Chrome/Perfetto
+  trace events (``pid`` = rank, ``tid`` 0 = the engine tier, ``tid`` 1 =
+  buffered wire events), named ``accl::<op>`` so they line up with the
+  host ranges ``utils.profiling.annotate`` already puts in xprof
+  timelines.  ``python -m accl_tpu.telemetry merge`` folds per-rank
+  files into one Perfetto-loadable timeline.
+
+Always-on cheap: recording is append-to-preallocated-ring plus a couple
+of dict increments on the completion path (no device interactions —
+counter-asserted by tests/test_telemetry.py), with the ``ACCL_TELEMETRY=0``
+kill switch and the ``ACCL_TELEMETRY_SAMPLE`` knob for TRACE-granularity
+wire events.  Zero dependencies: stdlib only, importable from jax-free
+emulator/native-tier processes.
+
+Env knobs:
+
+* ``ACCL_TELEMETRY=0``       — kill switch (no recording, no metrics)
+* ``ACCL_TELEMETRY_RING=N``  — flight-recorder capacity (default 512)
+* ``ACCL_TELEMETRY_SAMPLE=N``— keep 1-in-N TRACE wire events (default 1)
+* ``ACCL_TRACE_STDERR=1``    — opt back into synchronous stderr TRACE
+  (the pre-telemetry behavior; see utils/logging.py)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "CallRecord",
+    "FlightRecorder",
+    "MetricsRegistry",
+    "Telemetry",
+    "chrome_trace",
+    "enabled",
+    "merge_traces",
+    "to_json",
+    "to_prometheus",
+    "wire_event",
+    "wire_snapshot",
+]
+
+#: default flight-recorder capacity; the tail attached to errors
+DEFAULT_RING = 512
+ERROR_TAIL = 32
+
+# One epoch<->monotonic anchor per process: records carry perf_counter_ns
+# timestamps (cheap, monotonic), trace export maps them onto the epoch
+# clock so independently-captured per-rank traces merge onto one
+# timeline.  Cross-host skew is whatever NTP leaves — good enough for a
+# scrollable timeline, not for nanosecond causality.
+_ANCHOR_EPOCH_NS = time.time_ns()
+_ANCHOR_PERF_NS = time.perf_counter_ns()
+
+
+def _perf_to_epoch_us(perf_ns: int) -> float:
+    return (_ANCHOR_EPOCH_NS + (perf_ns - _ANCHOR_PERF_NS)) / 1e3
+
+
+def enabled() -> bool:
+    """The kill switch: ``ACCL_TELEMETRY=0`` disables recording (read
+    per ACCL-handle construction, so tests can flip it per group)."""
+    return os.environ.get("ACCL_TELEMETRY", "1") != "0"
+
+
+def _ring_capacity() -> int:
+    try:
+        return max(8, int(os.environ.get("ACCL_TELEMETRY_RING", DEFAULT_RING)))
+    except ValueError:
+        return DEFAULT_RING
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+class CallRecord:
+    """One completed engine call, structured (the reference's per-call
+    exchange-memory perf/retcode words, plus the dispatch-plan facts the
+    TPU tiers resolve per call)."""
+
+    __slots__ = (
+        "op", "comm", "epoch", "dtype", "count", "nbytes", "bucket",
+        "algorithm", "plan_hit", "eager", "duration_ns", "retcode",
+        "retcode_name", "end_perf_ns", "attempts", "peer",
+    )
+
+    def __init__(self, op, comm, epoch, dtype, count, nbytes, bucket,
+                 algorithm, plan_hit, eager, duration_ns, retcode,
+                 retcode_name, end_perf_ns, attempts=None, peer=None):
+        self.op = op
+        self.comm = comm
+        self.epoch = epoch
+        self.dtype = dtype
+        self.count = count
+        self.nbytes = nbytes
+        self.bucket = bucket
+        self.algorithm = algorithm
+        self.plan_hit = plan_hit
+        self.eager = eager
+        self.duration_ns = duration_ns
+        self.retcode = retcode
+        self.retcode_name = retcode_name
+        self.end_perf_ns = end_perf_ns
+        self.attempts = attempts
+        self.peer = peer
+
+    def as_dict(self) -> dict:
+        d = {
+            "op": self.op,
+            "comm": self.comm,
+            "epoch": self.epoch,
+            "dtype": self.dtype,
+            "count": self.count,
+            "nbytes": self.nbytes,
+            "bucket": self.bucket,
+            "algorithm": self.algorithm,
+            "plan_hit": self.plan_hit,
+            "eager": self.eager,
+            "duration_ns": self.duration_ns,
+            "retcode": self.retcode,
+            "retcode_name": self.retcode_name,
+            "end_us": round(_perf_to_epoch_us(self.end_perf_ns), 3),
+        }
+        if self.attempts is not None:
+            d["attempts"] = self.attempts
+        if self.peer is not None:
+            d["peer"] = self.peer
+        return d
+
+
+class FlightRecorder:
+    """Bounded ring of :class:`CallRecord`.  Appends are O(1) into a
+    preallocated slot list under a short lock — the warm-path cost the
+    <=5% ``facade_call_overhead_us`` budget covers."""
+
+    def __init__(self, capacity: Optional[int] = None):
+        self.capacity = capacity or _ring_capacity()
+        self._slots: List[Optional[CallRecord]] = [None] * self.capacity
+        self._next = 0  # total appended (monotone)
+        self._lock = threading.Lock()
+
+    def append(self, rec: CallRecord) -> None:
+        with self._lock:
+            self._slots[self._next % self.capacity] = rec
+            self._next += 1
+
+    def __len__(self) -> int:
+        with self._lock:
+            return min(self._next, self.capacity)
+
+    @property
+    def total(self) -> int:
+        """Records ever appended (>= len once the ring rolled over)."""
+        return self._next
+
+    def tail(self, n: Optional[int] = None) -> List[CallRecord]:
+        """Last ``n`` records, oldest first."""
+        with self._lock:
+            have = min(self._next, self.capacity)
+            n = have if n is None else min(n, have)
+            start = self._next - n
+            return [
+                self._slots[i % self.capacity]
+                for i in range(start, self._next)
+            ]
+
+    def tail_dicts(self, n: Optional[int] = None) -> List[dict]:
+        return [r.as_dict() for r in self.tail(n)]
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+
+def _log2_bucket(value: float) -> int:
+    """floor(log2(value)), floored at 0 — the histogram bucket scheme
+    shared with plans.size_bucket (log2 duration in us here)."""
+    return max(0, int(value).bit_length() - 1)
+
+
+class MetricsRegistry:
+    """Counters + log2-bucketed latency histograms per (op × size
+    bucket).  Label cardinality is bounded by construction: ops are a
+    small enum, size buckets ~log2(max count)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[tuple, int] = {}
+        # (op, size_bucket) -> [count, sum_ns, {log2_us: n}]
+        self._hist: Dict[tuple, list] = {}
+
+    def inc(self, name: str, labels: tuple = (), n: int = 1) -> None:
+        key = (name,) + tuple(labels)
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0) + n
+
+    def observe(self, op: str, size_bucket: int, duration_ns: int) -> None:
+        key = (op, size_bucket)
+        us = duration_ns // 1000
+        b = _log2_bucket(us)
+        with self._lock:
+            h = self._hist.get(key)
+            if h is None:
+                h = self._hist[key] = [0, 0, {}]
+            h[0] += 1
+            h[1] += duration_ns
+            h[2][b] = h[2].get(b, 0) + 1
+
+    def record_call(self, op: str, size_bucket: int, duration_ns: int,
+                    code: int, code_name: str, plan_hit,
+                    attempts) -> None:
+        """The completion-path fast lane: every counter/histogram update
+        one call makes, under ONE lock acquisition (separate inc/observe
+        calls each pay a lock + tuple build — measured at ~2x this)."""
+        b = max(0, (duration_ns // 1000).bit_length() - 1)
+        with self._lock:
+            c = self._counters
+            key = ("accl_calls_total", op)
+            c[key] = c.get(key, 0) + 1
+            if code != 0:
+                key = ("accl_call_errors_total", op, code_name)
+                c[key] = c.get(key, 0) + 1
+            if plan_hit is True:
+                key = ("accl_plan_hits_total", op)
+                c[key] = c.get(key, 0) + 1
+            elif plan_hit is False:
+                key = ("accl_plan_misses_total", op)
+                c[key] = c.get(key, 0) + 1
+            if attempts:
+                key = ("accl_call_attempts_total", op)
+                c[key] = c.get(key, 0) + int(attempts)
+            h = self._hist.get((op, size_bucket))
+            if h is None:
+                h = self._hist[(op, size_bucket)] = [0, 0, {}]
+            h[0] += 1
+            h[1] += duration_ns
+            h[2][b] = h[2].get(b, 0) + 1
+
+    def snapshot(self) -> dict:
+        """JSON-shaped view: ``counters`` keyed ``name[|label...]`` and
+        ``histograms`` keyed ``op/b<size_bucket>`` with log2-us buckets."""
+        with self._lock:
+            counters = {
+                "|".join(str(p) for p in key): v
+                for key, v in sorted(self._counters.items())
+            }
+            hist = {}
+            for (op, sb), (count, sum_ns, buckets) in sorted(
+                self._hist.items()
+            ):
+                hist[f"{op}/b{sb}"] = {
+                    "op": op,
+                    "size_bucket": sb,
+                    "count": count,
+                    "sum_ns": sum_ns,
+                    "mean_us": round(sum_ns / count / 1e3, 3) if count else 0,
+                    # {log2(us): n}: key k covers [2^k, 2^(k+1)) us
+                    "log2_us": {str(k): v for k, v in sorted(buckets.items())},
+                }
+        return {"counters": counters, "histograms": hist}
+
+
+# ---------------------------------------------------------------------------
+# buffered wire-event ring (the ACCL_DEBUG=TRACE path)
+# ---------------------------------------------------------------------------
+
+# Module-level because the wire is shared infrastructure (one fabric
+# serves every rank engine in a process); utils/logging routes TRACE
+# emissions here instead of synchronous stderr writes, so turning
+# tracing on no longer perturbs the timings being traced.
+_WIRE_CAP = 4096
+_wire_lock = threading.Lock()
+_wire_ring: List[Optional[dict]] = [None] * _WIRE_CAP
+_wire_next = 0
+_wire_seen = 0
+
+
+def _wire_sample() -> int:
+    try:
+        return max(1, int(os.environ.get("ACCL_TELEMETRY_SAMPLE", "1")))
+    except ValueError:
+        return 1
+
+
+def wire_event(source: str, message: str) -> None:
+    """Buffer one TRACE-granularity wire event (sampled 1-in-N by
+    ``ACCL_TELEMETRY_SAMPLE``).  Called from utils.logging on the send
+    path — must stay allocation-light."""
+    global _wire_next, _wire_seen
+    with _wire_lock:
+        _wire_seen += 1
+        if (_wire_seen - 1) % _wire_sample():
+            return
+        _wire_ring[_wire_next % _WIRE_CAP] = {
+            "ts_us": round(_perf_to_epoch_us(time.perf_counter_ns()), 3),
+            "src": source,
+            "event": message,
+        }
+        _wire_next += 1
+
+
+def wire_snapshot(last: int = 64) -> dict:
+    """The rendered-on-dump view of the wire ring."""
+    with _wire_lock:
+        have = min(_wire_next, _WIRE_CAP)
+        n = min(last, have)
+        events = [
+            _wire_ring[i % _WIRE_CAP]
+            for i in range(_wire_next - n, _wire_next)
+        ]
+        return {
+            "seen": _wire_seen,
+            "recorded": _wire_next,
+            "sample_1_in": _wire_sample(),
+            "events": events,
+        }
+
+
+def wire_events(limit: Optional[int] = None) -> List[dict]:
+    with _wire_lock:
+        have = min(_wire_next, _WIRE_CAP)
+        n = have if limit is None else min(limit, have)
+        return [
+            _wire_ring[i % _WIRE_CAP]
+            for i in range(_wire_next - n, _wire_next)
+        ]
+
+
+def wire_reset() -> None:
+    """Test hook: drop buffered wire events and counters."""
+    global _wire_next, _wire_seen
+    with _wire_lock:
+        _wire_next = 0
+        _wire_seen = 0
+        for i in range(_WIRE_CAP):
+            _wire_ring[i] = None
+
+
+# ---------------------------------------------------------------------------
+# the per-handle plane
+# ---------------------------------------------------------------------------
+
+
+class Telemetry:
+    """One rank handle's telemetry plane: flight recorder + metrics.
+
+    Created by the ACCL facade (one per handle), attached to Requests at
+    launch; ``Request.complete()`` calls :meth:`record` on every tier.
+    """
+
+    def __init__(self, rank: int, tier: str,
+                 capacity: Optional[int] = None):
+        self.rank = rank
+        self.tier = tier
+        self.recorder = FlightRecorder(capacity)
+        self.metrics = MetricsRegistry()
+
+    @classmethod
+    def create(cls, rank: int, tier: str) -> Optional["Telemetry"]:
+        """None when the ``ACCL_TELEMETRY=0`` kill switch is set."""
+        return cls(rank, tier) if enabled() else None
+
+    # -- recording (the Request.complete hook) ------------------------------
+    def attach(self, req, meta: dict) -> None:
+        """Arm ``req`` so its completion appends a CallRecord.  Handles
+        the already-completed race (engines that complete synchronously
+        inside ``start``) by recording immediately — and still arms
+        ``req._telemetry`` so a later ``check()`` attaches the
+        flight-recorder tail to its ACCLError (complete() has already
+        run, so no double-record is possible)."""
+        with req._cb_lock:
+            if not req._done.is_set():
+                req._telemetry = self
+                req._tmeta = meta
+                return
+        self.record(
+            meta, req.get_duration_ns(), req.get_retcode(),
+            req.error_context,
+        )
+        req._telemetry = self
+        req._tmeta = meta
+
+    def record(self, meta: dict, duration_ns: int, retcode,
+               error_context: Optional[dict] = None,
+               amend: bool = False) -> None:
+        """Append one CallRecord + metrics.  ``amend=True`` re-records a
+        call whose retcode changed AFTER completion (a deferred-result
+        adoption failure downgrading OK): the corrected record is
+        appended and the error counted, without double-counting the call
+        in calls_total or the latency histogram."""
+        ctx = error_context or {}
+        code = int(retcode)
+        code_name = getattr(retcode, "name", str(code))
+        duration_ns = int(duration_ns)
+        op = meta["op"] or "?"
+        bucket = meta["bucket"]
+        plan_hit = meta["plan_hit"]
+        attempts = ctx.get("attempts")
+        rec = CallRecord(
+            op, meta["comm"], meta["epoch"], meta["dtype"], meta["count"],
+            meta["nbytes"], bucket, meta["algorithm"], plan_hit,
+            meta["eager"], duration_ns, code, code_name,
+            time.perf_counter_ns(), attempts, ctx.get("peer"),
+        )
+        self.recorder.append(rec)
+        if amend:
+            if code != 0:
+                self.metrics.inc(
+                    "accl_call_errors_total", (op, code_name)
+                )
+            return
+        self.metrics.record_call(
+            op, bucket if bucket is not None else 0, duration_ns,
+            code, code_name, plan_hit, attempts,
+        )
+
+    # -- views ---------------------------------------------------------------
+    def tail_dicts(self, n: int = ERROR_TAIL) -> List[dict]:
+        return self.recorder.tail_dicts(n)
+
+    def chrome_events(self, wire: bool = True) -> List[dict]:
+        """This rank's records as Chrome/Perfetto complete events.
+
+        ``pid`` = rank, ``tid`` 0 = the engine tier's call stream, ``tid``
+        1 = buffered wire events (instants).  Names use the same
+        ``accl::<op>`` convention the gang's ``profiling.annotate``
+        ranges carry in xprof, so host spans and exported spans line up.
+        """
+        events: List[dict] = [
+            {
+                "ph": "M", "name": "process_name", "pid": self.rank,
+                "tid": 0, "args": {"name": f"rank {self.rank}"},
+            },
+            {
+                "ph": "M", "name": "thread_name", "pid": self.rank,
+                "tid": 0, "args": {"name": self.tier},
+            },
+        ]
+        for rec in self.recorder.tail():
+            dur_us = rec.duration_ns / 1e3
+            end_us = _perf_to_epoch_us(rec.end_perf_ns)
+            events.append({
+                "name": f"accl::{rec.op}",
+                "cat": "accl",
+                "ph": "X",
+                "ts": round(end_us - dur_us, 3),
+                "dur": round(dur_us, 3),
+                "pid": self.rank,
+                "tid": 0,
+                "args": {
+                    k: v for k, v in rec.as_dict().items()
+                    if k not in ("op", "end_us") and v is not None
+                },
+            })
+        if wire:
+            # The wire ring is PROCESS-wide (one fabric serves every
+            # in-process rank handle), so wire events export under the
+            # OS pid as their own process row — never under a rank pid,
+            # which would misattribute shared-fabric traffic.  In-process
+            # multi-rank exports each embed the same events; merge_traces
+            # dedups identical wire instants so the merged timeline
+            # carries one copy per process.
+            wire_pid = os.getpid()
+            wsnap = wire_events()
+            if wsnap:
+                events.append({
+                    "ph": "M", "name": "process_name", "pid": wire_pid,
+                    "tid": 1, "args": {"name": f"wire (pid {wire_pid})"},
+                })
+            for ev in wsnap:
+                events.append({
+                    "name": ev["event"][:64],
+                    "cat": "wire",
+                    "ph": "i",
+                    "s": "t",
+                    "ts": ev["ts_us"],
+                    "pid": wire_pid,
+                    "tid": 1,
+                    "args": {"src": ev["src"], "event": ev["event"]},
+                })
+        events.sort(key=lambda e: e.get("ts", 0.0))
+        return events
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+
+
+def to_json(snapshot: dict) -> str:
+    """The snapshot as canonical JSON (sorted keys, no NaN)."""
+    return json.dumps(snapshot, sort_keys=True, default=str)
+
+
+def _prom_labels(**labels) -> str:
+    inner = ",".join(
+        f'{k}="{v}"' for k, v in sorted(labels.items()) if v is not None
+    )
+    return "{" + inner + "}" if inner else ""
+
+
+def to_prometheus(snapshot: dict) -> str:
+    """Render a ``telemetry_snapshot()`` dict as Prometheus text
+    exposition (counters, gauges, and the per-(op × size-bucket) latency
+    histograms with cumulative log2-us ``le`` buckets)."""
+    rank = snapshot.get("rank")
+    tier = snapshot.get("tier")
+    base = {"rank": rank, "tier": tier}
+    lines: List[str] = []
+
+    metrics = snapshot.get("metrics") or {}
+    counters = metrics.get("counters") or {}
+    seen_types = set()
+    for key, val in sorted(counters.items()):
+        parts = key.split("|")
+        name, labels = parts[0], parts[1:]
+        if name not in seen_types:
+            lines.append(f"# TYPE {name} counter")
+            seen_types.add(name)
+        lbl = dict(base)
+        if labels:
+            lbl["op"] = labels[0]
+        if len(labels) > 1:
+            lbl["code"] = labels[1]
+        lines.append(f"{name}{_prom_labels(**lbl)} {val}")
+
+    hist = metrics.get("histograms") or {}
+    if hist:
+        lines.append("# TYPE accl_call_duration_us histogram")
+    for _key, h in sorted(hist.items()):
+        lbl = dict(base, op=h["op"], size_bucket=h["size_bucket"])
+        cum = 0
+        for k, v in sorted(h["log2_us"].items(), key=lambda kv: int(kv[0])):
+            cum += v
+            le = 2 ** (int(k) + 1)
+            lines.append(
+                "accl_call_duration_us_bucket"
+                f"{_prom_labels(le=le, **lbl)} {cum}"
+            )
+        lines.append(
+            "accl_call_duration_us_bucket"
+            f'{_prom_labels(le="+Inf", **lbl)} {h["count"]}'
+        )
+        lines.append(
+            f"accl_call_duration_us_sum{_prom_labels(**lbl)} "
+            f"{h['sum_ns'] / 1e3:.3f}"
+        )
+        lines.append(
+            f"accl_call_duration_us_count{_prom_labels(**lbl)} {h['count']}"
+        )
+
+    # scalar gauges folded out of the merged snapshot (engine report,
+    # plan cache): only numbers — structure stays in the JSON exporter
+    def gauge(name: str, value, **labels) -> None:
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            return
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name}{_prom_labels(**dict(base, **labels))} {value}")
+
+    gauge("accl_device_interactions", snapshot.get("device_interactions"))
+    pc = snapshot.get("plan_cache") or {}
+    for k in ("hits", "misses", "invalidations", "size"):
+        gauge(f"accl_plan_cache_{k}", pc.get(k))
+    gauge("accl_flight_records", len(snapshot.get("flight_recorder") or ()))
+    engine = snapshot.get("engine") or {}
+    for k, v in sorted(engine.items()):
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            gauge(f"accl_engine_{k}", v)
+        elif isinstance(v, dict):
+            for kk, vv in sorted(v.items()):
+                if isinstance(vv, (int, float)) and not isinstance(vv, bool):
+                    gauge(f"accl_engine_{k}_{kk}", vv)
+    return "\n".join(lines) + "\n"
+
+
+def chrome_trace(events: List[dict]) -> dict:
+    """Wrap event lists in the Chrome/Perfetto JSON object form."""
+    return {"traceEvents": list(events), "displayTimeUnit": "ms"}
+
+
+def merge_traces(docs: List[dict]) -> dict:
+    """Fold per-rank trace documents into one timeline.  Events keep
+    their own ``pid`` (= rank; wire rows ride the OS pid); the result is
+    sorted by ``ts`` so the merged file is monotonically consistent.
+    Wire/metadata events are deduplicated — in-process multi-rank
+    exports each embed the same process-wide wire ring, and the merged
+    timeline must carry one copy per process, not one per rank file."""
+    merged: List[dict] = []
+    seen: set = set()
+    for doc in docs:
+        evs = doc.get("traceEvents") if isinstance(doc, dict) else doc
+        for e in evs or ():
+            if e.get("cat") == "wire" or e.get("ph") == "M":
+                key = json.dumps(e, sort_keys=True)
+                if key in seen:
+                    continue
+                seen.add(key)
+            merged.append(e)
+    merged.sort(key=lambda e: e.get("ts", 0.0))
+    return chrome_trace(merged)
+
+
+# ---------------------------------------------------------------------------
+# CLI: python -m accl_tpu.telemetry merge --out merged.json rank*.json
+# ---------------------------------------------------------------------------
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m accl_tpu.telemetry",
+        description="telemetry artifact tools",
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    mp = sub.add_parser(
+        "merge",
+        help="fold per-rank Chrome/Perfetto trace files into one "
+             "timeline (open the result in ui.perfetto.dev or "
+             "chrome://tracing)",
+    )
+    mp.add_argument("inputs", nargs="+", help="per-rank trace JSON files")
+    mp.add_argument("--out", "-o", default="-",
+                    help="merged trace path (default: stdout)")
+    args = ap.parse_args(argv)
+
+    docs = []
+    for path in args.inputs:
+        with open(path) as f:
+            doc = json.load(f)
+        evs = doc.get("traceEvents") if isinstance(doc, dict) else doc
+        if not evs:
+            raise SystemExit(f"{path}: no traceEvents — refusing to merge "
+                             "an empty/malformed trace")
+        docs.append(doc)
+    merged = merge_traces(docs)
+    text = json.dumps(merged)
+    if args.out == "-":
+        print(text)
+    else:
+        with open(args.out, "w") as f:
+            f.write(text)
+        import sys
+
+        print(
+            f"wrote {args.out}: {len(merged['traceEvents'])} events from "
+            f"{len(docs)} rank files",
+            file=sys.stderr,
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -24,6 +24,12 @@ import time
 
 import numpy as np
 
+# All timed windows run on the ns-resolution monotonic clock through ONE
+# helper (utils.timing.Timer wraps time.perf_counter_ns) — the timing
+# discipline audit of the telemetry PR: no time.time()-resolution
+# windows anywhere in the harness (importing the package pulls no jax).
+from accl_tpu.utils.timing import Timer
+
 # ACCL_BENCH_SMALL=1 shrinks workloads ~1000x so the full bench harness can
 # be smoke-tested on CPU/CI; numbers are then meaningless but every code
 # path (incl. error reporting) runs.
@@ -107,10 +113,10 @@ def _combine_slope_bench(combine_fn) -> float:
 
     def timed(k):
         a_k = next(staged)  # distinct content per dispatch
-        t0 = time.perf_counter()
-        out = loop(a_k, b, k)
-        float(out[0])  # forced readback: completion barrier
-        return time.perf_counter() - t0
+        with Timer() as t:
+            out = loop(a_k, b, k)
+            float(out[0])  # forced readback: completion barrier
+        return t.elapsed_ns() / 1e9
 
     per_iter = _slope_time(timed, *((2, 6) if _SMALL else (10, 110)))
     moved = 3 * n * 4  # two reads + one write per combine
@@ -159,10 +165,10 @@ def _bench_cast_pallas(stochastic: bool = False) -> float:
 
     def timed(k):
         x_k = next(staged)  # distinct content per dispatch
-        t0 = time.perf_counter()
-        out = loop(x_k, k)
-        float(out[0])
-        return time.perf_counter() - t0
+        with Timer() as t:
+            out = loop(x_k, k)
+            float(out[0])
+        return t.elapsed_ns() / 1e9
 
     per_iter = _slope_time(timed, *((2, 6) if _SMALL else (4, 24)))
     moved = n * (4 + 2) + n * (2 + 4)  # down + up round trip
@@ -193,10 +199,10 @@ def _bench_quant_int8_pallas() -> float:
 
     def timed(k):
         x_k = next(staged)  # distinct content per dispatch
-        t0 = time.perf_counter()
-        out = loop(x_k, k)
-        float(out[0])
-        return time.perf_counter() - t0
+        with Timer() as t:
+            out = loop(x_k, k)
+            float(out[0])
+        return t.elapsed_ns() / 1e9
 
     per_iter = _slope_time(timed, *((2, 6) if _SMALL else (4, 24)))
     moved = n * (4 + 1) + n * (1 + 4)  # quantize + dequantize
@@ -235,11 +241,11 @@ def _bench_attention() -> dict:
     for impl in ("naive", "blockwise", "flash"):
         fn = jax.jit(lambda a, b, c, i=impl: _attention(a, b, c, impl=i))
         fn(q, q, q).block_until_ready()  # compile
-        t0 = time.perf_counter()
-        for it in range(iters):
-            r = fn(qs[it], q, q)
-        r.block_until_ready()
-        dt = (time.perf_counter() - t0) / iters
+        with Timer() as t:
+            for it in range(iters):
+                r = fn(qs[it], q, q)
+            r.block_until_ready()
+        dt = t.elapsed_ns() / iters / 1e9
         out[f"attn_{impl}_us"] = round(dt * 1e6, 1)
         out[f"attn_{impl}_tflops"] = round(flops / 2 / dt / 1e12, 2)
         # fwd+bwd (the training cost): flash exercises its custom_vjp
@@ -250,11 +256,11 @@ def _bench_attention() -> dict:
             argnums=(0, 1, 2),
         ))
         jax.block_until_ready(gfn(q, q, q))  # compile
-        t0 = time.perf_counter()
-        for it in range(iters):
-            r = gfn(qs[it], q, q)
-        jax.block_until_ready(r)
-        dt = (time.perf_counter() - t0) / iters
+        with Timer() as t:
+            for it in range(iters):
+                r = gfn(qs[it], q, q)
+            jax.block_until_ready(r)
+        dt = t.elapsed_ns() / iters / 1e9
         out[f"attn_{impl}_grad_us"] = round(dt * 1e6, 1)
     return out
 
@@ -332,11 +338,11 @@ def _bench_train_mfu(
     params, loss = step(params, tokens, targets)  # warm (reuses compile)
     float(loss)
     iters = 3 if small else 10
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        params, loss = step(params, tokens, targets)
-    float(loss)
-    dt = (time.perf_counter() - t0) / iters
+    with Timer() as t:
+        for _ in range(iters):
+            params, loss = step(params, tokens, targets)
+        float(loss)
+    dt = t.elapsed_ns() / iters / 1e9
 
     achieved_per_dev = flops_per_dev / dt
     suffix = "" if attention == "auto" else f"_{attention}"
@@ -391,11 +397,11 @@ def _bench_decode_throughput() -> dict:
     ]
     for p in prompts:
         p.block_until_ready()
-    t0 = time.perf_counter()
-    for it in range(iters):
-        out = fn(params, prompts[it])
-    out.block_until_ready()
-    dt = (time.perf_counter() - t0) / iters
+    with Timer() as t:
+        for it in range(iters):
+            out = fn(params, prompts[it])
+        out.block_until_ready()
+    dt = t.elapsed_ns() / iters / 1e9
     return {"decode_tokens_per_s": round(batch * ndev * steps / dt, 1)}
 
 
@@ -431,16 +437,16 @@ def _bench_facade_overhead() -> dict:
     x = jnp.ones((1024,), jnp.float32)
     trivial = jax.jit(lambda v: v + 1.0)
     trivial(x).block_until_ready()  # compile
-    t0 = time.perf_counter()
-    out = x
-    for _ in range(iters):
-        out = trivial(out)
-    out.block_until_ready()
-    floor_us = (time.perf_counter() - t0) / iters * 1e6
+    with Timer() as t:
+        out = x
+        for _ in range(iters):
+            out = trivial(out)
+        out.block_until_ready()
+    floor_us = t.elapsed_ns() / iters / 1e3
 
-    g = xla_group(1)
-    try:
-        a = g[0]
+    def prepare(a):
+        """Stage the warm-path loop on one rank handle; returns a
+        re-runnable round closure (plus the batched bench's state)."""
         s = a.create_buffer_from(np.ones(1024, np.float32))
         d = a.create_buffer(1024, np.float32)
         # warm TWICE: call 1 builds the CollectivePlan + compiles the
@@ -471,24 +477,61 @@ def _bench_facade_overhead() -> dict:
             if arr is not None:
                 arr.block_until_ready()
 
-        drain()  # earlier benches must not bill their queued work to us
-        ic0 = a.engine.device_interactions()
-        pc0 = a.capabilities()["plan_cache"]
-        t0 = time.perf_counter()
-        for it in range(iters):
-            a.allreduce(sends[it], d, 1024)
-        drain()  # sustained end-to-end: host control plane + device
-        call_us = (time.perf_counter() - t0) / iters * 1e6
-        # the honest architectural decomposition: device interactions per
-        # call, straight off the engine counter (the single-interaction
-        # contract says 1.0 on this path; anything above it is billed a
-        # tunnel RTT per unit on tunneled hosts)
-        per_call = (a.engine.device_interactions() - ic0) / iters
-        # ...and plan-cache hits per call (cached dispatch): 1.0 on the
-        # warm path — anything below it means calls are re-deriving
-        # their plan (invalidation churn / key instability)
-        pc1 = a.capabilities()["plan_cache"]
-        plan_hit_rate = (pc1["hits"] - pc0["hits"]) / iters
+        def run_round():
+            """One timed window: (us/call, interactions/call, plan-hit
+            rate).  Interactions come straight off the engine counter —
+            the single-interaction contract says 1.0 on this path; the
+            plan-hit rate says 1.0 means nothing re-derived."""
+            drain()  # earlier work must not bill its completion to us
+            ic0 = a.engine.device_interactions()
+            pc0 = a.capabilities()["plan_cache"]
+            with Timer() as t:
+                for it in range(iters):
+                    a.allreduce(sends[it], d, 1024)
+                drain()  # sustained end-to-end: host + device
+            pc1 = a.capabilities()["plan_cache"]
+            return (
+                t.elapsed_ns() / iters / 1e3,
+                (a.engine.device_interactions() - ic0) / iters,
+                (pc1["hits"] - pc0["hits"]) / iters,
+            )
+
+        return run_round, sends, d, drain
+
+    # two groups, telemetry ON (the default, always-on contract) and
+    # OFF (the ACCL_TELEMETRY=0 kill switch), both prepared/warmed up
+    # front and then measured in ALTERNATING rounds with rotating order
+    # — the sweep_group_paired noise discipline; two sequentially-
+    # captured windows differ by far more than the 5% being certified
+    # (first-window cache/alloc churn measured as a fake 2x "overhead")
+    g = xla_group(1)
+    g_off = []
+    try:
+        prev = os.environ.get("ACCL_TELEMETRY")
+        os.environ["ACCL_TELEMETRY"] = "0"
+        try:
+            g_off = xla_group(1)
+        finally:
+            if prev is None:
+                os.environ.pop("ACCL_TELEMETRY", None)
+            else:
+                os.environ["ACCL_TELEMETRY"] = prev
+        a = g[0]
+        run_on, sends, d, drain = prepare(a)
+        run_off, _, _, _ = prepare(g_off[0])
+        on_vals, off_vals = [], []
+        rounds = 4
+        for k in range(rounds):
+            order = (
+                (run_on, on_vals), (run_off, off_vals)
+            ) if k % 2 == 0 else (
+                (run_off, off_vals), (run_on, on_vals)
+            )
+            for fn, acc in order:
+                acc.append(fn())
+        best = min(on_vals)
+        call_us, per_call, plan_hit_rate = best
+        off_us = min(off_vals)[0]
 
         # batched dispatch: N queued collectives flush through the
         # command queue as ONE fused program — the amortized per-call
@@ -510,21 +553,45 @@ def _bench_facade_overhead() -> dict:
 
         batched_round(0)  # warm: compiles the fused batch program
         drain()
-        t0 = time.perf_counter()
-        for k in range(nbatches):
-            batched_round(k * B)
-        drain()
-        batched_us = (time.perf_counter() - t0) / (nbatches * B) * 1e6
+        with Timer() as t:
+            for k in range(nbatches):
+                batched_round(k * B)
+            drain()
+        batched_us = t.elapsed_ns() / (nbatches * B) / 1e3
+
+        # telemetry evidence for the capture artifact: the snapshot must
+        # carry every merged section (parse_results.check_telemetry) and
+        # the per-op histograms ride along as the warm path measured them
+        snap = a.telemetry_snapshot()
+        telemetry = {
+            "snapshot_keys": sorted(snap.keys()),
+            "records": len(snap["flight_recorder"]),
+            "histograms": {
+                k: {"count": h["count"], "mean_us": h["mean_us"]}
+                for k, h in (snap["metrics"].get("histograms") or {}).items()
+            },
+        }
     finally:
         for x in g:
             x.deinit()
+        for x in g_off:
+            x.deinit()
+
+    # the always-on budget (parse_results.check_telemetry): telemetry-on
+    # within 5% of -off on the identical interleaved loop
+    telemetry["overhead_pct"] = round(
+        max(0.0, (call_us - off_us) / max(off_us, 1e-9) * 100.0), 2
+    )
+
     return {
         "facade_call_overhead_us": round(call_us, 1),
+        "facade_call_overhead_telemetry_off_us": round(off_us, 1),
         "facade_dispatch_floor_us": round(floor_us, 1),
         "facade_arch_overhead_us": round(call_us - floor_us, 1),
         "facade_device_interactions_per_call": round(per_call, 2),
         "facade_plan_cache_hit_rate": round(plan_hit_rate, 4),
         "facade_batched_call_overhead_us": round(batched_us, 1),
+        "telemetry": telemetry,
     }
 
 
@@ -580,11 +647,11 @@ def _bench_gang_device_time() -> dict:
                     arr.block_until_ready()
 
             drain()
-            t0 = time.perf_counter()
-            for it in range(iters):
-                a.allreduce(sends[it], d, count)
-            drain()
-            return (time.perf_counter() - t0) / iters * 1e6
+            with Timer() as t:
+                for it in range(iters):
+                    a.allreduce(sends[it], d, count)
+                drain()
+            return t.elapsed_ns() / iters / 1e3
 
         w1 = timed(n)
         w2 = timed(2 * n)
@@ -647,10 +714,10 @@ def _bench_ring_allreduce(ndev: int, algo: str = "xla") -> float:
 
     def timed(k):
         x_k = next(staged)  # distinct content per dispatch
-        t0 = time.perf_counter()
-        out = loop(x_k, k)
-        float(out[0, 0])
-        return time.perf_counter() - t0
+        with Timer() as t:
+            out = loop(x_k, k)
+            float(out[0, 0])
+        return t.elapsed_ns() / 1e9
 
     per_iter = _slope_time(timed, *((2, 6) if _SMALL else (5, 25)))
     bytes_per_rank = n * 4
@@ -765,11 +832,11 @@ def _probe() -> dict:
     x = jnp.ones((8, 128), jnp.float32)
     f = jax.jit(lambda v: v + 1)
     f(x).block_until_ready()  # compile
-    t0 = time.perf_counter()
     n = 10
-    for _ in range(n):
-        f(x).block_until_ready()
-    ms = (time.perf_counter() - t0) / n * 1e3
+    with Timer() as t:
+        for _ in range(n):
+            f(x).block_until_ready()
+    ms = t.elapsed_ns() / n / 1e6
     out = {
         "ok": ms < threshold_ms,
         "dispatch_ms": round(ms, 2),
@@ -1499,7 +1566,9 @@ def main() -> None:
         # NameError from the gate's except clause below
         from benchmarks.parse_results import (
             ArchOverheadRegressionError,
+            TelemetryGateError,
             check_arch_overhead,
+            check_telemetry,
         )
     except ImportError:  # pragma: no cover - repo layout changed
         ArchOverheadRegressionError = None  # type: ignore[assignment]
@@ -1509,6 +1578,14 @@ def main() -> None:
             check_arch_overhead(extras, lkg_gate.get("result") or {})
         except ArchOverheadRegressionError as e:
             errors["facade_arch_regression"] = str(e)
+        # telemetry evidence gate: the capture must carry the snapshot
+        # sections + a within-budget always-on overhead (only when the
+        # facade bench ran at all — a wedged run has nothing to gate)
+        if "telemetry" in extras:
+            try:
+                check_telemetry(extras)
+            except TelemetryGateError as e:
+                errors["telemetry_gate"] = str(e)
 
     _sanitize_extras(extras, errors)
     result = _headline(extras)

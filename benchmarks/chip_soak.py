@@ -29,6 +29,38 @@ sys.path.insert(
 )
 
 
+def _emit_telemetry(a, phase: str, out_dir: str) -> dict:
+    """Write this phase's telemetry snapshot + rank trace artifacts and
+    validate them: non-empty flight recorder, JSON that round-trips, a
+    trace with events.  Returns {phase, records, paths, ok} — a soak
+    whose telemetry is empty/malformed FAILS (exit code), because an
+    unobservable chip run is exactly the failure mode this plane exists
+    to end."""
+    os.makedirs(out_dir, exist_ok=True)
+    snap_path = os.path.join(out_dir, f"chip_soak_telemetry_{phase}.json")
+    trace_path = os.path.join(out_dir, f"chip_soak_trace_{phase}_rank0.json")
+    out = {"phase": phase, "snapshot": snap_path, "trace": trace_path,
+           "records": 0, "ok": False}
+    try:
+        snap = a.telemetry_snapshot()
+        with open(snap_path, "w") as f:
+            f.write(a.telemetry_json())
+        a.export_chrome_trace(trace_path)
+        with open(snap_path) as f:
+            loaded = json.load(f)
+        with open(trace_path) as f:
+            trace = json.load(f)
+        out["records"] = len(loaded.get("flight_recorder") or ())
+        out["ok"] = bool(
+            out["records"]
+            and snap.get("metrics", {}).get("histograms")
+            and trace.get("traceEvents")
+        )
+    except Exception as e:  # malformed output must fail the soak, loudly
+        out["error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
 def main() -> int:
     from accl_tpu.utils import mirror_platform_env
 
@@ -121,6 +153,16 @@ def main() -> int:
                  if "rxbuf" in ln and "IDLE" not in ln]
         di = a.engine.device_interactions() - di0
 
+        # telemetry artifacts, per phase: snapshot + per-rank trace
+        # (merge multi-rank runs with `python -m accl_tpu.telemetry
+        # merge`); empty/malformed output fails the soak
+        tele_dir = os.environ.get(
+            "ACCL_SOAK_TELEMETRY_DIR",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "results"),
+        )
+        tele_soak = _emit_telemetry(a, "soak", tele_dir)
+
         # fault-recovery phase: one injected drop-and-recover round.  The
         # device tier's fault mode is "a peer never arrives", so induce a
         # recv whose sender does not exist, assert the watchdog converts
@@ -148,6 +190,10 @@ def main() -> int:
             ln for ln in a.dump_rx_buffers().splitlines()
             if "rxbuf" in ln and "IDLE" not in ln
         ]
+        # fault-phase telemetry: the snapshot now carries the failed
+        # recv in its flight recorder (retcode != OK) — the structured
+        # history an offline debugger reads instead of the log
+        tele_fault = _emit_telemetry(a, "fault", tele_dir)
         print(json.dumps({
             "iters": iters, "ops": ops, "seconds": round(dt, 1),
             "ops_per_s": round(ops / dt, 2), "rx_leaks": leaks,
@@ -158,12 +204,15 @@ def main() -> int:
             "interactions_per_op": round(di / max(ops, 1), 2),
             "device": jax.devices()[0].device_kind,
             "fault_recovery": fault,
+            "telemetry": [tele_soak, tele_fault],
         }))
         ok = (
             not leaks
             and fault["injected"] == 1
             and fault["recovered"]
             and fault["rx_leaks"] == []
+            and tele_soak["ok"]
+            and tele_fault["ok"]
         )
         return 0 if ok else 1
     finally:

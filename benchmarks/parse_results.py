@@ -87,6 +87,92 @@ def check_arch_overhead(extras: dict, lkg_result: dict,
             )
 
 
+# Telemetry gate (telemetry-plane PR): the committed bench capture must
+# carry the telemetry evidence — the snapshot's merged sections and the
+# measured always-on overhead.  The plane is ALWAYS ON by contract, so
+# a capture whose telemetry-on warm path costs more than this over the
+# telemetry-off A/B partner regressed the "recording is ring-append
+# only" discipline; refuse it like any other poisoned artifact.
+TELEMETRY_OVERHEAD_TOLERANCE_PCT = float(
+    os.environ.get("ACCL_TELEMETRY_OVERHEAD_PCT", "5.0")
+)
+
+#: sections ACCL.telemetry_snapshot() must merge on every tier — the
+#: one-dict contract (flight recorder, metrics registry, plan-cache/
+#: health/fault counters, engine report)
+REQUIRED_SNAPSHOT_KEYS = (
+    "flight_recorder",
+    "metrics",
+    "plan_cache",
+    "health",
+    "device_interactions",
+    "engine",
+    "faults",
+    "wire_trace",
+    "rank",
+    "tier",
+)
+
+
+class TelemetryGateError(ValueError):
+    """The capture's telemetry block is missing/incomplete, or the
+    measured telemetry-on overhead exceeded the always-on budget."""
+
+
+def check_telemetry(extras: dict, tolerance_pct: float = None) -> None:
+    """Gate a bench capture's telemetry evidence: the ``telemetry``
+    block must exist, its snapshot must carry every required merged
+    section, at least one flight record and per-op histogram must have
+    been captured, and the interleaved telemetry-on/off delta must be
+    within the always-on budget (<=5%)."""
+    tol = (
+        TELEMETRY_OVERHEAD_TOLERANCE_PCT
+        if tolerance_pct is None else tolerance_pct
+    )
+    tele = (extras or {}).get("telemetry")
+    if not isinstance(tele, dict):
+        raise TelemetryGateError(
+            "capture carries no telemetry block — the facade overhead "
+            "bench did not emit its snapshot evidence"
+        )
+    keys = set(tele.get("snapshot_keys") or ())
+    missing = [k for k in REQUIRED_SNAPSHOT_KEYS if k not in keys]
+    if missing:
+        raise TelemetryGateError(
+            f"telemetry snapshot is missing merged sections: {missing}"
+        )
+    if not tele.get("records"):
+        raise TelemetryGateError(
+            "telemetry flight recorder captured zero records over the "
+            "warm-path loop — recording is broken or disabled"
+        )
+    if not tele.get("histograms"):
+        raise TelemetryGateError(
+            "telemetry metrics captured no per-op histograms"
+        )
+    pct = tele.get("overhead_pct")
+    if pct is None:
+        raise TelemetryGateError(
+            "capture carries no telemetry-on/off overhead measurement"
+        )
+    if pct > tol:
+        raise TelemetryGateError(
+            f"telemetry-on warm path costs {pct:.2f}% over telemetry-off "
+            f"(budget {tol:.1f}%): recording crept off the append-only "
+            "fast path; fix it instead of committing the slower capture"
+        )
+
+
+def check_telemetry_capture(bench_path: str) -> None:
+    """CLI form (``--check-telemetry BENCH_rNN.json``)."""
+    import json
+
+    with open(bench_path) as f:
+        doc = json.load(f)
+    result = doc.get("parsed") or doc.get("result") or doc
+    check_telemetry((result or {}).get("extras") or {})
+
+
 # Autotuned-plan refusal: a TuningPlan only ever *overrides* registers
 # where a candidate measured faster than the defaults, so a tuned sweep
 # should never be meaningfully slower than the default sweep at any
@@ -276,6 +362,14 @@ def main(argv=None) -> str:
         i = argv.index("--check-bench")
         check_bench_capture(argv[i + 1])
         print(f"{argv[i + 1]}: gated facade overhead keys within tolerance")
+        return ""
+    if "--check-telemetry" in argv:
+        i = argv.index("--check-telemetry")
+        check_telemetry_capture(argv[i + 1])
+        print(
+            f"{argv[i + 1]}: telemetry snapshot complete, overhead within "
+            f"{TELEMETRY_OVERHEAD_TOLERANCE_PCT:.1f}%"
+        )
         return ""
     if "--check-tuned" in argv:
         i = argv.index("--check-tuned")

@@ -56,16 +56,20 @@ try:
     from parse_results import (  # running as a script: sibling import
         ARCH_REGRESSION_TOLERANCE,
         ArchOverheadRegressionError,
+        TelemetryGateError,
         TunedPlanRegressionError,
         check_arch_overhead,
+        check_telemetry,
         check_tuned_not_slower,
     )
 except ImportError:  # pragma: no cover - running as a package module
     from benchmarks.parse_results import (  # noqa: F401
         ARCH_REGRESSION_TOLERANCE,
         ArchOverheadRegressionError,
+        TelemetryGateError,
         TunedPlanRegressionError,
         check_arch_overhead,
+        check_telemetry,
         check_tuned_not_slower,
     )
 
@@ -312,11 +316,11 @@ def sweep_ops(world: int, sizes: List[int], writer, extra_algos=()) -> None:
             )
             stacked = jnp.ones(shape, jnp.float32)
             fn(stacked, mesh).block_until_ready()  # compile
-            t0 = time.perf_counter()
+            t0 = time.perf_counter_ns()
             for _ in range(5):
                 out = fn(stacked, mesh)
             out.block_until_ready()
-            ns = (time.perf_counter() - t0) / 5 * 1e9
+            ns = (time.perf_counter_ns() - t0) / 5
             write_row(writer, op, n, n * 4, ns)
 
 
@@ -362,6 +366,13 @@ def main(argv=None) -> int:
              "(--csv gets the default rows, this path the tuned rows) — "
              "the only capture mode whose <=5% not-slower comparison is "
              "meaningful on a contended host",
+    )
+    ap.add_argument(
+        "--trace-dir", default=None,
+        help="in-process backends: write each rank's telemetry as a "
+             "Chrome/Perfetto trace (trace_<backend>_w<world>_rankN.json) "
+             "after the sweep; merge with `python -m accl_tpu.telemetry "
+             "merge`",
     )
     args = ap.parse_args(argv)
 
@@ -428,6 +439,27 @@ def main(argv=None) -> int:
                         a.load_tuning_plan(args.tuning_plan)
                 sweep_group(group, sizes, args.collectives, writer,
                             best_of=args.best_of)
+            # telemetry artifacts: per-rank Perfetto traces (merge-able
+            # into one timeline) and — next to a file CSV — a sidecar
+            # with the telemetry-derived per-(op x size-bucket) latency
+            # histograms the same calls produced, so the CSV's
+            # steady-state rows ship with their full distribution
+            if args.trace_dir:
+                os.makedirs(args.trace_dir, exist_ok=True)
+                for r, a in enumerate(group):
+                    a.export_chrome_trace(os.path.join(
+                        args.trace_dir,
+                        f"trace_{args.backend}_w{args.world}_rank{r}.json",
+                    ))
+            if args.csv != "-":
+                import json
+
+                side = {
+                    f"rank{r}": a.telemetry_snapshot()["metrics"]
+                    for r, a in enumerate(group)
+                }
+                with open(args.csv + ".telemetry.json", "w") as f:
+                    json.dump(side, f, indent=1, sort_keys=True)
         finally:
             for a in group:
                 a.deinit()

@@ -6,14 +6,20 @@
 #   1. probe            — cheap health check; abort early if wedged
 #   2. bench.py guarded — the scoreboard capture: headline + T=4096
 #                         flash-attention training record + facade/
-#                         gang decompositions; refreshes .bench_lkg.json
+#                         gang decompositions + telemetry on/off delta;
+#                         refreshes .bench_lkg.json
 #   3. chip pytest tier — tests/run_tpu_tier.py writes TPU_TIER.json
 #   4. autotune         — guarded chip-tier TuningPlan + same-session
 #                         tuned-vs-default CSV pair (benchmarks/results/)
+#   5. telemetry        — short soak emitting per-phase telemetry
+#                         snapshots + rank traces (benchmarks/results/
+#                         chip_soak_telemetry_*.json, chip_soak_trace_*);
+#                         FAILS on empty/malformed telemetry output
 #
 # Run from the repo root. Artifacts to commit afterwards:
 #   .bench_lkg.json  TPU_TIER.json  tuning_plan_chip_w1.json
-#   sweep_chip_w1_tuned{_baseline,}.csv  (+ BENCH_NOTES update)
+#   sweep_chip_w1_tuned{_baseline,}.csv  chip_soak_telemetry_*.json
+#   chip_soak_trace_*  (+ BENCH_NOTES update)
 set -u -o pipefail
 cd "$(dirname "$0")/.."
 
@@ -65,5 +71,42 @@ if ! timeout 900 python -m accl_tpu.tuning --backend xla --world 1 \
        "still good; re-run the leg alone after a re-probe" >&2
 fi
 
+# Telemetry artifact leg: a SHORT soak (the endurance soak is its own
+# session) whose per-phase telemetry snapshot + rank trace are the
+# commit artifacts; the soak itself exits nonzero on empty/malformed
+# telemetry, and the validator below re-checks the files on disk so a
+# silently-skipped emission can't pass.  The bench leg's telemetry gate
+# (errors.telemetry_gate in the JSON) already covers the on/off delta.
+echo "== 5/5 telemetry artifacts (short soak)" >&2
+if ACCL_SOAK_SECONDS=60 timeout 600 python benchmarks/chip_soak.py \
+    | tee /tmp/chip_soak_tele.json; then
+  if ! python - <<'PY'
+import json, sys
+line = open("/tmp/chip_soak_tele.json").read().strip().splitlines()[-1]
+r = json.loads(line)
+phases = r.get("telemetry") or []
+bad = [p for p in phases if not p.get("ok")]
+if len(phases) < 2 or bad:
+    print(f"telemetry artifacts missing/malformed: {bad or 'no phases'}",
+          file=sys.stderr)
+    sys.exit(1)
+for p in phases:
+    for key in ("snapshot", "trace"):
+        doc = json.load(open(p[key]))
+        assert doc, f"{p[key]} is empty"
+print("telemetry artifacts:",
+      ", ".join(f"{p['phase']}={p['records']} records" for p in phases))
+PY
+  then
+    echo "telemetry artifact validation FAILED — bench/tier evidence" \
+         "above is still good; debug with ACCL_DEBUG=TRACE" >&2
+  fi
+else
+  echo "telemetry soak leg failed/timed out — bench + tier artifacts" \
+       "above are still good; re-run the leg alone after a re-probe" >&2
+fi
+
 echo "== done; commit .bench_lkg.json TPU_TIER.json" \
-     "benchmarks/results/tuning_plan_chip_w1.json and update BENCH_NOTES" >&2
+     "benchmarks/results/tuning_plan_chip_w1.json" \
+     "benchmarks/results/chip_soak_telemetry_*.json" \
+     "benchmarks/results/chip_soak_trace_* and update BENCH_NOTES" >&2
