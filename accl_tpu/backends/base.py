@@ -139,6 +139,14 @@ class BaseEngine:
         with no device (emulator/native: the dataplane is host memory)."""
         return None
 
+    def drain_inflight(self, timeout=None) -> bool:
+        """Overlap plane: block until every launched-but-incomplete call
+        of this engine has completed (the facade's ``flush()``/config/
+        ``soft_reset`` drain points).  Tiers without an in-flight window
+        (emulator/native: requests complete from their own schedulers)
+        are a no-op.  Returns False only on timeout."""
+        return True
+
     def health_report(self, comm) -> dict:
         """Per-peer health map for ``comm``, keyed by comm-relative rank
         (``capabilities()["health"]``).  Engines with timeout/retry

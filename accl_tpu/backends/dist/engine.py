@@ -136,6 +136,14 @@ class DistEngine(StreamPortMixin, BaseEngine):
         self.retry_backoff_s = 0.05
         self.tuning = {"allreduce_algorithm": "xla", "ring_segments": 1}
         self.interactions = InteractionCounter()
+        # overlap plane: this tier's in-flight unit is the serialized
+        # executor's backlog — start() applies backpressure once more
+        # than `inflight_window` calls are queued ahead of the executor
+        # (SET_INFLIGHT_WINDOW / ACCL_INFLIGHT_WINDOW), and
+        # drain_inflight() rides a NOP through the queue as the barrier.
+        from ...overlap import default_window_depth
+
+        self.inflight_window = default_window_depth()
         self._init_streams()
         # per-port consumed counter for remotely-posted stream chunks
         import threading as _threading
@@ -146,6 +154,10 @@ class DistEngine(StreamPortMixin, BaseEngine):
         self._nf_sig: Optional[tuple] = None
         self._nf_probed = False
         self._nf_probe_tries = 0
+        # compat KV adapter cache (legacy jaxlib clients lack the
+        # try-get/increment surface; see compat.kv_client)
+        self._kv_raw = None
+        self._kv_wrapped = None
         self._meshes: Dict[tuple, object] = {}
         # one serialized executor thread (the FPGAQueue role): calls run
         # in submission order — the property SPMD needs — while start()
@@ -213,6 +225,14 @@ class DistEngine(StreamPortMixin, BaseEngine):
                 target=self._execute, args=(options, req), daemon=True
             ).start()
         else:
+            # overlap backpressure: an async caller more than
+            # `inflight_window` calls ahead of the executor waits here —
+            # BOUNDED by the engine timeout so a wedged executor can
+            # never also wedge the submitting thread (facade deadlines
+            # must still fire, the design note on the executor above)
+            self._queue.wait_depth_below(
+                self.inflight_window, timeout=self.timeout_s
+            )
             try:
                 self._queue.push((options, req))
             except RuntimeError:  # engine shut down
@@ -253,10 +273,30 @@ class DistEngine(StreamPortMixin, BaseEngine):
         return {
             "device_interactions": self.interactions.read(),
             "executor_queue_depth": len(self._queue),
+            "inflight_window": self.inflight_window,
             "remote_stream_seq": stream_seq,
             "cached_meshes": len(self._meshes),
             "faults": None,
         }
+
+    def drain_inflight(self, timeout=None) -> bool:
+        """Overlap drain point: a NOP barrier through the serialized
+        executor — when it completes, every call queued before it has
+        executed (the SPMD program stream is empty)."""
+        from ...overlap import drain_deadline_s
+
+        req = Request(op_name="NOP")
+        try:
+            self._queue.push((CallOptions(op=Operation.NOP), req))
+        except RuntimeError:  # engine shut down: nothing left to drain
+            return True
+        # the shared drain policy: queued calls get their own engine
+        # deadlines first — a tighter bound here would make flush()
+        # spuriously report deadlock over a healthy backlog
+        return req.wait(
+            timeout if timeout is not None
+            else drain_deadline_s(self.timeout_s)
+        )
 
     def _run(self) -> None:
         while not self._shut:
@@ -657,7 +697,14 @@ class DistEngine(StreamPortMixin, BaseEngine):
         client = distributed.global_state.client
         if client is None:  # pragma: no cover - initialize() guarantees it
             raise RuntimeError("distributed KV service unavailable")
-        return client
+        # modern KV surface over whatever jaxlib provides: legacy clients
+        # (no try-get/increment) are wrapped once by the compat adapter
+        if self._kv_raw is not client:
+            from ...compat import kv_client
+
+            self._kv_raw = client
+            self._kv_wrapped = kv_client(client)
+        return self._kv_wrapped
 
     def _remote_stream_put(self, options: CallOptions) -> ErrorCode:
         n = options.count
@@ -873,6 +920,14 @@ class DistEngine(StreamPortMixin, BaseEngine):
             if val <= 0:
                 return ErrorCode.CONFIG_ERROR
             self.retry_backoff_s = float(val)
+        elif fn == ConfigFunction.SET_INFLIGHT_WINDOW:
+            from ...constants import MAX_INFLIGHT_WINDOW
+
+            if not 1 <= val <= MAX_INFLIGHT_WINDOW:
+                return ErrorCode.CONFIG_ERROR
+            # the config itself rode the queue, so everything launched
+            # under the old bound has already executed (ordered drain)
+            self.inflight_window = int(val)
         elif fn == ConfigFunction.SET_TUNING:
             return self._apply_tuning(options)
         else:

@@ -129,6 +129,13 @@ class EmuEngine(BaseEngine):
         # value-correct)
         self.retry_limit = 0
         self.retry_backoff_s = DEFAULT_RETRY_BACKOFF_S
+        # overlap-plane parity knob (ConfigFunction.SET_INFLIGHT_WINDOW):
+        # this tier completes requests from its own scheduler threads —
+        # launches never block on completion — so the window depth is
+        # accepted + reported for portability, not enforced as a bound
+        from ...overlap import default_window_depth
+
+        self.inflight_window = default_window_depth()
 
         self._rndzv_inits: List[Message] = []
         self._rndzv_done: List[Message] = []
@@ -361,6 +368,7 @@ class EmuEngine(BaseEngine):
             "retransmits_total": self._retransmits_total,
             "dedup_discards_total": self._dedup_discards_total,
             "retry_limit": self.retry_limit,
+            "inflight_window": self.inflight_window,
             "faults": inj.stats() if inj is not None else None,
         }
 
@@ -586,6 +594,12 @@ class EmuEngine(BaseEngine):
             if val <= 0:
                 return ErrorCode.CONFIG_ERROR
             self.max_rendezvous_size = int(val)
+        elif fn == ConfigFunction.SET_INFLIGHT_WINDOW:
+            from ...constants import MAX_INFLIGHT_WINDOW
+
+            if not 1 <= val <= MAX_INFLIGHT_WINDOW:
+                return ErrorCode.CONFIG_ERROR
+            self.inflight_window = int(val)
         elif fn == ConfigFunction.SET_TUNING:
             from ...constants import (
                 ALGORITHM_TUNING_KEYS,

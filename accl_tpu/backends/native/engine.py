@@ -189,6 +189,17 @@ class NativeEngine(BaseEngine):
             raise RuntimeError(f"native engine failed to open {address!r}")
         self._registered_comms: set = set()
         self._shut = False
+        from ...overlap import default_window_depth
+
+        self.inflight_window = default_window_depth()
+        # host-side mirror of the C engine's register table, seeded from
+        # the shared defaults: every SET_TUNING write that rides the ABI
+        # is mirrored here (write-through), registers the ABI predates
+        # (pipeline_threshold) live here outright — the facade's
+        # _engine_tuning and register-visibility tests read this dict
+        from ...constants import TUNING_DEFAULTS
+
+        self.tuning: dict = dict(TUNING_DEFAULTS)
 
     # -- plumbing ------------------------------------------------------------
     def _ensure_comm(self, comm: Communicator) -> None:
@@ -217,6 +228,48 @@ class NativeEngine(BaseEngine):
         return view.ctypes.data, int(buf.dtype), view
 
     def start(self, options: CallOptions) -> Request:
+        from ...constants import (
+            ConfigFunction,
+            MAX_INFLIGHT_WINDOW,
+            Operation,
+            TuningKey,
+        )
+
+        if (
+            options.op == Operation.CONFIG
+            and int(options.cfg_function)
+            == int(ConfigFunction.SET_INFLIGHT_WINDOW)
+        ):
+            # overlap-plane parity knob, handled host-side: the C engine
+            # predates the window vocabulary and its scheduler already
+            # completes requests asynchronously (no launch-path blocking
+            # to decouple) — accept + store so set_inflight_window is
+            # portable across all four tiers
+            req = Request(op_name=options.op.name)
+            req.mark_executing()
+            if 1 <= options.cfg_value <= MAX_INFLIGHT_WINDOW:
+                self.inflight_window = int(options.cfg_value)
+                req.complete(ErrorCode.OK)
+            else:
+                req.complete(ErrorCode.CONFIG_ERROR)
+            return req
+        if (
+            options.op == Operation.CONFIG
+            and int(options.cfg_function) == int(ConfigFunction.SET_TUNING)
+            and int(options.cfg_key)
+            == int(TuningKey.PIPELINE_THRESHOLD)
+        ):
+            # overlap-plane register, handled host-side: the C ABI's
+            # register table predates it, and the facade-level segmented
+            # split reads it from this host dict anyway
+            req = Request(op_name=options.op.name)
+            req.mark_executing()
+            if options.cfg_value >= 0:
+                self.tuning["pipeline_threshold"] = int(options.cfg_value)
+                req.complete(ErrorCode.OK)
+            else:
+                req.complete(ErrorCode.CONFIG_ERROR)
+            return req
         args = _CallArgs()
         args.op = int(options.op)
         args.cfg_function = int(options.cfg_function)
@@ -249,6 +302,35 @@ class NativeEngine(BaseEngine):
         native_id = self._lib.accl_ng_start(self._handle, ctypes.byref(args))
         req = NativeRequest(self, native_id, options.op.name, keep)
         req.mark_executing()
+        if (
+            options.op == Operation.CONFIG
+            and int(options.cfg_function) == int(ConfigFunction.SET_TUNING)
+        ):
+            # write-through mirror: keep the host-readable register dict
+            # in step with the C engine — but only once the engine
+            # ACCEPTED the write (a rejected value must never leak into
+            # the mirror the facade's pipelining verdict reads).  The
+            # algorithm registers are skipped: every other tier's table
+            # holds their NAME strings, and mirroring the wire's int
+            # would flip-flop the dict's value type across tiers.
+            from ...constants import ALGORITHM_TUNING_KEYS, TUNING_KEY_NAMES
+
+            try:
+                tkey = TuningKey(int(options.cfg_key))
+                name = (
+                    None if tkey in ALGORITHM_TUNING_KEYS
+                    else TUNING_KEY_NAMES.get(tkey)
+                )
+            except ValueError:
+                name = None
+            if name is not None:
+                val = int(options.cfg_value)
+
+                def _mirror(name=name, val=val, req=req):
+                    if req.get_retcode() == ErrorCode.OK:
+                        self.tuning[name] = val
+
+                req.add_done_callback(_mirror)
         return req
 
     def shutdown(self) -> None:

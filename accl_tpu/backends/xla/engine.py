@@ -59,9 +59,16 @@ from ...buffer import (
     dev_zeros as _dev_zeros,
     make_buffer,
 )
+from ...overlap import InflightWindow, drain_deadline_s
 from ...request import Request
 from ..base import BaseEngine, CallOptions, InteractionCounter, StreamPortMixin
 from ...ops import driver as opdriver
+
+#: sentinel returned by the gang execution paths when a call's completion
+#: was handed to the in-flight window (the overlap plane): the caller
+#: must NOT complete the requests — the window's drainer will, from the
+#: device done-probe, in launch order.
+IN_FLIGHT = object()
 
 
 def _np_stack_op0(
@@ -438,6 +445,13 @@ class XLAGangContext:
         # shared across the gang's rank handles — one collective on the
         # fast path bumps it exactly once, whatever the world size
         self.interactions = InteractionCounter()
+        # overlap plane: launched device programs park here and their
+        # requests complete from the drainer's done-probe instead of on
+        # the launch path — up to `window.depth` collectives per
+        # communicator in flight at once (SET_INFLIGHT_WINDOW /
+        # ACCL_INFLIGHT_WINDOW).  Drain points: Request.wait (per call),
+        # facade flush(), barrier, config writes, soft_reset.
+        self.window = InflightWindow()
         # per-GLOBAL-rank (Rank.session) health, fed by the slot watchdog:
         # a rank absent from a timed-out gang slot is "suspect"; two
         # strikes make it "dead" and collectives addressing it fail fast
@@ -583,6 +597,14 @@ class XLAGangContext:
         reset all per-communicator sequence counters restart at 0 and the
         next collective matches at a fresh slot.  Any still-parked call is
         completed with RECEIVE_TIMEOUT (its gang never assembled)."""
+        # overlap plane: a FULL drain first — every launched program's
+        # requests complete normally before any state is abandoned (the
+        # soft_reset drain-point contract, asserted by chip_soak).
+        # BOUNDED: soft_reset is the recovery path, so a wedged device
+        # call must not also wedge recovery — past the bound the reset
+        # proceeds and the stragglers complete (or fail) from the
+        # drainer whenever their done-probe returns
+        self.window.drain(drain_deadline_s(self.timeout_s))
         with self._lock:
             slots = list(self._slots.values())
             self._slots.clear()
@@ -687,12 +709,23 @@ class XLAGangContext:
                 with jax.profiler.TraceAnnotation(
                     f"accl::{lead.op.name.lower()}"
                 ):
-                    code = self._run_op(comm, calls, lead, reqs)
+                    code = self._run_op(comm, calls, lead, reqs, t0)
         except Exception:
             import traceback
 
             traceback.print_exc()
             code = ErrorCode.INVALID_OPERATION
+        if code is IN_FLIGHT:
+            # overlap plane: completion was handed to the in-flight
+            # window — the drainer completes these requests from the
+            # device done-probe, in launch order
+            return
+        # per-communicator ordering fence: an inline completion (host-path
+        # collectives, gang-mismatch failures) must not overtake earlier
+        # launched-but-incomplete device calls of this communicator — the
+        # window's launch-order contract.  Bounded like every drain point:
+        # a wedged earlier call must not also wedge this completion
+        self.window.drain_key(comm.id, drain_deadline_s(self.timeout_s))
         dt = time.perf_counter_ns() - t0
         for req in reqs:
             req.complete(code, dt)
@@ -855,12 +888,15 @@ class XLAGangContext:
         self.interactions.bump()  # ONE dispatch for the whole batch
         with jax.profiler.TraceAnnotation(f"accl::batch[{len(plans)}]"):
             outs = opdriver.run_batch(globals_, mesh, specs)
-        dt = time.perf_counter_ns() - t0
+        all_reqs: List[Request] = []
         for i, (calls, lead, plan) in enumerate(plans):
             reqs = [e[1][i] for e in entries]
             self._adopt_out_shards(outs[i], calls, plan, reqs)
-            for req in reqs:
-                req.complete(ErrorCode.OK, dt)
+            all_reqs.extend(reqs)
+        # the fused batch rides the in-flight window as ONE entry: all
+        # positions came out of one program, so they become ready (and
+        # complete) together, from the drainer's done-probe
+        self._park_inflight(comm, outs, all_reqs, t0)
         return True
 
     def _run_op(
@@ -869,19 +905,76 @@ class XLAGangContext:
         calls: List[CallOptions],
         lead: CallOptions,
         reqs: Optional[List[Request]] = None,
+        t0: Optional[int] = None,
     ) -> ErrorCode:
         if lead.op == Operation.BARRIER:
             # gang assembly IS the barrier on this tier: reaching here means
             # every rank of the communicator posted the call in this process.
             # A multi-process gang must NOT reuse this (see backends/dist for
-            # the cross-process barrier over the device mesh).
+            # the cross-process barrier over the device mesh).  The barrier
+            # is also an overlap drain point: no rank may observe it pass
+            # while an earlier collective of ITS communicator is still in
+            # flight — and a wedged one fails the barrier within the
+            # engine deadline instead of hanging it.  Per-key, matching
+            # the window's keys-drain-independently contract: a wedged
+            # UNRELATED communicator must not fail this barrier.
+            if not self.window.drain_key(
+                comm.id, drain_deadline_s(self.timeout_s)
+            ):
+                return ErrorCode.RECEIVE_TIMEOUT
             return ErrorCode.OK
         mesh = self.submesh(comm)
         if mesh is not None:
-            code = self._run_op_device(comm, calls, lead, mesh, reqs)
+            code = self._run_op_device(comm, calls, lead, mesh, reqs, t0)
             if code is not None:
                 return code
         return self._run_op_host(comm, calls, lead, mesh)
+
+    # -- overlap plane --------------------------------------------------------
+    def _park_inflight(self, comm, out, reqs, t0):
+        """Hand a dispatched device call's completion to the in-flight
+        window: the launch path returns immediately (result adoption has
+        already been wired — pointer swaps done, writebacks deferred)
+        and the drainer completes the requests when the device future
+        resolves.  Falls back to inline completion when there are no
+        requests to decouple."""
+        if reqs is None:
+            jax.block_until_ready(out)
+            return ErrorCode.OK
+        if t0 is None:
+            t0 = time.perf_counter_ns()
+
+        def waiter(out=out):
+            jax.block_until_ready(out)
+
+        def on_ready(overlap_ns, depth, ready_ns, reqs=reqs, t0=t0):
+            dt = max(ready_ns - t0, 1)
+            for req in reqs:
+                # overlap_ns is 0 when nothing overlapped this call (a
+                # lone sync call riding the window hid no device time) —
+                # record None so telemetry never over-credits the window
+                req.overlap_ns = overlap_ns or None
+                req.inflight_depth = depth
+                req.complete(ErrorCode.OK, dt)
+
+        def on_error(exc, reqs=reqs, t0=t0, comm_id=comm.id):
+            # a device-side failure surfaces on every request of the
+            # launch, with the failure context the flight recorder and
+            # ACCLError.details carry
+            dt = max(time.perf_counter_ns() - t0, 1)
+            ctx = {
+                "comm": comm_id,
+                "error": f"{type(exc).__name__}: {exc}"[:300],
+            }
+            for req in reqs:
+                if not req.done():  # side-effect-free engine probe
+                    req.complete(
+                        ErrorCode.INVALID_OPERATION, dt,
+                        context=dict(ctx, op=req.op_name),
+                    )
+
+        self.window.park(comm.id, waiter, on_ready, on_error)
+        return IN_FLIGHT
 
     # -- zero-host-copy device path ------------------------------------------
     def _plan_device_call(
@@ -1087,6 +1180,7 @@ class XLAGangContext:
         lead: CallOptions,
         state: dict,
         reqs: Optional[List[Request]] = None,
+        t0: Optional[int] = None,
     ) -> Optional[ErrorCode]:
         """The warm path of a planned gang collective: the template,
         sharding, adoption map and jitted program handle all come out of
@@ -1169,7 +1263,7 @@ class XLAGangContext:
         self._adopt_out_shards(
             out, calls, tmpl, reqs, state["dev_to_rank"]
         )
-        return ErrorCode.OK
+        return self._park_inflight(lead.comm, out, reqs, t0)
 
     def _adopt_out_shards(self, out, calls, plan, reqs,
                           dev_to_rank=None) -> None:
@@ -1209,6 +1303,7 @@ class XLAGangContext:
         lead: CallOptions,
         mesh,
         reqs: Optional[List[Request]] = None,
+        t0: Optional[int] = None,
     ) -> Optional[ErrorCode]:
         """Run the collective entirely on device-resident operands.
 
@@ -1239,7 +1334,7 @@ class XLAGangContext:
                 and state["tuning_epoch"] == self.tuning_epoch
             ):
                 code = self._run_op_device_prepared(
-                    calls, lead, state, reqs
+                    calls, lead, state, reqs, t0
                 )
                 if code is not None:
                     return code
@@ -1315,7 +1410,7 @@ class XLAGangContext:
             return None
 
         self._adopt_out_shards(out, calls, plan, reqs)
-        return ErrorCode.OK
+        return self._park_inflight(comm, out, reqs, t0)
 
     def _run_rooted(self, op, global_arr, mesh, lead, donate=False,
                     prep=None):
@@ -1657,6 +1752,17 @@ class XLAEngine(StreamPortMixin, BaseEngine):
     def device_interactions(self) -> int:
         return self.gang.interactions.read()
 
+    def drain_inflight(self, timeout=None) -> bool:
+        """Overlap drain point: block until the gang's in-flight window
+        is empty (every launched collective completed).  Bounded by
+        default — flush()/config callers must not hang forever on a
+        wedged device call (the per-request wait()/check() path is
+        where its failure surfaces)."""
+        return self.gang.window.drain(
+            timeout if timeout is not None
+            else drain_deadline_s(self.gang.timeout_s)
+        )
+
     def telemetry_report(self) -> dict:
         """Gang-tier counters for the telemetry snapshot: pending
         rendezvous slots, parked p2p posts, undrained stream ports, and
@@ -1675,6 +1781,9 @@ class XLAEngine(StreamPortMixin, BaseEngine):
             "gang_tuning_epoch": self.gang.tuning_epoch,
             "p2p_parked": len(self.p2p.dump_parked()),
             "stream_depths": stream_depths,
+            # overlap plane: the in-flight window's live depth + lifetime
+            # counters (launched/completed/failed/max depth/overlap ns)
+            "inflight": self.gang.window.stats(),
             "faults": None,
         }
 
@@ -2001,6 +2110,20 @@ class XLAEngine(StreamPortMixin, BaseEngine):
             if val <= 0:
                 return ErrorCode.CONFIG_ERROR
             self.retry_backoff_s = float(val)
+        elif fn == ConfigFunction.SET_INFLIGHT_WINDOW:
+            from ...constants import MAX_INFLIGHT_WINDOW
+
+            if not 1 <= val <= MAX_INFLIGHT_WINDOW:
+                return ErrorCode.CONFIG_ERROR
+            # a depth change is itself a drain point: no launch made
+            # under the old bound may still be in flight when the new
+            # bound starts admitting (bounded — a wedged call fails the
+            # config within the engine deadline instead of hanging it)
+            if not self.gang.window.drain(
+                drain_deadline_s(self.gang.timeout_s)
+            ):
+                return ErrorCode.RECEIVE_TIMEOUT
+            self.gang.window.set_depth(int(val))
         elif fn == ConfigFunction.SET_TUNING:
             return self._apply_tuning(options)
         return ErrorCode.OK
@@ -2047,4 +2170,7 @@ class XLAEngine(StreamPortMixin, BaseEngine):
         return "\n".join(lines)
 
     def shutdown(self) -> None:
-        pass
+        # overlap plane: drain and stop the shared window's drainer (the
+        # first rank handle's deinit does the work; later ones find it
+        # already stopped — parks then degrade to inline completion)
+        self.gang.window.stop()

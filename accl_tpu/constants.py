@@ -52,6 +52,15 @@ class ConfigFunction(enum.IntEnum):
     disables it (fire-and-forget, the classic wire); limit N arms
     per-segment ACKs with up to N retransmits at exponentially backed-off
     intervals starting from the configured backoff seconds.
+
+    ``SET_INFLIGHT_WINDOW`` sizes the overlap plane's per-communicator
+    in-flight window (``ACCL.set_inflight_window`` / the
+    ``ACCL_INFLIGHT_WINDOW`` env): up to N collectives may be launched
+    before the first completes — the TPU analog of the reference's
+    host-side command FIFO, which keeps queuing work while the CCLO
+    executes (the "no host in the data path" contract).  Value 1 keeps
+    the window but serializes (at most one launch in flight); the
+    engines still complete requests from the device done-probe.
     """
 
     RESET = 0
@@ -62,6 +71,7 @@ class ConfigFunction(enum.IntEnum):
     SET_TUNING = 5
     SET_RETRY_LIMIT = 6
     SET_RETRY_BACKOFF = 7
+    SET_INFLIGHT_WINDOW = 8
 
 
 class TuningKey(enum.IntEnum):
@@ -85,6 +95,11 @@ class TuningKey(enum.IntEnum):
     REDUCE_ALGORITHM = 8
     SCATTER_ALGORITHM = 9
     GATHER_ALGORITHM = 10
+    # overlap plane: payloads whose byte size exceeds this threshold are
+    # split into RING_SEGMENTS pipelined sub-launches (host staging of
+    # chunk k overlaps device execution of chunk k-1).  0 disables the
+    # host-level split (the conservative default; the autotuner races it)
+    PIPELINE_THRESHOLD = 11
 
 
 class AllreduceAlgorithm(enum.IntEnum):
@@ -110,6 +125,7 @@ TUNING_KEY_NAMES = {
     TuningKey.REDUCE_ALGORITHM: "reduce_algorithm",
     TuningKey.SCATTER_ALGORITHM: "scatter_algorithm",
     TuningKey.GATHER_ALGORITHM: "gather_algorithm",
+    TuningKey.PIPELINE_THRESHOLD: "pipeline_threshold",
 }
 
 #: lowerings valid for the ROOTED algorithm registers (no ppermute-ring /
@@ -337,4 +353,16 @@ TUNING_DEFAULTS = {
     "bcast_flat_tree_max_ranks": 3,
     "reduce_flat_tree_max_ranks": 4,
     "reduce_flat_tree_max_count": 8 * 1024,
+    # overlap plane: 0 = host-level segmented pipelining disabled (the
+    # conservative default; RING_SEGMENTS > 1 + a positive threshold arm
+    # it, typically via an autotuned TuningPlan)
+    "pipeline_threshold": 0,
 }
+
+# Overlap plane (async in-flight window) defaults: how many collectives
+# per communicator may be launched before the first completes.  Small
+# and conservative by default — each in-flight launch pins its output
+# shards in HBM until the done-probe fires.  Override per group with
+# ACCL.set_inflight_window / the ACCL_INFLIGHT_WINDOW env var.
+DEFAULT_INFLIGHT_WINDOW = 4
+MAX_INFLIGHT_WINDOW = 64

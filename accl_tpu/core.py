@@ -14,6 +14,8 @@ overlap calls (the reference's ``run_async`` flag).
 from __future__ import annotations
 
 import os
+import threading
+import time
 import zlib
 from typing import List, Optional, Sequence, Union
 
@@ -37,6 +39,7 @@ from .constants import (
     dtype_size,
     numpy_to_dtype,
 )
+from .overlap import drain_deadline_s
 from .plans import CollectivePlan, PlanCache, size_bucket
 from .request import Request
 from .telemetry import Telemetry, chrome_trace, to_json, to_prometheus
@@ -92,8 +95,6 @@ class ACCL:
         self._telemetry = Telemetry.create(
             rank=local_rank, tier=type(engine).__name__
         )
-        import threading
-
         self._call_tls = threading.local()
         self._initialize(timeout_s, max_eager_size, max_rendezvous_size)
         env_plan = os.environ.get("ACCL_TUNING_PLAN")
@@ -186,6 +187,19 @@ class ACCL:
 
     def set_max_rendezvous_size(self, nbytes: int) -> None:
         self._config(ConfigFunction.SET_MAX_RENDEZVOUS_SIZE, nbytes)
+
+    def set_inflight_window(self, depth: int) -> None:
+        """Size the overlap plane's per-communicator in-flight window:
+        up to ``depth`` collectives may be launched before the first
+        completes (the reference's host-FIFO-ahead-of-the-CCLO
+        discipline; JAX async dispatch makes the overlap free).  The
+        write is itself a drain point — nothing launched under the old
+        bound is still in flight when it returns.  Default: small and
+        conservative (:data:`~accl_tpu.constants.DEFAULT_INFLIGHT_WINDOW`,
+        or the ``ACCL_INFLIGHT_WINDOW`` env var read at engine
+        construction).  Tiers whose schedulers already complete
+        asynchronously (emulator/native) accept and report the knob."""
+        self._config(ConfigFunction.SET_INFLIGHT_WINDOW, int(depth))
 
     def set_retry_policy(self, limit: int, backoff_s: float = 0.05) -> None:
         """Arm (or with ``limit=0`` disarm) the eager retransmit protocol
@@ -285,20 +299,37 @@ class ACCL:
         self._plans.invalidate("unload_tuning_plan")
 
     # -- call-plan pool (accl_tpu.plans) -------------------------------------
+    def _engine_tuning(self) -> dict:
+        """The tuning table backing this rank's engine (engine-held on
+        the emulator/dist tiers, gang-held on the XLA tier; {} on tiers
+        whose registers live out of Python, e.g. the native C engine)."""
+        tuning = getattr(self.engine, "tuning", None)
+        if tuning is None:
+            gang = getattr(self.engine, "gang", None)
+            tuning = getattr(gang, "tuning", None)
+        return tuning if tuning is not None else {}
+
     def _algorithm_snapshot(self, op: Operation):
         """The algorithm-register value steering ``op`` right now, read
         from whichever tuning table backs this rank's engine (the
         reference reads its exchange-memory registers per call; we read
         once per plan)."""
-        tuning = getattr(self.engine, "tuning", None)
-        if tuning is None:
-            gang = getattr(self.engine, "gang", None)
-            tuning = getattr(gang, "tuning", None)
-        if tuning is None:
+        tuning = self._engine_tuning()
+        if not tuning:
             return None
         if op == Operation.ALLREDUCE:
             return tuning.get("allreduce_algorithm")
         return tuning.get(f"{op.name.lower()}_algorithm")
+
+    #: collectives eligible for host-level segmented pipelining: width-1
+    #: elementwise ops where a contiguous operand slice maps onto the
+    #: same contiguous result slice (allgather/alltoall-family outputs
+    #: interleave rank-major and cannot be split this way) AND whose
+    #: operands are buffers on EVERY rank.  REDUCE is excluded: its
+    #: per-rank stream-operand overload means one rank could split while
+    #: a streaming peer cannot — the registers are SPMD-uniform but the
+    #: operand kinds are not, and a half-split collective deadlocks.
+    _PIPELINE_OPS = frozenset((Operation.ALLREDUCE, Operation.BCAST))
 
     def _plan_for(
         self,
@@ -343,6 +374,21 @@ class ACCL:
         eager = True if hi <= eager_limit else (
             False if lo > eager_limit else None
         )
+        # overlap plane: the segmented-pipelining verdict, resolved once
+        # per plan from the per-bucket TuningPlan overlay over the global
+        # registers — payloads above pipeline_threshold bytes split into
+        # ring_segments pipelined sub-launches (accl_tpu.overlap).  The
+        # register set is identical across ranks (collective SET_TUNING /
+        # shared plan file), so the split stays SPMD-uniform.
+        pthresh, psegs = 0, 1
+        if op in self._PIPELINE_OPS:
+            table = self._engine_tuning()
+            pthresh = int((overlay or {}).get(
+                "pipeline_threshold", table.get("pipeline_threshold", 0)
+            ) or 0)
+            psegs = int((overlay or {}).get(
+                "ring_segments", table.get("ring_segments", 1)
+            ) or 1)
         plan = CollectivePlan(
             key, cfg, flags,
             wire_dtype=wire,
@@ -350,6 +396,8 @@ class ACCL:
             eager=eager,
             algorithm=self._algorithm_snapshot(op),
             tuning=overlay,
+            pipeline_threshold=pthresh,
+            pipeline_segments=psegs,
         )
         return self._plans.store(plan)
 
@@ -445,20 +493,46 @@ class ACCL:
             self._pending = CommandQueue()
 
     def flush(self) -> None:
-        """Dispatch everything queued in the open batch (no-op outside a
-        batch or when empty).  The batch stays open for further calls;
-        :meth:`end_batch` closes it."""
+        """Dispatch everything queued in the open batch, then drain the
+        overlap plane: when :meth:`flush` returns, every DEVICE call
+        this handle launched has completed (the in-flight window's
+        explicit drain point; ``wait()``, barriers, config writes and
+        ``soft_reset`` are the others).  Scope: the gang tier's window
+        and the dist tier's executor backlog — note the dist backlog is
+        the WHOLE serialized program stream, so a pending blocking op
+        (an async ``recv`` whose peer has not sent yet) gates the drain
+        until it completes or times out, exactly as it gates every
+        later call on that tier.  On the emulator/native tiers requests
+        complete from their own schedulers independent of the launch
+        path — ``flush`` does not wait for those (a pending ``recv``
+        may legitimately outlive it), use ``Request.wait`` per call.
+        Still safe inside a batch — the
+        batch stays open for further calls; :meth:`end_batch` closes it."""
+        self._dispatch_pending()
+        # overlap drain point: launched-but-incomplete device calls
+        # finish before flush() returns (no-op on windowless tiers).
+        # A failed (timed-out) drain must SURFACE — callers trust the
+        # documented contract and read result buffers next
+        if not self.engine.drain_inflight():
+            raise self._deadlock_error("flush")
+
+    def _dispatch_pending(self) -> None:
+        """Dispatch the open batch WITHOUT draining the in-flight
+        window: the auto-dispatch hook behind ``Request.wait``/``test``
+        on queued calls — ``test`` stays a (near) non-blocking probe and
+        ``wait`` synchronizes on its own request, not the whole window
+        (:meth:`flush` is the drain point)."""
         q = self._pending
-        if q is None:
-            return
-        items = q.drain()
-        if items:
-            # disarm the auto-flush hooks: once dispatched, a later
-            # wait()/test() on these requests must not flush whatever
-            # UNRELATED batch happens to be open at that point
-            for _, req in items:
-                req._pre_wait = None
-            self.engine.start_batch(items)
+        if q is not None:
+            items = q.drain()
+            if items:
+                # disarm the auto-dispatch hooks: once dispatched, a
+                # later wait()/test() on these requests must not flush
+                # whatever UNRELATED batch happens to be open at that
+                # point
+                for _, req in items:
+                    req._pre_wait = None
+                self.engine.start_batch(items)
 
     def end_batch(self) -> None:
         """Close the (outermost) batch: flush queued work and return to
@@ -529,22 +603,140 @@ class ACCL:
         return ACCLError(ErrorCode.DEADLOCK_SUSPECTED, context,
                          details=details)
 
+    def _pipeline_segments_for(self, plan, count: int, dtype) -> int:
+        """Sub-launch count for this call, from the plan's cached
+        pipelining verdict; 1 when the split does not apply (below
+        threshold, disabled registers, or already inside a pipelined
+        parent — segments never re-split)."""
+        if getattr(self._call_tls, "pipelining", False):
+            return 1
+        nseg = plan.pipeline_for(count * dtype_size(dtype))
+        return min(nseg, count) if count > 0 else 1
+
+    def _launch_pipelined(
+        self, op_name: str, plan, comm, count: int, nseg: int,
+        run_async: bool, launch_seg, context: str,
+    ) -> Optional[Request]:
+        """The segmented-pipelining launch: split ``count`` into ``nseg``
+        contiguous chunks and fire one async sub-collective per chunk
+        back-to-back — host staging of chunk k overlaps device execution
+        of chunk k-1 through the engine's in-flight window.  Returns ONE
+        aggregate Request that completes when the last segment does
+        (first failing segment's retcode + context win); its deferred
+        result resolves every segment's parked adoption in issue order.
+        """
+        base, rem = divmod(count, nseg)
+        bounds = []
+        start = 0
+        for i in range(nseg):
+            stop = start + base + (1 if i < rem else 0)
+            if stop > start:
+                bounds.append((start, stop))
+            start = stop
+
+        outer = Request(op_name=op_name.upper())
+        outer.mark_executing()
+        if self._pending is not None:
+            # segments queued into an open batch: waiting the aggregate
+            # must flush them (the same auto-flush contract single calls
+            # carry) — but ONLY while that very batch is still the open
+            # one; a later wait() must never flush whatever unrelated
+            # batch happens to be open at that point
+            batch_q = self._pending
+
+            def _pw(batch_q=batch_q):
+                if self._pending is batch_q:
+                    self._dispatch_pending()
+
+            outer._pre_wait = _pw
+        tel = self._telemetry
+        meta = None
+        if tel is not None:
+            # the aggregate's CallRecord covers the FULL payload; each
+            # segment also records itself (honest per-launch history)
+            dt = plan.arithcfg.uncompressed
+            meta = {
+                "op": op_name, "comm": comm.id, "epoch": comm.epoch,
+                "dtype": dt.name, "count": count,
+                "nbytes": count * dtype_size(dt),
+                "bucket": plan.bucket, "algorithm": plan.algorithm,
+                "plan_hit": getattr(self._call_tls, "plan_hit", None),
+                "eager": plan.eager,
+            }
+        t0 = time.perf_counter_ns()
+        self._call_tls.pipelining = True
+        try:
+            inner = [launch_seg(s0, s1) for (s0, s1) in bounds]
+        finally:
+            self._call_tls.pipelining = False
+
+        def _resolve(inner=inner):
+            for q in inner:
+                q.materialize()
+            for q in inner:
+                if q.get_retcode() != ErrorCode.OK:
+                    # a segment's deferred adoption failed after the
+                    # aggregate completed OK: raising here downgrades the
+                    # aggregate's retcode so check() surfaces it
+                    raise RuntimeError(
+                        f"pipelined segment failed: "
+                        f"{ErrorCode.describe(q.get_retcode())}"
+                    )
+
+        outer.defer_result(_resolve)
+        if tel is not None:
+            tel.attach(outer, meta)
+        lock = threading.Lock()
+        state = {"left": len(inner)}
+
+        def _seg_done():
+            with lock:
+                state["left"] -= 1
+                if state["left"]:
+                    return
+            code, ctx = ErrorCode.OK, None
+            depth = None
+            for q in inner:
+                rc = q.get_retcode()
+                if rc != ErrorCode.OK and code == ErrorCode.OK:
+                    code, ctx = rc, q.error_context
+                if q.inflight_depth:
+                    depth = max(depth or 0, q.inflight_depth)
+            # each SEGMENT already recorded its own overlap_ns — the
+            # aggregate must not record the sum again (that would
+            # double-count accl_overlap_ns_total vs the window's stats)
+            outer.inflight_depth = depth
+            outer.complete(
+                code, max(time.perf_counter_ns() - t0, 1), context=ctx
+            )
+
+        for q in inner:
+            q.add_done_callback(_seg_done)
+        if run_async:
+            return outer
+        if not outer.wait(timeout=drain_deadline_s(self._timeout_s)):
+            raise self._deadlock_error(context)
+        outer.check(context)
+        return outer
+
     def _launch(
         self, options: CallOptions, run_async: bool, context: str
     ) -> Optional[Request]:
         tel = self._telemetry
         if self._pending is not None:
             req = Request(op_name=options.op.name)
-            req._pre_wait = self.flush  # auto-flush when the user waits
+            req._pre_wait = self._dispatch_pending  # dispatch on wait
             if tel is not None:
                 tel.attach(req, self._call_meta(options))
             self._pending.push((options, req))
             if run_async:
                 return req
-            # a sync call inside a batch flushes the whole run (it cannot
-            # complete before its queued predecessors anyway)
-            self.flush()
-            if not req.wait(timeout=max(60.0, 4 * self._timeout_s)):
+            # a sync call inside a batch dispatches the whole run (it
+            # cannot complete before its queued predecessors anyway);
+            # its own wait below is the synchronization — a full window
+            # drain here could fail it over an UNRELATED wedged call
+            self._dispatch_pending()
+            if not req.wait(timeout=drain_deadline_s(self._timeout_s)):
                 raise self._deadlock_error(context)
             req.check(context)
             return req
@@ -555,11 +747,11 @@ class ACCL:
             tel.attach(req, self._call_meta(options))
         if run_async:
             return req
-        # facade-level deadline tracks the configured engine timeout, with a
-        # 4x margin (60s floor) so the engine's own RECEIVE_TIMEOUT fires
-        # first for assembly stalls — and a first-call XLA compile of a large
-        # program doesn't spuriously trip the deadlock detector
-        if not req.wait(timeout=max(60.0, 4 * self._timeout_s)):
+        # facade-level deadline follows the shared drain policy so the
+        # engine's own RECEIVE_TIMEOUT fires first for assembly stalls —
+        # and a first-call XLA compile of a large program doesn't
+        # spuriously trip the deadlock detector
+        if not req.wait(timeout=drain_deadline_s(self._timeout_s)):
             raise self._deadlock_error(context)
         req.check(context)
         return req
@@ -822,6 +1014,16 @@ class ACCL:
             Operation.BCAST, comm, buf.dtype, n, compress_dtype, host,
             (root,),
         )
+        nseg = self._pipeline_segments_for(plan, n, buf.dtype)
+        if nseg > 1:
+            return self._launch_pipelined(
+                "bcast", plan, comm, n, nseg, run_async,
+                lambda s0, s1: self.bcast(
+                    buf.slice(s0, s1), s1 - s0, root=root, comm=comm,
+                    compress_dtype=compress_dtype, run_async=True,
+                ),
+                "bcast",
+            )
         opts = CallOptions(
             op=Operation.BCAST,
             comm=comm,
@@ -1022,6 +1224,17 @@ class ACCL:
             Operation.ALLREDUCE, comm, sendbuf.dtype, n, compress_dtype,
             host, (int(function),),
         )
+        nseg = self._pipeline_segments_for(plan, n, sendbuf.dtype)
+        if nseg > 1:
+            return self._launch_pipelined(
+                "allreduce", plan, comm, n, nseg, run_async,
+                lambda s0, s1: self.allreduce(
+                    sendbuf.slice(s0, s1), recvbuf.slice(s0, s1),
+                    s1 - s0, function=function, comm=comm,
+                    compress_dtype=compress_dtype, run_async=True,
+                ),
+                "allreduce",
+            )
         opts = CallOptions(
             op=Operation.ALLREDUCE,
             comm=comm,
@@ -1283,6 +1496,9 @@ class ACCL:
             # collective is a hit; SET_TUNING / soft_reset / eager
             # threshold writes each count one invalidation
             "plan_cache": self._plans.stats(),
+            # overlap plane: the in-flight window depth this handle's
+            # engine runs (SET_INFLIGHT_WINDOW / ACCL_INFLIGHT_WINDOW)
+            "inflight_window": self._inflight_window_depth(),
             # the adopted measurement-driven TuningPlan, if any
             "tuning_plan": (
                 None if self._tuning_plan is None else {
@@ -1314,11 +1530,27 @@ class ACCL:
                 pass
         return caps
 
+    def _inflight_window_depth(self) -> Optional[int]:
+        """The engine's in-flight window depth (gang-held on the XLA
+        tier, engine-held elsewhere; None when the tier has neither)."""
+        depth = getattr(self.engine, "inflight_window", None)
+        if depth is not None:
+            return int(depth)
+        gang = getattr(self.engine, "gang", None)
+        window = getattr(gang, "window", None)
+        return int(window.depth) if window is not None else None
+
     def deinit(self) -> None:
         if self._initialized:
-            self.end_batch()  # queued work must not die with the handle
-            self.engine.shutdown()
-            self._initialized = False
+            try:
+                self.end_batch()  # queued work must not die with the handle
+            finally:
+                # a wedged in-flight call may make the flush above raise
+                # — the engine still shuts down (threads/queues must not
+                # leak) and the handle still deinitializes; the error
+                # propagates so the wedge stays loud
+                self.engine.shutdown()
+                self._initialized = False
 
 
 # ---------------------------------------------------------------------------

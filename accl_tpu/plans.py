@@ -59,10 +59,12 @@ class CollectivePlan:
     __slots__ = (
         "key", "arithcfg", "compression", "wire_dtype", "bucket",
         "eager", "algorithm", "tuning", "engine",
+        "pipeline_threshold", "pipeline_segments",
     )
 
     def __init__(self, key, arithcfg, compression, wire_dtype, bucket,
-                 eager, algorithm, tuning=None):
+                 eager, algorithm, tuning=None,
+                 pipeline_threshold=0, pipeline_segments=1):
         self.key = key
         self.arithcfg = arithcfg          # resolved ArithConfig
         self.compression = compression    # CompressionFlags
@@ -75,6 +77,23 @@ class CollectivePlan:
         self.algorithm = algorithm        # register snapshot at plan time
         self.tuning = tuning              # per-bucket register overlay
         self.engine: Dict[str, Any] = {}  # engine-private prepared state
+        # overlap plane: the segmented-pipelining verdict for this plan's
+        # (op, bucket) — payloads above pipeline_threshold bytes split
+        # into pipeline_segments sub-launches (0 / <=1 disables).  Cached
+        # here so the warm path never re-reads engine registers.
+        self.pipeline_threshold = int(pipeline_threshold or 0)
+        self.pipeline_segments = int(pipeline_segments or 1)
+
+    def pipeline_for(self, nbytes: int) -> int:
+        """Sub-launch count for a payload of ``nbytes``: the cached
+        segment count when host-level pipelining applies, else 1."""
+        if (
+            self.pipeline_segments > 1
+            and self.pipeline_threshold > 0
+            and nbytes > self.pipeline_threshold
+        ):
+            return self.pipeline_segments
+        return 1
 
     def describe(self) -> dict:
         """Introspection form (tests / debug dumps)."""
@@ -85,6 +104,8 @@ class CollectivePlan:
             "eager": self.eager,
             "algorithm": self.algorithm,
             "tuning": dict(self.tuning) if self.tuning else None,
+            "pipeline_threshold": self.pipeline_threshold,
+            "pipeline_segments": self.pipeline_segments,
         }
 
 

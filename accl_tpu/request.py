@@ -64,6 +64,12 @@ class Request:
         # flight-recorder hook every tier's completion path runs through
         self._telemetry = None
         self._tmeta: Optional[dict] = None
+        # overlap plane (accl_tpu.overlap): stamped by the engine's
+        # in-flight window drainer just before complete() — how long this
+        # call stayed in flight after its launch returned, and the window
+        # depth it was parked at.  None on tiers/paths without a window.
+        self.overlap_ns: Optional[int] = None
+        self.inflight_depth: Optional[int] = None
 
     # -- engine side --------------------------------------------------------
     def mark_executing(self) -> None:
@@ -89,7 +95,9 @@ class Request:
             # telemetry failure must never fail the call it observes)
             try:
                 tel.record(meta, self._duration_ns, self._retcode,
-                           self.error_context)
+                           self.error_context,
+                           overlap_ns=self.overlap_ns,
+                           inflight_depth=self.inflight_depth)
             except Exception:  # pragma: no cover - defensive
                 pass
         for cb in callbacks:
@@ -258,7 +266,31 @@ class CommandQueue:
                 self._cv.wait(timeout)
             if not self._items:
                 return None
-            return self._items.pop(0)
+            item = self._items.pop(0)
+            # wake backpressure waiters (wait_depth_below); a concurrent
+            # popper woken spuriously re-checks and times out harmlessly
+            self._cv.notify_all()
+            return item
+
+    def wait_depth_below(self, n: int, timeout: Optional[float] = None) -> bool:
+        """Overlap-plane backpressure: block until fewer than ``n`` items
+        are queued (or the queue closes / the timeout expires).  Bounds
+        how far an async caller can run ahead of the serialized executor
+        (the dist tier's in-flight window)."""
+        import time as _time
+
+        deadline = (
+            None if timeout is None else _time.monotonic() + float(timeout)
+        )
+        with self._cv:
+            while len(self._items) >= n and not self._closed:
+                rem = None
+                if deadline is not None:
+                    rem = deadline - _time.monotonic()
+                    if rem <= 0:
+                        return False
+                self._cv.wait(rem if rem is not None else 1.0)
+            return True
 
     def drain(self) -> list:
         """Atomically take every queued item (the batch-flush unit);

@@ -111,11 +111,13 @@ class CallRecord:
         "op", "comm", "epoch", "dtype", "count", "nbytes", "bucket",
         "algorithm", "plan_hit", "eager", "duration_ns", "retcode",
         "retcode_name", "end_perf_ns", "attempts", "peer",
+        "overlap_ns", "inflight_depth",
     )
 
     def __init__(self, op, comm, epoch, dtype, count, nbytes, bucket,
                  algorithm, plan_hit, eager, duration_ns, retcode,
-                 retcode_name, end_perf_ns, attempts=None, peer=None):
+                 retcode_name, end_perf_ns, attempts=None, peer=None,
+                 overlap_ns=None, inflight_depth=None):
         self.op = op
         self.comm = comm
         self.epoch = epoch
@@ -132,6 +134,10 @@ class CallRecord:
         self.end_perf_ns = end_perf_ns
         self.attempts = attempts
         self.peer = peer
+        # overlap plane: in-flight time past launch return + window depth
+        # at park (None when the call never rode an in-flight window)
+        self.overlap_ns = overlap_ns
+        self.inflight_depth = inflight_depth
 
     def as_dict(self) -> dict:
         d = {
@@ -154,6 +160,10 @@ class CallRecord:
             d["attempts"] = self.attempts
         if self.peer is not None:
             d["peer"] = self.peer
+        if self.overlap_ns is not None:
+            d["overlap_ns"] = self.overlap_ns
+        if self.inflight_depth is not None:
+            d["inflight_depth"] = self.inflight_depth
         return d
 
 
@@ -238,7 +248,7 @@ class MetricsRegistry:
 
     def record_call(self, op: str, size_bucket: int, duration_ns: int,
                     code: int, code_name: str, plan_hit,
-                    attempts) -> None:
+                    attempts, overlap_ns=None) -> None:
         """The completion-path fast lane: every counter/histogram update
         one call makes, under ONE lock acquisition (separate inc/observe
         calls each pay a lock + tuple build — measured at ~2x this)."""
@@ -259,6 +269,13 @@ class MetricsRegistry:
             if attempts:
                 key = ("accl_call_attempts_total", op)
                 c[key] = c.get(key, 0) + int(attempts)
+            if overlap_ns:
+                # overlap plane: device time hidden behind later host
+                # work — the in-flight window's win, summed per op
+                key = ("accl_overlap_ns_total", op)
+                c[key] = c.get(key, 0) + int(overlap_ns)
+                key = ("accl_overlapped_calls_total", op)
+                c[key] = c.get(key, 0) + 1
             h = self._hist.get((op, size_bucket))
             if h is None:
                 h = self._hist[(op, size_bucket)] = [0, 0, {}]
@@ -406,13 +423,16 @@ class Telemetry:
         self.record(
             meta, req.get_duration_ns(), req.get_retcode(),
             req.error_context,
+            overlap_ns=getattr(req, "overlap_ns", None),
+            inflight_depth=getattr(req, "inflight_depth", None),
         )
         req._telemetry = self
         req._tmeta = meta
 
     def record(self, meta: dict, duration_ns: int, retcode,
                error_context: Optional[dict] = None,
-               amend: bool = False) -> None:
+               amend: bool = False, overlap_ns=None,
+               inflight_depth=None) -> None:
         """Append one CallRecord + metrics.  ``amend=True`` re-records a
         call whose retcode changed AFTER completion (a deferred-result
         adoption failure downgrading OK): the corrected record is
@@ -431,6 +451,7 @@ class Telemetry:
             meta["nbytes"], bucket, meta["algorithm"], plan_hit,
             meta["eager"], duration_ns, code, code_name,
             time.perf_counter_ns(), attempts, ctx.get("peer"),
+            overlap_ns, inflight_depth,
         )
         self.recorder.append(rec)
         if amend:
@@ -441,7 +462,7 @@ class Telemetry:
             return
         self.metrics.record_call(
             op, bucket if bucket is not None else 0, duration_ns,
-            code, code_name, plan_hit, attempts,
+            code, code_name, plan_hit, attempts, overlap_ns,
         )
 
     # -- views ---------------------------------------------------------------

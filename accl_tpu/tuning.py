@@ -333,6 +333,7 @@ def _candidates(
     include_pallas: bool,
     eager_candidates: Sequence[int],
     segments: Sequence[int],
+    pipeline_thresholds: Sequence[int] = (),
 ) -> List[Dict[str, object]]:
     """Tier-appropriate register sets to race for one collective.  The
     empty dict (the defaults) is always candidate 0 — a plan can only
@@ -356,6 +357,17 @@ def _candidates(
             cands += [
                 {f"{op}_algorithm": "pallas_ring", "ring_segments": int(s)}
                 for s in segments
+            ]
+        if op in ("allreduce", "bcast"):
+            # overlap plane axes: host-level segmented pipelining —
+            # threshold x segment count (the split only fires above the
+            # threshold, so small sizes race it as a no-op and the
+            # hysteresis margin keeps the defaults)
+            cands += [
+                {"pipeline_threshold": int(t), "ring_segments": int(s)}
+                for t in pipeline_thresholds
+                for s in segments
+                if int(s) > 1
             ]
     elif tier in ("emulator", "native"):
         if op == "bcast":
@@ -406,6 +418,7 @@ def autotune(
     include_pallas: bool = False,
     eager_candidates: Sequence[int] = (),
     segments: Sequence[int] = (1, 2, 4),
+    pipeline_thresholds: Sequence[int] = (),
     margin: float = 0.10,
     log=None,
 ) -> TuningPlan:
@@ -439,7 +452,7 @@ def autotune(
                 measured: List[tuple] = []
                 for regs in _candidates(
                     tier, op, world, include_pallas, eager_candidates,
-                    segments,
+                    segments, pipeline_thresholds,
                 ):
                     try:
                         # the register writes are part of the candidate:
@@ -492,6 +505,7 @@ def autotune(
         "include_pallas": bool(include_pallas),
         "eager_candidates": [int(e) for e in eager_candidates],
         "segments": [int(s) for s in segments],
+        "pipeline_thresholds": [int(t) for t in pipeline_thresholds],
         "margin": float(margin),
     }
     try:
@@ -545,6 +559,12 @@ def main(argv=None) -> int:
                     help="max_eager_size candidates (bytes) to race")
     ap.add_argument("--segments", nargs="*", type=int, default=[1, 2, 4])
     ap.add_argument(
+        "--pipeline-thresholds", nargs="*", type=int, default=[],
+        help="pipeline_threshold candidates (bytes) to race against the "
+             "segment counts — the overlap plane's host-level segmented "
+             "pipelining axes (e.g. 65536 262144)",
+    )
+    ap.add_argument(
         "--margin", type=float, default=0.10,
         help="a non-default candidate must beat the defaults by this "
              "fraction to win its bucket (noise hysteresis)",
@@ -592,6 +612,7 @@ def main(argv=None) -> int:
             include_pallas=args.include_pallas,
             eager_candidates=args.eager,
             segments=args.segments,
+            pipeline_thresholds=args.pipeline_thresholds,
             margin=args.margin,
             log=lambda msg: print(msg, file=sys.stderr),
         )

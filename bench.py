@@ -355,6 +355,48 @@ def _bench_train_mfu(
     return out
 
 
+# measured HBM need of the T=4096 blockwise train step's compile (the
+# per-q-block backward residuals dominate; 17.91 GiB on v5e, diagnosed
+# 2026-08-01 — BENCH_r05's classified OOM).  The residual footprint
+# scales ~quadratically in seq at fixed tokens/step.
+_BLOCKWISE_T4096_NEED_BYTES = int(17.91 * (1 << 30))
+
+
+def _blockwise_t4096_oom_skip():
+    """Pre-flight for the known HBM-OOM configuration: a structured
+    ``skipped`` record (reason + the numbers behind it) when this host's
+    chips cannot compile the T=4096 blockwise step, else None (run it).
+    Unknown HBM sizes run the bench — a wrong guess there degrades to
+    the classified-OOM error path, never a silent skip."""
+    import jax
+
+    limit = None
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+        limit = (stats or {}).get("bytes_limit")
+    except Exception:
+        limit = None
+    if limit is None:
+        # memory_stats absent on some runtimes: fall back to the known
+        # 16 GiB-class device kinds the OOM was diagnosed on
+        kind = jax.devices()[0].device_kind.lower()
+        if any(k in kind for k in ("v5 lite", "v5e", "v6 lite", "v6e")):
+            limit = 16 * (1 << 30)
+    if limit is not None and _BLOCKWISE_T4096_NEED_BYTES > limit:
+        return {
+            "reason": (
+                "blockwise attention at T=4096 needs "
+                f"~{_BLOCKWISE_T4096_NEED_BYTES / (1 << 30):.2f} GiB of "
+                f"HBM at compile; this chip exposes "
+                f"{limit / (1 << 30):.2f} GiB (the BENCH_r05 classified "
+                "OOM, now detected up front)"
+            ),
+            "needed_bytes": _BLOCKWISE_T4096_NEED_BYTES,
+            "hbm_bytes_limit": int(limit),
+        }
+    return None
+
+
 def _bench_decode_throughput() -> dict:
     """Serving-side number: greedy KV-cache decode tokens/sec on the
     flagship model, summed over ALL local devices (dp-sharded, global
@@ -608,7 +650,17 @@ def _bench_gang_device_time() -> dict:
     so ``2 * (wall(2n) - wall(n))`` estimates the device time at ``2n``
     and the remainder is the dispatch floor.  The estimate is clamped to
     ``[0, wall]`` — the artifact invariant (device <= wall) holds by
-    construction, noise only degrades precision."""
+    construction, noise only degrades precision.
+
+    Overlap plane (this PR): the dispatch floor that matters to a
+    workload is the SUSTAINED one — a back-to-back window of ``run_async``
+    calls riding the engine's in-flight window, where each call's floor
+    amortizes behind its predecessors' device time.  The pipelined loop
+    measures that: ``gang_allreduce_dispatch_floor_us`` is now
+    ``pipelined_wall - device`` (the amortized floor), the serialized
+    per-call wall stays as ``gang_allreduce_wall_us``, and
+    ``gang_inflight_overlap_pct`` = how much of the serial wall the
+    window hides.  Gated by ``parse_results.check_overlap``."""
     from accl_tpu.core import xla_group
 
     n = _size(4 * 1024 * 1024)
@@ -620,7 +672,7 @@ def _bench_gang_device_time() -> dict:
     try:
         a = g[0]
 
-        def timed(count):
+        def timed(count, pipelined=False):
             # one DISTINCT send buffer per call (anti execution-cache,
             # see _bench_facade_overhead), staged from ONE host array
             # and BARRIERED before the timed window — create_buffer_from
@@ -647,19 +699,42 @@ def _bench_gang_device_time() -> dict:
                     arr.block_until_ready()
 
             drain()
-            with Timer() as t:
-                for it in range(iters):
-                    a.allreduce(sends[it], d, count)
-                drain()
+            if pipelined:
+                # the back-to-back window: launches run ahead of
+                # completion up to the in-flight depth; the wait+drain
+                # at the end closes the last calls' tails
+                with Timer() as t:
+                    reqs = [
+                        a.allreduce(sends[it], d, count, run_async=True)
+                        for it in range(iters)
+                    ]
+                    for r in reqs:
+                        r.wait(120)
+                    drain()
+                for r in reqs:
+                    r.check()
+            else:
+                with Timer() as t:
+                    for it in range(iters):
+                        a.allreduce(sends[it], d, count)
+                    drain()
             return t.elapsed_ns() / iters / 1e3
 
         w1 = timed(n)
         w2 = timed(2 * n)
         dev = min(max(2.0 * (w2 - w1), 0.0), w2)
+        p2 = timed(2 * n, pipelined=True)
+        floor = min(max(p2 - dev, 0.0), p2)
+        overlap_pct = max(0.0, (1.0 - p2 / w2) * 100.0) if w2 > 0 else 0.0
+        inflight = (a.engine.telemetry_report().get("inflight") or {})
         return {
             "gang_allreduce_wall_us": round(w2, 1),
             "gang_allreduce_device_us": round(dev, 1),
-            "gang_allreduce_dispatch_floor_us": round(w2 - dev, 1),
+            "gang_allreduce_pipelined_wall_us": round(p2, 1),
+            "gang_allreduce_dispatch_floor_us": round(floor, 1),
+            "gang_inflight_overlap_pct": round(overlap_pct, 1),
+            "gang_inflight_window_depth": inflight.get("depth"),
+            "gang_inflight_max_depth_seen": inflight.get("max_depth_seen"),
         }
     finally:
         for x in g:
@@ -1047,8 +1122,11 @@ def _save_lkg(result: dict) -> None:
     never let a CPU/smoke run clobber a real chip capture."""
     if result.get("value") is None or result.get("provenance"):
         return
-    if (result.get("errors") or {}).get("facade_arch_regression"):
+    gate_errors = result.get("errors") or {}
+    if gate_errors.get("facade_arch_regression"):
         return  # a regressed arch capture must never become the new LKG
+    if gate_errors.get("overlap_gate"):
+        return  # nor one whose overlap evidence failed its gate
     if _SMALL or "tpu" not in str(result.get("device", "")).lower():
         return
     import datetime
@@ -1543,10 +1621,29 @@ def main() -> None:
                 extras, errors, "train_mfu_t4096",
                 lambda: _bench_train_mfu(seq=4096),
             )
-            _try(
-                extras, errors, "train_mfu_t4096_blockwise",
-                lambda: _bench_train_mfu(seq=4096, attention="blockwise"),
-            )
+            # bench hygiene: the T=4096 blockwise step's compile needs
+            # ~17.9 GiB of HBM (per-q-block backward residuals; measured
+            # 2026-08-01) and OOMs on 16 GiB-class chips — detect the
+            # configuration up front and record a STRUCTURED skip instead
+            # of polluting `errors` with an HTTP-500 compile failure in
+            # every capture
+            skip = _blockwise_t4096_oom_skip()
+            if skip is not None:
+                extras.setdefault("skipped", {})[
+                    "train_mfu_t4096_blockwise"
+                ] = skip
+                print(
+                    "bench train_mfu_t4096_blockwise SKIPPED: "
+                    f"{skip['reason']}",
+                    file=sys.stderr,
+                )
+            else:
+                _try(
+                    extras, errors, "train_mfu_t4096_blockwise",
+                    lambda: _bench_train_mfu(
+                        seq=4096, attention="blockwise"
+                    ),
+                )
             # 8K-context record: auto->flash exactly fills the VMEM
             # gate (K+V = 4 MiB at D=128 bf16); batch=1 keeps
             # tokens/step at the same 8K as every other seq point
@@ -1566,8 +1663,10 @@ def main() -> None:
         # NameError from the gate's except clause below
         from benchmarks.parse_results import (
             ArchOverheadRegressionError,
+            OverlapGateError,
             TelemetryGateError,
             check_arch_overhead,
+            check_overlap,
             check_telemetry,
         )
     except ImportError:  # pragma: no cover - repo layout changed
@@ -1586,6 +1685,13 @@ def main() -> None:
                 check_telemetry(extras)
             except TelemetryGateError as e:
                 errors["telemetry_gate"] = str(e)
+        # overlap evidence gate: a gang dispatch-floor number must ship
+        # with its gang_inflight_overlap_pct, and the pipelined floor
+        # must not regress >10% vs the LKG (the in-flight window's win)
+        try:
+            check_overlap(extras, lkg_gate.get("result") or {})
+        except OverlapGateError as e:
+            errors["overlap_gate"] = str(e)
 
     _sanitize_extras(extras, errors)
     result = _headline(extras)

@@ -179,7 +179,23 @@ def main() -> int:
             fault["error"] = type(e).__name__
             fault["details"] = getattr(e, "details", {})
         fault["fail_seconds"] = round(time.monotonic() - t_f, 2)
+        # overlap plane: issue a burst of in-flight collectives and
+        # soft-reset behind them — soft_reset's drain point must leave
+        # the window FULLY empty (every request completed) before the
+        # engine state is abandoned
+        burst_s = a.create_buffer_from(np.ones(256, np.float32))
+        burst_d = a.create_buffer(256, np.float32)
+        burst = [
+            a.allreduce(burst_s, burst_d, 256, run_async=True)
+            for _ in range(6)
+        ]
         a.soft_reset()
+        fault["window_drained"] = bool(
+            all(r.done() for r in burst)
+            and (a.engine.telemetry_report().get("inflight") or {}).get(
+                "in_flight", -1
+            ) == 0
+        )
         a.set_timeout(180.0)
         rs = a.create_buffer_from(np.ones(64, np.float32))
         rd = a.create_buffer(64, np.float32)
@@ -204,6 +220,9 @@ def main() -> int:
             "interactions_per_op": round(di / max(ops, 1), 2),
             "device": jax.devices()[0].device_kind,
             "fault_recovery": fault,
+            # overlap plane: lifetime window counters (launched/
+            # completed must match for a leak-free run)
+            "inflight": a.engine.telemetry_report().get("inflight"),
             "telemetry": [tele_soak, tele_fault],
         }))
         ok = (
@@ -211,6 +230,7 @@ def main() -> int:
             and fault["injected"] == 1
             and fault["recovered"]
             and fault["rx_leaks"] == []
+            and fault.get("window_drained", False)
             and tele_soak["ok"]
             and tele_fault["ok"]
         )

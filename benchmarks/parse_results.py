@@ -173,6 +173,78 @@ def check_telemetry_capture(bench_path: str) -> None:
     check_telemetry((result or {}).get("extras") or {})
 
 
+# Overlap gate (overlap-plane PR): the gang bench's dispatch floor is
+# now measured from the BACK-TO-BACK pipelined loop (N collectives in
+# flight through the window), so a capture that carries the floor
+# without the overlap evidence — or whose floor regressed past this
+# tolerance vs the last-known-good — is refused the same way a poisoned
+# arch-overhead capture is.
+OVERLAP_REGRESSION_TOLERANCE = float(
+    os.environ.get("ACCL_OVERLAP_REGRESSION_TOLERANCE", "1.10")
+)
+
+
+class OverlapGateError(ValueError):
+    """The capture's overlap evidence is missing (a gang dispatch-floor
+    number with no ``gang_inflight_overlap_pct`` next to it) or the
+    pipelined dispatch floor regressed beyond tolerance vs the LKG —
+    the in-flight window stopped overlapping; fix the engine instead of
+    committing the slower capture."""
+
+
+def check_overlap(extras: dict, lkg_result: dict,
+                  tolerance: float = None) -> None:
+    """Gate a capture's overlap-plane evidence.  No-op when the gang
+    benches never ran (wedged/CPU captures carry neither key); refuses
+    a floor without its overlap metric, and a >tolerance floor
+    regression vs the last-known-good."""
+    tol = OVERLAP_REGRESSION_TOLERANCE if tolerance is None else tolerance
+    extras = extras or {}
+    floor = extras.get("gang_allreduce_dispatch_floor_us")
+    pct = extras.get("gang_inflight_overlap_pct")
+    if floor is None and pct is None:
+        return  # gang benches never ran: nothing to gate
+    if pct is None:
+        raise OverlapGateError(
+            "capture carries gang_allreduce_dispatch_floor_us without "
+            "gang_inflight_overlap_pct — the back-to-back overlap bench "
+            "did not run; the floor number is unverifiable"
+        )
+    base = ((lkg_result or {}).get("extras") or {}).get(
+        "gang_allreduce_dispatch_floor_us"
+    )
+    if floor is None or base is None or base <= 0:
+        return
+    if floor > tol * base:
+        raise OverlapGateError(
+            f"gang_allreduce_dispatch_floor_us {floor:.1f} us regressed "
+            f"beyond {tol:.2f}x the last-known-good {base:.1f} us — the "
+            "in-flight window stopped amortizing the per-call dispatch "
+            "floor (launches serializing again?); refusing the capture"
+        )
+
+
+def check_overlap_capture(bench_path: str, lkg_path: str = None) -> None:
+    """CLI form (``--check-overlap BENCH_rNN.json``)."""
+    import json
+
+    with open(bench_path) as f:
+        doc = json.load(f)
+    result = doc.get("parsed") or doc.get("result") or doc
+    lkg_path = lkg_path or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".bench_lkg.json",
+    )
+    try:
+        with open(lkg_path) as f:
+            lkg = json.load(f)
+    except (OSError, ValueError):
+        lkg = {}
+    check_overlap(
+        (result or {}).get("extras") or {}, lkg.get("result") or {}
+    )
+
+
 # Autotuned-plan refusal: a TuningPlan only ever *overrides* registers
 # where a candidate measured faster than the defaults, so a tuned sweep
 # should never be meaningfully slower than the default sweep at any
@@ -369,6 +441,14 @@ def main(argv=None) -> str:
         print(
             f"{argv[i + 1]}: telemetry snapshot complete, overhead within "
             f"{TELEMETRY_OVERHEAD_TOLERANCE_PCT:.1f}%"
+        )
+        return ""
+    if "--check-overlap" in argv:
+        i = argv.index("--check-overlap")
+        check_overlap_capture(argv[i + 1])
+        print(
+            f"{argv[i + 1]}: overlap evidence present, dispatch floor "
+            f"within {OVERLAP_REGRESSION_TOLERANCE:.2f}x of LKG"
         )
         return ""
     if "--check-tuned" in argv:

@@ -56,9 +56,12 @@ try:
     from parse_results import (  # running as a script: sibling import
         ARCH_REGRESSION_TOLERANCE,
         ArchOverheadRegressionError,
+        OVERLAP_REGRESSION_TOLERANCE,
+        OverlapGateError,
         TelemetryGateError,
         TunedPlanRegressionError,
         check_arch_overhead,
+        check_overlap,
         check_telemetry,
         check_tuned_not_slower,
     )
@@ -66,9 +69,12 @@ except ImportError:  # pragma: no cover - running as a package module
     from benchmarks.parse_results import (  # noqa: F401
         ARCH_REGRESSION_TOLERANCE,
         ArchOverheadRegressionError,
+        OVERLAP_REGRESSION_TOLERANCE,
+        OverlapGateError,
         TelemetryGateError,
         TunedPlanRegressionError,
         check_arch_overhead,
+        check_overlap,
         check_telemetry,
         check_tuned_not_slower,
     )

@@ -400,3 +400,30 @@ def test_check_tuned_not_slower(tmp_path):
 
     with pytest.raises(TunedPlanRegressionError):
         via_sweep(default, tuned)
+
+
+def test_plan_pipeline_verdict():
+    """The overlap plane's segmented-pipelining verdict is cached on the
+    plan: payloads above the threshold split into the cached segment
+    count, everything else (below threshold, disabled registers) is 1."""
+    p = CollectivePlan(
+        ("k",), arithcfg=None, compression=0, wire_dtype=None,
+        bucket=10, eager=False, algorithm="xla",
+        pipeline_threshold=4096, pipeline_segments=4,
+    )
+    assert p.pipeline_for(4096) == 1      # at threshold: no split
+    assert p.pipeline_for(4097) == 4      # above: the cached count
+    assert p.describe()["pipeline_threshold"] == 4096
+    assert p.describe()["pipeline_segments"] == 4
+    # disabled registers (the defaults) never split
+    off = CollectivePlan(
+        ("k2",), arithcfg=None, compression=0, wire_dtype=None,
+        bucket=10, eager=False, algorithm="xla",
+    )
+    assert off.pipeline_for(1 << 30) == 1
+    one_seg = CollectivePlan(
+        ("k3",), arithcfg=None, compression=0, wire_dtype=None,
+        bucket=10, eager=False, algorithm="xla",
+        pipeline_threshold=4096, pipeline_segments=1,
+    )
+    assert one_seg.pipeline_for(1 << 30) == 1
