@@ -1,0 +1,93 @@
+"""acclint: the project-invariant static analyzer + lock-order detector.
+
+Usage::
+
+    python -m accl_tpu.analysis            # analyze the package, report
+    python -m accl_tpu.analysis --check    # quiet gate mode (CI / bench)
+    python -m accl_tpu.analysis --json     # machine-readable findings
+
+    from accl_tpu.analysis import run_checks
+    findings = [f for f in run_checks() if not f.suppressed]
+
+Checks (each individually suppressible with
+``# acclint: allow[<check>] <reason>``):
+
+========================  ==================================================
+unbounded-wait            blocking acquire/wait/join/get without a timeout
+jax-free-module           overlap/telemetry/faults/plans/constants must
+                          import without jax/numpy at module scope
+timer-discipline          no time.time() windows; use utils.timing
+spmd-uniformity           @spmd_uniform functions must not branch on
+                          process-local state
+drain-before-config       config writes / soft_reset reach a drain call
+error-context             raised ACCLError carries structured details
+========================  ==================================================
+
+The dynamic lock-order registry (``accl_tpu.analysis.lockorder``) is
+the runtime companion: ``ACCL_LOCKCHECK=1`` wraps project locks and
+fails the test session on lock-order cycles or unreviewed edges vs the
+committed ``tests/lock_hierarchy.json``.
+
+Zero dependencies beyond the stdlib; importing this package must never
+pull jax/numpy (it runs in CI shells and jax-free rank processes).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional
+
+from .astchecks import PER_FILE_CHECKS
+from .base import Finding, iter_source_files, load_source, package_root
+from .graph import CROSS_FILE_CHECKS
+from .markers import spmd_uniform  # noqa: F401  (re-export)
+
+__all__ = [
+    "Finding",
+    "CHECKS",
+    "run_checks",
+    "spmd_uniform",
+    "package_root",
+]
+
+#: every named check, in report order
+CHECKS = tuple(PER_FILE_CHECKS) + tuple(CROSS_FILE_CHECKS)
+
+
+def run_checks(
+    paths: Optional[Iterable[str]] = None,
+    checks: Optional[Iterable[str]] = None,
+) -> List[Finding]:
+    """Run the named ``checks`` (default: all) over ``paths`` (default:
+    the accl_tpu package).  Returns EVERY finding, suppressed ones
+    included — gate callers filter on ``not f.suppressed``."""
+    selected = set(checks) if checks is not None else set(CHECKS)
+    unknown = selected - set(CHECKS)
+    if unknown:
+        raise ValueError(
+            f"unknown checks: {sorted(unknown)} (known: {sorted(CHECKS)})"
+        )
+    findings: List[Finding] = []
+    sources = []
+    for path in iter_source_files(paths):
+        src, parse_finding = load_source(path)
+        if parse_finding is not None:
+            findings.append(parse_finding)
+            continue
+        sources.append(src)
+        for line in src.bad_suppressions:
+            findings.append(Finding(
+                check="suppression-syntax", path=src.path, line=line,
+                message="acclint suppression without a reason does not "
+                        "apply; write '# acclint: allow[check] <why>'",
+            ))
+    for name, fn in PER_FILE_CHECKS.items():
+        if name not in selected:
+            continue
+        for src in sources:
+            findings.extend(fn(src))
+    for name, fn in CROSS_FILE_CHECKS.items():
+        if name not in selected:
+            continue
+        findings.extend(fn(sources))
+    findings.sort(key=lambda f: (f.path, f.line, f.check))
+    return findings

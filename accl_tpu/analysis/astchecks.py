@@ -1,0 +1,344 @@
+"""acclint per-file AST checks.
+
+Each check is a function ``(SourceFile) -> list[Finding]`` registered in
+``PER_FILE_CHECKS``; the cross-file checks (import graph, drain paths)
+live in :mod:`accl_tpu.analysis.graph`.
+
+The checks encode invariants this project has paid review tax for at
+least once each:
+
+* **unbounded-wait** — PR 5's review pass hand-hunted waits with no
+  deadline across five drain points; a blocking primitive without a
+  timeout turns any wedged peer/device into a wedged host thread, and
+  the facade's deadlock detector can only fire if every layer below it
+  stays bounded.
+* **timer-discipline** — PR 4's audit removed every ``time.time()``
+  duration window (wall clocks step under NTP; benches and watchdogs
+  must use the monotonic clocks in ``utils.timing``).
+* **error-context** — PR 2 introduced structured ``ACCLError.details``;
+  a bare ACCLError loses the op/comm/peer facts that make chaos-plane
+  failures diagnosable without a live session.
+* **spmd-uniformity** — the bug class PR 1's batch-fusion guard dodged:
+  inside a function marked ``@spmd_uniform`` (it runs identically on
+  every rank of an SPMD program stream), branching on process-local
+  state (rank, buffer identity/aliasing, health maps) desynchronizes
+  the ranks' program streams and wedges the mesh.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .base import Finding, SourceFile
+
+__all__ = [
+    "PER_FILE_CHECKS",
+    "check_unbounded_wait",
+    "check_timer_discipline",
+    "check_error_context",
+    "check_spmd_uniformity",
+]
+
+
+# ---------------------------------------------------------------------------
+# unbounded-wait
+# ---------------------------------------------------------------------------
+
+#: blocking attribute-calls that accept a deadline and run forever
+#: without one: Lock/RLock/Semaphore.acquire, Event/Condition.wait,
+#: Condition.wait_for, Thread/Process.join, queue.Queue.get
+_BLOCKING_ATTRS = ("acquire", "wait", "wait_for", "join", "get")
+
+
+def _is_unbounded_timeout(node: ast.AST, negative_blocks: bool) -> bool:
+    """Is this timeout VALUE a block-forever spelling?  ``None`` always
+    is; for ``Lock/RLock.acquire`` a negative number (-1, the default)
+    also means wait forever (``negative_blocks``), while the other
+    primitives raise or return immediately on negatives."""
+    if isinstance(node, ast.Constant) and node.value is None:
+        return True
+    if not negative_blocks:
+        return False
+    if (
+        isinstance(node, ast.UnaryOp)
+        and isinstance(node.op, ast.USub)
+        and isinstance(node.operand, ast.Constant)
+        and isinstance(node.operand.value, (int, float))
+    ):
+        return True  # literal -N
+    return False
+
+
+def _has_timeout(call: ast.Call, attr: str) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "timeout":
+            return not _is_unbounded_timeout(
+                kw.value, negative_blocks=(attr == "acquire")
+            )
+    return False
+
+
+def _is_none(node: ast.AST) -> bool:
+    return isinstance(node, ast.Constant) and node.value is None
+
+
+def check_unbounded_wait(src: SourceFile) -> List[Finding]:
+    out: List[Finding] = []
+    for node in src.nodes:
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+        ):
+            continue
+        attr = node.func.attr
+        if attr not in _BLOCKING_ATTRS:
+            continue
+        if _has_timeout(node, attr):
+            continue
+        pos = [a for a in node.args if not isinstance(a, ast.Starred)]
+        none_timeout_kw = any(
+            kw.arg == "timeout" and _is_unbounded_timeout(
+                kw.value, negative_blocks=(attr == "acquire")
+            )
+            for kw in node.keywords
+        )
+        flag = False
+        if attr in ("wait", "join", "get"):
+            # zero args (or an explicit None timeout) blocks forever;
+            # one non-None positional is a timeout — or a str.join /
+            # dict.get operand, which is not a blocking call at all
+            flag = (
+                (not pos and not node.keywords)
+                or (len(pos) == 1 and _is_none(pos[0]))
+                or none_timeout_kw
+            )
+            if attr == "get":
+                if node.keywords and not none_timeout_kw:
+                    flag = False  # dict.get(k, default=...)-style
+                # ...but the BLOCKING queue forms must still flag:
+                # get(True) / get(block=True) with no timeout
+                if (
+                    len(pos) == 1
+                    and isinstance(pos[0], ast.Constant)
+                    and pos[0].value is True
+                ) or any(
+                    kw.arg == "block"
+                    and not (
+                        isinstance(kw.value, ast.Constant)
+                        and kw.value.value is False
+                    )
+                    for kw in node.keywords
+                ):
+                    flag = True
+        elif attr == "wait_for":
+            # Condition.wait_for(predicate) with no timeout
+            flag = len(pos) == 1
+        elif attr == "acquire":
+            # acquire() / acquire(True) / timeout=None block forever;
+            # acquire(False) and blocking=False are non-blocking probes
+            blocking_false = (
+                pos
+                and isinstance(pos[0], ast.Constant)
+                and pos[0].value is False
+            ) or any(
+                kw.arg == "blocking"
+                and isinstance(kw.value, ast.Constant)
+                and kw.value.value is False
+                for kw in node.keywords
+            )
+            if blocking_false:
+                flag = False
+            elif none_timeout_kw:
+                flag = True
+            elif not pos and not node.keywords:
+                flag = True
+            elif (
+                len(pos) == 1
+                and isinstance(pos[0], ast.Constant)
+                and pos[0].value is True
+            ):
+                flag = True
+            elif len(pos) == 2 and _is_unbounded_timeout(
+                pos[1], negative_blocks=True
+            ):
+                flag = True  # acquire(True, -1) / acquire(True, None)
+            else:
+                flag = any(kw.arg == "blocking" for kw in node.keywords)
+        if flag:
+            # anchor on the attribute access itself: in a multi-line
+            # chained call the `.wait()` line is where the suppression
+            # naturally sits, not the chain's first line
+            anchor = getattr(node.func, "end_lineno", None) or node.lineno
+            out.append(src.finding(
+                "unbounded-wait", anchor,
+                f".{attr}() without a timeout can block forever; pass a "
+                f"deadline (see overlap.drain_deadline_s) or suppress "
+                f"with the audited reason",
+            ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# timer-discipline
+# ---------------------------------------------------------------------------
+
+
+def check_timer_discipline(src: SourceFile) -> List[Finding]:
+    """Ban ``time.time()`` (and ``from time import time``): wall clocks
+    step; every duration window must use ``utils.timing`` /
+    ``time.monotonic`` / ``time.perf_counter_ns``."""
+    out: List[Finding] = []
+    fn_aliases = set()      # names bound to the time.time FUNCTION
+    mod_aliases = {"time"}  # names bound to the time MODULE (any alias)
+    for node in src.nodes:
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            for alias in node.names:
+                if alias.name == "time":
+                    fn_aliases.add(alias.asname or "time")
+                    out.append(src.finding(
+                        "timer-discipline", node,
+                        "'from time import time' imports the wall clock; "
+                        "use utils.timing.Timer or time.monotonic / "
+                        "time.perf_counter_ns",
+                    ))
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "time":
+                    mod_aliases.add(alias.asname or "time")
+    for node in src.nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        wall = (
+            isinstance(f, ast.Attribute)
+            and f.attr == "time"
+            and isinstance(f.value, ast.Name)
+            and f.value.id in mod_aliases
+        ) or (
+            isinstance(f, ast.Name) and f.id in fn_aliases
+        )
+        if wall:
+            out.append(src.finding(
+                "timer-discipline", node,
+                "time.time() is a wall clock (steps under NTP); use "
+                "utils.timing.Timer / time.monotonic / perf_counter_ns "
+                "for windows, or suppress for genuine wall timestamps",
+            ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# error-context
+# ---------------------------------------------------------------------------
+
+
+def check_error_context(src: SourceFile) -> List[Finding]:
+    """Every constructed ACCLError must carry structured ``details``
+    (PR 2's failure model: op/comm/peer/attempts, PR 4's flight-recorder
+    tail all ride there — a bare message is not diagnosable)."""
+    out: List[Finding] = []
+    for node in src.nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = (
+            f.id if isinstance(f, ast.Name)
+            else f.attr if isinstance(f, ast.Attribute) else None
+        )
+        if name != "ACCLError":
+            continue
+        if len(node.args) >= 3:
+            continue  # positional details
+        if any(kw.arg == "details" for kw in node.keywords):
+            continue
+        out.append(src.finding(
+            "error-context", node,
+            "ACCLError without details=: attach the structured context "
+            "(op/comm/peer/...) that makes the failure diagnosable",
+        ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# spmd-uniformity
+# ---------------------------------------------------------------------------
+
+#: terminal identifiers that are process-local by construction: branch
+#: on them inside an @spmd_uniform function and the ranks' program
+#: streams diverge
+_SPMD_LOCAL_NAMES = frozenset((
+    "rank", "local_rank", "world_rank",
+    "is_dummy", "is_host_only",  # buffer identity (DummyBuffer on
+    # non-roots, host staging): PR 1's fusion-guard bug class
+))
+_SPMD_LOCAL_SUBSTR = ("health",)
+
+
+def _marked_spmd(fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", ()):
+        d = dec.func if isinstance(dec, ast.Call) else dec
+        name = (
+            d.id if isinstance(d, ast.Name)
+            else d.attr if isinstance(d, ast.Attribute) else None
+        )
+        if name == "spmd_uniform":
+            return True
+    return False
+
+
+def _local_state_refs(test: ast.AST) -> List[str]:
+    refs: List[str] = []
+    for sub in ast.walk(test):
+        term = None
+        if isinstance(sub, ast.Attribute):
+            term = sub.attr
+        elif isinstance(sub, ast.Name):
+            term = sub.id
+        elif isinstance(sub, ast.Call):
+            f = sub.func
+            if isinstance(f, ast.Name) and f.id == "id":
+                refs.append("id()")  # object identity is per-process
+            continue
+        if term is None:
+            continue
+        if term in _SPMD_LOCAL_NAMES or any(
+            s in term.lower() for s in _SPMD_LOCAL_SUBSTR
+        ):
+            refs.append(term)
+    return refs
+
+
+def check_spmd_uniformity(src: SourceFile) -> List[Finding]:
+    out: List[Finding] = []
+    for fn in ast.walk(src.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not _marked_spmd(fn):
+            continue
+        for node in ast.walk(fn):
+            test = None
+            if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                test = node.test
+            elif isinstance(node, ast.Assert):
+                test = node.test
+            if test is None:
+                continue
+            refs = _local_state_refs(test)
+            if refs:
+                out.append(src.finding(
+                    "spmd-uniformity", node,
+                    f"@spmd_uniform function {fn.name!r} branches on "
+                    f"process-local state ({', '.join(sorted(set(refs)))}); "
+                    f"divergent branches desynchronize the ranks' program "
+                    f"streams",
+                ))
+    return out
+
+
+PER_FILE_CHECKS = {
+    "unbounded-wait": check_unbounded_wait,
+    "timer-discipline": check_timer_discipline,
+    "error-context": check_error_context,
+    "spmd-uniformity": check_spmd_uniformity,
+}

@@ -1,0 +1,329 @@
+"""acclint cross-file checks: the import graph and drain-path checks.
+
+* **jax-free-module** — the modules the overlap/telemetry/chaos planes
+  promise are importable from jax-free processes (``overlap``,
+  ``telemetry``, ``faults``, ``plans``, ``constants``) must not import
+  jax/numpy at module scope, directly OR through anything they import
+  at module scope.  A socket-fabric rank process, the telemetry merge
+  CLI, and the lock-order shim all rely on this staying true.
+* **drain-before-config** — every config-write path (a function that
+  constructs an ``Operation.CONFIG`` call) and every ``soft_reset``
+  must reach a drain call before abandoning/overwriting engine state:
+  a config write that overtakes in-flight work observes (and corrupts)
+  a state snapshot mid-collective.  The check walks the intra-module
+  call graph from each entry point looking for a drain-family call.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set
+
+from .base import Finding, SourceFile, load_source, package_root
+
+__all__ = [
+    "CROSS_FILE_CHECKS",
+    "check_jax_free_modules",
+    "check_drain_before_config",
+    "JAX_FREE_MODULES",
+    "FORBIDDEN_HEAVY_IMPORTS",
+]
+
+#: accl_tpu modules that must stay importable without jax/numpy
+JAX_FREE_MODULES = (
+    "accl_tpu.overlap",
+    "accl_tpu.telemetry",
+    "accl_tpu.faults",
+    "accl_tpu.plans",
+    "accl_tpu.constants",
+)
+
+#: top-level packages whose module-scope import breaks jax-freedom
+#: (ml_dtypes transitively imports numpy)
+FORBIDDEN_HEAVY_IMPORTS = frozenset((
+    "jax", "jaxlib", "numpy", "ml_dtypes",
+))
+
+
+def _module_name(path: str, root: str) -> Optional[str]:
+    """``accl_tpu.backends.base`` for ``<root>/backends/base.py`` where
+    root is the accl_tpu package dir; None for files outside it."""
+    rel = os.path.relpath(os.path.abspath(path), root)
+    if rel.startswith(".."):
+        return None
+    parts = rel.replace(os.sep, "/").split("/")
+    if parts[-1] == "__init__.py":
+        parts = parts[:-1]
+    else:
+        parts[-1] = parts[-1][:-3]  # strip .py
+    return ".".join(["accl_tpu"] + [p for p in parts if p])
+
+
+def _module_scope_imports(tree: ast.Module):
+    """(node, imported-module-name, level, from-aliases) for every
+    import that runs at import time: top-level statements plus those
+    nested in module-level ``if``/``try`` blocks (a ``try: import
+    ml_dtypes`` still executes).  ``from-aliases`` carries the names an
+    ImportFrom binds — ``from . import constants`` names a MODULE via
+    its alias, which the consumer must try as a module too."""
+    stack = list(tree.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                yield node, alias.name, 0, ()
+        elif isinstance(node, ast.ImportFrom):
+            yield node, node.module or "", node.level, tuple(
+                a.name for a in node.names
+            )
+        elif isinstance(node, (ast.If, ast.Try, ast.With, ast.For,
+                               ast.While)):
+            # all of these EXECUTE their bodies at import time when they
+            # sit at module level (`with suppress(ImportError): import
+            # numpy` is the sneaky one — the idiom the old constants.py
+            # try-block used, spelled via contextlib)
+            for field in ("body", "orelse", "finalbody", "handlers"):
+                for sub in getattr(node, field, ()):
+                    if isinstance(sub, ast.ExceptHandler):
+                        stack.extend(sub.body)
+                    else:
+                        stack.append(sub)
+        # FunctionDef/ClassDef bodies do NOT run at import time
+
+
+def _resolve_relative(mod: str, name: str, level: int, is_pkg: bool) -> str:
+    """Absolute module name for a (possibly relative) import found in
+    ``mod`` (e.g. level=1 name='constants' in accl_tpu.overlap ->
+    accl_tpu.constants)."""
+    if level == 0:
+        return name
+    parts = mod.split(".")
+    if not is_pkg:
+        parts = parts[:-1]
+    if level > 1:
+        parts = parts[: len(parts) - (level - 1)]
+    return ".".join(parts + ([name] if name else []))
+
+
+def check_jax_free_modules(sources: List[SourceFile]) -> List[Finding]:
+    root = package_root()
+    by_mod: Dict[str, SourceFile] = {}
+    for src in sources:
+        mod = _module_name(src.path, root)
+        if mod:
+            by_mod[mod] = src
+
+    findings: List[Finding] = []
+    if not by_mod:
+        # analyzing loose files outside the package (fixture snippets,
+        # a path override): the contract modules are out of scope
+        return findings
+
+    def _load_pkg_module(mod: str) -> Optional[SourceFile]:
+        """The import closure is a WHOLE-PACKAGE fact: when the
+        analyzer was pointed at a path subset, pull the missing
+        package modules from disk so per-file invocations (pre-commit,
+        editors) see the same verdict as the full run."""
+        rel = mod.split(".")[1:]
+        for cand in (
+            os.path.join(root, *rel) + ".py",
+            os.path.join(root, *rel, "__init__.py") if rel else None,
+        ):
+            if cand and os.path.isfile(cand):
+                src, _ = load_source(cand)
+                return src
+        return None
+
+    def _source_for(mod: str) -> Optional[SourceFile]:
+        src = by_mod.get(mod)
+        if src is None:
+            src = _load_pkg_module(mod)
+            if src is not None:
+                by_mod[mod] = src
+        return src
+
+    # module -> [(line-node, imported absolute module)] at module scope;
+    # ImportFrom aliases and ancestor subpackage __init__s are expanded
+    # (both execute at import time)
+    edge_cache: Dict[str, List] = {}
+
+    def _edges(mod: str, src: SourceFile) -> List:
+        outs = edge_cache.get(mod)
+        if outs is not None:
+            return outs
+        is_pkg = src.path.endswith("__init__.py")
+        outs = []
+        for node, name, level, aliases in _module_scope_imports(src.tree):
+            target = _resolve_relative(mod, name, level, is_pkg)
+            candidates = [target]
+            # 'from X import y': each alias may itself name a module
+            for a in aliases:
+                if a != "*":
+                    candidates.append(f"{target}.{a}" if target else a)
+            for t in candidates:
+                outs.append((node, t))
+                # importing accl_tpu.a.b also executes accl_tpu.a's
+                # __init__ (the top package's init is bypassed by the
+                # jax-free loaders, so it is deliberately excluded)
+                parts = t.split(".")
+                for i in range(2, len(parts)):
+                    outs.append((node, ".".join(parts[:i])))
+        edge_cache[mod] = outs
+        return outs
+
+    for entry in JAX_FREE_MODULES:
+        if _source_for(entry) is None:
+            findings.append(Finding(
+                check="jax-free-module", path=entry, line=1,
+                message=f"declared jax-free module {entry} not found in "
+                        f"the package",
+            ))
+            continue
+        # DFS over module-scope imports reachable from the entry module
+        seen: Set[str] = set()
+        reported: Set[tuple] = set()
+        stack = [entry]
+        while stack:
+            mod = stack.pop()
+            if mod in seen:
+                continue
+            seen.add(mod)
+            src = _source_for(mod)
+            if src is None:
+                continue
+            for node, target in _edges(mod, src):
+                top = target.split(".")[0]
+                if top in FORBIDDEN_HEAVY_IMPORTS:
+                    key = (src.path, node.lineno, top)
+                    if key in reported:
+                        continue
+                    reported.add(key)
+                    chain = f" (imported via {mod})" if mod != entry else ""
+                    findings.append(src.finding(
+                        "jax-free-module", node,
+                        f"module-scope import of {top!r} breaks the "
+                        f"jax-free contract of {entry}{chain}; import it "
+                        f"lazily inside the function that needs it",
+                    ))
+                elif top == "accl_tpu" and target != "accl_tpu":
+                    stack.append(target)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# drain-before-config
+# ---------------------------------------------------------------------------
+
+#: a call whose terminal attribute/name is one of these counts as
+#: reaching the drain machinery
+_DRAIN_NAMES = frozenset((
+    "flush", "drain", "drain_key", "drain_inflight",
+))
+
+
+def _is_config_call(node: ast.AST) -> bool:
+    """Is this node a CallOptions(op=Operation.CONFIG...) construction?"""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    name = (
+        f.id if isinstance(f, ast.Name)
+        else f.attr if isinstance(f, ast.Attribute) else None
+    )
+    if name != "CallOptions":
+        return False
+    for kw in node.keywords:
+        if kw.arg == "op" and isinstance(kw.value, ast.Attribute):
+            if (
+                kw.value.attr == "CONFIG"
+                and isinstance(kw.value.value, ast.Name)
+                and kw.value.value.id == "Operation"
+            ):
+                return True
+    return False
+
+
+def _called_names(fn: ast.AST) -> Set[str]:
+    """Terminal names of every call made in ``fn``'s body."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name):
+                out.add(f.id)
+            elif isinstance(f, ast.Attribute):
+                out.add(f.attr)
+    return out
+
+
+def check_drain_before_config(sources: List[SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in sources:
+        # function name -> ALL same-named AST nodes, module-wide (two
+        # classes in one module can both define soft_reset — every one
+        # is an entry point, and a callee name may resolve to any of
+        # them).  Use the shared flattened walk; call-name sets are
+        # memoized per node.
+        fns: Dict[str, List[ast.AST]] = {}
+        config_lines: List[int] = []
+        for node in src.nodes:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fns.setdefault(node.name, []).append(node)
+            elif _is_config_call(node):
+                config_lines.append(node.lineno)
+        called_cache: Dict[ast.AST, Set[str]] = {}
+
+        def _called(f):
+            got = called_cache.get(f)
+            if got is None:
+                got = called_cache[f] = _called_names(f)
+            return got
+
+        entries = [
+            (name, fn)
+            for name, nodes in fns.items()
+            for fn in nodes
+            if name == "soft_reset" or any(
+                fn.lineno <= ln <= getattr(fn, "end_lineno", fn.lineno)
+                for ln in config_lines
+            )
+        ]
+        for name, fn in entries:
+            # BFS through same-module callees (depth-limited) looking
+            # for a drain-family call; a called name fans out to EVERY
+            # same-named definition (static name resolution can't pick
+            # the class, so reachability is the union)
+            reached = False
+            seen: Set[int] = set()
+            frontier = [fn]
+            for _ in range(4):  # entry + 3 levels of same-module calls
+                nxt = []
+                for f in frontier:
+                    called = _called(f)
+                    if called & _DRAIN_NAMES:
+                        reached = True
+                        break
+                    for c in called:
+                        for cand in fns.get(c, ()):
+                            if id(cand) not in seen:
+                                seen.add(id(cand))
+                                nxt.append(cand)
+                if reached or not nxt:
+                    break
+                frontier = nxt
+            if not reached:
+                findings.append(src.finding(
+                    "drain-before-config", fn,
+                    f"{name!r} writes engine config / resets state but "
+                    f"never reaches a drain call "
+                    f"({', '.join(sorted(_DRAIN_NAMES))}); in-flight "
+                    f"work must complete before state is abandoned",
+                ))
+    return findings
+
+
+CROSS_FILE_CHECKS = {
+    "jax-free-module": check_jax_free_modules,
+    "drain-before-config": check_drain_before_config,
+}

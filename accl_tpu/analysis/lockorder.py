@@ -1,0 +1,389 @@
+"""acclint dynamic lock-order registry: a race detector for the locks
+the overlap plane introduced.
+
+The static checks prove waits are bounded; they cannot prove the locks
+are acquired in a consistent global order.  This shim can: with
+``ACCL_LOCKCHECK=1`` (the tier-1 pytest fixture in ``tests/conftest.py``)
+every ``threading.Lock``/``RLock`` **created by accl_tpu code** is
+wrapped in a recording proxy.  Each thread keeps a stack of locks it
+holds; acquiring B while holding A records the directed edge A -> B in
+a process-global graph, keyed by the lock's *family* (its owning class
+— InflightWindow, CommandQueue, PlanCache, Telemetry, ... — or its
+creation site for module-level locks).  After the run:
+
+* a **cycle** in the observed graph is a real lock-order inversion —
+  two threads can deadlock by acquiring the families in opposite
+  orders (the classic ABBA);
+* an edge **absent from the reviewed snapshot**
+  (``tests/lock_hierarchy.json``, committed after a soak +
+  mid-window-fault run) is a new cross-family interaction that must be
+  re-reviewed — regenerate with ``ACCL_LOCKCHECK_UPDATE=1`` after
+  auditing it;
+* an edge that, merged with the snapshot, **creates a cycle** is an
+  ordering violation against the committed hierarchy even if this
+  run's interleavings never produced the full cycle.
+
+Only locks allocated from accl_tpu source files are wrapped (the
+factory inspects its caller), so jax/XLA internals run untouched and
+the overhead is a dict push/pop per project-lock acquisition.
+
+Zero jax imports — the shim must be installable before any engine
+exists, including in jax-free socket-fabric rank processes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import weakref
+from typing import Dict, List, Optional, Set, Tuple
+
+__all__ = [
+    "LockOrderRegistry",
+    "InstrumentedLock",
+    "install",
+    "uninstall",
+    "active_registry",
+    "SNAPSHOT_ENV",
+]
+
+SNAPSHOT_ENV = "ACCL_LOCKCHECK_SNAPSHOT"
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class LockOrderRegistry:
+    """Per-thread held-lock stacks + the global family-edge graph."""
+
+    def __init__(self):
+        self._tls = threading.local()
+        self._glock = threading.Lock()  # guards the edge table only
+        # (family_a, family_b) -> first-observed witness description
+        self.edges: Dict[Tuple[str, str], str] = {}
+        self.acquisitions = 0
+
+    # -- proxy side ----------------------------------------------------------
+    def _held(self) -> List[str]:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = []
+            self._tls.held = held
+        return held
+
+    def on_acquire(self, family: str, site: str) -> None:
+        held = self._held()
+        with self._glock:
+            self.acquisitions += 1
+            if family not in held:
+                for h in held:
+                    if h != family and (h, family) not in self.edges:
+                        self.edges[(h, family)] = (
+                            f"{threading.current_thread().name}: "
+                            f"held {h} while acquiring {family} at {site}"
+                        )
+        held.append(family)
+
+    def on_release(self, family: str) -> None:
+        held = self._held()
+        # release order may not mirror acquire order; drop the most
+        # recent occurrence (RLocks release per-acquisition)
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == family:
+                del held[i]
+                return
+
+    # -- verdicts ------------------------------------------------------------
+    def family_edges(self) -> Set[Tuple[str, str]]:
+        with self._glock:
+            return set(self.edges)
+
+    @staticmethod
+    def _find_cycle(
+        edges: Set[Tuple[str, str]]
+    ) -> Optional[List[str]]:
+        """One cycle as a node list (closed), or None if the graph is a
+        DAG — iterative coloring DFS."""
+        adj: Dict[str, List[str]] = {}
+        for a, b in edges:
+            adj.setdefault(a, []).append(b)
+        WHITE, GRAY, BLACK = 0, 1, 2
+        color = {n: WHITE for n in adj}
+        for root in sorted(adj):
+            if color.get(root, WHITE) != WHITE:
+                continue
+            stack = [(root, iter(adj.get(root, ())))]
+            color[root] = GRAY
+            path = [root]
+            while stack:
+                node, it = stack[-1]
+                advanced = False
+                for nxt in it:
+                    c = color.get(nxt, WHITE)
+                    if c == GRAY:
+                        return path[path.index(nxt):] + [nxt]
+                    if c == WHITE:
+                        color[nxt] = GRAY
+                        path.append(nxt)
+                        stack.append((nxt, iter(adj.get(nxt, ()))))
+                        advanced = True
+                        break
+                if not advanced:
+                    color[node] = BLACK
+                    path.pop()
+                    stack.pop()
+        return None
+
+    def violations(
+        self, snapshot_edges: Optional[Set[Tuple[str, str]]] = None
+    ) -> List[str]:
+        """Human-readable problems: observed cycles, then (when a
+        snapshot is given) unreviewed new edges and merged-graph
+        ordering violations."""
+        problems: List[str] = []
+        observed = self.family_edges()
+        cycle = self._find_cycle(observed)
+        if cycle:
+            witnesses = [
+                self.edges.get((a, b), "")
+                for a, b in zip(cycle, cycle[1:])
+            ]
+            problems.append(
+                "lock-order cycle observed: " + " -> ".join(cycle)
+                + "".join(f"\n    {w}" for w in witnesses if w)
+            )
+        if snapshot_edges is not None:
+            new = observed - snapshot_edges
+            if new:
+                lines = [
+                    f"    {a} -> {b}: {self.edges.get((a, b), '')}"
+                    for a, b in sorted(new)
+                ]
+                problems.append(
+                    "lock-order edges not in the reviewed snapshot "
+                    "(audit, then regenerate with "
+                    "ACCL_LOCKCHECK_UPDATE=1):\n" + "\n".join(lines)
+                )
+            merged_cycle = self._find_cycle(observed | snapshot_edges)
+            if merged_cycle and not cycle:
+                problems.append(
+                    "ordering violation against the committed hierarchy: "
+                    + " -> ".join(merged_cycle)
+                )
+        return problems
+
+    # -- snapshot artifact ---------------------------------------------------
+    def snapshot_dict(self) -> dict:
+        with self._glock:
+            return {
+                "schema": 1,
+                "edges": sorted([a, b] for (a, b) in self.edges),
+                "witnesses": {
+                    f"{a} -> {b}": w for (a, b), w in sorted(
+                        self.edges.items()
+                    )
+                },
+            }
+
+    def write_snapshot(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.snapshot_dict(), f, indent=1, sort_keys=True)
+            f.write("\n")
+
+
+def load_snapshot(path: str) -> Set[Tuple[str, str]]:
+    with open(path) as f:
+        data = json.load(f)
+    return {(a, b) for a, b in data.get("edges", [])}
+
+
+def merge_snapshot(path: str, registry: LockOrderRegistry) -> None:
+    """Fold this run's edges into an existing snapshot (regeneration
+    runs accumulate: soak + mid-window-fault are separate invocations).
+    Witness strings from prior runs are preserved — they are the audit
+    trail reviewers approved the edge on."""
+    edges = set()
+    old_witnesses = {}
+    if os.path.exists(path):
+        with open(path) as f:
+            old = json.load(f)
+        edges = {(a, b) for a, b in old.get("edges", [])}
+        old_witnesses = old.get("witnesses", {}) or {}
+    edges |= registry.family_edges()
+    data = {
+        "schema": 1,
+        "edges": sorted([a, b] for (a, b) in edges),
+        "witnesses": {
+            f"{a} -> {b}": (
+                registry.edges.get((a, b))
+                or old_witnesses.get(f"{a} -> {b}")
+                or "(from snapshot)"
+            )
+            for (a, b) in sorted(edges)
+        },
+    }
+    with open(path, "w") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+class InstrumentedLock:
+    """Recording proxy around a real Lock/RLock.  Supports the full
+    context-manager + acquire/release surface and is Condition-safe:
+    ``threading.Condition``'s fallback paths drive it through
+    ``acquire``/``release``/``_is_owned``, all provided here."""
+
+    __slots__ = ("_inner", "_family", "_site", "_registry", "__weakref__")
+
+    def __init__(self, inner, family: str, site: str,
+                 registry: LockOrderRegistry):
+        self._inner = inner
+        self._family = family
+        self._site = site
+        self._registry = registry
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._registry.on_acquire(self._family, self._site)
+        return ok
+
+    def release(self) -> None:
+        self._registry.on_release(self._family)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def _is_owned(self) -> bool:  # Condition support
+        inner = self._inner
+        if hasattr(inner, "_is_owned"):
+            return inner._is_owned()
+        if inner.acquire(False):
+            inner.release()
+            return False
+        return True
+
+    def __enter__(self):
+        # acclint: allow[unbounded-wait] transparent proxy: the wrapped
+        # project lock's own `with` sites are the audited surface
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"<InstrumentedLock {self._family} @ {self._site}>"
+
+
+_state = {
+    "registry": None,
+    "raw_lock": None,
+    "raw_rlock": None,
+}
+
+#: every proxy the INSTALLED shim created (weak: dropped with its lock).
+#: A later install() re-binds them all — long-lived engine locks created
+#: under a previous registry must record into the new session, not a
+#: dead one.  Directly-constructed proxies (unit tests) are not tracked
+#: and keep their explicit registry.
+_installed_proxies: "weakref.WeakSet[InstrumentedLock]" = weakref.WeakSet()
+
+
+def _family_for(frame) -> Tuple[str, str]:
+    """(family, site) for a lock allocated at ``frame``: the owning
+    class name when the allocation runs inside a method (``self`` in
+    scope), else the file-relative site."""
+    fn = frame.f_code.co_filename
+    rel = os.path.relpath(fn, _PKG_ROOT) if fn.startswith(_PKG_ROOT) else fn
+    site = f"{rel}:{frame.f_lineno}"
+    slf = frame.f_locals.get("self")
+    if slf is not None:
+        return type(slf).__name__, site
+    return rel, site
+
+
+def _wrapping_factory(raw_factory):
+    def factory(*args, **kwargs):
+        import sys
+
+        inner = raw_factory(*args, **kwargs)
+        reg = _state["registry"]
+        if reg is None:
+            return inner
+        frame = sys._getframe(1)
+        fn = frame.f_code.co_filename
+        if not fn.startswith(_PKG_ROOT) or fn.startswith(
+            os.path.join(_PKG_ROOT, "analysis")
+        ):
+            return inner  # only project locks; never our own
+        family, site = _family_for(frame)
+        proxy = InstrumentedLock(inner, family, site, reg)
+        _installed_proxies.add(proxy)
+        return proxy
+
+    return factory
+
+
+def install() -> LockOrderRegistry:
+    """Patch ``threading.Lock``/``RLock`` with recording factories
+    (idempotent; returns the active registry).  Call BEFORE engines are
+    constructed — locks created earlier stay raw.  Known module-level
+    locks of the telemetry plane are retro-wrapped explicitly."""
+    if _state["registry"] is not None:
+        return _state["registry"]
+    reg = LockOrderRegistry()
+    _state["registry"] = reg
+    _state["raw_lock"] = threading.Lock
+    _state["raw_rlock"] = threading.RLock
+    threading.Lock = _wrapping_factory(_state["raw_lock"])
+    threading.RLock = _wrapping_factory(_state["raw_rlock"])
+    # surviving proxies from a PREVIOUS install (long-lived engine /
+    # window locks) would otherwise keep recording into their dead
+    # registry, blinding this session to any edge they participate in
+    for proxy in list(_installed_proxies):
+        proxy._registry = reg
+    # module-level locks created at import time (before install) that
+    # belong to the audited families: wrap in place (re-binding an
+    # already-wrapped lock to THIS registry — a stale proxy recording
+    # into a dead registry would blind later sessions)
+    try:
+        from .. import telemetry as _tel
+
+        if isinstance(_tel._wire_lock, InstrumentedLock):
+            _tel._wire_lock._registry = reg
+        else:
+            _tel._wire_lock = InstrumentedLock(
+                _tel._wire_lock, "telemetry-wire",
+                "telemetry.py:_wire_lock", reg,
+            )
+    except Exception:  # pragma: no cover - telemetry not imported yet
+        pass
+    return reg
+
+
+def uninstall() -> Optional[LockOrderRegistry]:
+    """Restore the raw factories and unwrap the retro-wrapped
+    module-level locks; instance locks created while installed keep
+    their proxies (they keep working — the registry just stops being
+    consulted for verdicts after the report)."""
+    reg = _state["registry"]
+    if reg is None:
+        return None
+    threading.Lock = _state["raw_lock"]
+    threading.RLock = _state["raw_rlock"]
+    _state["registry"] = None
+    try:
+        from .. import telemetry as _tel
+
+        if isinstance(_tel._wire_lock, InstrumentedLock):
+            _tel._wire_lock = _tel._wire_lock._inner
+    except Exception:  # pragma: no cover - telemetry not imported
+        pass
+    return reg
+
+
+def active_registry() -> Optional[LockOrderRegistry]:
+    return _state["registry"]

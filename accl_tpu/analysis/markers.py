@@ -1,0 +1,20 @@
+"""Invariant markers: zero-dependency decorators the analyzer keys on.
+
+Importable from anywhere (engines, jax-free planes, tests) — this
+module must never grow imports.
+"""
+
+from __future__ import annotations
+
+__all__ = ["spmd_uniform"]
+
+
+def spmd_uniform(fn):
+    """Mark a function as SPMD-uniform: it runs identically on every
+    rank of an SPMD program stream, so its control flow must never
+    branch on process-local state (rank, buffer identity/aliasing,
+    health maps).  Purely declarative at runtime; the acclint
+    ``spmd-uniformity`` check statically audits the body of every
+    marked function (tests/test_analysis.py proves the detection)."""
+    fn.__spmd_uniform__ = True
+    return fn

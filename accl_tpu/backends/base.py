@@ -11,6 +11,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional
 
+from ..analysis.markers import spmd_uniform
 from ..arithconfig import ArithConfig
 from ..buffer import BaseBuffer
 from ..communicator import Communicator
@@ -54,6 +55,7 @@ class CallOptions:
     plan: Optional[object] = None
     tuning: Optional[dict] = None
 
+    @spmd_uniform
     def eager_limit(self, default: int) -> int:
         """The eager-vs-rendezvous threshold steering THIS call: the
         per-size-bucket TuningPlan overlay's value when present, else
@@ -64,6 +66,7 @@ class CallOptions:
             return self.tuning.get("max_eager_size", default)
         return default
 
+    @spmd_uniform
     def effective_tuning(self, table: dict) -> dict:
         """The engine tuning table overlaid with this call's per-bucket
         registers (identical across ranks when every member loaded the

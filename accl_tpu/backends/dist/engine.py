@@ -30,6 +30,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ...analysis.markers import spmd_uniform
 from ...buffer import DeviceBuffer, dev_zeros as _dev_zeros, make_buffer
 from ...communicator import Communicator, Rank
 from ...constants import (
@@ -222,7 +223,8 @@ class DistEngine(StreamPortMixin, BaseEngine):
             import threading
 
             threading.Thread(
-                target=self._execute, args=(options, req), daemon=True
+                target=self._execute, args=(options, req),
+                name="accl-dist-op", daemon=True,
             ).start()
         else:
             # overlap backpressure: an async caller more than
@@ -240,6 +242,7 @@ class DistEngine(StreamPortMixin, BaseEngine):
                 req.complete(ErrorCode.INVALID_OPERATION)
         return req
 
+    @spmd_uniform
     def start_batch(self, items) -> None:
         """A flushed facade batch becomes ONE queue item, so the executor
         sees the identical batch boundary in every member process (the

@@ -301,7 +301,10 @@ class SocketFabric(Fabric):
         self._attach_lock = threading.Lock()
         self._pre_attach: list = []
         self._closing = False
-        self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop,
+            name=f"accl-fabric-accept-{bind_address}", daemon=True,
+        )
         self._accept_thread.start()
 
     def attach(self, address: str, endpoint: Endpoint) -> None:
@@ -329,7 +332,8 @@ class SocketFabric(Fabric):
                     return
                 self._accepted.append(conn)
             threading.Thread(
-                target=self._recv_loop, args=(conn,), daemon=True
+                target=self._recv_loop, args=(conn,),
+                name="accl-fabric-recv", daemon=True,
             ).start()
 
     def _recv_loop(self, conn: socket.socket) -> None:

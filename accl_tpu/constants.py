@@ -13,7 +13,12 @@ from __future__ import annotations
 
 import enum
 
-import numpy as np
+# NOTE: no numpy/ml_dtypes at module scope.  This module anchors the
+# jax-free import closure (overlap/telemetry/faults/plans all pull it),
+# so socket-fabric rank processes, the telemetry merge CLI, and the
+# analysis tooling can import it without the heavy numeric stack; the
+# dtype tables below build lazily on first use (acclint:
+# jax-free-module enforces this stays true).
 
 # ---------------------------------------------------------------------------
 # Operations understood by the collective engine (the "CCLO" role).
@@ -171,47 +176,85 @@ class DataType(enum.IntEnum):
     FLOAT8_E5M2 = 9
 
 
-try:  # ml_dtypes ships with jax; bfloat16/fp8 numpy dtypes live there.
-    import ml_dtypes
-
-    _BFLOAT16 = np.dtype(ml_dtypes.bfloat16)
-    _F8_E4M3 = np.dtype(ml_dtypes.float8_e4m3fn)
-    _F8_E5M2 = np.dtype(ml_dtypes.float8_e5m2)
-except ImportError:  # pragma: no cover - ml_dtypes is bundled with jax
-    _BFLOAT16 = np.dtype(np.float32)
-    _F8_E4M3 = None  # fp8 requires ml_dtypes; aliasing another dtype
-    _F8_E5M2 = None  # would corrupt the inverted numpy->DataType map
-
-_DTYPE_TO_NUMPY = {
-    DataType.FLOAT16: np.dtype(np.float16),
-    DataType.FLOAT32: np.dtype(np.float32),
-    DataType.FLOAT64: np.dtype(np.float64),
-    DataType.INT32: np.dtype(np.int32),
-    DataType.INT64: np.dtype(np.int64),
-    DataType.BFLOAT16: _BFLOAT16,
-    DataType.INT8: np.dtype(np.int8),
+#: itemsize per DataType, table-driven so ``dtype_size`` needs no numpy
+#: (the jax-free planes size wire payloads with it constantly)
+_DTYPE_ITEMSIZE = {
+    DataType.FLOAT16: 2,
+    DataType.FLOAT32: 4,
+    DataType.FLOAT64: 8,
+    DataType.INT32: 4,
+    DataType.INT64: 8,
+    DataType.BFLOAT16: 2,
+    DataType.INT8: 1,
+    DataType.FLOAT8_E4M3: 1,
+    DataType.FLOAT8_E5M2: 1,
 }
-if _F8_E4M3 is not None:
-    _DTYPE_TO_NUMPY[DataType.FLOAT8_E4M3] = _F8_E4M3
-    _DTYPE_TO_NUMPY[DataType.FLOAT8_E5M2] = _F8_E5M2
 
-_NUMPY_TO_DTYPE = {v: k for k, v in _DTYPE_TO_NUMPY.items()}
+# lazily-built numpy dtype tables (populated on first dtype_to_numpy /
+# numpy_to_dtype call; importing this module must stay numpy-free)
+_DTYPE_TO_NUMPY = None
+_NUMPY_TO_DTYPE = None
 
 
-def dtype_to_numpy(dt: DataType) -> np.dtype:
-    return _DTYPE_TO_NUMPY[dt]
+def _dtype_tables():
+    global _DTYPE_TO_NUMPY, _NUMPY_TO_DTYPE
+    # racy-read safe: _DTYPE_TO_NUMPY is the guard and is assigned LAST,
+    # so a concurrent reader that sees it non-None also sees the inverse
+    # map (worst case two threads build the identical tables once each)
+    table, inv = _DTYPE_TO_NUMPY, _NUMPY_TO_DTYPE
+    if table is not None:
+        return table, inv
+    import numpy as np
+
+    try:  # ml_dtypes ships with jax; bfloat16/fp8 dtypes live there
+        import ml_dtypes
+
+        bf16 = np.dtype(ml_dtypes.bfloat16)
+        f8_e4m3 = np.dtype(ml_dtypes.float8_e4m3fn)
+        f8_e5m2 = np.dtype(ml_dtypes.float8_e5m2)
+    except ImportError:  # pragma: no cover - bundled with jax
+        # no ml_dtypes, no bf16/fp8 numpy dtypes: OMIT them rather than
+        # alias another dtype — an alias would lie about the wire
+        # itemsize (_DTYPE_ITEMSIZE says 2 for bf16) and corrupt the
+        # inverted map, skewing eager/pipeline byte accounting
+        bf16 = None
+        f8_e4m3 = None
+        f8_e5m2 = None
+
+    table = {
+        DataType.FLOAT16: np.dtype(np.float16),
+        DataType.FLOAT32: np.dtype(np.float32),
+        DataType.FLOAT64: np.dtype(np.float64),
+        DataType.INT32: np.dtype(np.int32),
+        DataType.INT64: np.dtype(np.int64),
+        DataType.INT8: np.dtype(np.int8),
+    }
+    if bf16 is not None:
+        table[DataType.BFLOAT16] = bf16
+        table[DataType.FLOAT8_E4M3] = f8_e4m3
+        table[DataType.FLOAT8_E5M2] = f8_e5m2
+    inv = {v: k for k, v in table.items()}
+    _NUMPY_TO_DTYPE = inv
+    _DTYPE_TO_NUMPY = table  # guard last (see note above)
+    return table, inv
+
+
+def dtype_to_numpy(dt: DataType):
+    return _dtype_tables()[0][dt]
 
 
 def numpy_to_dtype(dt) -> DataType:
+    import numpy as np
+
     dt = np.dtype(dt)
     try:
-        return _NUMPY_TO_DTYPE[dt]
+        return _dtype_tables()[1][dt]
     except KeyError:
         raise ValueError(f"unsupported dtype {dt}") from None
 
 
 def dtype_size(dt: DataType) -> int:
-    return _DTYPE_TO_NUMPY[dt].itemsize
+    return _DTYPE_ITEMSIZE[DataType(dt)]
 
 
 # ---------------------------------------------------------------------------

@@ -141,7 +141,11 @@ class ACCL:
                 cfg_key=int(key),
             )
         )
-        req.wait()
+        # bounded like every other drain point: a wedged engine must
+        # surface as DEADLOCK_SUSPECTED, not hang the config writer
+        # (acclint: unbounded-wait found the original bare wait here)
+        if not req.wait(timeout=drain_deadline_s(self._timeout_s)):
+            raise self._deadlock_error(f"config {fn.name}")
         req.check(f"config {fn.name}")
         if fn in self._PLAN_INVALIDATING:
             self._plans.invalidate(fn.name.lower())
@@ -463,6 +467,13 @@ class ACCL:
             raise ACCLError(
                 ErrorCode.INVALID_DTYPE,
                 f"no arithmetic config for {key[0].name}->{key[1].name}",
+                details={
+                    "dtype": key[0].name,
+                    "compressed": key[1].name,
+                    "available": sorted(
+                        f"{u.name}->{c.name}" for u, c in self._arith
+                    ),
+                },
             )
         return self._arith[key], flags
 
@@ -759,13 +770,19 @@ class ACCL:
     @staticmethod
     def _check_rank(comm: Communicator, rank: int) -> None:
         if not 0 <= rank < comm.size:
-            raise ACCLError(ErrorCode.INVALID_RANK, f"rank {rank}")
+            raise ACCLError(
+                ErrorCode.INVALID_RANK, f"rank {rank}",
+                details={"rank": rank, "comm": comm.id, "size": comm.size},
+            )
 
     @staticmethod
     def _count_of(buf: BaseBuffer, count: Optional[int]) -> int:
         n = buf.count if count is None else int(count)
         if n < 0:
-            raise ACCLError(ErrorCode.INVALID_COUNT, f"count {n}")
+            raise ACCLError(
+                ErrorCode.INVALID_COUNT, f"count {n}",
+                details={"count": n, "buffer_count": buf.count},
+            )
         return n
 
     def get_duration(self, request: Request) -> int:
@@ -1164,6 +1181,7 @@ class ACCL:
                 raise ACCLError(
                     ErrorCode.INVALID_OPERATION,
                     "reduce needs sendbuf unless from_stream",
+                    details={"op": "reduce", "from_stream": from_stream},
                 )
             op_dtype = (
                 _as_datatype(dtype)
@@ -1176,6 +1194,7 @@ class ACCL:
                 raise ACCLError(
                     ErrorCode.INVALID_COUNT,
                     "stream reduce needs an explicit count without recvbuf",
+                    details={"op": "reduce", "from_stream": from_stream},
                 )
             else:
                 n = int(count)

@@ -159,6 +159,9 @@ def _attention_kernel(axis_name, size, causal, scale, striped=False):
                         recv_sem=recv_sem.at[slot, which],
                         device_id=nxt,
                         device_id_type=pltpu.DeviceIdType.LOGICAL,
+                        # acclint: allow[unbounded-wait] Mosaic-traced DMA
+                        # semaphore wait: no timeout form exists in Pallas;
+                        # the host watchdog bounds the whole program
                     ).wait()
 
             start_hop(1, k_ref, v_ref)

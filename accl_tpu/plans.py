@@ -41,11 +41,17 @@ from typing import Any, Dict, Optional, Tuple
 __all__ = ["CollectivePlan", "PlanCache", "size_bucket"]
 
 
+from .analysis.markers import spmd_uniform
+
+
+@spmd_uniform
 def size_bucket(count: int) -> int:
     """Power-of-two bucket of an element count: ``floor(log2(count))``
     (0 for counts <= 1).  Counts in ``[2^k, 2^(k+1))`` share a plan —
     the same bucketing the dist tier's wire shapes ride, so one plan
-    covers one compiled wire shape."""
+    covers one compiled wire shape.  SPMD-uniform by contract: plan
+    keys (and so register overlays) must bucket identically on every
+    rank or protocol choices diverge across the mesh."""
     return max(0, int(count).bit_length() - 1)
 
 

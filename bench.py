@@ -1127,6 +1127,8 @@ def _save_lkg(result: dict) -> None:
         return  # a regressed arch capture must never become the new LKG
     if gate_errors.get("overlap_gate"):
         return  # nor one whose overlap evidence failed its gate
+    if gate_errors.get("acclint"):
+        return  # nor a capture from a tree violating project invariants
     if _SMALL or "tpu" not in str(result.get("device", "")).lower():
         return
     import datetime
@@ -1692,6 +1694,22 @@ def main() -> None:
             check_overlap(extras, lkg_gate.get("result") or {})
         except OverlapGateError as e:
             errors["overlap_gate"] = str(e)
+
+    # static-analysis gate (acclint): a capture taken from a tree that
+    # violates the project invariants (unbounded waits, broken jax-free
+    # imports, ...) is not evidence — record the findings and refuse
+    # the LKG stash (mirrors the overlap/telemetry gates).  Pure AST:
+    # ~1 s wall, no device work.
+    try:
+        from accl_tpu.analysis import run_checks as _acclint
+
+        _findings = [f for f in _acclint() if not f.suppressed]
+        if _findings:
+            errors["acclint"] = "; ".join(
+                f.render() for f in _findings[:5]
+            )[:400]
+    except Exception as e:  # pragma: no cover - analyzer must not
+        errors["acclint"] = f"analyzer failed: {e}"[:400]  # kill bench
 
     _sanitize_extras(extras, errors)
     result = _headline(extras)

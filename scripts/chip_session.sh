@@ -3,6 +3,8 @@
 # evidence per unit of wedge risk (the tunnel can re-wedge at any
 # Mosaic compile; never SIGTERM a chip process mid-compile):
 #
+#   0. acclint          — static invariant gate (pure AST, no device);
+#                         findings abort before any chip time is spent
 #   1. probe            — cheap health check; abort early if wedged
 #   2. bench.py guarded — the scoreboard capture: headline + T=4096
 #                         flash-attention training record + facade/
@@ -23,13 +25,23 @@
 set -u -o pipefail
 cd "$(dirname "$0")/.."
 
-echo "== 1/4 probe" >&2
+# Leg 0: acclint — pure AST, costs ~a second, touches no device.
+# A tree that violates the project invariants (unbounded waits, broken
+# jax-free imports, missing drain paths) must not burn chip time
+# producing evidence the bench gate would refuse anyway.
+echo "== 0/5 acclint (static analysis)" >&2
+if ! python -m accl_tpu.analysis --check; then
+  echo "acclint findings — fix or suppress (with reasons) before burning chip time" >&2
+  exit 4
+fi
+
+echo "== 1/5 probe" >&2
 if ! ACCL_BENCH_MODE=probe timeout 150 python bench.py; then
   echo "tunnel wedged — aborting before touching the chip" >&2
   exit 2
 fi
 
-echo "== 2/4 guarded bench (this is the long leg; do not signal it)" >&2
+echo "== 2/5 guarded bench (this is the long leg; do not signal it)" >&2
 python bench.py | tee /tmp/bench_chip_session.json
 # The guarded parent ALWAYS exits 0 (the wedge-proof fallback is the
 # point), so success is judged from the emitted JSON: a fresh capture
@@ -53,7 +65,7 @@ then
   exit 3
 fi
 
-echo "== 3/4 chip pytest tier" >&2
+echo "== 3/5 chip pytest tier" >&2
 python tests/run_tpu_tier.py
 
 # Guarded autotune leg (after bench: a wedged tunnel already aborted
@@ -61,7 +73,7 @@ python tests/run_tpu_tier.py
 # Writes the chip-tier TuningPlan artifact next to the sweep CSVs; a
 # failure here must not discard the bench/tier evidence already
 # captured — hence || true with a loud note.
-echo "== 4/4 autotune (chip tier, world=1)" >&2
+echo "== 4/5 autotune (chip tier, world=1)" >&2
 if ! timeout 900 python -m accl_tpu.tuning --backend xla --world 1 \
     --min-exp 8 --max-exp 20 --step-exp 4 --runs 3 \
     --out benchmarks/results/tuning_plan_chip_w1.json \

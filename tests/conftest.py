@@ -5,7 +5,27 @@ XLA's host-platform device emulation (the same way the driver's
 dryrun_multichip validates the multi-chip path).
 """
 
+import faulthandler
 import os
+import threading
+
+# Sanitizer-grade hardening: a wedged drainer/scheduler thread or a
+# deadlocked drain point should dump every thread's stack instead of
+# dying silently under the suite timeout.
+faulthandler.enable()
+
+# Dynamic lock-order registry (acclint's runtime companion): with
+# ACCL_LOCKCHECK=1 every threading.Lock/RLock created by accl_tpu code
+# is wrapped in a recording proxy BEFORE any engine exists; the
+# session-scoped fixture below reports cycles/unreviewed edges at exit.
+# Importing the analysis package is safe here — it is stdlib-only and
+# must stay so (its own jax-free-module check applies transitively).
+LOCKCHECK = os.environ.get("ACCL_LOCKCHECK") == "1"
+_lock_registry = None
+if LOCKCHECK:
+    from accl_tpu.analysis import lockorder as _lockorder
+
+    _lock_registry = _lockorder.install()
 
 # Opt-in REAL-CHIP tier (ref utility.hpp:29-51 --hardware flag): with
 # ACCL_TPU_TIER=1 the platform is left alone (the TPU backend loads) and
@@ -104,6 +124,75 @@ def gang4():
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+# -- sanitizer-grade runtime hardening ---------------------------------------
+
+#: thread-name prefixes of the project's background machinery (overlap
+#: drainers, emulator schedulers, the dist executor); an exception
+#: escaping one of these dies silently today unless
+#: leaked_scheduler_threads() happens to be asserted
+_ACCL_THREAD_PREFIX = "accl-"
+
+
+@pytest.fixture(autouse=True)
+def _accl_thread_excepthook_guard():
+    """Fail any test during which an exception escaped a drainer or
+    scheduler thread.  The engines' completion paths are wrapped in
+    defensive handlers; anything that still reaches threading.excepthook
+    on an ``accl-*`` thread is a real bug leaking silently."""
+    captured = []
+    prev = threading.excepthook
+
+    def hook(args):
+        name = getattr(args.thread, "name", "") or ""
+        if name.startswith(_ACCL_THREAD_PREFIX):
+            captured.append(
+                f"{name}: {args.exc_type.__name__}: {args.exc_value}"
+            )
+        prev(args)
+
+    threading.excepthook = hook
+    try:
+        yield
+    finally:
+        threading.excepthook = prev
+    assert not captured, (
+        "exception(s) leaked on accl background threads (would have died "
+        "silently): " + "; ".join(captured)
+    )
+
+
+_LOCK_SNAPSHOT_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "lock_hierarchy.json"
+)
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _lockcheck_verdict():
+    """ACCL_LOCKCHECK=1: after the whole session, check the recorded
+    lock-acquisition graph for cycles and for edges the committed
+    ``tests/lock_hierarchy.json`` snapshot has not reviewed.  With
+    ACCL_LOCKCHECK_UPDATE=1 the snapshot is (re)generated instead —
+    audit the diff and commit it."""
+    yield
+    if _lock_registry is None:
+        return
+    from accl_tpu.analysis import lockorder as _lockorder
+
+    _lockorder.uninstall()
+    if os.environ.get("ACCL_LOCKCHECK_UPDATE") == "1":
+        _lockorder.merge_snapshot(_LOCK_SNAPSHOT_PATH, _lock_registry)
+        return
+    snapshot = None
+    if os.path.exists(_LOCK_SNAPSHOT_PATH):
+        snapshot = _lockorder.load_snapshot(_LOCK_SNAPSHOT_PATH)
+    problems = _lock_registry.violations(snapshot)
+    assert not problems, (
+        "lock-order violations detected "
+        f"({_lock_registry.acquisitions} acquisitions recorded):\n"
+        + "\n".join(problems)
+    )
 
 
 @pytest.fixture
