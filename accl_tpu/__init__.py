@@ -39,6 +39,12 @@ from .faults import (  # noqa: F401
     PeerDeadError,
 )
 from .buffer import BaseBuffer, DummyBuffer, EmuBuffer  # noqa: F401
+from .contract import (  # noqa: F401
+    ContractVerifier,
+    VERIFY_ENV,
+    VERIFY_INTERVAL_ENV,
+    call_fingerprint,
+)
 from .communicator import Communicator, Rank  # noqa: F401
 from .core import ACCL, emulated_group, socket_group_member  # noqa: F401
 from .plans import CollectivePlan, PlanCache, size_bucket  # noqa: F401

@@ -38,6 +38,7 @@ __all__ = [
     "check_timer_discipline",
     "check_error_context",
     "check_spmd_uniformity",
+    "check_thread_naming",
 ]
 
 
@@ -336,9 +337,77 @@ def check_spmd_uniformity(src: SourceFile) -> List[Finding]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# thread-naming
+# ---------------------------------------------------------------------------
+
+
+def check_thread_naming(src: SourceFile) -> List[Finding]:
+    """Every ``threading.Thread(...)`` created under accl_tpu must pass
+    ``name="accl-..."``: the conftest excepthook guard (which fails any
+    test that leaks an exception on a background thread) keys on the
+    ``accl-`` prefix, so an unnamed thread silently bypasses it — PR 6
+    fixed the existing ones by hand; this keeps it machine-checked."""
+    out: List[Finding] = []
+    # names the Thread class / threading module are bound to in this
+    # module, INCLUDING aliases — 'import threading as _th' or 'from
+    # threading import Thread as T' must not silently bypass the guard
+    # the check exists to make unbypassable
+    thread_names: set = set()
+    module_names = {"threading"}
+    for n in src.nodes:
+        if isinstance(n, ast.ImportFrom) and n.module == "threading":
+            for a in n.names:
+                if a.name == "Thread":
+                    thread_names.add(a.asname or "Thread")
+        elif isinstance(n, ast.Import):
+            for a in n.names:
+                if a.name == "threading":
+                    module_names.add(a.asname or "threading")
+    for node in src.nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        is_thread = (
+            isinstance(f, ast.Attribute)
+            and f.attr == "Thread"
+            and isinstance(f.value, ast.Name)
+            and f.value.id in module_names
+        ) or (isinstance(f, ast.Name) and f.id in thread_names)
+        if not is_thread:
+            continue
+        name_kw = next(
+            (kw for kw in node.keywords if kw.arg == "name"), None
+        )
+        if name_kw is None:
+            out.append(src.finding(
+                "thread-naming", node,
+                "threading.Thread(...) without name=: the conftest "
+                "excepthook guard only covers 'accl-*' threads; pass "
+                "name=\"accl-<role>\"",
+            ))
+            continue
+        v = name_kw.value
+        literal = None
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            literal = v.value
+        elif isinstance(v, ast.JoinedStr) and v.values and isinstance(
+            v.values[0], ast.Constant
+        ) and isinstance(v.values[0].value, str):
+            literal = v.values[0].value  # f"accl-{...}" prefix
+        if literal is not None and not literal.startswith("accl-"):
+            out.append(src.finding(
+                "thread-naming", node,
+                f"thread name {literal!r} does not start with 'accl-': "
+                f"the conftest excepthook guard keys on that prefix",
+            ))
+    return out
+
+
 PER_FILE_CHECKS = {
     "unbounded-wait": check_unbounded_wait,
     "timer-discipline": check_timer_discipline,
     "error-context": check_error_context,
     "spmd-uniformity": check_spmd_uniformity,
+    "thread-naming": check_thread_naming,
 }

@@ -37,6 +37,7 @@ JAX_FREE_MODULES = (
     "accl_tpu.faults",
     "accl_tpu.plans",
     "accl_tpu.constants",
+    "accl_tpu.contract",
 )
 
 #: top-level packages whose module-scope import breaks jax-freedom

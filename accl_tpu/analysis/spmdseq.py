@@ -1,0 +1,421 @@
+"""acclint collective-sequence check: static SPMD sequence analysis.
+
+The cross-rank contract (``accl_tpu.contract``, runtime half) says every
+rank of a communicator issues the same collective sequence — same op,
+dtype, count, root and tag, in the same order.  This check proves the
+*static* half over the code that issues collectives (the facade entry
+points, ``tests/shared_scenarios.py``, the model zoo, the parallel
+helpers): a collective call whose **op choice** (control flow) or
+**contract field** (count / root / tag / function / comm) derives from a
+*rank-varying* value — the local rank id, per-rank buffer identity,
+``id()``, a health map, the process-global RNG — is flagged, because
+each rank would issue a different call and wedge the fabric.
+
+Abstract interpretation, per function, with one interprocedural pass:
+
+1. every function in the module gets a summary — "does its return value
+   derive from rank-varying state?" — computed by a forward taint walk
+   over its body (two passes, so loop-carried taint converges);
+2. each function body is then walked again with those summaries in
+   scope: calls to a tainted-returning same-module function taint their
+   result, calls to an ``@analysis.markers.spmd_uniform``-marked
+   function *sanitize* it (the marker is the audited "this is uniform
+   across ranks" assertion — the same marker machinery the
+   spmd-uniformity check verifies);
+3. at each collective call site (``<handle>.allreduce(...)`` etc.) the
+   governing branch conditions and the contract-field arguments are
+   checked for taint.
+
+Operand positions (the leading buffer arguments) are deliberately NOT
+contract fields: a root legitimately passes a real buffer where
+non-roots pass ``None``/Dummy — rank-varying *operands* are the API
+working as designed; rank-varying *op choice or shape fields* are the
+bug.  Audited-safe sites carry ``# acclint: allow[collective-sequence]
+<reason>`` like every other check.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .base import Finding, SourceFile
+
+__all__ = ["check_collective_sequence", "CONTRACT_CALLS", "extra_scope"]
+
+#: method names that issue sequence-contract collectives on a facade
+#: handle (P2P send/recv/stream_put are rank-asymmetric by design and
+#: exempt); begin/end/batch ride along because batch boundaries extend
+#: the same contract (every rank must flush at the same call-sequence
+#: point)
+CONTRACT_CALLS = frozenset((
+    "bcast", "scatter", "gather", "allgather", "reduce", "allreduce",
+    "reduce_scatter", "alltoall", "barrier",
+    "begin_batch", "end_batch", "soft_reset",
+))
+
+#: per-op count of leading positional OPERAND slots (buffers — allowed
+#: to vary per rank); positionals past these are contract fields
+_OPERAND_SLOTS = {
+    "bcast": 1, "scatter": 2, "gather": 2, "allgather": 2,
+    "reduce": 2, "allreduce": 2, "reduce_scatter": 2, "alltoall": 2,
+    "barrier": 0, "begin_batch": 0, "end_batch": 0, "soft_reset": 0,
+}
+
+#: keyword arguments that are contract fields (operand/buffer keywords
+#: and run_async are not — run_async only changes who waits, not what
+#: the engine matches)
+_CONTRACT_KWARGS = frozenset((
+    "count", "root", "tag", "function", "comm", "compress_dtype",
+    "stream_id", "dtype",
+))
+
+#: names that are rank-varying wherever they appear (parameters and
+#: locals): the per-rank identity itself, and buffer-identity flags
+_TAINT_NAMES = frozenset((
+    "rank", "local_rank", "world_rank",
+))
+#: attribute terminals that read process-local state
+_TAINT_ATTRS = frozenset((
+    "rank", "local_rank", "world_rank", "is_dummy", "is_host_only",
+    "process_index", "process_id",
+))
+_TAINT_SUBSTR = ("health",)
+
+#: built-in sanitizers (beyond same-module @spmd_uniform functions):
+#: ``create_communicator`` is the blessed MPI_Comm_split-style
+#: constructor — its MEMBERS argument legitimately varies per rank (each
+#: rank passes its own group) while the returned communicator is the
+#: uniform handle the new group's contract runs over
+_BUILTIN_SANITIZERS = frozenset(("create_communicator", "split"))
+
+
+def _is_spmd_marked(fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", ()):
+        d = dec.func if isinstance(dec, ast.Call) else dec
+        name = (
+            d.id if isinstance(d, ast.Name)
+            else d.attr if isinstance(d, ast.Attribute) else None
+        )
+        if name == "spmd_uniform":
+            return True
+    return False
+
+
+class _Taint:
+    """Forward taint walk over one function body."""
+
+    def __init__(self, sanitizers: Set[str], tainted_fns: Set[str]):
+        self.sanitizers = sanitizers
+        self.tainted_fns = tainted_fns
+        self.vars: Set[str] = set()
+
+    # -- expression taint ----------------------------------------------------
+    def expr_refs(self, node: ast.AST) -> List[str]:
+        """The rank-varying references an expression derives from
+        (empty = uniform as far as this analysis can tell).  Sanitizer
+        calls (same-module @spmd_uniform helpers, the blessed
+        create_communicator constructor) prune their whole subtree —
+        their result is uniform by audited contract even when their
+        arguments are not."""
+        refs: List[str] = []
+        self._expr_walk(node, refs)
+        return refs
+
+    def _expr_walk(self, node: ast.AST, refs: List[str]) -> None:
+        if isinstance(node, ast.Call):
+            f = node.func
+            fname = (
+                f.id if isinstance(f, ast.Name)
+                else f.attr if isinstance(f, ast.Attribute) else None
+            )
+            if fname in self.sanitizers or fname in _BUILTIN_SANITIZERS:
+                return  # uniform by marker/constructor contract
+            if fname == "id":
+                refs.append("id()")
+            elif fname in self.tainted_fns:
+                refs.append(f"{fname}()")
+            elif fname == "rank":
+                refs.append("rank()")
+            elif isinstance(f, ast.Attribute) and (
+                (isinstance(f.value, ast.Name) and f.value.id == "random")
+                or (isinstance(f.value, ast.Attribute)
+                    and f.value.attr == "random")
+            ):
+                refs.append(f"random.{f.attr}()")
+            for child in ast.iter_child_nodes(node):
+                self._expr_walk(child, refs)
+            return
+        if isinstance(node, ast.Attribute):
+            if node.attr in _TAINT_ATTRS or any(
+                s in node.attr.lower() for s in _TAINT_SUBSTR
+            ):
+                refs.append(node.attr)
+        elif isinstance(node, ast.Subscript):
+            # caps["health"] / snapshot["health"]: the canonical way
+            # the per-rank health map is read
+            sl = node.slice
+            if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+                if any(s in sl.value.lower() for s in _TAINT_SUBSTR):
+                    refs.append(f"[{sl.value!r}]")
+        elif isinstance(node, ast.Name):
+            if (
+                node.id in _TAINT_NAMES
+                or node.id in self.vars
+                or any(s in node.id.lower() for s in _TAINT_SUBSTR)
+            ):
+                refs.append(node.id)
+        for child in ast.iter_child_nodes(node):
+            self._expr_walk(child, refs)
+
+    # -- statement walk (assignment propagation) -----------------------------
+    def propagate(self, body: List[ast.stmt]) -> None:
+        """Two passes over the statement list so taint assigned late in
+        a loop body reaches uses earlier in the next iteration."""
+        for _ in range(2):
+            for node in body:
+                for sub in ast.walk(node):
+                    targets: List[ast.AST] = []
+                    value = None
+                    if isinstance(sub, ast.Assign):
+                        targets, value = sub.targets, sub.value
+                    elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)):
+                        if sub.value is not None:
+                            targets, value = [sub.target], sub.value
+                    elif isinstance(sub, ast.For):
+                        targets, value = [sub.target], sub.iter
+                    elif isinstance(sub, ast.withitem):
+                        if sub.optional_vars is not None:
+                            targets = [sub.optional_vars]
+                            value = sub.context_expr
+                    elif isinstance(sub, ast.NamedExpr):
+                        targets, value = [sub.target], sub.value
+                    if value is None or not targets:
+                        continue
+                    if not self.expr_refs(value):
+                        continue
+                    for t in targets:
+                        for n in ast.walk(t):
+                            if isinstance(n, ast.Name):
+                                self.vars.add(n.id)
+
+
+def _mentions_taint(fn: ast.AST, extra_names: Set[str]) -> bool:
+    """Cheap single-walk pre-filter: can this function possibly touch
+    rank-varying state?  Most functions mention no taint token at all
+    and skip the full propagation pass (the whole-tree run must stay
+    ~2 s — the same budget every acclint check lives under)."""
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Name):
+            if (
+                sub.id in _TAINT_NAMES or sub.id in extra_names
+                or sub.id == "id" or sub.id == "random"
+                or any(s in sub.id.lower() for s in _TAINT_SUBSTR)
+            ):
+                return True
+        elif isinstance(sub, ast.Attribute):
+            if sub.attr in _TAINT_ATTRS or sub.attr in extra_names or any(
+                s in sub.attr.lower() for s in _TAINT_SUBSTR
+            ):
+                return True
+        elif isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            if any(s in sub.value.lower() for s in _TAINT_SUBSTR):
+                return True
+    return False
+
+
+def _function_summaries(
+    src: SourceFile, relevant: Optional[Set[str]] = None
+) -> tuple:
+    """(sanitizer names, tainted-return names) for the module: phase 1
+    of the interprocedural pass.  A function whose ``return`` derives
+    from rank-varying state taints its callers' results; an
+    ``@spmd_uniform``-marked one sanitizes them.  ``relevant`` limits
+    the summary pass to names reachable from collective-issuing code
+    (the only summaries phase 2 can consume) — the rest of the module
+    never pays the propagation walk."""
+    sanitizers: Set[str] = set()
+    fns: Dict[str, ast.AST] = {}
+    for node in src.nodes:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if _is_spmd_marked(node):
+                sanitizers.add(node.name)
+            if relevant is not None and node.name not in relevant:
+                continue
+            fns.setdefault(node.name, node)
+    tainted: Set[str] = set()
+    for _ in range(2):  # one level of same-module call nesting converges
+        for name, fn in fns.items():
+            if name in sanitizers or name in tainted:
+                continue
+            if not _mentions_taint(fn, tainted):
+                continue
+            t = _Taint(sanitizers, tainted)
+            t.propagate(fn.body)
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Return) and sub.value is not None:
+                    if t.expr_refs(sub.value):
+                        tainted.add(name)
+                        break
+    return sanitizers, tainted
+
+
+def _op_of(call: ast.Call) -> Optional[str]:
+    """The contract-collective name this call issues, or None.  Only
+    attribute calls count (``handle.allreduce(...)``): a bare name like
+    ``reduce(...)`` is functools.reduce, not a collective."""
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr in CONTRACT_CALLS:
+        return f.attr
+    return None
+
+
+class _SiteVisitor(ast.NodeVisitor):
+    """Walk one function carrying the stack of governing branch
+    conditions, flagging contract-call sites."""
+
+    def __init__(self, src: SourceFile, fn, taint: _Taint,
+                 findings: List[Finding]):
+        self.src = src
+        self.fn = fn
+        self.taint = taint
+        self.findings = findings
+        self.cond_refs: List[List[str]] = []
+
+    # nested defs/lambdas get their own top-level walk; don't descend
+    def visit_FunctionDef(self, node):  # noqa: N802
+        if node is not self.fn:
+            return
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _visit_branch(self, test: ast.AST, bodies) -> None:
+        refs = self.taint.expr_refs(test)
+        self.visit(test)
+        if refs:
+            self.cond_refs.append(refs)
+        for body in bodies:
+            for stmt in body:
+                self.visit(stmt)
+        if refs:
+            self.cond_refs.pop()
+
+    def visit_If(self, node):  # noqa: N802
+        self._visit_branch(node.test, [node.body, node.orelse])
+
+    def visit_While(self, node):  # noqa: N802
+        self._visit_branch(node.test, [node.body, node.orelse])
+
+    def visit_For(self, node):  # noqa: N802
+        # a rank-varying ITERABLE governs the loop's trip count: a
+        # collective in the body runs a different number of times per
+        # rank — call-COUNT divergence, same bug class as a branch
+        self._visit_branch(node.iter, [node.body, node.orelse])
+
+    visit_AsyncFor = visit_For
+
+    def visit_IfExp(self, node):  # noqa: N802
+        refs = self.taint.expr_refs(node.test)
+        self.visit(node.test)
+        if refs:
+            self.cond_refs.append(refs)
+        self.visit(node.body)
+        self.visit(node.orelse)
+        if refs:
+            self.cond_refs.pop()
+
+    def visit_Call(self, node):  # noqa: N802
+        op = _op_of(node)
+        if op is None:
+            self.generic_visit(node)
+            return
+        if self.cond_refs:
+            governing = sorted({r for refs in self.cond_refs for r in refs})
+            self.findings.append(self.src.finding(
+                "collective-sequence", node,
+                f"collective '{op}' is issued under a branch on "
+                f"rank-varying state ({', '.join(governing)}): ranks "
+                f"taking different branches issue different call "
+                f"sequences and wedge the fabric; hoist the collective "
+                f"or mark the condition's source @spmd_uniform",
+            ))
+        nops = _OPERAND_SLOTS.get(op, 0)
+        for i, arg in enumerate(node.args):
+            if i < nops or isinstance(arg, ast.Starred):
+                continue
+            refs = self.taint.expr_refs(arg)
+            if refs:
+                self.findings.append(self.src.finding(
+                    "collective-sequence", node,
+                    f"collective '{op}' positional argument {i} (a "
+                    f"contract field) derives from rank-varying state "
+                    f"({', '.join(sorted(set(refs)))}): every rank must "
+                    f"pass the same value",
+                ))
+        for kw in node.keywords:
+            if kw.arg not in _CONTRACT_KWARGS:
+                continue
+            refs = self.taint.expr_refs(kw.value)
+            if refs:
+                self.findings.append(self.src.finding(
+                    "collective-sequence", node,
+                    f"collective '{op}' field {kw.arg}= derives from "
+                    f"rank-varying state ({', '.join(sorted(set(refs)))}): "
+                    f"every rank must pass the same value (audited-"
+                    f"uniform derivations go through an @spmd_uniform "
+                    f"helper or carry a suppression reason)",
+                ))
+        self.generic_visit(node)
+
+
+def check_collective_sequence(src: SourceFile) -> List[Finding]:
+    # fast reject on the shared flattened walk: any contract-call site
+    # at all?  (cheaper than re-walking per function; most modules have
+    # none and exit here)
+    if not any(
+        isinstance(n, ast.Call) and _op_of(n) is not None
+        for n in src.nodes
+    ):
+        return []
+    findings: List[Finding] = []
+    # candidate functions (those issuing contract collectives) and the
+    # names they call: only THOSE need phase-1 return-taint summaries
+    candidates = []
+    called: Set[str] = set()
+    for fn in src.nodes:
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if any(
+            isinstance(sub, ast.Call) and _op_of(sub) is not None
+            for sub in ast.walk(fn)
+        ):
+            candidates.append(fn)
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Call):
+                    f = sub.func
+                    if isinstance(f, ast.Name):
+                        called.add(f.id)
+                    elif isinstance(f, ast.Attribute):
+                        called.add(f.attr)
+    sanitizers, tainted_fns = _function_summaries(src, relevant=called)
+    for fn in candidates:
+        if not _mentions_taint(fn, tainted_fns):
+            continue  # no rank-varying token anywhere: nothing to flag
+        taint = _Taint(sanitizers, tainted_fns)
+        taint.propagate(fn.body)
+        _SiteVisitor(src, fn, taint, findings).visit(fn)
+    return findings
+
+
+def extra_scope() -> List[str]:
+    """Files OUTSIDE the package default scope this check also covers:
+    the shared scenario library every transport tier executes (its
+    collective sequences are the contract's highest-traffic users)."""
+    import os
+
+    from .base import package_root
+
+    repo = os.path.dirname(package_root())
+    path = os.path.join(repo, "tests", "shared_scenarios.py")
+    return [path] if os.path.isfile(path) else []

@@ -150,6 +150,29 @@ class BaseEngine:
         are a no-op.  Returns False only on timeout."""
         return True
 
+    def contract_anchor(self):
+        """The object the contract plane's in-process digest exchange
+        (``accl_tpu.contract.board_for``) anchors on.  Engines whose
+        rank handles share a process-wide object override this with it
+        (InProc fabric, XLA gang context); the default — ``None`` — on
+        one-engine-per-process tiers skips board posting entirely (a
+        single-poster board can never convict; copying the evidence
+        ring into it every window would be pure overhead against the
+        <=5% budget) and leaves verification to the wire piggyback /
+        facade intake checks."""
+        return None
+
+    #: the facade-armed ContractVerifier (None = verification off)
+    contract_verifier = None
+
+    def set_contract_verifier(self, verifier) -> None:
+        """Arm (or with ``None`` disarm) engine-side contract checks.
+        Default: store the handle — the facade's intake screen is the
+        only check on such tiers (native: the C dataplane cannot consult
+        a Python verifier mid-call).  Engines with their own schedulers
+        or delivery paths override to fail in-flight work fast too."""
+        self.contract_verifier = verifier
+
     def health_report(self, comm) -> dict:
         """Per-peer health map for ``comm``, keyed by comm-relative rank
         (``capabilities()["health"]``).  Engines with timeout/retry

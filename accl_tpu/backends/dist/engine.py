@@ -268,6 +268,15 @@ class DistEngine(StreamPortMixin, BaseEngine):
     def device_interactions(self) -> int:
         return self.interactions.read()
 
+    # -- contract plane (accl_tpu.contract) ----------------------------------
+    # One process per rank: there is no shared in-process board to meet
+    # on (contract_anchor() stays the BaseEngine default, None), so
+    # this tier verifies via the facade intake screen plus the executor
+    # screen in _execute (contract_verifier stored by the inherited
+    # BaseEngine.set_contract_verifier); a cross-process digest
+    # exchange piggybacked on the KV store rides with ROADMAP item 2's
+    # multi-slice work.
+
     def telemetry_report(self) -> dict:
         """Dist-tier counters for the telemetry snapshot: executor queue
         backlog, remote stream-port sequence positions, cached meshes."""
@@ -323,6 +332,24 @@ class DistEngine(StreamPortMixin, BaseEngine):
 
     def _execute(self, options: CallOptions, req: Request) -> None:
         req.mark_executing()
+        cv = self.contract_verifier
+        if (
+            cv is not None and cv.has_verdict and options.comm is not None
+        ):
+            verdict = cv.check(options.comm.id)
+            if verdict is not None:
+                # contract plane: the verifier proved this process's call
+                # sequence diverged from its peers — calls already queued
+                # behind the detection point fail fast instead of wedging
+                # the serialized executor on a cross-process program that
+                # can never assemble
+                from ...contract import verdict_context
+
+                req.complete(
+                    ErrorCode.CONTRACT_VIOLATION, 0,
+                    context=verdict_context(verdict, options.op.name),
+                )
+                return
         t0 = time.perf_counter_ns()
         try:
             code = self._dispatch(options, req)

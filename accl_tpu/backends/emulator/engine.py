@@ -39,6 +39,7 @@ from ...constants import (
     Operation,
     TUNING_DEFAULTS,
 )
+from ...contract import verdict_context
 from ...faults import PeerDeadError, SeqnLedger
 from ...request import CommandQueue, Request
 from ..base import BaseEngine, CallOptions
@@ -137,6 +138,11 @@ class EmuEngine(BaseEngine):
 
         self.inflight_window = default_window_depth()
 
+        # contract plane (accl_tpu.contract, ACCL_VERIFY=1): armed by the
+        # facade via set_contract_verifier — intake screens and active
+        # calls fail fast on a standing cross-rank divergence verdict
+        self.contract_verifier = None
+
         self._rndzv_inits: List[Message] = []
         self._rndzv_done: List[Message] = []
         self._notif_lock = threading.Lock()
@@ -206,6 +212,57 @@ class EmuEngine(BaseEngine):
 
     def new_vaddr(self) -> int:
         return next(self._vaddr_counter)
+
+    # -- contract plane (accl_tpu.contract) ----------------------------------
+    def contract_anchor(self):
+        """The object the contract plane's in-process exchange board
+        anchors on: the InProc fabric — shared by every InProc rank
+        engine, so their verifiers meet on one board.  A SocketFabric
+        serves exactly one rank per process: no board (single-poster
+        boards only cost ring copies), the wire piggyback does the
+        comparing."""
+        from .fabric import InProcFabric
+
+        return self.fabric if isinstance(self.fabric, InProcFabric) else None
+
+    def set_contract_verifier(self, verifier) -> None:
+        """Arm (or with ``None`` disarm) cross-rank contract checks on
+        this engine: inbound digest claims are observed at delivery, and
+        a standing divergence verdict fails queued + active calls fast
+        (CONTRACT_VIOLATION) instead of letting them time out."""
+        self.contract_verifier = verifier
+        if verifier is None:
+            self.endpoint.contract_hook = None
+            return
+
+        def observe(msg, v=verifier):
+            if msg.msg_type == MsgType.VERIFY:
+                # a peer convicted a divergence and relayed the verdict:
+                # adopt it so this rank's in-flight calls fail fast too
+                import json as _json
+
+                try:
+                    verdict = _json.loads(msg.payload.decode())
+                except (ValueError, UnicodeDecodeError):
+                    return
+                v.adopt_verdict(msg.comm_id, verdict, src_rank=msg.src)
+                return
+            v.observe_claim(
+                msg.comm_id, msg.src, msg.vfy_gen, msg.vfy_window,
+                msg.vfy_digest,
+            )
+
+        self.endpoint.contract_hook = observe
+        verifier.add_verdict_listener(lambda _vd: self._wake.set())
+
+    def _contract_verdict_for(self, options: Optional[CallOptions]):
+        v = self.contract_verifier
+        if (
+            v is None or not v.has_verdict or options is None
+            or options.comm is None or options.op not in _COMM_OPS
+        ):
+            return None
+        return v.check(options.comm.id)
 
     # -- wire helpers used by algorithms ------------------------------------
     def post(self, comm: Communicator, dst: int, msg: Message) -> None:
@@ -467,6 +524,16 @@ class EmuEngine(BaseEngine):
                     break
                 req, options = item
                 req.mark_executing()
+                verdict = self._contract_verdict_for(options)
+                if verdict is not None:
+                    # the contract verifier proved this communicator's
+                    # ranks diverged: fail at intake instead of burning
+                    # the call deadline on traffic that cannot match
+                    req.complete(
+                        ErrorCode.CONTRACT_VIOLATION, 0,
+                        context=verdict_context(verdict, options.op.name),
+                    )
+                    continue
                 dead = self._dead_peer_for(options)
                 if dead is not None:
                     # fail fast: the peer is already known dead — don't
@@ -491,6 +558,26 @@ class EmuEngine(BaseEngine):
 
             self._route_inbox()
             self._service_retransmits(time.monotonic())
+
+            cv = self.contract_verifier
+            if cv is not None and cv.has_verdict and active:
+                # a divergence verdict landed (boundary exchange or a
+                # peer's piggybacked claim) while calls are in flight:
+                # those calls' traffic can never match — fail them fast
+                # instead of letting each burn its full deadline
+                for task in list(active):
+                    verdict = self._contract_verdict_for(task.options)
+                    if verdict is None:
+                        continue
+                    task.gen.close()
+                    task.request.complete(
+                        ErrorCode.CONTRACT_VIOLATION,
+                        time.perf_counter_ns() - task.started_ns,
+                        context=verdict_context(
+                            verdict, task.request.op_name
+                        ),
+                    )
+                    active.remove(task)
 
             progressed = False
             now = time.monotonic()

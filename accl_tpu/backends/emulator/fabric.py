@@ -49,6 +49,9 @@ class MsgType(enum.IntEnum):
     RNDZV_DATA = 4  # the one-sided write itself (fabric-internal)
     STREAM = 5  # routed directly to a device stream port
     ACK = 6  # eager-segment delivery acknowledgment (retransmit protocol)
+    VERIFY = 7  # contract-plane verdict relay (JSON payload): a rank
+    # that convicted a divergence tells its peers so their in-flight
+    # calls fail fast too instead of waiting out the engine deadline
 
 
 @dataclasses.dataclass
@@ -67,6 +70,13 @@ class Message:
     reply_to: str = ""  # sender's fabric address for ACKs
     csum: int = 0  # crc32 of payload; stamped by the fabric on first send
     epoch: int = 0  # sender's communicator-instance epoch (seqn dedup scope)
+    # contract plane (accl_tpu.contract, ACCL_VERIFY=1): the sender's
+    # latest completed verification window piggybacks on every message —
+    # three ints of header, zero extra traffic.  vfy_window -1 = no
+    # stamp (verifier off or no window completed yet).
+    vfy_gen: int = 0
+    vfy_window: int = -1
+    vfy_digest: int = 0
 
 
 class Endpoint:
@@ -83,6 +93,9 @@ class Endpoint:
         self._wr_registry: Dict[int, memoryview] = {}
         self._deliver_cb = deliver_cb
         self.on_activity: Optional[Callable[[], None]] = None
+        # contract plane: the receiving rank's verifier hook — observes
+        # peers' piggybacked digest claims on every delivered message
+        self.contract_hook: Optional[Callable[[Message], None]] = None
         # wire-integrity accounting: payloads whose crc32 no longer matches
         # the stamped csum are discarded here (the rx dataplane's bit-error
         # detection; the sender's retransmit protocol recovers them)
@@ -105,6 +118,17 @@ class Endpoint:
             if self.on_activity is not None:
                 self.on_activity()
             return
+        # contract hook AFTER the csum guard: a corrupt-fault frame is
+        # discarded above and must never be consumed as a digest claim
+        # or a relayed VERIFY verdict
+        hook = self.contract_hook
+        if hook is not None and (
+            msg.vfy_window >= 0 or msg.msg_type == MsgType.VERIFY
+        ):
+            try:
+                hook(msg)  # a verifier failure must never drop traffic
+            except Exception:  # pragma: no cover - defensive
+                pass
         if msg.msg_type == MsgType.RNDZV_DATA:
             with self._lock:
                 mem = self._wr_registry.pop(msg.vaddr)
@@ -171,6 +195,22 @@ class Fabric:
     def fault_injector(self) -> Optional[FaultInjector]:
         return self._injector
 
+    # -- contract plane (accl_tpu.contract) ----------------------------------
+    def register_contract(self, comm_id: int, rank: int, verifier) -> None:
+        """Arm outbound digest stamping for (communicator, sending rank):
+        the send path piggybacks ``verifier.stamp(comm_id)`` onto every
+        message that rank sends on that communicator."""
+        stamps = getattr(self, "_contract_stamps", None)
+        if stamps is None:
+            stamps = self._contract_stamps = {}
+        stamps[(comm_id, rank)] = verifier
+
+    def unregister_contract(self, verifier) -> None:
+        stamps = getattr(self, "_contract_stamps", None)
+        if stamps:
+            for key in [k for k, v in stamps.items() if v is verifier]:
+                del stamps[key]
+
     def attach(self, address: str, endpoint: Endpoint) -> None:
         raise NotImplementedError
 
@@ -181,6 +221,17 @@ class Fabric:
                 f"src={msg.src} dst={msg.dst} tag={msg.tag} "
                 f"seqn={msg.seqn} bytes={len(msg.payload)} -> {address}"
             )
+        stamps = getattr(self, "_contract_stamps", None)
+        if stamps:
+            # contract plane piggyback: stamp the sending rank's latest
+            # completed digest window onto the outgoing message (one
+            # dict probe when verification is armed, one getattr when
+            # not — the ~0%-off budget)
+            verifier = stamps.get((msg.comm_id, msg.src))
+            if verifier is not None:
+                msg.vfy_gen, msg.vfy_window, msg.vfy_digest = (
+                    verifier.stamp(msg.comm_id)
+                )
         inj = self._injector
         if inj is None:
             self._transmit(address, msg)

@@ -358,6 +358,17 @@ class NativeEngine(BaseEngine):
                 return out.raw[:n]
             cap = int(n)  # chunk bigger than buffer: retry with exact size
 
+    # -- contract plane (accl_tpu.contract) ----------------------------------
+    def contract_anchor(self):
+        """None (no board): in-proc native groups share one
+        process-wide CDLL, but anchoring the digest board there would
+        let two *sequential* groups cross-compare stale windows under
+        colliding comm ids.  The native tier verifies via the facade
+        intake screen; its C dataplane cannot consult a Python verifier
+        mid-call (set_contract_verifier keeps the BaseEngine store-only
+        behavior)."""
+        return None
+
     # -- debug (ref ACCL::dump_eager_rx_buffers) -----------------------------
     def dump_rx_buffers(self) -> str:
         used = self._lib.accl_ng_rx_occupancy(self._handle)

@@ -619,6 +619,30 @@ class XLAGangContext:
                 if not req.done():
                     req.complete(ErrorCode.RECEIVE_TIMEOUT)
 
+    def contract_fail(self, verdict: dict) -> None:
+        """Contract plane: a cross-rank divergence verdict landed for
+        ``verdict["comm"]`` — complete every PARKED slot on that
+        communicator with CONTRACT_VIOLATION immediately.  The detecting
+        rank fails pre-dispatch at facade intake; its peers' calls are
+        already parked in half-assembled slots and would otherwise
+        starve until the watchdog (the hang this plane removes).
+        Idempotent: every rank's verifier listener calls this once."""
+        from ...contract import verdict_context
+
+        comm_id = verdict.get("comm")
+        with self._lock:
+            keys = [k for k in self._slots if k[0] == comm_id]
+            slots = [self._slots.pop(k) for k in keys]
+        for slot in slots:
+            if slot.watchdog is not None:
+                slot.watchdog.cancel()
+            for req in self._slot_requests(slot):
+                if not req.done():
+                    req.complete(
+                        ErrorCode.CONTRACT_VIOLATION,
+                        context=verdict_context(verdict, req.op_name),
+                    )
+
     def dump_state(self) -> List[str]:
         """Pending-rendezvous lines for the debug dump: every parked gang
         slot (a collective some rank posted that never assembled) is a
@@ -1751,6 +1775,23 @@ class XLAEngine(StreamPortMixin, BaseEngine):
 
     def device_interactions(self) -> int:
         return self.gang.interactions.read()
+
+    # -- contract plane (accl_tpu.contract) ----------------------------------
+    def contract_anchor(self):
+        """The gang context: every rank handle of this mesh shares it,
+        so their verifiers exchange digests on one in-process board (the
+        single-process analog of the multi-slice device-side digest
+        reduce — ROADMAP item 2)."""
+        return self.gang
+
+    def set_contract_verifier(self, verifier) -> None:
+        """A divergence verdict must fail the gang's PARKED slots too:
+        the detecting rank raises pre-dispatch, which means its peers'
+        already-submitted calls would otherwise starve their slot until
+        the watchdog — the exact hang the verifier exists to remove."""
+        self.contract_verifier = verifier
+        if verifier is not None:
+            verifier.add_verdict_listener(self.gang.contract_fail)
 
     def drain_inflight(self, timeout=None) -> bool:
         """Overlap drain point: block until the gang's in-flight window

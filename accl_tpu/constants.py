@@ -334,6 +334,11 @@ class ErrorCode(enum.IntFlag):
     NOT_READY = 1 << 19  # internal: call must be retried (never surfaced)
     DEADLOCK_SUSPECTED = 1 << 20
     CONFIG_ERROR = 1 << 21
+    # contract plane (accl_tpu.contract): the cross-rank runtime
+    # verifier proved this communicator's ranks issued diverging
+    # collective sequences — fail fast instead of letting the mismatch
+    # surface as a timeout N calls later
+    CONTRACT_VIOLATION = 1 << 22
 
     @staticmethod
     def describe(code: "ErrorCode") -> str:

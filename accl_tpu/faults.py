@@ -21,6 +21,12 @@ Actions:
   engine converts it to ``SEND_TIMEOUT``)
 * ``partition`` — the fabric splits into ``groups``; traffic crossing the
   cut vanishes silently in both directions
+* ``diverge``   — the rule's ``rank`` (comm-relative, like every rank
+  field here) has its collective-call fingerprints deterministically
+  perturbed (contract plane, ``accl_tpu.contract``): the wire is
+  untouched, but the cross-rank runtime verifier sees that rank's call
+  sequence diverge — the seeded proof that ``ACCL_VERIFY=1`` catches
+  real SPMD divergence instead of hanging
 
 Determinism: rule firing is driven purely by per-rule match counters
 (``nth`` / ``count``) and corruption bytes by the plan-seeded RNG, so the
@@ -61,6 +67,7 @@ class FaultAction(str, enum.Enum):
     CORRUPT = "corrupt"
     KILL_RANK = "kill_rank"
     PARTITION = "partition"
+    DIVERGE = "diverge"
 
 
 @dataclasses.dataclass
@@ -98,6 +105,8 @@ class FaultRule:
         self.action = FaultAction(self.action)
         if self.action == FaultAction.KILL_RANK and self.rank is None:
             raise ValueError("kill_rank rule needs a rank")
+        if self.action == FaultAction.DIVERGE and self.rank is None:
+            raise ValueError("diverge rule needs a rank")
         if self.action == FaultAction.PARTITION and not self.groups:
             raise ValueError("partition rule needs groups")
 
@@ -256,6 +265,8 @@ class FaultInjector:
                 if rule.action in (FaultAction.KILL_RANK,
                                    FaultAction.PARTITION) and rule.nth == 0:
                     continue  # install-time rules never fire per-message
+                if rule.action == FaultAction.DIVERGE:
+                    continue  # fires on fingerprints, not wire messages
                 if not rule.matches(msg):
                     continue
                 self._matched[i] += 1
@@ -298,6 +309,50 @@ class FaultInjector:
             if a is not None and b is not None and a != b:
                 return True
         return False
+
+    def on_fingerprint(self, comm_id: int, rank: int) -> int:
+        """The contract plane's hook (``accl_tpu.contract``): a nonzero
+        XOR mask when a ``diverge`` rule fires for this rank's next
+        collective-call fingerprint, 0 otherwise.  Deterministic: the
+        mask derives from the plan seed + rank (same plan, same
+        divergence), and firing follows the same ``nth``/``count``
+        counters as the wire actions."""
+        import zlib as _zlib
+
+        with self._lock:
+            if self._disabled:
+                return 0
+            for i, rule in enumerate(self.plan.rules):
+                if rule.action != FaultAction.DIVERGE:
+                    continue
+                if rule.rank != rank:
+                    continue
+                if rule.comm is not None and rule.comm != comm_id:
+                    continue
+                self._matched[i] += 1
+                if self._matched[i] < max(rule.nth, 1):
+                    continue
+                if rule.count is not None and self.applied[i] >= rule.count:
+                    continue
+                self.applied[i] += 1
+                if len(self.log) < self._LOG_CAP:
+                    self.log.append({
+                        "action": FaultAction.DIVERGE.value,
+                        "rule": i,
+                        "msg_type": "FINGERPRINT",
+                        "comm": comm_id,
+                        "src": rank,
+                        "dst": None,
+                        "tag": None,
+                        "seqn": self._matched[i] - 1,
+                    })
+                # any nonzero mask diverges; derive it from the seed so
+                # two plans with different seeds perturb differently
+                mask = _zlib.crc32(
+                    f"diverge|{self.plan.seed}|{rank}".encode()
+                ) | 1
+                return mask
+        return 0
 
     def corrupt_payload(self, payload: bytes) -> bytes:
         """Flip one byte at a plan-seeded position (deterministic given the

@@ -575,6 +575,40 @@ def _bench_facade_overhead() -> dict:
         call_us, per_call, plan_hit_rate = best
         off_us = min(off_vals)[0]
 
+        # contract-plane budget (parse_results.check_verify): the same
+        # interleaved A/B discipline, verifier armed vs disarmed on the
+        # SAME prepared warm path — ACCL_VERIFY must cost <=5% when on
+        # and ~0% when off (the off cost is one None check per call,
+        # already inside the telemetry-on baseline above)
+        ver_vals, base_vals = [], []
+        for k in range(rounds):
+            if k % 2 == 0:
+                a.set_contract_verify(True)
+                ver_vals.append(run_on())
+                a.set_contract_verify(False)
+                base_vals.append(run_on())
+            else:
+                base_vals.append(run_on())
+                a.set_contract_verify(True)
+                ver_vals.append(run_on())
+                a.set_contract_verify(False)
+        verify_snap = None
+        a.set_contract_verify(True)
+        run_on()  # one armed round so the snapshot carries live counters
+        verify_snap = a.telemetry_snapshot()["contract"]
+        a.set_contract_verify(False)
+        ver_us = min(ver_vals)[0]
+        base_us = min(base_vals)[0]
+        verify = {
+            "overhead_pct": round(
+                max(0.0, (ver_us - base_us) / max(base_us, 1e-9) * 100.0),
+                2,
+            ),
+            "interval": verify_snap.get("interval"),
+            "calls_verified": verify_snap.get("calls_verified"),
+            "windows_exchanged": verify_snap.get("windows_exchanged"),
+        }
+
         # batched dispatch: N queued collectives flush through the
         # command queue as ONE fused program — the amortized per-call
         # cost is the facade's floor when a training step batches its
@@ -633,7 +667,9 @@ def _bench_facade_overhead() -> dict:
         "facade_device_interactions_per_call": round(per_call, 2),
         "facade_plan_cache_hit_rate": round(plan_hit_rate, 4),
         "facade_batched_call_overhead_us": round(batched_us, 1),
+        "facade_verify_overhead_pct": verify["overhead_pct"],
         "telemetry": telemetry,
+        "verify": verify,
     }
 
 
@@ -1127,6 +1163,8 @@ def _save_lkg(result: dict) -> None:
         return  # a regressed arch capture must never become the new LKG
     if gate_errors.get("overlap_gate"):
         return  # nor one whose overlap evidence failed its gate
+    if gate_errors.get("verify_gate"):
+        return  # nor one whose contract-verify budget failed its gate
     if gate_errors.get("acclint"):
         return  # nor a capture from a tree violating project invariants
     if _SMALL or "tpu" not in str(result.get("device", "")).lower():
@@ -1667,9 +1705,11 @@ def main() -> None:
             ArchOverheadRegressionError,
             OverlapGateError,
             TelemetryGateError,
+            VerifyGateError,
             check_arch_overhead,
             check_overlap,
             check_telemetry,
+            check_verify,
         )
     except ImportError:  # pragma: no cover - repo layout changed
         ArchOverheadRegressionError = None  # type: ignore[assignment]
@@ -1694,6 +1734,12 @@ def main() -> None:
             check_overlap(extras, lkg_gate.get("result") or {})
         except OverlapGateError as e:
             errors["overlap_gate"] = str(e)
+        # contract-verify budget gate: a facade capture must carry the
+        # verifier A/B evidence and its <=5% opt-in overhead verdict
+        try:
+            check_verify(extras)
+        except VerifyGateError as e:
+            errors["verify_gate"] = str(e)
 
     # static-analysis gate (acclint): a capture taken from a tree that
     # violates the project invariants (unbounded waits, broken jax-free

@@ -112,6 +112,11 @@ REQUIRED_SNAPSHOT_KEYS = (
     "rank",
     "tier",
 )
+# NOT in REQUIRED_SNAPSHOT_KEYS (the committed r05 capture predates
+# it): the contract plane's "contract" section — always present in
+# live snapshots ({"enabled": False} when verification is off) and
+# asserted by tests/test_contract.py; fold it in at the next chip
+# recapture.
 
 
 class TelemetryGateError(ValueError):
@@ -171,6 +176,73 @@ def check_telemetry_capture(bench_path: str) -> None:
         doc = json.load(f)
     result = doc.get("parsed") or doc.get("result") or doc
     check_telemetry((result or {}).get("extras") or {})
+
+
+# Contract-plane gate: ACCL_VERIFY=1 must stay within the opt-in
+# budget — the verifier's per-call cost (one crc32 + ring append +
+# amortized window exchange) is certified <=5% against the interleaved
+# verifier-off baseline, and a capture claiming the facade bench ran
+# must carry the verify evidence block with live counters.
+VERIFY_OVERHEAD_TOLERANCE_PCT = float(
+    os.environ.get("ACCL_VERIFY_OVERHEAD_TOLERANCE_PCT", "5.0")
+)
+
+
+class VerifyGateError(ValueError):
+    """The capture's contract-verify evidence is missing/dead, or the
+    measured verifier-on overhead exceeded the opt-in budget."""
+
+
+def check_verify(extras: dict, tolerance_pct: float = None) -> None:
+    """Gate a capture's contract-plane evidence.  No-op when the facade
+    bench never ran (no ``verify`` block and no ``telemetry`` block —
+    wedged/partial captures carry neither); otherwise the block must
+    exist, its counters must show the verifier actually fingerprinted
+    calls and exchanged windows, and the interleaved on/off delta must
+    be within the <=5% budget."""
+    tol = (
+        VERIFY_OVERHEAD_TOLERANCE_PCT
+        if tolerance_pct is None else tolerance_pct
+    )
+    extras = extras or {}
+    ver = extras.get("verify")
+    if ver is None:
+        if extras.get("telemetry") is None:
+            return  # facade bench never ran: nothing to gate
+        raise VerifyGateError(
+            "capture carries facade-bench telemetry evidence but no "
+            "verify block — the contract-plane A/B did not run; the "
+            "<=5% verifier budget is unverifiable"
+        )
+    if not isinstance(ver, dict):
+        raise VerifyGateError("verify block is not a dict")
+    if not ver.get("calls_verified"):
+        raise VerifyGateError(
+            "verify evidence shows zero fingerprinted calls — the "
+            "verifier was never actually armed over the warm path"
+        )
+    pct = ver.get("overhead_pct")
+    if pct is None:
+        raise VerifyGateError(
+            "capture carries no verifier-on/off overhead measurement"
+        )
+    if pct > tol:
+        raise VerifyGateError(
+            f"verifier-on warm path costs {pct:.2f}% over verifier-off "
+            f"(budget {tol:.1f}%): fingerprinting crept off the "
+            "crc32+ring fast path; fix it instead of committing the "
+            "slower capture"
+        )
+
+
+def check_verify_capture(bench_path: str) -> None:
+    """CLI form (``--check-verify BENCH_rNN.json``)."""
+    import json
+
+    with open(bench_path) as f:
+        doc = json.load(f)
+    result = doc.get("parsed") or doc.get("result") or doc
+    check_verify((result or {}).get("extras") or {})
 
 
 # Overlap gate (overlap-plane PR): the gang bench's dispatch floor is
@@ -449,6 +521,14 @@ def main(argv=None) -> str:
         print(
             f"{argv[i + 1]}: overlap evidence present, dispatch floor "
             f"within {OVERLAP_REGRESSION_TOLERANCE:.2f}x of LKG"
+        )
+        return ""
+    if "--check-verify" in argv:
+        i = argv.index("--check-verify")
+        check_verify_capture(argv[i + 1])
+        print(
+            f"{argv[i + 1]}: contract-verify evidence present, overhead "
+            f"within {VERIFY_OVERHEAD_TOLERANCE_PCT:.1f}%"
         )
         return ""
     if "--check-tuned" in argv:

@@ -60,10 +60,12 @@ try:
         OverlapGateError,
         TelemetryGateError,
         TunedPlanRegressionError,
+        VerifyGateError,
         check_arch_overhead,
         check_overlap,
         check_telemetry,
         check_tuned_not_slower,
+        check_verify,
     )
 except ImportError:  # pragma: no cover - running as a package module
     from benchmarks.parse_results import (  # noqa: F401
@@ -73,10 +75,12 @@ except ImportError:  # pragma: no cover - running as a package module
         OverlapGateError,
         TelemetryGateError,
         TunedPlanRegressionError,
+        VerifyGateError,
         check_arch_overhead,
         check_overlap,
         check_telemetry,
         check_tuned_not_slower,
+        check_verify,
     )
 
 

@@ -231,7 +231,7 @@ def test_jax_free_module_traverses_from_import_alias(tmp_path, monkeypatch):
     (pkg / "heavy.py").write_text("from .sub.leaf import x\n")
     (pkg / "sub" / "__init__.py").write_text("import numpy\n")
     (pkg / "sub" / "leaf.py").write_text("x = 1\n")
-    for m in ("constants", "telemetry", "faults", "plans"):
+    for m in ("constants", "telemetry", "faults", "plans", "contract"):
         (pkg / f"{m}.py").write_text("")
     import accl_tpu.analysis.graph as graph_mod
 
@@ -253,6 +253,7 @@ def test_jax_free_module_detects_violation(tmp_path, monkeypatch):
     (pkg / "telemetry.py").write_text("from .constants import X\n")
     (pkg / "faults.py").write_text("")
     (pkg / "plans.py").write_text("")
+    (pkg / "contract.py").write_text("")
     import accl_tpu.analysis.base as base_mod
 
     monkeypatch.setattr(base_mod, "package_root", lambda: str(pkg))
@@ -277,7 +278,7 @@ def test_jax_free_module_sees_with_block_imports(tmp_path, monkeypatch):
         "with contextlib.suppress(ImportError):\n"
         "    import numpy\n"
     )
-    for m in ("constants", "overlap", "telemetry", "faults"):
+    for m in ("constants", "overlap", "telemetry", "faults", "contract"):
         (pkg / f"{m}.py").write_text("")
     import accl_tpu.analysis.base as base_mod
     import accl_tpu.analysis.graph as graph_mod
@@ -292,7 +293,7 @@ def test_jax_free_module_sees_with_block_imports(tmp_path, monkeypatch):
 
 
 def test_jax_free_modules_import_without_heavy_stack():
-    """Runtime proof of the static claim: load the five modules in a
+    """Runtime proof of the static claim: load the six modules in a
     subprocess with jax/numpy/ml_dtypes import-blocked (the package
     __init__ bypassed, exactly as a jax-free rank process loads them)."""
     code = textwrap.dedent("""
@@ -311,7 +312,8 @@ def test_jax_free_modules_import_without_heavy_stack():
         pkg = types.ModuleType('accl_tpu')
         pkg.__path__ = [root]
         sys.modules['accl_tpu'] = pkg
-        for m in ('constants', 'overlap', 'telemetry', 'faults', 'plans'):
+        for m in ('constants', 'overlap', 'telemetry', 'faults', 'plans',
+                  'contract'):
             spec = importlib.util.spec_from_file_location(
                 'accl_tpu.' + m, os.path.join(root, m + '.py'))
             mod = importlib.util.module_from_spec(spec)
@@ -661,3 +663,311 @@ def test_committed_lock_hierarchy_snapshot_is_sane():
     # PlanCache — releases before calling out, which is why the
     # committed graph is so small; the detector proves that stays true)
     assert families & {"FlightRecorder", "MetricsRegistry"}
+
+
+# ---------------------------------------------------------------------------
+# thread-naming
+# ---------------------------------------------------------------------------
+
+
+BAD_THREADS = [
+    "threading.Thread(target=f)",
+    "threading.Thread(target=f, daemon=True)",
+    'threading.Thread(target=f, name="worker-1")',
+    'Thread(target=f, name="drainer")',
+    # import aliases must not bypass the guard
+    "th.Thread(target=f)",
+    'T(target=f, name="oops")',
+]
+
+GOOD_THREADS = [
+    'threading.Thread(target=f, name="accl-engine-x", daemon=True)',
+    'threading.Thread(target=f, name=f"accl-fabric-{addr}")',
+    'Thread(target=f, name="accl-dist-op")',
+    "threading.Thread(target=f, name=make_name())",  # non-literal: trusted
+    "threading.Timer(1.0, f)",  # Timer is not Thread(); out of scope
+]
+
+
+@pytest.mark.parametrize("code", BAD_THREADS)
+def test_thread_naming_flags(tmp_path, code):
+    findings = _live(
+        _lint(tmp_path, f"""
+            import threading
+            import threading as th
+            from threading import Thread
+            from threading import Thread as T
+            def g(f, addr, make_name):
+                t = {code}
+        """),
+        "thread-naming",
+    )
+    assert len(findings) == 1, code
+
+
+@pytest.mark.parametrize("code", GOOD_THREADS)
+def test_thread_naming_passes(tmp_path, code):
+    findings = _live(
+        _lint(tmp_path, f"""
+            import threading
+            import threading as th
+            from threading import Thread
+            from threading import Thread as T
+            def g(f, addr, make_name):
+                t = {code}
+        """),
+        "thread-naming",
+    )
+    assert findings == [], code
+
+
+def test_thread_naming_suppressible(tmp_path):
+    findings = _lint(tmp_path, """
+        import threading
+        def g(f):
+            t = threading.Thread(target=f)  # acclint: allow[thread-naming] short-lived probe
+    """, ["thread-naming"])
+    assert findings and all(f.suppressed for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# collective-sequence (the static half of the contract plane)
+# ---------------------------------------------------------------------------
+
+
+BAD_SEQUENCES = [
+    # op choice branched on rank
+    """
+    def work(accl, rank, world):
+        if rank == 0:
+            accl.allreduce(a, b, 64)
+        else:
+            accl.allgather(a, b, 64)
+    """,
+    # count derived from rank
+    """
+    def work(accl, rank, world):
+        n = 64 + rank
+        accl.allreduce(a, b, n)
+    """,
+    # root keyword from rank
+    """
+    def work(accl, rank, world):
+        accl.bcast(buf, 64, root=rank % world)
+    """,
+    # tag from process-local id()
+    """
+    def work(accl, comm):
+        accl.allreduce(a, b, 64, tag=id(comm) & 0xFF)
+    """,
+    # comm choice from a health map
+    """
+    def work(accl, comms):
+        live = accl.capabilities()["health"]
+        accl.barrier(comm=pick(live))
+    """,
+    # count via a tainted same-module helper (the interprocedural hop)
+    """
+    def shard(rank, n):
+        return n // (rank + 1)
+    def work(accl, rank):
+        accl.allreduce(a, b, shard(rank, 64))
+    """,
+    # op guarded by unseeded process RNG
+    """
+    import random
+    def work(accl):
+        if random.random() < 0.5:
+            accl.barrier()
+    """,
+    # batch boundary under a rank branch (the contract extends to
+    # batches)
+    """
+    def work(accl, rank):
+        if rank == 0:
+            accl.begin_batch()
+    """,
+]
+
+GOOD_SEQUENCES = [
+    # rank-varying OPERANDS are the API working as designed
+    """
+    def work(accl, rank, world):
+        send = accl.create_buffer_from(data) if rank == 0 else None
+        accl.scatter(send, recv, 64, root=0)
+    """,
+    # uniform loop bounds / uniform fields
+    """
+    def work(accl, rank, world):
+        for root in range(world):
+            accl.bcast(buf, 256, root=root)
+    """,
+    # rank flows into DATA, not contract fields
+    """
+    def work(accl, rank, world):
+        chunk = make_data(700 + rank * 13)
+        send = accl.create_buffer_from(chunk)
+        accl.allreduce(send, recv, 256)
+    """,
+    # an @spmd_uniform-marked helper sanitizes its result by contract
+    """
+    from accl_tpu.analysis.markers import spmd_uniform
+    @spmd_uniform
+    def bucket(n):
+        return 1 << n.bit_length()
+    def work(accl, rank):
+        accl.allreduce(a, b, bucket(64))
+    """,
+    # create_communicator is the blessed split constructor: per-rank
+    # membership in, uniform handle out
+    """
+    def work(accl, rank, world):
+        half = list(range(world // 2)) if rank < world // 2 else \
+            list(range(world // 2, world))
+        sub = accl.create_communicator(half)
+        if sub is not None:
+            accl.allreduce(a, b, 64, comm=sub)
+    """,
+    # bare-name reduce is functools.reduce, not a collective
+    """
+    from functools import reduce
+    def work(rank, xs):
+        return reduce(lambda a, b: a + b, xs, rank)
+    """,
+]
+
+
+@pytest.mark.parametrize("code", BAD_SEQUENCES)
+def test_collective_sequence_flags(tmp_path, code):
+    findings = _live(
+        _lint(tmp_path, code, ["collective-sequence"]),
+        "collective-sequence",
+    )
+    assert findings, code
+
+
+@pytest.mark.parametrize("code", GOOD_SEQUENCES)
+def test_collective_sequence_passes(tmp_path, code):
+    findings = _live(
+        _lint(tmp_path, code, ["collective-sequence"]),
+        "collective-sequence",
+    )
+    assert findings == [], (code, [f.render() for f in findings])
+
+
+def test_collective_sequence_suppressible(tmp_path):
+    findings = _lint(tmp_path, """
+        def work(accl, rank, world):
+            # acclint: allow[collective-sequence] ranks rejoin at the barrier below
+            accl.bcast(buf, 64, root=rank)
+    """, ["collective-sequence"])
+    assert findings and all(f.suppressed for f in findings)
+
+
+def test_collective_sequence_covers_shared_scenarios(tmp_path, monkeypatch):
+    """The default (package) run must also analyze the extra-scope
+    shared scenario library outside the package — proved by pointing
+    extra_scope at a planted bad file and asserting the default run
+    flags it (a broken extra-scope wiring would pass a
+    file-exists-and-clean assertion vacuously)."""
+    scen = os.path.join(REPO, "tests", "shared_scenarios.py")
+    assert os.path.isfile(scen)
+    assert _live(
+        run_checks(checks=["collective-sequence"]), "collective-sequence"
+    ) == []
+    planted = tmp_path / "scenarios.py"
+    planted.write_text(textwrap.dedent("""
+        def work(accl, rank, world):
+            accl.bcast(buf, 64, root=rank)
+    """))
+    import accl_tpu.analysis as analysis_mod
+
+    monkeypatch.setattr(
+        analysis_mod, "extra_scope", lambda: [str(planted)]
+    )
+    findings = _live(
+        run_checks(checks=["collective-sequence"]), "collective-sequence"
+    )
+    assert [f for f in findings if f.path == str(planted)], (
+        "default run did not analyze the extra-scope file"
+    )
+
+
+def test_collective_sequence_whole_tree_clean():
+    assert _live(run_checks(), "collective-sequence") == []
+
+
+# ---------------------------------------------------------------------------
+# SARIF output
+# ---------------------------------------------------------------------------
+
+
+def test_sarif_output_shape(tmp_path):
+    from accl_tpu.analysis.__main__ import to_sarif
+
+    findings = _lint(tmp_path, """
+        import threading
+        def g(f):
+            a = threading.Thread(target=f)
+            b = threading.Thread(target=f)  # acclint: allow[thread-naming] probe
+    """, ["thread-naming"])
+    doc = to_sarif(findings)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "acclint"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert set(CHECKS) <= rule_ids
+    results = run["results"]
+    assert len(results) == 2
+    by_level = {r["level"] for r in results}
+    assert by_level == {"error", "note"}
+    supp = next(r for r in results if r["level"] == "note")
+    assert supp["suppressions"][0]["justification"] == "probe"
+    loc = results[0]["locations"][0]["physicalLocation"]
+    assert loc["region"]["startLine"] >= 1
+    assert not loc["artifactLocation"]["uri"].startswith("/") or True
+
+
+def test_sarif_cli_exit_codes(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import threading\nt = threading.Thread()\n")
+    out = subprocess.run(
+        [sys.executable, "-m", "accl_tpu.analysis", "--sarif", str(bad)],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert out.returncode == 1
+    doc = json.loads(out.stdout)
+    assert doc["runs"][0]["results"]
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    out = subprocess.run(
+        [sys.executable, "-m", "accl_tpu.analysis", "--sarif", str(good)],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert out.returncode == 0
+    assert json.loads(out.stdout)["runs"][0]["results"] == []
+
+
+def test_collective_sequence_flags_rank_varying_loop_count(tmp_path):
+    """A for-loop whose ITERABLE derives from rank governs the trip
+    count: collectives inside run a different number of times per rank
+    — call-count divergence, flagged like a branch."""
+    findings = _live(
+        _lint(tmp_path, """
+            def work(accl, rank, world):
+                for _ in range(rank):
+                    accl.barrier()
+        """, ["collective-sequence"]),
+        "collective-sequence",
+    )
+    assert findings and "barrier" in findings[0].message
+    # uniform loop bounds stay clean
+    findings = _live(
+        _lint(tmp_path, """
+            def work(accl, rank, world):
+                for _ in range(world):
+                    accl.barrier()
+        """, ["collective-sequence"]),
+        "collective-sequence",
+    )
+    assert findings == []

@@ -419,3 +419,161 @@ def test_subcomm_recv_with_fully_parked_pool():
     finally:
         for a in group:
             a.deinit()
+
+
+# ---------------------------------------------------------------------------
+# contract-verifier matrix (accl_tpu.contract): every way two ranks can
+# tear the SPMD call sequence must FAIL FAST with CONTRACT_VIOLATION and
+# the diverging rank named in ACCLError.details — never hang to the
+# engine deadline.  Runs on BOTH emulator transports via fresh_group2
+# (InProc: board + wire piggyback; socket: wire piggyback + relay).
+# ---------------------------------------------------------------------------
+
+
+def _drive_contract(group, works, timeout_s=20.0):
+    """Run works[rank] on its own thread; returns ({rank: ACCLError},
+    elapsed).  interval=1 so the first torn call is also a window
+    boundary — detection within ACCL_VERIFY_INTERVAL calls."""
+    from accl_tpu import ACCLError as _E
+
+    for a in group:
+        a.set_timeout(timeout_s)
+        a.set_contract_verify(True, interval=1)
+    errs = {}
+
+    def runner(rank):
+        try:
+            works[rank](group[rank])
+        except _E as e:
+            errs[rank] = e
+
+    import time as _time
+
+    threads = [
+        threading.Thread(
+            target=runner, args=(i,), name=f"accl-test-contract{i}",
+            daemon=True,
+        )
+        for i in range(len(group))
+    ]
+    t0 = _time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert all(not t.is_alive() for t in threads), "rank thread hung"
+    return errs, _time.monotonic() - t0
+
+
+def _assert_contract_failfast(errs, elapsed, diverging_rank=1):
+    """Fail-fast (nowhere near the 20 s deadline), CONTRACT_VIOLATION
+    on every failing rank, and the CONFORMING rank 0's report names the
+    diverging rank (pairwise blame at world=2 is two-party-symmetric:
+    production reads the conforming side's verdict)."""
+    assert elapsed < 10, f"not fail-fast: {elapsed:.1f}s"
+    assert 0 in errs, "conforming rank never failed (would have hung)"
+    for e in errs.values():
+        assert e.code == ErrorCode.CONTRACT_VIOLATION, e
+    assert errs[0].details["diverging_rank"] == diverging_rank
+    assert errs[0].details["contract"]["kind"] == "divergence"
+    assert "flight_recorder" in errs[0].details
+
+
+def test_contract_mismatched_op_order_fails_fast(fresh_group2):
+    """rank 0: [allreduce, allreduce]; rank 1: [allgather, allreduce] —
+    the op-order tear that classically wedges both ranks until their
+    receive deadlines."""
+
+    def work0(a):
+        s = a.create_buffer_from(np.ones(8, np.float32))
+        d = a.create_buffer(8, np.float32)
+        for _ in range(3):
+            a.allreduce(s, d, 8)
+
+    def work1(a):
+        s = a.create_buffer_from(np.full(8, 2.0, np.float32))
+        d = a.create_buffer(8, np.float32)
+        r = a.create_buffer(16, np.float32)
+        a.allgather(s, r, 8)
+        for _ in range(2):
+            a.allreduce(s, d, 8)
+
+    errs, elapsed = _drive_contract(fresh_group2, {0: work0, 1: work1})
+    _assert_contract_failfast(errs, elapsed)
+    # the verdict carries its evidence: the mismatched window plus the
+    # (local or relayed) recent-call ring
+    assert "window" in errs[0].details["contract"]
+
+
+def test_contract_mismatched_count_fails_fast(fresh_group2):
+    def work0(a):
+        s = a.create_buffer_from(np.ones(16, np.float32))
+        d = a.create_buffer(16, np.float32)
+        for _ in range(3):
+            a.allreduce(s, d, 16)
+
+    def work1(a):
+        s = a.create_buffer_from(np.full(16, 2.0, np.float32))
+        d = a.create_buffer(16, np.float32)
+        a.allreduce(s, d, 16)
+        a.allreduce(s, d, 8)  # the torn count
+        a.allreduce(s, d, 16)
+
+    errs, elapsed = _drive_contract(fresh_group2, {0: work0, 1: work1})
+    _assert_contract_failfast(errs, elapsed)
+
+
+def test_contract_mismatched_root_fails_fast(fresh_group2):
+    # both works end in a blocking allreduce: a ROOT's bcast is fire-
+    # and-forget on the emulator, so without it rank 0 would complete
+    # its whole (conforming) sequence before the verdict can reach it —
+    # the trailing collective is where its fail-fast must land
+    def work0(a):
+        b = a.create_buffer_from(np.ones(8, np.float32))
+        d = a.create_buffer(8, np.float32)
+        for _ in range(3):
+            a.bcast(b, 8, root=0)
+        a.allreduce(b, d, 8)
+
+    def work1(a):
+        b = a.create_buffer(8, np.float32)
+        d = a.create_buffer(8, np.float32)
+        a.bcast(b, 8, root=0)
+        a.bcast(b, 8, root=1)  # the torn root
+        a.bcast(b, 8, root=0)
+        a.allreduce(b, d, 8)
+
+    errs, elapsed = _drive_contract(fresh_group2, {0: work0, 1: work1})
+    _assert_contract_failfast(errs, elapsed)
+
+
+def test_contract_subcomm_epoch_skew_fails_fast(fresh_group2):
+    """Rank 1 re-creates the subcommunicator (a fresh instance epoch)
+    while rank 0 keeps using the original: the begin marker folded into
+    rank 1's digest stream diverges it at the next boundary — the skew
+    that otherwise surfaces as seqn-dedup silently discarding the fresh
+    instance's traffic."""
+
+    def work0(a):
+        sub = a.create_communicator([0, 1])
+        s = a.create_buffer_from(np.ones(8, np.float32))
+        d = a.create_buffer(8, np.float32)
+        for _ in range(4):
+            a.allreduce(s, d, 8, comm=sub)
+
+    def work1(a):
+        sub = a.create_communicator([0, 1])
+        s = a.create_buffer_from(np.full(8, 2.0, np.float32))
+        d = a.create_buffer(8, np.float32)
+        a.allreduce(s, d, 8, comm=sub)
+        sub = a.create_communicator([0, 1])  # the skewed re-create
+        for _ in range(3):
+            a.allreduce(s, d, 8, comm=sub)
+
+    errs, elapsed = _drive_contract(fresh_group2, {0: work0, 1: work1})
+    assert elapsed < 10, f"not fail-fast: {elapsed:.1f}s"
+    assert errs, "skew never detected"
+    for e in errs.values():
+        assert e.code == ErrorCode.CONTRACT_VIOLATION
+    if 0 in errs:
+        assert errs[0].details["diverging_rank"] == 1
