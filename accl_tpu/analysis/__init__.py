@@ -14,8 +14,9 @@ Checks (each individually suppressible with
 
 ========================  ==================================================
 unbounded-wait            blocking acquire/wait/join/get without a timeout
-jax-free-module           overlap/telemetry/faults/plans/constants/contract
-                          must import without jax/numpy at module scope
+jax-free-module           overlap/telemetry/faults/plans/constants/
+                          contract/monitor must import without jax/numpy
+                          at module scope
 timer-discipline          no time.time() windows; use utils.timing
 spmd-uniformity           @spmd_uniform functions must not branch on
                           process-local state
@@ -26,6 +27,9 @@ collective-sequence       collective op choice / count / root / tag must
 thread-naming             threading.Thread(...) under accl_tpu must pass
                           name="accl-..." (the conftest excepthook guard
                           keys on the prefix)
+metric-naming             registry metric names (.inc / gauge) must
+                          carry the accl_ prefix (the scrape endpoint
+                          exposes them verbatim)
 drain-before-config       config writes / soft_reset reach a drain call
 error-context             raised ACCLError carries structured details
 ========================  ==================================================

@@ -39,6 +39,7 @@ __all__ = [
     "check_error_context",
     "check_spmd_uniformity",
     "check_thread_naming",
+    "check_metric_naming",
 ]
 
 
@@ -404,10 +405,74 @@ def check_thread_naming(src: SourceFile) -> List[Finding]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# metric-naming
+# ---------------------------------------------------------------------------
+
+#: call names whose first string argument is a registry metric name:
+#: MetricsRegistry.inc / the exporter's gauge() emitter.  (record_call's
+#: counter keys are literal tuples inside telemetry.py itself and carry
+#: the prefix by construction.)
+_METRIC_CALL_NAMES = frozenset(("inc", "gauge"))
+_METRIC_PREFIX = "accl_"
+
+
+def _literal_prefix(node: ast.AST):
+    """The leading literal text of a str constant or f-string, or None
+    when the first piece is dynamic (nothing checkable)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr) and node.values and isinstance(
+        node.values[0], ast.Constant
+    ) and isinstance(node.values[0].value, str):
+        return node.values[0].value
+    return None
+
+
+def check_metric_naming(src: SourceFile) -> List[Finding]:
+    """Every metric name handed to the registry (``.inc(...)`` /
+    ``gauge(...)``) must carry the ``accl_`` prefix: the scrape
+    endpoint exposes the registry verbatim, and an unprefixed metric
+    collides with every other exporter on the Prometheus server —
+    operators filter dashboards and alerts on the prefix."""
+    out: List[Finding] = []
+    for node in src.nodes:
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        f = node.func
+        name = (
+            f.id if isinstance(f, ast.Name)
+            else f.attr if isinstance(f, ast.Attribute) else None
+        )
+        if name not in _METRIC_CALL_NAMES:
+            continue
+        literal = _literal_prefix(node.args[0])
+        if literal is None:
+            # dynamic first piece: nothing to check statically (dict
+            # .inc lookalikes pass a variable; real metric sites in
+            # this tree all start with a literal)
+            continue
+        if not literal.startswith(_METRIC_PREFIX):
+            # `inc` is a common method name (collections.Counter-style
+            # helpers): only flag when the literal LOOKS like a metric
+            # name (a snake_case identifier) to keep false positives
+            # out of non-registry call sites
+            if name == "inc" and not literal.replace("_", "").isalnum():
+                continue
+            out.append(src.finding(
+                "metric-naming", node,
+                f"metric name {literal!r} does not start with "
+                f"'{_METRIC_PREFIX}': every registry metric must carry "
+                f"the project prefix so scrapes stay filterable",
+            ))
+    return out
+
+
 PER_FILE_CHECKS = {
     "unbounded-wait": check_unbounded_wait,
     "timer-discipline": check_timer_discipline,
     "error-context": check_error_context,
     "spmd-uniformity": check_spmd_uniformity,
     "thread-naming": check_thread_naming,
+    "metric-naming": check_metric_naming,
 }

@@ -38,6 +38,7 @@ JAX_FREE_MODULES = (
     "accl_tpu.plans",
     "accl_tpu.constants",
     "accl_tpu.contract",
+    "accl_tpu.monitor",
 )
 
 #: top-level packages whose module-scope import breaks jax-freedom

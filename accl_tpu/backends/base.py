@@ -165,6 +165,28 @@ class BaseEngine:
     #: the facade-armed ContractVerifier (None = verification off)
     contract_verifier = None
 
+    #: the facade's straggler SkewTracker (monitor plane; None = off)
+    skew_tracker = None
+
+    def set_skew_tracker(self, tracker) -> None:
+        """Arm (or with ``None`` disarm) the monitor plane's cross-rank
+        skew exchange on this engine.  Default: store the handle — on
+        board-anchored tiers (InProc emulator, XLA gang) the shared
+        judge does the exchanging and the engine has nothing to wire;
+        fabric tiers override to observe peers' piggybacked window
+        claims at delivery (the contract plane's stamp cadence,
+        reused)."""
+        self.skew_tracker = tracker
+
+    def skew_exchange_mode(self) -> str:
+        """How this tier's straggler samples cross ranks: ``"board"``
+        (shared in-process judge via ``contract_anchor()``), ``"wire"``
+        (per-message piggyback), or ``"local"`` (single-rank baselines
+        only — the dist tier's cross-process exchange rides ROADMAP
+        item 2's topology work, like the contract plane's KV
+        piggyback)."""
+        return "board" if self.contract_anchor() is not None else "local"
+
     def set_contract_verifier(self, verifier) -> None:
         """Arm (or with ``None`` disarm) engine-side contract checks.
         Default: store the handle — the facade's intake screen is the
@@ -195,6 +217,7 @@ class BaseEngine:
         return {
             "device_interactions": self.device_interactions(),
             "faults": None,
+            "skew_exchange": self.skew_exchange_mode(),
         }
 
     def create_buffer(self, count: int, dtype, host_only: bool = False,

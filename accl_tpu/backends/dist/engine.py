@@ -289,6 +289,10 @@ class DistEngine(StreamPortMixin, BaseEngine):
             "remote_stream_seq": stream_seq,
             "cached_meshes": len(self._meshes),
             "faults": None,
+            # monitor plane: per-rank baselines only — the cross-
+            # process skew exchange rides ROADMAP item 2's topology
+            # work, like the contract plane's KV piggyback above
+            "skew_exchange": "local",
         }
 
     def drain_inflight(self, timeout=None) -> bool:

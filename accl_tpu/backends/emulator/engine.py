@@ -255,6 +255,34 @@ class EmuEngine(BaseEngine):
         self.endpoint.contract_hook = observe
         verifier.add_verdict_listener(lambda _vd: self._wake.set())
 
+    # -- monitor plane (accl_tpu.monitor) ------------------------------------
+    def set_skew_tracker(self, tracker) -> None:
+        """Arm straggler-skew exchange: peers' piggybacked (window,
+        mean_wait) claims are observed at delivery — same cadence and
+        hook shape as the contract digest piggyback.  On the InProc
+        fabric the shared judge already exchanges in-process; the hook
+        is still wired so the one mechanism covers both fabrics."""
+        self.skew_tracker = tracker
+        if tracker is None:
+            self.endpoint.skew_hook = None
+            return
+
+        def observe(msg, tracker=tracker):
+            if msg.sent_ns:
+                tracker.on_message(
+                    msg.comm_id, msg.src, time.time_ns() - msg.sent_ns
+                )
+            tracker.observe_claim(
+                msg.comm_id, msg.src, msg.skw_window, msg.skw_mean_us
+            )
+
+        self.endpoint.skew_hook = observe
+
+    def skew_exchange_mode(self) -> str:
+        from .fabric import InProcFabric
+
+        return "board" if isinstance(self.fabric, InProcFabric) else "wire"
+
     def _contract_verdict_for(self, options: Optional[CallOptions]):
         v = self.contract_verifier
         if (
@@ -427,6 +455,10 @@ class EmuEngine(BaseEngine):
             "retry_limit": self.retry_limit,
             "inflight_window": self.inflight_window,
             "faults": inj.stats() if inj is not None else None,
+            # monitor plane: how this rank's straggler samples reach
+            # its peers (board = shared in-process judge, wire = the
+            # per-message piggyback on the socket fabric)
+            "skew_exchange": self.skew_exchange_mode(),
         }
 
     # -- scheduler ----------------------------------------------------------

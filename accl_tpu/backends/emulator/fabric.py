@@ -28,6 +28,7 @@ import socket
 import struct
 import sys
 import threading
+import time
 import zlib
 from typing import Callable, Dict, List, Optional
 
@@ -77,6 +78,18 @@ class Message:
     vfy_gen: int = 0
     vfy_window: int = -1
     vfy_digest: int = 0
+    # monitor plane (accl_tpu.monitor): the sender's latest completed
+    # straggler-skew window (window index + mean wait in us) rides the
+    # same piggyback cadence — two header fields, zero extra traffic.
+    # skw_window -1 = no stamp (monitor off or no window completed).
+    skw_window: int = -1
+    skw_mean_us: float = 0.0
+    # send wall-timestamp (time_ns; 0 = unstamped): receivers measure
+    # per-source arrival latency from it — the straggler analyzer's
+    # direct observable of a slow sender/link.  Wall clock because it
+    # is the only clock two processes share; cross-host skew is
+    # whatever NTP leaves (same-host fabrics are exact).
+    sent_ns: int = 0
 
 
 class Endpoint:
@@ -96,6 +109,9 @@ class Endpoint:
         # contract plane: the receiving rank's verifier hook — observes
         # peers' piggybacked digest claims on every delivered message
         self.contract_hook: Optional[Callable[[Message], None]] = None
+        # monitor plane: the receiving rank's skew hook — observes
+        # peers' piggybacked straggler-window claims the same way
+        self.skew_hook: Optional[Callable[[Message], None]] = None
         # wire-integrity accounting: payloads whose crc32 no longer matches
         # the stamped csum are discarded here (the rx dataplane's bit-error
         # detection; the sender's retransmit protocol recovers them)
@@ -127,6 +143,14 @@ class Endpoint:
         ):
             try:
                 hook(msg)  # a verifier failure must never drop traffic
+            except Exception:  # pragma: no cover - defensive
+                pass
+        shook = self.skew_hook
+        if shook is not None and (msg.skw_window >= 0 or msg.sent_ns):
+            # after the csum guard like the contract hook: a corrupt
+            # frame's skew claim must not poison the judge
+            try:
+                shook(msg)
             except Exception:  # pragma: no cover - defensive
                 pass
         if msg.msg_type == MsgType.RNDZV_DATA:
@@ -211,6 +235,24 @@ class Fabric:
             for key in [k for k, v in stamps.items() if v is verifier]:
                 del stamps[key]
 
+    # -- monitor plane (accl_tpu.monitor) ------------------------------------
+    def register_skew(self, comm_id: int, rank: int, tracker) -> None:
+        """Arm outbound straggler-skew stamping for (communicator,
+        sending rank): the send path piggybacks ``tracker.stamp(
+        comm_id)`` — the latest completed (window, mean_wait) — onto
+        every message that rank sends on that communicator, exactly
+        like the contract digest stamp."""
+        stamps = getattr(self, "_skew_stamps", None)
+        if stamps is None:
+            stamps = self._skew_stamps = {}
+        stamps[(comm_id, rank)] = tracker
+
+    def unregister_skew(self, tracker) -> None:
+        stamps = getattr(self, "_skew_stamps", None)
+        if stamps:
+            for key in [k for k, v in stamps.items() if v is tracker]:
+                del stamps[key]
+
     def attach(self, address: str, endpoint: Endpoint) -> None:
         raise NotImplementedError
 
@@ -232,6 +274,16 @@ class Fabric:
                 msg.vfy_gen, msg.vfy_window, msg.vfy_digest = (
                     verifier.stamp(msg.comm_id)
                 )
+        skews = getattr(self, "_skew_stamps", None)
+        if skews:
+            # monitor plane piggyback: the sending rank's latest
+            # completed skew window rides the same one-probe-per-send
+            # discipline as the contract stamp above, plus the send
+            # timestamp receivers measure arrival latency from
+            tracker = skews.get((msg.comm_id, msg.src))
+            if tracker is not None:
+                msg.skw_window, msg.skw_mean_us = tracker.stamp(msg.comm_id)
+                msg.sent_ns = time.time_ns()
         inj = self._injector
         if inj is None:
             self._transmit(address, msg)

@@ -389,6 +389,10 @@ class NativeEngine(BaseEngine):
                 "total": int(self._lib.accl_ng_rx_capacity(self._handle)),
             },
             "faults": None,
+            # monitor plane: per-rank baselines only (no board — the
+            # contract_anchor rationale above applies to the skew judge
+            # identically: sequential groups would cross-compare)
+            "skew_exchange": "local",
         }
 
 

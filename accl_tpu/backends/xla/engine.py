@@ -1826,6 +1826,10 @@ class XLAEngine(StreamPortMixin, BaseEngine):
             # counters (launched/completed/failed/max depth/overlap ns)
             "inflight": self.gang.window.stats(),
             "faults": None,
+            # monitor plane: rank handles share the gang context, so
+            # straggler windows meet on one in-process judge (the
+            # contract board's anchor discipline reused)
+            "skew_exchange": "board",
         }
 
     def health_report(self, comm: Communicator) -> Dict[int, dict]:

@@ -414,3 +414,24 @@ TUNING_DEFAULTS = {
 # ACCL.set_inflight_window / the ACCL_INFLIGHT_WINDOW env var.
 DEFAULT_INFLIGHT_WINDOW = 4
 MAX_INFLIGHT_WINDOW = 64
+
+# Segmented-pipelining wire tags (overlap plane): concurrent segment
+# sub-collectives of ONE pipelined call execute as concurrent engine
+# tasks on the fabric tiers, and eager matching there is strictly
+# seqn-ordered per (comm, peer, tag) with no per-task discrimination —
+# same-tag siblings can steal each other's chunks under scheduler
+# stalls.  Each segment therefore rides a reserved tag derived from a
+# per-comm pipelined-call counter (SPMD-uniform: the split decision is
+# register-driven, so every rank assigns the same tags in the same
+# order).  The base sits below the barrier-reserved space (0x7FFFFFF0)
+# and far above plausible user tags.
+PIPELINE_SEG_TAG_BASE = 0x7E000000
+
+
+def pipeline_segment_tag(call_index: int, segment: int) -> int:
+    """Reserved tag for segment ``segment`` of the ``call_index``-th
+    pipelined collective on a communicator.  The call counter wraps at
+    2^15 (collision would need 32768 pipelined calls concurrently in
+    flight — orders beyond any window bound); segments cap at 64
+    (``MAX_INFLIGHT_WINDOW``-scale, far above practical ring_segments)."""
+    return PIPELINE_SEG_TAG_BASE | ((call_index & 0x7FFF) << 6) | (segment & 0x3F)

@@ -56,6 +56,7 @@ __all__ = [
     "DEFAULT_VERIFY_INTERVAL",
     "VERIFY_ENV",
     "VERIFY_INTERVAL_ENV",
+    "anchored",
     "board_for",
     "call_fingerprint",
     "env_enabled",
@@ -149,23 +150,35 @@ def _injector_for(fabric) -> Optional[object]:
 _board_lock = threading.Lock()
 
 
+def anchored(anchor, attr: str, factory):
+    """One shared exchange object per process-wide ``anchor``: the
+    get-or-create-an-attribute discipline both in-process exchange
+    planes use — the contract board here, and the monitor plane's skew
+    judge (``accl_tpu.monitor.judge_for``) — so rank handles sharing an
+    engine anchor (InProc fabric, XLA gang context) meet on one
+    instance.  None when the anchor is None (one-process-per-rank
+    tiers: the wire piggyback does the exchanging) or cannot hold
+    attributes (slotted/foreign anchor)."""
+    if anchor is None:
+        return None
+    with _board_lock:
+        obj = getattr(anchor, attr, None)
+        if obj is None:
+            obj = factory()
+            try:
+                setattr(anchor, attr, obj)
+            except (AttributeError, TypeError):  # slotted/foreign anchor
+                return None
+        return obj
+
+
 def board_for(anchor) -> Optional["ContractBoard"]:
     """The :class:`ContractBoard` shared by every rank handle anchored
     on ``anchor`` (the engine's ``contract_anchor()``: the InProc
     fabric, the XLA gang context, or the engine itself on
     one-process-per-rank tiers, where the board degenerates to a single
     poster and the wire piggyback does the comparing)."""
-    if anchor is None:
-        return None
-    with _board_lock:
-        board = getattr(anchor, "_accl_contract_board", None)
-        if board is None:
-            board = ContractBoard()
-            try:
-                anchor._accl_contract_board = board
-            except (AttributeError, TypeError):  # slotted/foreign anchor
-                return None
-        return board
+    return anchored(anchor, "_accl_contract_board", ContractBoard)
 
 
 class ContractBoard:

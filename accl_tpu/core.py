@@ -38,6 +38,7 @@ from .constants import (
     StreamFlags,
     dtype_size,
     numpy_to_dtype,
+    pipeline_segment_tag,
 )
 from .contract import ContractVerifier, board_for, env_enabled as _verify_env
 from .contract import verdict_context
@@ -98,6 +99,41 @@ class ACCL:
             rank=local_rank, tier=type(engine).__name__
         )
         self._call_tls = threading.local()
+        # segmented-pipelining call counter per communicator id: every
+        # rank advances it identically (the split decision is register-
+        # driven and SPMD-uniform), so the reserved per-segment tags it
+        # derives match across ranks — concurrent segment tasks of one
+        # pipelined collective must never share a (comm, src, tag)
+        # matching signature on the fabric tiers (the cross-segment
+        # steal race test_segmented_pipelining_emulator caught)
+        self._pipeline_ctr: dict = {}
+        # monitor plane (accl_tpu.monitor): continuous observability —
+        # straggler tracker + anomaly watchdog riding the telemetry
+        # completion observer, plus the opt-in scrape service
+        # (ACCL_MONITOR_PORT / start_monitor()) and streaming trace
+        # writer (ACCL_TRACE_STREAM).  None when telemetry is killed.
+        self._monitor = None
+        if self._telemetry is not None:
+            from . import monitor as _monitor
+
+            self._monitor = _monitor.Monitor(
+                rank=local_rank, world=len(ranks),
+                telemetry=self._telemetry,
+                anchor=engine.contract_anchor(),
+                tier=type(engine).__name__,
+            )
+            # one-process-per-rank fabrics exchange skew windows by
+            # piggybacking (window, mean_wait) on outgoing messages —
+            # the contract plane's stamp cadence, reused
+            self._monitor.tracker.begin_comm(
+                self._world.id, local_rank, len(ranks)
+            )
+            fabric = getattr(engine, "fabric", None)
+            if fabric is not None and hasattr(fabric, "register_skew"):
+                fabric.register_skew(
+                    self._world.id, local_rank, self._monitor.tracker
+                )
+            engine.set_skew_tracker(self._monitor.tracker)
         # contract plane (accl_tpu.contract): the opt-in cross-rank
         # runtime verifier — every collective call fingerprinted into a
         # per-communicator rolling digest, exchanged with the other
@@ -108,6 +144,34 @@ class ACCL:
         self._initialize(timeout_s, max_eager_size, max_rendezvous_size)
         if _verify_env():
             self.set_contract_verify(True)
+        if self._monitor is not None:
+            from . import monitor as _monitor
+
+            if _monitor.env_port() is not None:
+                try:
+                    self.start_monitor()
+                except OSError as e:
+                    # in-process multi-rank groups race for one port:
+                    # the first handle serves, the rest log and skip
+                    # (pass port=0 / per-rank ports to serve them all)
+                    import sys
+
+                    print(
+                        f"[accl] monitor port busy, not serving rank "
+                        f"{local_rank}: {e}",
+                        file=sys.stderr,
+                    )
+            tdir = os.environ.get(_monitor.TRACE_STREAM_ENV)
+            if tdir:
+                try:
+                    self._monitor.start_trace_stream(tdir)
+                except OSError as e:  # a bad dir must not brick startup
+                    import sys
+
+                    print(
+                        f"[accl] ignoring ACCL_TRACE_STREAM={tdir!r}: {e}",
+                        file=sys.stderr,
+                    )
         env_plan = os.environ.get("ACCL_TUNING_PLAN")
         if env_plan:
             try:
@@ -196,6 +260,17 @@ class ACCL:
             # digest generation — collective by contract (like the reset
             # itself), so generations stay aligned across ranks
             self._contract.reset()
+        if self._monitor is not None:
+            # skew baselines and standing slow_rank verdicts are about
+            # the PRE-reset regime; recovery starts them fresh too —
+            # but the memberships survive (like the contract verifier's
+            # reset), so early post-reset claims keep resolving in the
+            # right rank space
+            self._monitor.reset()
+            for comm in self._communicators:
+                self._monitor.tracker.begin_comm(
+                    comm.id, comm.local_rank, comm.size
+                )
 
     def set_timeout(self, seconds: float) -> None:
         self._config(ConfigFunction.SET_TIMEOUT, seconds)
@@ -549,6 +624,20 @@ class ACCL:
         comm = base.split(members, comm_id=comm_id)
         if comm is not None:
             self._communicators.append(comm)
+            if self._monitor is not None:
+                # straggler windows on the subcomm piggyback like the
+                # world comm's; membership registered up front so a
+                # peer's early claims resolve in the subcomm's rank
+                # space (board tiers need no fabric registration — the
+                # shared judge keys on comm id)
+                self._monitor.tracker.begin_comm(
+                    comm.id, comm.local_rank, comm.size
+                )
+                fabric = getattr(self.engine, "fabric", None)
+                if fabric is not None and hasattr(fabric, "register_skew"):
+                    fabric.register_skew(
+                        comm.id, comm.local_rank, self._monitor.tracker
+                    )
             if self._contract is not None:
                 # register membership + fold a begin marker into the
                 # digest stream (a rank that re-creates a subcomm its
@@ -708,6 +797,11 @@ class ACCL:
             "op": options.op.name.lower(),
             "comm": comm.id if comm is not None else None,
             "epoch": comm.epoch if comm is not None else None,
+            # comm-relative identity for the monitor plane's skew
+            # tracker (a subcomm's straggler blame lives in ITS rank
+            # space, like every contract-plane rank field)
+            "comm_rank": comm.local_rank if comm is not None else None,
+            "comm_world": comm.size if comm is not None else None,
             "dtype": dt.name if dt is not None else None,
             "count": options.count,
             "nbytes": (
@@ -734,6 +828,12 @@ class ACCL:
             details = {"flight_recorder": self._telemetry.tail_dicts()}
         return ACCLError(ErrorCode.DEADLOCK_SUSPECTED, context,
                          details=details)
+
+    def _seg_tag(self) -> int:
+        """The reserved wire tag for the pipelined segment currently
+        being launched on this thread (0 outside a pipelined launch, and
+        on fabric-less engines — see _launch_pipelined)."""
+        return getattr(self._call_tls, "pipeline_tag", 0) or 0
 
     def _pipeline_segments_for(self, plan, count: int, dtype) -> int:
         """Sub-launch count for this call, from the plan's cached
@@ -766,6 +866,27 @@ class ACCL:
                 bounds.append((start, stop))
             start = stop
 
+        # On the fabric tiers, concurrent segment sub-collectives of one
+        # pipelined call MUST NOT share a (comm, src, tag) matching
+        # signature: eager matching is strictly seqn-ordered per peer
+        # with no per-task discrimination, and under scheduler stalls a
+        # segment task can consume a chunk addressed to its sibling
+        # (the test_segmented_pipelining_emulator ~1/25 corruption).
+        # Each segment therefore rides a RESERVED tag derived from a
+        # per-comm pipelined-call counter — SPMD-uniform, because every
+        # rank's registers select the same splits in the same order.
+        # Device tiers (no fabric) keep tag 0: their ordering contract
+        # is the gang's SPMD seqn slots, and a varying tag would churn
+        # their program cache keys for nothing.
+        seg_tags = None
+        call_idx = self._pipeline_ctr.get(comm.id, 0)
+        self._pipeline_ctr[comm.id] = call_idx + 1
+        if getattr(self.engine, "fabric", None) is not None:
+            seg_tags = [
+                pipeline_segment_tag(call_idx, i)
+                for i in range(len(bounds))
+            ]
+
         outer = Request(op_name=op_name.upper())
         outer.mark_executing()
         if self._pending is not None:
@@ -789,6 +910,7 @@ class ACCL:
             dt = plan.arithcfg.uncompressed
             meta = {
                 "op": op_name, "comm": comm.id, "epoch": comm.epoch,
+                "comm_rank": comm.local_rank, "comm_world": comm.size,
                 "dtype": dt.name, "count": count,
                 "nbytes": count * dtype_size(dt),
                 "bucket": plan.bucket, "algorithm": plan.algorithm,
@@ -798,9 +920,15 @@ class ACCL:
         t0 = time.perf_counter_ns()
         self._call_tls.pipelining = True
         try:
-            inner = [launch_seg(s0, s1) for (s0, s1) in bounds]
+            inner = []
+            for i, (s0, s1) in enumerate(bounds):
+                self._call_tls.pipeline_tag = (
+                    seg_tags[i] if seg_tags is not None else 0
+                )
+                inner.append(launch_seg(s0, s1))
         finally:
             self._call_tls.pipelining = False
+            self._call_tls.pipeline_tag = 0
 
         def _resolve(inner=inner):
             for q in inner:
@@ -1213,6 +1341,7 @@ class ACCL:
             comm=comm,
             count=n,
             root_src=root,
+            tag=self._seg_tag(),
             arithcfg=plan.arithcfg,
             compression=plan.compression,
             host=host,
@@ -1425,6 +1554,7 @@ class ACCL:
             op=Operation.ALLREDUCE,
             comm=comm,
             count=n,
+            tag=self._seg_tag(),
             reduce_function=function,
             arithcfg=plan.arithcfg,
             compression=plan.compression,
@@ -1565,7 +1695,7 @@ class ACCL:
         comm = comm or self._world
         doc = {
             "comm": comm.as_dict(),
-            "health": self.engine.health_report(comm),
+            "health": self._annotated_health(comm),
         }
         if as_dict:
             return doc
@@ -1602,8 +1732,12 @@ class ACCL:
         from . import telemetry as _t
 
         tel = self._telemetry
+        mon = self._monitor
         engine_report = self.engine.telemetry_report()
         return {
+            # bumped when the merged shape changes (see telemetry.
+            # SCHEMA_VERSION); dashboards key on this, not sniffing
+            "schema_version": _t.SCHEMA_VERSION,
             "telemetry_enabled": tel is not None,
             "rank": self._world.local_rank,
             "world": self._world.size,
@@ -1613,7 +1747,7 @@ class ACCL:
             "metrics": tel.metrics.snapshot() if tel else {},
             "wire_trace": _t.wire_snapshot(),
             "plan_cache": self._plans.stats(),
-            "health": self.engine.health_report(self._world),
+            "health": self._annotated_health(self._world),
             "device_interactions": self.engine.device_interactions(),
             "engine": engine_report,
             "faults": engine_report.get("faults"),
@@ -1623,7 +1757,34 @@ class ACCL:
                 self._contract.snapshot()
                 if self._contract is not None else {"enabled": False}
             ),
+            # monitor plane: cross-rank straggler verdicts, per-(op x
+            # bucket) anomaly alerts, and the live-service state (the
+            # one-line answer to "which rank is slow?")
+            "stragglers": (
+                mon.straggler_snapshot() if mon is not None
+                else {"enabled": False}
+            ),
+            "anomalies": (
+                mon.anomaly_snapshot() if mon is not None
+                else {"enabled": False}
+            ),
+            "monitor": (
+                mon.service_snapshot() if mon is not None
+                else {"serving": False}
+            ),
         }
+
+    def _annotated_health(self, comm: Communicator) -> dict:
+        """The engine health map plus the monitor plane's standing
+        straggler verdicts as ``suspect_slow`` annotations — annotation
+        ONLY: a slow rank is an operator signal, never a fail-fast
+        (the dead-rank path stays the health map's own state machine)."""
+        health = self.engine.health_report(comm)
+        if self._monitor is not None:
+            for r in self._monitor.slow_ranks(comm.id):
+                if r in health:
+                    health[r]["suspect_slow"] = True
+        return health
 
     def telemetry_prometheus(self) -> str:
         """The snapshot in Prometheus text exposition format."""
@@ -1640,6 +1801,52 @@ class ACCL:
         if self._telemetry is None:
             return []
         return self._telemetry.chrome_events()
+
+    def start_monitor(self, port: Optional[int] = None) -> int:
+        """Start the live scrape service for this rank handle: a stdlib
+        HTTP server on an ``accl-monitor`` thread serving ``/metrics``
+        (Prometheus text), ``/snapshot`` (the ``telemetry_snapshot()``
+        JSON) and ``/trace`` (the rolling Chrome-trace window).  Binds
+        127.0.0.1; ``port`` 0 (and the default when ``ACCL_MONITOR_PORT``
+        is unset) picks an ephemeral port.  Returns the bound port.
+        Idempotent while already serving."""
+        from . import monitor as _monitor
+
+        if self._monitor is None:
+            raise ACCLError(
+                ErrorCode.INVALID_OPERATION,
+                "telemetry disabled (ACCL_TELEMETRY=0): nothing to serve",
+                details={"op": "start_monitor"},
+            )
+        if self._monitor.server is not None:
+            return self._monitor.server.port
+        if port is None:
+            port = _monitor.env_port() or 0
+
+        def _trace_doc() -> str:
+            import json as _json
+
+            return _json.dumps(chrome_trace(self.telemetry_trace_events()))
+
+        srv = _monitor.MonitorServer({
+            "/metrics": (
+                self.telemetry_prometheus,
+                "text/plain; version=0.0.4; charset=utf-8",
+            ),
+            "/snapshot": (self.telemetry_json, "application/json"),
+            "/trace": (_trace_doc, "application/json"),
+        }, port=int(port))
+        srv.start()
+        self._monitor.server = srv
+        return srv.port
+
+    def stop_monitor(self) -> bool:
+        """Stop the scrape service (bounded join of the ``accl-monitor``
+        thread); True when it exited cleanly.  No-op when not serving."""
+        if self._monitor is None or self._monitor.server is None:
+            return True
+        srv, self._monitor.server = self._monitor.server, None
+        return srv.stop()
 
     def export_chrome_trace(self, path: Optional[str] = None) -> dict:
         """Write (or return) this rank's Perfetto-loadable trace.  Merge
@@ -1702,11 +1909,19 @@ class ACCL:
             # graceful-degradation map: per-peer state for the world
             # communicator, keyed by rank — fed by timeout/retry
             # accounting (emulator tiers) and the gang slot watchdog
-            # (XLA tier); a peer marked "dead" fails collectives fast
-            "health": self.engine.health_report(self._world),
+            # (XLA tier); a peer marked "dead" fails collectives fast,
+            # a peer annotated "suspect_slow" is the monitor plane's
+            # standing straggler verdict (annotation only)
+            "health": self._annotated_health(self._world),
             # telemetry plane armed? (ACCL_TELEMETRY kill switch) — the
             # full merged view is ACCL.telemetry_snapshot()
             "telemetry": self._telemetry is not None,
+            # monitor plane: the live scrape service, when serving
+            # (ACCL_MONITOR_PORT / start_monitor)
+            "monitor": (
+                self._monitor.service_snapshot()
+                if self._monitor is not None else None
+            ),
             # contract plane armed? (ACCL_VERIFY / set_contract_verify)
             "contract_verify": (
                 None if self._contract is None else {
@@ -1741,7 +1956,18 @@ class ACCL:
 
     def deinit(self) -> None:
         if self._initialized:
-            # disarm the contract verifier first: its board listener must
+            # monitor services first: a scrape landing mid-teardown must
+            # not race the engine shutdown (stop is a bounded join) —
+            # and the skew tracker leaves the shared fabric like the
+            # contract verifier does, so a dead handle's tracker can't
+            # keep stamping/observing for the fabric's lifetime
+            if self._monitor is not None:
+                self._monitor.close()
+                self.engine.set_skew_tracker(None)
+                fabric = getattr(self.engine, "fabric", None)
+                if fabric is not None and hasattr(fabric, "unregister_skew"):
+                    fabric.unregister_skew(self._monitor.tracker)
+            # disarm the contract verifier: its board listener must
             # not outlive the handle (a stale listener would keep failing
             # gang slots for a verifier whose facade is gone)
             self.set_contract_verify(False)

@@ -57,10 +57,12 @@ __all__ = [
     "CallRecord",
     "FlightRecorder",
     "MetricsRegistry",
+    "SCHEMA_VERSION",
     "Telemetry",
     "chrome_trace",
     "enabled",
     "merge_traces",
+    "record_event",
     "to_json",
     "to_prometheus",
     "wire_event",
@@ -70,6 +72,12 @@ __all__ = [
 #: default flight-recorder capacity; the tail attached to errors
 DEFAULT_RING = 512
 ERROR_TAIL = 32
+
+#: ``telemetry_snapshot()`` schema version: bumped whenever the merged
+#: dict gains/renames sections, so dashboards and the exporter
+#: round-trip tests can key on shape instead of sniffing.  2 = the
+#: monitor plane (schema_version, stragglers, anomalies, monitor).
+SCHEMA_VERSION = 2
 
 # One epoch<->monotonic anchor per process: records carry perf_counter_ns
 # timestamps (cheap, monotonic), trace export maps them onto the epoch
@@ -202,6 +210,20 @@ class FlightRecorder:
                 self._slots[i % self.capacity]
                 for i in range(start, self._next)
             ]
+
+    def since(self, cursor: int) -> tuple:
+        """``(records, new_cursor)``: every record appended after total
+        count ``cursor``, oldest first — the streaming exporter's
+        cursor.  Records that rolled out of the ring before being
+        pulled are lost (bounded memory beats completeness; the stream
+        flush cadence keeps the window comfortably inside capacity)."""
+        with self._lock:
+            total = self._next
+            start = max(int(cursor), total - self.capacity, 0)
+            return (
+                [self._slots[i % self.capacity] for i in range(start, total)],
+                total,
+            )
 
     def tail_dicts(self, n: Optional[int] = None) -> List[dict]:
         return [r.as_dict() for r in self.tail(n)]
@@ -401,6 +423,18 @@ class Telemetry:
         self.tier = tier
         self.recorder = FlightRecorder(capacity)
         self.metrics = MetricsRegistry()
+        # completion observers (the monitor plane's straggler tracker /
+        # anomaly watchdog): called after every recorded completion
+        # with (meta, duration_ns, code) — each must be cheap and must
+        # never raise into the call it observes
+        self._observers: List[Any] = []
+
+    def add_observer(self, fn) -> None:
+        """Register a completion observer ``fn(meta, duration_ns,
+        code)`` — the monitor plane's hook onto the flight-recorder
+        append path (one list iteration per call; empty by default)."""
+        if fn not in self._observers:
+            self._observers.append(fn)
 
     @classmethod
     def create(cls, rank: int, tier: str) -> Optional["Telemetry"]:
@@ -464,6 +498,14 @@ class Telemetry:
             op, bucket if bucket is not None else 0, duration_ns,
             code, code_name, plan_hit, attempts, overlap_ns,
         )
+        for obs in self._observers:
+            # monitor plane (skew tracker / anomaly watchdog): amended
+            # records are skipped above — an observer must never see
+            # the same call twice
+            try:
+                obs(meta, duration_ns, code)
+            except Exception:  # pragma: no cover - defensive
+                pass
 
     # -- views ---------------------------------------------------------------
     def tail_dicts(self, n: int = ERROR_TAIL) -> List[dict]:
@@ -488,21 +530,7 @@ class Telemetry:
             },
         ]
         for rec in self.recorder.tail():
-            dur_us = rec.duration_ns / 1e3
-            end_us = _perf_to_epoch_us(rec.end_perf_ns)
-            events.append({
-                "name": f"accl::{rec.op}",
-                "cat": "accl",
-                "ph": "X",
-                "ts": round(end_us - dur_us, 3),
-                "dur": round(dur_us, 3),
-                "pid": self.rank,
-                "tid": 0,
-                "args": {
-                    k: v for k, v in rec.as_dict().items()
-                    if k not in ("op", "end_us") and v is not None
-                },
-            })
+            events.append(record_event(rec, self.rank))
         if wire:
             # The wire ring is PROCESS-wide (one fabric serves every
             # in-process rank handle), so wire events export under the
@@ -533,6 +561,28 @@ class Telemetry:
         return events
 
 
+def record_event(rec: CallRecord, rank: int) -> dict:
+    """One CallRecord as a Chrome/Perfetto complete event — the single
+    rendering both the on-demand exporter (:meth:`Telemetry.
+    chrome_events`) and the monitor plane's streaming writer use, so
+    streamed and exported timelines line up event-for-event."""
+    dur_us = rec.duration_ns / 1e3
+    end_us = _perf_to_epoch_us(rec.end_perf_ns)
+    return {
+        "name": f"accl::{rec.op}",
+        "cat": "accl",
+        "ph": "X",
+        "ts": round(end_us - dur_us, 3),
+        "dur": round(dur_us, 3),
+        "pid": rank,
+        "tid": 0,
+        "args": {
+            k: v for k, v in rec.as_dict().items()
+            if k not in ("op", "end_us") and v is not None
+        },
+    }
+
+
 # ---------------------------------------------------------------------------
 # exporters
 # ---------------------------------------------------------------------------
@@ -543,9 +593,22 @@ def to_json(snapshot: dict) -> str:
     return json.dumps(snapshot, sort_keys=True, default=str)
 
 
+def _prom_escape(value) -> str:
+    """Prometheus label-value escaping (exposition format): backslash,
+    double quote and newline must be escaped or an op/comm id carrying
+    one corrupts every later line of the scrape."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 def _prom_labels(**labels) -> str:
     inner = ",".join(
-        f'{k}="{v}"' for k, v in sorted(labels.items()) if v is not None
+        f'{k}="{_prom_escape(v)}"'
+        for k, v in sorted(labels.items()) if v is not None
     )
     return "{" + inner + "}" if inner else ""
 
@@ -601,11 +664,17 @@ def to_prometheus(snapshot: dict) -> str:
         )
 
     # scalar gauges folded out of the merged snapshot (engine report,
-    # plan cache): only numbers — structure stays in the JSON exporter
+    # plan cache): only numbers — structure stays in the JSON exporter.
+    # ONE TYPE line per metric name however many label sets it carries:
+    # a second TYPE line for the same name is invalid exposition and
+    # fails the whole scrape (the per-(comm, peer) straggler gauges
+    # would emit one per peer without the dedup)
     def gauge(name: str, value, **labels) -> None:
         if isinstance(value, bool) or not isinstance(value, (int, float)):
             return
-        lines.append(f"# TYPE {name} gauge")
+        if name not in seen_types:
+            lines.append(f"# TYPE {name} gauge")
+            seen_types.add(name)
         lines.append(f"{name}{_prom_labels(**dict(base, **labels))} {value}")
 
     gauge("accl_device_interactions", snapshot.get("device_interactions"))
@@ -621,6 +690,30 @@ def to_prometheus(snapshot: dict) -> str:
             for kk, vv in sorted(v.items()):
                 if isinstance(vv, (int, float)) and not isinstance(vv, bool):
                     gauge(f"accl_engine_{k}_{kk}", vv)
+
+    # monitor plane (live observability): per-peer straggler EWMA lags,
+    # standing slow_rank verdicts, anomaly alert totals, scrape counts —
+    # the gauges a dashboard alerts on
+    strag = snapshot.get("stragglers") or {}
+    for comm, ranks in sorted((strag.get("ewma_wait_lag_us") or {}).items()):
+        for r, v in sorted(ranks.items()):
+            gauge("accl_straggler_ewma_wait_lag_us", v, comm=comm, peer=r)
+    for comm, ranks in sorted((strag.get("ewma_latency_us") or {}).items()):
+        for r, v in sorted(ranks.items()):
+            gauge("accl_straggler_ewma_latency_us", v, comm=comm, peer=r)
+    for comm, v in sorted((strag.get("standing") or {}).items()):
+        gauge("accl_straggler_slow_rank", v.get("rank"), comm=comm)
+    gauge("accl_straggler_windows_judged", strag.get("windows_judged"))
+    gauge("accl_straggler_verdicts", len(strag.get("verdicts") or ()))
+    anom = snapshot.get("anomalies") or {}
+    gauge("accl_anomaly_alerts_total", anom.get("alerts_total"))
+    mon = snapshot.get("monitor") or {}
+    server = mon.get("server") or {}
+    if server.get("scrapes"):
+        gauge("accl_monitor_scrapes_total", sum(server["scrapes"].values()))
+        gauge("accl_monitor_scrape_errors_total", server.get("errors"))
+    stream = mon.get("trace_stream") or {}
+    gauge("accl_trace_stream_events_total", stream.get("events_streamed"))
     return "\n".join(lines) + "\n"
 
 

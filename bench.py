@@ -673,6 +673,145 @@ def _bench_facade_overhead() -> dict:
     }
 
 
+def _bench_monitor_overhead() -> dict:
+    """Interleaved monitor-on/off A/B on the facade warm path with the
+    scrape service LIVE and actually polled during the on rounds —
+    the monitor plane's <=5% budget (parse_results.check_monitor),
+    certified under real serving load, not an idle socket.
+
+    "On" = scrape server bound on an ephemeral port + a poller thread
+    GETting /metrics every 100 ms while the timed loop runs (still 10x
+    hotter than an aggressive 1 s production scrape; each scrape
+    renders a full snapshot on the request thread, so the GIL cost is
+    real and measured); "off" = service stopped.  Rounds alternate with
+    rotating order (the sweep_group_paired noise discipline the
+    telemetry/verify A/Bs use) and are sized to span several scrape
+    periods.  The straggler tracker and anomaly watchdog are armed in
+    BOTH arms — they ride the telemetry observer unconditionally, so
+    their cost is part of the telemetry A/B's always-on budget; this
+    bench isolates the SERVICE."""
+    import threading
+    import urllib.request
+
+    from accl_tpu.core import xla_group
+
+    iters = 50 if _SMALL else 1500
+    g = xla_group(1)
+    try:
+        a = g[0]
+        d = a.create_buffer(1024, np.float32)
+        sends = [
+            a.create_buffer_from(
+                np.full(1024, 1.0 + (i + 1) / 64.0, np.float32)
+            )
+            for i in range(16)
+        ]
+        for sb in sends:
+            sb.device_array().block_until_ready()
+        a.allreduce(sends[0], d, 1024)
+        a.allreduce(sends[0], d, 1024)  # warm: plan + prepared program
+
+        def drain():
+            arr = d.device_array() if hasattr(d, "device_array") else None
+            if arr is not None:
+                arr.block_until_ready()
+
+        def run_round():
+            drain()
+            with Timer() as t:
+                for it in range(iters):
+                    a.allreduce(sends[it % len(sends)], d, 1024)
+                drain()
+            return t.elapsed_ns() / iters / 1e3
+
+        scrape_stats = {"n": 0, "errors": 0}
+
+        def scrape_once(port):
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=2
+                ) as r:
+                    r.read()
+                scrape_stats["n"] += 1
+            except Exception:
+                scrape_stats["errors"] += 1
+
+        def scraper(port, stop):
+            while not stop.wait(0.1):
+                scrape_once(port)
+
+        def on_round():
+            port = a.start_monitor(0)
+            stop = threading.Event()
+            t = threading.Thread(
+                target=scraper, args=(port, stop),
+                name="accl-bench-scraper", daemon=True,
+            )
+            t.start()
+            try:
+                return run_round()
+            finally:
+                stop.set()
+                t.join(timeout=5.0)
+                # at least one scrape is guaranteed live per armed
+                # round, however short ACCL_BENCH_SMALL makes the loop
+                scrape_once(port)
+                a.stop_monitor()
+
+        on_vals, off_vals = [], []
+        for k in range(4):
+            order = (
+                ((on_round, on_vals), (run_round, off_vals))
+                if k % 2 == 0
+                else ((run_round, off_vals), (on_round, on_vals))
+            )
+            for fn, acc in order:
+                acc.append(fn())
+
+        # route validation: every endpoint live and well-formed (the
+        # check_monitor gate refuses a capture without this evidence)
+        port = a.start_monitor(0)
+        routes_ok = True
+        try:
+            for route, kind in (
+                ("/metrics", "prom"), ("/snapshot", "json"),
+                ("/trace", "json"),
+            ):
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{route}", timeout=5
+                ) as r:
+                    body = r.read().decode()
+                if kind == "json":
+                    json.loads(body)
+                elif "accl_" not in body:
+                    routes_ok = False
+        except Exception:
+            routes_ok = False
+        finally:
+            a.stop_monitor()
+        snap = a.telemetry_snapshot()
+        on_us, off_us = min(on_vals), min(off_vals)
+        monitor = {
+            "overhead_pct": round(
+                max(0.0, (on_us - off_us) / max(off_us, 1e-9) * 100.0), 2
+            ),
+            "scrapes": scrape_stats["n"],
+            "scrape_errors": scrape_stats["errors"],
+            "routes_ok": routes_ok,
+            "schema_version": snap.get("schema_version"),
+            "stragglers_enabled": bool(
+                (snap.get("stragglers") or {}).get("enabled")
+            ),
+        }
+        return {
+            "facade_monitor_overhead_pct": monitor["overhead_pct"],
+            "monitor": monitor,
+        }
+    finally:
+        for x in g:
+            x.deinit()
+
+
 def _bench_gang_device_time() -> dict:
     """Separate the gang call's DEVICE time from its host/transport
     dispatch floor by payload-slope timing (VERDICT r3 item 10: the
@@ -1165,6 +1304,8 @@ def _save_lkg(result: dict) -> None:
         return  # nor one whose overlap evidence failed its gate
     if gate_errors.get("verify_gate"):
         return  # nor one whose contract-verify budget failed its gate
+    if gate_errors.get("monitor_gate"):
+        return  # nor one whose live-monitor budget failed its gate
     if gate_errors.get("acclint"):
         return  # nor a capture from a tree violating project invariants
     if _SMALL or "tpu" not in str(result.get("device", "")).lower():
@@ -1624,6 +1765,9 @@ def main() -> None:
         extras, errors, "facade_call_overhead_us", _bench_facade_overhead
     )
     _try(
+        extras, errors, "monitor_overhead", _bench_monitor_overhead
+    )
+    _try(
         extras, errors, "gang_device_time", _bench_gang_device_time
     )
 
@@ -1703,10 +1847,12 @@ def main() -> None:
         # NameError from the gate's except clause below
         from benchmarks.parse_results import (
             ArchOverheadRegressionError,
+            MonitorGateError,
             OverlapGateError,
             TelemetryGateError,
             VerifyGateError,
             check_arch_overhead,
+            check_monitor,
             check_overlap,
             check_telemetry,
             check_verify,
@@ -1740,6 +1886,12 @@ def main() -> None:
             check_verify(extras)
         except VerifyGateError as e:
             errors["verify_gate"] = str(e)
+        # monitor budget gate: a facade capture must carry the live
+        # scrape-service A/B evidence and its <=5% overhead verdict
+        try:
+            check_monitor(extras)
+        except MonitorGateError as e:
+            errors["monitor_gate"] = str(e)
 
     # static-analysis gate (acclint): a capture taken from a tree that
     # violates the project invariants (unbounded waits, broken jax-free

@@ -245,6 +245,84 @@ def check_verify_capture(bench_path: str) -> None:
     check_verify((result or {}).get("extras") or {})
 
 
+# Monitor gate (live-observability PR): the monitor plane must stay
+# inside the same <=5% budget as telemetry/verify while the scrape
+# service is LIVE and actually being polled — a capture claiming the
+# facade bench ran must carry the interleaved monitor-on/off A/B with
+# at least one real scrape during the measured window.
+MONITOR_OVERHEAD_TOLERANCE_PCT = float(
+    os.environ.get("ACCL_MONITOR_OVERHEAD_PCT", "5.0")
+)
+
+
+class MonitorGateError(ValueError):
+    """The capture's monitor evidence is missing/dead, or the measured
+    monitor-on overhead exceeded the live-service budget."""
+
+
+def check_monitor(extras: dict, tolerance_pct: float = None) -> None:
+    """Gate a capture's monitor-plane evidence.  No-op when the facade
+    bench never ran (no ``monitor`` block and no ``telemetry`` block);
+    otherwise the block must exist, the service must have served real
+    scrapes during the measured run, and the interleaved on/off delta
+    must be within the <=5% budget."""
+    tol = (
+        MONITOR_OVERHEAD_TOLERANCE_PCT
+        if tolerance_pct is None else tolerance_pct
+    )
+    extras = extras or {}
+    mon = extras.get("monitor")
+    if mon is None:
+        if extras.get("telemetry") is None:
+            return  # facade bench never ran: nothing to gate
+        raise MonitorGateError(
+            "capture carries facade-bench telemetry evidence but no "
+            "monitor block — the monitor on/off A/B did not run; the "
+            "<=5% live-service budget is unverifiable"
+        )
+    if not isinstance(mon, dict):
+        raise MonitorGateError("monitor block is not a dict")
+    if not mon.get("scrapes"):
+        raise MonitorGateError(
+            "monitor evidence shows zero live scrapes — the service "
+            "was never actually polled during the measured run"
+        )
+    if not mon.get("routes_ok"):
+        raise MonitorGateError(
+            "monitor routes were not validated (/metrics must parse, "
+            "/snapshot and /trace must be well-formed JSON)"
+        )
+    pct = mon.get("overhead_pct")
+    if pct is None:
+        raise MonitorGateError(
+            "capture carries no monitor-on/off overhead measurement"
+        )
+    if pct > tol:
+        raise MonitorGateError(
+            f"monitor-on warm path costs {pct:.2f}% over monitor-off "
+            f"(budget {tol:.1f}%): serving scrapes crept into the call "
+            "path; fix it instead of committing the slower capture"
+        )
+
+
+def check_monitor_capture(bench_path: str) -> None:
+    """CLI form (``--check-monitor <capture>.json``): accepts both the
+    full-bench shape (monitor block under ``extras``) and the flat
+    committed-artifact shape (``facade_monitor_cpu.json``, monitor
+    block at top level)."""
+    import json
+
+    with open(bench_path) as f:
+        doc = json.load(f)
+    result = doc.get("parsed") or doc.get("result") or doc
+    extras = (result or {}).get("extras") or result or {}
+    if extras.get("monitor") is None and extras.get("telemetry") is None:
+        raise MonitorGateError(
+            f"{bench_path}: no monitor evidence anywhere in the capture"
+        )
+    check_monitor(extras)
+
+
 # Overlap gate (overlap-plane PR): the gang bench's dispatch floor is
 # now measured from the BACK-TO-BACK pipelined loop (N collectives in
 # flight through the window), so a capture that carries the floor
@@ -529,6 +607,14 @@ def main(argv=None) -> str:
         print(
             f"{argv[i + 1]}: contract-verify evidence present, overhead "
             f"within {VERIFY_OVERHEAD_TOLERANCE_PCT:.1f}%"
+        )
+        return ""
+    if "--check-monitor" in argv:
+        i = argv.index("--check-monitor")
+        check_monitor_capture(argv[i + 1])
+        print(
+            f"{argv[i + 1]}: monitor evidence present (live scrapes), "
+            f"overhead within {MONITOR_OVERHEAD_TOLERANCE_PCT:.1f}%"
         )
         return ""
     if "--check-tuned" in argv:

@@ -1,0 +1,79 @@
+"""CI monitor smoke: start the live scrape service on the (jax-free)
+emulator tier, assert every route answers with a well-formed payload,
+and stop it cleanly.  Needs numpy only — the same footprint as the
+acclint gate job it runs next to (.github/workflows/analysis.yml).
+
+Usage::
+
+    python scripts/monitor_smoke.py
+"""
+
+import json
+import os
+import re
+import sys
+import threading
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from accl_tpu.core import emulated_group
+
+_PROM_LINE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [^ ]+$")
+
+
+def get(port: int, route: str) -> str:
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{route}", timeout=10
+    ) as r:
+        assert r.status == 200, (route, r.status)
+        return r.read().decode()
+
+
+def main() -> int:
+    g = emulated_group(2)
+    try:
+        send = [
+            a.create_buffer_from(np.full(64, float(r + 1), np.float32))
+            for r, a in enumerate(g)
+        ]
+        recv = [a.create_buffer(64, np.float32) for a in g]
+        for _ in range(4):
+            threads = [
+                threading.Thread(
+                    target=lambda a, r: a.allreduce(send[r], recv[r], 64),
+                    args=(a, r),
+                )
+                for r, a in enumerate(g)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(60)
+
+        port = g[0].start_monitor(0)
+        metrics = get(port, "/metrics")
+        assert "accl_calls_total" in metrics, "no accl_ metrics served"
+        for line in metrics.splitlines():
+            if line and not line.startswith("#"):
+                assert _PROM_LINE.match(line), f"malformed: {line!r}"
+        snap = json.loads(get(port, "/snapshot"))
+        assert snap["schema_version"] == 2
+        assert snap["stragglers"]["enabled"] is True
+        trace = json.loads(get(port, "/trace"))
+        assert trace["traceEvents"], "empty trace window"
+        assert g[0].stop_monitor() is True
+        print(
+            f"monitor smoke OK: {len(metrics.splitlines())} metric lines, "
+            f"{len(trace['traceEvents'])} trace events"
+        )
+        return 0
+    finally:
+        for a in g:
+            a.deinit()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -231,7 +231,8 @@ def test_jax_free_module_traverses_from_import_alias(tmp_path, monkeypatch):
     (pkg / "heavy.py").write_text("from .sub.leaf import x\n")
     (pkg / "sub" / "__init__.py").write_text("import numpy\n")
     (pkg / "sub" / "leaf.py").write_text("x = 1\n")
-    for m in ("constants", "telemetry", "faults", "plans", "contract"):
+    for m in ("constants", "telemetry", "faults", "plans", "contract",
+              "monitor"):
         (pkg / f"{m}.py").write_text("")
     import accl_tpu.analysis.graph as graph_mod
 
@@ -254,6 +255,7 @@ def test_jax_free_module_detects_violation(tmp_path, monkeypatch):
     (pkg / "faults.py").write_text("")
     (pkg / "plans.py").write_text("")
     (pkg / "contract.py").write_text("")
+    (pkg / "monitor.py").write_text("")
     import accl_tpu.analysis.base as base_mod
 
     monkeypatch.setattr(base_mod, "package_root", lambda: str(pkg))
@@ -278,7 +280,8 @@ def test_jax_free_module_sees_with_block_imports(tmp_path, monkeypatch):
         "with contextlib.suppress(ImportError):\n"
         "    import numpy\n"
     )
-    for m in ("constants", "overlap", "telemetry", "faults", "contract"):
+    for m in ("constants", "overlap", "telemetry", "faults", "contract",
+              "monitor"):
         (pkg / f"{m}.py").write_text("")
     import accl_tpu.analysis.base as base_mod
     import accl_tpu.analysis.graph as graph_mod
@@ -313,7 +316,7 @@ def test_jax_free_modules_import_without_heavy_stack():
         pkg.__path__ = [root]
         sys.modules['accl_tpu'] = pkg
         for m in ('constants', 'overlap', 'telemetry', 'faults', 'plans',
-                  'contract'):
+                  'contract', 'monitor'):
             spec = importlib.util.spec_from_file_location(
                 'accl_tpu.' + m, os.path.join(root, m + '.py'))
             mod = importlib.util.module_from_spec(spec)
@@ -971,3 +974,59 @@ def test_collective_sequence_flags_rank_varying_loop_count(tmp_path):
         "collective-sequence",
     )
     assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# metric-naming
+# ---------------------------------------------------------------------------
+
+BAD_METRICS = [
+    'registry.inc("calls_total")',
+    'registry.inc("deadlocks", ("op",))',
+    'self.metrics.inc("retries_total")',
+    'gauge("device_interactions", 3)',
+    'gauge(f"engine_{k}", v)',
+]
+
+GOOD_METRICS = [
+    'registry.inc("accl_calls_total")',
+    'self.metrics.inc("accl_call_errors_total", (op, name))',
+    'gauge("accl_device_interactions", n)',
+    'gauge(f"accl_engine_{k}", v)',
+    'counts.inc("x y z")',      # not a metric-shaped literal
+    'registry.inc(name)',       # dynamic: nothing checkable statically
+    'd.get("calls_total")',     # not a registry call at all
+]
+
+
+@pytest.mark.parametrize("code", BAD_METRICS)
+def test_metric_naming_flags(tmp_path, code):
+    findings = _live(
+        _lint(tmp_path, f"def f(registry, gauge, k, v, n, op, name, self):\n"
+                        f"    {code}\n"),
+        "metric-naming",
+    )
+    assert len(findings) == 1, code
+    assert "accl_" in findings[0].message
+
+
+@pytest.mark.parametrize("code", GOOD_METRICS)
+def test_metric_naming_passes(tmp_path, code):
+    findings = _live(
+        _lint(tmp_path, f"def f(registry, gauge, counts, d, k, v, n, op,"
+                        f" name, self):\n    {code}\n"),
+        "metric-naming",
+    )
+    assert not findings, code
+
+
+def test_metric_naming_suppressible(tmp_path):
+    findings = _live(_lint(tmp_path, """
+        def f(registry):
+            registry.inc("legacy_total")  # acclint: allow[metric-naming] pre-prefix legacy export
+    """), "metric-naming")
+    assert not findings
+
+
+def test_metric_naming_clean_at_head():
+    assert not _live(run_checks(checks=["metric-naming"]))

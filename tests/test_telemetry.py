@@ -17,6 +17,7 @@ The observability contract (telemetry-plane PR):
 
 import json
 import os
+import re
 
 import numpy as np
 import pytest
@@ -581,3 +582,139 @@ def test_committed_capture_passes_telemetry_gate():
     check_telemetry(doc)
     assert doc["facade_device_interactions_per_call"] == 1.0
     assert doc["facade_plan_cache_hit_rate"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# schema_version + exporter round-trip (monitor-plane PR satellites)
+# ---------------------------------------------------------------------------
+
+#: one Prometheus exposition line: name{labels} value
+_PROM_LINE_RE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(\{(?P<labels>[^{}]*)\})? (?P<value>[^ ]+)$'
+)
+#: one label pair inside {...}; values may contain escaped \\ \" \n
+_PROM_LABEL_RE = re.compile(
+    r'(?P<key>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<val>(?:[^"\\]|\\.)*)"'
+)
+
+
+def _prom_parse(text: str):
+    """Re-parse Prometheus exposition text into
+    [(name, {label: unescaped value}, raw value)] — the round-trip
+    proof that every emitted line survives a real scrape parser."""
+    out = []
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _PROM_LINE_RE.match(line)
+        assert m, f"malformed exposition line: {line!r}"
+        labels = {}
+        raw = m.group("labels")
+        if raw:
+            consumed = ",".join(
+                lm.group(0) for lm in _PROM_LABEL_RE.finditer(raw)
+            )
+            assert consumed == raw, f"malformed label block: {raw!r}"
+            for lm in _PROM_LABEL_RE.finditer(raw):
+                val = (
+                    lm.group("val")
+                    .replace("\\n", "\n")
+                    .replace('\\"', '"')
+                    .replace("\\\\", "\\")
+                )
+                labels[lm.group("key")] = val
+        out.append((m.group("name"), labels, m.group("value")))
+    return out
+
+
+def test_snapshot_carries_schema_version():
+    g = emulated_group(2)
+    try:
+        snap = g[0].telemetry_snapshot()
+        assert snap["schema_version"] == T.SCHEMA_VERSION == 2
+        # the JSON exporter round-trips it
+        assert json.loads(g[0].telemetry_json())["schema_version"] == 2
+    finally:
+        _deinit(g)
+
+
+def test_prometheus_round_trip_reparses():
+    """Every line of a live scrape re-parses: names, label blocks,
+    values — and the emitted metric set survives with its counts."""
+    g = emulated_group(2)
+    try:
+        _exercise(g, n=16)
+        parsed = _prom_parse(g[0].telemetry_prometheus())
+        names = {p[0] for p in parsed}
+        assert "accl_calls_total" in names
+        assert "accl_call_duration_us_bucket" in names
+        calls = [
+            p for p in parsed
+            if p[0] == "accl_calls_total" and p[1].get("op") == "allreduce"
+        ]
+        assert calls and int(calls[0][2]) >= 1
+        # histogram cumulative buckets end with +Inf == _count
+        infs = [
+            p for p in parsed
+            if p[0] == "accl_call_duration_us_bucket"
+            and p[1].get("le") == "+Inf"
+        ]
+        counts = {
+            (p[1].get("op"), p[1].get("size_bucket")): p[2]
+            for p in parsed if p[0] == "accl_call_duration_us_count"
+        }
+        for p in infs:
+            key = (p[1].get("op"), p[1].get("size_bucket"))
+            assert counts[key] == p[2]
+    finally:
+        _deinit(g)
+
+
+def test_prometheus_label_escaping_round_trip():
+    """Label values carrying quotes, backslashes and newlines (an op or
+    comm id gone weird) must escape on emission and unescape to the
+    original on re-parse — one bad value must not corrupt the scrape."""
+    weird_ops = ['all"reduce', "bc\\ast", "gat\nher", "plain"]
+    snap = {
+        "rank": 0,
+        "tier": 'Emu"Engine\\odd',
+        "metrics": {
+            "counters": {
+                f"accl_calls_total|{op}": 3 for op in weird_ops
+            },
+            "histograms": {},
+        },
+    }
+    text = T.to_prometheus(snap)
+    parsed = _prom_parse(text)
+    got_ops = {
+        p[1]["op"] for p in parsed if p[0] == "accl_calls_total"
+    }
+    assert got_ops == set(weird_ops)
+    tiers = {p[1].get("tier") for p in parsed if "tier" in p[1]}
+    assert tiers == {'Emu"Engine\\odd'}
+
+
+def test_prometheus_type_lines_unique_across_label_sets():
+    """One '# TYPE' line per metric name however many label sets carry
+    it — a duplicate TYPE line is invalid exposition and fails the whole
+    scrape (the per-(comm, peer) straggler gauges regressed this)."""
+    snap = {
+        "rank": 0,
+        "tier": "EmuEngine",
+        "metrics": {"counters": {}, "histograms": {}},
+        "stragglers": {
+            "ewma_wait_lag_us": {"0": {"0": 1.0, "1": 2.0, "2": 3.0}},
+            "ewma_latency_us": {"0": {"0": 4.0, "1": 5.0, "2": 6.0}},
+            "standing": {},
+            "verdicts": [],
+            "windows_judged": 3,
+        },
+    }
+    text = T.to_prometheus(snap)
+    type_lines = [l for l in text.splitlines() if l.startswith("# TYPE")]
+    assert len(type_lines) == len(set(type_lines)), type_lines
+    parsed = _prom_parse(text)
+    lags = [p for p in parsed if p[0] == "accl_straggler_ewma_wait_lag_us"]
+    assert len(lags) == 3  # all three peers' gauges survived the dedup
