@@ -26,6 +26,7 @@ __all__ = [
     "CROSS_FILE_CHECKS",
     "check_jax_free_modules",
     "check_drain_before_config",
+    "check_cmdring_slot_layout",
     "JAX_FREE_MODULES",
     "FORBIDDEN_HEAVY_IMPORTS",
 ]
@@ -325,7 +326,148 @@ def check_drain_before_config(sources: List[SourceFile]) -> List[Finding]:
     return findings
 
 
+# ---------------------------------------------------------------------------
+# cmdring-slot-layout
+# ---------------------------------------------------------------------------
+
+#: names that constitute the command-ring slot contract; exactly ONE
+#: definition (constants.py) may exist — the host-side encoder and the
+#: device-side sequencer must both read it from there
+_CMDRING_CANONICAL_NAMES = frozenset((
+    "CMDRING_FIELDS", "CMDRING_SLOT_WORDS", "CmdOpcode",
+    "CMDRING_ST_OK", "CMDRING_ST_BAD_OP",
+))
+
+#: modules that encode/decode slots (relative to the accl_tpu root)
+_CMDRING_MODULES = (
+    "ops/pallas/cmdring.py",
+    "backends/xla/cmdring.py",
+)
+
+
+def _cmdring_table(src: SourceFile):
+    """(fields: {name: index} | None, slot_words: int | None) from the
+    constants module's literal table."""
+    fields = None
+    slot_words = None
+    for node in src.tree.body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if not isinstance(tgt, ast.Name):
+            continue
+        if tgt.id == "CMDRING_FIELDS" and isinstance(node.value, ast.Dict):
+            fields = {}
+            for k, v in zip(node.value.keys, node.value.values):
+                if isinstance(k, ast.Constant) and isinstance(
+                    v, ast.Constant
+                ):
+                    fields[k.value] = v.value
+        elif tgt.id == "CMDRING_SLOT_WORDS" and isinstance(
+            node.value, ast.Constant
+        ):
+            slot_words = node.value.value
+    return fields, slot_words
+
+
+def check_cmdring_slot_layout(sources: List[SourceFile]) -> List[Finding]:
+    """Encoder and sequencer must agree on the slot layout from ONE
+    table: ``constants.CMDRING_FIELDS``/``CMDRING_SLOT_WORDS`` must be
+    well-formed (dense, unique, in-bounds int indices), the cmdring
+    modules may not REDEFINE any canonical layout name with a local
+    literal (aliasing the imported table is fine), and every string
+    subscript into a fields-table alias must name a field the canonical
+    table defines — a typo'd or locally-invented field silently decodes
+    the wrong word on device."""
+    root = package_root()
+    findings: List[Finding] = []
+    consts = None
+    ringmods: List[SourceFile] = []
+    for src in sources:
+        mod = _module_name(src.path, root)
+        if mod == "accl_tpu.constants":
+            consts = src
+        rel = os.path.relpath(os.path.abspath(src.path), root)
+        if rel.replace(os.sep, "/") in _CMDRING_MODULES:
+            ringmods.append(src)
+    if consts is None:
+        return findings  # partial-scope run without constants.py
+    fields, slot_words = _cmdring_table(consts)
+    if fields is None or slot_words is None:
+        if ringmods:  # the ring exists but its contract table is gone
+            findings.append(Finding(
+                check="cmdring-slot-layout", path=consts.path, line=1,
+                message="constants.py lost the literal CMDRING_FIELDS/"
+                        "CMDRING_SLOT_WORDS table the encoder and "
+                        "sequencer decode slots from",
+            ))
+        return findings
+    # table well-formedness: dense unique int indices inside the slot
+    idxs = list(fields.values())
+    if (
+        not all(isinstance(i, int) for i in idxs)
+        or len(set(idxs)) != len(idxs)
+        or any(i < 0 or i >= slot_words for i in idxs)
+        or sorted(idxs) != list(range(len(idxs)))
+    ):
+        findings.append(Finding(
+            check="cmdring-slot-layout", path=consts.path, line=1,
+            message=f"CMDRING_FIELDS indices {sorted(idxs)} must be "
+                    f"dense, unique ints in [0, CMDRING_SLOT_WORDS="
+                    f"{slot_words})",
+        ))
+    for src in ringmods:
+        # aliases of the canonical fields table in this module
+        aliases = set()
+        for node in src.nodes:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = node.targets[0]
+                if not isinstance(tgt, ast.Name):
+                    continue
+                val = node.value
+                refs_canonical = (
+                    isinstance(val, ast.Name)
+                    and val.id == "CMDRING_FIELDS"
+                ) or (
+                    isinstance(val, ast.Attribute)
+                    and val.attr == "CMDRING_FIELDS"
+                )
+                if refs_canonical:
+                    aliases.add(tgt.id)
+                elif tgt.id in _CMDRING_CANONICAL_NAMES:
+                    findings.append(src.finding(
+                        "cmdring-slot-layout", node,
+                        f"{tgt.id!r} redefined locally: the slot layout "
+                        f"has exactly one definition (constants.py); "
+                        f"import it instead of re-deriving",
+                    ))
+        aliases.add("CMDRING_FIELDS")
+        for node in src.nodes:
+            if not isinstance(node, ast.Subscript):
+                continue
+            base = node.value
+            base_name = (
+                base.id if isinstance(base, ast.Name)
+                else base.attr if isinstance(base, ast.Attribute)
+                else None
+            )
+            if base_name not in aliases:
+                continue
+            key = node.slice
+            if isinstance(key, ast.Constant) and isinstance(
+                key.value, str
+            ) and key.value not in fields:
+                findings.append(src.finding(
+                    "cmdring-slot-layout", node,
+                    f"slot field {key.value!r} is not in "
+                    f"constants.CMDRING_FIELDS ({sorted(fields)}): "
+                    f"encoder and sequencer must agree on one table",
+                ))
+    return findings
+
+
 CROSS_FILE_CHECKS = {
     "jax-free-module": check_jax_free_modules,
     "drain-before-config": check_drain_before_config,
+    "cmdring-slot-layout": check_cmdring_slot_layout,
 }

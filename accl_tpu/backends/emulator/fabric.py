@@ -209,9 +209,17 @@ class Fabric:
     the transport's :meth:`_transmit`."""
 
     _injector: Optional[FaultInjector] = None
+    _delay_lock: Optional[threading.Lock] = None
 
     def install_fault_plan(self, plan: Optional[FaultPlan]) -> Optional[FaultInjector]:
         """Arm (or with ``None``, disarm) a fault plan on this fabric."""
+        if self._delay_lock is None:
+            # the ordered-delay state is created HERE (setup time,
+            # single-threaded) rather than lazily on the send path: two
+            # senders racing a lazy first-touch could each build their
+            # own queue dict and orphan one side's delayed frames
+            self._delay_lock = threading.Lock()
+            self._delayed = {}
         self._injector = FaultInjector(plan) if plan is not None else None
         return self._injector
 
@@ -306,13 +314,94 @@ class Fabric:
             )
         copies = 2 if v.duplicate else 1
         if v.delay_s > 0:
-            t = threading.Timer(
-                v.delay_s, self._transmit_copies, (address, msg, copies, True)
-            )
-            t.daemon = True
-            t.start()
-        else:
+            self._delay_enqueue(address, msg, copies, v.delay_s)
+        elif not self._delay_enqueue_if_pending(address, msg, copies):
             self._transmit_copies(address, msg, copies, False)
+
+    # -- ordered delayed transmit --------------------------------------------
+    # A congested link delays everything BEHIND the stalled frame — it
+    # does not reorder.  The old Timer-per-message path let every later
+    # send to the same peer overtake the delayed one, which on the
+    # multi-rank socket tier (strictly seqn-consuming receivers, one
+    # recv thread per link) wedged ranks into RECEIVE_TIMEOUT (the PR 8
+    # pre-existing issue).  Delayed sends now park in a per-address FIFO
+    # drained by one worker in order; while the queue exists, later
+    # undelayed sends to that address queue behind it instead of
+    # overtaking.  Other addresses are unaffected (per-peer ordering is
+    # the wire's contract; cross-peer ordering never was).
+
+    def _delay_state(self):
+        # created by install_fault_plan (the only way an injector — and
+        # so a delay verdict — can exist); never lazily on the send path
+        return self._delay_lock, self._delayed
+
+    def _delay_enqueue(self, address: str, msg: Message, copies: int,
+                       delay_s: float) -> None:
+        lock, delayed = self._delay_state()
+        with lock:
+            q = delayed.get(address)
+            fresh = q is None
+            if fresh:
+                q = delayed[address] = []
+            q.append((time.monotonic() + float(delay_s), msg, copies))
+        if fresh:
+            t = threading.Thread(
+                target=self._drain_delayed, args=(address,),
+                name=f"accl-fabric-delay-{address}", daemon=True,
+            )
+            t.start()
+
+    def _delay_enqueue_if_pending(self, address: str, msg: Message,
+                                  copies: int) -> bool:
+        """Queue an UNDELAYED send behind the address's pending delayed
+        frames (due immediately — no extra delay beyond head-of-line
+        blocking); False when nothing is pending and the caller should
+        transmit directly.  The probe and the append are one locked
+        step, so a send can never observe the queue draining away and
+        then append to an orphaned list."""
+        lock, delayed = self._delay_state()
+        with lock:
+            q = delayed.get(address)
+            if q is None:
+                return False
+            q.append((time.monotonic(), msg, copies))
+            return True
+
+    def _drain_delayed(self, address: str) -> None:
+        """One worker per delayed address: transmit the FIFO in order,
+        sleeping out each frame's residual delay; exits (and removes the
+        queue, restoring the direct-send fast path) once empty.  Frames
+        are popped only AFTER their transmit, so the queue stays
+        non-empty — and later sends keep queuing behind — until the last
+        pending frame is really on the wire."""
+        lock, delayed = self._delay_state()
+        while True:
+            with lock:
+                q = delayed.get(address)
+                if not q:
+                    delayed.pop(address, None)
+                    return
+                due, msg, copies = q[0]
+            wait = due - time.monotonic()
+            if wait > 0:
+                time.sleep(wait)
+            try:
+                try:
+                    self._transmit_copies(address, msg, copies, False)
+                except Exception as e:
+                    # a queued frame has no caller to raise into, but
+                    # the failure must not vanish silently: the sender
+                    # believed the send succeeded.  Log loudly; the
+                    # transports' own dead-marking (SocketFabric) makes
+                    # the NEXT direct send fail fast.
+                    print(
+                        f"[accl fabric] delayed-queue transmit to "
+                        f"{address} failed: {type(e).__name__}: {e}",
+                        file=sys.stderr,
+                    )
+            finally:
+                with lock:
+                    q.pop(0)
 
     def _transmit_copies(
         self, address: str, msg: Message, copies: int, swallow: bool

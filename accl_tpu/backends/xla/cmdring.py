@@ -1,0 +1,436 @@
+"""The gang engine's command-ring session: arm / refill / teardown.
+
+This is the host half of the TPU CCLO analog (the device half is
+``ops/pallas/cmdring.py``): host code that used to *issue* collectives
+becomes code that *refills a queue*.  A warm batched window of N
+eligible collectives is encoded into N slots of the per-communicator
+ring, written to the device and executed by ONE sequencer dispatch —
+one host refill interaction however large the window (counter-asserted
+by tests/test_cmdring.py).  Everything else — cold calls, oversized
+payloads, compressed lanes, host operands, unsupported ops — falls back
+to the ordinary host-dispatch paths, with the reason counted in
+:meth:`GangCommandRing.stats`.
+
+Lifecycle (the ``run loop`` states of the reference firmware, modeled
+at the session level):
+
+* **parked** — no window in flight: the sequencer waits on the doorbell
+  (no device work, no spin).  A refill underrun — host slower than the
+  sequencer — simply returns the ring here.
+* **armed**  — one or more refill windows in flight; the in-flight
+  window (``overlap.InflightWindow``) is the refill window: its drain
+  points block on the device status word the sequencer wrote.
+* **teardown/reset** — ``soft_reset`` parks the sequencer, clears every
+  session and realigns seqn/head at 0 (the ``HALT`` opcode marks this
+  transition in the slot schema).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...constants import (
+    CMDRING_DEPTH_DEFAULT,
+    CMDRING_DEPTH_ENV,
+    CMDRING_ENV,
+    CMDRING_FIELDS,
+    CMDRING_MAX_BYTES_ENV,
+    CMDRING_MAX_DEPTH,
+    CMDRING_MAX_PAYLOAD_BYTES,
+    CMDRING_SLOT_WORDS,
+    CMDRING_ST_OK,
+    CmdOpcode,
+    ErrorCode,
+    Operation,
+)
+
+_F = CMDRING_FIELDS
+
+#: Operation -> CmdOpcode for the sequencer's warm-path subset
+_RING_OPS = {
+    Operation.ALLREDUCE: CmdOpcode.ALLREDUCE,
+    Operation.BCAST: CmdOpcode.BCAST,
+}
+
+
+def _env_mode() -> str:
+    return os.environ.get(CMDRING_ENV, "1").strip().lower()
+
+
+def default_lowering() -> str:
+    """Sequencer lowering: the Pallas remote-DMA kernel on a real TPU,
+    the XLA gather lowering everywhere else (the emulator/CI tier —
+    this box's jax has no Pallas interpreter; see compat).  Override
+    with ``ACCL_CMDRING_LOWERING``."""
+    explicit = os.environ.get("ACCL_CMDRING_LOWERING")
+    if explicit in ("xla", "pallas"):
+        return explicit
+    import jax
+
+    return "pallas" if jax.default_backend() == "tpu" else "xla"
+
+
+class _RingSession:
+    """Per-communicator ring state: the persistent host mirror of the
+    device ring (wrap-around is real — slot i of refill k+1 reuses the
+    words of slot i of refill k-depth) plus the monotone seqn."""
+
+    __slots__ = ("ring", "head", "seqn")
+
+    def __init__(self, depth: int):
+        self.ring = np.zeros((depth, CMDRING_SLOT_WORDS), np.int32)
+        self.head = 0
+        self.seqn = 0
+
+
+class GangCommandRing:
+    """One gang context's command ring (all communicators' sessions)."""
+
+    def __init__(self, gang):
+        self.gang = gang
+        mode = _env_mode()
+        self.enabled = mode not in ("0", "off", "false", "")
+        self.eager = mode == "eager"
+        try:
+            depth = int(
+                os.environ.get(CMDRING_DEPTH_ENV, CMDRING_DEPTH_DEFAULT)
+            )
+        except ValueError:
+            depth = CMDRING_DEPTH_DEFAULT
+        self.depth = max(1, min(depth, CMDRING_MAX_DEPTH))
+        try:
+            self.max_bytes = int(
+                os.environ.get(
+                    CMDRING_MAX_BYTES_ENV, CMDRING_MAX_PAYLOAD_BYTES
+                )
+            )
+        except ValueError:
+            self.max_bytes = CMDRING_MAX_PAYLOAD_BYTES
+        self.lowering = default_lowering()
+        self._lock = threading.Lock()
+        self._sessions: Dict[int, _RingSession] = {}
+        self._inflight_windows = 0
+        # lifetime counters (telemetry_report()["cmdring"]).  One
+        # counter backs both the refill and doorbell stats keys: on
+        # this tier the slot write and the doorbell ride the same
+        # dispatch, so they cannot diverge by construction.
+        self.refills = 0          # refill windows dispatched (= doorbells)
+        self.slots_enqueued = 0   # collectives executed ring-resident
+        self.wraps = 0            # head wrapped past the ring depth
+        self.resets = 0           # soft_reset teardowns (sequencer parked)
+        self.max_window = 0
+        self.last_window = 0
+        self.fallbacks: Dict[str, int] = {}
+
+    # -- introspection -------------------------------------------------------
+    def supports(self, op) -> bool:
+        """Whether ``op`` has a sequencer opcode — the ONE definition of
+        the ring's warm-path subset (the engine's eager hook asks here
+        instead of duplicating the table)."""
+        return op in _RING_OPS
+
+    @property
+    def parked(self) -> bool:
+        """True when no refill window is in flight — the sequencer waits
+        on the doorbell instead of spinning (the underrun posture)."""
+        with self._lock:
+            return self._inflight_windows == 0
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "mode": "eager" if self.eager else
+                        ("batch" if self.enabled else "off"),
+                "lowering": self.lowering,
+                "depth": self.depth,
+                "state": "parked" if self._inflight_windows == 0
+                         else "armed",
+                "refills": self.refills,
+                "doorbells": self.refills,  # one dispatch = one doorbell
+                "slots": self.slots_enqueued,
+                "wraps": self.wraps,
+                "resets": self.resets,
+                "max_window": self.max_window,
+                # refill occupancy: how full the last doorbell's window
+                # filled the ring (1.0 = a full ring per refill)
+                "occupancy": round(self.last_window / self.depth, 3)
+                if self.last_window else 0.0,
+                "fallbacks": dict(self.fallbacks),
+            }
+
+    def _fallback(self, reason: str) -> bool:
+        with self._lock:
+            self.fallbacks[reason] = self.fallbacks.get(reason, 0) + 1
+        return False
+
+    # -- teardown ------------------------------------------------------------
+    def reset(self) -> None:
+        """soft_reset: park the sequencer and realign every session's
+        seqn/head at 0 (the gang has already drained the in-flight
+        window — the full-flush contract)."""
+        with self._lock:
+            self._sessions.clear()
+            self._inflight_windows = 0
+            self.resets += 1
+
+    # -- the refill path -----------------------------------------------------
+    def run_batch(self, comm, entries, npos: int,
+                  t0: Optional[int] = None) -> bool:
+        """Try to execute a fully matched batch slot ring-resident.
+        Returns False — having dispatched NOTHING — when any position
+        disqualifies (the ordinary fused/sequential paths then own the
+        batch); True once dispatch begins (request completion is owned
+        by the ring's window parks)."""
+        if not self.enabled:
+            return False
+        gang = self.gang
+        mesh = gang.submesh(comm)
+        if mesh is None or npos == 0:
+            return False
+        # explicit algorithm registers (global or per-call TuningPlan
+        # overlay) selecting a non-XLA lowering keep their meaning: the
+        # ring is its own lowering and must not shadow a requested one
+        # (mirrors _run_batch_fused's disqualifiers)
+        keys = gang._BATCH_TUNING_KEYS
+        if any(gang.tuning.get(k, "xla") != "xla" for k in keys):
+            return self._fallback("tuning_override")
+        for options_list, _ in entries:
+            for c in options_list:
+                if c.tuning and any(
+                    c.tuning.get(k, "xla") != "xla" for k in keys
+                ):
+                    return self._fallback("tuning_override")
+        if t0 is None:
+            t0 = time.perf_counter_ns()
+
+        plans = []
+        written: set = set()  # result roots of earlier positions
+        window_npdt = None
+        for i in range(npos):
+            calls = [e[0][i] for e in entries]
+            lead = calls[0]
+            if lead.op not in _RING_OPS:
+                return self._fallback("unsupported_op")
+            if any(gang._sig(c) != gang._sig(lead) for c in calls[1:]):
+                return False  # torn gang: surface through the host path
+            nbytes = lead.count * lead.arithcfg.uncompressed_elem_bytes
+            if nbytes > self.max_bytes:
+                return self._fallback("oversized")
+            plan = gang._plan_device_call(comm, calls, lead, mesh)
+            if plan is None:
+                return self._fallback("host_operands")
+            if plan["compressed"]:
+                return self._fallback("compressed")
+            # one dtype per window: the pallas lowering packs every
+            # slot into ONE concatenated buffer, where a mixed window
+            # would silently promote — and mosaic has no f16 at all
+            if window_npdt is None:
+                window_npdt = plan["npdt"]
+            elif plan["npdt"] != window_npdt:
+                return self._fallback("mixed_dtype")
+            if (
+                self.lowering == "pallas"
+                and np.dtype(plan["npdt"]) == np.float16
+            ):
+                return self._fallback("mosaic_dtype")
+            # all operands assemble BEFORE the one dispatch: a position
+            # reading an earlier position's result would see pre-window
+            # bytes — only the sequential path orders such chains
+            for call in calls:
+                buf = call.op0
+                if (
+                    buf is not None
+                    and not buf.is_dummy
+                    and id(buf._root()) in written
+                ):
+                    return self._fallback("data_dependency")
+            for r in plan["writers"]:
+                res = calls[r].res
+                if res is not None and not res.is_dummy:
+                    written.add(id(res._root()))
+            plans.append((calls, lead, plan))
+
+        # windows of at most `depth` slots: each window is one refill
+        # interaction (slot write + doorbell dispatch)
+        for lo in range(0, npos, self.depth):
+            window = plans[lo:lo + self.depth]
+            reqs_per_slot = [
+                [e[1][i] for e in entries]
+                for i in range(lo, lo + len(window))
+            ]
+            try:
+                self._dispatch_window(
+                    comm, mesh, window, reqs_per_slot, t0
+                )
+            except Exception:
+                # this window's dispatch failed: fail ITS slots and the
+                # not-yet-dispatched remainder — earlier windows are in
+                # flight and complete (or fail) from their own parks;
+                # never re-execute a collective
+                import traceback
+
+                traceback.print_exc()
+                dt = time.perf_counter_ns() - t0
+                for i in range(lo, npos):
+                    for e in entries:
+                        req = e[1][i]
+                        if not req.done():  # side-effect-free probe
+                            req.ring_resident = True
+                            req.complete(ErrorCode.INVALID_OPERATION, dt)
+                break
+        return True
+
+    def _encode(self, session: _RingSession, lead, plan) -> np.ndarray:
+        """Encode one collective into the session's next ring slot —
+        through the CollectivePlan's cached slot template when the call
+        carries a plan (the plan -> slot encoding cache), patching only
+        the per-call fields (seqn, count, root, function)."""
+        from ...ops.pallas.cmdring import encode_slot
+
+        fp = lead.plan
+        tmpl = fp.cmdring_slot if fp is not None else None
+        if tmpl is None:
+            tmpl = encode_slot(
+                0,
+                _RING_OPS[lead.op],
+                0,
+                dtype=int(lead.arithcfg.uncompressed),
+                function=lead.reduce_function,
+                root=0,
+                nseg=1,
+            )
+            if fp is not None:
+                fp.cmdring_slot = tmpl
+        words = np.array(tmpl, np.int32)
+        words[_F["seqn"]] = session.seqn & 0x7FFFFFFF
+        words[_F["count"]] = lead.count
+        words[_F["function"]] = int(lead.reduce_function)
+        words[_F["root"]] = (
+            lead.root_src if lead.op == Operation.BCAST else 0
+        )
+        slot_idx = session.head % self.ring_depth_of(session)
+        session.ring[slot_idx] = words
+        session.head += 1
+        session.seqn += 1
+        return words
+
+    @staticmethod
+    def ring_depth_of(session: _RingSession) -> int:
+        return session.ring.shape[0]
+
+    def _dispatch_window(self, comm, mesh, window, reqs_per_slot,
+                         t0) -> None:
+        from ...ops.pallas import cmdring as devring
+
+        gang = self.gang
+        n = len(window)
+        globals_ = []
+        take_ws = []
+        adopt = []  # (calls, plan) per slot, for result adoption
+        with self._lock:
+            session = self._sessions.get(comm.id)
+            if session is None:
+                session = self._sessions[comm.id] = _RingSession(self.depth)
+            start = session.head
+            slot_rows = []
+            for calls, lead, plan in window:
+                slot_rows.append(self._encode(session, lead, plan))
+            if (start % self.depth) + n > self.depth:
+                self.wraps += 1
+            self.refills += 1
+            self.slots_enqueued += n
+            self.last_window = n
+            self.max_window = max(self.max_window, n)
+            self._inflight_windows += 1
+        slots_np = np.stack(slot_rows)
+
+        try:
+            for calls, lead, plan in window:
+                global_arr, prep, _raw = gang._assemble_flat(
+                    calls, plan, mesh
+                )
+                globals_.append(global_arr)
+                take_ws.append(plan["in_w"])
+                adopt.append((calls, plan))
+
+            gang.interactions.bump()  # THE refill: slot write + doorbell,
+            # one host interaction for the whole window
+            import jax
+
+            with jax.profiler.TraceAnnotation(f"accl::cmdring[{n}]"):
+                st, outs = devring.run_window(
+                    slots_np, globals_, mesh, take_ws, self.lowering
+                )
+            for i, (calls, plan) in enumerate(adopt):
+                gang._adopt_out_shards(
+                    outs[i], calls, plan, reqs_per_slot[i]
+                )
+            self._park_window(comm, st, outs, reqs_per_slot, t0)
+        except BaseException:
+            # the window never parked: the armed count must not leak
+            # (the parked/no-spin posture is part of the contract)
+            with self._lock:
+                self._inflight_windows = max(0, self._inflight_windows - 1)
+            raise
+
+    def _park_window(self, comm, st, outs, reqs_per_slot, t0) -> None:
+        """Hand the window's completion to the in-flight window (the
+        refill window): the drainer blocks on the device status word
+        the sequencer wrote, then completes every slot's requests with
+        its per-slot retcode."""
+        from ...ops.pallas.cmdring import status_view
+
+        gang = self.gang
+
+        def waiter(st=st, outs=outs):
+            import jax
+
+            jax.block_until_ready(st)
+            for o in outs:
+                jax.block_until_ready(o)
+
+        def window_done():
+            with self._lock:
+                self._inflight_windows = max(0, self._inflight_windows - 1)
+
+        def on_ready(overlap_ns, depth, ready_ns,
+                     reqs_per_slot=reqs_per_slot, t0=t0):
+            sv = status_view(st)
+            dt = max(ready_ns - t0, 1)
+            window_done()
+            for i, slot_reqs in enumerate(reqs_per_slot):
+                code = (
+                    ErrorCode.OK
+                    if i < len(sv) and int(sv[i, 1]) == CMDRING_ST_OK
+                    else ErrorCode.INVALID_OPERATION
+                )
+                for req in slot_reqs:
+                    req.overlap_ns = overlap_ns or None
+                    req.inflight_depth = depth
+                    req.ring_resident = True
+                    req.complete(code, dt)
+
+        def on_error(exc, reqs_per_slot=reqs_per_slot, t0=t0,
+                     comm_id=comm.id):
+            dt = max(time.perf_counter_ns() - t0, 1)
+            window_done()
+            ctx = {
+                "comm": comm_id,
+                "error": f"{type(exc).__name__}: {exc}"[:300],
+            }
+            for slot_reqs in reqs_per_slot:
+                for req in slot_reqs:
+                    if not req.done():  # side-effect-free engine probe
+                        req.ring_resident = True
+                        req.complete(
+                            ErrorCode.INVALID_OPERATION, dt,
+                            context=dict(ctx, op=req.op_name),
+                        )
+
+        gang.window.park(comm.id, waiter, on_ready, on_error, ring=True)

@@ -63,6 +63,7 @@ from ...overlap import InflightWindow, drain_deadline_s
 from ...request import Request
 from ..base import BaseEngine, CallOptions, InteractionCounter, StreamPortMixin
 from ...ops import driver as opdriver
+from .cmdring import GangCommandRing
 
 #: sentinel returned by the gang execution paths when a call's completion
 #: was handed to the in-flight window (the overlap plane): the caller
@@ -457,6 +458,12 @@ class XLAGangContext:
         # strikes make it "dead" and collectives addressing it fail fast
         # instead of waiting out the watchdog again.  soft_reset clears it.
         self.health: Dict[int, dict] = {}
+        # command-ring plane (the TPU CCLO analog): warm batched windows
+        # of eligible collectives refill a device-resident slot ring and
+        # execute under ONE sequencer dispatch — the host stops issuing
+        # collectives and starts refilling a queue.  ACCL_CMDRING=0
+        # disables; =eager also routes single warm calls through it.
+        self.cmdring = GangCommandRing(self)
 
     _DEAD_AFTER_TIMEOUTS = 2
 
@@ -612,6 +619,10 @@ class XLAGangContext:
             self._asm_cache.clear()
             self.health.clear()  # degradation state is part of the reset
             self.tuning_epoch += 1  # prepared plan state dies with the reset
+        # command ring: park the sequencer and realign every session's
+        # seqn/head at 0 (after the full window drain above — no slot
+        # can still be in flight when the ring state is abandoned)
+        self.cmdring.reset()
         for slot in slots:
             if slot.watchdog is not None:
                 slot.watchdog.cancel()
@@ -774,6 +785,20 @@ class XLAGangContext:
                     req.complete(ErrorCode.INVALID_OPERATION)
             return
         npos = len(entries[0][0])
+        try:
+            # command-ring fast path first: a warm window of eligible
+            # collectives becomes slot refills + ONE sequencer dispatch
+            # (planning is side-effect-free; True means the ring owns
+            # request completion).  Ineligible batches fall through to
+            # the fused program, then the sequential path.
+            handled = self.cmdring.run_batch(comm, entries, npos)
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
+            handled = False
+        if handled:
+            return
         try:
             # planning is side-effect-free: a False return means "not
             # fusable", safe to fall back; once dispatch has begun,
@@ -1343,6 +1368,21 @@ class XLAGangContext:
         interaction — the reference's one-hostctrl-command-per-collective
         discipline.  Returns None to fall back to the host-staged path.
         """
+        # command-ring eager mode (ACCL_CMDRING=eager): a single warm
+        # eligible call rides a one-slot refill window — the `ring` fast
+        # path beside the prepared-plan path.  Default mode keeps single
+        # calls on the prepared path (a one-slot window amortizes
+        # nothing) and reserves the ring for batched windows.
+        if (
+            self.cmdring.eager
+            and reqs is not None
+            and self.cmdring.supports(lead.op)
+        ):
+            entries = [
+                ([calls[r]], [reqs[r]]) for r in range(len(calls))
+            ]
+            if self.cmdring.run_batch(comm, entries, 1, t0=t0):
+                return IN_FLIGHT
         fp = lead.plan
         fast_eligible = fp is not None and lead.op in _FAST_OPS
         if fast_eligible:
@@ -1825,6 +1865,9 @@ class XLAEngine(StreamPortMixin, BaseEngine):
             # overlap plane: the in-flight window's live depth + lifetime
             # counters (launched/completed/failed/max depth/overlap ns)
             "inflight": self.gang.window.stats(),
+            # command-ring plane: refill/doorbell counters, occupancy,
+            # park state and per-reason fallback counts
+            "cmdring": self.gang.cmdring.stats(),
             "faults": None,
             # monitor plane: rank handles share the gang context, so
             # straggler windows meet on one in-process judge (the

@@ -415,6 +415,62 @@ TUNING_DEFAULTS = {
 DEFAULT_INFLIGHT_WINDOW = 4
 MAX_INFLIGHT_WINDOW = 64
 
+
+# ---------------------------------------------------------------------------
+# Device-resident command ring (the TPU CCLO analog).  The host encodes
+# warm collectives into fixed-width int32 slots of a device-memory ring;
+# ONE sequencer program per refill decodes the slots ON DEVICE and
+# executes the whole window, writing a (seqn, retcode) status word per
+# slot that the drainer polls.  This table is the single source of truth
+# for the slot layout: the host-side encoder (ops/pallas/cmdring.py) and
+# the device-side sequencer decode THE SAME indices from it, and the
+# acclint ``cmdring-slot-layout`` check fails any module that re-derives
+# them locally.  Everything here is plain ints — the jax-free closure.
+# ---------------------------------------------------------------------------
+
+
+class CmdOpcode(enum.IntEnum):
+    """Opcode space of a command-ring slot (the sequencer's dispatch
+    vocabulary — a deliberately small warm-path subset of Operation;
+    anything else falls back to host dispatch)."""
+
+    NOP = 0        # padding slot: decoded, skipped, status OK
+    ALLREDUCE = 1
+    BCAST = 2
+    HALT = 3       # teardown marker: parks the sequencer (soft_reset)
+
+
+#: int32 words per slot (fields below + reserved headroom)
+CMDRING_SLOT_WORDS = 8
+
+#: field name -> word index within a slot.  Indices must stay dense,
+#: unique and < CMDRING_SLOT_WORDS (enforced by acclint).
+CMDRING_FIELDS = {
+    "seqn": 0,      # monotone completion sequence number (mod 2^31)
+    "opcode": 1,    # CmdOpcode
+    "count": 2,     # element count of the collective
+    "dtype": 3,     # DataType of the operand
+    "function": 4,  # ReduceFunction (ALLREDUCE slots)
+    "root": 5,      # comm-relative root rank (BCAST slots)
+    "flags": 6,     # reserved (compression lanes, future)
+    "nseg": 7,      # ring segmentation register snapshot
+}
+
+#: per-slot status-word retcodes the sequencer writes back
+CMDRING_ST_OK = 1
+CMDRING_ST_BAD_OP = 2
+
+#: ring geometry + knobs (ACCL_CMDRING=0 disables; =eager also routes
+#: single warm calls through one-slot windows; ACCL_CMDRING_DEPTH sizes
+#: the ring; payloads above ACCL_CMDRING_MAX_BYTES fall back to host
+#: dispatch — big transfers are bandwidth-bound, not floor-bound)
+CMDRING_ENV = "ACCL_CMDRING"
+CMDRING_DEPTH_ENV = "ACCL_CMDRING_DEPTH"
+CMDRING_MAX_BYTES_ENV = "ACCL_CMDRING_MAX_BYTES"
+CMDRING_DEPTH_DEFAULT = 8
+CMDRING_MAX_DEPTH = 64
+CMDRING_MAX_PAYLOAD_BYTES = 4 * 1024 * 1024
+
 # Segmented-pipelining wire tags (overlap plane): concurrent segment
 # sub-collectives of ONE pipelined call execute as concurrent engine
 # tasks on the fabric tiers, and eager matching there is strictly

@@ -8,13 +8,25 @@ TPU kernels.
 * ``ring`` — the firmware's segmented ring collectives as single Pallas
   kernels whose hops are Mosaic remote DMAs over ICI, with slot-ack flow
   control (the RX-buffer release protocol).
+* ``cmdring`` — the device-resident command ring (the CCLO run-loop
+  analog): host-side slot encoder + the sequencer program that decodes
+  slots on device and executes a whole refill window under one
+  dispatch.
 
 On non-TPU backends every kernel runs under the Pallas TPU interpreter so
 the CI tier exercises the identical kernel code (see
 ``_common.default_interpret``).
 """
 
-from . import alltoall, attention, compression, put, ring, rooted  # noqa: F401
+from . import (  # noqa: F401
+    alltoall,
+    attention,
+    cmdring,
+    compression,
+    put,
+    ring,
+    rooted,
+)
 from ._common import default_interpret, pack_lanes, unpack_lanes  # noqa: F401
 from .attention import flash_attention  # noqa: F401
 from .alltoall import alltoall as alltoall_kernel  # noqa: F401

@@ -1,0 +1,393 @@
+"""The device-resident command ring: slot encoder + persistent sequencer.
+
+Role model: the reference's CCLO firmware run loop — the host enqueues
+fixed-width commands into the hostctrl FIFO and the offload kernel's
+own loop decodes and executes whole collectives with no host in the
+data path (``ccl_offload_control.c`` run loop + ``dma_mover``).  The
+TPU analog built here:
+
+* the **host-side encoder** packs a warm collective's plan snapshot
+  (op, seqn, count, dtype, reduce function, root, tuning registers)
+  into ``CMDRING_SLOT_WORDS`` int32 words — the layout comes from ONE
+  table, :data:`accl_tpu.constants.CMDRING_FIELDS`, which the device
+  decoder reads too (acclint ``cmdring-slot-layout`` keeps both honest);
+* the **sequencer** is one device program per refill window that reads
+  the slot words AS DATA on device, decodes each slot in its own loop,
+  executes the collective, and writes a ``(seqn, retcode)`` status word
+  the host drainer polls.  Opcode, reduce function and root are data —
+  the same compiled program serves any mix of warm collectives, so a
+  refill never recompiles; only operand shapes key the program cache.
+
+Two lowerings of the same decode loop (selected like every other
+algorithm register — see ``backends/xla/cmdring.py``):
+
+* ``"xla"`` — each slot's wire move is one ``lax.all_gather`` and the
+  fold/root-select run as data-driven ``jnp.where``/``take`` on the
+  gathered blocks.  This is the emulator/CI tier: provable on the
+  virtual CPU mesh with no Mosaic.
+* ``"pallas"`` — ONE Pallas kernel executes the whole window: per slot
+  the gather hops are Mosaic remote DMAs over ICI driven by the ring
+  kernels' store-and-relay machine (``ring.relay_allgather_hops``; the
+  two-rank form composes ``put.remote_block_put``), and the data-driven
+  fold runs on the VPU between hops.  The kernel's own slot loop — not
+  host dispatch — sequences the collectives, which is the CCLO claim.
+
+Payloads ride the gather at full window width; results are trimmed by
+the host-side adoption (pads are never observed).  Oversized payloads
+never get here — the engine falls back to host dispatch above
+``CMDRING_MAX_PAYLOAD_BYTES`` (big transfers are bandwidth-bound; the
+ring exists to collapse the dispatch floor of small warm windows).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Sequence
+
+import numpy as np
+
+import jax
+
+from ...compat import install as _compat_install
+
+_compat_install()  # legacy-jax shims (shard_map kwargs, lax.axis_size)
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ...constants import (
+    CMDRING_FIELDS,
+    CMDRING_SLOT_WORDS,
+    CMDRING_ST_BAD_OP,
+    CMDRING_ST_OK,
+    CmdOpcode,
+    ReduceFunction,
+)
+from ._common import (
+    LANES,
+    InterpretArg,
+    default_interpret,
+    require_mosaic_dtypes,
+    sublanes_for,
+)
+from .put import remote_block_put
+from .ring import _neighbors, _ring_barrier, relay_allgather_hops
+
+__all__ = [
+    "decode_slot",
+    "encode_slot",
+    "encode_window",
+    "run_window",
+    "sequencer_program",
+    "status_view",
+]
+
+_F = CMDRING_FIELDS  # the one layout table (constants.py)
+
+
+# ---------------------------------------------------------------------------
+# host-side encoder / decoder
+# ---------------------------------------------------------------------------
+
+
+def encode_slot(
+    seqn: int,
+    opcode: CmdOpcode,
+    count: int,
+    dtype: int = 0,
+    function: ReduceFunction = ReduceFunction.SUM,
+    root: int = 0,
+    flags: int = 0,
+    nseg: int = 1,
+) -> np.ndarray:
+    """One command slot as ``(CMDRING_SLOT_WORDS,)`` int32 — every field
+    written through :data:`CMDRING_FIELDS`, never a literal index."""
+    words = np.zeros(CMDRING_SLOT_WORDS, np.int32)
+    words[_F["seqn"]] = int(seqn) & 0x7FFFFFFF
+    words[_F["opcode"]] = int(opcode)
+    words[_F["count"]] = int(count)
+    words[_F["dtype"]] = int(dtype)
+    words[_F["function"]] = int(function)
+    words[_F["root"]] = int(root)
+    words[_F["flags"]] = int(flags)
+    words[_F["nseg"]] = max(1, int(nseg))
+    return words
+
+
+def decode_slot(words) -> dict:
+    """The encoder's inverse (tests / debug dumps / ring introspection)."""
+    w = np.asarray(words).reshape(-1)
+    if w.size != CMDRING_SLOT_WORDS:
+        raise ValueError(
+            f"slot has {w.size} words, layout says {CMDRING_SLOT_WORDS}"
+        )
+    out = {name: int(w[idx]) for name, idx in _F.items()}
+    out["opcode"] = CmdOpcode(out["opcode"])
+    return out
+
+
+def encode_window(slots: Sequence[np.ndarray], depth: int) -> np.ndarray:
+    """Stack encoded slots into a ``(depth, CMDRING_SLOT_WORDS)`` window,
+    NOP-padding the tail (padding slots decode to retcode OK and move no
+    payload — the sequencer's idle slots)."""
+    if len(slots) > depth:
+        raise ValueError(f"{len(slots)} slots into a depth-{depth} window")
+    rows = [np.asarray(s, np.int32).reshape(-1) for s in slots]
+    while len(rows) < depth:
+        rows.append(encode_slot(0, CmdOpcode.NOP, 0))
+    return np.stack(rows).astype(np.int32)
+
+
+# ---------------------------------------------------------------------------
+# the shared decode epilogue (both lowerings)
+# ---------------------------------------------------------------------------
+
+
+def _fold_blocks(blocks, own, op, fn, root):
+    """Data-driven per-slot epilogue shared by both lowerings:
+    ``blocks`` is the list of gathered per-rank blocks (static length =
+    world size), ``own`` this rank's operand, and ``op``/``fn``/``root``
+    are int32 scalars read from the slot words ON DEVICE — so the traced
+    program covers every warm op mix without recompiling.  Selects stay
+    static-indexed ``jnp.where`` chains (no dynamic gather): both the
+    VPU and the CPU tier lower them."""
+    acc_sum = blocks[0]
+    acc_max = blocks[0]
+    for b in blocks[1:]:
+        acc_sum = acc_sum + b
+        acc_max = jnp.maximum(acc_max, b)
+    reduced = jnp.where(fn == int(ReduceFunction.MAX), acc_max, acc_sum)
+    rooted = blocks[0]
+    for r in range(1, len(blocks)):
+        rooted = jnp.where(root == r, blocks[r], rooted)
+    return jnp.where(
+        op == int(CmdOpcode.ALLREDUCE),
+        reduced,
+        jnp.where(op == int(CmdOpcode.BCAST), rooted, own),
+    )
+
+
+def _status_words(slots):
+    """Per-slot ``(seqn, retcode)`` status words, computed ON DEVICE from
+    the slot data by the same program that executes the window — the
+    completion word the host drainer polls."""
+    op = slots[:, _F["opcode"]]
+    ok = (
+        (op == int(CmdOpcode.NOP))
+        | (op == int(CmdOpcode.ALLREDUCE))
+        | (op == int(CmdOpcode.BCAST))
+        | (op == int(CmdOpcode.HALT))
+    )
+    ret = jnp.where(ok, CMDRING_ST_OK, CMDRING_ST_BAD_OP).astype(jnp.int32)
+    return jnp.stack([slots[:, _F["seqn"]], ret], axis=1)
+
+
+# ---------------------------------------------------------------------------
+# the Pallas sequencer kernel (one kernel, N collectives)
+# ---------------------------------------------------------------------------
+
+
+def _sequencer_kernel(axis_name: str, size: int, depth: int, rows: int):
+    """One window as ONE Mosaic program: the kernel loop — not host
+    dispatch — sequences ``depth`` collectives.  ``rows`` is the
+    (uniform, tile-aligned) per-slot payload height; slot ``i`` owns
+    ``x_ref[i*rows:(i+1)*rows]``.  Per slot: ring-allgather the block
+    via the store-and-relay remote-DMA machine (the two-rank ring
+    degenerates to one ``put.remote_block_put`` exchange), then fold
+    with the data-driven epilogue.  A neighbor barrier separates window
+    slots so slot ``i+1``'s first hop can never overwrite a comm slot
+    its consumer is still folding."""
+
+    def kernel(slots_ref, x_ref, o_ref, gathered, carry, comm, send_sem,
+               recv_sem, ack_sem):
+        me, nxt, prv = _neighbors(axis_name, size)
+        for i in range(depth):
+            _ring_barrier(nxt, prv)  # doorbell + inter-slot slot-reuse gate
+            block = x_ref[pl.ds(i * rows, rows), :]
+            gathered[pl.ds(me * rows, rows), :] = block
+            if size == 2:
+                # two-rank gather IS one neighbor put (the put.py
+                # primitive): my block lands in the peer's comm slot
+                carry[0] = block
+                remote_block_put(
+                    carry.at[0],
+                    comm.at[0, 0],
+                    send_sem.at[0, 0],
+                    recv_sem.at[0, 0],
+                    nxt,
+                )
+                gathered[pl.ds(prv * rows, rows), :] = comm[0, 0]
+            elif size > 2:
+                carry[0] = block
+
+                def place(origin, _j, data):
+                    gathered[pl.ds(origin * rows, rows), :] = data
+
+                relay_allgather_hops(
+                    place, carry, comm, send_sem, recv_sem, ack_sem,
+                    me, nxt, prv, size,
+                )
+            # decode the slot words from SMEM (scalar reads) and fold
+            op = slots_ref[i, _F["opcode"]]
+            fn = slots_ref[i, _F["function"]]
+            root = slots_ref[i, _F["root"]]
+            blocks = [
+                gathered[pl.ds(r * rows, rows), :] for r in range(size)
+            ]
+            o_ref[pl.ds(i * rows, rows), :] = _fold_blocks(
+                blocks, block, op, fn, root
+            )
+
+    return kernel
+
+
+def _pallas_window(slots, xs, axis_name, size, depth, take_ws,
+                   interpret: InterpretArg = None):
+    """Trace the whole window through one ``pallas_call``.  Per-slot
+    operands are packed to one uniform tile-aligned height inside the
+    traced body (zero extra dispatch — this all runs in the SAME
+    program), the kernel executes every slot, and the per-slot results
+    are unpacked back to their true widths."""
+    dtype = xs[0].dtype
+    interp = default_interpret(interpret)
+    require_mosaic_dtypes(interp, "command-ring sequencer", dtype)
+    sub = sublanes_for(dtype)
+    width = max(take_ws)
+    rows = max(-(-width // LANES), 1)
+    rows = -(-rows // sub) * sub  # tile-aligned uniform slot height
+    packed = []
+    for x, w in zip(xs, take_ws):
+        flat = x[:w]
+        pad = rows * LANES - w
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros((pad,), dtype)])
+        packed.append(flat.reshape(rows, LANES))
+    xp = jnp.concatenate(packed, axis=0)  # (depth*rows, LANES)
+    scratch = [
+        pltpu.VMEM((size * rows, LANES), dtype),  # gathered blocks
+        pltpu.VMEM((1, rows, LANES), dtype),      # relay carry
+        pltpu.VMEM((2, 1, rows, LANES), dtype),   # comm slots
+        pltpu.SemaphoreType.DMA((2, 1)),          # send
+        pltpu.SemaphoreType.DMA((2, 1)),          # recv
+        pltpu.SemaphoreType.REGULAR((2, 1)),      # slot acks
+    ]
+    out = pl.pallas_call(
+        _sequencer_kernel(axis_name, size, depth, rows),
+        out_shape=jax.ShapeDtypeStruct((depth * rows, LANES), dtype),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=scratch,
+        compiler_params=_compiler_params(),
+        interpret=interp,
+    )(slots, xp)
+    outs = []
+    for i, w in enumerate(take_ws):
+        outs.append(out[i * rows:(i + 1) * rows].reshape(-1)[:w])
+    return outs
+
+
+def _compiler_params():
+    """CompilerParams across jax vintages: modern ``CompilerParams``
+    (has_side_effects) when present, else the legacy
+    ``TPUCompilerParams`` surface (collective id 5 — the module
+    namespace holds 0=ring, 1=put, 2=attention, 3=alltoall, 4=int8
+    scale leg, 5=this sequencer)."""
+    if hasattr(pltpu, "CompilerParams"):
+        return pltpu.CompilerParams(has_side_effects=True, collective_id=5)
+    return pltpu.TPUCompilerParams(collective_id=5)  # pragma: no cover
+
+
+# ---------------------------------------------------------------------------
+# the sequencer program (one dispatch per refill window)
+# ---------------------------------------------------------------------------
+
+
+@lru_cache(maxsize=256)
+def _program(mesh_id: int, depth: int, widths: tuple, take_ws: tuple,
+             lowering: str):
+    """The jitted refill program: ``(slots_global, *slot_globals) ->
+    (status_global, *result_globals)``.  Slot CONTENT is data — only
+    the window shape (depth, per-slot widths) and the lowering key the
+    cache, so a warm ring session never recompiles on op/function/root
+    churn."""
+    from ..driver import _MESHES, AXIS, _smap
+
+    mesh = _MESHES[mesh_id]
+    size = mesh.devices.size
+    spec_in = (jax.sharding.PartitionSpec(AXIS),) * (1 + depth)
+    spec_out = (jax.sharding.PartitionSpec(AXIS),) * (1 + depth)
+
+    def body(slots, *xs):
+        # slots: this rank's (depth, CMDRING_SLOT_WORDS) replica shard
+        if lowering == "pallas":
+            outs = _pallas_window(
+                slots, xs, AXIS, size, depth, list(take_ws)
+            )
+        else:
+            outs = []
+            for i in range(depth):
+                own = xs[i][:take_ws[i]]
+                # the slot's wire move: ONE gather; fold/root-select are
+                # data-driven on the gathered stack
+                gathered = lax.all_gather(own, AXIS)
+                blocks = [gathered[r] for r in range(size)]
+                outs.append(_fold_blocks(
+                    blocks, own,
+                    slots[i, _F["opcode"]],
+                    slots[i, _F["function"]],
+                    slots[i, _F["root"]],
+                ))
+        return (_status_words(slots), *outs)
+
+    return _smap(mesh, body, spec_in, spec_out)
+
+
+def sequencer_program(mesh, depth: int, widths: Sequence[int],
+                      take_ws: Sequence[int], lowering: str = "xla"):
+    """Prepared-program handle for a ring session (the engine caches it
+    per window shape, exactly like ``opdriver.prepare``)."""
+    from ..driver import _mesh_key
+
+    return _program(
+        _mesh_key(mesh), int(depth), tuple(int(w) for w in widths),
+        tuple(int(w) for w in take_ws), str(lowering),
+    )
+
+
+def run_window(slots_np: np.ndarray, globals_, mesh, take_ws,
+               lowering: str = "xla"):
+    """Dispatch one refill window: ``slots_np`` is the host ring's
+    ``(depth, CMDRING_SLOT_WORDS)`` int32 view, ``globals_`` one
+    assembled flat global per slot (raw per-rank HBM shards — the
+    zero-copy assembly of the gang engine).  Returns
+    ``(status_global, result_globals)``; the caller blocks on the
+    status global — THE device status word — at its drain points."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from ..driver import AXIS
+
+    depth = int(slots_np.shape[0])
+    size = mesh.devices.size
+    widths = tuple(int(g.shape[0]) // size for g in globals_)
+    prog = sequencer_program(mesh, depth, widths, take_ws, lowering)
+    # the refill write: the slot words land in device memory as part of
+    # THIS dispatch (slots ride the program call — one host interaction
+    # per refill, the counter-asserted contract)
+    tiled = np.tile(np.asarray(slots_np, np.int32), (size, 1))
+    slots_dev = jax.device_put(
+        tiled, NamedSharding(mesh, PartitionSpec(AXIS))
+    )
+    out = prog(slots_dev, *globals_)
+    return out[0], list(out[1:])
+
+
+def status_view(status_global) -> np.ndarray:
+    """The drainer's read of the device status word: one addressable
+    shard (every rank's copy is identical by construction) as a host
+    ``(depth, 2)`` int32 array of ``(seqn, retcode)``."""
+    shard = status_global.addressable_shards[0].data
+    return np.asarray(shard).reshape(-1, 2)

@@ -41,6 +41,28 @@ from ._common import (
 )
 
 
+def remote_block_put(src_ref, dst_ref, send_sem, recv_sem, dst_dev):
+    """One device-initiated block put: remote-DMA ``src_ref`` into
+    ``dst_ref`` on ``dst_dev`` and block until both sides drained — the
+    ``stream_put`` primitive factored out of :func:`fused_shift` so
+    other kernels (the command-ring sequencer's two-rank exchange) can
+    compose it.  The caller owns the pre-put barrier (the remote ref
+    must exist before data lands in it)."""
+    rdma = pltpu.make_async_remote_copy(
+        src_ref=src_ref,
+        dst_ref=dst_ref,
+        send_sem=send_sem,
+        recv_sem=recv_sem,
+        device_id=dst_dev,
+        device_id_type=pltpu.DeviceIdType.LOGICAL,
+    )
+    rdma.start()
+    # acclint: allow[unbounded-wait] Mosaic-traced DMA semaphore wait
+    # inside the kernel: Pallas remote copies have no timeout form;
+    # the host-side gang watchdog bounds the whole program instead
+    rdma.wait()
+
+
 def _kernel(axis_name: str, size: int, distance: int, compute):
     def kernel(x_ref, o_ref, y, send_sem, recv_sem):
         me = lax.axis_index(axis_name)
@@ -53,20 +75,7 @@ def _kernel(axis_name: str, size: int, distance: int, compute):
         # put phase: the "stream_put" half — this kernel, not the host and
         # not a collective op, initiates the wire transfer
         neighbor_barrier(dst, src)
-
-        rdma = pltpu.make_async_remote_copy(
-            src_ref=y,
-            dst_ref=o_ref,
-            send_sem=send_sem,
-            recv_sem=recv_sem,
-            device_id=dst,
-            device_id_type=pltpu.DeviceIdType.LOGICAL,
-        )
-        rdma.start()
-        # acclint: allow[unbounded-wait] Mosaic-traced DMA semaphore wait
-        # inside the kernel: Pallas remote copies have no timeout form;
-        # the host-side gang watchdog bounds the whole program instead
-        rdma.wait()
+        remote_block_put(y, o_ref, send_sem, recv_sem, dst)
 
     return kernel
 

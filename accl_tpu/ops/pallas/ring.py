@@ -247,9 +247,36 @@ def _reduce_scatter_kernel(axis_name, size, num_segments, op):
     return kernel
 
 
-def _allgather_kernel(axis_name, size, num_segments):
+def relay_allgather_hops(dst_write, carry, comm, send_sem, recv_sem,
+                         ack_sem, me, nxt, prv, size):
+    """The store-and-relay ring allgather hop loop (ref
+    ccl_offload_control.c:1402-1500), factored out so the allgather
+    kernel AND the command-ring sequencer (``cmdring``) drive the same
+    machine: ``carry[j]`` must be pre-seeded with this rank's own block
+    segments; ``dst_write(origin, j, data)`` places each arriving
+    block's segment ``j`` (``origin`` = the block's home rank, traced).
+    Segment count derives from ``carry``'s leading dim; semaphores drain
+    to zero by loop end (the slot-ack release discipline)."""
+    S = carry.shape[0]
     total_hops = size - 1
+    for t in range(1, size):
+        slot = t % 2
+        rdmas = [
+            _hop(comm.at[slot, j], carry.at[j],
+                 send_sem.at[slot, j], recv_sem.at[slot, j],
+                 ack_sem.at[slot, j], nxt, t)
+            for j in range(S)
+        ]
+        origin = jnp.mod(me - t, size)
+        for j in range(S):
+            rdmas[j].wait_recv()
+            rdmas[j].wait_send()
+            dst_write(origin, j, comm[slot, j])
+            carry[j] = comm[slot, j]
+            _release(ack_sem.at[slot, j], prv, t, total_hops)
 
+
+def _allgather_kernel(axis_name, size, num_segments):
     def kernel(x_ref, o_ref, carry, comm, send_sem, recv_sem, ack_sem):
         me, nxt, prv = _neighbors(axis_name, size)
         S = num_segments
@@ -260,21 +287,14 @@ def _allgather_kernel(axis_name, size, num_segments):
         for j in range(S):
             carry[j] = x_ref[pl.ds(j * segB, segB), :]
             o_ref[pl.ds(me * B + j * segB, segB), :] = carry[j]
-        for t in range(1, size):
-            slot = t % 2
-            rdmas = [
-                _hop(comm.at[slot, j], carry.at[j],
-                     send_sem.at[slot, j], recv_sem.at[slot, j],
-                     ack_sem.at[slot, j], nxt, t)
-                for j in range(S)
-            ]
-            origin = jnp.mod(me - t, size)
-            for j in range(S):
-                rdmas[j].wait_recv()
-                rdmas[j].wait_send()
-                o_ref[pl.ds(origin * B + j * segB, segB), :] = comm[slot, j]
-                carry[j] = comm[slot, j]
-                _release(ack_sem.at[slot, j], prv, t, total_hops)
+
+        def place(origin, j, data):
+            o_ref[pl.ds(origin * B + j * segB, segB), :] = data
+
+        relay_allgather_hops(
+            place, carry, comm, send_sem, recv_sem, ack_sem, me, nxt, prv,
+            size,
+        )
 
     return kernel
 

@@ -124,6 +124,9 @@ class InflightWindow:
         self.failed = 0
         self.max_depth_seen = 0
         self.overlap_ns_total = 0
+        # command-ring plane: refill windows parked with ring=True (each
+        # is ONE entry covering a whole window of collectives)
+        self.ring_launched = 0
 
     # -- engine side ---------------------------------------------------------
     def set_depth(self, depth: int) -> None:
@@ -137,6 +140,7 @@ class InflightWindow:
         waiter: Callable[[], None],
         on_ready: Callable[[int, int, int], None],
         on_error: Callable[[BaseException], None],
+        ring: bool = False,
     ) -> None:
         """Queue one launched call.  ``waiter`` blocks until the device
         result is ready; ``on_ready(overlap_ns, depth_at_park,
@@ -145,7 +149,14 @@ class InflightWindow:
         is at the depth bound (backpressure, bounded by
         ``park_timeout_s`` — a wedged oldest call must not also wedge
         the submitting thread), and runs synchronously when the window
-        was stopped (engine shutdown degraded mode)."""
+        was stopped (engine shutdown degraded mode).
+
+        ``ring=True`` marks a command-ring refill window (the TPU CCLO
+        plane): for ring-resident traffic THIS window is the refill
+        window — its depth bounds how many refill dispatches run ahead
+        of completion, and every drain point below blocks on the device
+        status word the sequencer wrote (the ``waiter``).  Counted
+        separately in :meth:`stats` (``ring_launched``)."""
         with self._cv:
             stopped = self._stopped
             if not stopped:
@@ -176,6 +187,8 @@ class InflightWindow:
                 fifo.append(entry)
                 self._total += 1
                 self.launched += 1
+                if ring:
+                    self.ring_launched += 1
                 self.max_depth_seen = max(self.max_depth_seen, depth)
                 t = self._threads.get(key)
                 if t is None:
@@ -192,6 +205,8 @@ class InflightWindow:
         # invariant the soak/overlap tests assert)
         with self._lock:
             self.launched += 1
+            if ring:
+                self.ring_launched += 1
         self._complete(
             _Entry(key, waiter, on_ready, on_error,
                    time.perf_counter_ns(), 1)
@@ -265,6 +280,7 @@ class InflightWindow:
                 "completed": self.completed,
                 "failed": self.failed,
                 "overlap_ns_total": self.overlap_ns_total,
+                "ring_launched": self.ring_launched,
             }
 
     # -- drainer (one per active key) ----------------------------------------

@@ -65,7 +65,7 @@ class CollectivePlan:
     __slots__ = (
         "key", "arithcfg", "compression", "wire_dtype", "bucket",
         "eager", "algorithm", "tuning", "engine",
-        "pipeline_threshold", "pipeline_segments",
+        "pipeline_threshold", "pipeline_segments", "cmdring_slot",
     )
 
     def __init__(self, key, arithcfg, compression, wire_dtype, bucket,
@@ -89,6 +89,12 @@ class CollectivePlan:
         # here so the warm path never re-reads engine registers.
         self.pipeline_threshold = int(pipeline_threshold or 0)
         self.pipeline_segments = int(pipeline_segments or 1)
+        # command-ring plane: the plan -> slot encoding, cached by the
+        # gang engine on first ring-resident dispatch (an int32 word
+        # template from ops/pallas/cmdring.encode_slot; per-call fields
+        # — seqn/count/root/function — are patched at refill).  Opaque
+        # here: this module stays jax/numpy-free.
+        self.cmdring_slot = None
 
     def pipeline_for(self, nbytes: int) -> int:
         """Sub-launch count for a payload of ``nbytes``: the cached
@@ -112,6 +118,7 @@ class CollectivePlan:
             "tuning": dict(self.tuning) if self.tuning else None,
             "pipeline_threshold": self.pipeline_threshold,
             "pipeline_segments": self.pipeline_segments,
+            "cmdring_slot_cached": self.cmdring_slot is not None,
         }
 
 

@@ -70,6 +70,10 @@ class Request:
         # depth it was parked at.  None on tiers/paths without a window.
         self.overlap_ns: Optional[int] = None
         self.inflight_depth: Optional[int] = None
+        # command-ring plane (the TPU CCLO analog): True when this call
+        # executed ring-resident — decoded and sequenced on device by
+        # the persistent sequencer, the host only refilling the ring
+        self.ring_resident: Optional[bool] = None
 
     # -- engine side --------------------------------------------------------
     def mark_executing(self) -> None:
@@ -97,7 +101,8 @@ class Request:
                 tel.record(meta, self._duration_ns, self._retcode,
                            self.error_context,
                            overlap_ns=self.overlap_ns,
-                           inflight_depth=self.inflight_depth)
+                           inflight_depth=self.inflight_depth,
+                           ring_resident=self.ring_resident)
             except Exception:  # pragma: no cover - defensive
                 pass
         for cb in callbacks:

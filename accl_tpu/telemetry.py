@@ -119,13 +119,14 @@ class CallRecord:
         "op", "comm", "epoch", "dtype", "count", "nbytes", "bucket",
         "algorithm", "plan_hit", "eager", "duration_ns", "retcode",
         "retcode_name", "end_perf_ns", "attempts", "peer",
-        "overlap_ns", "inflight_depth",
+        "overlap_ns", "inflight_depth", "ring_resident",
     )
 
     def __init__(self, op, comm, epoch, dtype, count, nbytes, bucket,
                  algorithm, plan_hit, eager, duration_ns, retcode,
                  retcode_name, end_perf_ns, attempts=None, peer=None,
-                 overlap_ns=None, inflight_depth=None):
+                 overlap_ns=None, inflight_depth=None,
+                 ring_resident=None):
         self.op = op
         self.comm = comm
         self.epoch = epoch
@@ -146,6 +147,10 @@ class CallRecord:
         # at park (None when the call never rode an in-flight window)
         self.overlap_ns = overlap_ns
         self.inflight_depth = inflight_depth
+        # command-ring plane: True when the call executed ring-resident
+        # (sequenced on device by the cmdring sequencer, not by host
+        # dispatch); None on non-ring paths/tiers
+        self.ring_resident = ring_resident
 
     def as_dict(self) -> dict:
         d = {
@@ -172,6 +177,8 @@ class CallRecord:
             d["overlap_ns"] = self.overlap_ns
         if self.inflight_depth is not None:
             d["inflight_depth"] = self.inflight_depth
+        if self.ring_resident is not None:
+            d["ring_resident"] = self.ring_resident
         return d
 
 
@@ -270,7 +277,8 @@ class MetricsRegistry:
 
     def record_call(self, op: str, size_bucket: int, duration_ns: int,
                     code: int, code_name: str, plan_hit,
-                    attempts, overlap_ns=None) -> None:
+                    attempts, overlap_ns=None,
+                    ring_resident=None) -> None:
         """The completion-path fast lane: every counter/histogram update
         one call makes, under ONE lock acquisition (separate inc/observe
         calls each pay a lock + tuple build — measured at ~2x this)."""
@@ -297,6 +305,11 @@ class MetricsRegistry:
                 key = ("accl_overlap_ns_total", op)
                 c[key] = c.get(key, 0) + int(overlap_ns)
                 key = ("accl_overlapped_calls_total", op)
+                c[key] = c.get(key, 0) + 1
+            if ring_resident:
+                # command-ring plane: calls the device sequencer executed
+                # (host only refilled the ring)
+                key = ("accl_ring_resident_calls_total", op)
                 c[key] = c.get(key, 0) + 1
             h = self._hist.get((op, size_bucket))
             if h is None:
@@ -459,6 +472,7 @@ class Telemetry:
             req.error_context,
             overlap_ns=getattr(req, "overlap_ns", None),
             inflight_depth=getattr(req, "inflight_depth", None),
+            ring_resident=getattr(req, "ring_resident", None),
         )
         req._telemetry = self
         req._tmeta = meta
@@ -466,7 +480,7 @@ class Telemetry:
     def record(self, meta: dict, duration_ns: int, retcode,
                error_context: Optional[dict] = None,
                amend: bool = False, overlap_ns=None,
-               inflight_depth=None) -> None:
+               inflight_depth=None, ring_resident=None) -> None:
         """Append one CallRecord + metrics.  ``amend=True`` re-records a
         call whose retcode changed AFTER completion (a deferred-result
         adoption failure downgrading OK): the corrected record is
@@ -485,7 +499,7 @@ class Telemetry:
             meta["nbytes"], bucket, meta["algorithm"], plan_hit,
             meta["eager"], duration_ns, code, code_name,
             time.perf_counter_ns(), attempts, ctx.get("peer"),
-            overlap_ns, inflight_depth,
+            overlap_ns, inflight_depth, ring_resident,
         )
         self.recorder.append(rec)
         if amend:
@@ -497,6 +511,7 @@ class Telemetry:
         self.metrics.record_call(
             op, bucket if bucket is not None else 0, duration_ns,
             code, code_name, plan_hit, attempts, overlap_ns,
+            ring_resident,
         )
         for obs in self._observers:
             # monitor plane (skew tracker / anomaly watchdog): amended

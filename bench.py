@@ -916,6 +916,111 @@ def _bench_gang_device_time() -> dict:
             x.deinit()
 
 
+def _bench_cmdring() -> dict:
+    """The command-ring (device-resident sequencer) dispatch floor: the
+    SAME warm facade allreduce measured two ways at the same payload —
+    a serialized sync loop (every call pays the host-dispatch floor)
+    and batched windows riding the command ring (one refill interaction
+    per window of N, sequenced on device).  Device time is estimated by
+    payload slope exactly like ``_bench_gang_device_time``; the two
+    floors are then wall − device at the SAME 2n point, so
+    ``check_cmdring`` can demand ring < host on one capture.  A smaller
+    payload than the gang bench keeps the floor (not bandwidth)
+    dominant — the regime the ring exists for.  Also emits
+    ``gang_cmdring_refills_per_call``: the host-interaction
+    amortization evidence (1/window when every call rode the ring)."""
+    from accl_tpu.core import xla_group
+
+    n = _size(64 * 1024)  # 256 KB fp32: floor-dominant, ring-eligible
+    wdepth = 8            # collectives per batched window
+    windows = 3 if _SMALL else 12
+    g = xla_group(1)
+    try:
+        a = g[0]
+
+        def fresh_sends(count, k):
+            host = np.ones(count, np.float32)
+            sends = []
+            for i in range(k):
+                host[0] = 1.0 + (i + 1) / 128.0  # distinct content
+                sends.append(a.create_buffer_from(host.copy()))
+            for sb in sends:
+                sb.device_array().block_until_ready()
+            return sends
+
+        def drain(d):
+            arr = d.device_array() if hasattr(d, "device_array") else None
+            if arr is not None:
+                arr.block_until_ready()
+
+        def timed_serial(count):
+            iters = wdepth * (2 if _SMALL else 3)
+            sends = fresh_sends(count, iters)
+            d = a.create_buffer(count, np.float32)
+            a.allreduce(sends[0], d, count)  # warm compile
+            drain(d)
+            with Timer() as t:
+                for sb in sends:
+                    a.allreduce(sb, d, count)
+                drain(d)
+            return t.elapsed_ns() / iters / 1e3
+
+        def timed_ring(count):
+            sends = fresh_sends(count, wdepth)
+            d = a.create_buffer(count, np.float32)
+            # warm window: compiles the sequencer program
+            with a.batch():
+                reqs = [
+                    a.allreduce(sb, d, count, run_async=True)
+                    for sb in sends
+                ]
+            for r in reqs:
+                r.wait(120)
+                r.check()
+            drain(d)
+            ring0 = a.engine.telemetry_report().get("cmdring") or {}
+            with Timer() as t:
+                for _ in range(windows):
+                    with a.batch():
+                        reqs = [
+                            a.allreduce(sb, d, count, run_async=True)
+                            for sb in sends
+                        ]
+                    for r in reqs:
+                        r.wait(120)
+                        r.check()
+                drain(d)
+            ring1 = a.engine.telemetry_report().get("cmdring") or {}
+            calls = windows * wdepth
+            refills = ring1.get("refills", 0) - ring0.get("refills", 0)
+            slots = ring1.get("slots", 0) - ring0.get("slots", 0)
+            return t.elapsed_ns() / calls / 1e3, refills / calls, slots
+
+        w1 = timed_serial(n)
+        w2 = timed_serial(2 * n)
+        dev = min(max(2.0 * (w2 - w1), 0.0), w2)
+        r2, refills_per_call, slots = timed_ring(2 * n)
+        floor_host = min(max(w2 - dev, 0.0), w2)
+        floor_ring = min(max(r2 - dev, 0.0), r2)
+        ring_stats = a.engine.telemetry_report().get("cmdring") or {}
+        return {
+            "gang_cmdring_serial_wall_us": round(w2, 1),
+            "gang_cmdring_wall_us": round(r2, 1),
+            "gang_cmdring_device_us": round(dev, 1),
+            "gang_cmdring_host_floor_us": round(floor_host, 1),
+            "gang_cmdring_dispatch_floor_us": round(floor_ring, 1),
+            "gang_cmdring_refills_per_call": round(refills_per_call, 4),
+            "gang_cmdring_window": wdepth,
+            "gang_cmdring_ring_slots": slots,
+            "gang_cmdring_mode": ring_stats.get("mode"),
+            "gang_cmdring_lowering": ring_stats.get("lowering"),
+            "gang_cmdring_fallbacks": ring_stats.get("fallbacks"),
+        }
+    finally:
+        for x in g:
+            x.deinit()
+
+
 def _bench_ring_allreduce(ndev: int, algo: str = "xla") -> float:
     """Bus bandwidth of a K-iteration device-side allreduce loop over the
     mesh; slope timing so dispatch cancels out.  ``algo`` picks the XLA
@@ -1302,6 +1407,8 @@ def _save_lkg(result: dict) -> None:
         return  # a regressed arch capture must never become the new LKG
     if gate_errors.get("overlap_gate"):
         return  # nor one whose overlap evidence failed its gate
+    if gate_errors.get("cmdring_gate"):
+        return  # nor one whose command-ring evidence failed its gate
     if gate_errors.get("verify_gate"):
         return  # nor one whose contract-verify budget failed its gate
     if gate_errors.get("monitor_gate"):
@@ -1770,6 +1877,7 @@ def main() -> None:
     _try(
         extras, errors, "gang_device_time", _bench_gang_device_time
     )
+    _try(extras, errors, "cmdring", _bench_cmdring)
 
     if on_tpu or _SMALL:
         _try(extras, errors, "attention", _bench_attention)
@@ -1847,11 +1955,13 @@ def main() -> None:
         # NameError from the gate's except clause below
         from benchmarks.parse_results import (
             ArchOverheadRegressionError,
+            CmdringGateError,
             MonitorGateError,
             OverlapGateError,
             TelemetryGateError,
             VerifyGateError,
             check_arch_overhead,
+            check_cmdring,
             check_monitor,
             check_overlap,
             check_telemetry,
@@ -1880,6 +1990,13 @@ def main() -> None:
             check_overlap(extras, lkg_gate.get("result") or {})
         except OverlapGateError as e:
             errors["overlap_gate"] = str(e)
+        # command-ring evidence gate: a ring floor must ship with its
+        # host-floor comparison + refill amortization counters, engage
+        # the ring (slots > 0), and beat the host-dispatch floor
+        try:
+            check_cmdring(extras, lkg_gate.get("result") or {})
+        except CmdringGateError as e:
+            errors["cmdring_gate"] = str(e)
         # contract-verify budget gate: a facade capture must carry the
         # verifier A/B evidence and its <=5% opt-in overhead verdict
         try:

@@ -395,6 +395,89 @@ def check_overlap_capture(bench_path: str, lkg_path: str = None) -> None:
     )
 
 
+class CmdringGateError(ValueError):
+    """The command-ring capture is missing its evidence or the ring
+    floor does not beat the host-dispatch floor at the same point: the
+    sequencer stopped amortizing the refill — fix the engine instead of
+    committing the capture."""
+
+
+def check_cmdring(extras: dict, lkg_result: dict = None,
+                  tolerance: float = None) -> None:
+    """Gate a capture's command-ring evidence.  No-op when the cmdring
+    bench never ran (wedged captures carry no cmdring keys); otherwise
+    the capture must carry the ring floor WITH its host-floor
+    comparison point and refill-amortization counters, the warm window
+    must have actually ridden the ring (slots > 0, refills_per_call
+    < 1), the ring floor must be strictly below the host-dispatch
+    floor measured at the same payload, and the ring floor must not
+    regress >tolerance vs the last-known-good."""
+    tol = OVERLAP_REGRESSION_TOLERANCE if tolerance is None else tolerance
+    extras = extras or {}
+    floor = extras.get("gang_cmdring_dispatch_floor_us")
+    host = extras.get("gang_cmdring_host_floor_us")
+    rpc = extras.get("gang_cmdring_refills_per_call")
+    slots = extras.get("gang_cmdring_ring_slots")
+    if floor is None and host is None and rpc is None:
+        return  # cmdring bench never ran: nothing to gate
+    if floor is None or host is None or rpc is None:
+        raise CmdringGateError(
+            "capture carries partial command-ring evidence (need "
+            "gang_cmdring_dispatch_floor_us + gang_cmdring_host_floor_us "
+            "+ gang_cmdring_refills_per_call together) — the ring floor "
+            "is unverifiable"
+        )
+    if not slots:
+        raise CmdringGateError(
+            "cmdring bench ran but no collective executed ring-resident "
+            f"(slots={slots}, fallbacks="
+            f"{extras.get('gang_cmdring_fallbacks')}): the ring fast "
+            "path is not engaging; refusing the capture"
+        )
+    if rpc >= 1.0:
+        raise CmdringGateError(
+            f"gang_cmdring_refills_per_call {rpc} >= 1: a batched "
+            "window must amortize to ONE host refill interaction for N "
+            "collectives; the ring is dispatching per call"
+        )
+    if host > 0 and floor >= host:
+        raise CmdringGateError(
+            f"ring floor {floor:.1f} us is not below the host-dispatch "
+            f"floor {host:.1f} us at the same point — the sequencer "
+            "buys nothing; refusing the capture"
+        )
+    base = ((lkg_result or {}).get("extras") or {}).get(
+        "gang_cmdring_dispatch_floor_us"
+    )
+    if base is not None and base > 0 and floor > tol * base:
+        raise CmdringGateError(
+            f"gang_cmdring_dispatch_floor_us {floor:.1f} us regressed "
+            f"beyond {tol:.2f}x the last-known-good {base:.1f} us; "
+            "refusing the capture"
+        )
+
+
+def check_cmdring_capture(bench_path: str, lkg_path: str = None) -> None:
+    """CLI form (``--check-cmdring BENCH_rNN.json``).  Also accepts the
+    committed standalone capture shape (a ``cmdring`` section)."""
+    import json
+
+    with open(bench_path) as f:
+        doc = json.load(f)
+    result = doc.get("parsed") or doc.get("result") or doc
+    extras = (result or {}).get("extras") or result.get("cmdring") or {}
+    lkg_path = lkg_path or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".bench_lkg.json",
+    )
+    try:
+        with open(lkg_path) as f:
+            lkg = json.load(f)
+    except (OSError, ValueError):
+        lkg = {}
+    check_cmdring(extras, lkg.get("result") or {})
+
+
 # Autotuned-plan refusal: a TuningPlan only ever *overrides* registers
 # where a candidate measured faster than the defaults, so a tuned sweep
 # should never be meaningfully slower than the default sweep at any
@@ -599,6 +682,14 @@ def main(argv=None) -> str:
         print(
             f"{argv[i + 1]}: overlap evidence present, dispatch floor "
             f"within {OVERLAP_REGRESSION_TOLERANCE:.2f}x of LKG"
+        )
+        return ""
+    if "--check-cmdring" in argv:
+        i = argv.index("--check-cmdring")
+        check_cmdring_capture(argv[i + 1])
+        print(
+            f"{argv[i + 1]}: command-ring evidence present, ring floor "
+            "below the host-dispatch floor, refills amortized"
         )
         return ""
     if "--check-verify" in argv:

@@ -56,12 +56,14 @@ try:
     from parse_results import (  # running as a script: sibling import
         ARCH_REGRESSION_TOLERANCE,
         ArchOverheadRegressionError,
+        CmdringGateError,
         OVERLAP_REGRESSION_TOLERANCE,
         OverlapGateError,
         TelemetryGateError,
         TunedPlanRegressionError,
         VerifyGateError,
         check_arch_overhead,
+        check_cmdring,
         check_overlap,
         check_telemetry,
         check_tuned_not_slower,
@@ -71,12 +73,14 @@ except ImportError:  # pragma: no cover - running as a package module
     from benchmarks.parse_results import (  # noqa: F401
         ARCH_REGRESSION_TOLERANCE,
         ArchOverheadRegressionError,
+        CmdringGateError,
         OVERLAP_REGRESSION_TOLERANCE,
         OverlapGateError,
         TelemetryGateError,
         TunedPlanRegressionError,
         VerifyGateError,
         check_arch_overhead,
+        check_cmdring,
         check_overlap,
         check_telemetry,
         check_tuned_not_slower,

@@ -65,6 +65,42 @@ then
   exit 3
 fi
 
+# Command-ring leg: the r06 capture the ISSUE gate targets — the warm
+# batched-window floor on the REAL chip (pallas sequencer lowering),
+# exported standalone so the TPU evidence commits like the CPU-mesh
+# capture (benchmarks/results/cmdring_gang_cpu.json).  The guarded
+# bench above already ran _bench_cmdring into the scoreboard + its
+# cmdring_gate; this leg re-captures it as the committed artifact.
+echo "== 2b/5 command-ring capture (TPU r06)" >&2
+if ! timeout 600 python - <<'PY'
+import datetime, json
+import bench
+out = bench._bench_cmdring()
+doc = {
+    "capture": "command ring: warm batched windows on the "
+               "device-resident sequencer vs serialized host dispatch",
+    "provenance": None,  # fresh chip capture
+    "device": "tpu",
+    "bench_small": False,
+    "at": datetime.datetime.now(datetime.timezone.utc)
+    .isoformat(timespec="seconds"),
+    "cmdring": out,
+}
+path = "benchmarks/results/cmdring_gang_tpu_r06.json"
+with open(path, "w") as f:
+    json.dump(doc, f, indent=1)
+from benchmarks.parse_results import check_cmdring
+check_cmdring(out, {})
+print(f"wrote {path}: ring floor "
+      f"{out['gang_cmdring_dispatch_floor_us']} us vs host "
+      f"{out['gang_cmdring_host_floor_us']} us, "
+      f"{out['gang_cmdring_refills_per_call']} refills/call")
+PY
+then
+  echo "cmdring leg failed/timed out — bench evidence above is still" \
+       "good; re-run the leg alone after a re-probe" >&2
+fi
+
 echo "== 3/5 chip pytest tier" >&2
 python tests/run_tpu_tier.py
 
@@ -120,5 +156,6 @@ fi
 
 echo "== done; commit .bench_lkg.json TPU_TIER.json" \
      "benchmarks/results/tuning_plan_chip_w1.json" \
+     "benchmarks/results/cmdring_gang_tpu_r06.json" \
      "benchmarks/results/chip_soak_telemetry_*.json" \
      "benchmarks/results/chip_soak_trace_* and update BENCH_NOTES" >&2

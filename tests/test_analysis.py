@@ -1030,3 +1030,80 @@ def test_metric_naming_suppressible(tmp_path):
 
 def test_metric_naming_clean_at_head():
     assert not _live(run_checks(checks=["metric-naming"]))
+
+
+# ---------------------------------------------------------------------------
+# cmdring-slot-layout (encoder and sequencer agree on ONE table)
+# ---------------------------------------------------------------------------
+
+_RING_CONSTS = """
+CMDRING_SLOT_WORDS = 4
+CMDRING_FIELDS = {"seqn": 0, "opcode": 1, "count": 2, "root": 3}
+"""
+
+
+def _ring_pkg(tmp_path, monkeypatch, consts, encoder):
+    pkg = tmp_path / "accl_tpu"
+    (pkg / "ops" / "pallas").mkdir(parents=True)
+    (pkg / "backends" / "xla").mkdir(parents=True)
+    (pkg / "constants.py").write_text(consts)
+    (pkg / "ops" / "pallas" / "cmdring.py").write_text(encoder)
+    import accl_tpu.analysis.base as base_mod
+    import accl_tpu.analysis.graph as graph_mod
+
+    monkeypatch.setattr(base_mod, "package_root", lambda: str(pkg))
+    monkeypatch.setattr(graph_mod, "package_root", lambda: str(pkg))
+    return _live(
+        run_checks([str(pkg)], ["cmdring-slot-layout"]),
+        "cmdring-slot-layout",
+    )
+
+
+def test_cmdring_layout_clean_at_head():
+    assert not _live(run_checks(checks=["cmdring-slot-layout"]))
+
+
+def test_cmdring_layout_accepts_table_driven_encoder(
+    tmp_path, monkeypatch
+):
+    findings = _ring_pkg(tmp_path, monkeypatch, _RING_CONSTS, """
+from ...constants import CMDRING_FIELDS
+_F = CMDRING_FIELDS
+def encode(words, seqn):
+    words[_F["seqn"]] = seqn
+    words[_F["root"]] = 0
+""")
+    assert not findings
+
+
+def test_cmdring_layout_flags_unknown_field(tmp_path, monkeypatch):
+    findings = _ring_pkg(tmp_path, monkeypatch, _RING_CONSTS, """
+from ...constants import CMDRING_FIELDS
+_F = CMDRING_FIELDS
+def encode(words, seqn):
+    words[_F["sequence"]] = seqn
+""")
+    assert len(findings) == 1
+    assert "sequence" in findings[0].message
+
+
+def test_cmdring_layout_flags_local_redefinition(tmp_path, monkeypatch):
+    findings = _ring_pkg(tmp_path, monkeypatch, _RING_CONSTS, """
+CMDRING_SLOT_WORDS = 6
+def encode(words):
+    return words[:CMDRING_SLOT_WORDS]
+""")
+    assert len(findings) == 1
+    assert "redefined" in findings[0].message
+
+
+def test_cmdring_layout_flags_malformed_table(tmp_path, monkeypatch):
+    bad = """
+CMDRING_SLOT_WORDS = 2
+CMDRING_FIELDS = {"seqn": 0, "opcode": 5}
+"""
+    findings = _ring_pkg(tmp_path, monkeypatch, bad, """
+from ...constants import CMDRING_FIELDS
+""")
+    assert len(findings) == 1
+    assert "dense" in findings[0].message

@@ -1,0 +1,549 @@
+"""Command-ring mechanics: the device-resident sequencer contract.
+
+The ring's counter-asserted claim (ISSUE 10 / ROADMAP item 1): a warm
+batched window of N eligible collectives costs exactly ONE host refill
+interaction — the host encodes slots and rings the doorbell, the
+sequencer program decodes and executes the window on device, and the
+drainer polls the status word.  These tests pin the mechanics around
+that claim: slot encode/decode from the one layout table, wrap-around,
+refill underrun (sequencer parks — no spin), oversized/unsupported
+fallback to host dispatch, soft_reset teardown realigning seqn, and the
+``ring_resident`` telemetry trail.  Runs on the 8-device virtual CPU
+mesh (xla sequencer lowering — the Pallas lowering is the chip tier).
+"""
+
+import numpy as np
+import pytest
+
+from helpers import run_parallel
+
+from accl_tpu.constants import (
+    CMDRING_FIELDS,
+    CMDRING_SLOT_WORDS,
+    CmdOpcode,
+    ReduceFunction,
+)
+from accl_tpu.core import xla_group
+from accl_tpu.ops.pallas.cmdring import (
+    decode_slot,
+    encode_slot,
+    encode_window,
+)
+
+
+@pytest.fixture(scope="module")
+def g4():
+    g = xla_group(4)
+    yield g
+    for a in g:
+        a.deinit()
+
+
+def _interactions(a) -> int:
+    return a.capabilities()["device_interactions"]
+
+
+def _ring(a):
+    return a.engine.gang.cmdring
+
+
+# ---------------------------------------------------------------------------
+# encoder / decoder (the slot-layout contract)
+# ---------------------------------------------------------------------------
+
+
+def test_slot_round_trip():
+    words = encode_slot(
+        41, CmdOpcode.ALLREDUCE, 1024, dtype=2,
+        function=ReduceFunction.MAX, root=3, flags=0, nseg=2,
+    )
+    assert words.shape == (CMDRING_SLOT_WORDS,)
+    d = decode_slot(words)
+    assert d["seqn"] == 41
+    assert d["opcode"] is CmdOpcode.ALLREDUCE
+    assert d["count"] == 1024
+    assert d["function"] == int(ReduceFunction.MAX)
+    assert d["root"] == 3
+    assert d["nseg"] == 2
+    # every layout field decodes (the table is the contract)
+    assert set(d) == set(CMDRING_FIELDS)
+
+
+def test_window_nop_padding_and_overflow():
+    w = encode_window([encode_slot(0, CmdOpcode.BCAST, 8)], 4)
+    assert w.shape == (4, CMDRING_SLOT_WORDS)
+    for i in (1, 2, 3):
+        assert decode_slot(w[i])["opcode"] is CmdOpcode.NOP
+    with pytest.raises(ValueError):
+        encode_window([encode_slot(0, CmdOpcode.NOP, 0)] * 3, 2)
+
+
+def test_decode_rejects_wrong_width():
+    with pytest.raises(ValueError):
+        decode_slot(np.zeros(CMDRING_SLOT_WORDS + 1, np.int32))
+
+
+# ---------------------------------------------------------------------------
+# the counter-asserted contract: N collectives, ONE refill interaction
+# ---------------------------------------------------------------------------
+
+
+def _window(g4, send, out_ar, out_mx, out_bc, n):
+    def work(a, r):
+        with a.batch():
+            r1 = a.allreduce(send[r], out_ar[r], n, run_async=True)
+            r2 = a.allreduce(
+                send[r], out_mx[r], n,
+                function=ReduceFunction.MAX, run_async=True,
+            )
+            r3 = a.bcast(out_bc[r], n, root=2, run_async=True)
+        reqs = (r1, r2, r3)
+        for req in reqs:
+            assert req.wait(60)
+            req.check()
+        return reqs
+
+    return run_parallel(g4, work)
+
+
+def test_warm_window_is_one_refill_interaction(g4):
+    n = 32
+    send = [
+        a.create_buffer_from(np.full(n, float(r + 1), np.float32))
+        for r, a in enumerate(g4)
+    ]
+    out_ar = [a.create_buffer(n, np.float32) for a in g4]
+    out_mx = [a.create_buffer(n, np.float32) for a in g4]
+    out_bc = [
+        a.create_buffer_from(np.full(n, 50.0 + r, np.float32))
+        for r, a in enumerate(g4)
+    ]
+    _window(g4, send, out_ar, out_mx, out_bc, n)  # cold: compiles
+    for r, a in enumerate(g4):
+        out_bc[r].data[:] = 50.0 + r
+        out_bc[r].sync_to_device()
+    ring0 = _ring(g4[0]).stats()
+    ic0 = _interactions(g4[0])
+    reqs = _window(g4, send, out_ar, out_mx, out_bc, n)
+    ic1 = _interactions(g4[0])
+    ring1 = _ring(g4[0]).stats()
+    assert ic1 - ic0 == 1, (
+        "a warm ring window of 3 collectives must be exactly ONE host "
+        "refill interaction"
+    )
+    assert ring1["refills"] - ring0["refills"] == 1
+    assert ring1["doorbells"] - ring0["doorbells"] == 1
+    assert ring1["slots"] - ring0["slots"] == 3
+    # results: sum, max, root-2 bcast
+    for r in range(4):
+        out_ar[r].sync_from_device()
+        np.testing.assert_allclose(out_ar[r].data, 10.0)
+        out_mx[r].sync_from_device()
+        np.testing.assert_allclose(out_mx[r].data, 4.0)
+        out_bc[r].sync_from_device()
+        np.testing.assert_allclose(out_bc[r].data, 52.0)
+    # every request carries the ring-resident mark
+    for rank_reqs in reqs:
+        for req in rank_reqs:
+            assert req.ring_resident is True
+
+
+def test_ring_resident_rides_telemetry(g4):
+    tail = g4[0]._telemetry.tail_dicts(3)
+    assert tail and all(rec.get("ring_resident") for rec in tail)
+    counters = g4[0].telemetry_snapshot()["metrics"]["counters"]
+    assert any(
+        k.startswith("accl_ring_resident_calls_total") for k in counters
+    )
+    rep = g4[0].engine.telemetry_report()["cmdring"]
+    for key in ("refills", "doorbells", "occupancy", "state", "depth"):
+        assert key in rep
+    inflight = g4[0].engine.telemetry_report()["inflight"]
+    assert inflight["ring_launched"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# wrap-around, underrun parking, soft_reset teardown
+# ---------------------------------------------------------------------------
+
+
+def test_slot_wrap_around(g4):
+    ring = _ring(g4[0])
+    depth = ring.depth
+    n = 16
+    send = [
+        a.create_buffer_from(np.full(n, float(r + 1), np.float32))
+        for r, a in enumerate(g4)
+    ]
+    out = [a.create_buffer(n, np.float32) for a in g4]
+
+    def window(a, r):
+        with a.batch():
+            reqs = [
+                a.allreduce(send[r], out[r], n, run_async=True)
+                for _ in range(3)
+            ]
+        for req in reqs:
+            assert req.wait(60)
+            req.check()
+
+    wraps0 = ring.stats()["wraps"]
+    rounds = depth // 3 + 2  # head must cross the ring boundary
+    for _ in range(rounds):
+        run_parallel(g4, window)
+    st = ring.stats()
+    assert st["wraps"] > wraps0, "head never wrapped the ring"
+    comm_id = g4[0]._world.id
+    session = ring._sessions[comm_id]
+    assert session.seqn >= rounds * 3  # seqn stays monotone across wraps
+    assert session.ring.shape == (depth, CMDRING_SLOT_WORDS)
+    for r in range(4):
+        out[r].sync_from_device()
+        np.testing.assert_allclose(out[r].data, 10.0)
+
+
+def test_refill_underrun_parks_sequencer(g4):
+    """Host slower than the sequencer: when the last in-flight window
+    drains, the sequencer parks on the doorbell — no window in flight,
+    no spin — and the next refill re-arms it."""
+    import time
+
+    ring = _ring(g4[0])
+    deadline = time.monotonic() + 30
+    while not ring.parked:
+        assert time.monotonic() < deadline, "sequencer never parked"
+        time.sleep(0.01)
+    st = ring.stats()
+    assert st["state"] == "parked"
+    assert st["doorbells"] == st["refills"]  # one doorbell per refill,
+    # none fired while parked (the no-spin contract)
+
+
+def test_soft_reset_parks_and_realigns_seqn(g4):
+    n = 16
+    send = [
+        a.create_buffer_from(np.full(n, float(r + 1), np.float32))
+        for r, a in enumerate(g4)
+    ]
+    out = [a.create_buffer(n, np.float32) for a in g4]
+
+    def window(a, r):
+        with a.batch():
+            reqs = [
+                a.allreduce(send[r], out[r], n, run_async=True)
+                for _ in range(2)
+            ]
+        for req in reqs:
+            assert req.wait(60)
+            req.check()
+
+    run_parallel(g4, window)
+    ring = _ring(g4[0])
+    comm_id = g4[0]._world.id
+    assert ring._sessions[comm_id].seqn > 0
+    resets0 = ring.stats()["resets"]
+
+    run_parallel(g4, lambda a, r: a.soft_reset())
+    st = ring.stats()
+    assert st["resets"] > resets0
+    assert st["state"] == "parked"
+    assert comm_id not in ring._sessions  # teardown: session abandoned
+
+    run_parallel(g4, window)  # the ring re-arms after the reset
+    assert ring._sessions[comm_id].seqn == 2  # realigned at 0, then 2
+    for r in range(4):
+        out[r].sync_from_device()
+        np.testing.assert_allclose(out[r].data, 10.0)
+
+
+# ---------------------------------------------------------------------------
+# fallbacks: oversized payloads + unsupported ops stay on host dispatch
+# ---------------------------------------------------------------------------
+
+
+def test_oversized_payload_falls_back_to_host_dispatch(g4):
+    ring = _ring(g4[0])
+    n = 64
+    send = [
+        a.create_buffer_from(np.full(n, float(r + 1), np.float32))
+        for r, a in enumerate(g4)
+    ]
+    out = [a.create_buffer(n, np.float32) for a in g4]
+
+    def window(a, r):
+        with a.batch():
+            reqs = [
+                a.allreduce(send[r], out[r], n, run_async=True)
+                for _ in range(2)
+            ]
+        for req in reqs:
+            assert req.wait(60)
+            req.check()
+        return reqs
+
+    saved = ring.max_bytes
+    ring.max_bytes = n * 4 - 1  # every payload is now oversized
+    try:
+        over0 = ring.stats()["fallbacks"].get("oversized", 0)
+        slots0 = ring.stats()["slots"]
+        reqs = run_parallel(g4, window)
+        st = ring.stats()
+        assert st["fallbacks"].get("oversized", 0) > over0
+        assert st["slots"] == slots0  # nothing executed ring-resident
+        for rank_reqs in reqs:
+            for req in rank_reqs:
+                assert req.ring_resident is None
+        for r in range(4):
+            out[r].sync_from_device()
+            np.testing.assert_allclose(out[r].data, 10.0)
+    finally:
+        ring.max_bytes = saved
+
+
+def test_unsupported_op_falls_back(g4):
+    """A batch containing a reduce_scatter (no ring opcode) falls back
+    whole — and still fuses to one interaction on the legacy path."""
+    ring = _ring(g4[0])
+    n = 16
+    world = 4
+    send = [
+        a.create_buffer_from(np.full(n, float(r + 1), np.float32))
+        for r, a in enumerate(g4)
+    ]
+    rs_send = [
+        a.create_buffer_from(np.full(world * n, float(r + 1), np.float32))
+        for r, a in enumerate(g4)
+    ]
+    ar = [a.create_buffer(n, np.float32) for a in g4]
+    rs = [a.create_buffer(n, np.float32) for a in g4]
+
+    def work(a, r):
+        with a.batch():
+            r1 = a.allreduce(send[r], ar[r], n, run_async=True)
+            r2 = a.reduce_scatter(rs_send[r], rs[r], n, run_async=True)
+        for req in (r1, r2):
+            assert req.wait(60)
+            req.check()
+
+    run_parallel(g4, work)  # cold
+    un0 = ring.stats()["fallbacks"].get("unsupported_op", 0)
+    ic0 = _interactions(g4[0])
+    run_parallel(g4, work)
+    assert _interactions(g4[0]) - ic0 == 1  # fused batch still 1
+    assert ring.stats()["fallbacks"].get("unsupported_op", 0) > un0
+    for r in range(4):
+        ar[r].sync_from_device()
+        np.testing.assert_allclose(ar[r].data, 10.0)
+        rs[r].sync_from_device()
+        np.testing.assert_allclose(rs[r].data, 10.0)
+
+
+# ---------------------------------------------------------------------------
+# eager mode: single warm calls ride one-slot windows
+# ---------------------------------------------------------------------------
+
+
+def test_eager_mode_routes_single_calls(monkeypatch):
+    monkeypatch.setenv("ACCL_CMDRING", "eager")
+    g = xla_group(2)
+    try:
+        ring = _ring(g[0])
+        assert ring.eager
+        n = 16
+        send = [
+            a.create_buffer_from(np.full(n, float(r + 1), np.float32))
+            for r, a in enumerate(g)
+        ]
+        out = [a.create_buffer(n, np.float32) for a in g]
+
+        def work(a, r):
+            return a.allreduce(send[r], out[r], n, run_async=True)
+
+        reqs = run_parallel(g, work)
+        for req in reqs:
+            assert req.wait(60)
+            req.check()
+        # warm pass: one refill per call (a one-slot window)
+        refills0 = ring.stats()["refills"]
+        ic0 = _interactions(g[0])
+        reqs = run_parallel(g, work)
+        for req in reqs:
+            assert req.wait(60)
+            req.check()
+        assert _interactions(g[0]) - ic0 == 1
+        assert ring.stats()["refills"] - refills0 == 1
+        assert all(req.ring_resident for req in reqs)
+        for r in range(2):
+            out[r].sync_from_device()
+            np.testing.assert_allclose(out[r].data, 3.0)
+    finally:
+        for a in g:
+            a.deinit()
+
+
+def test_disabled_ring_stays_off(monkeypatch):
+    monkeypatch.setenv("ACCL_CMDRING", "0")
+    g = xla_group(2)
+    try:
+        ring = _ring(g[0])
+        assert not ring.enabled
+        n = 16
+        send = [
+            a.create_buffer_from(np.full(n, float(r + 1), np.float32))
+            for r, a in enumerate(g)
+        ]
+        out = [a.create_buffer(n, np.float32) for a in g]
+
+        def work(a, r):
+            with a.batch():
+                req = a.allreduce(send[r], out[r], n, run_async=True)
+            assert req.wait(60)
+            req.check()
+            return req
+
+        reqs = run_parallel(g, work)
+        assert ring.stats()["refills"] == 0
+        assert all(req.ring_resident is None for req in reqs)
+        for r in range(2):
+            out[r].sync_from_device()
+            np.testing.assert_allclose(out[r].data, 3.0)
+    finally:
+        for a in g:
+            a.deinit()
+
+
+# ---------------------------------------------------------------------------
+# bench gate units (parse_results.check_cmdring)
+# ---------------------------------------------------------------------------
+
+
+def _gate():
+    import importlib.util
+    import os
+
+    path = os.path.join(
+        os.path.dirname(__file__), "..", "benchmarks", "parse_results.py"
+    )
+    spec = importlib.util.spec_from_file_location("parse_results", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _evidence(**over):
+    base = {
+        "gang_cmdring_dispatch_floor_us": 40.0,
+        "gang_cmdring_host_floor_us": 200.0,
+        "gang_cmdring_refills_per_call": 0.125,
+        "gang_cmdring_ring_slots": 96,
+    }
+    base.update(over)
+    return base
+
+
+def test_check_cmdring_passes_good_capture():
+    _gate().check_cmdring(_evidence(), {})
+
+
+def test_check_cmdring_noop_when_bench_never_ran():
+    _gate().check_cmdring({}, {})
+
+
+def test_check_cmdring_refuses_floor_without_evidence():
+    mod = _gate()
+    with pytest.raises(mod.CmdringGateError):
+        mod.check_cmdring(
+            {"gang_cmdring_dispatch_floor_us": 40.0}, {}
+        )
+
+
+def test_check_cmdring_refuses_unamortized_refills():
+    mod = _gate()
+    with pytest.raises(mod.CmdringGateError):
+        mod.check_cmdring(
+            _evidence(gang_cmdring_refills_per_call=1.0), {}
+        )
+
+
+def test_check_cmdring_refuses_ring_not_engaging():
+    mod = _gate()
+    with pytest.raises(mod.CmdringGateError):
+        mod.check_cmdring(_evidence(gang_cmdring_ring_slots=0), {})
+
+
+def test_check_cmdring_requires_ring_below_host_floor():
+    mod = _gate()
+    with pytest.raises(mod.CmdringGateError):
+        mod.check_cmdring(
+            _evidence(gang_cmdring_dispatch_floor_us=250.0), {}
+        )
+
+
+def test_check_cmdring_refuses_lkg_regression():
+    mod = _gate()
+    lkg = {"extras": _evidence(gang_cmdring_dispatch_floor_us=10.0)}
+    with pytest.raises(mod.CmdringGateError):
+        mod.check_cmdring(_evidence(), {"extras": lkg["extras"]})
+
+
+def test_committed_cpu_capture_passes_gate():
+    import json
+    import os
+
+    mod = _gate()
+    path = os.path.join(
+        os.path.dirname(__file__), "..", "benchmarks", "results",
+        "cmdring_gang_cpu.json",
+    )
+    with open(path) as f:
+        doc = json.load(f)
+    mod.check_cmdring(doc["cmdring"], {})
+    assert doc["cmdring"]["gang_cmdring_refills_per_call"] < 1.0
+
+
+def test_mixed_dtype_window_falls_back(g4):
+    """The pallas lowering packs a window into ONE buffer, so a mixed-
+    dtype window must fall back whole (on every lowering — the slot
+    schema is lowering-agnostic) instead of silently promoting."""
+    ring = _ring(g4[0])
+    n = 16
+    send_f = [
+        a.create_buffer_from(np.full(n, float(r + 1), np.float32))
+        for r, a in enumerate(g4)
+    ]
+    send_i = [
+        a.create_buffer_from(np.full(n, r + 1, np.int32))
+        for r, a in enumerate(g4)
+    ]
+    out_f = [a.create_buffer(n, np.float32) for a in g4]
+    out_i = [a.create_buffer(n, np.int32) for a in g4]
+
+    def work(a, r):
+        with a.batch():
+            r1 = a.allreduce(send_f[r], out_f[r], n, run_async=True)
+            r2 = a.allreduce(send_i[r], out_i[r], n, run_async=True)
+        for req in (r1, r2):
+            assert req.wait(60)
+            req.check()
+
+    mixed0 = ring.stats()["fallbacks"].get("mixed_dtype", 0)
+    run_parallel(g4, work)
+    assert ring.stats()["fallbacks"].get("mixed_dtype", 0) > mixed0
+    for r in range(4):
+        out_f[r].sync_from_device()
+        np.testing.assert_allclose(out_f[r].data, 10.0)
+        out_i[r].sync_from_device()
+        np.testing.assert_array_equal(out_i[r].data, 10)
+
+
+def test_check_cmdring_refuses_partial_evidence_any_side():
+    mod = _gate()
+    ev = _evidence()
+    for missing in (
+        "gang_cmdring_dispatch_floor_us",
+        "gang_cmdring_host_floor_us",
+        "gang_cmdring_refills_per_call",
+    ):
+        partial = {k: v for k, v in ev.items() if k != missing}
+        with pytest.raises(mod.CmdringGateError):
+            mod.check_cmdring(partial, {})

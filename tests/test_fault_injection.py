@@ -566,3 +566,113 @@ def test_chaos_soak_rank_death_and_partition(fault_plan):
             assert x.engine.rx_pool.occupancy()[0] == 0
     finally:
         _deinit(g)
+
+
+# ---------------------------------------------------------------------------
+# delayed-transmit ordering (the PR 8 socket-tier wedge, satellite fix)
+# ---------------------------------------------------------------------------
+
+
+def test_delayed_transmit_preserves_per_peer_ordering(fault_plan):
+    """The wire contract a delay fault must keep: a congested link
+    delays everything BEHIND the stalled frame, it does not reorder.
+    The old Timer-per-message transmit let every later send to the same
+    peer overtake the delayed one (delivery [1, 2, 3, 0]) — on the
+    multi-rank socket tier, whose receivers consume strictly per peer,
+    that wedged two ranks into RECEIVE_TIMEOUT.  Delayed sends now park
+    in a per-address FIFO; later sends queue behind; other peers are
+    unaffected."""
+    from accl_tpu.backends.emulator.fabric import (
+        Endpoint,
+        InProcFabric,
+        Message,
+        MsgType,
+    )
+
+    f = InProcFabric()
+    f.install_fault_plan(fault_plan(
+        dict(action="delay", delay_s=0.2, msg_type="EAGER", nth=1,
+             count=1),
+    ))
+    got, got_b = [], []
+    ep, epb = Endpoint(), Endpoint()
+    orig, origb = ep.deliver, epb.deliver
+    ep.deliver = lambda m: (got.append((m.seqn, time.monotonic())),
+                            orig(m))[1]
+    epb.deliver = lambda m: (got_b.append(m.seqn), origb(m))[1]
+    f.attach("a", ep)
+    f.attach("b", epb)
+    t0 = time.monotonic()
+    for k in range(4):
+        f.send("a", Message(MsgType.EAGER, 0, 1, 0, 5, seqn=k,
+                            payload=b"x"))
+    f.send("b", Message(MsgType.EAGER, 0, 1, 0, 5, seqn=99, payload=b"x"))
+    t_b = time.monotonic() - t0
+    deadline = time.monotonic() + 10
+    while len(got) < 4 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert [s for s, _ in got] == [0, 1, 2, 3], (
+        "later sends to a peer overtook its delayed frame"
+    )
+    # the delay really happened, and head-of-line frames carried it
+    assert got[0][1] - t0 >= 0.2
+    # an unrelated peer's traffic was not queued behind the delay
+    assert got_b == [99] and t_b < 0.1
+
+
+@pytest.mark.slow
+def test_delay_fault_on_world3_socket_tier_completes(fault_plan,
+                                                     monkeypatch):
+    """Regression for the PR 8 pre-existing wedge: a delay FaultRule on
+    the multi-rank socket tier (world 3) must not wedge ranks into
+    RECEIVE_TIMEOUT — every collective completes value-correct within
+    the deadline now that delayed socket transmits preserve per-peer
+    ordering."""
+    import socket as socketlib
+
+    from accl_tpu import socket_group_member
+
+    plan = fault_plan(
+        dict(action="delay", delay_s=0.05, msg_type="EAGER", src=1),
+        seed=7,
+    )
+    monkeypatch.setenv(FAULT_PLAN_ENV, plan.to_env())
+    ports, socks = [], []
+    for _ in range(3):
+        s = socketlib.socket()
+        s.setsockopt(socketlib.SOL_SOCKET, socketlib.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        socks.append(s)
+    for s in socks:
+        s.close()
+    addrs = [f"127.0.0.1:{p}" for p in ports]
+    g = [socket_group_member(i, addrs) for i in range(3)]
+    try:
+        for x in g:
+            x.set_timeout(8.0)
+        n = 2048  # several eager segments per transfer
+        send = [
+            a.create_buffer_from(np.full(n, float(r + 1), np.float32))
+            for r, a in enumerate(g)
+        ]
+        recv = [a.create_buffer(n, np.float32) for a in g]
+
+        def work(a, r):
+            for it in range(6):
+                a.allreduce(send[r], recv[r], n)
+                a.bcast(recv[r], n, root=it % 3)
+
+        t0 = time.monotonic()
+        run_parallel(g, work, timeout=60.0)
+        assert time.monotonic() - t0 < 60.0
+        # at least one frame really rode the delayed path
+        injs = [x.engine.fabric.fault_injector for x in g]
+        assert any(
+            any(e["action"] == "delay" for e in inj.log)
+            for inj in injs if inj is not None
+        )
+        for r in range(3):
+            recv[r].sync_from_device()
+    finally:
+        _deinit(g)

@@ -21,10 +21,22 @@ except ImportError:  # pragma: no cover - older jax
 from jax.sharding import Mesh, PartitionSpec as P
 from jax.experimental.pallas import tpu as pltpu
 
+from accl_tpu.compat import has_interpret_params, interpret_params_reason
 from accl_tpu.constants import ReduceFunction
 from accl_tpu.ops import pallas as pk
 
-pytestmark = pytest.mark.pallas
+pytestmark = [
+    pytest.mark.pallas,
+    # off-chip these kernels need the Pallas TPU interpreter; where the
+    # probe fails (e.g. legacy jax without pltpu.InterpretParams) the
+    # whole suite skips LOUDLY with the probe's reason instead of
+    # failing on the missing attribute (the compat loud-skip convention)
+    pytest.mark.skipif(
+        jax.default_backend() != "tpu" and not has_interpret_params(),
+        reason=f"Pallas interpret tier unavailable: "
+               f"{interpret_params_reason()}",
+    ),
+]
 
 # Gradient-comparison atol: on real silicon the HIGHEST-precision kernels
 # still disagree with XLA's autodiff by ~1e-4 absolute (different exp
