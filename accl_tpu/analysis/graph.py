@@ -340,9 +340,19 @@ _CMDRING_CANONICAL_NAMES = frozenset((
 
 #: modules that encode/decode slots (relative to the accl_tpu root)
 _CMDRING_MODULES = (
-    "ops/pallas/cmdring.py",
-    "backends/xla/cmdring.py",
+    "cmdring.py",            # host half: slot codec + mailbox protocol
+    "ops/pallas/cmdring.py",  # device half: both sequencer lowerings
+    "backends/xla/cmdring.py",  # engine half: sessions + refills
 )
+
+#: the module holding the decode loop both lowerings share — it must
+#: reference every executable opcode (the cross-file presence check)
+_CMDRING_DECODE_MODULE = "ops/pallas/cmdring.py"
+
+#: opcodes exempt from the decode-presence requirement: NOP is the
+#: padding slot (decoded, skipped), HALT the teardown marker — neither
+#: executes a collective
+_CMDRING_MARKER_OPCODES = frozenset(("NOP", "HALT"))
 
 
 def _cmdring_table(src: SourceFile):
@@ -370,29 +380,132 @@ def _cmdring_table(src: SourceFile):
     return fields, slot_words
 
 
+def _cmdring_opcodes(src: SourceFile):
+    """(opcode name -> value, opcode-map line) from the constants
+    module: the ``CmdOpcode`` IntEnum body (literal member assigns) and
+    the names referenced as values of the ``CMDRING_OPCODES``
+    Operation-map literal."""
+    opcodes = None
+    mapped = None
+    map_line = 1
+    for node in src.tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == "CmdOpcode":
+            opcodes = {}
+            for stmt in node.body:
+                if (
+                    isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and isinstance(stmt.value, ast.Constant)
+                ):
+                    opcodes[stmt.targets[0].id] = stmt.value.value
+        elif (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == "CMDRING_OPCODES"
+            and isinstance(node.value, ast.Dict)
+        ):
+            map_line = node.lineno
+            mapped = set()
+            for v in node.value.values:
+                if isinstance(v, ast.Attribute):
+                    mapped.add(v.attr)
+    return opcodes, mapped, map_line
+
+
+def _cmdopcode_refs(src: SourceFile):
+    """Every ``CmdOpcode.<NAME>`` attribute referenced in a module (the
+    presence evidence that its decode path handles the opcode)."""
+    refs = set()
+    for node in src.nodes:
+        if isinstance(node, ast.Attribute):
+            base = node.value
+            if isinstance(base, ast.Name) and base.id == "CmdOpcode":
+                refs.add(node.attr)
+            elif (
+                isinstance(base, ast.Attribute)
+                and base.attr == "CmdOpcode"
+            ):
+                refs.add(node.attr)
+    return refs
+
+
 def check_cmdring_slot_layout(sources: List[SourceFile]) -> List[Finding]:
-    """Encoder and sequencer must agree on the slot layout from ONE
-    table: ``constants.CMDRING_FIELDS``/``CMDRING_SLOT_WORDS`` must be
-    well-formed (dense, unique, in-bounds int indices), the cmdring
-    modules may not REDEFINE any canonical layout name with a local
-    literal (aliasing the imported table is fine), and every string
-    subscript into a fields-table alias must name a field the canonical
-    table defines — a typo'd or locally-invented field silently decodes
-    the wrong word on device."""
+    """Encoder and sequencer must agree on the slot layout AND the
+    opcode space from ONE definition each:
+
+    * ``constants.CMDRING_FIELDS``/``CMDRING_SLOT_WORDS`` must be
+      well-formed (dense, unique, in-bounds int indices); the cmdring
+      modules may not REDEFINE any canonical layout name with a local
+      literal (aliasing the imported table is fine), and every string
+      subscript into a fields-table alias must name a field the
+      canonical table defines — a typo'd or locally-invented field
+      silently decodes the wrong word on device;
+    * ``constants.CmdOpcode`` must be dense unique int values from 0
+      (the sequencer's range-check status path depends on density);
+    * every executable opcode (non-NOP/HALT) must appear as a value of
+      the ``CMDRING_OPCODES`` Operation map (the engine's eligibility
+      table covers the space) AND be referenced by the decode module's
+      shared epilogue (``ops/pallas/cmdring.py`` — both lowerings run
+      that one decode loop, so presence there is presence in both):
+      the cross-file guarantee that growing the enum without wiring a
+      lowering fails the tree, not a workload."""
     root = package_root()
     findings: List[Finding] = []
     consts = None
     ringmods: List[SourceFile] = []
+    decode_mod = None
     for src in sources:
         mod = _module_name(src.path, root)
         if mod == "accl_tpu.constants":
             consts = src
         rel = os.path.relpath(os.path.abspath(src.path), root)
-        if rel.replace(os.sep, "/") in _CMDRING_MODULES:
+        rel = rel.replace(os.sep, "/")
+        if rel in _CMDRING_MODULES:
             ringmods.append(src)
+        if rel == _CMDRING_DECODE_MODULE:
+            decode_mod = src
     if consts is None:
         return findings  # partial-scope run without constants.py
     fields, slot_words = _cmdring_table(consts)
+    opcodes, mapped, map_line = _cmdring_opcodes(consts)
+    if opcodes is not None and ringmods:
+        vals = list(opcodes.values())
+        if (
+            not all(isinstance(v, int) for v in vals)
+            or len(set(vals)) != len(vals)
+            or sorted(vals) != list(range(len(vals)))
+        ):
+            findings.append(Finding(
+                check="cmdring-slot-layout", path=consts.path, line=1,
+                message=f"CmdOpcode values {sorted(vals)} must be "
+                        "dense, unique ints from 0 — the sequencer's "
+                        "status range-check depends on density",
+            ))
+        executable = set(opcodes) - set(_CMDRING_MARKER_OPCODES)
+        if mapped is not None:
+            missing_map = sorted(executable - mapped)
+            if missing_map:
+                findings.append(Finding(
+                    check="cmdring-slot-layout", path=consts.path,
+                    line=map_line,
+                    message=f"CMDRING_OPCODES maps no Operation onto "
+                            f"{missing_map}: the engine can never "
+                            "encode these opcodes — dead enum growth",
+                ))
+        if decode_mod is not None:
+            refs = _cmdopcode_refs(decode_mod)
+            missing_dec = sorted(executable - refs)
+            if missing_dec:
+                findings.append(Finding(
+                    check="cmdring-slot-layout", path=decode_mod.path,
+                    line=1,
+                    message=f"decode module never references CmdOpcode "
+                            f"{missing_dec}: both lowerings run this "
+                            "module's decode loop, so an unreferenced "
+                            "opcode is an unimplemented one",
+                ))
     if fields is None or slot_words is None:
         if ringmods:  # the ring exists but its contract table is gone
             findings.append(Finding(

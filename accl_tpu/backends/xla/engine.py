@@ -464,6 +464,10 @@ class XLAGangContext:
         # collectives and starts refilling a queue.  ACCL_CMDRING=0
         # disables; =eager also routes single warm calls through it.
         self.cmdring = GangCommandRing(self)
+        # the shared tag-matched p2p channel (set by the first rank
+        # handle): the fallback route for batched SEND/RECV positions
+        # that did not pair into a ring slot
+        self.p2p = None
 
     _DEAD_AFTER_TIMEOUTS = 2
 
@@ -736,7 +740,25 @@ class XLAGangContext:
         t0 = time.perf_counter_ns()
         lead = calls[0]
         try:
-            if any(self._sig(c) != self._sig(lead) for c in calls[1:]):
+            if all(
+                c.op in (Operation.SEND, Operation.RECV) for c in calls
+            ):
+                # a batched p2p position that did not ride the ring
+                # (fallback / ring disabled mid-flight): a
+                # complementary pair delivers directly, anything else
+                # re-routes through the shared channel (which then owns
+                # completion — None)
+                code = self._execute_p2p_pair(comm, calls, reqs)
+                if code is None:
+                    return
+            elif any(
+                c.op in (Operation.SEND, Operation.RECV) for c in calls
+            ):
+                # a position mixing p2p with a collective is a torn
+                # gang (SPMD divergence): fail fast — the channel must
+                # not be fed a collective call dressed as a recv
+                code = ErrorCode.INVALID_OPERATION
+            elif any(self._sig(c) != self._sig(lead) for c in calls[1:]):
                 code = ErrorCode.INVALID_OPERATION  # mismatched gang calls
             else:
                 # named range in the xprof timeline (the per-call span the
@@ -764,6 +786,102 @@ class XLAGangContext:
         dt = time.perf_counter_ns() - t0
         for req in reqs:
             req.complete(code, dt)
+
+    def _execute_p2p_pair(self, comm: Communicator,
+                          calls: List[CallOptions],
+                          reqs: List[Request]) -> Optional[ErrorCode]:
+        """A batched p2p position that did not ride the ring.  A
+        complementary SEND/RECV pair delivers directly — the slot IS
+        the rendezvous (both sides posted at the same batch position):
+        the device fabric hop for device-resident ends, a host write
+        otherwise.  Any other shape (the classic cross-exchange where
+        both ranks batch ``[send, recv]`` and positions pair ACROSS
+        slots, or pairs with mismatched tags) routes each call through
+        the shared p2p channel exactly as an unbatched call would —
+        tag matching across positions keeps working.  Returns None
+        when the calls were handed to the channel (it owns their
+        completion)."""
+        from ...cmdring import complementary_pair
+
+        # THE pair definition, shared with the ring planner (_plan_p2p):
+        # a dtype-mismatched or compressed position is not a match on
+        # either path — it rides the channel, whose cast-on-deliver /
+        # wire-cast semantics the unbatched path already has
+        pair = complementary_pair(calls)
+        if pair is not None:
+            src, dst = pair
+            snd, rcv = calls[src], calls[dst]
+            if rcv.res is not None and not rcv.res.is_dummy:
+                n = snd.count
+                ic = self.interactions
+                res = rcv.res
+                op0 = snd.op0
+                if isinstance(op0, DeviceBuffer) and isinstance(
+                    res, DeviceBuffer
+                ):
+                    payload = _trim_program(n, op0.device)(
+                        op0.device_array()
+                    )
+                    ic.bump()  # the payload-copy program
+                    _p2p_device_deliver(payload, res, n, ic)
+                else:
+                    row = np.asarray(op0.device_view()[:n])
+                    _write_host_result(res, row, n, ic)
+                return ErrorCode.OK
+        if self.p2p is None:  # pragma: no cover - engines always set it
+            return ErrorCode.INVALID_OPERATION
+        for r, (call, req) in enumerate(zip(calls, reqs)):
+            self._route_p2p_channel(comm, r, call, req)
+        return None
+
+    def _route_p2p_channel(self, comm: Communicator, rank: int,
+                           call: CallOptions, req: Request) -> None:
+        """Post one gang-assembled SEND/RECV onto the shared tag-matched
+        channel (the unbatched path's machinery, minus streams — stream
+        p2p is never gang-eligible)."""
+        ic = self.interactions
+        me_world = comm.ranks[rank].session
+        if call.op == Operation.SEND:
+            cfg = call.arithcfg
+            if isinstance(call.op0, DeviceBuffer):
+                payload = _trim_program(call.count, call.op0.device)(
+                    call.op0.device_array()
+                )
+                ic.bump()  # the payload-copy program
+                if call.compression & CompressionFlags.ETH_COMPRESSED:
+                    # compress lane on the sending chip (the unbatched
+                    # path's wire-cast discipline, _start_send)
+                    payload = _cast_program(
+                        dtype_to_numpy(cfg.compressed), call.op0.device
+                    )(payload)
+                    ic.bump()
+            else:
+                payload = np.asarray(
+                    call.op0.device_view()[: call.count]
+                ).copy()
+                if call.compression & CompressionFlags.ETH_COMPRESSED:
+                    payload = payload.astype(
+                        dtype_to_numpy(cfg.compressed)
+                    )
+            dst_world = comm.ranks[call.root_dst].session
+            key = (comm.id, call.tag, me_world, dst_world)
+            self.p2p.post_send(key, payload, req,
+                               timeout_s=self.timeout_s)
+            return
+        src_world = comm.ranks[call.root_src].session
+        key = (comm.id, call.tag, src_world, me_world)
+
+        def sink(payload, call=call, ic=ic):
+            if isinstance(payload, jax.Array) and isinstance(
+                call.res, DeviceBuffer
+            ):
+                _p2p_device_deliver(payload, call.res, call.count, ic)
+                return
+            if isinstance(payload, jax.Array):
+                payload = np.asarray(payload)
+            _write_host_result(call.res, payload, call.count, ic)
+
+        self.p2p.post_recv(key, sink, req, timeout_s=self.timeout_s)
 
     # -- batched execution ---------------------------------------------------
     _BATCH_TUNING_KEYS = (
@@ -1764,6 +1882,8 @@ class XLAEngine(StreamPortMixin, BaseEngine):
     ):
         self.gang = gang
         self.p2p = p2p or _P2PChannel()
+        if gang.p2p is None:
+            gang.p2p = self.p2p
         self.peers = peers if peers is not None else {}
         self.device = device  # this rank's chip; buffers commit to its HBM
         self.timeout_s = DEFAULT_TIMEOUT_S
@@ -1803,6 +1923,18 @@ class XLAEngine(StreamPortMixin, BaseEngine):
                 (options.op in IN_W or options.op == Operation.BARRIER)
                 and options.stream == StreamFlags.NO_STREAM
             )
+            # command-ring p2p: a batched SEND/RECV on a world-2 gang
+            # joins the collective run so a matched pair can ride one
+            # ring slot (root=src, peer=dst).  Eligibility is
+            # pair-symmetric by construction (cmdring.p2p_eligible) so
+            # both ends classify identically; unpaired positions fall
+            # back to _execute_p2p_pair / the channel below.
+            if (
+                options.op in (Operation.SEND, Operation.RECV)
+                and options.stream == StreamFlags.NO_STREAM
+                and self.gang.cmdring.p2p_eligible(options)
+            ):
+                gang_eligible = True
             if gang_eligible:
                 if run_comm is not None and options.comm is not run_comm:
                     flush_run()
@@ -2267,3 +2399,7 @@ class XLAEngine(StreamPortMixin, BaseEngine):
         # first rank handle's deinit does the work; later ones find it
         # already stopped — parks then degrade to inline completion)
         self.gang.window.stop()
+        # command ring: halt every resident sequencer run so the
+        # long-running programs return promptly instead of riding out
+        # their linger with the process tearing down around them
+        self.gang.cmdring.halt_sessions()

@@ -238,6 +238,13 @@ class DeviceBuffer(BaseBuffer):
         # re-entering resolve_pending on the same thread cannot deadlock.
         self._pending: Optional[object] = None
         self._plock = threading.RLock()
+        # monotone defer counter: every parked thunk bumps it, so a
+        # writer that wants to COLLAPSE successive whole-result stores
+        # (the command ring's window adoption) can prove no other
+        # deferred write slipped in between (buffer.py stays policy-
+        # free: chaining remains the default — partial writes must
+        # layer in issue order)
+        self._defer_seq = 0
         npdt = dtype_to_numpy(dtype)
         self._host = host if host is not None else np.zeros(count, npdt)
         if parent is not None:
@@ -280,6 +287,7 @@ class DeviceBuffer(BaseBuffer):
         the buffer is finally resolved."""
         root = self._root()
         with root._plock:
+            root._defer_seq += 1
             prev = root._pending
             if prev is None:
                 root._pending = thunk

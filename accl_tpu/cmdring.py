@@ -1,0 +1,436 @@
+"""Command-ring host half: slot codec + the persistent-sequencer mailbox.
+
+Role model: the reference's hostctrl path — the host writes fixed-width
+commands into a hardware FIFO and reads completions from a status FIFO
+while the CCLO firmware's ``run()`` loop *lives on the device*
+(``ccl_offload_control.c``).  This module is everything the host owns
+of that protocol, importable without jax (numpy only — the CI ring
+smoke exercises it standalone):
+
+* the **slot codec**: ``encode_slot``/``decode_slot``/``encode_window``
+  pack a collective into ``CMDRING_SLOT_WORDS`` int32 words through the
+  ONE layout table (:data:`accl_tpu.constants.CMDRING_FIELDS` — the
+  acclint ``cmdring-slot-layout`` check keeps every reader honest);
+* the **mailbox**: :class:`SequencerMailbox` is the host-visible region
+  one persistent sequencer *run* drains.  A run is ONE long-running
+  device program that pulls up to ``run_windows`` refill windows before
+  returning; while it is live, a refill is a mailbox ``post`` (the
+  doorbell becomes a memory write), NOT a program launch.  The pull
+  side blocks the sequencer for at most ``linger_s`` on an empty
+  mailbox, then HALTs the run so the device stream is never pinned by
+  an idle sequencer (the parked posture stays no-spin *and* no-occupy).
+
+The mailbox's decision protocol is SPMD-safe by construction: the first
+rank to pull step ``s`` decides (window w / HALT) once, every other
+rank's step-``s`` pull returns the identical decision — a rank can
+never gather against peers that saw a different schedule.
+
+The device half — the two sequencer lowerings that decode these slots —
+lives in ``ops/pallas/cmdring.py``; the gang engine's session/refill
+management in ``backends/xla/cmdring.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .constants import (
+    CMDRING_FIELDS,
+    CMDRING_LINGER_ENV,
+    CMDRING_LINGER_MS_DEFAULT,
+    CMDRING_MAX_RUN_WINDOWS,
+    CMDRING_RUN_WINDOWS_DEFAULT,
+    CMDRING_RUN_WINDOWS_ENV,
+    CMDRING_SLOT_WORDS,
+    CmdOpcode,
+    Operation,
+    ReduceFunction,
+)
+
+__all__ = [
+    "SequencerMailbox",
+    "WindowShape",
+    "complementary_pair",
+    "decode_slot",
+    "default_linger_s",
+    "default_run_windows",
+    "encode_slot",
+    "encode_window",
+    "mailbox_for",
+    "register_mailbox",
+    "ring_widths",
+    "unregister_mailbox",
+]
+
+_F = CMDRING_FIELDS  # the one layout table (constants.py)
+
+
+# ---------------------------------------------------------------------------
+# slot codec
+# ---------------------------------------------------------------------------
+
+
+def encode_slot(
+    seqn: int,
+    opcode: CmdOpcode,
+    count: int,
+    dtype: int = 0,
+    function: ReduceFunction = ReduceFunction.SUM,
+    root: int = 0,
+    flags: int = 0,
+    nseg: int = 1,
+    peer: int = 0,
+    wire: int = 0,
+) -> np.ndarray:
+    """One command slot as ``(CMDRING_SLOT_WORDS,)`` int32 — every field
+    written through :data:`CMDRING_FIELDS`, never a literal index.
+    ``root`` doubles as the SEND/RECV source rank with ``peer`` the
+    destination; ``wire`` is the compressed wire DataType (0 = none)."""
+    words = np.zeros(CMDRING_SLOT_WORDS, np.int32)
+    words[_F["seqn"]] = int(seqn) & 0x7FFFFFFF
+    words[_F["opcode"]] = int(opcode)
+    words[_F["count"]] = int(count)
+    words[_F["dtype"]] = int(dtype)
+    words[_F["function"]] = int(function)
+    words[_F["root"]] = int(root)
+    words[_F["flags"]] = int(flags)
+    words[_F["nseg"]] = max(1, int(nseg))
+    words[_F["peer"]] = int(peer)
+    words[_F["wire"]] = int(wire)
+    return words
+
+
+def decode_slot(words) -> dict:
+    """The encoder's inverse (tests / debug dumps / ring introspection)."""
+    w = np.asarray(words).reshape(-1)
+    if w.size != CMDRING_SLOT_WORDS:
+        raise ValueError(
+            f"slot has {w.size} words, layout says {CMDRING_SLOT_WORDS}"
+        )
+    out = {name: int(w[idx]) for name, idx in _F.items()}
+    out["opcode"] = CmdOpcode(out["opcode"])
+    return out
+
+
+def encode_window(slots: Sequence[np.ndarray], depth: int) -> np.ndarray:
+    """Stack encoded slots into a ``(depth, CMDRING_SLOT_WORDS)`` window,
+    NOP-padding the tail (padding slots decode to retcode OK and move no
+    payload — the sequencer's idle slots)."""
+    if len(slots) > depth:
+        raise ValueError(f"{len(slots)} slots into a depth-{depth} window")
+    rows = [np.asarray(s, np.int32).reshape(-1) for s in slots]
+    while len(rows) < depth:
+        rows.append(encode_slot(0, CmdOpcode.NOP, 0))
+    return np.stack(rows).astype(np.int32)
+
+
+def complementary_pair(calls) -> Optional[Tuple[int, int]]:
+    """(src, dst) when a world-2 batch position holds a matched
+    SEND/RECV pair — THE one pair definition the ring planner's slot
+    eligibility and the engine's direct-delivery fallback both use (a
+    divergence between them would let one path deliver what the other
+    rejects).  A matched pair agrees on roles, count, tag and operand
+    dtype, and carries no wire compression (compressed p2p keeps the
+    channel's cast lanes).  None otherwise."""
+    if len(calls) != 2:
+        return None
+    ops = [c.op for c in calls]
+    if sorted(ops) != sorted((Operation.SEND, Operation.RECV)):
+        return None
+    src = ops.index(Operation.SEND)
+    dst = ops.index(Operation.RECV)
+    snd, rcv = calls[src], calls[dst]
+    from .constants import CompressionFlags
+
+    if (
+        snd.root_dst != dst or rcv.root_src != src
+        or snd.count != rcv.count or snd.tag != rcv.tag
+        or snd.arithcfg.uncompressed != rcv.arithcfg.uncompressed
+        or (snd.compression | rcv.compression)
+        & CompressionFlags.ETH_COMPRESSED
+    ):
+        return None
+    return src, dst
+
+
+def ring_widths(op: Operation, count: int, size: int) -> Tuple[int, int]:
+    """(operand width, result width) in elements for one ring slot —
+    the sequencer analog of the engine's IN_W/OUT_W tables.  BARRIER
+    rides a one-element token; SEND/RECV move ``count`` point-to-point."""
+    n = int(count)
+    if op in (Operation.REDUCE_SCATTER, Operation.ALLTOALL):
+        in_w = n * size
+    elif op == Operation.BARRIER:
+        in_w = 1
+    else:
+        in_w = n
+    if op in (Operation.ALLGATHER, Operation.ALLTOALL):
+        out_w = n * size
+    elif op == Operation.BARRIER:
+        out_w = 1
+    else:
+        out_w = n
+    return in_w, out_w
+
+
+# ---------------------------------------------------------------------------
+# persistent-sequencer knobs
+# ---------------------------------------------------------------------------
+
+
+def default_run_windows() -> int:
+    """Refill windows one sequencer run drains before returning (the
+    ``fori``/scan bound of the mega-window program)."""
+    try:
+        n = int(
+            os.environ.get(
+                CMDRING_RUN_WINDOWS_ENV, CMDRING_RUN_WINDOWS_DEFAULT
+            )
+        )
+    except ValueError:
+        n = CMDRING_RUN_WINDOWS_DEFAULT
+    return max(1, min(n, CMDRING_MAX_RUN_WINDOWS))
+
+
+def default_linger_s() -> float:
+    """How long a live run waits on an empty mailbox before halting.
+    Small on purpose: a lingering sequencer occupies the device stream,
+    so anything else dispatched to the mesh pays at most this bound."""
+    try:
+        ms = float(
+            os.environ.get(CMDRING_LINGER_ENV, CMDRING_LINGER_MS_DEFAULT)
+        )
+    except ValueError:
+        ms = CMDRING_LINGER_MS_DEFAULT
+    return max(0.0, ms) / 1e3
+
+
+# ---------------------------------------------------------------------------
+# the mailbox
+# ---------------------------------------------------------------------------
+
+
+class WindowShape:
+    """Static shape signature of a refill window — everything that keys
+    the sequencer program's compile cache.  Slot CONTENT (opcode, reduce
+    function, root, peer, seqn) stays data; only the payload geometry
+    and the per-slot wire-cast dtypes are shape."""
+
+    __slots__ = ("depth", "in_ws", "out_ws", "wires", "npdt")
+
+    def __init__(self, depth: int, in_ws, out_ws, wires, npdt):
+        self.depth = int(depth)
+        self.in_ws = tuple(int(w) for w in in_ws)
+        self.out_ws = tuple(int(w) for w in out_ws)
+        self.wires = tuple(wires)  # numpy dtype name or None, per slot
+        self.npdt = np.dtype(npdt)
+
+    def key(self) -> tuple:
+        return (self.depth, self.in_ws, self.out_ws, self.wires,
+                self.npdt.name)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, WindowShape) and self.key() == other.key()
+
+    def __hash__(self) -> int:
+        return hash(self.key())
+
+
+class _PostedWindow:
+    __slots__ = ("window_id", "slots", "payload", "status", "results",
+                 "pushed")
+
+    def __init__(self, window_id: int, slots: np.ndarray, payload):
+        self.window_id = window_id
+        self.slots = np.asarray(slots, np.int32)
+        # payload[i][r]: rank r's operand row for slot i (a VIEW of the
+        # committed immutable device array — snapshot semantics with no
+        # copy; None rows pull as zeros), or payload[i] a (size, w)
+        # array (the smoke/test convenience form)
+        self.payload = payload
+        self.status: Optional[np.ndarray] = None
+        self.results: Dict[int, List[np.ndarray]] = {}  # rank -> per slot
+        self.pushed = 0
+
+
+class SequencerMailbox:
+    """One sequencer run's host-visible mailbox (command FIFO in, status
+    FIFO out).  ``pull(rank)`` is the device program's per-step window
+    fetch; ``post`` the host's refill; ``push(rank, ...)`` the device's
+    per-step status/result writeback.  ``on_window_done(window_id,
+    status, results)`` fires — outside every mailbox lock — when all
+    ranks pushed a window's step."""
+
+    def __init__(self, size: int, shape: WindowShape,
+                 run_windows: Optional[int] = None,
+                 linger_s: Optional[float] = None,
+                 on_window_done: Optional[Callable] = None):
+        self.size = int(size)
+        self.shape = shape
+        self.run_windows = (
+            run_windows if run_windows is not None else default_run_windows()
+        )
+        self.linger_s = (
+            linger_s if linger_s is not None else default_linger_s()
+        )
+        self.on_window_done = on_window_done
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._queue: List[_PostedWindow] = []
+        self._decisions: List[Optional[_PostedWindow]] = []  # None = HALT
+        self._pull_cursor = [0] * self.size
+        self._push_cursor = [0] * self.size
+        self._halt_seen = [False] * self.size
+        self._accepted = 0
+        self._halted = False
+        self.drained = threading.Event()  # every rank pulled a HALT
+
+    # -- host side -----------------------------------------------------------
+    def post(self, window_id: int, slots: np.ndarray, payload) -> bool:
+        """Queue one refill window.  False when this run can no longer
+        take it (halted, or its window budget is spent) — the caller
+        must dispatch a fresh run instead."""
+        with self._cv:
+            if self._halted or self._accepted >= self.run_windows:
+                return False
+            self._accepted += 1
+            self._queue.append(_PostedWindow(window_id, slots, payload))
+            self._cv.notify_all()
+            return True
+
+    def halt(self) -> None:
+        """Teardown doorbell (soft_reset / engine shutdown / shape
+        change): stop accepting posts and let the run drain its backlog,
+        then return.  Queued windows still execute — their requests are
+        already parked."""
+        with self._cv:
+            self._halted = True
+            self._cv.notify_all()
+
+    @property
+    def accepting(self) -> bool:
+        with self._lock:
+            return not self._halted and self._accepted < self.run_windows
+
+    # -- device side (io_callback targets; XLA runtime threads) --------------
+    def pull(self, rank: int):
+        """Step decision + window fetch for one rank.  Returns
+        ``(live, slots, payload_rows)`` with ``live=0`` zeros on a HALT
+        step.  The first rank to reach a step decides it (bounded by
+        ``linger_s`` on an empty queue); everyone else reads the same
+        decision."""
+        r = int(rank)
+        with self._cv:
+            step = self._pull_cursor[r]
+            self._pull_cursor[r] += 1
+            while len(self._decisions) <= step:
+                if self._queue:
+                    self._decisions.append(self._queue.pop(0))
+                    break
+                if self._halted:
+                    self._decisions.append(None)
+                    break
+                # bounded linger, measured fresh per step: an idle
+                # sequencer must hand the device stream back promptly
+                deadline = time.monotonic() + self.linger_s
+                decided = len(self._decisions)
+                while (
+                    not self._queue
+                    and not self._halted
+                    and len(self._decisions) == decided
+                ):
+                    rem = deadline - time.monotonic()
+                    if rem <= 0:
+                        break
+                    self._cv.wait(min(rem, 0.05))
+                if (
+                    not self._queue
+                    and not self._halted
+                    and len(self._decisions) == decided
+                ):
+                    self._halted = True  # linger expired: park the run
+                self._cv.notify_all()
+            win = self._decisions[step]
+            if win is None:
+                # the run-loop exit: this rank saw the HALT; once every
+                # rank has, the program has returned the device stream
+                self._halt_seen[r] = True
+                if all(self._halt_seen):
+                    self.drained.set()
+                self._cv.notify_all()
+        if win is None:
+            return self._halt_payload(r)
+        sh = self.shape
+        rows = []
+        for i, p in enumerate(win.payload):
+            row = p[r] if p is not None else None
+            if row is None:
+                row = np.zeros((sh.in_ws[i],), sh.npdt)
+            rows.append(row)
+        return (np.int32(1), win.slots, rows)
+
+    def _halt_payload(self, rank: int):
+        sh = self.shape
+        return (
+            np.int32(0),
+            np.zeros((sh.depth, CMDRING_SLOT_WORDS), np.int32),
+            [np.zeros((w,), sh.npdt) for w in sh.in_ws],
+        )
+
+    def push(self, rank: int, live: int, status: np.ndarray,
+             outs: List[np.ndarray]) -> None:
+        """Per-step status/result writeback from one rank.  Completion
+        callbacks fire outside the lock once every rank pushed."""
+        r = int(rank)
+        done = None
+        with self._cv:
+            step = self._push_cursor[r]
+            self._push_cursor[r] += 1
+            win = (
+                self._decisions[step]
+                if step < len(self._decisions) else None
+            )
+            if win is not None and int(live):
+                win.results[r] = [np.asarray(o) for o in outs]
+                if win.status is None:
+                    win.status = np.asarray(status, np.int32).copy()
+                win.pushed += 1
+                if win.pushed == self.size:
+                    done = win
+            self._cv.notify_all()
+        if done is not None and self.on_window_done is not None:
+            self.on_window_done(done.window_id, done.status, done.results)
+
+
+# ---------------------------------------------------------------------------
+# mailbox registry (the device program addresses its mailbox by id, so
+# one compiled program serves every run of its shape — the callback
+# trampolines in ops/pallas/cmdring.py dispatch through here)
+# ---------------------------------------------------------------------------
+
+_REGISTRY: Dict[int, SequencerMailbox] = {}
+_REGISTRY_LOCK = threading.Lock()
+_NEXT_ID = [1]
+
+
+def register_mailbox(mbox: SequencerMailbox) -> int:
+    with _REGISTRY_LOCK:
+        mid = _NEXT_ID[0]
+        _NEXT_ID[0] += 1
+        _REGISTRY[mid] = mbox
+        return mid
+
+
+def mailbox_for(mid: int) -> Optional[SequencerMailbox]:
+    with _REGISTRY_LOCK:
+        return _REGISTRY.get(int(mid))
+
+
+def unregister_mailbox(mid: int) -> None:
+    with _REGISTRY_LOCK:
+        _REGISTRY.pop(int(mid), None)

@@ -284,6 +284,94 @@ def interpret_params_reason() -> str:
     return _interpret_probe[1]
 
 
+_replay_probe = None
+
+
+def _probe_bitexact_replay():
+    """(ok, reason) for bit-exact train-step replay on this host: run a
+    TINY sharded trainer step twice from value-identical states — once
+    chained on the donated step OUTPUT, once from a ``device_put`` clone
+    (exactly what a checkpoint restore produces).  Some XLA builds
+    execute a provenance-dependent program (donated/aliased inputs pick
+    different in-place kernels with a different FP reduction order), so
+    a resumed run cannot be bit-comparable to an uninterrupted one even
+    though save/restore and the data stream are value-faithful.  Each
+    path is individually repeatable — this is replay instability, not
+    nondeterminism, which is why it must be PROBED, not assumed."""
+    try:
+        import numpy as np
+
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh
+
+        from .models import (
+            TransformerConfig,
+            init_params,
+            make_sharded_train_step,
+        )
+    except Exception as e:  # pragma: no cover - broken env
+        return False, f"replay probe unavailable: {type(e).__name__}: {e}"
+    try:
+        devs = jax.devices()
+        tp = 2 if len(devs) >= 2 else 1
+        dp = max(len(devs) // tp, 1)
+        mesh = Mesh(np.array(devs[: dp * tp]).reshape(dp, tp), ("dp", "tp"))
+        heads = max(2, tp)
+        cfg = TransformerConfig(
+            vocab=32, d_model=8 * heads, n_heads=heads, n_layers=1,
+            d_ff=16 * heads, max_seq=8, dtype=jnp.float32,
+        )
+        step_fn, shard = make_sharded_train_step(cfg, mesh, lr=0.1)
+        params = shard(init_params(jax.random.PRNGKey(0), cfg))
+        rng = np.random.default_rng(7)
+        toks = jnp.asarray(
+            rng.integers(0, cfg.vocab, (2 * dp, cfg.max_seq)), jnp.int32
+        )
+        tgts = jnp.asarray(
+            rng.integers(0, cfg.vocab, (2 * dp, cfg.max_seq)), jnp.int32
+        )
+        p1, _ = step_fn(params, toks, tgts)
+        clone = jax.tree.map(
+            lambda a: jax.device_put(np.asarray(a).copy(), a.sharding), p1
+        )
+        _, chained = step_fn(p1, toks, tgts)
+        _, replayed = step_fn(clone, toks, tgts)
+        chained, replayed = float(chained), float(replayed)
+    except Exception as e:
+        return False, f"replay probe failed: {type(e).__name__}: {e}"
+    if chained != replayed:
+        return False, (
+            "XLA executes a provenance-dependent program: the same step "
+            "on value-identical params gives "
+            f"{chained!r} chained vs {replayed!r} from a device_put "
+            "clone — checkpoint resume cannot be bit-exact on this "
+            "platform (restore IS a device_put)"
+        )
+    return True, ""
+
+
+def has_bitexact_replay() -> bool:
+    """True when a donated train-step output and a value-identical
+    ``device_put`` clone replay to bit-identical results (probed once,
+    cached).  Checkpoint-resume bit-exactness tests gate on this and
+    skip LOUDLY with :func:`bitexact_replay_reason` where the platform
+    cannot deliver it — the loud-skip convention of
+    ``has_interpret_params``."""
+    global _replay_probe
+    if _replay_probe is None:
+        _replay_probe = _probe_bitexact_replay()
+    return _replay_probe[0]
+
+
+def bitexact_replay_reason() -> str:
+    """Why :func:`has_bitexact_replay` is False ('' when it is True)."""
+    global _replay_probe
+    if _replay_probe is None:
+        _replay_probe = _probe_bitexact_replay()
+    return _replay_probe[1]
+
+
 def has_pallas_interpret() -> bool:
     """True when jax ships the Pallas TPU interpreter
     (``pltpu.InterpretParams``) that lets the Mosaic kernels run

@@ -430,18 +430,43 @@ MAX_INFLIGHT_WINDOW = 64
 
 
 class CmdOpcode(enum.IntEnum):
-    """Opcode space of a command-ring slot (the sequencer's dispatch
-    vocabulary — a deliberately small warm-path subset of Operation;
-    anything else falls back to host dispatch)."""
+    """Opcode space of a command-ring slot — the sequencer's full
+    dispatch vocabulary (the reference CCLO's run-loop opcode set).
+    Every non-NOP opcode is implemented by BOTH sequencer lowerings
+    (enforced by the acclint ``cmdring-slot-layout`` cross-file
+    presence check); anything outside this enum falls back to host
+    dispatch with a counted reason."""
 
     NOP = 0        # padding slot: decoded, skipped, status OK
     ALLREDUCE = 1
     BCAST = 2
     HALT = 3       # teardown marker: parks the sequencer (soft_reset)
+    REDUCE_SCATTER = 4
+    ALLGATHER = 5
+    ALLTOALL = 6
+    BARRIER = 7    # the gather IS the sync; orders the slots around it
+    SEND = 8       # matched p2p pair as one slot (root=src, peer=dst)
+    RECV = 9       # the complementary spelling of the same pair slot
 
+
+#: Operation -> CmdOpcode: the ONE definition of the sequencer's
+#: warm-path subset (engine eligibility, slot encoding and the bench's
+#: per-opcode residency evidence all read this table).  COPY/COMBINE/
+#: SCATTER/GATHER/REDUCE stay host-dispatch: rooted trees and local ops
+#: are not floor-bound the way the warm window stream is.
+CMDRING_OPCODES = {
+    Operation.ALLREDUCE: CmdOpcode.ALLREDUCE,
+    Operation.BCAST: CmdOpcode.BCAST,
+    Operation.REDUCE_SCATTER: CmdOpcode.REDUCE_SCATTER,
+    Operation.ALLGATHER: CmdOpcode.ALLGATHER,
+    Operation.ALLTOALL: CmdOpcode.ALLTOALL,
+    Operation.BARRIER: CmdOpcode.BARRIER,
+    Operation.SEND: CmdOpcode.SEND,
+    Operation.RECV: CmdOpcode.RECV,
+}
 
 #: int32 words per slot (fields below + reserved headroom)
-CMDRING_SLOT_WORDS = 8
+CMDRING_SLOT_WORDS = 10
 
 #: field name -> word index within a slot.  Indices must stay dense,
 #: unique and < CMDRING_SLOT_WORDS (enforced by acclint).
@@ -450,10 +475,12 @@ CMDRING_FIELDS = {
     "opcode": 1,    # CmdOpcode
     "count": 2,     # element count of the collective
     "dtype": 3,     # DataType of the operand
-    "function": 4,  # ReduceFunction (ALLREDUCE slots)
-    "root": 5,      # comm-relative root rank (BCAST slots)
-    "flags": 6,     # reserved (compression lanes, future)
+    "function": 4,  # ReduceFunction (ALLREDUCE/REDUCE_SCATTER slots)
+    "root": 5,      # comm-relative root rank (BCAST; src for SEND/RECV)
+    "flags": 6,     # reserved (future lanes)
     "nseg": 7,      # ring segmentation register snapshot
+    "peer": 8,      # comm-relative destination rank (SEND/RECV slots)
+    "wire": 9,      # DataType of the compressed wire lane (0 = none)
 }
 
 #: per-slot status-word retcodes the sequencer writes back
@@ -470,6 +497,20 @@ CMDRING_MAX_BYTES_ENV = "ACCL_CMDRING_MAX_BYTES"
 CMDRING_DEPTH_DEFAULT = 8
 CMDRING_MAX_DEPTH = 64
 CMDRING_MAX_PAYLOAD_BYTES = 4 * 1024 * 1024
+
+# Persistent-sequencer mailbox knobs.  One sequencer *run* is one
+# long-running device program that drains up to ACCL_CMDRING_RUN_WINDOWS
+# refill windows from the host-visible mailbox before returning; while
+# a run is live, a refill is a mailbox write (doorbell), NOT a program
+# launch.  When the mailbox stays empty for ACCL_CMDRING_LINGER_MS the
+# run halts and the sequencer parks (returns the device) — the bounded
+# linger keeps a parked sequencer from pinning the device stream under
+# host-dispatch traffic.
+CMDRING_RUN_WINDOWS_ENV = "ACCL_CMDRING_RUN_WINDOWS"
+CMDRING_LINGER_ENV = "ACCL_CMDRING_LINGER_MS"
+CMDRING_RUN_WINDOWS_DEFAULT = 16
+CMDRING_MAX_RUN_WINDOWS = 128
+CMDRING_LINGER_MS_DEFAULT = 2.0
 
 # Segmented-pipelining wire tags (overlap plane): concurrent segment
 # sub-collectives of ONE pipelined call execute as concurrent engine

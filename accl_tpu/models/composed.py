@@ -593,10 +593,17 @@ def make_pp_train_step(
 
     def shard(params):
         # map over SPECS first: PartitionSpec is a tuple subclass, so it
-        # must be the is_leaf-guarded tree or jax flattens it
+        # must be the is_leaf-guarded tree or jax flattens it.  Specs
+        # are normalized at placement (trailing Nones stripped) so the
+        # placed tree carries the SAME sharding spelling the step's
+        # outputs do — see transformer.normalize_spec (the resume-
+        # divergence / double-compile fix).
+        from .transformer import normalize_spec
+
         return jax.tree.map(
             lambda s, p_: jax.device_put(
-                jnp.array(p_, copy=True), NamedSharding(mesh, s)
+                jnp.array(p_, copy=True),
+                NamedSharding(mesh, normalize_spec(s)),
             ),
             specs, _stacked(params),
             is_leaf=lambda x: isinstance(x, P),

@@ -1279,13 +1279,29 @@ def _reshard(x, mesh, spec):
     return jax.lax.with_sharding_constraint(x, s)
 
 
+def normalize_spec(spec):
+    """``PartitionSpec`` with trailing ``None`` entries stripped — the
+    canonical form the runtime stamps on program OUTPUTS.  Placement
+    must use this form: ``P('tp', None)`` and ``P('tp')`` are the same
+    layout, but jit keys its cache on the spelling, so unnormalized
+    placement makes step 0 run a DIFFERENT compiled program (different
+    reduction order) than the steady state — which is both a silent
+    double-compile and the checkpoint-resume divergence bug (a restored
+    tree re-enters at step-0 spelling while an uninterrupted run is on
+    the steady program)."""
+    parts = tuple(spec)
+    while parts and parts[-1] is None:
+        parts = parts[:-1]
+    return P(*parts)
+
+
 def _shard_params(params, specs, mesh):
     # copy before committing: device_put may ALIAS the source buffer (it
     # does on CPU), and the train step donates its params — without the
     # copy, donation would delete the caller's original arrays
     return jax.tree.map(
         lambda p, s: jax.device_put(
-            jnp.array(p, copy=True), NamedSharding(mesh, s)
+            jnp.array(p, copy=True), NamedSharding(mesh, normalize_spec(s))
         ),
         params, specs,
     )

@@ -82,10 +82,11 @@ def drain_deadline_s(timeout_s: float) -> float:
 class _Entry:
     __slots__ = (
         "key", "waiter", "on_ready", "on_error", "parked_ns", "depth",
-        "overlapped",
+        "overlapped", "ring",
     )
 
-    def __init__(self, key, waiter, on_ready, on_error, parked_ns, depth):
+    def __init__(self, key, waiter, on_ready, on_error, parked_ns, depth,
+                 ring=False):
         self.key = key
         self.waiter = waiter
         self.on_ready = on_ready
@@ -95,6 +96,12 @@ class _Entry:
         # set when a LATER launch of this key parks while this entry is
         # still in flight — the witness that its device time was hidden
         self.overlapped = False
+        # command-ring refill window: its waiter blocks on the mailbox
+        # status words, not a program future, and completion may arrive
+        # while the sequencer run is STILL resident serving later
+        # windows (the multi-window drain contract: drain points never
+        # require the run to return, only its windows to push)
+        self.ring = ring
 
 
 class InflightWindow:
@@ -125,8 +132,12 @@ class InflightWindow:
         self.max_depth_seen = 0
         self.overlap_ns_total = 0
         # command-ring plane: refill windows parked with ring=True (each
-        # is ONE entry covering a whole window of collectives)
+        # is ONE entry covering a whole window of collectives).  With
+        # the persistent sequencer a run serves MANY windows: parks and
+        # completions count WINDOWS, never runs — draining the window
+        # plane is independent of the sequencer program returning.
         self.ring_launched = 0
+        self.ring_completed = 0
 
     # -- engine side ---------------------------------------------------------
     def set_depth(self, depth: int) -> None:
@@ -183,7 +194,7 @@ class InflightWindow:
                 parked_ns = time.perf_counter_ns()
                 depth = len(fifo) + 1
                 entry = _Entry(key, waiter, on_ready, on_error,
-                               parked_ns, depth)
+                               parked_ns, depth, ring=ring)
                 fifo.append(entry)
                 self._total += 1
                 self.launched += 1
@@ -209,7 +220,7 @@ class InflightWindow:
                 self.ring_launched += 1
         self._complete(
             _Entry(key, waiter, on_ready, on_error,
-                   time.perf_counter_ns(), 1)
+                   time.perf_counter_ns(), 1, ring=ring)
         )
 
     # -- drain points --------------------------------------------------------
@@ -281,6 +292,7 @@ class InflightWindow:
                 "failed": self.failed,
                 "overlap_ns_total": self.overlap_ns_total,
                 "ring_launched": self.ring_launched,
+                "ring_completed": self.ring_completed,
             }
 
     # -- drainer (one per active key) ----------------------------------------
@@ -321,6 +333,8 @@ class InflightWindow:
             with self._lock:
                 self.failed += 1
                 self.completed += 1
+                if entry.ring:
+                    self.ring_completed += 1
             try:
                 entry.on_error(e)
             except Exception:  # pragma: no cover - defensive
@@ -334,6 +348,8 @@ class InflightWindow:
         )
         with self._lock:
             self.completed += 1
+            if entry.ring:
+                self.ring_completed += 1
             self.overlap_ns_total += overlap_ns
         try:
             entry.on_ready(overlap_ns, entry.depth, ready_ns)

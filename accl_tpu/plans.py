@@ -91,9 +91,10 @@ class CollectivePlan:
         self.pipeline_segments = int(pipeline_segments or 1)
         # command-ring plane: the plan -> slot encoding, cached by the
         # gang engine on first ring-resident dispatch (an int32 word
-        # template from ops/pallas/cmdring.encode_slot; per-call fields
-        # — seqn/count/root/function — are patched at refill).  Opaque
-        # here: this module stays jax/numpy-free.
+        # template from accl_tpu.cmdring.encode_slot covering the FULL
+        # opcode space; per-call fields — seqn/count/root/peer/function/
+        # wire — are patched at refill).  Opaque here: this module
+        # stays jax/numpy-free.
         self.cmdring_slot = None
 
     def pipeline_for(self, nbytes: int) -> int:

@@ -706,6 +706,23 @@ def to_prometheus(snapshot: dict) -> str:
                 if isinstance(vv, (int, float)) and not isinstance(vv, bool):
                     gauge(f"accl_engine_{k}_{kk}", vv)
 
+    # command-ring plane (the persistent sequencer): the sustained-
+    # occupancy gauge (refill windows served per program dispatch — the
+    # persistence evidence, >1 means the run survived across refills),
+    # per-opcode ring-residency counters and per-reason fallbacks.  The
+    # scalar ring counters (refills/dispatches/mailbox_posts/...) ride
+    # the generic accl_engine_cmdring_* folding above; these are the
+    # labeled third-level dicts that folding cannot reach.
+    ring = engine.get("cmdring") or {}
+    gauge(
+        "accl_cmdring_sustained_occupancy",
+        ring.get("sustained_occupancy"),
+    )
+    for opname, cnt in sorted((ring.get("ops") or {}).items()):
+        gauge("accl_cmdring_op_slots_total", cnt, op=opname)
+    for reason, cnt in sorted((ring.get("fallbacks") or {}).items()):
+        gauge("accl_cmdring_fallbacks_total", cnt, reason=reason)
+
     # monitor plane (live observability): per-peer straggler EWMA lags,
     # standing slow_rank verdicts, anomaly alert totals, scrape counts —
     # the gauges a dashboard alerts on

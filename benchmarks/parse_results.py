@@ -402,6 +402,13 @@ class CmdringGateError(ValueError):
     committing the capture."""
 
 
+#: the opcodes the mixed-op warm leg must show ring-resident (per-slot
+#: residency evidence the capture gate demands)
+CMDRING_EVIDENCE_OPS = (
+    "ALLREDUCE", "REDUCE_SCATTER", "ALLGATHER", "ALLTOALL", "BARRIER",
+)
+
+
 def check_cmdring(extras: dict, lkg_result: dict = None,
                   tolerance: float = None) -> None:
     """Gate a capture's command-ring evidence.  No-op when the cmdring
@@ -410,8 +417,18 @@ def check_cmdring(extras: dict, lkg_result: dict = None,
     comparison point and refill-amortization counters, the warm window
     must have actually ridden the ring (slots > 0, refills_per_call
     < 1), the ring floor must be strictly below the host-dispatch
-    floor measured at the same payload, and the ring floor must not
-    regress >tolerance vs the last-known-good."""
+    floor measured at the same payload, and the ring/sustained floors
+    must not regress >tolerance vs the last-known-good.
+
+    Persistent-sequencer evidence (captures carrying the sustained
+    keys — every capture from the multi-window sequencer on): the
+    sustained stream must show the run surviving across refills
+    (``gang_cmdring_redispatches_per_window < 1``, target 0 warm),
+    every opcode of the mixed warm leg must show per-opcode ring
+    residency (``gang_cmdring_op_slots`` > 0 each), and the
+    ``unsupported_op``/``compressed`` fallback counters for the mixed
+    leg must read ZERO — the grown opcode space leaves nothing on the
+    host path."""
     tol = OVERLAP_REGRESSION_TOLERANCE if tolerance is None else tolerance
     extras = extras or {}
     floor = extras.get("gang_cmdring_dispatch_floor_us")
@@ -446,6 +463,58 @@ def check_cmdring(extras: dict, lkg_result: dict = None,
             f"floor {host:.1f} us at the same point — the sequencer "
             "buys nothing; refusing the capture"
         )
+    redisp = extras.get("gang_cmdring_redispatches_per_window")
+    sustained = extras.get("gang_cmdring_sustained_floor_us")
+    op_slots = extras.get("gang_cmdring_op_slots")
+    mixed_fb = extras.get("gang_cmdring_mixed_fallbacks")
+    if any(
+        k is not None for k in (redisp, sustained, op_slots, mixed_fb)
+    ):
+        if redisp is None or sustained is None:
+            raise CmdringGateError(
+                "capture carries partial persistence evidence (need "
+                "gang_cmdring_redispatches_per_window + "
+                "gang_cmdring_sustained_floor_us together) — the "
+                "sustained stream is unverifiable"
+            )
+        if redisp >= 1.0:
+            raise CmdringGateError(
+                f"gang_cmdring_redispatches_per_window {redisp} >= 1: "
+                "the sequencer re-dispatched for every window — the "
+                "run did not survive across refills (the persistence "
+                "claim fails); refusing the capture"
+            )
+        missing = [
+            op for op in CMDRING_EVIDENCE_OPS
+            if not (op_slots or {}).get(op)
+        ]
+        if missing:
+            raise CmdringGateError(
+                "per-opcode ring-residency evidence missing for "
+                f"{missing}: the mixed warm window left opcodes on the "
+                "host path; refusing the capture"
+            )
+        nonzero = {
+            k: v for k, v in (mixed_fb or {}).items() if v
+        }
+        if mixed_fb is None or nonzero:
+            raise CmdringGateError(
+                "fallback-counters-zero gate failed for the mixed warm "
+                f"workload: {nonzero or 'no fallback evidence'} — "
+                "unsupported_op and compressed must both read 0"
+            )
+        sus_base = ((lkg_result or {}).get("extras") or {}).get(
+            "gang_cmdring_sustained_floor_us"
+        )
+        if (
+            sus_base is not None and sus_base > 0
+            and sustained > tol * sus_base
+        ):
+            raise CmdringGateError(
+                f"gang_cmdring_sustained_floor_us {sustained:.1f} us "
+                f"regressed beyond {tol:.2f}x the last-known-good "
+                f"{sus_base:.1f} us; refusing the capture"
+            )
     base = ((lkg_result or {}).get("extras") or {}).get(
         "gang_cmdring_dispatch_floor_us"
     )

@@ -94,7 +94,10 @@ check_cmdring(out, {})
 print(f"wrote {path}: ring floor "
       f"{out['gang_cmdring_dispatch_floor_us']} us vs host "
       f"{out['gang_cmdring_host_floor_us']} us, "
-      f"{out['gang_cmdring_refills_per_call']} refills/call")
+      f"{out['gang_cmdring_refills_per_call']} refills/call, "
+      f"{out.get('gang_cmdring_redispatches_per_window')} "
+      f"redispatches/window (sustained floor "
+      f"{out.get('gang_cmdring_sustained_floor_us')} us)")
 PY
 then
   echo "cmdring leg failed/timed out — bench evidence above is still" \

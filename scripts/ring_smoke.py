@@ -1,0 +1,174 @@
+"""CI command-ring smoke: exercise the ring's HOST half — the slot
+codec over the full opcode space and the persistent-sequencer mailbox
+protocol — plus the capture gate's units, with numpy only (no jax, the
+same footprint as the acclint gate job it runs next to,
+.github/workflows/analysis.yml).  The device lowerings are covered by
+the jax test tier (tests/test_cmdring.py); this job proves the
+protocol the firmware-side contract rides stays importable and correct
+standalone.
+
+Usage::
+
+    python scripts/ring_smoke.py
+"""
+
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from accl_tpu.cmdring import (
+    SequencerMailbox,
+    WindowShape,
+    decode_slot,
+    encode_slot,
+    encode_window,
+    mailbox_for,
+    register_mailbox,
+    ring_widths,
+    unregister_mailbox,
+)
+from accl_tpu.constants import (
+    CMDRING_OPCODES,
+    CMDRING_SLOT_WORDS,
+    CmdOpcode,
+    Operation,
+    ReduceFunction,
+)
+
+
+def codec_smoke() -> None:
+    """Every executable opcode round-trips through the slot codec with
+    its full field set."""
+    for op, opcode in CMDRING_OPCODES.items():
+        words = encode_slot(
+            11, opcode, 256, dtype=2, function=ReduceFunction.MAX,
+            root=1, nseg=2, peer=3, wire=1,
+        )
+        assert words.shape == (CMDRING_SLOT_WORDS,)
+        d = decode_slot(words)
+        assert d["opcode"] is opcode, op
+        assert d["count"] == 256 and d["peer"] == 3 and d["wire"] == 1
+    w = encode_window([encode_slot(0, CmdOpcode.BARRIER, 1)], 4)
+    assert w.shape == (4, CMDRING_SLOT_WORDS)
+    assert decode_slot(w[3])["opcode"] is CmdOpcode.NOP
+    # width table sanity (the sequencer analog of IN_W/OUT_W)
+    assert ring_widths(Operation.ALLREDUCE, 8, 4) == (8, 8)
+    assert ring_widths(Operation.REDUCE_SCATTER, 8, 4) == (32, 8)
+    assert ring_widths(Operation.ALLGATHER, 8, 4) == (8, 32)
+    assert ring_widths(Operation.ALLTOALL, 8, 4) == (32, 32)
+    assert ring_widths(Operation.BARRIER, 0, 4) == (1, 1)
+    print("codec: ok")
+
+
+def mailbox_smoke() -> None:
+    """The persistent run's mailbox protocol, driven like the device
+    program would: N rank pullers, SPMD-identical step decisions, one
+    completion per window once every rank pushed, bounded-linger HALT
+    park."""
+    size = 2
+    shape = WindowShape(1, [4], [4], [None], np.float32)
+    done = []
+    mbox = SequencerMailbox(
+        size, shape, run_windows=4, linger_s=0.2,
+        on_window_done=lambda wid, st, res: done.append((wid, st, res)),
+    )
+    mid = register_mailbox(mbox)
+    assert mailbox_for(mid) is mbox
+    slots = encode_window([encode_slot(0, CmdOpcode.ALLREDUCE, 4)], 1)
+    payload = [np.arange(size * 4, dtype=np.float32).reshape(size, 4)]
+    assert mbox.post(1, slots, payload)
+    assert mbox.post(2, slots, payload)
+
+    schedules = {r: [] for r in range(size)}
+
+    def rank_loop(r):
+        for _step in range(4):
+            live, got_slots, rows = mbox.pull(r)
+            schedules[r].append(int(live))
+            status = np.stack(
+                [got_slots[:, 0], np.ones(1, np.int32)], axis=1
+            )
+            mbox.push(r, int(live), status, [rows[0] * 2])
+
+    threads = [
+        threading.Thread(target=rank_loop, args=(r,), daemon=True,
+                         name=f"accl-ring-smoke-{r}")
+        for r in range(size)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+        assert not t.is_alive(), "mailbox protocol wedged"
+    # both ranks saw the identical schedule: 2 live windows, then the
+    # linger expired and every later step HALTed
+    assert schedules[0] == schedules[1] == [1, 1, 0, 0], schedules
+    assert [wid for wid, _, _ in done] == [1, 2]
+    for _wid, _st, res in done:
+        assert set(res) == {0, 1}
+        np.testing.assert_array_equal(res[0][0], payload[0][0] * 2)
+    assert not mbox.accepting  # halted: the next refill re-dispatches
+    assert not mbox.post(3, slots, payload)
+    assert mbox.drained.is_set()
+    unregister_mailbox(mid)
+    assert mailbox_for(mid) is None
+    print("mailbox: ok")
+
+
+def gate_smoke() -> None:
+    """check_cmdring's persistence requirements hold stand-alone (the
+    same units tests/test_cmdring.py pins, importable without jax)."""
+    sys.path.insert(
+        0,
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "benchmarks",
+        ),
+    )
+    import parse_results as pr
+
+    good = {
+        "gang_cmdring_dispatch_floor_us": 40.0,
+        "gang_cmdring_host_floor_us": 200.0,
+        "gang_cmdring_refills_per_call": 0.125,
+        "gang_cmdring_ring_slots": 96,
+        "gang_cmdring_sustained_floor_us": 35.0,
+        "gang_cmdring_redispatches_per_window": 0.0,
+        "gang_cmdring_op_slots": {
+            op: 1 for op in pr.CMDRING_EVIDENCE_OPS
+        },
+        "gang_cmdring_mixed_fallbacks": {
+            "unsupported_op": 0, "compressed": 0,
+        },
+    }
+    pr.check_cmdring(dict(good), {})
+    for mutate, expect in (
+        ({"gang_cmdring_redispatches_per_window": 1.0}, "re-dispatched"),
+        (
+            {"gang_cmdring_mixed_fallbacks": {"compressed": 3}},
+            "fallback-counters-zero",
+        ),
+    ):
+        try:
+            pr.check_cmdring(dict(good, **mutate), {})
+        except pr.CmdringGateError as e:
+            assert expect in str(e), e
+        else:
+            raise AssertionError(f"gate accepted {mutate}")
+    print("gate: ok")
+
+
+def main() -> int:
+    codec_smoke()
+    mailbox_smoke()
+    gate_smoke()
+    print("ring smoke: all ok")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1107,3 +1107,75 @@ from ...constants import CMDRING_FIELDS
 """)
     assert len(findings) == 1
     assert "dense" in findings[0].message
+
+
+# the grown-opcode contract: dense enum, full Operation map, and the
+# decode module referencing every executable opcode
+
+_RING_CONSTS_OPS = _RING_CONSTS + """
+class CmdOpcode:
+    NOP = 0
+    ALLREDUCE = 1
+    HALT = 2
+    ALLGATHER = 3
+
+CMDRING_OPCODES = {
+    "allreduce": CmdOpcode.ALLREDUCE,
+    "allgather": CmdOpcode.ALLGATHER,
+}
+"""
+
+_RING_DECODER_OPS = """
+from ...constants import CMDRING_FIELDS, CmdOpcode
+_F = CMDRING_FIELDS
+def decode(op, blocks, own):
+    if op == CmdOpcode.ALLREDUCE:
+        return sum(blocks)
+    if op == CmdOpcode.ALLGATHER:
+        return blocks
+    return own
+"""
+
+
+def test_cmdring_opcode_contract_clean(tmp_path, monkeypatch):
+    findings = _ring_pkg(
+        tmp_path, monkeypatch, _RING_CONSTS_OPS, _RING_DECODER_OPS
+    )
+    assert not findings
+
+
+def test_cmdring_flags_sparse_opcode_values(tmp_path, monkeypatch):
+    sparse = _RING_CONSTS_OPS.replace("ALLGATHER = 3", "ALLGATHER = 7")
+    findings = _ring_pkg(
+        tmp_path, monkeypatch, sparse, _RING_DECODER_OPS
+    )
+    assert len(findings) == 1
+    assert "dense" in findings[0].message and "CmdOpcode" in (
+        findings[0].message
+    )
+
+
+def test_cmdring_flags_unmapped_opcode(tmp_path, monkeypatch):
+    unmapped = _RING_CONSTS_OPS.replace(
+        '    "allgather": CmdOpcode.ALLGATHER,\n', ""
+    )
+    findings = _ring_pkg(
+        tmp_path, monkeypatch, unmapped, _RING_DECODER_OPS
+    )
+    assert len(findings) == 1
+    assert "ALLGATHER" in findings[0].message
+    assert "CMDRING_OPCODES" in findings[0].message
+
+
+def test_cmdring_flags_unimplemented_opcode_in_decoder(
+    tmp_path, monkeypatch
+):
+    decoder = _RING_DECODER_OPS.replace(
+        "    if op == CmdOpcode.ALLGATHER:\n        return blocks\n", ""
+    )
+    findings = _ring_pkg(
+        tmp_path, monkeypatch, _RING_CONSTS_OPS, decoder
+    )
+    assert len(findings) == 1
+    assert "ALLGATHER" in findings[0].message
+    assert "unimplemented" in findings[0].message

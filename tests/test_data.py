@@ -107,6 +107,21 @@ def test_trainer_consumes_token_file(tmp_path):
     _, loss_c = train(
         steps=6, ckpt_dir=ckpt, save_every=2, log_every=0, data=path
     )
+    # stream/restore integrity holds on every platform: a resume that
+    # consumed wrong data or restored wrong values lands far outside
+    # this band (the loose gate runs BEFORE any skip so gross breakage
+    # still fails loudly everywhere)
+    assert loss_c == pytest.approx(loss_b, rel=5e-2), (
+        "resumed run diverged grossly — wrong data stream or corrupted "
+        "restore, not platform replay noise"
+    )
+    from accl_tpu.compat import bitexact_replay_reason, has_bitexact_replay
+
+    if not has_bitexact_replay():
+        pytest.skip(
+            "bit-exact resume unverifiable here: "
+            + bitexact_replay_reason()
+        )
     assert loss_c == pytest.approx(loss_b, rel=1e-5), (
         "resumed run must consume the exact stream the uninterrupted "
         "run does"
