@@ -47,6 +47,11 @@ from .contract import (  # noqa: F401
 )
 from .communicator import Communicator, Rank  # noqa: F401
 from .core import ACCL, emulated_group, socket_group_member  # noqa: F401
+from .membership import (  # noqa: F401
+    CircuitBreaker,
+    ELASTIC_ENV,
+    MembershipView,
+)
 from .plans import CollectivePlan, PlanCache, size_bucket  # noqa: F401
 from .request import Request, RequestStatus  # noqa: F401
 from .telemetry import (  # noqa: F401

@@ -40,6 +40,7 @@ JAX_FREE_MODULES = (
     "accl_tpu.constants",
     "accl_tpu.contract",
     "accl_tpu.monitor",
+    "accl_tpu.membership",
 )
 
 #: top-level packages whose module-scope import breaks jax-freedom

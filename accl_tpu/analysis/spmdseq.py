@@ -86,8 +86,18 @@ _TAINT_SUBSTR = ("health",)
 #: ``create_communicator`` is the blessed MPI_Comm_split-style
 #: constructor — its MEMBERS argument legitimately varies per rank (each
 #: rank passes its own group) while the returned communicator is the
-#: uniform handle the new group's contract runs over
-_BUILTIN_SANITIZERS = frozenset(("create_communicator", "split"))
+#: uniform handle the new group's contract runs over.  The membership
+#: plane's EXCHANGED-verdict accessors join it: ``demote_decision`` /
+#: ``suggest_root`` derive from the shared demotion ledger (latched per
+#: (comm, call index) — every rank reads the same decision) and
+#: ``evict_rank``/``take_cutover`` apply a majority-confirmed plan —
+#: SPMD-uniform by construction.  Raw health-map reads stay taint
+#: SOURCES (_TAINT_SUBSTR below): a collective branched on the LOCAL
+#: health map still flags.
+_BUILTIN_SANITIZERS = frozenset((
+    "create_communicator", "split",
+    "demote_decision", "suggest_root",
+))
 
 
 def _is_spmd_marked(fn: ast.AST) -> bool:

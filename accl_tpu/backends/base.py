@@ -168,6 +168,36 @@ class BaseEngine:
     #: the facade's straggler SkewTracker (monitor plane; None = off)
     skew_tracker = None
 
+    #: the facade's MembershipView (accl_tpu.membership; None = off)
+    membership = None
+
+    #: facade hook fired on every peer-health state transition
+    #: (``(peer, old_state, new_state)``): feeds the transition
+    #: counters/event ring and, when elastic membership is armed, the
+    #: dead-verdict eviction proposal.  Must be cheap and never raise.
+    on_health_transition = None
+
+    def set_membership(self, view) -> None:
+        """Arm (or with ``None`` disarm) the membership plane on this
+        engine.  Default: store the handle — the facade's intake/
+        failure paths do the acting; fabric tiers override to observe
+        MEMBER agreement frames at delivery and to fail in-flight work
+        against confirmed evictions fast."""
+        self.membership = view
+
+    def on_membership_cutover(self, plan: dict, addresses: tuple = (),
+                              comm_ids: tuple = ()) -> None:
+        """Engine-side shrink hook: tear down / re-arm per-comm session
+        state over the survivors (ring sessions + mailboxes on the XLA
+        tier; rx/ledger/retransmit purge + health-strike hygiene on the
+        emulator).  ``addresses`` are the evicted peers' transport
+        addresses; ``comm_ids`` the communicators that shrank.
+        Default: no per-comm session state to re-arm."""
+
+    def on_membership_restore(self) -> None:
+        """Engine-side restore hook (soft_reset re-admission): the
+        reset itself already flushed engine state on every tier."""
+
     def set_skew_tracker(self, tracker) -> None:
         """Arm (or with ``None`` disarm) the monitor plane's cross-rank
         skew exchange on this engine.  Default: store the handle — on

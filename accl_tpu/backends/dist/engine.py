@@ -59,6 +59,16 @@ from ..xla.engine import (
 )
 
 
+#: collectives whose completion advances the contract verifier's digest
+#: (the facade's _CONTRACT_OPS) — only these can complete a verification
+#: window, so only they trigger the KV digest-piggyback exchange
+_KV_VERIFIED_OPS = frozenset((
+    Operation.BCAST, Operation.SCATTER, Operation.GATHER,
+    Operation.ALLGATHER, Operation.REDUCE, Operation.ALLREDUCE,
+    Operation.REDUCE_SCATTER, Operation.ALLTOALL, Operation.BARRIER,
+))
+
+
 def _bucket_width(n: int) -> int:
     """Power-of-two wire bucket (floor 8) for a per-chunk element count.
 
@@ -159,6 +169,12 @@ class DistEngine(StreamPortMixin, BaseEngine):
         # try-get/increment surface; see compat.kv_client)
         self._kv_raw = None
         self._kv_wrapped = None
+        # contract plane: per-comm KV digest-piggyback cursors +
+        # lifetime counters (see _kv_contract_exchange)
+        self._vfy_kv_state: Dict[int, dict] = {}
+        self._vfy_kv_counters: Dict[str, int] = {
+            "posted": 0, "claims": 0, "errors": 0,
+        }
         self._meshes: Dict[tuple, object] = {}
         # one serialized executor thread (the FPGAQueue role): calls run
         # in submission order — the property SPMD needs — while start()
@@ -272,10 +288,35 @@ class DistEngine(StreamPortMixin, BaseEngine):
     # One process per rank: there is no shared in-process board to meet
     # on (contract_anchor() stays the BaseEngine default, None), so
     # this tier verifies via the facade intake screen plus the executor
-    # screen in _execute (contract_verifier stored by the inherited
-    # BaseEngine.set_contract_verifier); a cross-process digest
-    # exchange piggybacked on the KV store rides with ROADMAP item 2's
-    # multi-slice work.
+    # screen in _execute — AND the rolling-digest piggyback on the
+    # distributed KV plane below (the PR 7 deferral, landed): after
+    # each executed collective the verifier's latest completed window
+    # digest is posted under accl/vfy/<comm>/<gen>/<window>/<rank> and
+    # peers' posted digests are compared via observe_claim, so
+    # cross-host divergence fails fast exactly like in-process.
+
+    def _kv_contract_exchange(self, comm) -> None:
+        """Post/compare the verifier's rolling digest over the KV plane
+        (executor thread; bounded — try-get, never blocking-get).
+        Failures are counted, never raised: an unreachable KV degrades
+        verification to the intake screen, not the collective."""
+        v = self.contract_verifier
+        if v is None or comm is None:
+            return
+        from ...contract import kv_digest_exchange
+
+        state = self._vfy_kv_state.setdefault(comm.id, {})
+        try:
+            kv = self._kv()
+        except Exception:
+            self._vfy_kv_counters["errors"] += 1
+            return
+        out = kv_digest_exchange(
+            kv, v, comm.id, comm.local_rank, comm.size,
+            state=state, is_notfound=self._is_notfound,
+        )
+        for k, n in out.items():
+            self._vfy_kv_counters[k] = self._vfy_kv_counters.get(k, 0) + n
 
     def telemetry_report(self) -> dict:
         """Dist-tier counters for the telemetry snapshot: executor queue
@@ -289,9 +330,12 @@ class DistEngine(StreamPortMixin, BaseEngine):
             "remote_stream_seq": stream_seq,
             "cached_meshes": len(self._meshes),
             "faults": None,
+            # contract plane: the KV digest-piggyback exchange counters
+            # (windows posted / peer claims compared / KV errors)
+            "contract_kv": dict(self._vfy_kv_counters),
             # monitor plane: per-rank baselines only — the cross-
             # process skew exchange rides ROADMAP item 2's topology
-            # work, like the contract plane's KV piggyback above
+            # work
             "skew_exchange": "local",
         }
 
@@ -361,6 +405,15 @@ class DistEngine(StreamPortMixin, BaseEngine):
             traceback.print_exc()
             code = ErrorCode.INVALID_OPERATION
         req.complete(code, time.perf_counter_ns() - t0)
+        if (
+            cv is not None and code == ErrorCode.OK
+            and options.comm is not None
+            and options.op in _KV_VERIFIED_OPS
+        ):
+            # digest piggyback on the KV plane: post/compare the latest
+            # completed verification window (cheap cursor check when
+            # nothing new completed)
+            self._kv_contract_exchange(options.comm)
 
     # -- batched execution ---------------------------------------------------
     def _execute_batch(self, options_list, reqs) -> None:

@@ -769,23 +769,32 @@ def op_allreduce(eng, call: CallOptions) -> Generator:
 
 
 def op_barrier(eng, call: CallOptions) -> Generator:
-    """ref firmware ``barrier`` c:2078-2120: zero-byte gather to rank 0 then
-    zero-byte broadcast back."""
+    """ref firmware ``barrier`` c:2078-2120: zero-byte gather to a root
+    then zero-byte broadcast back.  The root rides ``call.root_src``
+    (default 0) — the membership plane re-routes it around demoted
+    stragglers, SPMD-uniformly (every rank is handed the same root by
+    the shared demotion ledger; the contract verifier folds it into the
+    call fingerprint like any other root)."""
     comm = call.comm
     r, size = comm.local_rank, comm.size
     if size == 1:
         yield Yield()
         return ErrorCode.OK
     tag = call.tag
-    if r == 0:
-        for peer in range(1, size):
+    root = call.root_src if 0 <= call.root_src < size else 0
+    if r == root:
+        for peer in range(size):
+            if peer == root:
+                continue
             h = eager_recv_post(eng, comm, peer, tag, 0)
             yield from eager_recv_wait(eng, comm, h)
-        for peer in range(1, size):
+        for peer in range(size):
+            if peer == root:
+                continue
             yield from eager_send(eng, comm, peer, tag, b"")
     else:
-        yield from eager_send(eng, comm, 0, tag, b"")
-        h = eager_recv_post(eng, comm, 0, tag, 0)
+        yield from eager_send(eng, comm, root, tag, b"")
+        h = eager_recv_post(eng, comm, root, tag, 0)
         yield from eager_recv_wait(eng, comm, h)
     return ErrorCode.OK
 

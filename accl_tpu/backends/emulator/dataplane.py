@@ -134,6 +134,35 @@ class RxBufferPool:
             buf.msg = None
             self._cv.notify_all()
 
+    def purge(self, floors) -> int:
+        """Release every FILLED slot holding a STALE segment for a
+        shrunk communicator (``floors``: comm id -> minimum accepted
+        membership epoch, the cutover fence) — the membership-plane
+        cutover flush: a shrunk communicator's seqn space restarted,
+        and a stale chunk of the aborted pre-shrink collective would
+        match (and corrupt) the first post-shrink collective's
+        receives.  Epoch-aware: a fast peer's POST-shrink frames may
+        already be parked when this rank's purge runs — those carry
+        ``msg.mbr >= floor`` and must survive.  CLAIMED slots are left
+        alone (a consumer owns them).  Returns slots released."""
+        with self._cv:
+            n = 0
+            for b in self._buffers:
+                m = b.msg
+                if b.status != RxStatus.FILLED or m is None:
+                    continue
+                floor = floors.get(m.comm_id)
+                if floor is None or m.mbr >= floor:
+                    continue
+                n += 1
+                if self._matcher is not None:
+                    self._matcher.release(b.index)
+                b.status = RxStatus.IDLE
+                b.msg = None
+            if n:
+                self._cv.notify_all()
+            return n
+
     def reset(self) -> int:
         """Force every slot back to IDLE (soft-reset recovery: stale
         segments from a faulted collective must not leak slots).  Returns

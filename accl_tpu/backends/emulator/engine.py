@@ -160,6 +160,18 @@ class EmuEngine(BaseEngine):
         # protocol event totals the metrics registry absorbs
         self._retransmits_total = 0
         self._dedup_discards_total = 0
+        # membership plane: pre-shrink straggler frames discarded by
+        # the epoch screen (see Message.mbr).  The fence is COMM-scoped
+        # (_mbr_floor: comm id -> minimum accepted epoch, written at
+        # cutover): traffic on communicators that never shrank must
+        # keep flowing whatever the sender's global epoch says.
+        self._mbr_drops = 0
+        self._mbr_floor: Dict[int, int] = {}
+        # cutover purges queued by the facade thread, applied ON the
+        # scheduler thread (the rx pool / ledger / retransmit window /
+        # health map are scheduler-owned state; a cross-thread mutation
+        # races _route_inbox mid-iteration)
+        self._mbr_cutovers: List[tuple] = []
         self.leaked_scheduler_thread = False
 
         self._queue = CommandQueue()
@@ -283,6 +295,155 @@ class EmuEngine(BaseEngine):
 
         return "board" if isinstance(self.fabric, InProcFabric) else "wire"
 
+    # -- membership plane (accl_tpu.membership) ------------------------------
+    def set_membership(self, view) -> None:
+        """Arm (or with ``None`` disarm) the membership plane: MEMBER
+        agreement frames are observed at delivery (the wire exchange on
+        socket fabrics; harmless duplicate tallies on InProc where the
+        board already exchanged), and a confirmed eviction wakes the
+        scheduler so in-flight calls against the evicted rank fail
+        fast instead of burning their deadline."""
+        self.membership = view
+        if view is None:
+            self.endpoint.membership_hook = None
+            return
+
+        def observe(msg, v=view):
+            from ...membership import member_payload
+
+            payload = member_payload(msg.payload)
+            if payload is not None:
+                v.observe_wire(payload, msg.src)
+
+        self.endpoint.membership_hook = observe
+        view.add_listener(lambda _evt: self._wake.set())
+
+    def _membership_failure(self, options: Optional[CallOptions],
+                            peer_rank: Optional[int],
+                            default_code: ErrorCode) -> tuple:
+        """(code, extra_context) for a failed call against ``peer_rank``
+        (comm-relative): RANK_EVICTED + agreement evidence when the
+        membership plane holds a confirmed (or applied) eviction
+        covering that peer — the structured terminal the shrink
+        protocol promises for in-flight work — else the tier's own
+        timeout code."""
+        mv = self.membership
+        if (
+            mv is None or options is None or options.comm is None
+            or peer_rank is None
+        ):
+            return default_code, {}
+        try:
+            session = options.comm.ranks[peer_rank].session
+        except IndexError:
+            return default_code, {}
+        if mv.plan_covers(session):
+            return ErrorCode.RANK_EVICTED, {"membership": mv.evidence()}
+        return default_code, {}
+
+    def _evicted_peer_for(self, options: CallOptions) -> Optional[int]:
+        """Comm-relative rank of a participating peer under a confirmed
+        eviction, or None — the active-task sweep's screen (mirrors
+        ``_dead_peer_for`` but consults the agreed plan, which can
+        land while the health map still says ``suspect``)."""
+        mv = self.membership
+        comm = options.comm
+        if mv is None or comm is None or options.op not in _COMM_OPS:
+            return None
+        if not (mv.cutover_ready() or mv.evicted):
+            return None
+        if options.op == Operation.SEND:
+            candidates = [options.root_dst]
+        elif options.op == Operation.RECV:
+            candidates = [options.root_src]
+        else:
+            candidates = [
+                r for r in range(comm.size) if r != comm.local_rank
+            ]
+        for r in candidates:
+            if mv.plan_covers(comm.ranks[r].session):
+                return r
+        return None
+
+    def on_membership_cutover(self, plan: dict, addresses: tuple = (),
+                              comm_ids: tuple = ()) -> None:
+        """Queue the post-shrink purge for the SCHEDULER thread (the rx
+        pool, dedup ledger, retransmit window and health map are
+        scheduler-owned; mutating them from the facade thread races
+        _route_inbox mid-iteration) and raise the shrunk comms'
+        stale-frame fence floors.  The scheduler drains the queue
+        before popping any later intake item, so the purge strictly
+        precedes the first post-shrink collective."""
+        mv = self.membership
+        if mv is not None:
+            for cid in comm_ids:
+                self._mbr_floor[cid] = mv.epoch
+        with self._notif_lock:
+            self._mbr_cutovers.append(
+                (tuple(addresses), tuple(comm_ids))
+            )
+        self._wake.set()
+
+    def _apply_membership_purge(self, addresses: tuple,
+                                comm_ids: tuple) -> None:
+        """The purge itself (scheduler thread only), the per-comm
+        analog of the soft-reset full flush: drop the shrunk comms'
+        STALE parked rx segments, inbox frames, retransmit and
+        rendezvous entries of the ABORTED pre-shrink collective (its
+        chunk geometry differs from the post-shrink one, and seqn
+        matching ignores epochs, so a stale chunk would corrupt the
+        first shrunk collective).  Epoch-aware via the fence floors: a
+        fast peer that cut over first may already have POST-shrink
+        frames parked here — those carry the new membership epoch and
+        survive.  The dedup ledger is deliberately NOT purged: its
+        keys carry the sender's communicator-instance epoch, which the
+        shrink refreshed, so post-shrink segments never collide with
+        pre-shrink floors (the PR 2 epoch design).  Also drops the
+        evicted peers' health entries and clears the suspect strikes
+        the failure cascade accrued against the SURVIVORS (a rank
+        stalled behind the dead one is not sick)."""
+        ids = set(comm_ids)
+        if ids:
+            floors = {c: self._mbr_floor.get(c, 0) for c in ids}
+
+            def stale(m, floors=floors):
+                floor = floors.get(m.comm_id)
+                return floor is not None and m.mbr < floor
+
+            self.rx_pool.purge(floors)
+            while self.endpoint.take_matching(stale) is not None:
+                pass
+            # retransmit entries for the shrunk comms are pre-cutover
+            # by construction (this engine's own post-cutover sends
+            # cannot precede the drain that runs this purge)
+            for key in [k for k in self._retrans if k[0] in ids]:
+                del self._retrans[key]
+            with self._notif_lock:
+                self._rndzv_inits = [
+                    m for m in self._rndzv_inits if not stale(m)
+                ]
+                self._rndzv_done = [
+                    m for m in self._rndzv_done if not stale(m)
+                ]
+        for a in addresses:
+            self._health.pop(a, None)
+        for h in self._health.values():
+            if h["state"] == "suspect":
+                h["state"] = "ok"
+                h["timeouts"] = 0
+
+    def _drain_membership_cutovers(self) -> None:
+        """Apply queued cutover purges (scheduler thread).  Called
+        before every intake pop: the cutover marker is queued strictly
+        before the facade issues its first post-shrink collective, so
+        draining here orders purge-before-serve."""
+        if not self._mbr_cutovers:
+            return
+        with self._notif_lock:
+            cutovers, self._mbr_cutovers = self._mbr_cutovers, []
+        for addresses, comm_ids in cutovers:
+            self._apply_membership_purge(addresses, comm_ids)
+
     def _contract_verdict_for(self, options: Optional[CallOptions]):
         v = self.contract_verifier
         if (
@@ -295,6 +456,12 @@ class EmuEngine(BaseEngine):
     # -- wire helpers used by algorithms ------------------------------------
     def post(self, comm: Communicator, dst: int, msg: Message) -> None:
         addr = comm.ranks[dst].address
+        mv = self.membership
+        if mv is not None:
+            # membership-epoch stamp: globally aligned by the eviction
+            # agreement, so receivers can discard stale pre-shrink
+            # frames (see Message.mbr)
+            msg.mbr = mv.epoch
         try:
             self.fabric.send(addr, msg)
         except PeerDeadError:
@@ -325,6 +492,7 @@ class EmuEngine(BaseEngine):
             addr, {"state": "ok", "timeouts": 0, "failures": 0,
                    "last_event": ""}
         )
+        old = h["state"]
         if event == "timeout":
             h["timeouts"] += 1
         else:
@@ -338,6 +506,14 @@ class EmuEngine(BaseEngine):
             h["state"] = "dead"
         elif h["state"] != "dead":
             h["state"] = "suspect"
+        hook = self.on_health_transition
+        if hook is not None and h["state"] != old:
+            # the facade's transition hook: health-event ring + counter
+            # and, under elastic membership, the dead->propose edge
+            try:
+                hook(addr, old, h["state"])
+            except Exception:  # pragma: no cover - must never fail a call
+                pass
 
     def health_report(self, comm: Communicator) -> Dict[int, dict]:
         """Per-peer health for ``comm``'s members, keyed by comm-relative
@@ -452,6 +628,7 @@ class EmuEngine(BaseEngine):
             "retransmit_window": len(self._retrans),
             "retransmits_total": self._retransmits_total,
             "dedup_discards_total": self._dedup_discards_total,
+            "membership_drops_total": self._mbr_drops,
             "retry_limit": self.retry_limit,
             "inflight_window": self.inflight_window,
             "faults": inj.stats() if inj is not None else None,
@@ -494,6 +671,18 @@ class EmuEngine(BaseEngine):
                 )
                 if emsg is not None:
                     routed_any = True
+                    floor = self._mbr_floor.get(emsg.comm_id)
+                    if floor is not None and emsg.mbr < floor:
+                        # a pre-shrink straggler frame on a SHRUNK comm
+                        # (the sender's membership epoch lags the
+                        # cutover floor): discard — its chunk geometry
+                        # belongs to the aborted collective and seqn
+                        # matching would hand it to the first
+                        # post-shrink receive.  Comm-scoped: traffic on
+                        # communicators that never shrank keeps flowing
+                        # whatever the sender's global epoch says.
+                        self._mbr_drops += 1
+                        continue
                     self._maybe_ack(emsg)
                     if not self._ledger.seen(
                         (emsg.comm_id, emsg.src, emsg.epoch), emsg.seqn
@@ -506,6 +695,17 @@ class EmuEngine(BaseEngine):
                         self._dedup_discards_total += 1
             if not routed_any:
                 return
+
+    @staticmethod
+    def _rank_of_address(options: Optional[CallOptions],
+                         addr: Optional[str]) -> Optional[int]:
+        """Comm-relative rank behind a transport address, or None."""
+        if options is None or options.comm is None or addr is None:
+            return None
+        for i, r in enumerate(options.comm.ranks):
+            if r.address == addr:
+                return i
+        return None
 
     def _task_context(self, task: _CallTask, peer=None, attempts=None) -> dict:
         """Structured ACCLError context for a failed call (op, comm, peer,
@@ -551,6 +751,11 @@ class EmuEngine(BaseEngine):
         active: List[_CallTask] = []
         while not self._stop:
             while True:
+                # cutover purges strictly precede any intake item
+                # queued after them (the marker is appended before the
+                # facade returns from _apply_cutover, hence before its
+                # first post-shrink collective is queued)
+                self._drain_membership_cutovers()
                 item = self._queue.pop(timeout=0)
                 if item is None:
                     break
@@ -566,6 +771,21 @@ class EmuEngine(BaseEngine):
                         context=verdict_context(verdict, options.op.name),
                     )
                     continue
+                mv = self.membership
+                if (
+                    mv is not None and mv.self_evicted
+                    and options.op in _COMM_OPS and options.comm is not None
+                ):
+                    # this rank was voted out of the group: every comm
+                    # op fails fast with the agreement evidence (local
+                    # copy/combine/config keep working)
+                    req.complete(ErrorCode.RANK_EVICTED, 0, context={
+                        "op": options.op.name,
+                        "comm": options.comm.id,
+                        "membership": mv.evidence(),
+                        "elapsed_s": 0.0,
+                    })
+                    continue
                 dead = self._dead_peer_for(options)
                 if dead is not None:
                     # fail fast: the peer is already known dead — don't
@@ -576,12 +796,31 @@ class EmuEngine(BaseEngine):
                         if options.op == Operation.RECV
                         else ErrorCode.SEND_TIMEOUT
                     )
+                    code, extra = self._membership_failure(
+                        options, rank_d, code
+                    )
                     h = self._health.get(addr, {})
-                    req.complete(code, 0, context={
+                    req.complete(code, 0, context=dict({
                         "op": options.op.name,
                         "comm": options.comm.id,
                         "peer": addr,
                         "attempts": h.get("failures", 0),
+                        "elapsed_s": 0.0,
+                    }, **extra))
+                    continue
+                evicted = (
+                    self._evicted_peer_for(options)
+                    if mv is not None else None
+                )
+                if evicted is not None:
+                    # the surviving majority agreed this peer is out
+                    # (possibly before local health caught up): the
+                    # structured terminal, carrying the evidence
+                    req.complete(ErrorCode.RANK_EVICTED, 0, context={
+                        "op": options.op.name,
+                        "comm": options.comm.id,
+                        "peer": options.comm.ranks[evicted].address,
+                        "membership": mv.evidence(),
                         "elapsed_s": 0.0,
                     })
                     continue
@@ -611,6 +850,35 @@ class EmuEngine(BaseEngine):
                     )
                     active.remove(task)
 
+            mv = self.membership
+            if mv is not None and active and (
+                mv.cutover_ready() or mv.self_evicted
+            ):
+                # a confirmed eviction landed while calls are in
+                # flight: work addressing the evicted rank can never
+                # complete — fail it fast with the agreement evidence
+                # instead of letting each call burn its deadline
+                for task in list(active):
+                    if task.options is None:
+                        continue
+                    hit = (
+                        mv.self_evicted
+                        and task.options.op in _COMM_OPS
+                        and task.options.comm is not None
+                    ) or self._evicted_peer_for(task.options) is not None
+                    if not hit:
+                        continue
+                    task.gen.close()
+                    task.request.complete(
+                        ErrorCode.RANK_EVICTED,
+                        time.perf_counter_ns() - task.started_ns,
+                        context=dict(
+                            self._task_context(task),
+                            membership=mv.evidence(),
+                        ),
+                    )
+                    active.remove(task)
+
             progressed = False
             now = time.monotonic()
             for task in list(active):
@@ -622,10 +890,19 @@ class EmuEngine(BaseEngine):
                             peer = getattr(task.cond, "peer_addr", None)
                             if peer is not None:
                                 self._health_note(peer, "timeout")
+                            code = task.cond.timeout_code
+                            ctx = self._task_context(task, peer=peer)
+                            peer_rank = self._rank_of_address(
+                                task.options, peer
+                            )
+                            code, extra = self._membership_failure(
+                                task.options, peer_rank, code
+                            )
+                            ctx.update(extra)
                             task.request.complete(
-                                task.cond.timeout_code,
+                                code,
                                 time.perf_counter_ns() - task.started_ns,
-                                context=self._task_context(task, peer=peer),
+                                context=ctx,
                             )
                             active.remove(task)
                             progressed = True
@@ -643,13 +920,22 @@ class EmuEngine(BaseEngine):
                     progressed = True
                 except PeerDeadError as dead_exc:
                     # a send hit a dead/detached endpoint: fast, diagnosable
-                    # SEND_TIMEOUT (the silent-drop fix of fabric.py:222)
-                    task.request.complete(
-                        ErrorCode.SEND_TIMEOUT,
-                        time.perf_counter_ns() - task.started_ns,
-                        context=self._task_context(
-                            task, peer=dead_exc.address
+                    # SEND_TIMEOUT (the silent-drop fix of fabric.py:222) —
+                    # or RANK_EVICTED when the group already agreed the
+                    # peer is out (membership plane)
+                    ctx = self._task_context(task, peer=dead_exc.address)
+                    code, extra = self._membership_failure(
+                        task.options,
+                        self._rank_of_address(
+                            task.options, dead_exc.address
                         ),
+                        ErrorCode.SEND_TIMEOUT,
+                    )
+                    ctx.update(extra)
+                    task.request.complete(
+                        code,
+                        time.perf_counter_ns() - task.started_ns,
+                        context=ctx,
                     )
                     active.remove(task)
                     progressed = True
@@ -691,6 +977,12 @@ class EmuEngine(BaseEngine):
                 self._retrans.clear()
                 self._ledger.clear()
                 self._health.clear()
+                # membership restore rides soft_reset: the stale-frame
+                # fence floors belong to the pre-reset epochs (runs on
+                # the scheduler thread, like the rest of the flush)
+                self._mbr_floor.clear()
+                with self._notif_lock:
+                    self._mbr_cutovers.clear()
         elif fn == ConfigFunction.ENABLE_TRANSPORT:
             self.transport_enabled = True
         elif fn == ConfigFunction.SET_RETRY_LIMIT:

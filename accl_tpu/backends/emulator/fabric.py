@@ -53,6 +53,9 @@ class MsgType(enum.IntEnum):
     VERIFY = 7  # contract-plane verdict relay (JSON payload): a rank
     # that convicted a divergence tells its peers so their in-flight
     # calls fail fast too instead of waiting out the engine deadline
+    MEMBER = 8  # membership-plane agreement frame (JSON payload): the
+    # shrink protocol's propose/confirm exchange on one-process-per-
+    # rank fabrics (board-anchored tiers exchange in process instead)
 
 
 @dataclasses.dataclass
@@ -84,6 +87,13 @@ class Message:
     # skw_window -1 = no stamp (monitor off or no window completed).
     skw_window: int = -1
     skw_mean_us: float = 0.0
+    # membership plane (accl_tpu.membership): the sender's membership
+    # EPOCH — globally aligned by the eviction agreement (unlike the
+    # process-local communicator epochs), so receivers can discard
+    # stale pre-shrink frames still in flight at cutover (seqn matching
+    # ignores epochs; a stale chunk of the aborted collective would
+    # otherwise corrupt the first post-shrink collective's receives)
+    mbr: int = 0
     # send wall-timestamp (time_ns; 0 = unstamped): receivers measure
     # per-source arrival latency from it — the straggler analyzer's
     # direct observable of a slow sender/link.  Wall clock because it
@@ -112,6 +122,9 @@ class Endpoint:
         # monitor plane: the receiving rank's skew hook — observes
         # peers' piggybacked straggler-window claims the same way
         self.skew_hook: Optional[Callable[[Message], None]] = None
+        # membership plane: the receiving rank's agreement hook —
+        # observes MEMBER propose/confirm frames at delivery
+        self.membership_hook: Optional[Callable[[Message], None]] = None
         # wire-integrity accounting: payloads whose crc32 no longer matches
         # the stamped csum are discarded here (the rx dataplane's bit-error
         # detection; the sender's retransmit protocol recovers them)
@@ -143,6 +156,14 @@ class Endpoint:
         ):
             try:
                 hook(msg)  # a verifier failure must never drop traffic
+            except Exception:  # pragma: no cover - defensive
+                pass
+        mhook = self.membership_hook
+        if mhook is not None and msg.msg_type == MsgType.MEMBER:
+            # after the csum guard like the contract hook: a corrupt
+            # frame must never be consumed as an agreement vote
+            try:
+                mhook(msg)
             except Exception:  # pragma: no cover - defensive
                 pass
         shook = self.skew_hook

@@ -270,6 +270,28 @@ class NativeEngine(BaseEngine):
             else:
                 req.complete(ErrorCode.CONFIG_ERROR)
             return req
+        mv = self.membership
+        if (
+            mv is not None and mv.self_evicted
+            and options.comm is not None
+            and options.op not in (
+                Operation.CONFIG, Operation.NOP, Operation.COPY,
+                Operation.COMBINE,
+            )
+        ):
+            # membership plane: a rank voted out of the group fails its
+            # comm ops fast at intake with the agreement evidence — the
+            # C dataplane cannot consult the Python view mid-call, so
+            # the screen sits here, like the facade's intake screen
+            req = Request(op_name=options.op.name)
+            req.mark_executing()
+            req.complete(ErrorCode.RANK_EVICTED, 0, context={
+                "op": options.op.name,
+                "comm": options.comm.id,
+                "membership": mv.evidence(),
+                "elapsed_s": 0.0,
+            })
+            return req
         args = _CallArgs()
         args.op = int(options.op)
         args.cfg_function = int(options.cfg_function)

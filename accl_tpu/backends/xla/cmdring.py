@@ -75,9 +75,19 @@ from ...constants import (
     Operation,
     dtype_to_numpy,
 )
+from ...membership import CircuitBreaker
 from ...overlap import drain_deadline_s
 
 _F = CMDRING_FIELDS
+
+#: ring-session circuit breaker (membership plane): window failures
+#: against a dying peer strike the per-comm breaker; OPEN degrades the
+#: comm's dispatch ring -> host (counted ``circuit_open``), HALF_OPEN
+#: re-probes with an INLINE window (one-shot program, no persistent
+#: run to wedge) after the cool-down, success restores the ring.
+CMDRING_BREAKER_COOLDOWN_ENV = "ACCL_CMDRING_COOLDOWN_S"
+CMDRING_BREAKER_COOLDOWN_S = 2.0
+CMDRING_BREAKER_THRESHOLD = 2
 
 #: ops whose operand/result widths scale with world size ('P' slots)
 _P_WIDE = (Operation.REDUCE_SCATTER, Operation.ALLTOALL)
@@ -331,6 +341,18 @@ class GangCommandRing:
         self.last_window = 0
         self.op_slots: Dict[str, int] = {}  # per-opcode residency
         self.fallbacks: Dict[str, int] = {}
+        # per-comm ring circuit breakers (membership plane): window
+        # failures degrade that comm's dispatch ring -> inline -> host,
+        # re-probing after a cool-down — a dying peer no longer needs a
+        # full soft_reset to get the ring back
+        try:
+            cooldown = float(os.environ.get(
+                CMDRING_BREAKER_COOLDOWN_ENV, CMDRING_BREAKER_COOLDOWN_S
+            ))
+        except ValueError:
+            cooldown = CMDRING_BREAKER_COOLDOWN_S
+        self.breaker_cooldown_s = cooldown
+        self._breakers: Dict[int, CircuitBreaker] = {}
 
     # -- introspection -------------------------------------------------------
     def supports(self, op) -> bool:
@@ -375,6 +397,7 @@ class GangCommandRing:
             )
 
     def stats(self) -> dict:
+        breakers = self._breaker_snapshots()
         with self._lock:
             resident = any(
                 s.run is not None and s.run.mbox.accepting
@@ -414,12 +437,32 @@ class GangCommandRing:
                 ) if self.dispatches else 0.0,
                 "ops": dict(self.op_slots),
                 "fallbacks": dict(self.fallbacks),
+                "breakers": breakers,
             }
+
+    def _breaker_snapshots(self) -> dict:
+        with self._lock:
+            items = list(self._breakers.items())
+        # breaker locks taken OUTSIDE the ring lock (leaf discipline)
+        return {str(c): brk.snapshot() for c, brk in items}
 
     def _fallback(self, reason: str) -> bool:
         with self._lock:
             self.fallbacks[reason] = self.fallbacks.get(reason, 0) + 1
         return False
+
+    def breaker_for(self, comm_id: int) -> CircuitBreaker:
+        """The comm's ring circuit breaker (membership plane): strikes
+        on window failures, degrades ring -> inline -> host, re-probes
+        after the cool-down."""
+        with self._lock:
+            brk = self._breakers.get(comm_id)
+            if brk is None:
+                brk = self._breakers[comm_id] = CircuitBreaker(
+                    threshold=CMDRING_BREAKER_THRESHOLD,
+                    cooldown_s=self.breaker_cooldown_s,
+                )
+            return brk
 
     # -- teardown ------------------------------------------------------------
     def reset(self) -> None:
@@ -434,6 +477,7 @@ class GangCommandRing:
             self._sessions.clear()
             self._inflight_windows = 0
             self.resets += 1
+            self._breakers.clear()  # full recovery re-closes the ring
             self._drained_runs.extend(runs)
         for run in runs:
             run.mbox.halt()
@@ -544,6 +588,15 @@ class GangCommandRing:
         mesh = gang.submesh(comm)
         if mesh is None or npos == 0:
             return False
+        # ring circuit breaker (membership plane): an OPEN comm rides
+        # host dispatch until the cool-down; HALF_OPEN probes with the
+        # inline window form (no persistent run to wedge on a dying
+        # peer); a probe success restores the ring
+        brk = self.breaker_for(comm.id)
+        verdict = brk.allow()
+        if verdict == CircuitBreaker.OPEN:
+            return self._fallback("circuit_open")
+        probe = verdict == "probe"
         # explicit algorithm registers (global or per-call TuningPlan
         # overlay) selecting a non-XLA lowering keep their meaning: the
         # ring is its own lowering and must not shadow a requested one
@@ -632,7 +685,7 @@ class GangCommandRing:
             ]
             try:
                 self._dispatch_window(
-                    comm, mesh, window, reqs_per_slot, t0
+                    comm, mesh, window, reqs_per_slot, t0, probe=probe
                 )
             except Exception:
                 # this window's dispatch failed: fail ITS slots and the
@@ -642,6 +695,7 @@ class GangCommandRing:
                 import traceback
 
                 traceback.print_exc()
+                brk.record_failure("dispatch_error")
                 dt = time.perf_counter_ns() - t0
                 for i in range(lo, npos):
                     for e in entries:
@@ -787,7 +841,7 @@ class GangCommandRing:
 
     # -- dispatch ------------------------------------------------------------
     def _dispatch_window(self, comm, mesh, window, reqs_per_slot,
-                         t0) -> None:
+                         t0, probe: bool = False) -> None:
         gang = self.gang
         n = len(window)
         shape = self._window_shape(comm, window)
@@ -853,7 +907,10 @@ class GangCommandRing:
                     # (zero-copy operands, async dispatch, no mailbox
                     # round trip on its latency path).
                     streaming = len(session.parks) > 1
-                if live or streaming:
+                if (live or streaming) and not probe:
+                    # (a half-open probe window stays INLINE — the
+                    # ring -> inline degradation step: one-shot
+                    # program, no persistent run to wedge)
                     payload = self._payload_rows(comm, window, shape)
                     run = self._post_or_dispatch(
                         comm, mesh, session, shape, window_id, slots_np,
@@ -1147,6 +1204,10 @@ class GangCommandRing:
             sv = park.status
             dt = max(ready_ns - t0, 1)
             window_done()
+            # a completed window closes (or restores) the comm's ring
+            # circuit breaker — per-slot BAD_OP retcodes are opcode
+            # errors, not transport failures, and don't strike
+            self.breaker_for(comm.id).success()
             for i, slot_reqs in enumerate(park.reqs_per_slot):
                 code = (
                     ErrorCode.OK
@@ -1165,6 +1226,13 @@ class GangCommandRing:
         def on_error(exc, park=park, run=run, t0=t0, comm_id=comm.id):
             dt = max(time.perf_counter_ns() - t0, 1)
             window_done()
+            # window failure (run latch, drain deadline, dispatch
+            # error): strike the comm's ring breaker — repeated strikes
+            # open it and the comm degrades to host dispatch until the
+            # cool-down probe
+            self.breaker_for(comm_id).record_failure(
+                type(exc).__name__
+            )
             # tear down the run THIS window rode (an inline window rode
             # none) — never whatever run the session points at now,
             # which may be a healthy successor serving later windows.

@@ -471,16 +471,42 @@ class XLAGangContext:
 
     _DEAD_AFTER_TIMEOUTS = 2
 
+    def add_health_listener(self, fn) -> None:
+        """Register a health-transition listener ``fn(session, old,
+        new)`` — the membership plane's hook onto the slot-watchdog
+        accounting (one per rank handle; each facade records the edge
+        and, under elastic membership, proposes eviction on ``dead``)."""
+        listeners = getattr(self, "_health_listeners", None)
+        if listeners is None:
+            listeners = self._health_listeners = []
+        if fn not in listeners:
+            listeners.append(fn)
+
+    def remove_health_listener(self, fn) -> None:
+        """Deregister (engine deinit): the gang outlives individual
+        rank handles, and a dead handle's listener must not keep
+        firing — or pin the handle — for the gang's lifetime."""
+        listeners = getattr(self, "_health_listeners", None)
+        if listeners is not None and fn in listeners:
+            listeners.remove(fn)
+
     def _health_note_absent(self, session: int) -> None:
         h = self.health.setdefault(
             session,
             {"state": "ok", "timeouts": 0, "failures": 0, "last_event": ""},
         )
+        old = h["state"]
         h["timeouts"] += 1
         h["last_event"] = "gang_timeout"
         h["state"] = (
             "dead" if h["timeouts"] >= self._DEAD_AFTER_TIMEOUTS else "suspect"
         )
+        if h["state"] != old:
+            for fn in getattr(self, "_health_listeners", ()):
+                try:
+                    fn(session, old, h["state"])
+                except Exception:  # a listener must never fail the gang
+                    pass
 
     def dead_rank_in(self, comm: Communicator) -> Optional[int]:
         """Comm-relative rank of a member already marked dead (excluding
@@ -1975,6 +2001,49 @@ class XLAEngine(StreamPortMixin, BaseEngine):
             timeout if timeout is not None
             else drain_deadline_s(self.gang.timeout_s)
         )
+
+    # -- membership plane (accl_tpu.membership) ------------------------------
+    def set_membership(self, view) -> None:
+        """Arm (or with ``None`` disarm) the membership plane: the
+        gang's slot-watchdog health transitions forward to the facade
+        hook (the board does the agreement exchange — every gang rank
+        handle shares the anchor).  Disarm removes the forwarder from
+        the shared gang — it must not keep firing (or pin this engine)
+        for the gang's lifetime across handle churn."""
+        self.membership = view
+        fwd = getattr(self, "_mbr_fwd", None)
+        if view is None:
+            if fwd is not None:
+                self.gang.remove_health_listener(fwd)
+                self._mbr_fwd = None
+            return
+        if fwd is None:
+
+            def fwd(session, old, new, eng=self):
+                hook = eng.on_health_transition
+                if hook is not None:
+                    hook(session, old, new)
+
+            self._mbr_fwd = fwd
+            self.gang.add_health_listener(fwd)
+
+    def on_membership_cutover(self, plan: dict, addresses: tuple = (),
+                              comm_ids: tuple = ()) -> None:
+        """Post-shrink session re-arm: halt the command ring's
+        persistent runs and abandon its per-comm sessions (they re-arm
+        lazily over the survivors at the next warm window — the
+        documented tear-down/re-arm), drop the evicted sessions'
+        watchdog entries, and clear the suspect strikes the failure
+        cascade accrued against survivors."""
+        for s in plan.get("evict", ()):
+            self.gang.health.pop(s, None)
+        # snapshot before iterating: the watchdog timer thread inserts
+        # concurrently, and a bare .values() walk can raise mid-cutover
+        for h in list(self.gang.health.values()):
+            if h["state"] == "suspect":
+                h["state"] = "ok"
+                h["timeouts"] = 0
+        self.gang.cmdring.reset()
 
     def telemetry_report(self) -> dict:
         """Gang-tier counters for the telemetry snapshot: pending

@@ -61,6 +61,10 @@ class Communicator:
         # table, communicator.hpp:34-39, maintained by dma_mover.cpp:581-658.)
         self._outbound_seq: Dict[int, int] = {i: 0 for i in range(len(ranks))}
         self._inbound_seq: Dict[int, int] = {i: 0 for i in range(len(ranks))}
+        # membership plane (accl_tpu.membership): the pre-shrink
+        # membership stashed by shrink() so soft_reset can restore it
+        self._full_ranks: Optional[List[Rank]] = None
+        self._full_local: Optional[int] = None
 
     # -- introspection ------------------------------------------------------
     @property
@@ -100,6 +104,57 @@ class Communicator:
             for i in self._outbound_seq:
                 self._outbound_seq[i] = 0
                 self._inbound_seq[i] = 0
+
+    # -- membership plane (accl_tpu.membership) ------------------------------
+    def shrink(self, keep: Sequence[int]) -> Optional[Dict[int, int]]:
+        """Cut this communicator over IN PLACE to the surviving members
+        (``keep``: comm-relative ranks, ascending, local rank included)
+        — the elastic-membership cutover.  A fresh epoch starts (plan
+        caches and seqn dedup re-key instead of silently mis-bucketing)
+        and every per-peer sequence counter restarts at 0, like the
+        soft-reset realignment.  Returns the survivor-visible
+        translation table ``{old comm-relative rank -> new}`` so
+        callers re-key rank-indexed state; None when the local rank is
+        not among the survivors (the evicted side never shrinks — it is
+        out of the group entirely)."""
+        keep = sorted(set(int(k) for k in keep))
+        for k in keep:
+            if not 0 <= k < self.size:
+                raise ValueError(f"survivor rank {k} out of range")
+        with self._lock:
+            if self.local_rank not in keep:
+                return None
+            if self._full_ranks is None:
+                self._full_ranks = list(self.ranks)
+                self._full_local = self.local_rank
+            translation = {old: new for new, old in enumerate(keep)}
+            self.ranks = [self.ranks[k] for k in keep]
+            self.local_rank = translation[self.local_rank]
+            self.epoch = next(_comm_epochs)
+            self._outbound_seq = {i: 0 for i in range(len(self.ranks))}
+            self._inbound_seq = {i: 0 for i in range(len(self.ranks))}
+            return translation
+
+    def restore(self) -> bool:
+        """Undo every shrink: re-admit the full pre-shrink membership
+        (the soft_reset recovery path, collective by contract like the
+        reset itself).  Fresh epoch + zeroed sequence counters; False
+        when the communicator never shrank."""
+        with self._lock:
+            if self._full_ranks is None:
+                return False
+            self.ranks = list(self._full_ranks)
+            self.local_rank = int(self._full_local)
+            self._full_ranks = None
+            self._full_local = None
+            self.epoch = next(_comm_epochs)
+            self._outbound_seq = {i: 0 for i in range(len(self.ranks))}
+            self._inbound_seq = {i: 0 for i in range(len(self.ranks))}
+            return True
+
+    @property
+    def shrunk(self) -> bool:
+        return self._full_ranks is not None
 
     # -- derivation ---------------------------------------------------------
     def split(

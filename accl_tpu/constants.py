@@ -339,6 +339,11 @@ class ErrorCode(enum.IntFlag):
     # collective sequences — fail fast instead of letting the mismatch
     # surface as a timeout N calls later
     CONTRACT_VIOLATION = 1 << 22
+    # membership plane (accl_tpu.membership): the call addressed (or
+    # belongs to) a rank the surviving majority agreed to evict — the
+    # structured terminal code for in-flight work against a dead
+    # member, carrying the agreement evidence in ACCLError.details
+    RANK_EVICTED = 1 << 23
 
     @staticmethod
     def describe(code: "ErrorCode") -> str:

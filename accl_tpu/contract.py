@@ -62,6 +62,7 @@ __all__ = [
     "env_enabled",
     "env_interval",
     "install_fault_plan",
+    "kv_digest_exchange",
     "roll_digest",
 ]
 
@@ -898,6 +899,30 @@ class ContractVerifier:
             )
             st.digest = roll_digest(st.digest, fp)
 
+    def shrink_comm(self, comm_id: int, local_rank: int,
+                    sessions: tuple, membership_epoch: int) -> None:
+        """Membership-plane cutover (``accl_tpu.membership``): fold a
+        ``__shrink__`` marker into the CONTINUOUS digest stream — the
+        ``__begin__`` discipline applied to eviction — and re-register
+        the shrunk membership (new comm-relative local rank + rank ->
+        session map).  A rank that missed the cutover keeps digesting
+        the old membership and diverges at the next window boundary:
+        one window of delay instead of a silent hang.  Pre-shrink wire
+        claims are dropped — their src ranks live in the old rank
+        space."""
+        with self._lock:
+            st = self._comm_state(comm_id)
+            st.local_rank = int(local_rank)
+            st.sessions = tuple(sessions)
+            st.size = len(st.sessions)
+            fp = call_fingerprint(
+                "__shrink__", comm_id, self.generation, None,
+                len(sessions), membership_epoch, 0, st.calls,
+            )
+            st.digest = roll_digest(st.digest, fp)
+            st.claims.clear()
+            st.pending_relays.clear()
+
     def reset(self) -> None:
         """soft_reset recovery: drop every verdict, digest and claim and
         start a new generation (collective by contract, so generations
@@ -939,6 +964,66 @@ class ContractVerifier:
                     for c, st in self._comms.items()
                 },
             }
+
+
+def kv_digest_exchange(kv, verifier: "ContractVerifier", comm_id: int,
+                       local_rank: int, size: int,
+                       state: Optional[dict] = None,
+                       is_notfound=None) -> dict:
+    """Piggyback the verifier's rolling digest onto a distributed KV
+    plane — the dist tier's exchange path (the PR 7 deferral): post
+    this rank's latest completed window digest under
+    ``accl/vfy/<comm>/<gen>/<window>/<rank>`` and compare every peer's
+    posted digest via :meth:`ContractVerifier.observe_claim`, so
+    cross-host divergence fails fast exactly like in-process.
+
+    ``kv`` needs ``key_value_set_bytes(key, bytes)`` and
+    ``key_value_try_get_bytes(key) -> bytes|None`` (the compat-wrapped
+    jax KV client surface); ``state`` carries the per-comm cursor
+    (``{"posted": window, "checked": {peer: window}}``) between calls
+    so warm calls cost one stamp read.  Missing peer keys (a rank
+    behind us) are skipped — ``is_notfound(exc)`` classifies raisy KV
+    clients.  Returns counter deltas for telemetry.  Stdlib-only so
+    the exchange is unit-testable without jax (a dict-backed fake KV).
+    """
+    out = {"posted": 0, "claims": 0, "errors": 0}
+    gen, window, digest = verifier.stamp(comm_id)
+    if window < 0:
+        return out
+    st = state if state is not None else {}
+    base = f"accl/vfy/{comm_id}/{gen}"
+    if st.get("posted") != (gen, window):
+        try:
+            kv.key_value_set_bytes(
+                f"{base}/{window}/{local_rank}", str(digest).encode()
+            )
+            st["posted"] = (gen, window)
+            out["posted"] = 1
+        except Exception:
+            out["errors"] += 1
+            return out  # the KV is unreachable: nothing to compare
+    checked = st.setdefault("checked", {})
+    for peer in range(size):
+        if peer == local_rank or checked.get(peer) == (gen, window):
+            continue
+        try:
+            raw = kv.key_value_try_get_bytes(f"{base}/{window}/{peer}")
+        except Exception as e:
+            if is_notfound is not None and is_notfound(e):
+                continue  # peer hasn't completed this window yet
+            out["errors"] += 1
+            continue
+        if raw is None:
+            continue
+        try:
+            theirs = int(raw)
+        except ValueError:
+            out["errors"] += 1
+            continue
+        checked[peer] = (gen, window)
+        out["claims"] += 1
+        verifier.observe_claim(comm_id, peer, gen, window, theirs)
+    return out
 
 
 def verdict_context(verdict: dict, op: Optional[str] = None) -> dict:

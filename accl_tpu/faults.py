@@ -234,6 +234,13 @@ class FaultInjector:
         with self._lock:
             return (None, rank) in self._dead or (comm_id, rank) in self._dead
 
+    def dead_ranks(self) -> List[int]:
+        """Ranks currently killed by standing ``kill_rank`` state (any
+        comm scope) — the membership plane's chaos-evidence query: a
+        seeded plan's eviction set is reproducible from it."""
+        with self._lock:
+            return sorted({r for (_scope, r) in self._dead})
+
     def clear(self) -> None:
         """Heal the network: deactivate kills/partitions and stop firing
         rules (counters keep their history for inspection)."""
@@ -396,6 +403,67 @@ class FaultInjector:
                 "events": len(self.log),
                 "dead": sorted(self._dead),
                 "partitions": len(self._partitions),
+            }
+
+
+#: the peer-health state machine's vocabulary (PR 2's ok/suspect/dead
+#: plus the membership plane's acting states) — transition EDGES over
+#: these states are what HealthTransitions records
+HEALTH_STATES = ("ok", "suspect", "dead", "demoted", "evicted", "restored")
+
+#: bounded health-event ring capacity (telemetry_snapshot()
+#: ["health_events"]["events"])
+_HEALTH_EVENT_CAP = 128
+
+
+class HealthTransitions:
+    """Bounded record of health-map state *transitions* — the
+    flap-visibility satellite: the instantaneous health map cannot show
+    an ok→suspect→ok flap that self-clears between scrapes, so every
+    edge is counted (``accl_health_transitions_total{peer,from,to}``)
+    and the last N edges ride a bounded event ring into
+    ``telemetry_snapshot()["health_events"]``.
+
+    Fed by the engines' health accounting (emulator ``_health_note``,
+    the XLA gang slot watchdog) via the facade's transition hook, plus
+    the membership plane's demoted/evicted/restored edges.  Thread-safe
+    and allocation-light — the hook runs on engine scheduler threads.
+    """
+
+    def __init__(self, capacity: int = _HEALTH_EVENT_CAP):
+        self.capacity = max(8, int(capacity))
+        self._lock = threading.Lock()
+        self._counters: Dict[tuple, int] = {}  # (peer, from, to) -> n
+        self._events: List[dict] = []
+        self.total = 0
+
+    def note(self, peer, old: str, new: str) -> None:
+        if old == new:
+            return
+        import time as _time
+
+        with self._lock:
+            key = (str(peer), str(old), str(new))
+            self._counters[key] = self._counters.get(key, 0) + 1
+            self.total += 1
+            self._events.append({
+                "peer": str(peer),
+                "from": str(old),
+                "to": str(new),
+                "mono_ns": _time.perf_counter_ns(),
+            })
+            if len(self._events) > self.capacity:
+                self._events.pop(0)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "transitions_total": self.total,
+                "counters": {
+                    "|".join(k): v
+                    for k, v in sorted(self._counters.items())
+                },
+                "events": [dict(e) for e in self._events],
             }
 
 

@@ -1,0 +1,882 @@
+"""The membership plane: self-healing communicators.
+
+The sensing machinery landed across the earlier robustness PRs — the
+per-peer health state machine (PR 2), the contract plane's cross-rank
+exchange paths (PR 7), the straggler judge (PR 8).  All of it *reports*:
+a ``dead`` verdict makes every later collective fail fast at intake —
+correct, loud, and terminal.  This module is the acting half: a
+long-lived fabric that **shrinks the communicator and keeps serving**
+when a rank dies, and **routes around** ranks the straggler judge
+convicts instead of only reporting them.
+
+Three coupled pieces:
+
+* **Shrink protocol** (:class:`MembershipView` + :class:`MembershipBoard`)
+  — on a ``dead`` health verdict (or an explicit ``ACCL.evict_rank()``)
+  the surviving ranks run a bounded three-phase agreement over the
+  contract plane's exchange paths (shared board on InProc/gang anchors,
+  ``MEMBER`` wire frames on the socket tier):
+
+  1. **propose** — the observing rank votes an eviction set (world
+     sessions);
+  2. **confirm** — peers *second* the proposal (a rank with no
+     conflicting evidence adds its vote; votes from ranks inside the
+     eviction set never count); a strict majority of the would-be
+     survivors confirms the plan;
+  3. **cutover** — each survivor atomically applies the confirmed plan
+     at its next call boundary: drain the in-flight window, shrink
+     every affected communicator to the survivors (fresh epoch — plans
+     and tuning overlays re-key instead of silently mis-bucketing),
+     fold a ``__shrink__`` marker into the contract digest stream (the
+     PR 7 ``__begin__`` discipline: a rank that missed the cutover
+     diverges within one window instead of hanging), and tear down /
+     re-arm engine sessions over the survivors.
+
+  Collectives in flight against the evicted rank complete with
+  structured ``ErrorCode.RANK_EVICTED`` carrying the agreement
+  evidence; collectives issued after cutover just run at the new world
+  size.  ``soft_reset`` (collective, after the operator heals the
+  fabric) restores full membership.
+
+* **Straggler demotion** (:class:`DemotionLedger`) — a convicted
+  ``slow_rank`` (PR 8: two-window arrival-skew dominance, exchanged
+  cross-rank) is *demoted*: kept in the communicator, excluded from
+  root/relay roles where topology allows (today: the barrier's
+  internal gather root, plus the advisory ``ACCL.suggest_root()``),
+  behind a circuit breaker (strike → open/demoted → half-open probe →
+  restore) timed on the monotonic clock.  Demotion decisions are
+  SPMD-uniform by construction: they derive from the *exchanged*
+  verdict (the shared judge on board-anchored tiers), never from local
+  observation, and every per-call decision is latched per (comm, call
+  index) on the shared ledger — the first rank to a call index decides,
+  every other rank reads the same decision (the sequencer-mailbox
+  discipline).  On wire tiers, whose straggler verdicts are pairwise
+  (correct only on the conforming side), demotion never alters routing
+  — verdicts stay operator signals there.
+
+* **Circuit breaker** (:class:`CircuitBreaker`) — the shared
+  strike/open/half-open/closed machine, also used by the XLA command
+  ring to degrade ring → inline → host dispatch per communicator when
+  sequencer windows fail against a dying peer, re-probing after a
+  cool-down (``backends/xla/cmdring.py``).
+
+Opt-in: the *acting* behaviors (shrink, demotion routing) arm via
+``ACCL_ELASTIC=1`` or ``ACCL.set_elastic(True)``; the sensing surface
+(health transition events, the membership snapshot) is always on.
+Everything here is monotonic-clock timed and every wait is bounded
+(acclint: unbounded-wait, timer-discipline).
+
+Zero dependencies (stdlib only): this module joins the jax-free import
+closure next to ``faults``/``contract``/``monitor`` and is
+machine-checked by acclint's jax-free-module pass.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, FrozenSet, List, Optional, Set
+
+from .analysis.markers import spmd_uniform
+from .contract import anchored
+
+__all__ = [
+    "CircuitBreaker",
+    "DemotionLedger",
+    "ELASTIC_ENV",
+    "MembershipBoard",
+    "MembershipView",
+    "board_for",
+    "env_elastic",
+    "ledger_for",
+]
+
+ELASTIC_ENV = "ACCL_ELASTIC"
+DEMOTE_COOLDOWN_ENV = "ACCL_DEMOTE_COOLDOWN_S"
+EVICT_CONFIRM_ENV = "ACCL_EVICT_CONFIRM_S"
+
+DEFAULT_DEMOTE_COOLDOWN_S = 30.0
+DEFAULT_EVICT_CONFIRM_S = 5.0
+
+#: cutover records retained per view (the eviction history the
+#: determinism test replays)
+_HISTORY_CAP = 32
+#: latched per-(comm, seq) demotion decisions retained on the ledger
+_DECISION_CAP = 256
+
+
+def env_elastic(environ=None) -> bool:
+    """The ``ACCL_ELASTIC`` opt-in (read at ACCL-handle construction):
+    arms the acting half — communicator shrink on dead verdicts and
+    straggler demotion routing."""
+    return (environ or os.environ).get(ELASTIC_ENV, "0") not in ("0", "")
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def env_confirm_s() -> float:
+    """How long a failed collective waits for eviction confirmation
+    before surfacing its raw timeout (bounded — the shrink deadline)."""
+    return max(0.1, _env_float(EVICT_CONFIRM_ENV, DEFAULT_EVICT_CONFIRM_S))
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+
+
+class CircuitBreaker:
+    """Strike / open / half-open / closed, monotonic-clock timed.
+
+    * CLOSED — healthy; ``record_failure`` counts strikes and opens the
+      breaker at ``threshold``.
+    * OPEN — degraded; ``allow()`` answers ``"open"`` until
+      ``cooldown_s`` elapses, then flips to HALF_OPEN.
+    * HALF_OPEN — probing; ``allow()`` answers ``"probe"``.
+      ``success()`` restores (CLOSED, strikes reset); ``record_failure``
+      re-opens with a fresh cool-down.
+
+    Thread-safe; the clock is injectable for deterministic tests.
+    """
+
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+    def __init__(self, threshold: int = 2, cooldown_s: float = 5.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.threshold = max(1, int(threshold))
+        self.cooldown_s = float(cooldown_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.state = self.CLOSED
+        self.strikes = 0
+        self.opened_at: Optional[float] = None
+        self.opens_total = 0
+        self.restores_total = 0
+        self.reasons: Dict[str, int] = {}
+
+    def allow(self) -> str:
+        """``"closed"`` / ``"probe"`` / ``"open"`` — the routing verdict
+        for the next unit of work (a window, a root role)."""
+        with self._lock:
+            if self.state == self.OPEN:
+                if (
+                    self.opened_at is not None
+                    and self._clock() - self.opened_at >= self.cooldown_s
+                ):
+                    self.state = self.HALF_OPEN
+            if self.state == self.CLOSED:
+                return self.CLOSED
+            return "probe" if self.state == self.HALF_OPEN else self.OPEN
+
+    def record_failure(self, reason: str = "failure") -> bool:
+        """One strike; True when this strike opened (or re-opened) the
+        breaker."""
+        with self._lock:
+            self.reasons[reason] = self.reasons.get(reason, 0) + 1
+            self.strikes += 1
+            if self.state == self.HALF_OPEN or (
+                self.state == self.CLOSED and self.strikes >= self.threshold
+            ):
+                self.state = self.OPEN
+                self.opened_at = self._clock()
+                self.opens_total += 1
+                return True
+            if self.state == self.OPEN:
+                self.opened_at = self._clock()  # extend the cool-down
+            return False
+
+    def success(self) -> bool:
+        """A probe (or closed-path unit) succeeded; True when this
+        restored a half-open breaker to CLOSED."""
+        with self._lock:
+            restored = self.state == self.HALF_OPEN
+            if restored:
+                self.restores_total += 1
+            if self.state != self.CLOSED:
+                self.state = self.CLOSED
+            self.strikes = 0
+            self.opened_at = None
+            return restored
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "state": self.state,
+                "strikes": self.strikes,
+                "threshold": self.threshold,
+                "cooldown_s": self.cooldown_s,
+                "opens_total": self.opens_total,
+                "restores_total": self.restores_total,
+                "reasons": dict(self.reasons),
+            }
+
+
+# ---------------------------------------------------------------------------
+# the shared agreement board (InProc fabric / XLA gang anchors)
+# ---------------------------------------------------------------------------
+
+
+def board_for(anchor) -> Optional["MembershipBoard"]:
+    """The :class:`MembershipBoard` shared by every rank handle anchored
+    on ``anchor`` (the engine's ``contract_anchor()`` — the same anchor
+    discipline as the contract board); None on one-process-per-rank
+    tiers, where ``MEMBER`` wire frames do the exchanging."""
+    return anchored(anchor, "_accl_membership_board", MembershipBoard)
+
+
+def ledger_for(anchor) -> Optional["DemotionLedger"]:
+    """The shared :class:`DemotionLedger` for board-anchored tiers —
+    demotion routing decisions must come from ONE shared state machine
+    so every in-process rank reads the same verdict; None on wire
+    tiers, where demotion never alters routing."""
+    return anchored(anchor, "_accl_demotion_ledger", DemotionLedger)
+
+
+class MembershipBoard:
+    """Shared eviction-agreement state for rank handles in one process.
+
+    Votes are keyed ``(epoch, eviction set)``; a post that completes a
+    strict majority of the would-be survivors confirms the plan.
+    Listeners observe both proposals (so elastic peers can second) and
+    confirmations (so every handle cuts over).  Votes from ranks inside
+    the eviction set never count toward the majority.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # (epoch, frozenset(evict)) -> set(voting world ranks)
+        self._votes: Dict[tuple, Set[int]] = {}
+        self._plans: Dict[int, dict] = {}  # epoch -> confirmed plan
+        self._listeners: List[Callable[[dict], None]] = []
+
+    def add_listener(self, fn: Callable[[dict], None]) -> None:
+        with self._lock:
+            if fn not in self._listeners:
+                self._listeners.append(fn)
+
+    def remove_listener(self, fn) -> None:
+        with self._lock:
+            if fn in self._listeners:
+                self._listeners.remove(fn)
+
+    def standing(self, epoch: int) -> Optional[dict]:
+        with self._lock:
+            plan = self._plans.get(epoch)
+            return dict(plan) if plan is not None else None
+
+    def clear(self) -> None:
+        """Recovery (soft_reset restore): drop votes and plans."""
+        with self._lock:
+            self._votes.clear()
+            self._plans.clear()
+
+    def post(self, epoch: int, evict: FrozenSet[int], rank: int,
+             world: int,
+             excluded: FrozenSet[int] = frozenset()) -> Optional[dict]:
+        """One rank's vote for evicting ``evict`` (world sessions) at
+        membership ``epoch``.  ``excluded`` carries the sessions
+        evicted in EARLIER epochs: their votes never count and they
+        leave the survivor base — a second eviction's majority is over
+        the ranks actually still serving, matching the wire-mode tally
+        (views share one cumulative evicted set after cutover, so every
+        poster passes the same base).  Returns the confirmed plan once
+        a strict majority of survivors voted; notifies listeners of
+        both the proposal and (once) the confirmation — listeners are
+        called OUTSIDE the board lock."""
+        evict = frozenset(int(r) for r in evict)
+        excluded = frozenset(int(r) for r in excluded)
+        notify: List[tuple] = []
+        plan = None
+        with self._lock:
+            stand = self._plans.get(epoch)
+            if stand is not None:
+                return dict(stand)
+            if rank in evict or rank in excluded:
+                return None  # the condemned/evicted don't vote
+            votes = self._votes.setdefault((epoch, evict), set())
+            fresh = rank not in votes
+            votes.add(rank)
+            survivors = world - len(excluded | evict)
+            listeners = list(self._listeners)
+            if len(votes) * 2 > survivors:
+                plan = {
+                    "kind": "evict",
+                    "epoch": epoch,
+                    "evict": sorted(evict),
+                    "votes": sorted(votes),
+                    "world": world,
+                    "survivors": survivors,
+                    "basis": "board",
+                }
+                self._plans[epoch] = plan
+                notify.append(("confirmed", dict(plan)))
+            elif fresh:
+                notify.append(("propose", {
+                    "epoch": epoch, "evict": sorted(evict),
+                    "votes": sorted(votes), "world": world,
+                }))
+        for kind, payload in notify:
+            for fn in listeners:
+                try:
+                    fn(dict(payload, type=kind))
+                except Exception:  # a listener must never fail the vote
+                    pass
+        return dict(plan) if plan is not None else None
+
+
+# ---------------------------------------------------------------------------
+# straggler demotion (board tiers)
+# ---------------------------------------------------------------------------
+
+
+class DemotionLedger:
+    """Shared per-(comm, rank) demotion breakers plus the per-call
+    decision latch.  One instance serves every in-process rank handle
+    (board anchor), so the routing decision for call index ``seq`` is
+    computed exactly once and read identically by every rank — the
+    SPMD-uniformity the barrier-root re-route depends on."""
+
+    def __init__(self, cooldown_s: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.cooldown_s = (
+            cooldown_s if cooldown_s is not None
+            else _env_float(DEMOTE_COOLDOWN_ENV, DEFAULT_DEMOTE_COOLDOWN_S)
+        )
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._breakers: Dict[tuple, CircuitBreaker] = {}
+        self._decisions: Dict[tuple, dict] = {}
+        self._order: List[tuple] = []  # decision-insertion FIFO (gc)
+        self.demotions_total = 0
+        self.restores_total = 0
+        self.last_decision: Dict[int, dict] = {}  # comm -> latest
+
+    def candidates(self, comm_id: int) -> Set[int]:
+        """Ranks with demotion state on ``comm_id`` (for pre-computing
+        recovery evidence OUTSIDE the ledger lock)."""
+        with self._lock:
+            return {r for (c, r) in self._breakers if c == comm_id}
+
+    def decide(self, comm_id: int, world: int, seq: int,
+               slow: List[int], recovered: Dict[int, bool]) -> dict:
+        """The latched routing decision for call index ``seq`` on
+        ``comm_id``: first caller computes (possibly transitioning
+        breakers), every later caller reads the cached decision —
+        identical on every rank by construction.  ``slow`` is the
+        exchanged standing slow_rank verdict (shared judge);
+        ``recovered`` maps candidate rank -> "its skew recovered"
+        (pre-computed outside this lock)."""
+        key = (comm_id, seq)
+        with self._lock:
+            cached = self._decisions.get(key)
+            if cached is not None:
+                return dict(cached)
+            for r in slow:
+                brk = self._breakers.get((comm_id, r))
+                if brk is None:
+                    brk = self._breakers[(comm_id, r)] = CircuitBreaker(
+                        threshold=1, cooldown_s=self.cooldown_s,
+                        clock=self._clock,
+                    )
+                if brk.state == CircuitBreaker.CLOSED:
+                    brk.record_failure("slow_rank")
+                    self.demotions_total += 1
+            demoted: List[int] = []
+            restored: List[int] = []
+            for (c, r), brk in list(self._breakers.items()):
+                if c != comm_id:
+                    continue
+                verdict = brk.allow()
+                if verdict == CircuitBreaker.OPEN:
+                    demoted.append(r)
+                elif verdict == "probe":
+                    # re-admission gates on the RECOVERY evidence (the
+                    # judge's current EWMA back under the conviction
+                    # bar) — the standing verdict itself is cleared by
+                    # the caller on restore, so it cannot self-renew
+                    if recovered.get(r, False):
+                        brk.success()
+                        restored.append(r)
+                        self.restores_total += 1
+                        del self._breakers[(c, r)]
+                    else:
+                        brk.record_failure("still_slow")
+                        demoted.append(r)
+            demoted = sorted(set(demoted))
+            healthy = [r for r in range(world) if r not in demoted]
+            decision = {
+                "seq": seq,
+                "demoted": demoted,
+                "restored": sorted(restored),
+                # the re-routed relay/root role: lowest healthy rank
+                # (0 when nothing is demoted — the stock choice)
+                "root": healthy[0] if healthy else 0,
+            }
+            self._decisions[key] = decision
+            self._order.append(key)
+            while len(self._order) > _DECISION_CAP:
+                self._decisions.pop(self._order.pop(0), None)
+            self.last_decision[comm_id] = decision
+            return dict(decision)
+
+    def demoted(self, comm_id: int) -> List[int]:
+        """Currently-demoted ranks (OPEN breakers) on ``comm_id`` —
+        the advisory view (``suggest_root``); no transitions."""
+        with self._lock:
+            return sorted(
+                r for (c, r), brk in self._breakers.items()
+                if c == comm_id and brk.state != CircuitBreaker.CLOSED
+            )
+
+    def reset(self) -> None:
+        """soft_reset recovery: drop breakers and latched decisions."""
+        with self._lock:
+            self._breakers.clear()
+            self._decisions.clear()
+            self._order.clear()
+            self.last_decision.clear()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "cooldown_s": self.cooldown_s,
+                "demotions_total": self.demotions_total,
+                "restores_total": self.restores_total,
+                "breakers": {
+                    f"{c}/{r}": brk.snapshot()
+                    for (c, r), brk in sorted(self._breakers.items())
+                },
+                "last_decision": {
+                    str(c): dict(d)
+                    for c, d in sorted(self.last_decision.items())
+                },
+            }
+
+
+# ---------------------------------------------------------------------------
+# the per-handle view
+# ---------------------------------------------------------------------------
+
+
+class MembershipView:
+    """One rank handle's end of the membership plane.
+
+    Created by the ACCL facade unconditionally (sensing is always on);
+    the *acting* half — shrink, demotion routing — arms via
+    ``elastic`` (``ACCL_ELASTIC=1`` / ``ACCL.set_elastic``).  Exchange
+    rides the board when one exists (InProc / gang anchors) and
+    ``MEMBER`` wire frames otherwise (``send_fn``, wired by the
+    facade over the fabric).
+    """
+
+    def __init__(self, rank: int, world: int,
+                 board: Optional[MembershipBoard] = None,
+                 ledger: Optional[DemotionLedger] = None,
+                 send_fn: Optional[Callable[[dict, Set[int]], None]] = None):
+        self.rank = int(rank)       # world session of this handle
+        self.world = int(world)
+        self.board = board
+        self.ledger = ledger
+        self._send = send_fn
+        self.elastic = False
+        self._lock = threading.Lock()
+        self.epoch = 0
+        # wire-mode agreement state for the CURRENT epoch
+        self._votes: Dict[FrozenSet[int], Set[int]] = {}
+        self._own_vote: Optional[FrozenSet[int]] = None
+        self._announced = False
+        self._plan: Optional[dict] = None   # confirmed, not yet applied
+        self._confirmed = threading.Event()
+        self.evicted: Set[int] = set()      # cumulative evicted sessions
+        self.self_evicted = False
+        self.history: List[dict] = []       # bounded cutover records
+        self.proposals = 0
+        self.evictions_total = 0
+        self.restores_total = 0
+        self._listeners: List[Callable[[dict], None]] = []
+        if board is not None:
+            board.add_listener(self._on_board_event)
+
+    # -- wiring ---------------------------------------------------------------
+    def add_listener(self, fn: Callable[[dict], None]) -> None:
+        """Plan-event listener (the engine wires its scheduler wake
+        here so in-flight calls against a freshly-confirmed eviction
+        fail fast instead of burning their deadline)."""
+        with self._lock:
+            if fn not in self._listeners:
+                self._listeners.append(fn)
+
+    def close(self) -> None:
+        if self.board is not None:
+            self.board.remove_listener(self._on_board_event)
+
+    def _notify(self, event: dict) -> None:
+        with self._lock:
+            listeners = list(self._listeners)
+        for fn in listeners:
+            try:
+                fn(event)
+            except Exception:  # a listener must never fail the plane
+                pass
+
+    # -- agreement ------------------------------------------------------------
+    def propose(self, evict, reason: str = "",
+                evidence: Optional[dict] = None) -> Optional[dict]:
+        """Phase 1: vote an eviction set (world sessions) at the
+        current epoch.  Returns the confirmed plan when this vote (or
+        earlier ones) completed the majority."""
+        evict = frozenset(int(r) for r in evict)
+        if not evict or self.rank in evict:
+            # evicting self is a mark, not a vote: the group decides
+            with self._lock:
+                if self.rank in evict:
+                    self.self_evicted = True
+            return None
+        with self._lock:
+            if self._plan is not None:
+                return dict(self._plan)
+            epoch = self.epoch
+            if self._own_vote is None:
+                self._own_vote = evict
+                self.proposals += 1
+            elif self._own_vote != evict:
+                # first-proposal-wins: hard PeerDead evidence lands
+                # before cascade timeouts, so the genuine dead set wins
+                # the race deterministically; conflicting later sets
+                # are dropped (they re-propose at the next epoch)
+                evict = self._own_vote
+        plan = self._vote(epoch, evict, self.rank, reason, evidence)
+        if plan is not None:
+            return plan
+        self._broadcast("propose", epoch, evict)
+        return None
+
+    def _vote(self, epoch: int, evict: FrozenSet[int], rank: int,
+              reason: str = "", evidence: Optional[dict] = None
+              ) -> Optional[dict]:
+        """Register one vote (board post or local tally) and adopt the
+        plan if it confirms."""
+        if self.board is not None:
+            with self._lock:
+                excluded = frozenset(self.evicted)
+            plan = self.board.post(
+                epoch, evict, rank, self.world, excluded=excluded
+            )
+            if plan is not None:
+                self._adopt_plan(plan, reason, evidence)
+            return plan
+        with self._lock:
+            if self._plan is not None:
+                return dict(self._plan)
+            if (
+                epoch != self.epoch or rank in evict
+                or rank in self.evicted  # the evicted don't vote
+            ):
+                return None
+            votes = self._votes.setdefault(evict, set())
+            votes.add(rank)
+            survivors = self.world - len(self.evicted | evict)
+            if len(votes) * 2 <= survivors:
+                return None
+            plan = {
+                "kind": "evict",
+                "epoch": epoch,
+                "evict": sorted(evict),
+                "votes": sorted(votes),
+                "world": self.world,
+                "survivors": survivors,
+                "basis": "wire",
+            }
+        self._adopt_plan(plan, reason, evidence)
+        return plan
+
+    def _adopt_plan(self, plan: dict, reason: str = "",
+                    evidence: Optional[dict] = None) -> None:
+        announce = False
+        with self._lock:
+            if self._plan is not None or plan.get("epoch") != self.epoch:
+                return
+            plan = dict(plan)
+            if reason:
+                plan.setdefault("reason", reason)
+            if evidence:
+                plan.setdefault("evidence", evidence)
+            self._plan = plan
+            if self.rank in plan["evict"]:
+                self.self_evicted = True
+            self._confirmed.set()
+            announce = not self._announced
+            self._announced = True
+        if announce:
+            self._broadcast(
+                "confirm", plan["epoch"], frozenset(plan["evict"]),
+                votes=plan.get("votes"),
+            )
+        self._notify(dict(plan, type="confirmed"))
+
+    def _broadcast(self, phase: str, epoch: int, evict: FrozenSet[int],
+                   votes=None) -> None:
+        """Wire-tier exchange: one MEMBER frame per surviving peer.
+        Board tiers skip — the shared board already told everyone."""
+        if self._send is None or self.board is not None:
+            return
+        payload = {
+            "phase": phase,
+            "epoch": epoch,
+            "evict": sorted(evict),
+            "src_session": self.rank,
+        }
+        if votes is not None:
+            payload["votes"] = sorted(votes)
+        try:
+            self._send(payload, set(evict) | set(self.evicted))
+        except Exception:  # a dead peer mid-broadcast: nothing to tell
+            pass
+
+    def observe_wire(self, payload: dict, src: int = -1) -> None:
+        """A peer's MEMBER frame (fabric delivery thread).  Elastic
+        handles *second* proposals they cannot refute (phase 2 of the
+        agreement); confirmed frames carry the full vote set and are
+        adopted directly once the majority checks out locally."""
+        try:
+            phase = payload.get("phase")
+            epoch = int(payload.get("epoch", -1))
+            evict = frozenset(int(r) for r in payload.get("evict") or ())
+            voter = int(payload.get("src_session", src))
+        except (TypeError, ValueError):
+            return
+        if not evict or epoch != self.epoch:
+            return
+        if self.rank in evict:
+            with self._lock:
+                self.self_evicted = True
+            return
+        # tally the sender's vote (and, for confirm frames, the votes
+        # it aggregated)
+        voters = {voter}
+        if phase == "confirm":
+            try:
+                voters |= {int(v) for v in payload.get("votes") or ()}
+            except (TypeError, ValueError):
+                pass
+        plan = None
+        for v in sorted(voters - evict):
+            plan = self._vote(epoch, evict, v) or plan
+        if plan is not None:
+            return
+        # phase 2: second a proposal we cannot refute (no conflicting
+        # own vote).  Only elastic handles act; passive handles just
+        # tally so their snapshot shows the attempt.
+        if not self.elastic:
+            return
+        second = False
+        with self._lock:
+            if (
+                self._own_vote is None and self._plan is None
+                and not self.self_evicted
+            ):
+                self._own_vote = evict
+                second = True
+        if second:
+            self._vote(epoch, evict, self.rank)
+            self._broadcast("confirm" if self.confirmed() else "propose",
+                            epoch, evict)
+
+    def _on_board_event(self, event: dict) -> None:
+        """Board listener: adopt confirmations; second proposals (the
+        elastic handles' phase-2 vote)."""
+        if event.get("type") == "confirmed":
+            self._adopt_plan({k: v for k, v in event.items() if k != "type"})
+            return
+        if not self.elastic or event.get("type") != "propose":
+            return
+        try:
+            epoch = int(event.get("epoch", -1))
+            evict = frozenset(int(r) for r in event.get("evict") or ())
+        except (TypeError, ValueError):
+            return
+        if epoch != self.epoch or not evict or self.rank in evict:
+            return
+        second = False
+        with self._lock:
+            if (
+                self._own_vote is None and self._plan is None
+                and not self.self_evicted
+            ):
+                self._own_vote = evict
+                second = True
+        if second:
+            self._vote(epoch, evict, self.rank)
+
+    # -- verdict surface ------------------------------------------------------
+    def confirmed(self) -> Optional[dict]:
+        with self._lock:
+            return dict(self._plan) if self._plan is not None else None
+
+    def cutover_ready(self) -> bool:
+        return self._plan is not None  # racy read; take_cutover decides
+
+    def proposing(self) -> bool:
+        """Any votes (own or observed) pending at the current epoch —
+        the failed-call path only waits for confirmation when an
+        eviction is actually in flight."""
+        with self._lock:
+            return (
+                self._plan is not None or self._own_vote is not None
+                or bool(self._votes)
+            )
+
+    def wait_confirmed(self, timeout: float) -> Optional[dict]:
+        """Bounded wait for a confirmed plan (the shrink deadline);
+        None on timeout — the caller surfaces its raw failure."""
+        self._confirmed.wait(timeout=max(0.0, float(timeout)))
+        return self.confirmed()
+
+    def plan_covers(self, session: int) -> bool:
+        """Is ``session`` under a confirmed (or already applied)
+        eviction?  The engine's intake/failure paths use this to
+        complete with RANK_EVICTED instead of a bare timeout."""
+        with self._lock:
+            if session in self.evicted:
+                return True
+            return self._plan is not None and session in self._plan["evict"]
+
+    def evidence(self) -> dict:
+        """The agreement evidence attached to RANK_EVICTED errors."""
+        with self._lock:
+            return {
+                "epoch": self.epoch,
+                "evicted": sorted(self.evicted),
+                "plan": dict(self._plan) if self._plan is not None else None,
+                "self_evicted": self.self_evicted,
+            }
+
+    # -- cutover / restore ----------------------------------------------------
+    def take_cutover(self) -> Optional[dict]:
+        """Atomically consume the confirmed plan: bump the membership
+        epoch, fold the eviction set into the cumulative record, reset
+        the agreement state for the new epoch.  Exactly one non-None
+        return per confirmed plan per view — the facade applies the
+        communicator surgery on it."""
+        with self._lock:
+            plan = self._plan
+            if plan is None:
+                return None
+            self._plan = None
+            self._votes.clear()
+            self._own_vote = None
+            self._announced = False
+            self._confirmed.clear()
+            self.epoch += 1
+            self.evicted |= set(plan["evict"])
+            if self.rank in self.evicted:
+                self.self_evicted = True
+            else:
+                self.evictions_total += 1
+            record = dict(plan, applied_epoch=self.epoch)
+            self.history.append(record)
+            if len(self.history) > _HISTORY_CAP:
+                self.history.pop(0)
+            return dict(record)
+
+    def restore(self) -> Optional[dict]:
+        """soft_reset recovery (collective, after the operator healed
+        the fabric): re-admit every evicted session, drop any pending
+        agreement state, and return to membership epoch 0 — the GENESIS
+        epoch, so a previously-evicted rank (which never advanced past
+        0) realigns with the survivors without needing to have observed
+        the shrink at all.  Returns the restore record, or None when
+        there was nothing to restore."""
+        with self._lock:
+            pending = (
+                self._plan is not None or self._own_vote is not None
+                or bool(self._votes)
+            )
+            if not self.evicted and not self.self_evicted and not pending:
+                return None
+            record = {
+                "kind": "restore",
+                "readmitted": sorted(self.evicted),
+                "epoch": 0,
+            }
+            had_evictions = bool(self.evicted)
+            self.evicted.clear()
+            self.self_evicted = False
+            self._plan = None
+            self._votes.clear()
+            self._own_vote = None
+            self._announced = False
+            self._confirmed.clear()
+            self.epoch = 0
+            if had_evictions:
+                self.restores_total += 1
+                self.history.append(record)
+                if len(self.history) > _HISTORY_CAP:
+                    self.history.pop(0)
+        if self.board is not None:
+            self.board.clear()
+        if self.ledger is not None:
+            self.ledger.reset()
+        return dict(record)
+
+    # -- demotion -------------------------------------------------------------
+    @spmd_uniform
+    def demote_decision(self, comm_id: int, world: int, seq: int,
+                        slow: List[int],
+                        recovered: Dict[int, bool]) -> dict:
+        """The SPMD-uniform routing decision for call index ``seq``:
+        derived from the EXCHANGED slow_rank verdict (shared judge) and
+        latched per (comm, seq) on the shared ledger — never from local
+        observation.  ``{"demoted": [...], "restored": [...],
+        "root": n}``; the stock decision when no ledger is shared
+        (wire tiers: verdicts are pairwise, routing stays put)."""
+        if self.ledger is None or not self.elastic:
+            return {"seq": seq, "demoted": [], "restored": [], "root": 0}
+        return self.ledger.decide(comm_id, world, seq, slow, recovered)
+
+    def demoted(self, comm_id: int) -> List[int]:
+        """Currently-demoted ranks on ``comm_id`` (advisory view)."""
+        if self.ledger is None:
+            return []
+        return self.ledger.demoted(comm_id)
+
+    # -- telemetry ------------------------------------------------------------
+    def snapshot(self) -> dict:
+        with self._lock:
+            doc = {
+                "elastic": self.elastic,
+                "epoch": self.epoch,
+                "world": self.world,
+                "evicted": sorted(self.evicted),
+                "self_evicted": self.self_evicted,
+                "pending_plan": (
+                    dict(self._plan) if self._plan is not None else None
+                ),
+                "proposals": self.proposals,
+                "evictions_total": self.evictions_total,
+                "restores_total": self.restores_total,
+                "history": [dict(h) for h in self.history],
+                "exchange": "board" if self.board is not None else "wire",
+            }
+        if self.ledger is not None:
+            doc["demotion"] = self.ledger.snapshot()
+        return doc
+
+
+def member_payload(data: bytes) -> Optional[dict]:
+    """Decode one MEMBER wire frame's JSON payload; None on garbage (a
+    corrupt-fault frame must never poison the agreement)."""
+    try:
+        doc = json.loads(data.decode())
+    except (ValueError, UnicodeDecodeError):
+        return None
+    return doc if isinstance(doc, dict) else None

@@ -370,6 +370,39 @@ class SkewJudge:
             v = self._slow.get(comm_id)
             return [v["rank"]] if v is not None else []
 
+    def recovered(self, comm_id: int, rank: int) -> bool:
+        """Has ``rank``'s arrival skew recovered?  True when its
+        current EWMA latency no longer clears the conviction bar
+        (below the absolute floor, or below ``factor`` × the slowest
+        other rank) — the membership plane's half-open circuit-breaker
+        probe: a demoted rank is re-admitted when this turns true and
+        no standing verdict renews."""
+        with self._lock:
+            ew = self._lat_ewma.get(comm_id) or {}
+            lat = ew.get(rank)
+            if lat is None:
+                return True  # no recent observations: nothing to hold
+            if lat < self.min_us:
+                return True
+            others = [v for r, v in ew.items() if r != rank]
+            if not others:
+                return True
+            return lat < self.factor * (max(others) + 1.0)
+
+    def clear_slow(self, comm_id: int, rank: Optional[int] = None) -> bool:
+        """Drop the standing slow_rank verdict (optionally only when it
+        names ``rank``) and its streaks — the demotion-restore path:
+        re-admission must also lift the health map's ``suspect_slow``
+        annotation, or the operator keeps paging on a healed rank."""
+        with self._lock:
+            v = self._slow.get(comm_id)
+            if v is None or (rank is not None and v.get("rank") != rank):
+                return False
+            del self._slow[comm_id]
+            for k in [k for k in self._streak if k[0] == comm_id]:
+                self._streak[k] = 0
+            return True
+
     def reset(self) -> None:
         """soft_reset recovery: drop posts, baselines, streaks and
         standing verdicts (the collective recovery point, like the

@@ -76,8 +76,9 @@ ERROR_TAIL = 32
 #: ``telemetry_snapshot()`` schema version: bumped whenever the merged
 #: dict gains/renames sections, so dashboards and the exporter
 #: round-trip tests can key on shape instead of sniffing.  2 = the
-#: monitor plane (schema_version, stragglers, anomalies, monitor).
-SCHEMA_VERSION = 2
+#: monitor plane (schema_version, stragglers, anomalies, monitor);
+#: 3 = the membership plane (membership, health_events).
+SCHEMA_VERSION = 3
 
 # One epoch<->monotonic anchor per process: records carry perf_counter_ns
 # timestamps (cheap, monotonic), trace export maps them onto the epoch
@@ -722,6 +723,41 @@ def to_prometheus(snapshot: dict) -> str:
         gauge("accl_cmdring_op_slots_total", cnt, op=opname)
     for reason, cnt in sorted((ring.get("fallbacks") or {}).items()):
         gauge("accl_cmdring_fallbacks_total", cnt, reason=reason)
+
+    # membership plane (elastic membership): the epoch gauge, eviction/
+    # demotion/restore counters, per-(comm, rank) demotion breaker
+    # states, and the health-transition edge counters — the
+    # accl_membership_* / accl_health_transitions_total surface the
+    # live monitor serves
+    mem = snapshot.get("membership") or {}
+    gauge("accl_membership_epoch", mem.get("epoch"))
+    gauge("accl_membership_elastic", int(bool(mem.get("elastic"))))
+    gauge("accl_membership_evicted_ranks", len(mem.get("evicted") or ()))
+    gauge("accl_membership_evictions_total", mem.get("evictions_total"))
+    gauge("accl_membership_restores_total", mem.get("restores_total"))
+    gauge("accl_membership_proposals_total", mem.get("proposals"))
+    demo = mem.get("demotion") or {}
+    gauge("accl_membership_demotions_total", demo.get("demotions_total"))
+    gauge(
+        "accl_membership_demotion_restores_total",
+        demo.get("restores_total"),
+    )
+    for key, brk in sorted((demo.get("breakers") or {}).items()):
+        comm, _, peer = key.partition("/")
+        gauge(
+            "accl_membership_demoted", int(brk.get("state") != "closed"),
+            comm=comm, peer=peer,
+        )
+    he = snapshot.get("health_events") or {}
+    gauge("accl_health_transition_events", he.get("transitions_total"))
+    for key, v in sorted((he.get("counters") or {}).items()):
+        parts = key.split("|")
+        if len(parts) != 3:
+            continue
+        gauge(
+            "accl_health_transitions_total", v,
+            **{"peer": parts[0], "from": parts[1], "to": parts[2]},
+        )
 
     # monitor plane (live observability): per-peer straggler EWMA lags,
     # standing slow_rank verdicts, anomaly alert totals, scrape counts —

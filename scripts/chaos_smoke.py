@@ -1,0 +1,182 @@
+"""CI chaos smoke: the elastic-membership recovery cycle on the
+(jax-free) emulator tiers — kill a rank under a seeded FaultPlan,
+assert the surviving majority agrees and shrinks within a bounded
+deadline, serves bit-correct at the new world size, and soft_reset
+restores full membership.  Runs the cycle on BOTH transports (InProc
+board agreement, Socket MEMBER-frame agreement) plus the membership
+units.  Needs numpy only — the same footprint as the monitor/ring
+smokes it runs next to (.github/workflows/analysis.yml).
+
+Usage::
+
+    python scripts/chaos_smoke.py
+"""
+
+import os
+import socket as socketlib
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from accl_tpu import (
+    ACCLError,
+    ErrorCode,
+    FAULT_PLAN_ENV,
+    FaultPlan,
+    FaultRule,
+    emulated_group,
+    socket_group_member,
+)
+from accl_tpu.membership import CircuitBreaker, MembershipBoard
+
+
+def run_parallel(group, fn, timeout=60.0):
+    results = [None] * len(group)
+    errors = [None] * len(group)
+
+    def runner(i):
+        try:
+            results[i] = fn(group[i], i)
+        except BaseException as e:  # noqa: BLE001
+            errors[i] = e
+
+    threads = [
+        threading.Thread(target=runner, args=(i,), daemon=True)
+        for i in range(len(group))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+        assert not t.is_alive(), "a rank wedged (deadline exceeded)"
+    for e in errors:
+        if e is not None:
+            raise e
+    return results
+
+
+def kill_plan(rank, seed=11):
+    return FaultPlan(
+        rules=[FaultRule(action="kill_rank", rank=rank, nth=0)], seed=seed
+    )
+
+
+def cycle(group, injectors, world, victim, label):
+    survivors = [a for i, a in enumerate(group) if i != victim]
+
+    def doomed(a, r):
+        s = a.create_buffer_from(np.full(64, r + 1.0, np.float32))
+        d = a.create_buffer(64, np.float32)
+        try:
+            a.allreduce(s, d, 64)
+            return "ok"
+        except ACCLError as e:
+            return int(e.code)
+
+    t0 = time.monotonic()
+    failed = run_parallel(survivors, doomed, timeout=30.0)
+    assert all(c & int(ErrorCode.RANK_EVICTED) for c in failed), failed
+    assert [a.size for a in survivors] == [world - 1] * (world - 1)
+    print(f"[{label}] shrink to world {world - 1} in "
+          f"{time.monotonic() - t0:.2f}s: RANK_EVICTED on every survivor")
+
+    expected = float(sum(i + 1 for i in range(world) if i != victim))
+
+    def serve(a, r):
+        for _ in range(3):
+            s = a.create_buffer_from(np.full(64, r + 1.0, np.float32))
+            d = a.create_buffer(64, np.float32)
+            a.allreduce(s, d, 64)
+            d.sync_from_device()
+            assert float(d.data[0]) == expected
+        return "ok"
+
+    assert run_parallel(survivors, serve, timeout=30.0) == ["ok"] * len(
+        survivors
+    )
+    print(f"[{label}] served 3 green rounds at world {world - 1}")
+
+    for inj in injectors:
+        if inj is not None:
+            inj.clear()
+    for a in group:
+        a.set_timeout(10.0)
+    run_parallel(group, lambda a, r: a.soft_reset(), timeout=60.0)
+    assert [a.size for a in group] == [world] * world
+    total = float(sum(i + 1 for i in range(world)))
+
+    def full(a, r):
+        s = a.create_buffer_from(np.full(64, r + 1.0, np.float32))
+        d = a.create_buffer(64, np.float32)
+        a.allreduce(s, d, 64)
+        d.sync_from_device()
+        return float(d.data[0])
+
+    assert run_parallel(group, full, timeout=60.0) == [total] * world
+    print(f"[{label}] soft_reset restored full membership (world {world})")
+    snap = group[0].telemetry_snapshot()
+    assert snap["membership"]["evictions_total"] == 1
+    assert snap["membership"]["restores_total"] == 1
+    assert "accl_membership_epoch" in group[0].telemetry_prometheus()
+
+
+def units():
+    brk = CircuitBreaker(threshold=2, cooldown_s=5.0, clock=lambda: 0.0)
+    brk.record_failure("x")
+    assert brk.allow() == "closed"
+    brk.record_failure("x")
+    assert brk.allow() == "open"
+    board = MembershipBoard()
+    assert board.post(0, frozenset({3}), rank=2, world=4) is None
+    plan = board.post(0, frozenset({3}), rank=0, world=4)
+    assert plan is not None and plan["evict"] == [3]
+    print("[units] breaker + board agreement OK")
+
+
+def main() -> int:
+    units()
+
+    g = emulated_group(4)
+    try:
+        for a in g:
+            a.set_elastic(True)
+            a.set_timeout(1.5)
+        inj = g[0].engine.fabric.install_fault_plan(kill_plan(3))
+        cycle(g, [inj], world=4, victim=3, label="inproc")
+    finally:
+        for a in g:
+            a.deinit()
+
+    os.environ[FAULT_PLAN_ENV] = kill_plan(3, seed=23).to_env()
+    ports, socks = [], []
+    for _ in range(4):
+        s = socketlib.socket()
+        s.setsockopt(socketlib.SOL_SOCKET, socketlib.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        socks.append(s)
+    for s in socks:
+        s.close()
+    addrs = [f"127.0.0.1:{p}" for p in ports]
+    g = [socket_group_member(i, addrs) for i in range(4)]
+    del os.environ[FAULT_PLAN_ENV]
+    try:
+        for a in g:
+            a.set_elastic(True)
+            a.set_timeout(2.0)
+        injectors = [a.engine.fabric.fault_injector for a in g]
+        cycle(g, injectors, world=4, victim=3, label="socket")
+    finally:
+        for a in g:
+            a.deinit()
+
+    print("chaos smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

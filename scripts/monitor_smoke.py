@@ -60,7 +60,7 @@ def main() -> int:
             if line and not line.startswith("#"):
                 assert _PROM_LINE.match(line), f"malformed: {line!r}"
         snap = json.loads(get(port, "/snapshot"))
-        assert snap["schema_version"] == 2
+        assert snap["schema_version"] == 3
         assert snap["stragglers"]["enabled"] is True
         trace = json.loads(get(port, "/trace"))
         assert trace["traceEvents"], "empty trace window"

@@ -232,7 +232,7 @@ def test_jax_free_module_traverses_from_import_alias(tmp_path, monkeypatch):
     (pkg / "sub" / "__init__.py").write_text("import numpy\n")
     (pkg / "sub" / "leaf.py").write_text("x = 1\n")
     for m in ("constants", "telemetry", "faults", "plans", "contract",
-              "monitor"):
+              "monitor", "membership"):
         (pkg / f"{m}.py").write_text("")
     import accl_tpu.analysis.graph as graph_mod
 
@@ -256,6 +256,7 @@ def test_jax_free_module_detects_violation(tmp_path, monkeypatch):
     (pkg / "plans.py").write_text("")
     (pkg / "contract.py").write_text("")
     (pkg / "monitor.py").write_text("")
+    (pkg / "membership.py").write_text("")
     import accl_tpu.analysis.base as base_mod
 
     monkeypatch.setattr(base_mod, "package_root", lambda: str(pkg))
@@ -281,7 +282,7 @@ def test_jax_free_module_sees_with_block_imports(tmp_path, monkeypatch):
         "    import numpy\n"
     )
     for m in ("constants", "overlap", "telemetry", "faults", "contract",
-              "monitor"):
+              "monitor", "membership"):
         (pkg / f"{m}.py").write_text("")
     import accl_tpu.analysis.base as base_mod
     import accl_tpu.analysis.graph as graph_mod
@@ -316,7 +317,7 @@ def test_jax_free_modules_import_without_heavy_stack():
         pkg.__path__ = [root]
         sys.modules['accl_tpu'] = pkg
         for m in ('constants', 'overlap', 'telemetry', 'faults', 'plans',
-                  'contract', 'monitor'):
+                  'contract', 'monitor', 'membership'):
             spec = importlib.util.spec_from_file_location(
                 'accl_tpu.' + m, os.path.join(root, m + '.py'))
             mod = importlib.util.module_from_spec(spec)
@@ -790,6 +791,25 @@ BAD_SEQUENCES = [
         if rank == 0:
             accl.begin_batch()
     """,
+    # membership plane: a LOCAL health-map read steering a contract
+    # field — raw health reads stay taint sources even though the
+    # exchanged-verdict accessors (suggest_root/demote_decision) are
+    # sanitizers; per-rank health maps differ, so this root diverges
+    """
+    def work(accl, comm):
+        health = accl.capabilities()["health"]
+        root = 1 if health[0]["state"] != "ok" else 0
+        accl.bcast(buf, 64, root=root)
+    """,
+    # a collective GUARDED by the local health map (the demote-it-
+    # myself anti-pattern the membership plane's exchanged verdicts
+    # exist to replace)
+    """
+    def work(accl, comm):
+        health = accl.capabilities()["health"]
+        if health[2]["state"] == "ok":
+            accl.allreduce(a, b, 64, comm=comm)
+    """,
 ]
 
 GOOD_SEQUENCES = [
@@ -836,6 +856,23 @@ GOOD_SEQUENCES = [
     from functools import reduce
     def work(rank, xs):
         return reduce(lambda a, b: a + b, xs, rank)
+    """,
+    # membership plane: suggest_root derives from the EXCHANGED
+    # demotion verdict (shared ledger, latched per call index) — a
+    # sanitizer by construction, even downstream of a health-tainted
+    # handle
+    """
+    def work(accl, comm):
+        health = accl.capabilities()["health"]
+        log(health)
+        root = accl.suggest_root(comm)
+        accl.bcast(buf, 64, root=root)
+    """,
+    # demote_decision is the latched SPMD-uniform decision surface
+    """
+    def work(accl, comm, seq):
+        d = view.demote_decision(comm.id, 4, seq, [], {})
+        accl.bcast(buf, 64, root=d["root"])
     """,
 ]
 

@@ -1,0 +1,724 @@
+"""Membership plane: elastic communicators that shrink around dead ranks
+and demote convicted stragglers (ISSUE 12 acceptance).
+
+The soak pair — kill → bounded-deadline shrink → N green collectives at
+the new world size → soft_reset restore — runs on the InProc AND Socket
+transports, determinism-checked (same FaultPlan seed → same eviction
+epoch/evict set/terminal code).  Everything here is marked ``chaos``.
+"""
+
+import os
+import socket as socketlib
+import time
+
+import numpy as np
+import pytest
+
+from accl_tpu import (
+    ACCLError,
+    ErrorCode,
+    FAULT_PLAN_ENV,
+    FaultPlan,
+    FaultRule,
+    emulated_group,
+    socket_group_member,
+)
+from accl_tpu.membership import (
+    CircuitBreaker,
+    DemotionLedger,
+    MembershipBoard,
+    MembershipView,
+)
+from helpers import run_parallel
+
+pytestmark = pytest.mark.chaos
+
+
+def _deinit(group):
+    for a in group:
+        a.deinit()
+
+
+def _kill_plan(rank: int, seed: int = 11) -> FaultPlan:
+    return FaultPlan(
+        rules=[FaultRule(action="kill_rank", rank=rank, nth=0)], seed=seed
+    )
+
+
+# ---------------------------------------------------------------------------
+# units: circuit breaker / board / view / communicator surgery
+# ---------------------------------------------------------------------------
+
+
+def test_circuit_breaker_state_machine():
+    """strike -> open -> cool-down -> half-open probe -> restore; a
+    failed probe re-opens with a fresh cool-down.  Deterministic via an
+    injected clock."""
+    now = [0.0]
+    brk = CircuitBreaker(threshold=2, cooldown_s=5.0, clock=lambda: now[0])
+    assert brk.allow() == "closed"
+    assert not brk.record_failure("window_error")  # 1 strike: still closed
+    assert brk.allow() == "closed"
+    assert brk.record_failure("window_error")  # 2nd strike opens
+    assert brk.allow() == "open"
+    now[0] = 4.9
+    assert brk.allow() == "open"  # cool-down not elapsed
+    now[0] = 5.1
+    assert brk.allow() == "probe"  # half-open
+    assert brk.record_failure("still_bad")  # failed probe re-opens
+    assert brk.allow() == "open"
+    now[0] = 10.3
+    assert brk.allow() == "probe"
+    assert brk.success()  # probe succeeded: restored
+    assert brk.allow() == "closed"
+    snap = brk.snapshot()
+    assert snap["opens_total"] == 2
+    assert snap["restores_total"] == 1
+    assert snap["reasons"]["window_error"] == 2
+
+
+def test_membership_board_majority_and_evicted_votes():
+    """A strict majority of the SURVIVORS confirms; votes from ranks
+    inside the eviction set never count."""
+    board = MembershipBoard()
+    events = []
+    board.add_listener(events.append)
+    # world 4, evicting {3}: survivors 3, majority needs 2
+    assert board.post(0, frozenset({3}), rank=2, world=4) is None
+    assert board.post(0, frozenset({3}), rank=3, world=4) is None  # condemned
+    plan = board.post(0, frozenset({3}), rank=0, world=4)
+    assert plan is not None
+    assert plan["evict"] == [3] and sorted(plan["votes"]) == [0, 2]
+    assert [e["type"] for e in events] == ["propose", "confirmed"]
+    # standing: later posts return the plan, not a new vote round
+    again = board.post(0, frozenset({3}), rank=1, world=4)
+    assert again["votes"] == plan["votes"]
+
+
+def test_wire_agreement_seconding_and_confirm():
+    """Wire-mode three-phase agreement: A proposes, B seconds what it
+    cannot refute, both confirm on the same plan; cutover is one-shot
+    and bumps the membership epoch."""
+    frames = {0: [], 1: []}
+    views = {}
+
+    def send_for(me):
+        def send(payload, exclude):
+            for peer in (0, 1, 2):
+                if peer != me and peer not in exclude and peer in views:
+                    frames[peer].append(dict(payload))
+        return send
+
+    a = views[0] = MembershipView(rank=0, world=3, send_fn=send_for(0))
+    b = views[1] = MembershipView(rank=1, world=3, send_fn=send_for(1))
+    a.elastic = b.elastic = True
+    assert a.propose({2}, reason="test") is None  # 1 of 2 survivors
+    # deliver A's propose to B: B seconds -> majority (2/2) -> confirmed
+    for f in frames[1]:
+        b.observe_wire(f)
+    assert b.confirmed() is not None
+    # B's confirm frame carries the votes; A adopts
+    for f in frames[0]:
+        a.observe_wire(f)
+    plan = a.confirmed()
+    assert plan is not None and plan["evict"] == [2]
+    assert sorted(plan["votes"]) == [0, 1]
+    rec = a.take_cutover()
+    assert rec is not None and a.epoch == 1 and a.evicted == {2}
+    assert a.take_cutover() is None  # one-shot
+    assert a.plan_covers(2) and not a.plan_covers(1)
+
+
+def test_communicator_shrink_restore_round_trip():
+    from accl_tpu.communicator import Communicator, Rank
+
+    ranks = [Rank(address=f"x:{i}", session=i) for i in range(4)]
+    c = Communicator(ranks, 2, comm_id=9)
+    e0 = c.epoch
+    translation = c.shrink([0, 2, 3])
+    assert translation == {0: 0, 2: 1, 3: 2}
+    assert c.size == 3 and c.local_rank == 1 and c.shrunk
+    assert [r.session for r in c.ranks] == [0, 2, 3]
+    assert c.epoch != e0
+    # the evicted side never shrinks
+    c2 = Communicator(ranks, 1, comm_id=10)
+    assert c2.shrink([0, 2, 3]) is None and c2.size == 4
+    assert c.restore()
+    assert c.size == 4 and c.local_rank == 2 and not c.shrunk
+    assert not c.restore()  # idempotent
+
+
+def test_shrink_marker_diverges_missed_rank():
+    """The __shrink__ digest marker: a rank that missed the cutover
+    keeps the old digest stream and diverges from a rank that folded
+    the marker — one verification window instead of a silent hang."""
+    from accl_tpu.contract import ContractVerifier
+
+    a = ContractVerifier(rank=0, world=3)
+    b = ContractVerifier(rank=1, world=3)
+    for v in (a, b):
+        v.begin_comm(5, v.rank, (0, 1, 2))
+        v.record("allreduce", 5, "FLOAT32", 64, "0/0", 0)
+    a.shrink_comm(5, 0, (0, 1), membership_epoch=1)
+    for v in (a, b):
+        v.record("allreduce", 5, "FLOAT32", 64, "0/0", 0)
+    with a._lock:
+        da = a._comms[5].digest
+    with b._lock:
+        db = b._comms[5].digest
+    assert da != db
+
+
+# ---------------------------------------------------------------------------
+# kill -> shrink -> serve -> restore (the soak pair: InProc AND Socket)
+# ---------------------------------------------------------------------------
+
+
+def _soak_cycle(group, injectors, world, victim, rounds=4, timeout=30.0):
+    """One full elastic cycle on an already-armed group; returns the
+    determinism record (terminal codes + per-rank membership facts)."""
+    survivors = [a for i, a in enumerate(group) if i != victim]
+
+    def doomed(a, r):
+        s = a.create_buffer_from(np.full(64, r + 1.0, np.float32))
+        d = a.create_buffer(64, np.float32)
+        try:
+            a.allreduce(s, d, 64)
+            return "ok"
+        except ACCLError as e:
+            ev = e.details.get("membership") or {}
+            # the agreement evidence rides the error either as the
+            # still-pending plan or (post-cutover) the applied set
+            evict = (ev.get("plan") or {}).get("evict") or ev.get("evicted")
+            return (int(e.code), evict)
+
+    t0 = time.monotonic()
+    failed = run_parallel(survivors, doomed, timeout=timeout)
+    shrink_s = time.monotonic() - t0
+    # bounded-deadline shrink: well under the run_parallel bound
+    assert shrink_s < timeout / 2, f"shrink took {shrink_s:.1f}s"
+    for code, _evict in failed:
+        assert code & int(ErrorCode.RANK_EVICTED), failed
+    sizes = [a.size for a in survivors]
+    epochs = [a._membership.epoch for a in survivors]
+    assert sizes == [world - 1] * len(survivors)
+    assert epochs == [1] * len(survivors)
+
+    # N green collectives at the new world size, bit-correct
+    expected = float(sum(
+        i + 1 for i in range(world) if i != victim
+    ))
+
+    def serve(a, r):
+        out = []
+        for _ in range(rounds):
+            s = a.create_buffer_from(np.full(64, r + 1.0, np.float32))
+            d = a.create_buffer(64, np.float32)
+            a.allreduce(s, d, 64)
+            d.sync_from_device()
+            out.append(float(d.data[0]))
+        return out
+
+    served = run_parallel(survivors, serve, timeout=timeout)
+    for vals in served:
+        assert vals == [expected] * rounds, served
+
+    # heal + collective soft_reset restores full membership
+    for inj in injectors:
+        if inj is not None:
+            inj.clear()
+    for a in group:
+        a.set_timeout(10.0)
+    run_parallel(group, lambda a, r: a.soft_reset(), timeout=timeout * 2)
+    assert [a.size for a in group] == [world] * world
+
+    def full(a, r):
+        s = a.create_buffer_from(np.full(64, r + 1.0, np.float32))
+        d = a.create_buffer(64, np.float32)
+        a.allreduce(s, d, 64)
+        d.sync_from_device()
+        return float(d.data[0])
+
+    total = float(sum(i + 1 for i in range(world)))
+    assert run_parallel(group, full, timeout=timeout * 2) == [total] * world
+    return {
+        "failed": failed,
+        "evicted": [sorted(a._membership.evicted) for a in survivors],
+        "history": [
+            [
+                {k: h[k] for k in ("kind", "epoch")
+                 if k in h} | {"evict": h.get("evict"),
+                              "readmitted": h.get("readmitted")}
+                for h in a._membership.snapshot()["history"]
+            ]
+            for a in survivors
+        ],
+    }
+
+
+def _run_inproc_cycle(seed=11):
+    g = emulated_group(4)
+    try:
+        for a in g:
+            a.set_elastic(True)
+            a.set_timeout(1.5)
+        inj = g[0].engine.fabric.install_fault_plan(_kill_plan(3, seed))
+        rec = _soak_cycle(g, [inj], world=4, victim=3)
+        # membership metrics visible on the live surface
+        snap = g[0].telemetry_snapshot()
+        assert snap["membership"]["evictions_total"] == 1
+        assert snap["membership"]["restores_total"] == 1
+        assert snap["membership"]["epoch"] == 0  # restored to genesis
+        prom = g[0].telemetry_prometheus()
+        assert "accl_membership_epoch" in prom
+        assert "accl_membership_evictions_total" in prom
+        return rec
+    finally:
+        _deinit(g)
+
+
+def test_kill_shrink_serve_restore_inproc():
+    """World 4, kill rank 3: survivors agree within a bounded deadline,
+    fail the in-flight collective with structured RANK_EVICTED carrying
+    the agreement evidence, serve bit-correct at world 3, and soft_reset
+    restores full membership."""
+    _run_inproc_cycle()
+
+
+def test_kill_shrink_deterministic_per_seed():
+    """Same FaultPlan seed -> same eviction epoch, evict set, terminal
+    codes and membership history — twice, from fresh groups."""
+    first = _run_inproc_cycle(seed=42)
+    second = _run_inproc_cycle(seed=42)
+    assert first == second
+
+
+def test_kill_shrink_serve_restore_socket(monkeypatch):
+    """The same cycle over the one-process-per-rank socket transport:
+    the agreement rides MEMBER wire frames (no shared board) and the
+    membership-epoch stamp discards pre-shrink straggler frames."""
+    plan = _kill_plan(3, seed=23)
+    monkeypatch.setenv(FAULT_PLAN_ENV, plan.to_env())
+    ports, socks = [], []
+    for _ in range(4):
+        s = socketlib.socket()
+        s.setsockopt(socketlib.SOL_SOCKET, socketlib.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        socks.append(s)
+    for s in socks:
+        s.close()
+    addrs = [f"127.0.0.1:{p}" for p in ports]
+    g = [socket_group_member(i, addrs) for i in range(4)]
+    monkeypatch.delenv(FAULT_PLAN_ENV)
+    try:
+        for a in g:
+            a.set_elastic(True)
+            a.set_timeout(2.0)
+        injectors = [a.engine.fabric.fault_injector for a in g]
+        rec = _soak_cycle(g, injectors, world=4, victim=3, timeout=40.0)
+        assert all(
+            code & int(ErrorCode.RANK_EVICTED) for code, _ in rec["failed"]
+        )
+        # the agreement was wire-based on this tier
+        assert g[0]._membership.snapshot()["exchange"] == "wire"
+    finally:
+        _deinit(g)
+
+
+def test_evicted_rank_fails_fast_with_self_evidence():
+    """On the board tier the condemned rank's handle observes the
+    confirmed plan too: its later comm ops fail fast with RANK_EVICTED
+    (self_evicted) instead of burning deadlines into a group that
+    stopped listening."""
+    g = emulated_group(3)
+    try:
+        for a in g:
+            a.set_elastic(True)
+            a.set_timeout(1.0)
+        inj = g[0].engine.fabric.install_fault_plan(_kill_plan(2, seed=5))
+        survivors = g[:2]
+
+        def doomed(a, r):
+            s = a.create_buffer_from(np.ones(8, np.float32))
+            d = a.create_buffer(8, np.float32)
+            try:
+                a.allreduce(s, d, 8)
+                return "ok"
+            except ACCLError as e:
+                return e.code
+
+        res = run_parallel(survivors, doomed, timeout=30.0)
+        assert all(c & ErrorCode.RANK_EVICTED for c in res)
+        # the dead rank's handle adopted the plan from the shared board
+        assert g[2]._membership.self_evicted
+        s = g[2].create_buffer_from(np.ones(8, np.float32))
+        d = g[2].create_buffer(8, np.float32)
+        t0 = time.monotonic()
+        with pytest.raises(ACCLError) as exc:
+            g[2].allreduce(s, d, 8)
+        assert time.monotonic() - t0 < 1.0  # fast, not a deadline burn
+        assert exc.value.code == ErrorCode.RANK_EVICTED
+        assert exc.value.details["membership"]["self_evicted"] is True
+        inj.clear()
+    finally:
+        _deinit(g)
+
+
+def test_explicit_evict_rank_api():
+    """ACCL.evict_rank: no faults at all — the operator's lever.  Every
+    surviving rank calls it (collective by contract); majority confirms
+    and the cutover applies before the call returns."""
+    g = emulated_group(3)
+    try:
+        for a in g:
+            a.set_elastic(True)
+
+        def evict(a, r):
+            return a.evict_rank(2)
+
+        res = run_parallel(g[:2], evict, timeout=30.0)
+        assert all(p is not None and p["evict"] == [2] for p in res)
+        assert [a.size for a in g[:2]] == [2, 2]
+
+        def serve(a, r):
+            s = a.create_buffer_from(np.full(8, r + 1.0, np.float32))
+            d = a.create_buffer(8, np.float32)
+            a.allreduce(s, d, 8)
+            d.sync_from_device()
+            return float(d.data[0])
+
+        assert run_parallel(g[:2], serve, timeout=30.0) == [3.0, 3.0]
+        # the evicted handle evicting ITSELF raises the structured code
+        with pytest.raises(ACCLError) as exc:
+            g[2].evict_rank(2)
+        assert exc.value.code == ErrorCode.RANK_EVICTED
+    finally:
+        _deinit(g)
+
+
+def test_unshrunk_subcomm_survives_cutover():
+    """The stale-frame fence is COMM-scoped: after a shrink, traffic on
+    a subcommunicator that never contained the evicted rank keeps
+    flowing even though its senders' membership epochs lag the world
+    comm's cutover (review finding: a global epoch fence discarded
+    healthy-subcomm frames and cascaded spurious evictions)."""
+    g = emulated_group(4)
+    try:
+        for a in g:
+            a.set_elastic(True)
+            a.set_timeout(2.0)
+        # a subcomm over ranks {0, 1} — no member dies
+        subs = [a.create_communicator([0, 1]) for a in g[:2]]
+        inj = g[0].engine.fabric.install_fault_plan(_kill_plan(3, seed=31))
+        survivors = g[:3]
+
+        def doomed(a, r):
+            s = a.create_buffer_from(np.ones(16, np.float32))
+            d = a.create_buffer(16, np.float32)
+            try:
+                a.allreduce(s, d, 16)
+                return "ok"
+            except ACCLError as e:
+                return e.code
+
+        res = run_parallel(survivors, doomed, timeout=30.0)
+        assert all(c & ErrorCode.RANK_EVICTED for c in res)
+        # the world comm shrank; the subcomm did NOT (its membership
+        # never contained the evicted session)
+        assert [a.size for a in survivors] == [3, 3, 3]
+        assert all(sc.size == 2 for sc in subs)
+
+        def sub_round(a, r):
+            s = a.create_buffer_from(np.full(16, r + 1.0, np.float32))
+            d = a.create_buffer(16, np.float32)
+            a.allreduce(s, d, 16, comm=subs[r])
+            d.sync_from_device()
+            return float(d.data[0])
+
+        # the subcomm keeps serving across the cutover boundary
+        for _ in range(3):
+            assert run_parallel(g[:2], sub_round, timeout=30.0) == [3.0, 3.0]
+        inj.clear()
+    finally:
+        _deinit(g)
+
+
+def test_board_majority_over_remaining_survivors():
+    """Sequential evictions: the second eviction's majority is over the
+    ranks still serving — already-evicted sessions leave the survivor
+    base and their votes never count (review finding: the board used
+    the original world, wedging every second eviction)."""
+    # world 4, rank 3 already evicted: evicting {2} at epoch 1 leaves
+    # survivors {0, 1} — majority needs 2 votes of THOSE two
+    board = MembershipBoard()
+    gone = frozenset({3})
+    assert board.post(1, frozenset({2}), rank=0, world=4,
+                      excluded=gone) is None
+    # votes from the condemned and the previously-evicted never count
+    assert board.post(1, frozenset({2}), rank=2, world=4,
+                      excluded=gone) is None
+    assert board.post(1, frozenset({2}), rank=3, world=4,
+                      excluded=gone) is None
+    assert board.standing(1) is None
+    plan = board.post(1, frozenset({2}), rank=1, world=4, excluded=gone)
+    assert plan is not None
+    assert plan["survivors"] == 2 and sorted(plan["votes"]) == [0, 1]
+    # degenerate tail: a lone remaining survivor self-confirms (the
+    # world-2-kill discipline applied transitively)
+    board2 = MembershipBoard()
+    plan = board2.post(2, frozenset({1}), rank=0, world=3,
+                       excluded=frozenset({2}))
+    assert plan is not None and plan["survivors"] == 1
+
+
+def test_health_transition_events_and_flap_visibility():
+    """State transitions are counted and ring-buffered: an ok->dead
+    edge is visible in telemetry_snapshot()["health_events"] and as
+    accl_health_transitions_total{peer,from,to} — even after the
+    instantaneous map changes again."""
+    g = emulated_group(2)
+    try:
+        g[0].engine.fabric.install_fault_plan(_kill_plan(1, seed=3))
+        sb = g[0].create_buffer_from(np.ones(4, np.float32))
+        with pytest.raises(ACCLError):
+            g[0].send(sb, 4, dst=1, tag=1)
+        snap = g[0].telemetry_snapshot()
+        he = snap["health_events"]
+        assert he["transitions_total"] >= 1
+        assert any(
+            k.endswith("|ok|dead") or "|dead" in k
+            for k in he["counters"]
+        ), he
+        assert he["events"][0]["to"] in ("suspect", "dead")
+        prom = g[0].telemetry_prometheus()
+        assert "accl_health_transitions_total" in prom
+        assert 'to="dead"' in prom
+    finally:
+        _deinit(g)
+
+
+# ---------------------------------------------------------------------------
+# straggler demotion: conviction -> excluded root -> half-open restore
+# ---------------------------------------------------------------------------
+
+
+def test_straggler_demotion_and_halfopen_restore(monkeypatch):
+    """End-to-end from a delay-rule conviction to excluded-root routing
+    and circuit-breaker restore: rank 0 is convicted slow (exchanged
+    verdict, shared judge), the barrier's internal root re-routes to
+    rank 1 on EVERY handle (latched SPMD-uniform decision), and once
+    the delay rule exhausts and arrival skew recovers, the half-open
+    probe re-admits it and clears the standing verdict."""
+    monkeypatch.setenv("ACCL_SKEW_INTERVAL", "4")
+    monkeypatch.setenv("ACCL_DEMOTE_COOLDOWN_S", "0.3")
+    g = emulated_group(2)
+    try:
+        for a in g:
+            a.set_elastic(True)
+        g[0].engine.fabric.install_fault_plan(FaultPlan(
+            rules=[FaultRule(action="delay", src=0, delay_s=0.02,
+                             msg_type="EAGER", count=10)],
+            seed=7,
+        ))
+        send = [
+            a.create_buffer_from(np.full(64, float(r + 1), np.float32))
+            for r, a in enumerate(g)
+        ]
+        recv = [a.create_buffer(64, np.float32) for a in g]
+
+        def drive(rounds):
+            for _ in range(rounds):
+                run_parallel(
+                    g, lambda a, r: a.allreduce(send[r], recv[r], 64)
+                )
+
+        drive(9)  # two skew windows: conviction (the PR 8 acceptance)
+        judge = g[0]._monitor.tracker.judge
+        assert judge.slow_ranks(0) == [0]
+        run_parallel(g, lambda a, r: a.barrier())
+        # demoted + re-routed, identically on every handle
+        assert g[0]._membership.demoted(0) == [0]
+        assert [a.suggest_root() for a in g] == [1, 1]
+        decision = g[0].telemetry_snapshot()["membership"]["demotion"][
+            "last_decision"]["0"]
+        assert decision["demoted"] == [0] and decision["root"] == 1
+        prom = g[0].telemetry_prometheus()
+        assert "accl_membership_demotions_total" in prom
+        assert "accl_membership_demoted" in prom
+
+        # the delay rule exhausts (count=10); EWMA decays over judged
+        # windows until the half-open probe restores — bounded loop
+        deadline = time.monotonic() + 60.0
+        while g[0]._membership.demoted(0):
+            assert time.monotonic() < deadline, (
+                "demotion never restored",
+                judge.snapshot()["ewma_latency_us"],
+            )
+            drive(4)
+            time.sleep(0.35)
+            run_parallel(g, lambda a, r: a.barrier())
+        # restored: standing verdict cleared, counters moved
+        assert judge.slow_ranks(0) == []
+        assert g[0]._membership.ledger.restores_total == 1
+        assert [a.suggest_root() for a in g] == [0, 0]
+        h = g[0].telemetry_snapshot()["health"]
+        assert not any(v.get("suspect_slow") for v in h.values())
+    finally:
+        _deinit(g)
+
+
+def test_demotion_decision_latched_per_seq():
+    """The shared ledger latches one decision per (comm, call index):
+    later callers read the cached verdict even if breaker state has
+    since moved — the sequencer-mailbox first-caller-decides
+    discipline that keeps routing SPMD-uniform."""
+    now = [0.0]
+    led = DemotionLedger(cooldown_s=5.0, clock=lambda: now[0])
+    d1 = led.decide(7, 4, 0, slow=[2], recovered={})
+    assert d1["demoted"] == [2] and d1["root"] == 0
+    now[0] = 10.0  # cool-down elapsed: a FRESH seq would probe...
+    again = led.decide(7, 4, 0, slow=[], recovered={2: True})
+    assert again == d1  # ...but seq 0 is latched
+    d2 = led.decide(7, 4, 1, slow=[], recovered={2: True})
+    assert d2["restored"] == [2] and d2["demoted"] == []
+
+
+# ---------------------------------------------------------------------------
+# ring-session resilience (the XLA command ring's circuit breaker)
+# ---------------------------------------------------------------------------
+
+
+def test_ring_breaker_degrades_and_reprobes(monkeypatch):
+    """A comm whose ring windows fail degrades ring -> host (counted
+    circuit_open), re-probes INLINE after the cool-down, and a probe
+    success restores ring dispatch with fallback counters quiet."""
+    monkeypatch.setenv("ACCL_CMDRING_COOLDOWN_S", "0.2")
+    from accl_tpu.core import xla_group
+
+    g = xla_group(2)
+    try:
+        ring = g[0].engine.gang.cmdring
+        if not ring.enabled:
+            pytest.skip("command ring disabled in this environment")
+        send = [
+            a.create_buffer_from(np.full(32, float(r + 1), np.float32))
+            for r, a in enumerate(g)
+        ]
+        recv = [a.create_buffer(32, np.float32) for a in g]
+
+        def batch_round(a, r):
+            with a.batch():
+                a.allreduce(send[r], recv[r], 32, run_async=True)
+                a.allreduce(send[r], recv[r], 32, run_async=True)
+
+        run_parallel(g, batch_round, timeout=120.0)
+        assert ring.stats()["slots"] > 0  # the ring really engaged
+        base_slots = ring.stats()["slots"]
+
+        # wedge the breaker open (the window-failure path's strikes)
+        brk = ring.breaker_for(g[0].comm.id)
+        brk.record_failure("TimeoutError")
+        brk.record_failure("TimeoutError")
+        assert brk.allow() == "open"
+        run_parallel(g, batch_round, timeout=120.0)
+        st = ring.stats()
+        assert st["fallbacks"].get("circuit_open", 0) >= 1
+        assert st["slots"] == base_slots  # host path served the batch
+        assert st["breakers"][str(g[0].comm.id)]["state"] == "open"
+        # the host-path results stayed bit-correct
+        for r, a in enumerate(g):
+            recv[r].sync_from_device()
+            np.testing.assert_allclose(recv[r].data, 3.0)
+
+        time.sleep(0.25)  # cool-down -> half-open
+        run_parallel(g, batch_round, timeout=120.0)  # the probe window
+        st = ring.stats()
+        assert st["slots"] > base_slots  # probe rode the ring (inline)
+        assert st["breakers"][str(g[0].comm.id)]["state"] == "closed"
+        fallbacks_after_restore = st["fallbacks"].get("circuit_open", 0)
+        run_parallel(g, batch_round, timeout=120.0)
+        st = ring.stats()
+        # restored: no NEW circuit fallbacks once the probe closed it
+        assert st["fallbacks"].get("circuit_open", 0) == (
+            fallbacks_after_restore
+        )
+    finally:
+        _deinit(g)
+
+
+# ---------------------------------------------------------------------------
+# dist-tier KV digest piggyback (the PR 7 deferral, unit-proven)
+# ---------------------------------------------------------------------------
+
+
+class _FakeKV:
+    """Dict-backed stand-in for the jax distributed KV client surface
+    the exchange uses."""
+
+    def __init__(self, store=None):
+        self.store = store if store is not None else {}
+
+    def key_value_set_bytes(self, key, value):
+        self.store[key] = bytes(value)
+
+    def key_value_try_get_bytes(self, key):
+        return self.store.get(key)
+
+
+def test_kv_digest_exchange_detects_cross_host_divergence():
+    """Two verifiers exchange window digests through a shared KV plane:
+    matched streams stay silent; a diverging stream yields a pairwise
+    verdict naming the peer — cross-host divergence fails fast exactly
+    like in-process."""
+    from accl_tpu.contract import ContractVerifier, kv_digest_exchange
+
+    store = {}
+    kv = _FakeKV(store)
+    a = ContractVerifier(rank=0, world=2, interval=4)
+    b = ContractVerifier(rank=1, world=2, interval=4)
+    for v in (a, b):
+        v.begin_comm(3, v.rank, (0, 1))
+    for i in range(4):
+        a.record("allreduce", 3, "FLOAT32", 64, "0/0", 0)
+        b.record("allreduce", 3, "FLOAT32", 64, "0/0", 0)
+    sa, sb = {}, {}
+    out = kv_digest_exchange(kv, a, 3, 0, 2, state=sa)
+    assert out["posted"] == 1 and out["claims"] == 0
+    out = kv_digest_exchange(kv, b, 3, 1, 2, state=sb)
+    assert out["posted"] == 1 and out["claims"] == 1
+    assert kv_digest_exchange(kv, a, 3, 0, 2, state=sa)["claims"] == 1
+    assert a.check(3) is None and b.check(3) is None  # matched: quiet
+
+    # diverge the streams: next window's digests differ
+    a.record("allreduce", 3, "FLOAT32", 64, "0/0", 0)
+    b.record("allreduce", 3, "FLOAT32", 128, "0/0", 0)  # wrong count
+    for i in range(3):
+        a.record("allreduce", 3, "FLOAT32", 64, "0/0", 0)
+        b.record("allreduce", 3, "FLOAT32", 64, "0/0", 0)
+    kv_digest_exchange(kv, a, 3, 0, 2, state=sa)
+    kv_digest_exchange(kv, b, 3, 1, 2, state=sb)
+    kv_digest_exchange(kv, a, 3, 0, 2, state=sa)
+    verdict = a.check(3)
+    assert verdict is not None and verdict["basis"] == "pairwise"
+    assert verdict["diverging_rank"] == 1
+
+
+def test_kv_digest_exchange_tolerates_kv_failures():
+    """An unreachable/raisy KV degrades to counted errors — never an
+    exception into the executor."""
+    from accl_tpu.contract import ContractVerifier, kv_digest_exchange
+
+    class _DeadKV:
+        def key_value_set_bytes(self, key, value):
+            raise RuntimeError("kv unreachable")
+
+        def key_value_try_get_bytes(self, key):
+            raise RuntimeError("kv unreachable")
+
+    v = ContractVerifier(rank=0, world=2, interval=2)
+    v.begin_comm(1, 0, (0, 1))
+    v.record("barrier", 1, None, 0, "0/0", 0)
+    v.record("barrier", 1, None, 0, "0/0", 0)
+    out = kv_digest_exchange(_DeadKV(), v, 1, 0, 2, state={})
+    assert out["errors"] == 1 and out["posted"] == 0

@@ -85,7 +85,7 @@ def test_scrape_endpoints_smoke():
         status, body = _get(port, "/snapshot")
         assert status == 200
         snap = json.loads(body)
-        assert snap["schema_version"] == 2
+        assert snap["schema_version"] == 3
         for key in ("flight_recorder", "metrics", "stragglers",
                     "anomalies", "monitor", "health"):
             assert key in snap
@@ -522,6 +522,8 @@ def test_committed_capture_passes_monitor_gate():
     check_monitor(doc)
     assert doc["monitor"]["scrapes"] >= 1
     assert doc["monitor"]["routes_ok"] is True
+    # the committed capture predates the membership plane (schema 3):
+    # the artifact gate pins the version it was captured at
     assert doc["monitor"]["schema_version"] == 2
 
 
