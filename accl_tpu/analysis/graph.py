@@ -580,8 +580,132 @@ def check_cmdring_slot_layout(sources: List[SourceFile]) -> List[Finding]:
     return findings
 
 
+# ---------------------------------------------------------------------------
+# postmortem-path
+# ---------------------------------------------------------------------------
+
+#: facade error codes the postmortem plane covers: every ACCLError the
+#: facade raises with one of these must reach the BlackBox capture hook
+_POSTMORTEM_ERROR_CODES = frozenset((
+    "CONTRACT_VIOLATION", "RANK_EVICTED", "DEADLOCK_SUSPECTED",
+))
+
+#: a call whose terminal name is one of these counts as reaching the
+#: postmortem machinery
+_POSTMORTEM_NAMES = frozenset((
+    "_structured_failure", "capture",
+))
+
+#: the module the rule scopes to (the facade owns the covered raises;
+#: engines surface codes through Request retcodes, which the facade's
+#: _check_failed funnels)
+_POSTMORTEM_MODULE = "core.py"
+
+
+def _postmortem_code_of(node: ast.AST) -> Optional[str]:
+    """The covered ErrorCode name when ``node`` constructs
+    ``ACCLError(ErrorCode.<covered>, ...)``; None otherwise."""
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    name = (
+        f.id if isinstance(f, ast.Name)
+        else f.attr if isinstance(f, ast.Attribute) else None
+    )
+    if name != "ACCLError" or not node.args:
+        return None
+    code = node.args[0]
+    if (
+        isinstance(code, ast.Attribute)
+        and isinstance(code.value, ast.Name)
+        and code.value.id == "ErrorCode"
+        and code.attr in _POSTMORTEM_ERROR_CODES
+    ):
+        return code.attr
+    return None
+
+
+def check_postmortem_path(sources: List[SourceFile]) -> List[Finding]:
+    """Every facade construction of a covered structured-failure
+    ACCLError (CONTRACT_VIOLATION / RANK_EVICTED / DEADLOCK_SUSPECTED)
+    must reach the BlackBox hook (``_structured_failure`` /
+    ``capture``) within a depth-bounded walk of the same-module call
+    graph — the drain-before-config machinery applied to the
+    postmortem contract: a covered failure that skips the hook dies
+    with only the local flight-recorder tail, exactly the evidence
+    loss the bundle plane exists to remove."""
+    findings: List[Finding] = []
+    for src in sources:
+        if not src.path.replace("\\", "/").endswith(
+            "accl_tpu/" + _POSTMORTEM_MODULE
+        ):
+            continue
+        fns: Dict[str, List[ast.AST]] = {}
+        for node in src.nodes:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fns.setdefault(node.name, []).append(node)
+        called_cache: Dict[int, Set[str]] = {}
+
+        def _called(f):
+            got = called_cache.get(id(f))
+            if got is None:
+                got = called_cache[id(f)] = _called_names(f)
+            return got
+
+        for node in src.nodes:
+            code = _postmortem_code_of(node)
+            if code is None:
+                continue
+            # the function whose body constructs the error is the walk
+            # entry (innermost enclosing function)
+            entry = None
+            for name, defs in fns.items():
+                for fn in defs:
+                    if fn.lineno <= node.lineno <= getattr(
+                        fn, "end_lineno", fn.lineno
+                    ):
+                        if entry is None or fn.lineno > entry.lineno:
+                            entry = fn
+            if entry is None:
+                findings.append(src.finding(
+                    "postmortem-path", node,
+                    f"module-scope ACCLError(ErrorCode.{code}) can "
+                    f"never reach the BlackBox hook",
+                ))
+                continue
+            reached = False
+            seen: Set[int] = set()
+            frontier = [entry]
+            for _ in range(4):  # entry + 3 levels of same-module calls
+                nxt = []
+                for f in frontier:
+                    called = _called(f)
+                    if called & _POSTMORTEM_NAMES:
+                        reached = True
+                        break
+                    for c in called:
+                        for cand in fns.get(c, ()):
+                            if id(cand) not in seen:
+                                seen.add(id(cand))
+                                nxt.append(cand)
+                if reached or not nxt:
+                    break
+                frontier = nxt
+            if not reached:
+                findings.append(src.finding(
+                    "postmortem-path", node,
+                    f"{entry.name!r} raises ACCLError(ErrorCode.{code}) "
+                    f"but never reaches the BlackBox hook "
+                    f"({', '.join(sorted(_POSTMORTEM_NAMES))}); covered "
+                    f"structured failures must capture their evidence "
+                    f"bundle",
+                ))
+    return findings
+
+
 CROSS_FILE_CHECKS = {
     "jax-free-module": check_jax_free_modules,
     "drain-before-config": check_drain_before_config,
     "cmdring-slot-layout": check_cmdring_slot_layout,
+    "postmortem-path": check_postmortem_path,
 }

@@ -208,6 +208,25 @@ class BaseEngine:
         reused)."""
         self.skew_tracker = tracker
 
+    #: the facade's POSTMORTEM frame handler (None = postmortem off)
+    postmortem_handler = None
+
+    def set_postmortem(self, handler) -> None:
+        """Arm (or with ``None`` disarm) the postmortem plane's wire
+        solicitation on this engine.  Default: store the handle —
+        board-anchored tiers solicit in process over the anchored
+        registry; fabric tiers override to route POSTMORTEM frames to
+        the handler at delivery."""
+        self.postmortem_handler = handler
+
+    def trace_events(self) -> list:
+        """Engine-owned Chrome/Perfetto trace events merged into the
+        facade's export: ring-resident slot spans on the gang tier
+        (one span per slot, parented under its refill window and
+        flow-linked to the issuing call); [] on tiers with no engine-
+        resident execution to introspect."""
+        return []
+
     def skew_exchange_mode(self) -> str:
         """How this tier's straggler samples cross ranks: ``"board"``
         (shared in-process judge via ``contract_anchor()``), ``"wire"``

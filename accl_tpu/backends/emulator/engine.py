@@ -295,6 +295,15 @@ class EmuEngine(BaseEngine):
 
         return "board" if isinstance(self.fabric, InProcFabric) else "wire"
 
+    # -- postmortem plane (accl_tpu.monitor.BlackBox) -------------------------
+    def set_postmortem(self, handler) -> None:
+        """Route POSTMORTEM solicitation frames to the facade's
+        BlackBox handler at delivery — the wire half of the bundle
+        solicitation on one-process-per-rank fabrics (the board tiers
+        solicit in process and never send frames)."""
+        self.postmortem_handler = handler
+        self.endpoint.postmortem_hook = handler
+
     # -- membership plane (accl_tpu.membership) ------------------------------
     def set_membership(self, view) -> None:
         """Arm (or with ``None`` disarm) the membership plane: MEMBER

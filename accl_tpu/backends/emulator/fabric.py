@@ -56,6 +56,10 @@ class MsgType(enum.IntEnum):
     MEMBER = 8  # membership-plane agreement frame (JSON payload): the
     # shrink protocol's propose/confirm exchange on one-process-per-
     # rank fabrics (board-anchored tiers exchange in process instead)
+    POSTMORTEM = 9  # postmortem-bundle solicitation (JSON payload): a
+    # failing rank asks its peers for their evidence tails and peers
+    # reply best-effort within the requester's bounded deadline
+    # (board-anchored tiers solicit in process instead)
 
 
 @dataclasses.dataclass
@@ -100,6 +104,12 @@ class Message:
     # is the only clock two processes share; cross-host skew is
     # whatever NTP leaves (same-host fabrics are exact).
     sent_ns: int = 0
+    # causal trace plane (accl_tpu.telemetry): the sender's CURRENT
+    # collective trace id piggybacks on every message (one int; same
+    # one-probe-per-send discipline as vfy_/skw_) — receivers record a
+    # wire-hop flow step, so a merged timeline links send→recv across
+    # processes.  0 = unstamped (flows off, or no call in flight).
+    trc: int = 0
 
 
 class Endpoint:
@@ -125,6 +135,11 @@ class Endpoint:
         # membership plane: the receiving rank's agreement hook —
         # observes MEMBER propose/confirm frames at delivery
         self.membership_hook: Optional[Callable[[Message], None]] = None
+        # postmortem plane: the receiving rank's solicitation hook —
+        # observes POSTMORTEM request/reply frames at delivery (frames
+        # are consumed here, never parked in the inbox: they carry no
+        # collective matching signature)
+        self.postmortem_hook: Optional[Callable[[Message], None]] = None
         # wire-integrity accounting: payloads whose crc32 no longer matches
         # the stamped csum are discarded here (the rx dataplane's bit-error
         # detection; the sender's retransmit protocol recovers them)
@@ -174,6 +189,26 @@ class Endpoint:
                 shook(msg)
             except Exception:  # pragma: no cover - defensive
                 pass
+        if msg.trc:
+            # causal trace plane: a piggybacked trace id records one
+            # wire-hop flow step (sampled bounded ring — never raises,
+            # never drops traffic)
+            try:
+                from ...telemetry import wire_flow
+
+                wire_flow(msg.trc, msg.src, msg.dst, msg.comm_id)
+            except Exception:  # pragma: no cover - defensive
+                pass
+        if msg.msg_type == MsgType.POSTMORTEM:
+            phook = self.postmortem_hook
+            if phook is not None:
+                try:
+                    phook(msg)
+                except Exception:  # pragma: no cover - defensive
+                    pass
+            if self.on_activity is not None:
+                self.on_activity()
+            return
         if msg.msg_type == MsgType.RNDZV_DATA:
             with self._lock:
                 mem = self._wr_registry.pop(msg.vaddr)
@@ -282,6 +317,26 @@ class Fabric:
             for key in [k for k, v in stamps.items() if v is tracker]:
                 del stamps[key]
 
+    # -- causal trace plane (accl_tpu.telemetry flows) ------------------------
+    def register_trace(self, comm_id: int, rank: int, provider) -> None:
+        """Arm outbound trace-id stamping for (communicator, sending
+        rank): the send path piggybacks ``provider.trace_stamp(
+        comm_id)`` — the id assigned to that rank's latest collective
+        intake — onto every message it sends on the communicator,
+        exactly like the contract/skew stamps.  Best-effort by design:
+        a message of call k+1 racing call k's tail is window-grade
+        attribution, same as the skew stamp."""
+        stamps = getattr(self, "_trace_stamps", None)
+        if stamps is None:
+            stamps = self._trace_stamps = {}
+        stamps[(comm_id, rank)] = provider
+
+    def unregister_trace(self, provider) -> None:
+        stamps = getattr(self, "_trace_stamps", None)
+        if stamps:
+            for key in [k for k, v in stamps.items() if v is provider]:
+                del stamps[key]
+
     def attach(self, address: str, endpoint: Endpoint) -> None:
         raise NotImplementedError
 
@@ -313,6 +368,13 @@ class Fabric:
             if tracker is not None:
                 msg.skw_window, msg.skw_mean_us = tracker.stamp(msg.comm_id)
                 msg.sent_ns = time.time_ns()
+        traces = getattr(self, "_trace_stamps", None)
+        if traces:
+            # causal trace piggyback: the sending rank's current
+            # collective trace id (one dict probe when armed)
+            provider = traces.get((msg.comm_id, msg.src))
+            if provider is not None:
+                msg.trc = provider.trace_stamp(msg.comm_id)
         inj = self._injector
         if inj is None:
             self._transmit(address, msg)

@@ -203,7 +203,8 @@ class _WindowPark:
     side of the mailbox protocol)."""
 
     __slots__ = ("window_id", "event", "status", "results", "plans",
-                 "reqs_per_slot", "calls_per_slot", "t0", "settled")
+                 "reqs_per_slot", "calls_per_slot", "t0", "settled",
+                 "slots_info", "form", "logged")
 
     def __init__(self, window_id: int, plans, reqs_per_slot,
                  calls_per_slot, t0):
@@ -218,6 +219,12 @@ class _WindowPark:
         # session bookkeeping (written-ledger decrement, last_status)
         # done exactly once, by whichever completion path ran
         self.settled = False
+        # introspection: per-slot facts for the window log (seqn,
+        # opcode, the issuing call's trace id), the dispatch form
+        # (inline / mailbox), and the logged-once latch
+        self.slots_info: list = []
+        self.form = "inline"
+        self.logged = False
 
 
 class _ResidentRun:
@@ -341,6 +348,24 @@ class GangCommandRing:
         self.last_window = 0
         self.op_slots: Dict[str, int] = {}  # per-opcode residency
         self.fallbacks: Dict[str, int] = {}
+        # introspection plane: a bounded log of completed windows
+        # (per-slot seqn/opcode/retcode/trace-id next to the host-side
+        # timing — basis "host": neither lowering can write a device
+        # clock next to the status word on this mesh, and the snapshot
+        # says so instead of faking device time), a window-latency
+        # log2-us histogram, and the facade's failure hook (postmortem
+        # plane: run latch / drain deadline / dispatch error)
+        from collections import deque as _deque
+
+        try:
+            log_cap = int(os.environ.get("ACCL_CMDRING_WINDOW_LOG", "64"))
+        except ValueError:
+            log_cap = 64
+        self._window_log = _deque(maxlen=max(8, log_cap))
+        self.windows_logged = 0
+        self.window_latency: Dict[int, int] = {}
+        self.window_latency_sum_us = 0.0
+        self.on_failure = None
         # per-comm ring circuit breakers (membership plane): window
         # failures degrade that comm's dispatch ring -> inline -> host,
         # re-probing after a cool-down — a dying peer no longer needs a
@@ -399,6 +424,15 @@ class GangCommandRing:
     def stats(self) -> dict:
         breakers = self._breaker_snapshots()
         with self._lock:
+            live_mboxes = [
+                s.run.mbox for s in self._sessions.values()
+                if s.run is not None
+            ]
+        # mailbox locks taken OUTSIDE the ring lock (leaf discipline,
+        # like the breaker snapshots): queued-but-unpulled refill
+        # windows across every live run — how far the host runs ahead
+        mailbox_depth = sum(m.depth() for m in live_mboxes)
+        with self._lock:
             resident = any(
                 s.run is not None and s.run.mbox.accepting
                 for s in self._sessions.values()
@@ -438,6 +472,19 @@ class GangCommandRing:
                 "ops": dict(self.op_slots),
                 "fallbacks": dict(self.fallbacks),
                 "breakers": breakers,
+                # introspection plane: the refill-window timeline (per-
+                # slot seqn/opcode/retcode/trace-id, host-basis timing),
+                # the window-latency histogram, and the mailbox depth
+                "mailbox_depth": mailbox_depth,
+                "windows_logged": self.windows_logged,
+                "window_latency_sum_us": round(
+                    self.window_latency_sum_us, 3
+                ),
+                "window_latency_log2_us": {
+                    str(k): v
+                    for k, v in sorted(self.window_latency.items())
+                },
+                "windows": list(self._window_log)[-16:],
             }
 
     def _breaker_snapshots(self) -> dict:
@@ -696,6 +743,13 @@ class GangCommandRing:
 
                 traceback.print_exc()
                 brk.record_failure("dispatch_error")
+                # postmortem plane: a failed window DISPATCH is a ring
+                # failure too (the latch path covers in-flight wedges)
+                if self.on_failure is not None:
+                    try:
+                        self.on_failure(comm.id, "dispatch_error")
+                    except Exception:
+                        pass
                 dt = time.perf_counter_ns() - t0
                 for i in range(lo, npos):
                     for e in entries:
@@ -875,6 +929,22 @@ class GangCommandRing:
                 [calls for calls, _, _ in window],
                 t0,
             )
+            # introspection: per-slot facts captured at encode time —
+            # the (seqn, opcode) written into the ring words plus the
+            # issuing call's trace id (flow linkage into the merged
+            # timeline)
+            for k, (_calls, _, plan) in enumerate(window):
+                tid = None
+                for req in reqs_per_slot[k]:
+                    m = getattr(req, "_tmeta", None)
+                    if m and m.get("trace_id"):
+                        tid = m["trace_id"]
+                        break
+                park.slots_info.append({
+                    "seqn": int(slot_rows[k][_F["seqn"]]),
+                    "opcode": CMDRING_OPCODES[plan["op"]].name,
+                    "trace_id": tid,
+                })
             session.parks.append(park)
             for k, (calls, _, plan) in enumerate(window):
                 for r in plan["writers"]:
@@ -912,6 +982,7 @@ class GangCommandRing:
                     # ring -> inline degradation step: one-shot
                     # program, no persistent run to wedge)
                     payload = self._payload_rows(comm, window, shape)
+                    park.form = "mailbox"
                     run = self._post_or_dispatch(
                         comm, mesh, session, shape, window_id, slots_np,
                         payload,
@@ -1006,6 +1077,130 @@ class GangCommandRing:
                             session.written.pop(rid, None)
                         else:
                             session.written[rid] = left
+
+    def _log_window(self, comm_id: int, park: _WindowPark, status,
+                    end_ns: int, run=None, error=None) -> None:
+        """One completed (or failed) window into the bounded window
+        log: per-slot (seqn, opcode, retcode, trace id) next to the
+        host-side timing — basis ``"host"`` labeled honestly (neither
+        lowering can write a device clock next to its status words on
+        this mesh; the mailbox's posted/pulled/pushed stamps are the
+        closest observable refill timeline).  Logged exactly once per
+        window whichever completion path ran."""
+        from ...telemetry import _perf_to_epoch_us
+
+        with self._lock:
+            if park.logged:
+                return
+            park.logged = True
+        slots = []
+        for k, info in enumerate(park.slots_info):
+            ret = None
+            if status is not None and k < len(status):
+                ret = int(status[k][1])
+            slots.append(dict(info, retcode=ret))
+        t0_us = _perf_to_epoch_us(park.t0)
+        end_us = _perf_to_epoch_us(end_ns)
+        entry = {
+            "window_id": park.window_id,
+            "comm": comm_id,
+            "form": park.form,
+            "ts_us": round(t0_us, 3),
+            "dur_us": round(max(end_us - t0_us, 0.001), 3),
+            "slots": slots,
+            "basis": "host",
+        }
+        if error is not None:
+            entry["error"] = str(error)[:200]
+        if run is not None:
+            timing = run.mbox.take_timing(park.window_id)
+            if timing is not None:
+                entry["mailbox_us"] = {
+                    k2.replace("_ns", "_us"):
+                        round(_perf_to_epoch_us(v), 3)
+                    for k2, v in timing.items()
+                }
+        with self._lock:
+            self._window_log.append(entry)
+            self.windows_logged += 1
+            lat_us = max(end_us - t0_us, 0.001)
+            b = max(1, int(lat_us)).bit_length() - 1
+            self.window_latency[b] = self.window_latency.get(b, 0) + 1
+            self.window_latency_sum_us += lat_us
+
+    def window_log(self, last: Optional[int] = None) -> List[dict]:
+        with self._lock:
+            log = list(self._window_log)
+        return log if last is None else log[-last:]
+
+    def trace_events(self) -> List[dict]:
+        """The window log as Chrome/Perfetto events: one span per
+        refill window and one span per slot nested under it (cat
+        ``cmdring`` so merge_traces dedups the shared-gang rows), each
+        slot flow-linked (``f`` phase) to the issuing call's trace id —
+        intake→refill→window-execution→completion reads as connected
+        arrows in the merged timeline."""
+        pid = os.getpid()
+        events: List[dict] = []
+        log = self.window_log()
+        if not log:
+            return events
+        events.append({
+            "ph": "M", "name": "process_name", "pid": pid, "tid": 2,
+            "args": {"name": f"cmdring (pid {pid})"},
+        })
+        events.append({
+            "ph": "M", "name": "thread_name", "pid": pid, "tid": 2,
+            "args": {"name": "ring windows"},
+        })
+        for entry in log:
+            ts, dur = entry["ts_us"], entry["dur_us"]
+            events.append({
+                "name": f"cmdring::window[{len(entry['slots'])}]",
+                "cat": "cmdring",
+                "ph": "X",
+                "ts": ts,
+                "dur": dur,
+                "pid": pid,
+                "tid": 2,
+                "args": {
+                    k: v for k, v in entry.items() if k != "slots"
+                },
+            })
+            n = max(1, len(entry["slots"]))
+            for k, slot in enumerate(entry["slots"]):
+                # slots execute in order within the window: render
+                # them as equal sub-spans parented (by containment)
+                # under the refill window span
+                s_ts = ts + dur * k / n
+                s_dur = dur / n
+                events.append({
+                    "name": f"cmdring::{slot['opcode'].lower()}",
+                    "cat": "cmdring",
+                    "ph": "X",
+                    "ts": round(s_ts, 3),
+                    "dur": round(s_dur, 3),
+                    "pid": pid,
+                    "tid": 2,
+                    "args": dict(slot, window=entry["window_id"]),
+                })
+                if slot.get("trace_id"):
+                    # a STEP (`t`) on the issuing call's flow: the
+                    # arrow renders without claiming a flow END — the
+                    # call's own s/f pair lives on the rank rows, and
+                    # a slot whose issuing record rolled out of the
+                    # flight ring must not fail flow validation
+                    events.append({
+                        "name": "accl::flow",
+                        "cat": "cmdring",
+                        "ph": "t",
+                        "id": f"0x{slot['trace_id']:08x}",
+                        "ts": round(s_ts + s_dur / 2, 3),
+                        "pid": pid,
+                        "tid": 2,
+                        "args": {"window": entry["window_id"]},
+                    })
+        return events
 
     def _make_window_done(self, comm_id: int):
         """Completion hook one mailbox carries: adopt results (deferred
@@ -1196,13 +1391,15 @@ class GangCommandRing:
                             "deadline)"
                         )
 
-        def on_ready(overlap_ns, depth, ready_ns, park=park, t0=t0):
+        def on_ready(overlap_ns, depth, ready_ns, park=park, t0=t0,
+                     run=run):
             # the xla mailbox path completed the requests on the run
             # thread already (on_window_done, the latency path); this
             # settles anything still pending (the pallas backlog path,
             # torn-down sessions) and the window-plane accounting
             sv = park.status
             dt = max(ready_ns - t0, 1)
+            self._log_window(comm.id, park, sv, ready_ns, run=run)
             window_done()
             # a completed window closes (or restores) the comm's ring
             # circuit breaker — per-slot BAD_OP retcodes are opcode
@@ -1225,6 +1422,18 @@ class GangCommandRing:
 
         def on_error(exc, park=park, run=run, t0=t0, comm_id=comm.id):
             dt = max(time.perf_counter_ns() - t0, 1)
+            err = f"{type(exc).__name__}: {exc}"
+            self._log_window(
+                comm_id, park, park.status, time.perf_counter_ns(),
+                run=run, error=err,
+            )
+            # postmortem plane: the ring failure latch — the facade's
+            # BlackBox captures the window log + flight evidence
+            if self.on_failure is not None:
+                try:
+                    self.on_failure(comm_id, err)
+                except Exception:  # must never mask the failure path
+                    pass
             window_done()
             # window failure (run latch, drain deadline, dispatch
             # error): strike the comm's ring breaker — repeated strikes

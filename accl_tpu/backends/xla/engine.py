@@ -2076,6 +2076,14 @@ class XLAEngine(StreamPortMixin, BaseEngine):
             "skew_exchange": "board",
         }
 
+    def trace_events(self) -> list:
+        """Ring-resident spans (one per slot, nested under its refill
+        window, flow-linked to the issuing call) — the gang tier's
+        engine-owned rows in the facade's Perfetto export.  Every rank
+        handle shares the gang, so every rank file embeds the same
+        rows; merge_traces dedups them to one copy (cat ``cmdring``)."""
+        return self.gang.cmdring.trace_events()
+
     def health_report(self, comm: Communicator) -> Dict[int, dict]:
         """Per-peer health from the gang watchdog accounting, keyed by
         comm-relative rank (capabilities()["health"] on the gang tier)."""

@@ -289,6 +289,27 @@ class SequencerMailbox:
         self._accepted = 0
         self._halted = False
         self.drained = threading.Event()  # every rank pulled a HALT
+        # per-window host-side timing (the introspection basis where
+        # the lowering can't write device timestamps next to the
+        # status words — labeled "host" honestly in every surface):
+        # posted_ns (refill doorbell), pulled_ns (first rank's fetch —
+        # the device-side dequeue point), pushed_ns (last rank's
+        # status writeback).  Bounded: entries are pruned once read by
+        # the session's window log.
+        self._timings: Dict[int, Dict[str, int]] = {}
+
+    # -- introspection -------------------------------------------------------
+    def depth(self) -> int:
+        """Queued refill windows not yet pulled (the mailbox-depth
+        gauge: how far the host runs ahead of the sequencer)."""
+        with self._lock:
+            return len(self._queue)
+
+    def take_timing(self, window_id: int) -> Optional[Dict[str, int]]:
+        """The window's host-side timing record, removed (the window
+        log consumes it exactly once)."""
+        with self._lock:
+            return self._timings.pop(int(window_id), None)
 
     # -- host side -----------------------------------------------------------
     def post(self, window_id: int, slots: np.ndarray, payload) -> bool:
@@ -300,6 +321,12 @@ class SequencerMailbox:
                 return False
             self._accepted += 1
             self._queue.append(_PostedWindow(window_id, slots, payload))
+            self._timings[int(window_id)] = {
+                "posted_ns": time.perf_counter_ns()
+            }
+            if len(self._timings) > 4 * self.run_windows:
+                for k in sorted(self._timings)[: -2 * self.run_windows]:
+                    del self._timings[k]
             self._cv.notify_all()
             return True
 
@@ -330,7 +357,13 @@ class SequencerMailbox:
             self._pull_cursor[r] += 1
             while len(self._decisions) <= step:
                 if self._queue:
-                    self._decisions.append(self._queue.pop(0))
+                    nxt = self._queue.pop(0)
+                    t = self._timings.get(nxt.window_id)
+                    if t is not None and "pulled_ns" not in t:
+                        # the device-side dequeue point (host clock —
+                        # the pull trampoline runs on the host)
+                        t["pulled_ns"] = time.perf_counter_ns()
+                    self._decisions.append(nxt)
                     break
                 if self._halted:
                     self._decisions.append(None)
@@ -402,6 +435,9 @@ class SequencerMailbox:
                 win.pushed += 1
                 if win.pushed == self.size:
                     done = win
+                    t = self._timings.get(win.window_id)
+                    if t is not None:
+                        t["pushed_ns"] = time.perf_counter_ns()
             self._cv.notify_all()
         if done is not None and self.on_window_done is not None:
             self.on_window_done(done.window_id, done.status, done.results)

@@ -47,7 +47,14 @@ from . import membership as _mbr
 from .overlap import drain_deadline_s
 from .plans import CollectivePlan, PlanCache, size_bucket
 from .request import Request
-from .telemetry import Telemetry, chrome_trace, to_json, to_prometheus
+from .telemetry import (
+    Telemetry,
+    chrome_trace,
+    collective_trace_id,
+    p2p_trace_id,
+    to_json,
+    to_prometheus,
+)
 
 DTypeLike = Union[DataType, str, np.dtype, type]
 
@@ -163,6 +170,61 @@ class ACCL:
         self._demoted_seen: set = set()  # (comm, rank) demotions counted
         engine.set_membership(self._membership)
         engine.on_health_transition = self._on_health_transition
+        # causal trace plane (accl_tpu.telemetry): deterministic
+        # trace/span ids assigned at facade intake — per-comm collective
+        # seqn counters plus directed p2p channel counters, both
+        # SPMD-uniform so every rank of a collective derives the SAME
+        # id with zero wire bytes; the generation re-keys on soft_reset
+        # like the contract digests.  _trace_last is the lock-free wire
+        # piggyback stamp (Fabric.register_trace).
+        self._trace_seq: dict = {}
+        self._p2p_seq: dict = {}
+        self._trace_gen = 1
+        self._trace_last: dict = {}
+        self._batch_trace = None
+        self._batch_ctr = 0
+        fabric = getattr(engine, "fabric", None)
+        if self._telemetry is not None and fabric is not None and hasattr(
+            fabric, "register_trace"
+        ):
+            fabric.register_trace(self._world.id, local_rank, self)
+        # postmortem plane (accl_tpu.monitor.BlackBox): automatic
+        # evidence bundles on structured failures.  In-process peers
+        # solicit over an anchored registry (the contract-board
+        # discipline); one-process-per-rank fabrics use POSTMORTEM wire
+        # frames with a bounded best-effort wait.  Disabled (one None
+        # check per failure) unless ACCL_POSTMORTEM_DIR is set.
+        self._blackbox = None
+        if self._telemetry is not None:
+            from . import monitor as _monitor
+            from .contract import anchored as _anchored
+
+            pm_registry = _anchored(
+                anchor, "_accl_blackbox_registry", dict
+            )
+            session = ranks[local_rank].session
+            if pm_registry is not None:
+                pm_registry[session] = self._postmortem_evidence
+            self._blackbox = _monitor.BlackBox(
+                rank=session, world=len(ranks),
+                evidence_fn=self._postmortem_evidence,
+                peers_fn=(
+                    (lambda reg=pm_registry: reg)
+                    if pm_registry is not None else None
+                ),
+                solicit_fn=(
+                    self._postmortem_solicit
+                    if pm_registry is None and fabric is not None
+                    else None
+                ),
+                metrics=self._telemetry.metrics,
+            )
+            engine.set_postmortem(self._on_postmortem_frame)
+            # command-ring failure latch → postmortem hook (the run
+            # latch / drain deadline / dispatch error paths)
+            ring = getattr(getattr(engine, "gang", None), "cmdring", None)
+            if ring is not None:
+                ring.on_failure = self._on_ring_failure
         self._initialize(timeout_s, max_eager_size, max_rendezvous_size)
         if _verify_env():
             self.set_contract_verify(True)
@@ -329,6 +391,17 @@ class ACCL:
                 self._monitor.tracker.begin_comm(
                     comm.id, comm.local_rank, comm.size
                 )
+        # causal trace plane: a new generation re-keys every trace id
+        # (collective by contract, like the verifier's generation — so
+        # post-reset ids keep matching across ranks and never collide
+        # with pre-reset ones), and the postmortem latches clear (a
+        # fresh regime's failures deserve fresh bundles)
+        self._trace_gen += 1
+        self._trace_seq.clear()
+        self._p2p_seq.clear()
+        self._trace_last.clear()
+        if self._blackbox is not None:
+            self._blackbox.reset()
 
     def set_timeout(self, seconds: float) -> None:
         self._config(ConfigFunction.SET_TIMEOUT, seconds)
@@ -474,10 +547,10 @@ class ACCL:
         mv = self._membership
         if session == self._world.ranks[self._world.local_rank].session:
             mv.propose({session}, reason="evict_rank_self")
-            raise ACCLError(
+            raise self._structured_failure(ACCLError(
                 ErrorCode.RANK_EVICTED, "evict_rank",
                 details={"membership": mv.evidence(), "rank": rank},
-            )
+            ))
         mv.propose({session}, reason="evict_rank")
         plan = mv.wait_confirmed(timeout=_mbr.env_confirm_s())
         if plan is None:
@@ -520,6 +593,113 @@ class ACCL:
                 ))
             except Exception:
                 pass  # a dead/partitioned peer: nothing to tell
+
+    # -- postmortem plane (accl_tpu.monitor.BlackBox) -------------------------
+    def _postmortem_evidence(self) -> dict:
+        """This rank's evidence for a bundle: the flight-recorder tail
+        plus the full merged telemetry snapshot (which carries the
+        ring/mailbox state, the membership event ring, skew baselines
+        and contract window digests).  Called from the failing thread
+        locally and from peers' capture paths (board registry / wire
+        request) — must stay bounded and side-effect-free."""
+        tel = self._telemetry
+        return {
+            "rank": self._world.local_rank,
+            "session": self._world.ranks[self._world.local_rank].session,
+            "tier": type(self.engine).__name__,
+            "flight_recorder": tel.tail_dicts(64) if tel else [],
+            "snapshot": self.telemetry_snapshot(),
+        }
+
+    def _postmortem_solicit(self, token: int) -> int:
+        """Wire solicitation (one-process-per-rank fabrics): POSTMORTEM
+        request frames to every surviving world peer; replies land via
+        the engine's postmortem hook.  Returns how many peers were
+        asked — the BlackBox's bounded wait counts replies against it,
+        and a dead peer simply never answers (documented absent)."""
+        fabric = getattr(self.engine, "fabric", None)
+        if fabric is None:
+            return 0
+        import json as _json
+
+        from .backends.emulator.fabric import Message, MsgType
+
+        comm = self._world
+        me = comm.ranks[comm.local_rank]
+        payload = _json.dumps({
+            "kind": "request", "token": int(token),
+            "reply_to": me.address, "rank": me.session,
+        }).encode()
+        n = 0
+        for i, r in enumerate(comm.ranks):
+            if i == comm.local_rank or r.session in self._membership.evicted:
+                continue
+            try:
+                fabric.send(r.address, Message(
+                    MsgType.POSTMORTEM, comm.id, comm.local_rank, i, 0,
+                    payload=payload,
+                ))
+                n += 1
+            except Exception:
+                pass  # dead/partitioned peer: documented absent
+        return n
+
+    def _on_postmortem_frame(self, msg) -> None:
+        """POSTMORTEM wire frames (fabric delivery thread): a peer's
+        request gets this rank's evidence back best-effort; a reply
+        feeds the bounded collection of our own in-flight capture."""
+        bb = self._blackbox
+        if bb is None:
+            return
+        import json as _json
+
+        try:
+            payload = _json.loads(msg.payload.decode())
+        except (ValueError, UnicodeDecodeError):
+            return
+        kind = payload.get("kind")
+        if kind == "reply":
+            bb.deliver_reply(
+                payload.get("token", 0), payload.get("rank", -1),
+                payload.get("evidence") or {},
+            )
+            return
+        if kind != "request":
+            return
+        fabric = getattr(self.engine, "fabric", None)
+        reply_to = payload.get("reply_to")
+        if fabric is None or not reply_to:
+            return
+        try:
+            evidence = self._postmortem_evidence()
+        except Exception as e:  # half evidence beats a dropped reply
+            evidence = {"error": f"{type(e).__name__}: {e}"[:200]}
+        from .backends.emulator.fabric import Message, MsgType
+
+        me = self._world.ranks[self._world.local_rank]
+        body = _json.dumps({
+            "kind": "reply", "token": payload.get("token", 0),
+            "rank": me.session, "evidence": evidence,
+        }, default=str).encode()
+        try:
+            fabric.send(reply_to, Message(
+                MsgType.POSTMORTEM, msg.comm_id, msg.dst, msg.src, 0,
+                payload=body,
+            ))
+        except Exception:
+            pass  # requester died mid-capture: nothing to tell
+
+    def _on_ring_failure(self, comm_id: int, error: str) -> None:
+        """Command-ring failure latch (run latch / drain deadline /
+        dispatch error): capture the ring's postmortem evidence — the
+        window that wedged is in the ring's window log and the
+        requests' flight records ride the snapshot."""
+        if self._blackbox is not None:
+            self._blackbox.capture(
+                "RING_FAILURE", f"cmdring comm {comm_id}",
+                details={"comm": comm_id, "error": error},
+                key=("RING_FAILURE", comm_id),
+            )
 
     def _on_health_transition(self, peer, old: str, new: str) -> None:
         """Engine health-map transition hook (engine scheduler / gang
@@ -613,6 +793,11 @@ class ACCL:
                     fabric.register_skew(
                         comm.id, comm.local_rank, self._monitor.tracker
                     )
+            if self._telemetry is not None and fabric is not None and (
+                hasattr(fabric, "register_trace")
+            ):
+                # the shrunk comm's new local rank stamps trace ids
+                fabric.register_trace(comm.id, comm.local_rank, self)
         self.engine.on_membership_cutover(
             plan, addresses=tuple(sorted(set(addresses))),
             comm_ids=tuple(shrunk_ids),
@@ -624,6 +809,18 @@ class ACCL:
             self._health_events.note(s, "dead", "evicted")
         if self._telemetry is not None:
             self._telemetry.metrics.inc("accl_membership_evictions_total")
+        if self._blackbox is not None:
+            # membership cutover is a covered structured-failure path:
+            # the evidence (who voted, who died, the pre-shrink tails)
+            # is exactly what ROADMAP's p99 forensics need collected
+            # automatically.  Latched on the membership epoch — the
+            # RANK_EVICTED raise paths share the key, so one eviction
+            # yields ONE bundle however many paths observe it.
+            self._blackbox.capture(
+                "RANK_EVICTED", "membership_cutover",
+                details={"plan": plan},
+                key=("RANK_EVICTED", self._membership.epoch),
+            )
         return plan
 
     def _membership_intake(self, options: CallOptions,
@@ -640,10 +837,10 @@ class ACCL:
             options.op in self._CONTRACT_OPS
             or options.op in (Operation.SEND, Operation.RECV)
         ):
-            raise ACCLError(
+            raise self._structured_failure(ACCLError(
                 ErrorCode.RANK_EVICTED, context,
                 details={"membership": mv.evidence(), "comm": comm.id},
-            )
+            ))
         if mv.elastic and mv.cutover_ready() and self._pending is None:
             self._apply_cutover()
 
@@ -678,7 +875,9 @@ class ACCL:
         }
         if self._telemetry is not None:
             details["flight_recorder"] = self._telemetry.tail_dicts()
-        raise ACCLError(ErrorCode.RANK_EVICTED, context, details=details)
+        raise self._structured_failure(ACCLError(
+            ErrorCode.RANK_EVICTED, context, details=details,
+        ))
 
     def _barrier_root(self, comm: Communicator) -> int:
         """The barrier's internal gather root, re-routed around demoted
@@ -981,6 +1180,12 @@ class ACCL:
                     fabric.register_skew(
                         comm.id, comm.local_rank, self._monitor.tracker
                     )
+            if self._telemetry is not None:
+                fabric = getattr(self.engine, "fabric", None)
+                if fabric is not None and hasattr(
+                    fabric, "register_trace"
+                ):
+                    fabric.register_trace(comm.id, comm.local_rank, self)
             if self._contract is not None:
                 # register membership + fold a begin marker into the
                 # digest stream (a rank that re-creates a subcomm its
@@ -1055,6 +1260,14 @@ class ACCL:
             from .request import CommandQueue
 
             self._pending = CommandQueue()
+            # batch parent span id: deterministic from the per-handle
+            # batch counter (batches are collective by contract, so
+            # every rank's counter agrees) — queued calls' flow events
+            # step on it, nesting the fused window under one parent
+            self._batch_ctr += 1
+            self._batch_trace = collective_trace_id(
+                "__batch__", 0, self._trace_gen, self._batch_ctr
+            )
 
     def flush(self) -> None:
         """Dispatch everything queued in the open batch, then drain the
@@ -1108,6 +1321,7 @@ class ACCL:
         self._batch_depth = 0
         self.flush()
         self._pending = None
+        self._batch_trace = None
 
     def batch(self):
         """Context manager form::
@@ -1129,6 +1343,82 @@ class ACCL:
 
         return _cm()
 
+    # -- causal trace plane (accl_tpu.telemetry flows) -----------------------
+    def _assign_trace(self, options: CallOptions) -> tuple:
+        """(trace_id, flow_phase, parent_id) for one call at intake.
+
+        Collectives derive ``collective_trace_id`` from the per-comm
+        intake counter (SPMD-uniform: every rank issues the contract
+        ops in matching order, the invariant the contract plane
+        verifies); plain SEND/RECV derive ``p2p_trace_id`` from the
+        directed channel's match counter (sends and receives on one
+        (comm, src, dst, tag) channel match strictly in order).
+        Stream-port p2p variants get no flow phase — their far end
+        never posts a matching CallRecord.  The flow phase is this
+        rank's role in the merged flow: lowest comm rank starts (s),
+        highest finishes (f), middles step (t)."""
+        comm = options.comm
+        if comm is None:
+            return None, None, None
+        parent = getattr(self._call_tls, "parent_trace", None)
+        if parent is None and self._pending is not None:
+            parent = self._batch_trace
+        op = options.op
+        if op in self._CONTRACT_OPS:
+            tid, phase = self._derive_collective_trace(
+                op.name.lower(), comm
+            )
+            return tid, phase, parent
+        if op in (Operation.SEND, Operation.RECV):
+            if op == Operation.SEND:
+                src, dst = comm.local_rank, options.root_dst
+            else:
+                src, dst = options.root_src, comm.local_rank
+            key = (comm.id, src, dst, options.tag, int(options.stream))
+            seqn = self._p2p_seq.get(key, 0)
+            self._p2p_seq[key] = seqn + 1
+            tid = p2p_trace_id(
+                comm.id, src, dst, options.tag, seqn,
+                stream=int(options.stream),
+            )
+            self._trace_last[comm.id] = tid
+            phase = None
+            if options.stream == StreamFlags.NO_STREAM:
+                phase = "s" if op == Operation.SEND else "f"
+            return tid, phase, parent
+        return None, None, parent
+
+    def _derive_collective_trace(self, op_name: str, comm) -> tuple:
+        """(trace_id, flow_phase) for one collective: consume the
+        comm's SPMD-uniform intake counter, derive the deterministic
+        id, stamp the wire-piggyback slot, and pick this rank's flow
+        role.  THE one implementation — single calls and pipelined
+        aggregates must share it, or their cross-rank ids/phases
+        silently diverge (the exact failure flow validation reports)."""
+        seqn = self._trace_seq.get(comm.id, 0)
+        self._trace_seq[comm.id] = seqn + 1
+        tid = collective_trace_id(
+            op_name, comm.id, self._trace_gen, seqn
+        )
+        self._trace_last[comm.id] = tid
+        if comm.size < 2:
+            phase = None
+        elif comm.local_rank == 0:
+            phase = "s"
+        elif comm.local_rank == comm.size - 1:
+            phase = "f"
+        else:
+            phase = "t"
+        return tid, phase
+
+    def trace_stamp(self, comm_id: int) -> int:
+        """The wire piggyback provider (``Fabric.register_trace``):
+        this rank's latest intake trace id on the communicator, 0 when
+        none.  Lock-free read on the per-send hot path — values are
+        ints replaced whole, a racing reader sees old or new (both
+        valid window-grade attribution, like the skew stamp)."""
+        return self._trace_last.get(comm_id, 0)
+
     def _call_meta(self, options: CallOptions) -> dict:
         """The CallRecord facts known at launch (accl_tpu.telemetry):
         resolved once per call — a handful of attribute reads, no device
@@ -1136,7 +1426,11 @@ class ACCL:
         comm = options.comm
         plan = options.plan
         dt = options.arithcfg.uncompressed if options.arithcfg else None
+        trace_id, trace_phase, parent_id = self._assign_trace(options)
         return {
+            "trace_id": trace_id,
+            "trace_phase": trace_phase,
+            "parent_id": parent_id,
             "op": options.op.name.lower(),
             "comm": comm.id if comm is not None else None,
             "epoch": comm.epoch if comm is not None else None,
@@ -1162,6 +1456,51 @@ class ACCL:
             "eager": plan.eager if plan is not None else None,
         }
 
+    #: structured-failure codes the postmortem plane covers: every
+    #: facade raise of one of these reaches the BlackBox hook (machine-
+    #: checked by acclint's postmortem-path rule)
+    _POSTMORTEM_CODES = (
+        ErrorCode.CONTRACT_VIOLATION
+        | ErrorCode.RANK_EVICTED
+        | ErrorCode.DEADLOCK_SUSPECTED
+    )
+
+    def _structured_failure(self, err: ACCLError) -> ACCLError:
+        """The postmortem hook every covered structured-failure path
+        funnels through: capture an evidence bundle (one per failure —
+        latched) and name it in ``ACCLError.details["postmortem"]``.
+        No-op (one None/flag check) when the plane is disabled."""
+        bb = self._blackbox
+        if bb is None or not bb.enabled:
+            return err
+        if not (err.code & self._POSTMORTEM_CODES):
+            return err
+        if err.code & ErrorCode.RANK_EVICTED:
+            code_name = "RANK_EVICTED"
+            # one eviction = one bundle, however many paths observe it
+            # (the cutover hook, the intake screen, the post-failure
+            # gate): latch on the epoch the eviction HAS ONCE APPLIED —
+            # take_cutover bumps the epoch at plan consumption, so a
+            # raise observing the confirmed-but-unapplied plan must key
+            # one ahead to collapse onto the cutover hook's bundle
+            mv = self._membership
+            key = (
+                "RANK_EVICTED",
+                mv.epoch + (1 if mv.cutover_ready() else 0),
+            )
+        elif err.code & ErrorCode.CONTRACT_VIOLATION:
+            code_name = "CONTRACT_VIOLATION"
+            key = (code_name, err.details.get("comm"))
+        else:
+            code_name = "DEADLOCK_SUSPECTED"
+            key = (code_name, self._trace_gen)
+        path = bb.capture(
+            code_name, context=str(err), details=err.details, key=key
+        )
+        if path is not None:
+            err.details["postmortem"] = path
+        return err
+
     def _deadlock_error(self, context: str) -> ACCLError:
         """DEADLOCK_SUSPECTED with the flight-recorder tail attached —
         the watchdog/timeout paths ship their recent history too."""
@@ -1169,8 +1508,9 @@ class ACCL:
         if self._telemetry is not None:
             self._telemetry.metrics.inc("accl_deadlock_suspected_total")
             details = {"flight_recorder": self._telemetry.tail_dicts()}
-        return ACCLError(ErrorCode.DEADLOCK_SUSPECTED, context,
-                         details=details)
+        return self._structured_failure(ACCLError(
+            ErrorCode.DEADLOCK_SUSPECTED, context, details=details,
+        ))
 
     def _seg_tag(self) -> int:
         """The reserved wire tag for the pipelined segment currently
@@ -1247,9 +1587,16 @@ class ACCL:
             outer._pre_wait = _pw
         tel = self._telemetry
         meta = None
+        agg_tid = None
         if tel is not None:
             # the aggregate's CallRecord covers the FULL payload; each
-            # segment also records itself (honest per-launch history)
+            # segment also records itself (honest per-launch history).
+            # The aggregate consumes one trace-seq slot like any
+            # collective (the split is SPMD-uniform, so every rank's
+            # counters stay aligned) and parents its segments' spans.
+            agg_tid, agg_phase = self._derive_collective_trace(
+                op_name, comm
+            )
             dt = plan.arithcfg.uncompressed
             meta = {
                 "op": op_name, "comm": comm.id, "epoch": comm.epoch,
@@ -1259,9 +1606,16 @@ class ACCL:
                 "bucket": plan.bucket, "algorithm": plan.algorithm,
                 "plan_hit": getattr(self._call_tls, "plan_hit", None),
                 "eager": plan.eager,
+                "trace_id": agg_tid,
+                "trace_phase": agg_phase,
+                "parent_id": (
+                    self._batch_trace if self._pending is not None
+                    else None
+                ),
             }
         t0 = time.perf_counter_ns()
         self._call_tls.pipelining = True
+        self._call_tls.parent_trace = agg_tid
         try:
             inner = []
             for i, (s0, s1) in enumerate(bounds):
@@ -1272,6 +1626,7 @@ class ACCL:
         finally:
             self._call_tls.pipelining = False
             self._call_tls.pipeline_tag = 0
+            self._call_tls.parent_trace = None
 
         def _resolve(inner=inner):
             for q in inner:
@@ -1319,7 +1674,7 @@ class ACCL:
             return outer
         if not outer.wait(timeout=drain_deadline_s(self._timeout_s)):
             raise self._deadlock_error(context)
-        outer.check(context)
+        self._check_failed(outer, context)
         return outer
 
     #: operations under the cross-rank sequence contract: every rank of
@@ -1337,9 +1692,9 @@ class ACCL:
         details = verdict_context(verdict, context)
         if self._telemetry is not None:
             details["flight_recorder"] = self._telemetry.tail_dicts()
-        return ACCLError(
+        return self._structured_failure(ACCLError(
             ErrorCode.CONTRACT_VIOLATION, context, details=details
-        )
+        ))
 
     def _contract_gate(self, options: CallOptions, context: str) -> None:
         """Contract-plane intake: fingerprint this collective into the
@@ -1373,11 +1728,15 @@ class ACCL:
         tel = self._telemetry
         self._membership_intake(options, context)
         self._contract_gate(options, context)
+        # trace/span id assigned at INTAKE — before dispatch — so the
+        # fabric's outbound trace stamp covers this call's own wire
+        # traffic, not just its successors'
+        meta = self._call_meta(options) if tel is not None else None
         if self._pending is not None:
             req = Request(op_name=options.op.name)
             req._pre_wait = self._dispatch_pending  # dispatch on wait
             if tel is not None:
-                tel.attach(req, self._call_meta(options))
+                tel.attach(req, meta)
             self._pending.push((options, req))
             if run_async:
                 return req
@@ -1389,13 +1748,13 @@ class ACCL:
             if not req.wait(timeout=drain_deadline_s(self._timeout_s)):
                 raise self._deadlock_error(context)
             self._membership_after_failure(options, req, context)
-            req.check(context)
+            self._check_failed(req, context)
             return req
         req = self.engine.start(options)
         if tel is not None:
             # attach AFTER start: engines that complete synchronously
             # inside start() are recorded immediately by attach()
-            tel.attach(req, self._call_meta(options))
+            tel.attach(req, meta)
         if run_async:
             return req
         # facade-level deadline follows the shared drain policy so the
@@ -1405,8 +1764,19 @@ class ACCL:
         if not req.wait(timeout=drain_deadline_s(self._timeout_s)):
             raise self._deadlock_error(context)
         self._membership_after_failure(options, req, context)
-        req.check(context)
+        self._check_failed(req, context)
         return req
+
+    def _check_failed(self, req: Request, context: str) -> None:
+        """``Request.check`` with the postmortem hook: a structured
+        failure surfacing through the sync path (the engine converts
+        peer death to RANK_EVICTED, a relayed contract verdict fails
+        the in-flight call, ...) captures its evidence bundle before
+        it propagates."""
+        try:
+            req.check(context)
+        except ACCLError as e:
+            raise self._structured_failure(e)
 
     @staticmethod
     def _check_rank(comm: Communicator, rank: int) -> None:
@@ -2129,6 +2499,12 @@ class ACCL:
                 mon.service_snapshot() if mon is not None
                 else {"serving": False}
             ),
+            # postmortem plane: bundle accounting (the one-line answer
+            # to "did the failure leave evidence, and where?")
+            "postmortem": (
+                self._blackbox.snapshot()
+                if self._blackbox is not None else {"enabled": False}
+            ),
         }
 
     def _annotated_health(self, comm: Communicator) -> dict:
@@ -2153,11 +2529,19 @@ class ACCL:
 
     def telemetry_trace_events(self) -> list:
         """This rank's flight-recorder records (plus buffered wire
-        events) as Chrome/Perfetto trace events; [] when telemetry is
+        events and the engine's ring-resident spans — the command
+        ring's per-slot window timeline, flow-linked to the issuing
+        calls) as Chrome/Perfetto trace events; [] when telemetry is
         disabled."""
         if self._telemetry is None:
             return []
-        return self._telemetry.chrome_events()
+        events = self._telemetry.chrome_events()
+        try:
+            events.extend(self.engine.trace_events())
+        except Exception:  # a ring render bug must not kill the export
+            pass
+        events.sort(key=lambda e: e.get("ts", 0.0))
+        return events
 
     def start_monitor(self, port: Optional[int] = None) -> int:
         """Start the live scrape service for this rank handle: a stdlib
@@ -2185,17 +2569,95 @@ class ACCL:
 
             return _json.dumps(chrome_trace(self.telemetry_trace_events()))
 
+        def _cmdring_doc() -> str:
+            import json as _json
+
+            ring = self.engine.telemetry_report().get("cmdring")
+            return _json.dumps(
+                ring if ring is not None else {"enabled": False},
+                default=str,
+            )
+
         srv = _monitor.MonitorServer({
+            "/": (self._monitor_index, "text/plain; charset=utf-8"),
             "/metrics": (
                 self.telemetry_prometheus,
                 "text/plain; version=0.0.4; charset=utf-8",
             ),
             "/snapshot": (self.telemetry_json, "application/json"),
             "/trace": (_trace_doc, "application/json"),
+            "/cmdring": (_cmdring_doc, "application/json"),
         }, port=int(port))
         srv.start()
         self._monitor.server = srv
         return srv.port
+
+    def _monitor_index(self) -> str:
+        """The monitor's ``/`` page: route links plus a live one-screen
+        health summary — ring sessions, postmortem bundle count, and
+        the last verdict lines (stragglers / anomalies / membership) —
+        so a bare browser hit answers "is this mesh healthy" without
+        curl-ing three routes."""
+        lines = [
+            f"accl monitor — rank {self._world.local_rank}/"
+            f"{self._world.size} ({type(self.engine).__name__})",
+            "routes: /metrics /snapshot /trace /cmdring",
+            "",
+        ]
+        ring = self.engine.telemetry_report().get("cmdring") or {}
+        if ring:
+            lines.append(
+                f"cmdring: state={ring.get('state', '?')} "
+                f"refills={ring.get('refills', 0)} "
+                f"dispatches={ring.get('dispatches', 0)} "
+                f"mailbox_depth={ring.get('mailbox_depth', 0)} "
+                f"fallbacks={sum((ring.get('fallbacks') or {}).values())}"
+            )
+        else:
+            lines.append("cmdring: (tier has no command ring)")
+        bb = self._blackbox.snapshot() if self._blackbox else {}
+        lines.append(
+            f"postmortem: bundles={bb.get('bundles_written', 0)} "
+            f"last={bb.get('last_bundle') or '-'}"
+            if bb.get("enabled")
+            else "postmortem: disabled (set ACCL_POSTMORTEM_DIR)"
+        )
+        strag = (
+            self._monitor.straggler_snapshot()
+            if self._monitor is not None else {}
+        )
+        standing = strag.get("standing") or {}
+        if standing:
+            for c, v in sorted(standing.items()):
+                lines.append(
+                    f"straggler: comm {c} slow_rank={v.get('rank')} "
+                    f"ewma={v.get('ewma_latency_us')}us "
+                    f"streak={v.get('streak')}"
+                )
+        else:
+            lines.append("straggler: none standing")
+        anom = (
+            self._monitor.anomaly_snapshot()
+            if self._monitor is not None else {}
+        )
+        alerts = anom.get("alerts") or []
+        if alerts:
+            a = alerts[-1]
+            lines.append(
+                f"anomaly: {a.get('op')}/b{a.get('size_bucket')} "
+                f"{a.get('duration_us')}us vs baseline "
+                f"{a.get('baseline_us')}us "
+                f"(total {anom.get('alerts_total', 0)})"
+            )
+        else:
+            lines.append("anomaly: none")
+        mem = self._membership.snapshot()
+        lines.append(
+            f"membership: epoch={mem.get('epoch')} "
+            f"elastic={mem.get('elastic')} "
+            f"evicted={sorted(mem.get('evicted') or [])}"
+        )
+        return "\n".join(lines) + "\n"
 
     def stop_monitor(self) -> bool:
         """Stop the scrape service (bounded join of the ``accl-monitor``
@@ -2337,6 +2799,29 @@ class ACCL:
             # not outlive the handle (a stale listener would keep failing
             # gang slots for a verifier whose facade is gone)
             self.set_contract_verify(False)
+            # causal trace/postmortem planes: the fabric stamp and the
+            # anchored evidence registry must not outlive the handle
+            # (same stale-listener reason), and the engine's hooks clear
+            fabric = getattr(self.engine, "fabric", None)
+            if fabric is not None and hasattr(fabric, "unregister_trace"):
+                fabric.unregister_trace(self)
+            if self._blackbox is not None:
+                from .contract import anchored as _anchored
+
+                reg = _anchored(
+                    self.engine.contract_anchor(),
+                    "_accl_blackbox_registry", dict,
+                )
+                if reg is not None:
+                    reg.pop(self._blackbox.rank, None)
+                self.engine.set_postmortem(None)
+                ring = getattr(
+                    getattr(self.engine, "gang", None), "cmdring", None
+                )
+                if ring is not None and ring.on_failure == (
+                    self._on_ring_failure
+                ):
+                    ring.on_failure = None
             # and the membership plane's board listener + engine hooks,
             # for the same stale-listener reason
             self._membership.close()

@@ -90,23 +90,32 @@ import json
 import os
 import statistics
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from .contract import anchored
 
 __all__ = [
     "AnomalyWatchdog",
+    "BlackBox",
     "Monitor",
     "MonitorServer",
     "SkewJudge",
     "SkewTracker",
     "TraceStreamWriter",
     "env_port",
+    "env_postmortem_dir",
     "judge_for",
+    "load_bundle",
 ]
 
 MONITOR_PORT_ENV = "ACCL_MONITOR_PORT"
 TRACE_STREAM_ENV = "ACCL_TRACE_STREAM"
+POSTMORTEM_DIR_ENV = "ACCL_POSTMORTEM_DIR"
+POSTMORTEM_WAIT_ENV = "ACCL_POSTMORTEM_WAIT_S"
+DEFAULT_POSTMORTEM_WAIT_S = 2.0
+#: bundle.json layout version (bumped when the artifact shape changes)
+BUNDLE_SCHEMA = 1
 
 DEFAULT_SKEW_INTERVAL = 8
 DEFAULT_STRAGGLER_FACTOR = 4.0
@@ -157,6 +166,261 @@ def env_port(environ=None) -> Optional[int]:
         return int(raw)
     except ValueError:
         return None
+
+
+def env_postmortem_dir(environ=None) -> Optional[str]:
+    """The ``ACCL_POSTMORTEM_DIR`` opt-in (read at handle
+    construction); None/empty = postmortem bundles disabled (the
+    always-on cost of the plane is then exactly one None check per
+    structured failure)."""
+    raw = (environ or os.environ).get(POSTMORTEM_DIR_ENV)
+    return raw or None
+
+
+def _env_wait_s() -> float:
+    return max(0.0, _env_float(
+        POSTMORTEM_WAIT_ENV, DEFAULT_POSTMORTEM_WAIT_S
+    ))
+
+
+# ---------------------------------------------------------------------------
+# postmortem bundles (the flight-data-recorder plane)
+# ---------------------------------------------------------------------------
+
+
+class BlackBox:
+    """Automatic postmortem bundles for structured failures.
+
+    On any covered failure path (facade ``ACCLError`` with
+    CONTRACT_VIOLATION / RANK_EVICTED / DEADLOCK_SUSPECTED, the
+    command-ring failure latch, a membership cutover) the facade calls
+    :meth:`capture`: the local evidence (flight-recorder tail +
+    telemetry snapshot — which carries ring/mailbox state, the
+    membership event ring, skew baselines and contract window digests)
+    is snapshotted, reachable peers are solicited for THEIR evidence —
+    in process over the anchored registry (the contract-board
+    discipline), across processes via a POSTMORTEM wire frame — and
+    everything merges into one crash-safe, atomically-written
+    ``bundle.json`` whose path rides ``ACCLError.details["postmortem"]``.
+
+    Bounded + best-effort by construction: peer solicitation waits at
+    most ``ACCL_POSTMORTEM_WAIT_S`` (default 2 s); dead/partitioned
+    peers are documented as ``absent`` in the bundle, never waited out.
+    One bundle per failure: captures are latched per failure key
+    (counter-asserted), and the latch clears with ``soft_reset`` like
+    every other recovery surface.  Disabled (one None check per
+    failure) unless ``ACCL_POSTMORTEM_DIR`` is set."""
+
+    def __init__(self, rank: int, world: int,
+                 evidence_fn: Callable[[], dict],
+                 directory: Optional[str] = None,
+                 wait_s: Optional[float] = None,
+                 peers_fn: Optional[Callable[[], Dict[int, Any]]] = None,
+                 solicit_fn: Optional[Callable[[int], int]] = None,
+                 metrics=None):
+        self.rank = int(rank)
+        self.world = int(world)
+        self.directory = (
+            directory if directory is not None else env_postmortem_dir()
+        )
+        self.enabled = bool(self.directory)
+        self.wait_s = wait_s if wait_s is not None else _env_wait_s()
+        self._evidence_fn = evidence_fn
+        # in-process solicitation: {session: evidence_fn} (the anchored
+        # registry every rank handle of the process registers into)
+        self._peers_fn = peers_fn
+        # wire solicitation: sends POSTMORTEM request frames, returns
+        # how many peers were asked (replies land via deliver_reply)
+        self._solicit_fn = solicit_fn
+        self._metrics = metrics
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._latched: Dict[tuple, Optional[str]] = {}
+        self._replies: Dict[int, Dict[int, dict]] = {}
+        self._token = 0
+        self._seq = 0  # bundle-name allocator (monotone, never reused)
+        self.bundles_written = 0
+        self.solicit_timeouts = 0
+        self.last_bundle: Optional[str] = None
+
+    # -- wire reply intake (fabric delivery thread) --------------------------
+    def deliver_reply(self, token: int, rank: int, evidence: dict) -> None:
+        with self._cv:
+            bucket = self._replies.get(int(token))
+            if bucket is None:
+                return  # late reply after the bounded deadline: dropped
+            bucket[int(rank)] = evidence
+            self._cv.notify_all()
+
+    def _solicit(self) -> tuple:
+        """(peer evidence {session: dict}, absent sessions).  Board
+        peers answer synchronously; wire peers get the bounded wait."""
+        collected: Dict[int, dict] = {}
+        asked: set = set()
+        if self._peers_fn is not None:
+            try:
+                registry = dict(self._peers_fn() or {})
+            except Exception:
+                registry = {}
+            for session, fn in sorted(registry.items()):
+                if session == self.rank:
+                    continue
+                asked.add(session)
+                try:
+                    collected[session] = fn()
+                except Exception as e:  # a wedged peer must not wedge us
+                    collected[session] = {
+                        "error": f"{type(e).__name__}: {e}"[:200]
+                    }
+        if self._solicit_fn is not None:
+            with self._cv:
+                self._token += 1
+                token = self._token
+                self._replies[token] = {}
+            try:
+                n_asked = int(self._solicit_fn(token) or 0)
+            except Exception:
+                n_asked = 0
+            if n_asked:
+                deadline = time.monotonic() + self.wait_s
+                with self._cv:
+                    while len(self._replies[token]) < n_asked:
+                        rem = deadline - time.monotonic()
+                        if rem <= 0:
+                            self.solicit_timeouts += 1
+                            break
+                        self._cv.wait(rem)
+                    for r, ev in self._replies[token].items():
+                        collected[r] = ev
+                        asked.add(r)
+            with self._cv:
+                self._replies.pop(token, None)
+        absent = sorted(
+            s for s in range(self.world)
+            if s != self.rank and s not in collected
+        )
+        return collected, absent
+
+    # -- the capture path ----------------------------------------------------
+    def capture(self, code: str, context: str = "",
+                details: Optional[dict] = None,
+                key: Optional[tuple] = None) -> Optional[str]:
+        """Write one bundle for this failure (or return the already-
+        written one when the failure key is latched).  Never raises —
+        a postmortem failure must not mask the failure it documents."""
+        if not self.enabled:
+            return None
+        key = key if key is not None else (str(code),)
+        with self._lock:
+            if key in self._latched:
+                return self._latched[key]
+            self._latched[key] = None  # claim: concurrent paths collapse
+            # the bundle name is allocated HERE, atomically with the
+            # claim: two concurrent captures (distinct keys, same code)
+            # must never derive the same directory and clobber each
+            # other's bundle.json
+            seq = self._seq
+            self._seq += 1
+        path = None
+        try:
+            path = self._write_bundle(code, context, details, seq)
+        except Exception:  # pragma: no cover - defensive
+            import traceback
+
+            traceback.print_exc()
+        with self._lock:
+            self._latched[key] = path
+            if path is not None:
+                self.bundles_written += 1
+                self.last_bundle = path
+        if path is not None and self._metrics is not None:
+            try:
+                self._metrics.inc("accl_postmortem_bundles_total")
+            except Exception:  # pragma: no cover - defensive
+                pass
+        return path
+
+    def _write_bundle(self, code: str, context: str,
+                      details: Optional[dict], seq: int) -> str:
+        try:
+            local = self._evidence_fn()
+        except Exception as e:  # evidence half-missing beats no bundle
+            local = {"error": f"{type(e).__name__}: {e}"[:200]}
+        peers, absent = self._solicit()
+        ranks = {str(self.rank): local}
+        for r, ev in sorted(peers.items()):
+            ranks[str(r)] = ev
+        bundle = {
+            "bundle_schema": BUNDLE_SCHEMA,
+            "code": str(code),
+            "context": str(context),
+            "rank": self.rank,
+            "world": self.world,
+            # wall timestamp on purpose (cross-process artifact naming/
+            # correlation needs the shared clock, same as Message.
+            # sent_ns) — never used as a duration
+            "created_ns": time.time_ns(),
+            "ranks": ranks,
+            "reachable": sorted(int(r) for r in ranks),
+            "absent": absent,
+        }
+        if details:
+            bundle["details"] = _jsonable(details)
+        os.makedirs(self.directory, exist_ok=True)
+        name = (
+            f"accl_postmortem_{str(code).lower()}_rank{self.rank}_{seq:03d}"
+        )
+        bdir = os.path.join(self.directory, name)
+        os.makedirs(bdir, exist_ok=True)
+        path = os.path.join(bdir, "bundle.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(bundle, f, default=str)
+        os.replace(tmp, path)  # crash-safe: the artifact is atomic
+        return path
+
+    def reset(self) -> None:
+        """soft_reset recovery: clear the per-failure latches (a fresh
+        regime's failures deserve fresh bundles); written-bundle
+        accounting is lifetime and survives."""
+        with self._lock:
+            self._latched.clear()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "dir": self.directory,
+                "wait_s": self.wait_s,
+                "bundles_written": self.bundles_written,
+                "solicit_timeouts": self.solicit_timeouts,
+                "last_bundle": self.last_bundle,
+                "latched": len(self._latched),
+            }
+
+
+def _jsonable(obj):
+    """Best-effort JSON-safe copy (ACCLError.details may carry enums /
+    numpy scalars; the bundle must always serialize)."""
+    try:
+        json.dumps(obj)
+        return obj
+    except (TypeError, ValueError):
+        return json.loads(json.dumps(obj, default=str))
+
+
+def load_bundle(path: str) -> dict:
+    """Load + structurally validate one ``bundle.json`` (the test/CI
+    surface): raises ValueError on a malformed bundle."""
+    with open(path) as f:
+        doc = json.load(f)
+    for k in ("bundle_schema", "code", "rank", "world", "ranks",
+              "reachable", "absent"):
+        if k not in doc:
+            raise ValueError(f"postmortem bundle missing {k!r}: {path}")
+    if not isinstance(doc["ranks"], dict) or not doc["ranks"]:
+        raise ValueError(f"postmortem bundle has no rank evidence: {path}")
+    return doc
 
 
 # ---------------------------------------------------------------------------
@@ -705,7 +969,7 @@ class MonitorServer:
         class _Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 - stdlib handler contract
                 path = self.path.split("?", 1)[0]
-                if path == "/":
+                if path == "/" and "/" not in outer.routes:
                     body = "\n".join(sorted(outer.routes)) + "\n"
                     self._reply(200, body, "text/plain; charset=utf-8")
                     return

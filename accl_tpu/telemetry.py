@@ -51,6 +51,7 @@ import json
 import os
 import threading
 import time
+import zlib
 from typing import Any, Dict, List, Optional
 
 __all__ = [
@@ -60,11 +61,17 @@ __all__ = [
     "SCHEMA_VERSION",
     "Telemetry",
     "chrome_trace",
+    "collective_trace_id",
     "enabled",
+    "flow_events_for",
+    "flows_enabled",
     "merge_traces",
+    "p2p_trace_id",
     "record_event",
     "to_json",
     "to_prometheus",
+    "validate_flow_docs",
+    "validate_flows",
     "wire_event",
     "wire_snapshot",
 ]
@@ -77,8 +84,10 @@ ERROR_TAIL = 32
 #: dict gains/renames sections, so dashboards and the exporter
 #: round-trip tests can key on shape instead of sniffing.  2 = the
 #: monitor plane (schema_version, stragglers, anomalies, monitor);
-#: 3 = the membership plane (membership, health_events).
-SCHEMA_VERSION = 3
+#: 3 = the membership plane (membership, health_events);
+#: 4 = the causal trace plane (postmortem section, trace ids in
+#: flight records, cmdring window timelines under engine.cmdring).
+SCHEMA_VERSION = 4
 
 # One epoch<->monotonic anchor per process: records carry perf_counter_ns
 # timestamps (cheap, monotonic), trace export maps them onto the epoch
@@ -107,6 +116,47 @@ def _ring_capacity() -> int:
 
 
 # ---------------------------------------------------------------------------
+# causal trace ids (the cross-rank flow linkage)
+# ---------------------------------------------------------------------------
+
+#: ``ACCL_TRACE_FLOWS=0`` disables flow-event RENDERING (ids are still
+#: derived and stamped — they are a handful of crc32s per call and the
+#: postmortem bundles want them regardless)
+TRACE_FLOWS_ENV = "ACCL_TRACE_FLOWS"
+
+
+def flows_enabled() -> bool:
+    return os.environ.get(TRACE_FLOWS_ENV, "1") != "0"
+
+
+def collective_trace_id(op: str, comm_id: int, generation: int,
+                        seqn: int) -> int:
+    """Deterministic 32-bit trace id of one collective: the contract
+    plane's fingerprint basis (op|comm|generation|seqn) hashed with
+    crc32 — NEVER Python ``hash`` (process-salted), so every rank of
+    the collective derives the SAME id with zero wire bytes.  The
+    generation re-keys across soft_reset like the contract digests;
+    nonzero by construction (0 means "unstamped")."""
+    data = f"{op}|{comm_id}|{generation}|{seqn}".encode()
+    return zlib.crc32(data) or 1
+
+
+def p2p_trace_id(comm_id: int, src: int, dst: int, tag: int,
+                 seqn: int, stream: int = 0) -> int:
+    """Deterministic trace id of one send→recv pair: both ends derive
+    it from the DIRECTED (comm, src, dst, tag, stream) channel's match
+    counter — sends and receives on one channel match strictly in
+    order, so the sender's k-th send and the receiver's k-th recv
+    agree on the id with zero wire bytes (the wire stamp is
+    corroboration, not the mechanism).  ``stream`` keeps stream-port
+    p2p variants on their own id space: their counters are separate at
+    intake, so without the discriminator a stream_put and a plain send
+    on the same (comm, dst, tag) would collide at seqn 0."""
+    data = f"p2p|{comm_id}|{src}|{dst}|{tag}|{stream}|{seqn}".encode()
+    return zlib.crc32(data) or 1
+
+
+# ---------------------------------------------------------------------------
 # flight recorder
 # ---------------------------------------------------------------------------
 
@@ -121,13 +171,15 @@ class CallRecord:
         "algorithm", "plan_hit", "eager", "duration_ns", "retcode",
         "retcode_name", "end_perf_ns", "attempts", "peer",
         "overlap_ns", "inflight_depth", "ring_resident",
+        "trace_id", "trace_phase", "parent_id",
     )
 
     def __init__(self, op, comm, epoch, dtype, count, nbytes, bucket,
                  algorithm, plan_hit, eager, duration_ns, retcode,
                  retcode_name, end_perf_ns, attempts=None, peer=None,
                  overlap_ns=None, inflight_depth=None,
-                 ring_resident=None):
+                 ring_resident=None, trace_id=None, trace_phase=None,
+                 parent_id=None):
         self.op = op
         self.comm = comm
         self.epoch = epoch
@@ -152,6 +204,13 @@ class CallRecord:
         # (sequenced on device by the cmdring sequencer, not by host
         # dispatch); None on non-ring paths/tiers
         self.ring_resident = ring_resident
+        # causal trace plane: the deterministic cross-rank trace id
+        # (collective_trace_id / p2p_trace_id basis), this rank's flow
+        # phase in the merged timeline ("s"/"t"/"f"; None = no flow),
+        # and the parent span's id (pipelined segments / batched calls)
+        self.trace_id = trace_id
+        self.trace_phase = trace_phase
+        self.parent_id = parent_id
 
     def as_dict(self) -> dict:
         d = {
@@ -180,6 +239,10 @@ class CallRecord:
             d["inflight_depth"] = self.inflight_depth
         if self.ring_resident is not None:
             d["ring_resident"] = self.ring_resident
+        if self.trace_id is not None:
+            d["trace_id"] = self.trace_id
+        if self.parent_id is not None:
+            d["parent_id"] = self.parent_id
         return d
 
 
@@ -411,12 +474,55 @@ def wire_events(limit: Optional[int] = None) -> List[dict]:
 
 def wire_reset() -> None:
     """Test hook: drop buffered wire events and counters."""
-    global _wire_next, _wire_seen
+    global _wire_next, _wire_seen, _flow_next, _flow_seen
     with _wire_lock:
         _wire_next = 0
         _wire_seen = 0
         for i in range(_WIRE_CAP):
             _wire_ring[i] = None
+        _flow_next = 0
+        _flow_seen = 0
+        for i in range(_WIRE_CAP):
+            _flow_ring[i] = None
+
+
+# wire-arrival flow steps (the causal trace plane's delivery-side
+# corroboration): a delivered message carrying a piggybacked trace id
+# (Message.trc — the vfy_/skw_ stamp pattern) records one step here;
+# exports render them as `t` flow phases on the wire row, so the merged
+# timeline shows the wire hop INSIDE the send→recv / collective flow.
+# Same process-wide + sampled discipline as the wire ring above.
+_flow_ring: List[Optional[dict]] = [None] * _WIRE_CAP
+_flow_next = 0
+_flow_seen = 0
+
+
+def wire_flow(trace_id: int, src: int, dst: int, comm_id: int) -> None:
+    """One delivered message's piggybacked trace id (fabric delivery
+    thread; sampled 1-in-N by ``ACCL_TELEMETRY_SAMPLE``)."""
+    global _flow_next, _flow_seen
+    with _wire_lock:
+        _flow_seen += 1
+        if (_flow_seen - 1) % _wire_sample():
+            return
+        _flow_ring[_flow_next % _WIRE_CAP] = {
+            "ts_us": round(_perf_to_epoch_us(time.perf_counter_ns()), 3),
+            "id": int(trace_id),
+            "src": int(src),
+            "dst": int(dst),
+            "comm": int(comm_id),
+        }
+        _flow_next += 1
+
+
+def wire_flow_events(limit: Optional[int] = None) -> List[dict]:
+    with _wire_lock:
+        have = min(_flow_next, _WIRE_CAP)
+        n = have if limit is None else min(limit, have)
+        return [
+            _flow_ring[i % _WIRE_CAP]
+            for i in range(_flow_next - n, _flow_next)
+        ]
 
 
 # ---------------------------------------------------------------------------
@@ -501,6 +607,8 @@ class Telemetry:
             meta["eager"], duration_ns, code, code_name,
             time.perf_counter_ns(), attempts, ctx.get("peer"),
             overlap_ns, inflight_depth, ring_resident,
+            meta.get("trace_id"), meta.get("trace_phase"),
+            meta.get("parent_id"),
         )
         self.recorder.append(rec)
         if amend:
@@ -545,8 +653,11 @@ class Telemetry:
                 "tid": 0, "args": {"name": self.tier},
             },
         ]
+        flows = flows_enabled()
         for rec in self.recorder.tail():
             events.append(record_event(rec, self.rank))
+            if flows:
+                events.extend(flow_events_for(rec, self.rank))
         if wire:
             # The wire ring is PROCESS-wide (one fabric serves every
             # in-process rank handle), so wire events export under the
@@ -573,8 +684,138 @@ class Telemetry:
                     "tid": 1,
                     "args": {"src": ev["src"], "event": ev["event"]},
                 })
+            if flows:
+                # delivered piggybacked trace ids: wire-hop steps on
+                # the flow (cat "wire.flow" so merge_traces dedups the
+                # process-wide ring like the wire instants)
+                for fv in wire_flow_events():
+                    events.append({
+                        "name": "accl::flow",
+                        "cat": "wire.flow",
+                        "ph": "t",
+                        "id": f"0x{fv['id']:08x}",
+                        "ts": fv["ts_us"],
+                        "pid": wire_pid,
+                        "tid": 1,
+                        "args": {
+                            "src": fv["src"], "dst": fv["dst"],
+                            "comm": fv["comm"],
+                        },
+                    })
         events.sort(key=lambda e: e.get("ts", 0.0))
         return events
+
+
+def flow_events_for(rec: CallRecord, rank: int) -> List[dict]:
+    """One CallRecord's Perfetto flow events (Chrome ``s``/``t``/``f``
+    phases): the cross-rank causal linkage.  Every rank of a collective
+    derives the same ``trace_id`` and a deterministic phase — the
+    lowest comm rank starts the flow (``s``), the highest finishes it
+    (``f``), middles are steps (``t``) — so the MERGED timeline carries
+    exactly one matched s/f pair per collective plus steps, and a
+    send→recv pair contributes the sender's ``s`` and the receiver's
+    ``f``.  Name and category are uniform (``accl::flow``) because
+    Chrome binds flows by (cat, name, id)."""
+    if not rec.trace_id or rec.trace_phase not in ("s", "t", "f"):
+        return []
+    dur_us = rec.duration_ns / 1e3
+    end_us = _perf_to_epoch_us(rec.end_perf_ns)
+    ev = {
+        "name": "accl::flow",
+        "cat": "accl.flow",
+        "ph": rec.trace_phase,
+        "id": f"0x{rec.trace_id:08x}",
+        # anchored INSIDE the span (mid-point): flows bind to the
+        # enclosing slice, and span starts/ends can coincide across
+        # ranks on a fast mesh
+        "ts": round(end_us - dur_us / 2, 3),
+        "pid": rank,
+        "tid": 0,
+        "args": {"op": rec.op, "comm": rec.comm},
+    }
+    if rec.trace_phase == "f":
+        ev["bp"] = "e"  # bind to the enclosing slice, Perfetto-style
+    out = [ev]
+    if rec.parent_id:
+        # parent/child nesting (pipelined segments, batched calls):
+        # a step on the PARENT's flow anchored at this child's span —
+        # the merged timeline draws aggregate→segment arrows
+        out.append({
+            "name": "accl::flow",
+            "cat": "accl.flow",
+            "ph": "t",
+            "id": f"0x{rec.parent_id:08x}",
+            "ts": round(end_us - dur_us / 2, 3),
+            "pid": rank,
+            "tid": 0,
+            "args": {"op": rec.op, "child": rec.trace_id},
+        })
+    return out
+
+
+def validate_flows(events: List[dict]) -> List[str]:
+    """Flow well-formedness over a (merged) event list: every flow
+    start (``s``) must have at least one finish (``f``) and every
+    finish a start — an unmatched end means a rank's span went missing
+    from the merge (or a derivation diverged), which is exactly what
+    the causal plane exists to surface.  Steps (``t``) are advisory
+    and never error.  Returns human-readable problems ([] = valid)."""
+    starts: Dict[str, int] = {}
+    finishes: Dict[str, int] = {}
+    for e in events:
+        if e.get("cat") not in ("accl.flow", "wire.flow"):
+            continue
+        fid = str(e.get("id"))
+        ph = e.get("ph")
+        if ph == "s":
+            starts[fid] = starts.get(fid, 0) + 1
+        elif ph == "f":
+            finishes[fid] = finishes.get(fid, 0) + 1
+    problems = []
+    for fid in sorted(set(starts) - set(finishes)):
+        problems.append(f"flow {fid}: start without a finish")
+    for fid in sorted(set(finishes) - set(starts)):
+        problems.append(f"flow {fid}: finish without a start")
+    return problems
+
+
+def validate_flow_docs(docs: List[dict]) -> List[str]:
+    """The merge CLI's truncation-aware form of :func:`validate_flows`:
+    flight recorders are bounded rings, so a long run legitimately
+    evicts one rank's old flow events while a peer's matching end
+    survives.  Any flow carrying an event OLDER than the latest
+    "earliest flow event" across the input files (the common covered
+    window) is exempted whole — its counterpart may simply have rolled
+    out.  A genuinely missing rank file contributes no floor, so its
+    unmatched counterparts still error, which is the case the
+    validation exists to catch."""
+    events: List[dict] = []
+    floor = None
+    for doc in docs:
+        evs = doc.get("traceEvents") if isinstance(doc, dict) else doc
+        evs = list(evs or ())
+        events.extend(evs)
+        ts = [
+            e.get("ts", 0.0) for e in evs
+            if e.get("cat") == "accl.flow"
+        ]
+        if ts:
+            m = min(ts)
+            floor = m if floor is None else max(floor, m)
+    if floor is not None:
+        exempt = {
+            str(e.get("id")) for e in events
+            if e.get("cat") == "accl.flow" and e.get("ts", 0.0) < floor
+        }
+        if exempt:
+            events = [
+                e for e in events
+                if not (
+                    e.get("cat") == "accl.flow"
+                    and str(e.get("id")) in exempt
+                )
+            ]
+    return validate_flows(events)
 
 
 def record_event(rec: CallRecord, rank: int) -> dict:
@@ -723,6 +964,53 @@ def to_prometheus(snapshot: dict) -> str:
         gauge("accl_cmdring_op_slots_total", cnt, op=opname)
     for reason, cnt in sorted((ring.get("fallbacks") or {}).items()):
         gauge("accl_cmdring_fallbacks_total", cnt, reason=reason)
+    # ring introspection (the causal trace plane): mailbox depth (how
+    # far the host runs ahead of the sequencer), the run-thread state
+    # as a numeric gauge (0 parked / 1 resident / 2 armed), and the
+    # refill-window latency histogram (log2-us buckets, host basis)
+    gauge("accl_cmdring_mailbox_depth", ring.get("mailbox_depth"))
+    gauge("accl_cmdring_windows_total", ring.get("windows_logged"))
+    state = ring.get("state")
+    if state is not None:
+        gauge(
+            "accl_cmdring_run_state",
+            {"parked": 0, "resident": 1, "armed": 2}.get(state, -1),
+        )
+    wl = ring.get("window_latency_log2_us") or {}
+    if wl:
+        # a REAL Prometheus histogram (cumulative _bucket / +Inf /
+        # _sum / _count — the accl_call_duration_us pattern): raw
+        # per-bucket gauges with an `le` label would feed
+        # histogram_quantile garbage
+        lines.append("# TYPE accl_cmdring_window_latency_us histogram")
+        seen_types.add("accl_cmdring_window_latency_us")
+        cum = 0
+        for k, v in sorted(wl.items(), key=lambda kv: int(kv[0])):
+            cum += v
+            lines.append(
+                "accl_cmdring_window_latency_us_bucket"
+                f"{_prom_labels(le=2 ** (int(k) + 1), **base)} {cum}"
+            )
+        lines.append(
+            "accl_cmdring_window_latency_us_bucket"
+            f'{_prom_labels(le="+Inf", **base)} {cum}'
+        )
+        lines.append(
+            "accl_cmdring_window_latency_us_sum"
+            f"{_prom_labels(**base)} "
+            f"{ring.get('window_latency_sum_us') or 0.0:.3f}"
+        )
+        lines.append(
+            f"accl_cmdring_window_latency_us_count"
+            f"{_prom_labels(**base)} {cum}"
+        )
+
+    # postmortem plane: bundle accounting (the lifetime counter also
+    # rides accl_postmortem_bundles_total in the counters section)
+    pm = snapshot.get("postmortem") or {}
+    gauge("accl_postmortem_enabled", int(bool(pm.get("enabled"))))
+    gauge("accl_postmortem_bundles", pm.get("bundles_written"))
+    gauge("accl_postmortem_solicit_timeouts", pm.get("solicit_timeouts"))
 
     # membership plane (elastic membership): the epoch gauge, eviction/
     # demotion/restore counters, per-(comm, rank) demotion breaker
@@ -802,7 +1090,12 @@ def merge_traces(docs: List[dict]) -> dict:
     for doc in docs:
         evs = doc.get("traceEvents") if isinstance(doc, dict) else doc
         for e in evs or ():
-            if e.get("cat") == "wire" or e.get("ph") == "M":
+            # process-wide rows every in-process rank file embeds
+            # (wire instants, wire-flow steps, cmdring spans, metadata)
+            # merge to ONE copy per process
+            if e.get("cat") in ("wire", "wire.flow", "cmdring") or (
+                e.get("ph") == "M"
+            ):
                 key = json.dumps(e, sort_keys=True)
                 if key in seen:
                     continue
@@ -834,6 +1127,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     mp.add_argument("inputs", nargs="+", help="per-rank trace JSON files")
     mp.add_argument("--out", "-o", default="-",
                     help="merged trace path (default: stdout)")
+    mp.add_argument(
+        "--no-flow-check", action="store_true",
+        help="skip the flow well-formedness validation (every flow "
+             "start needs a finish and vice versa — unmatched ends "
+             "are an error by default: they mean a rank's file is "
+             "missing from the merge or an id derivation diverged)",
+    )
     args = ap.parse_args(argv)
 
     docs = []
@@ -846,6 +1146,19 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "an empty/malformed trace")
         docs.append(doc)
     merged = merge_traces(docs)
+    if not args.no_flow_check:
+        # truncation-aware: flows partially evicted from a rank's
+        # bounded flight ring are exempt; a MISSING rank file still
+        # errors (validate_flow_docs explains the floor rule)
+        problems = validate_flow_docs(docs)
+        if problems:
+            head = "; ".join(problems[:8])
+            raise SystemExit(
+                f"merged trace has {len(problems)} unmatched flow "
+                f"end(s): {head} — a rank file is missing from the "
+                "merge or a trace-id derivation diverged (pass "
+                "--no-flow-check to merge anyway)"
+            )
     text = json.dumps(merged)
     if args.out == "-":
         print(text)

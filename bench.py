@@ -641,12 +641,59 @@ def _bench_facade_overhead() -> dict:
         snap = a.telemetry_snapshot()
         telemetry = {
             "snapshot_keys": sorted(snap.keys()),
+            "schema_version": snap.get("schema_version"),
             "records": len(snap["flight_recorder"]),
             "histograms": {
                 k: {"count": h["count"], "mean_us": h["mean_us"]}
                 for k, h in (snap["metrics"].get("histograms") or {}).items()
             },
         }
+
+        # causal trace plane evidence (parse_results.check_telemetry):
+        # flow events need >= 2 ranks (a world-1 span has no far end to
+        # link), so a 2-rank InProc side group produces a merged,
+        # VALIDATED flow set — the capture proves cross-rank linkage,
+        # not just that ids were derived
+        import threading as _threading
+
+        from accl_tpu import telemetry as _telemetry
+        from accl_tpu.core import emulated_group
+
+        fg = emulated_group(2)
+        try:
+            fsend = [
+                x.create_buffer_from(np.ones(64, np.float32)) for x in fg
+            ]
+            frecv = [x.create_buffer(64, np.float32) for x in fg]
+            for _ in range(4):
+                ths = [
+                    _threading.Thread(
+                        target=lambda x, i: x.allreduce(
+                            fsend[i], frecv[i], 64
+                        ),
+                        args=(x, i), name="accl-bench-flow",
+                    )
+                    for i, x in enumerate(fg)
+                ]
+                for t2 in ths:
+                    t2.start()
+                for t2 in ths:
+                    t2.join(60)
+            merged = _telemetry.merge_traces([
+                {"traceEvents": x.telemetry_trace_events()} for x in fg
+            ])
+            flow_problems = _telemetry.validate_flows(
+                merged["traceEvents"]
+            )
+            flow_events = sum(
+                1 for e in merged["traceEvents"]
+                if e.get("cat") == "accl.flow"
+            )
+        finally:
+            for x in fg:
+                x.deinit()
+        telemetry["flow_events"] = 0 if flow_problems else flow_events
+        telemetry["flow_problems"] = len(flow_problems)
     finally:
         for x in g:
             x.deinit()
@@ -770,12 +817,30 @@ def _bench_monitor_overhead() -> dict:
 
         # route validation: every endpoint live and well-formed (the
         # check_monitor gate refuses a capture without this evidence)
+        # ring-span evidence (the causal trace plane): one batched
+        # window rides the command ring, so the /trace export carries
+        # ring-resident spans next to the call spans
+        try:
+            with a.batch():
+                ring_reqs = [
+                    a.allreduce(sends[i], d, 1024, run_async=True)
+                    for i in range(2)
+                ]
+            for rq in ring_reqs:
+                rq.wait()
+        except Exception:
+            pass  # evidence-only: the gate below reports honestly
+        ring_spans = sum(
+            1 for e in a.telemetry_trace_events()
+            if e.get("cat") == "cmdring"
+        )
+
         port = a.start_monitor(0)
         routes_ok = True
         try:
             for route, kind in (
                 ("/metrics", "prom"), ("/snapshot", "json"),
-                ("/trace", "json"),
+                ("/trace", "json"), ("/cmdring", "json"),
             ):
                 with urllib.request.urlopen(
                     f"http://127.0.0.1:{port}{route}", timeout=5
@@ -802,6 +867,7 @@ def _bench_monitor_overhead() -> dict:
             "stragglers_enabled": bool(
                 (snap.get("stragglers") or {}).get("enabled")
             ),
+            "ring_spans": ring_spans,
         }
         return {
             "facade_monitor_overhead_pct": monitor["overhead_pct"],

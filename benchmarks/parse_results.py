@@ -111,6 +111,13 @@ REQUIRED_SNAPSHOT_KEYS = (
     "wire_trace",
     "rank",
     "tier",
+    # the PR 8 deferral, landed with the causal trace plane: snapshots
+    # must carry their schema version (dashboards key on it, not
+    # sniffing).  Pre-v4 committed captures are exempted by
+    # check_telemetry's era carve-out below, like the "contract"
+    # section note — refreshing them needs a capture host whose
+    # interleaved A/B actually clears the <=5% budget.
+    "schema_version",
 )
 # NOT in REQUIRED_SNAPSHOT_KEYS (the committed r05 capture predates
 # it): the contract plane's "contract" section — always present in
@@ -141,7 +148,17 @@ def check_telemetry(extras: dict, tolerance_pct: float = None) -> None:
             "bench did not emit its snapshot evidence"
         )
     keys = set(tele.get("snapshot_keys") or ())
-    missing = [k for k in REQUIRED_SNAPSHOT_KEYS if k not in keys]
+    # era carve-out (the check_monitor pattern): a capture that does
+    # not declare its schema version predates the causal trace plane —
+    # the committed pre-v4 artifact pins its capture-time shape, and
+    # the v4 requirements (schema_version key, flow evidence) apply to
+    # every capture the refreshed bench emits
+    legacy = tele.get("schema_version") is None
+    required = (
+        tuple(k for k in REQUIRED_SNAPSHOT_KEYS if k != "schema_version")
+        if legacy else REQUIRED_SNAPSHOT_KEYS
+    )
+    missing = [k for k in required if k not in keys]
     if missing:
         raise TelemetryGateError(
             f"telemetry snapshot is missing merged sections: {missing}"
@@ -154,6 +171,14 @@ def check_telemetry(extras: dict, tolerance_pct: float = None) -> None:
     if not tele.get("histograms"):
         raise TelemetryGateError(
             "telemetry metrics captured no per-op histograms"
+        )
+    if not legacy and not tele.get("flow_events"):
+        # causal trace plane (v4+ captures): the machinery must have
+        # emitted VALIDATED cross-rank flow events (ids are derived at
+        # intake — zero events means derivation or rendering broke)
+        raise TelemetryGateError(
+            "telemetry captured zero (or unvalidated) flow events — "
+            "causal trace-id derivation or flow rendering is broken"
         )
     pct = tele.get("overhead_pct")
     if pct is None:
@@ -290,7 +315,18 @@ def check_monitor(extras: dict, tolerance_pct: float = None) -> None:
     if not mon.get("routes_ok"):
         raise MonitorGateError(
             "monitor routes were not validated (/metrics must parse, "
-            "/snapshot and /trace must be well-formed JSON)"
+            "/snapshot, /trace and /cmdring must be well-formed JSON)"
+        )
+    if int(mon.get("schema_version") or 0) >= 4 and not mon.get(
+        "ring_spans"
+    ):
+        # causal trace plane (schema 4+): the capture's /trace window
+        # must carry ring-resident spans — the command-ring
+        # introspection evidence (older committed captures pin their
+        # capture-time schema and predate the ring plane)
+        raise MonitorGateError(
+            "monitor evidence carries no ring-resident spans — the "
+            "command-ring introspection rows are missing from /trace"
         )
     pct = mon.get("overhead_pct")
     if pct is None:

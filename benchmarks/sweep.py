@@ -114,6 +114,58 @@ _rank_op = rank_op
 _run_group_op = run_group_op
 
 
+def _flow_scenario(group) -> None:
+    """Exercise every flow family before a ``--trace-dir`` export: a
+    plain send→recv pair between ranks 0 and 1 (the p2p s/f flow) and
+    one batched window of collectives (ring-resident slot spans on the
+    gang tier, batch-parent nesting everywhere).  The sweep's own loop
+    is sync one-at-a-time collectives — without this the committed
+    artifact would carry collective flows only."""
+    import threading
+
+    if len(group) < 2:
+        return
+    n = 256
+    src = group[0].create_buffer_from(np.arange(n, dtype=np.float32))
+    dst = group[1].create_buffer(n, np.float32)
+    pair = [
+        threading.Thread(
+            target=lambda: group[0].send(src, n, 1, tag=7),
+            name="accl-sweep-flow-send",
+        ),
+        threading.Thread(
+            target=lambda: group[1].recv(dst, n, 0, tag=7),
+            name="accl-sweep-flow-recv",
+        ),
+    ]
+    for t in pair:
+        t.start()
+    for t in pair:
+        t.join(60)
+    sends = [a.create_buffer_from(np.ones(n, np.float32)) for a in group]
+    out1 = [a.create_buffer(n, np.float32) for a in group]
+    out2 = [a.create_buffer(n, np.float32) for a in group]
+
+    def work(a, r):
+        with a.batch():
+            q1 = a.allreduce(sends[r], out1[r], n, run_async=True)
+            q2 = a.allreduce(sends[r], out2[r], n, run_async=True)
+        q1.wait()
+        q2.wait()
+
+    for _ in range(2):  # twice: the second window is the warm ring
+        threads = [
+            threading.Thread(
+                target=work, args=(a, r), name=f"accl-sweep-flow-{r}"
+            )
+            for r, a in enumerate(group)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+
+
 def sweep_group(group, sizes: List[int], collectives: List[str], writer,
                 best_of: int = 1) -> None:
     for op in collectives:
@@ -459,6 +511,12 @@ def main(argv=None) -> int:
             # histograms the same calls produced, so the CSV's
             # steady-state rows ship with their full distribution
             if args.trace_dir:
+                # causal trace plane: make sure the committed artifact
+                # carries every flow family — a send→recv pair and (on
+                # the gang tier) a batched window riding the command
+                # ring — before exporting, so the merged timeline
+                # shows cross-rank arrows, not just per-rank spans
+                _flow_scenario(group)
                 os.makedirs(args.trace_dir, exist_ok=True)
                 for r, a in enumerate(group):
                     a.export_chrome_trace(os.path.join(

@@ -60,14 +60,38 @@ def main() -> int:
             if line and not line.startswith("#"):
                 assert _PROM_LINE.match(line), f"malformed: {line!r}"
         snap = json.loads(get(port, "/snapshot"))
-        assert snap["schema_version"] == 3
+        assert snap["schema_version"] == 4
         assert snap["stragglers"]["enabled"] is True
+        assert "postmortem" in snap
         trace = json.loads(get(port, "/trace"))
         assert trace["traceEvents"], "empty trace window"
+        # causal trace plane: the /cmdring route parses on EVERY tier
+        # (the emulator has no ring — the route says so instead of 404)
+        ring = json.loads(get(port, "/cmdring"))
+        assert isinstance(ring, dict)
+        # ...and the index page answers "is this mesh healthy" alone
+        index = get(port, "/")
+        for needle in ("/cmdring", "postmortem:", "membership: epoch="):
+            assert needle in index, f"index page missing {needle!r}"
+        # flow well-formedness: both ranks' exports merge with every
+        # flow start matched to a finish (the merge-CLI invariant)
+        from accl_tpu import telemetry as T
+
+        merged = T.merge_traces([
+            {"traceEvents": a.telemetry_trace_events()} for a in g
+        ])
+        problems = T.validate_flows(merged["traceEvents"])
+        assert not problems, f"unmatched flow ends: {problems[:4]}"
+        nflows = sum(
+            1 for e in merged["traceEvents"]
+            if e.get("cat") == "accl.flow"
+        )
+        assert nflows, "no flow events in the merged trace"
         assert g[0].stop_monitor() is True
         print(
             f"monitor smoke OK: {len(metrics.splitlines())} metric lines, "
-            f"{len(trace['traceEvents'])} trace events"
+            f"{len(trace['traceEvents'])} trace events, "
+            f"{nflows} validated flow events"
         )
         return 0
     finally:
@@ -75,5 +99,71 @@ def main() -> int:
             a.deinit()
 
 
+def postmortem_smoke() -> None:
+    """An induced CONTRACT_VIOLATION writes a loadable postmortem
+    bundle naming every reachable rank (jax-free, board solicitation)."""
+    import tempfile
+
+    from accl_tpu.constants import ACCLError, ErrorCode
+    from accl_tpu.core import emulated_group
+    from accl_tpu.faults import FaultPlan, FaultRule
+    from accl_tpu.monitor import load_bundle
+
+    pmdir = tempfile.mkdtemp(prefix="accl_pm_smoke_")
+    os.environ["ACCL_POSTMORTEM_DIR"] = pmdir
+    try:
+        g = emulated_group(3)
+        try:
+            for a in g:
+                a.set_contract_verify(True, interval=2)
+            g[0].engine.fabric.install_fault_plan(FaultPlan(
+                rules=[FaultRule(action="diverge", rank=2)], seed=7,
+            ))
+            send = [
+                a.create_buffer_from(np.ones(8, np.float32)) for a in g
+            ]
+            recv = [a.create_buffer(8, np.float32) for a in g]
+            errs = {}
+
+            def run_rank(a, r):
+                try:
+                    for _ in range(10):
+                        a.allreduce(send[r], recv[r], 8)
+                except ACCLError as e:
+                    errs[r] = e
+
+            threads = [
+                threading.Thread(
+                    target=run_rank, args=(a, r),
+                    name=f"accl-smoke-pm-{r}",
+                )
+                for r, a in enumerate(g)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(60)
+            assert errs, "divergence was never detected"
+            r, e = next(iter(errs.items()))
+            assert e.code == ErrorCode.CONTRACT_VIOLATION
+            path = e.details.get("postmortem")
+            assert path and os.path.exists(path), "no bundle written"
+            bundle = load_bundle(path)
+            assert bundle["code"] == "CONTRACT_VIOLATION"
+            assert len(bundle["reachable"]) == 3, bundle["reachable"]
+            assert bundle["absent"] == []
+            print(
+                f"postmortem smoke OK: bundle {os.path.basename(path)} "
+                f"merged ranks {bundle['reachable']}"
+            )
+        finally:
+            for a in g:
+                a.deinit()
+    finally:
+        os.environ.pop("ACCL_POSTMORTEM_DIR", None)
+
+
 if __name__ == "__main__":
-    sys.exit(main())
+    rc = main()
+    postmortem_smoke()
+    sys.exit(rc)

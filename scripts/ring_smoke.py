@@ -82,6 +82,9 @@ def mailbox_smoke() -> None:
     payload = [np.arange(size * 4, dtype=np.float32).reshape(size, 4)]
     assert mbox.post(1, slots, payload)
     assert mbox.post(2, slots, payload)
+    # introspection plane: queued-but-unpulled depth (the
+    # accl_cmdring_mailbox_depth gauge's source)
+    assert mbox.depth() == 2
 
     schedules = {r: [] for r in range(size)}
 
@@ -114,6 +117,14 @@ def mailbox_smoke() -> None:
     assert not mbox.accepting  # halted: the next refill re-dispatches
     assert not mbox.post(3, slots, payload)
     assert mbox.drained.is_set()
+    assert mbox.depth() == 0
+    # host-side window timing (basis "host", labeled honestly in the
+    # window log): posted -> pulled -> pushed, consumed exactly once
+    for wid in (1, 2):
+        t = mbox.take_timing(wid)
+        assert t is not None, f"window {wid} timing missing"
+        assert t["posted_ns"] <= t["pulled_ns"] <= t["pushed_ns"]
+        assert mbox.take_timing(wid) is None
     unregister_mailbox(mid)
     assert mailbox_for(mid) is None
     print("mailbox: ok")

@@ -1023,6 +1023,12 @@ BAD_METRICS = [
     'self.metrics.inc("retries_total")',
     'gauge("device_interactions", 3)',
     'gauge(f"engine_{k}", v)',
+    # the causal-trace/postmortem PR's new gauge call sites stay in
+    # scope: ring introspection and bundle accounting must carry the
+    # prefix like every earlier plane's metrics
+    'gauge("cmdring_mailbox_depth", v)',
+    'gauge("postmortem_bundles", n)',
+    'self.metrics.inc("postmortem_bundles_total")',
 ]
 
 GOOD_METRICS = [
@@ -1216,3 +1222,77 @@ def test_cmdring_flags_unimplemented_opcode_in_decoder(
     assert len(findings) == 1
     assert "ALLGATHER" in findings[0].message
     assert "unimplemented" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# postmortem-path (causal trace plane PR)
+# ---------------------------------------------------------------------------
+
+
+def _lint_core(tmp_path, code):
+    """The postmortem-path rule scopes to the facade module: fixtures
+    must live at .../accl_tpu/core.py to be in scope."""
+    pkg = tmp_path / "accl_tpu"
+    pkg.mkdir(exist_ok=True)
+    p = pkg / "core.py"
+    p.write_text(textwrap.dedent(code))
+    return run_checks([str(p)])
+
+
+def test_postmortem_path_clean_at_head():
+    assert not _live(run_checks(checks=["postmortem-path"]))
+
+
+def test_postmortem_path_flags_unhooked_covered_raise(tmp_path):
+    findings = _live(_lint_core(tmp_path, """
+        class ACCL:
+            def _gate(self, ctx):
+                raise ACCLError(
+                    ErrorCode.CONTRACT_VIOLATION, ctx, details={}
+                )
+    """), "postmortem-path")
+    assert len(findings) == 1
+    assert "CONTRACT_VIOLATION" in findings[0].message
+    assert "BlackBox" in findings[0].message
+
+
+def test_postmortem_path_follows_call_graph(tmp_path):
+    """A raise that reaches the hook through a same-module funnel is
+    clean — the drain-before-config depth-bounded walk, reused."""
+    findings = _live(_lint_core(tmp_path, """
+        class ACCL:
+            def _evicted(self, ctx):
+                return self._wrap(ACCLError(
+                    ErrorCode.RANK_EVICTED, ctx, details={}
+                ))
+
+            def _wrap(self, err):
+                return self._structured_failure(err)
+
+            def intake(self, ctx):
+                raise self._evicted(ctx)
+    """), "postmortem-path")
+    assert not findings
+
+
+def test_postmortem_path_ignores_uncovered_codes(tmp_path):
+    findings = _live(_lint_core(tmp_path, """
+        class ACCL:
+            def check_rank(self, rank):
+                raise ACCLError(
+                    ErrorCode.INVALID_RANK, "rank", details={}
+                )
+    """), "postmortem-path")
+    assert not findings
+
+
+def test_postmortem_path_out_of_scope_module(tmp_path):
+    """Only the facade module is in scope: engines surface the covered
+    codes through Request retcodes, which _check_failed funnels."""
+    findings = _live(_lint(tmp_path, """
+        def f(ctx):
+            raise ACCLError(
+                ErrorCode.DEADLOCK_SUSPECTED, ctx, details={}
+            )
+    """), "postmortem-path")
+    assert not findings

@@ -85,7 +85,7 @@ def test_scrape_endpoints_smoke():
         status, body = _get(port, "/snapshot")
         assert status == 200
         snap = json.loads(body)
-        assert snap["schema_version"] == 3
+        assert snap["schema_version"] == 4
         for key in ("flight_recorder", "metrics", "stragglers",
                     "anomalies", "monitor", "health"):
             assert key in snap
@@ -489,6 +489,19 @@ def test_check_monitor_gate_units():
         },
     }
     check_monitor(good)
+    # schema 4+ captures must also carry ring-span evidence (older
+    # captures pin their capture-time schema and are exempt)
+    with pytest.raises(MonitorGateError):
+        check_monitor({
+            "monitor": dict(
+                good["monitor"], schema_version=4, ring_spans=0
+            ),
+        })
+    check_monitor({
+        "monitor": dict(
+            good["monitor"], schema_version=4, ring_spans=17
+        ),
+    })
     check_monitor({})  # facade bench never ran: nothing to gate
     with pytest.raises(MonitorGateError):
         check_monitor({"telemetry": good["telemetry"]})  # A/B missing

@@ -37,7 +37,7 @@ RESULTS = os.path.join(
 SNAPSHOT_KEYS = (
     "flight_recorder", "metrics", "plan_cache", "health",
     "device_interactions", "engine", "faults", "wire_trace", "rank",
-    "tier",
+    "tier", "schema_version",
 )
 
 
@@ -247,7 +247,9 @@ def test_merge_cli_on_committed_artifacts(tmp_path, capsys):
     assert T.main(["merge", "--out", str(out)] + inputs) == 0
     doc = json.loads(out.read_text())
     evs = doc["traceEvents"]
-    assert {e["pid"] for e in evs} == {0, 1, 2, 3}
+    # rank rows 0..3 plus the process-wide rows (cmdring spans / wire
+    # instants export under the OS pid)
+    assert {e["pid"] for e in evs} >= {0, 1, 2, 3}
     ts = [e["ts"] for e in evs if "ts" in e]
     assert ts == sorted(ts)
     # the committed pre-merged artifact matches a fresh merge
@@ -536,11 +538,24 @@ def test_check_telemetry_gate():
 
     good = {"telemetry": {
         "snapshot_keys": list(REQUIRED_SNAPSHOT_KEYS) + ["world"],
+        "schema_version": 4,
         "records": 64,
         "histograms": {"allreduce/b10": {"count": 300, "mean_us": 220.0}},
+        "flow_events": 12,
         "overhead_pct": 1.2,
     }}
     check_telemetry(good)
+    with pytest.raises(TelemetryGateError):  # causal-plane evidence
+        bad = json.loads(json.dumps(good))
+        bad["telemetry"]["flow_events"] = 0
+        check_telemetry(bad)
+    # era carve-out: a capture that predates the causal trace plane
+    # (no declared schema) is exempt from the v4 requirements
+    legacy = json.loads(json.dumps(good))
+    del legacy["telemetry"]["schema_version"]
+    del legacy["telemetry"]["flow_events"]
+    legacy["telemetry"]["snapshot_keys"].remove("schema_version")
+    check_telemetry(legacy)
     with pytest.raises(TelemetryGateError):
         check_telemetry({})  # no telemetry block at all
     with pytest.raises(TelemetryGateError):  # missing merged section
@@ -632,9 +647,9 @@ def test_snapshot_carries_schema_version():
     g = emulated_group(2)
     try:
         snap = g[0].telemetry_snapshot()
-        assert snap["schema_version"] == T.SCHEMA_VERSION == 3
+        assert snap["schema_version"] == T.SCHEMA_VERSION == 4
         # the JSON exporter round-trips it
-        assert json.loads(g[0].telemetry_json())["schema_version"] == 3
+        assert json.loads(g[0].telemetry_json())["schema_version"] == 4
     finally:
         _deinit(g)
 
