@@ -41,6 +41,7 @@ JAX_FREE_MODULES = (
     "accl_tpu.contract",
     "accl_tpu.monitor",
     "accl_tpu.membership",
+    "accl_tpu.arbiter",
 )
 
 #: top-level packages whose module-scope import breaks jax-freedom

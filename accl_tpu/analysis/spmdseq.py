@@ -80,7 +80,7 @@ _TAINT_ATTRS = frozenset((
     "rank", "local_rank", "world_rank", "is_dummy", "is_host_only",
     "process_index", "process_id",
 ))
-_TAINT_SUBSTR = ("health",)
+_TAINT_SUBSTR = ("health", "tenant_class")
 
 #: built-in sanitizers (beyond same-module @spmd_uniform functions):
 #: ``create_communicator`` is the blessed MPI_Comm_split-style
@@ -91,12 +91,17 @@ _TAINT_SUBSTR = ("health",)
 #: ``suggest_root`` derive from the shared demotion ledger (latched per
 #: (comm, call index) — every rank reads the same decision) and
 #: ``evict_rank``/``take_cutover`` apply a majority-confirmed plan —
-#: SPMD-uniform by construction.  Raw health-map reads stay taint
-#: SOURCES (_TAINT_SUBSTR below): a collective branched on the LOCAL
-#: health map still flags.
+#: SPMD-uniform by construction.  The QoS arbiter plane's decision
+#: accessor joins them: ``admit`` returns the per-(comm, call index)
+#: admission record latched on the shared arbiter — every rank reads
+#: the same class/throttle verdict.  Raw health-map and tenant-class
+#: reads stay taint SOURCES (_TAINT_SUBSTR above): a collective
+#: branched on a locally-read ``tenant_class`` field still flags —
+#: route it through the latched decision instead.
 _BUILTIN_SANITIZERS = frozenset((
     "create_communicator", "split",
     "demote_decision", "suggest_root",
+    "admit",
 ))
 
 

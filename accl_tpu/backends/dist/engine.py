@@ -155,6 +155,8 @@ class DistEngine(StreamPortMixin, BaseEngine):
         from ...overlap import default_window_depth
 
         self.inflight_window = default_window_depth()
+        # QoS arbiter plane: engine-side mirror of SET_TENANT_* writes
+        self.tenants: Dict[int, dict] = {}
         self._init_streams()
         # per-port consumed counter for remotely-posted stream chunks
         import threading as _threading
@@ -1015,6 +1017,24 @@ class DistEngine(StreamPortMixin, BaseEngine):
             # the config itself rode the queue, so everything launched
             # under the old bound has already executed (ordered drain)
             self.inflight_window = int(val)
+        elif fn in (
+            ConfigFunction.SET_TENANT_CLASS,
+            ConfigFunction.SET_TENANT_WEIGHT,
+            ConfigFunction.SET_TENANT_WINDOW_SHARE,
+            ConfigFunction.SET_TENANT_RING_SLOTS,
+            ConfigFunction.SET_TENANT_RATE,
+        ):
+            # QoS arbiter plane: this tier serializes everything through
+            # one executor — enforcement lives in the per-process facade
+            # arbiter; the ONE shared validator keeps the write
+            # portable across tiers
+            from ...arbiter import tenant_config_field, tenant_config_valid
+
+            if not tenant_config_valid(fn, val):
+                return ErrorCode.CONFIG_ERROR
+            self.tenants.setdefault(
+                int(options.cfg_key), {}
+            )[tenant_config_field(fn)] = val
         elif fn == ConfigFunction.SET_TUNING:
             return self._apply_tuning(options)
         else:

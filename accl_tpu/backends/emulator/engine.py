@@ -137,6 +137,9 @@ class EmuEngine(BaseEngine):
         from ...overlap import default_window_depth
 
         self.inflight_window = default_window_depth()
+        # QoS arbiter plane: engine-side mirror of SET_TENANT_* writes
+        # (comm id -> {class, weight, window_share, ring_slots, rate})
+        self.tenants: Dict[int, dict] = {}
 
         # contract plane (accl_tpu.contract, ACCL_VERIFY=1): armed by the
         # facade via set_contract_verifier — intake screens and active
@@ -640,6 +643,9 @@ class EmuEngine(BaseEngine):
             "membership_drops_total": self._mbr_drops,
             "retry_limit": self.retry_limit,
             "inflight_window": self.inflight_window,
+            # QoS arbiter plane: the engine-side tenant quota mirror
+            "tenants": {str(k): dict(v) for k, v in
+                        sorted(self.tenants.items())},
             "faults": inj.stats() if inj is not None else None,
             # monitor plane: how this rank's straggler samples reach
             # its peers (board = shared in-process judge, wire = the
@@ -1020,6 +1026,26 @@ class EmuEngine(BaseEngine):
             if not 1 <= val <= MAX_INFLIGHT_WINDOW:
                 return ErrorCode.CONFIG_ERROR
             self.inflight_window = int(val)
+        elif fn in (
+            ConfigFunction.SET_TENANT_CLASS,
+            ConfigFunction.SET_TENANT_WEIGHT,
+            ConfigFunction.SET_TENANT_WINDOW_SHARE,
+            ConfigFunction.SET_TENANT_RING_SLOTS,
+            ConfigFunction.SET_TENANT_RATE,
+        ):
+            # QoS arbiter plane: this tier has no device window or ring
+            # — enforcement lives in the facade's shared arbiter, which
+            # bounds a tenant's outstanding admissions by its window
+            # share.  ONE shared validator (arbiter.tenant_config_valid)
+            # so a write accepted here can never be CONFIG_ERROR on
+            # another tier.
+            from ...arbiter import tenant_config_field, tenant_config_valid
+
+            if not tenant_config_valid(fn, val):
+                return ErrorCode.CONFIG_ERROR
+            self.tenants.setdefault(
+                int(options.cfg_key), {}
+            )[tenant_config_field(fn)] = val
         elif fn == ConfigFunction.SET_TUNING:
             from ...constants import (
                 ALGORITHM_TUNING_KEYS,

@@ -192,6 +192,9 @@ class NativeEngine(BaseEngine):
         from ...overlap import default_window_depth
 
         self.inflight_window = default_window_depth()
+        # QoS arbiter plane: host-side mirror of SET_TENANT_* writes
+        # (the C ABI predates the tenant vocabulary)
+        self.tenants: dict = {}
         # host-side mirror of the C engine's register table, seeded from
         # the shared defaults: every SET_TUNING write that rides the ABI
         # is mirrored here (write-through), registers the ABI predates
@@ -249,6 +252,34 @@ class NativeEngine(BaseEngine):
             req.mark_executing()
             if 1 <= options.cfg_value <= MAX_INFLIGHT_WINDOW:
                 self.inflight_window = int(options.cfg_value)
+                req.complete(ErrorCode.OK)
+            else:
+                req.complete(ErrorCode.CONFIG_ERROR)
+            return req
+        if options.op == Operation.CONFIG and int(
+            options.cfg_function
+        ) in (
+            int(ConfigFunction.SET_TENANT_CLASS),
+            int(ConfigFunction.SET_TENANT_WEIGHT),
+            int(ConfigFunction.SET_TENANT_WINDOW_SHARE),
+            int(ConfigFunction.SET_TENANT_RING_SLOTS),
+            int(ConfigFunction.SET_TENANT_RATE),
+        ):
+            # QoS arbiter plane, handled host-side: the C ABI predates
+            # the tenant vocabulary and enforcement lives in the facade
+            # arbiter anyway — accept + mirror, through the ONE shared
+            # validator, so set_tenant_class/quota stay portable across
+            # all four tiers
+            from ...arbiter import tenant_config_field, tenant_config_valid
+
+            fn = ConfigFunction(int(options.cfg_function))
+            val = options.cfg_value
+            req = Request(op_name=options.op.name)
+            req.mark_executing()
+            if tenant_config_valid(fn, val):
+                self.tenants.setdefault(
+                    int(options.cfg_key), {}
+                )[tenant_config_field(fn)] = val
                 req.complete(ErrorCode.OK)
             else:
                 req.complete(ErrorCode.CONFIG_ERROR)

@@ -378,6 +378,15 @@ class GangCommandRing:
             cooldown = CMDRING_BREAKER_COOLDOWN_S
         self.breaker_cooldown_s = cooldown
         self._breakers: Dict[int, CircuitBreaker] = {}
+        # QoS arbiter plane (SET_TENANT_RING_SLOTS): per-comm slot
+        # budgets — a budgeted tenant's warm batches chunk into refill
+        # windows of at most its budget, so a flooder pays extra
+        # doorbells instead of monopolizing whole ring windows.  Plus
+        # per-comm slot residency totals, the counter the fairness
+        # tests assert ring-share against.
+        self._slot_budgets: Dict[int, int] = {}
+        self.comm_slots: Dict[int, int] = {}
+        self.budgeted_windows = 0
 
     # -- introspection -------------------------------------------------------
     def supports(self, op) -> bool:
@@ -472,6 +481,16 @@ class GangCommandRing:
                 "ops": dict(self.op_slots),
                 "fallbacks": dict(self.fallbacks),
                 "breakers": breakers,
+                # QoS arbiter plane: configured per-comm slot budgets,
+                # per-comm ring-slot residency (the fairness evidence)
+                # and how many windows a budget actually clamped
+                "slot_budgets": {
+                    str(c): b for c, b in sorted(self._slot_budgets.items())
+                },
+                "comm_slots": {
+                    str(c): n for c, n in sorted(self.comm_slots.items())
+                },
+                "budgeted_windows": self.budgeted_windows,
                 # introspection plane: the refill-window timeline (per-
                 # slot seqn/opcode/retcode/trace-id, host-basis timing),
                 # the window-latency histogram, and the mailbox depth
@@ -497,6 +516,23 @@ class GangCommandRing:
         with self._lock:
             self.fallbacks[reason] = self.fallbacks.get(reason, 0) + 1
         return False
+
+    def set_slot_budget(self, comm_id: int,
+                        slots: Optional[int]) -> None:
+        """Per-comm refill-window slot budget (the QoS arbiter's
+        SET_TENANT_RING_SLOTS lever): ``comm_id``'s warm batches chunk
+        into windows of at most ``slots`` ring slots; None clears."""
+        with self._lock:
+            if slots is None:
+                self._slot_budgets.pop(int(comm_id), None)
+            else:
+                self._slot_budgets[int(comm_id)] = max(
+                    1, min(int(slots), self.depth)
+                )
+
+    def slot_budget_of(self, comm_id: int) -> Optional[int]:
+        with self._lock:
+            return self._slot_budgets.get(int(comm_id))
 
     def breaker_for(self, comm_id: int) -> CircuitBreaker:
         """The comm's ring circuit breaker (membership plane): strikes
@@ -722,10 +758,19 @@ class GangCommandRing:
             plans[i] = (calls, lead,
                         self._plan_barrier(comm, mesh, window_npdt))
 
-        # windows of at most `depth` slots: each window is one refill
-        # (doorbell) — a program dispatch only when no run is live
-        for lo in range(0, npos, self.depth):
-            window = plans[lo:lo + self.depth]
+        # windows of at most `depth` slots — clamped to the comm's QoS
+        # slot budget when one is configured (the flooder pays extra
+        # doorbells; unbudgeted tenants keep full windows): each window
+        # is one refill (doorbell) — a program dispatch only when no
+        # run is live
+        with self._lock:
+            budget = self._slot_budgets.get(comm.id)
+        eff_depth = min(self.depth, budget) if budget else self.depth
+        for lo in range(0, npos, eff_depth):
+            window = plans[lo:lo + eff_depth]
+            if budget and npos > eff_depth:
+                with self._lock:
+                    self.budgeted_windows += 1
             reqs_per_slot = [
                 [e[1][i] for e in entries]
                 for i in range(lo, lo + len(window))
@@ -915,6 +960,9 @@ class GangCommandRing:
                 self.wraps += 1
             self.refills += 1
             self.slots_enqueued += n
+            # per-comm residency: the ring-share counter the QoS
+            # fairness evidence reads (tenant = communicator)
+            self.comm_slots[comm.id] = self.comm_slots.get(comm.id, 0) + n
             self.last_window = n
             self.max_window = max(self.max_window, n)
             for _, _, plan in window:

@@ -66,6 +66,17 @@ class ConfigFunction(enum.IntEnum):
     executes (the "no host in the data path" contract).  Value 1 keeps
     the window but serializes (at most one launch in flight); the
     engines still complete requests from the device done-probe.
+
+    ``SET_TENANT_*`` configure the QoS arbiter plane
+    (``accl_tpu.arbiter``; ``ACCL.set_tenant_class`` /
+    ``ACCL.set_tenant_quota``), keyed by communicator id in
+    ``cfg_key``: CLASS is the :class:`~accl_tpu.arbiter.TenantClass`
+    int, WEIGHT the DRR weight, WINDOW_SHARE the tenant's per-rank
+    share of the in-flight window depth, RING_SLOTS its slot budget
+    per command-ring refill window, RATE a token-bucket bytes/s cap
+    (0 clears).  Every tier accepts + stores them; the device tier
+    additionally wires WINDOW_SHARE into the overlap window's per-key
+    depth and RING_SLOTS into the gang command ring.
     """
 
     RESET = 0
@@ -77,6 +88,11 @@ class ConfigFunction(enum.IntEnum):
     SET_RETRY_LIMIT = 6
     SET_RETRY_BACKOFF = 7
     SET_INFLIGHT_WINDOW = 8
+    SET_TENANT_CLASS = 9
+    SET_TENANT_WEIGHT = 10
+    SET_TENANT_WINDOW_SHARE = 11
+    SET_TENANT_RING_SLOTS = 12
+    SET_TENANT_RATE = 13
 
 
 class TuningKey(enum.IntEnum):

@@ -43,6 +43,7 @@ from .constants import (
 from .contract import ContractVerifier, board_for, env_enabled as _verify_env
 from .contract import verdict_context
 from .faults import HealthTransitions
+from . import arbiter as _arb
 from . import membership as _mbr
 from .overlap import drain_deadline_s
 from .plans import CollectivePlan, PlanCache, size_bucket
@@ -170,6 +171,21 @@ class ACCL:
         self._demoted_seen: set = set()  # (comm, rank) demotions counted
         engine.set_membership(self._membership)
         engine.on_health_transition = self._on_health_transition
+        # QoS arbiter plane (accl_tpu.arbiter): per-communicator tenant
+        # registry + deficit-weighted round-robin admission in front of
+        # engine dispatch.  Shared per process anchor (the contract-
+        # board discipline) so every in-process rank handle meets on ONE
+        # grant order and ONE decision latch; one-process-per-rank tiers
+        # run a per-process arbiter over identical per-comm streams.
+        # Registration/quotas are always accepted; the acting half (DRR
+        # queueing, throttles) arms via ACCL_ARBITER=1 / set_arbiter().
+        self._arbiter = _arb.arbiter_for(anchor) or _arb.QosArbiter()
+        if _arb.env_arbiter():
+            self._arbiter.armed = True
+        self._arbiter_seq: dict = {}  # comm id -> admission call index
+        # this handle's admission owner identity (one owner = one rank
+        # handle; the per-rank window-share bound keys on it)
+        self._arbiter_owner = ranks[local_rank].session
         # causal trace plane (accl_tpu.telemetry): deterministic
         # trace/span ids assigned at facade intake — per-comm collective
         # seqn counters plus directed p2p channel counters, both
@@ -372,6 +388,14 @@ class ACCL:
             self._membership.ledger.reset()
         self._demote_seq.clear()
         self._demoted_seen.clear()
+        # arbiter plane: admission call-index counters restart at 0
+        # with the rest of the sequence space, so the latched decision
+        # ledger clears with them (stale throttles must never replay
+        # against post-reset indices).  Collective by contract, like
+        # the reset itself — registrations and quotas survive (config
+        # state, exactly like the tuning registers).
+        self._arbiter_seq.clear()
+        self._arbiter.reset_ledger()
         for comm in self._communicators:
             comm.reset_sequences()
         self._config(ConfigFunction.ENABLE_TRANSPORT, 1)
@@ -927,6 +951,166 @@ class ACCL:
             self._demoted_seen.discard((comm.id, r))
         return int(decision.get("root", 0))
 
+    # -- QoS arbiter plane (accl_tpu.arbiter) ---------------------------------
+    def set_arbiter(self, enabled: bool = True) -> None:
+        """Arm (or disarm) the multi-tenant QoS arbiter on this
+        handle's shared arbiter: registered tenants' collectives pass
+        the deficit-weighted round-robin admission queue at intake, and
+        quota throttles apply.  Collective by contract: every rank of
+        every participating group arms it at the same call-sequence
+        point (the set_elastic discipline) — admission delays are
+        uniform per (comm, call index), so a lone armed rank would
+        merely pace itself.  Also read from ``ACCL_ARBITER=1`` at
+        handle construction."""
+        self._arbiter.armed = bool(enabled)
+
+    def set_tenant_class(self, tenant_class, comm=None,
+                         weight: Optional[int] = None,
+                         name: Optional[str] = None):
+        """Register communicator ``comm`` (default: the world) as a
+        tenant of the QoS arbiter with priority class ``tenant_class``
+        (:class:`~accl_tpu.arbiter.TenantClass`, its name, or its int)
+        and an optional explicit DRR ``weight`` (default: the class
+        weight).  Collective by contract: every rank of the
+        communicator registers with the same class/weight at the same
+        point of its call sequence — the write rides the CONFIG drain
+        path like every other register, so nothing launched under the
+        old class is still in flight when it returns.  Returns the
+        registered tenant record."""
+        from .arbiter import coerce_class
+
+        comm = comm or self._world
+        cls = coerce_class(tenant_class)
+        self._config(
+            ConfigFunction.SET_TENANT_CLASS, int(cls), key=comm.id
+        )
+        if weight is not None:
+            self._config(
+                ConfigFunction.SET_TENANT_WEIGHT, int(weight), key=comm.id
+            )
+        return self._arbiter.register(
+            comm.id, name=name, cls=cls, weight=weight, world=comm.size
+        )
+
+    def set_tenant_quota(self, comm=None,
+                         window_share: Optional[int] = None,
+                         ring_slots: Optional[int] = None,
+                         bytes_per_s: Optional[float] = None):
+        """Quota writes for tenant ``comm`` (default: the world), at
+        the two places cross-tenant contention actually lives plus the
+        wire-rate cap:
+
+        * ``window_share`` — this tenant's per-rank share of the
+          overlap plane's in-flight window depth (device tiers bound
+          the tenant's launched-but-incomplete calls by it; the
+          arbiter bounds admissions by ``share x world`` everywhere);
+        * ``ring_slots`` — this tenant's slot budget per command-ring
+          refill window (gang tier): its warm batches chunk into
+          windows of at most this many slots, so a flooder pays more
+          refill doorbells instead of monopolizing the ring;
+        * ``bytes_per_s`` — optional token-bucket wire-rate cap
+          (0 clears it), enforced at admission with the throttle
+          latched per (comm, call index).
+
+        Collective by contract, like every config write.  Returns the
+        tenant record, or None when ``comm`` was never registered."""
+        comm = comm or self._world
+        if window_share is not None:
+            self._config(
+                ConfigFunction.SET_TENANT_WINDOW_SHARE,
+                int(window_share), key=comm.id,
+            )
+        if ring_slots is not None:
+            self._config(
+                ConfigFunction.SET_TENANT_RING_SLOTS,
+                int(ring_slots), key=comm.id,
+            )
+        if bytes_per_s is not None:
+            self._config(
+                ConfigFunction.SET_TENANT_RATE,
+                float(bytes_per_s), key=comm.id,
+            )
+        return self._arbiter.set_quota(
+            comm.id, window_share=window_share, ring_slots=ring_slots,
+            bytes_per_s=bytes_per_s,
+        )
+
+    def _arbiter_gate(self, options: CallOptions) -> None:
+        """Admission intake (the client_arbiter analog): a registered
+        tenant's collective passes the shared DRR queue before engine
+        dispatch — out-of-credit or over-quota tenants wait (bounded)
+        here, absorbing backpressure at the facade instead of inside
+        the fabric.  One attribute check when disarmed.  The decision
+        record (class, throttle) is latched per (comm, call index) on
+        the shared arbiter, so every rank admits the same call with
+        the same delay."""
+        arb = self._arbiter
+        self._call_tls.qos = None
+        comm = options.comm
+        if (
+            not arb.armed or comm is None
+            or options.op not in self._ARBITER_OPS
+        ):
+            return
+        # only COLLECTIVES consume the shared per-comm call index (the
+        # latch key): p2p is rank-asymmetric by design, and letting it
+        # bump the counter would desync collective indices across ranks
+        # (seq -1 = admit without latching; the p2p side charges its
+        # own bucket share directly)
+        if options.op in self._CONTRACT_OPS:
+            seq = self._arbiter_seq.get(comm.id, 0)
+            self._arbiter_seq[comm.id] = seq + 1
+        else:
+            seq = -1
+        cfg = options.arithcfg
+        cost = options.count * (
+            cfg.uncompressed_elem_bytes if cfg is not None else 1
+        )
+        # calls queued into an open batch are charged, not paced: their
+        # dispatch unit is the flushed window (the ring slot budget is
+        # that unit's quota), and holding an admission slot for a call
+        # that cannot complete before its batch flushes would wedge any
+        # batch deeper than the tenant's limit
+        self._call_tls.qos = arb.admit(
+            comm.id, seq, cost, self._timeout_s,
+            self._pending is None, self._arbiter_owner,
+        )
+
+    def _arbiter_async(self, options: CallOptions, req: Request,
+                       dec: dict) -> None:
+        """Completion hook for an ASYNC admitted call: free the
+        tenant's outstanding-admission slot and fold the call's latency
+        into its live histogram when the request completes.  Sync calls
+        account inline on the calling thread instead
+        (:meth:`_arbiter_done`) — a done-callback takes the arbiter
+        lock on the completer thread at exactly the moment the caller's
+        next admission wants it, and that contention measured ~25 us
+        per warm call."""
+        arb = self._arbiter
+        comm_id = options.comm.id
+        paced = bool(dec.get("paced"))
+        owner = self._arbiter_owner
+
+        def _done(arb=arb, comm_id=comm_id, req=req, paced=paced,
+                  owner=owner):
+            # charged-only (batched) calls hold no slot: release=False
+            arb.complete(
+                comm_id, req.get_duration_ns(), owner=owner,
+                release=paced,
+            )
+
+        req.add_done_callback(_done)
+
+    def _arbiter_done(self, options: CallOptions, req: Request,
+                      dec: dict) -> None:
+        """Inline completion accounting for a SYNC admitted call (the
+        calling thread, after its wait) — no cross-thread lock handoff
+        on the warm path."""
+        self._arbiter.complete(
+            options.comm.id, req.get_duration_ns(),
+            owner=self._arbiter_owner, release=bool(dec.get("paced")),
+        )
+
     def set_retry_policy(self, limit: int, backoff_s: float = 0.05) -> None:
         """Arm (or with ``limit=0`` disarm) the eager retransmit protocol
         on the emulated tiers: each eager segment requests an ACK and is
@@ -1419,15 +1603,21 @@ class ACCL:
         valid window-grade attribution, like the skew stamp)."""
         return self._trace_last.get(comm_id, 0)
 
-    def _call_meta(self, options: CallOptions) -> dict:
+    def _call_meta(self, options: CallOptions,
+                   qos: Optional[dict] = None) -> dict:
         """The CallRecord facts known at launch (accl_tpu.telemetry):
         resolved once per call — a handful of attribute reads, no device
-        work — and carried to Request.complete by Telemetry.attach."""
+        work — and carried to Request.complete by Telemetry.attach.
+        ``qos`` is the admission decision (passed explicitly — the tls
+        slot is already consumed by the time meta is built)."""
         comm = options.comm
         plan = options.plan
         dt = options.arithcfg.uncompressed if options.arithcfg else None
         trace_id, trace_phase, parent_id = self._assign_trace(options)
         return {
+            # arbiter plane: which tenant admitted this call (None when
+            # the arbiter is disarmed / the comm unregistered)
+            "tenant": qos["tenant"] if qos else None,
             "trace_id": trace_id,
             "trace_phase": trace_phase,
             "parent_id": parent_id,
@@ -1688,6 +1878,10 @@ class ACCL:
         Operation.REDUCE_SCATTER, Operation.ALLTOALL, Operation.BARRIER,
     ))
 
+    #: operations the QoS arbiter gates at intake: the contract ops
+    #: plus plain p2p — local ops/CONFIG move no fabric bytes
+    _ARBITER_OPS = _CONTRACT_OPS | {Operation.SEND, Operation.RECV}
+
     def _contract_error(self, verdict: dict, context: str) -> ACCLError:
         details = verdict_context(verdict, context)
         if self._telemetry is not None:
@@ -1727,45 +1921,92 @@ class ACCL:
     ) -> Optional[Request]:
         tel = self._telemetry
         self._membership_intake(options, context)
-        self._contract_gate(options, context)
-        # trace/span id assigned at INTAKE — before dispatch — so the
-        # fabric's outbound trace stamp covers this call's own wire
-        # traffic, not just its successors'
-        meta = self._call_meta(options) if tel is not None else None
-        if self._pending is not None:
-            req = Request(op_name=options.op.name)
-            req._pre_wait = self._dispatch_pending  # dispatch on wait
+        # QoS admission BEFORE the contract fingerprint: the arbiter
+        # can only delay a whole call (bounded), never reorder within a
+        # comm, so the digest stream the verifier checks is untouched
+        self._arbiter_gate(options)
+        qos = getattr(self._call_tls, "qos", None)
+        if qos is not None:
+            self._call_tls.qos = None
+        # between admission and the completion hooks, ANY raise (a
+        # contract verdict, a failed engine start) must free the
+        # tenant's outstanding slot, or repeated caught-and-retried
+        # failures pin the owner at its limit forever; once `tracked`,
+        # the async callback / the sync finally owns the release
+        tracked = False
+        try:
+            self._contract_gate(options, context)
+            # trace/span id assigned at INTAKE — before dispatch — so
+            # the fabric's outbound trace stamp covers this call's own
+            # wire traffic, not just its successors'
+            meta = (
+                self._call_meta(options, qos) if tel is not None
+                else None
+            )
+            if self._pending is not None:
+                req = Request(op_name=options.op.name)
+                req._pre_wait = self._dispatch_pending  # dispatch on wait
+                if qos is not None and run_async:
+                    self._arbiter_async(options, req, qos)
+                    tracked = True
+                if tel is not None:
+                    tel.attach(req, meta)
+                self._pending.push((options, req))
+                if run_async:
+                    return req
+                # a sync call inside a batch dispatches the whole run
+                # (it cannot complete before its queued predecessors
+                # anyway); its own wait below is the synchronization —
+                # a full window drain here could fail it over an
+                # UNRELATED wedged call
+                self._dispatch_pending()
+                tracked = True
+                try:
+                    if not req.wait(
+                        timeout=drain_deadline_s(self._timeout_s)
+                    ):
+                        raise self._deadlock_error(context)
+                    self._membership_after_failure(
+                        options, req, context
+                    )
+                    self._check_failed(req, context)
+                finally:
+                    if qos is not None:  # freed however the call ends
+                        self._arbiter_done(options, req, qos)
+                return req
+            req = self.engine.start(options)
+            if qos is not None and run_async:
+                self._arbiter_async(options, req, qos)
+                tracked = True
             if tel is not None:
+                # attach AFTER start: engines that complete
+                # synchronously inside start() are recorded
+                # immediately by attach()
                 tel.attach(req, meta)
-            self._pending.push((options, req))
             if run_async:
                 return req
-            # a sync call inside a batch dispatches the whole run (it
-            # cannot complete before its queued predecessors anyway);
-            # its own wait below is the synchronization — a full window
-            # drain here could fail it over an UNRELATED wedged call
-            self._dispatch_pending()
-            if not req.wait(timeout=drain_deadline_s(self._timeout_s)):
-                raise self._deadlock_error(context)
-            self._membership_after_failure(options, req, context)
-            self._check_failed(req, context)
+            # facade-level deadline follows the shared drain policy so
+            # the engine's own RECEIVE_TIMEOUT fires first for assembly
+            # stalls — and a first-call XLA compile of a large program
+            # doesn't spuriously trip the deadlock detector
+            tracked = True
+            try:
+                if not req.wait(
+                    timeout=drain_deadline_s(self._timeout_s)
+                ):
+                    raise self._deadlock_error(context)
+                self._membership_after_failure(options, req, context)
+                self._check_failed(req, context)
+            finally:
+                if qos is not None:  # slot freed however the call ends
+                    self._arbiter_done(options, req, qos)
             return req
-        req = self.engine.start(options)
-        if tel is not None:
-            # attach AFTER start: engines that complete synchronously
-            # inside start() are recorded immediately by attach()
-            tel.attach(req, meta)
-        if run_async:
-            return req
-        # facade-level deadline follows the shared drain policy so the
-        # engine's own RECEIVE_TIMEOUT fires first for assembly stalls —
-        # and a first-call XLA compile of a large program doesn't
-        # spuriously trip the deadlock detector
-        if not req.wait(timeout=drain_deadline_s(self._timeout_s)):
-            raise self._deadlock_error(context)
-        self._membership_after_failure(options, req, context)
-        self._check_failed(req, context)
-        return req
+        except BaseException:
+            if qos is not None and not tracked and qos.get("paced"):
+                self._arbiter.release(
+                    options.comm.id, owner=self._arbiter_owner
+                )
+            raise
 
     def _check_failed(self, req: Request, context: str) -> None:
         """``Request.check`` with the postmortem hook: a structured
@@ -2487,6 +2728,10 @@ class ACCL:
             # and when?")
             "membership": self._membership.snapshot(),
             "health_events": self._health_events.snapshot(),
+            # arbiter plane: per-tenant admission counters, quotas, and
+            # the live latency histograms with their p99 tails (the
+            # one-line answer to "who is hogging the fabric?")
+            "tenants": self._arbiter.snapshot(),
             "stragglers": (
                 mon.straggler_snapshot() if mon is not None
                 else {"enabled": False}
@@ -2578,6 +2823,11 @@ class ACCL:
                 default=str,
             )
 
+        def _tenants_doc() -> str:
+            import json as _json
+
+            return _json.dumps(self._arbiter.snapshot(), default=str)
+
         srv = _monitor.MonitorServer({
             "/": (self._monitor_index, "text/plain; charset=utf-8"),
             "/metrics": (
@@ -2587,6 +2837,7 @@ class ACCL:
             "/snapshot": (self.telemetry_json, "application/json"),
             "/trace": (_trace_doc, "application/json"),
             "/cmdring": (_cmdring_doc, "application/json"),
+            "/tenants": (_tenants_doc, "application/json"),
         }, port=int(port))
         srv.start()
         self._monitor.server = srv
@@ -2601,7 +2852,7 @@ class ACCL:
         lines = [
             f"accl monitor — rank {self._world.local_rank}/"
             f"{self._world.size} ({type(self.engine).__name__})",
-            "routes: /metrics /snapshot /trace /cmdring",
+            "routes: /metrics /snapshot /trace /cmdring /tenants",
             "",
         ]
         ring = self.engine.telemetry_report().get("cmdring") or {}
@@ -2657,6 +2908,26 @@ class ACCL:
             f"elastic={mem.get('elastic')} "
             f"evicted={sorted(mem.get('evicted') or [])}"
         )
+        # arbiter plane: the one-line per-tenant QoS summary — class,
+        # admission counts, live p99 — so a bare browser hit answers
+        # "who is hogging the fabric" without curl-ing /tenants
+        arb = self._arbiter.snapshot()
+        tenants = arb.get("tenants") or {}
+        if not tenants:
+            lines.append(
+                f"tenants: none registered "
+                f"(arbiter {'armed' if arb.get('enabled') else 'disarmed'})"
+            )
+        else:
+            for cid, t in sorted(tenants.items()):
+                p99 = (t.get("latency") or {}).get("p99_us")
+                lines.append(
+                    f"tenant {t.get('name')}: class={t.get('class')} "
+                    f"weight={t.get('weight')} "
+                    f"admitted={t.get('admitted')} "
+                    f"queued={t.get('queued')} "
+                    f"p99={p99 if p99 is not None else '-'}us"
+                )
         return "\n".join(lines) + "\n"
 
     def stop_monitor(self) -> bool:

@@ -117,6 +117,10 @@ class InflightWindow:
                  park_timeout_s: float = 120.0):
         self.depth = depth if depth is not None else default_window_depth()
         self.park_timeout_s = float(park_timeout_s)
+        # QoS arbiter plane: per-key depth overrides — a tenant
+        # communicator's share of the window (SET_TENANT_WINDOW_SHARE).
+        # Keys without an override ride the global depth.
+        self._key_depth: Dict[Any, int] = {}
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         # per-key FIFO; the head entry is the one its drainer is waiting
@@ -144,6 +148,25 @@ class InflightWindow:
         with self._cv:
             self.depth = max(1, min(int(depth), MAX_INFLIGHT_WINDOW))
             self._cv.notify_all()
+
+    def set_key_depth(self, key: Any, depth: Optional[int]) -> None:
+        """Per-key depth override (the QoS arbiter's per-tenant window
+        share): ``key``'s launches bound at ``depth`` instead of the
+        global depth; ``None`` clears the override.  Widening wakes
+        parked launchers like :meth:`set_depth` does."""
+        with self._cv:
+            if depth is None:
+                self._key_depth.pop(key, None)
+            else:
+                self._key_depth[key] = max(
+                    1, min(int(depth), MAX_INFLIGHT_WINDOW)
+                )
+            self._cv.notify_all()
+
+    def depth_for(self, key: Any) -> int:
+        """The depth bound governing ``key`` right now."""
+        with self._lock:
+            return self._key_depth.get(key, self.depth)
 
     def park(
         self,
@@ -177,7 +200,8 @@ class InflightWindow:
                 # start() still returns and facade deadlines can fire
                 deadline = time.monotonic() + self.park_timeout_s
                 while (
-                    len(self._pending.get(key, ())) >= self.depth
+                    len(self._pending.get(key, ()))
+                    >= self._key_depth.get(key, self.depth)
                     and not self._stopped
                 ):
                     rem = deadline - time.monotonic()
@@ -285,6 +309,7 @@ class InflightWindow:
         with self._lock:
             return {
                 "depth": self.depth,
+                "key_depths": dict(self._key_depth),
                 "in_flight": self._total,
                 "max_depth_seen": self.max_depth_seen,
                 "launched": self.launched,

@@ -86,8 +86,10 @@ ERROR_TAIL = 32
 #: monitor plane (schema_version, stragglers, anomalies, monitor);
 #: 3 = the membership plane (membership, health_events);
 #: 4 = the causal trace plane (postmortem section, trace ids in
-#: flight records, cmdring window timelines under engine.cmdring).
-SCHEMA_VERSION = 4
+#: flight records, cmdring window timelines under engine.cmdring);
+#: 5 = the QoS arbiter plane (tenants section: per-tenant admission
+#: counters, quotas, and live latency histograms with p99 tails).
+SCHEMA_VERSION = 5
 
 # One epoch<->monotonic anchor per process: records carry perf_counter_ns
 # timestamps (cheap, monotonic), trace export maps them onto the epoch
@@ -171,7 +173,7 @@ class CallRecord:
         "algorithm", "plan_hit", "eager", "duration_ns", "retcode",
         "retcode_name", "end_perf_ns", "attempts", "peer",
         "overlap_ns", "inflight_depth", "ring_resident",
-        "trace_id", "trace_phase", "parent_id",
+        "trace_id", "trace_phase", "parent_id", "tenant",
     )
 
     def __init__(self, op, comm, epoch, dtype, count, nbytes, bucket,
@@ -179,7 +181,7 @@ class CallRecord:
                  retcode_name, end_perf_ns, attempts=None, peer=None,
                  overlap_ns=None, inflight_depth=None,
                  ring_resident=None, trace_id=None, trace_phase=None,
-                 parent_id=None):
+                 parent_id=None, tenant=None):
         self.op = op
         self.comm = comm
         self.epoch = epoch
@@ -211,6 +213,10 @@ class CallRecord:
         self.trace_id = trace_id
         self.trace_phase = trace_phase
         self.parent_id = parent_id
+        # QoS arbiter plane: which tenant admitted this call (None when
+        # the arbiter is disarmed / the comm unregistered) — per-call
+        # tenant forensics on the flight recorder
+        self.tenant = tenant
 
     def as_dict(self) -> dict:
         d = {
@@ -243,6 +249,8 @@ class CallRecord:
             d["trace_id"] = self.trace_id
         if self.parent_id is not None:
             d["parent_id"] = self.parent_id
+        if self.tenant is not None:
+            d["tenant"] = self.tenant
         return d
 
 
@@ -608,7 +616,7 @@ class Telemetry:
             time.perf_counter_ns(), attempts, ctx.get("peer"),
             overlap_ns, inflight_depth, ring_resident,
             meta.get("trace_id"), meta.get("trace_phase"),
-            meta.get("parent_id"),
+            meta.get("parent_id"), meta.get("tenant"),
         )
         self.recorder.append(rec)
         if amend:
@@ -1004,6 +1012,71 @@ def to_prometheus(snapshot: dict) -> str:
             f"accl_cmdring_window_latency_us_count"
             f"{_prom_labels(**base)} {cum}"
         )
+
+    # QoS arbiter plane: per-tenant admission counters/gauges and the
+    # per-tenant completion-latency histogram — a REAL Prometheus
+    # histogram (cumulative _bucket / +Inf / _sum / _count, the
+    # accl_call_duration_us pattern) so histogram_quantile() serves the
+    # per-tenant p99 the fairness gate reads live
+    arb = snapshot.get("tenants") or {}
+    tenants = arb.get("tenants") or {}
+    gauge("accl_tenant_arbiter_enabled", int(bool(arb.get("enabled"))))
+    gauge("accl_tenant_rounds_total", arb.get("rounds"))
+    gauge("accl_tenant_grant_timeouts_total", arb.get("grant_timeouts"))
+    gauge("accl_tenant_passthrough_total", arb.get("passthrough"))
+    for _cid, t in sorted(tenants.items()):
+        lbl = {"tenant": t.get("name"), "tenant_class": t.get("class")}
+        gauge("accl_tenant_weight", t.get("weight"), **lbl)
+        gauge("accl_tenant_admitted_total", t.get("admitted"), **lbl)
+        gauge("accl_tenant_completed_total", t.get("completed"), **lbl)
+        gauge(
+            "accl_tenant_cost_granted_bytes_total",
+            t.get("cost_granted_bytes"), **lbl,
+        )
+        gauge(
+            "accl_tenant_grant_wait_ns_total",
+            t.get("grant_wait_ns_total"), **lbl,
+        )
+        gauge(
+            "accl_tenant_throttle_ns_total",
+            t.get("throttle_ns_total"), **lbl,
+        )
+        gauge("accl_tenant_outstanding", t.get("outstanding"), **lbl)
+        gauge("accl_tenant_queued", t.get("queued"), **lbl)
+        gauge(
+            "accl_tenant_over_admissions_total",
+            t.get("over_admissions"), **lbl,
+        )
+        lat = t.get("latency") or {}
+        buckets = lat.get("log2_us") or {}
+        if buckets:
+            if "accl_tenant_call_duration_us" not in seen_types:
+                lines.append(
+                    "# TYPE accl_tenant_call_duration_us histogram"
+                )
+                seen_types.add("accl_tenant_call_duration_us")
+            hlbl = dict(base, **lbl)
+            cum = 0
+            for k, v in sorted(
+                buckets.items(), key=lambda kv: int(kv[0])
+            ):
+                cum += v
+                lines.append(
+                    "accl_tenant_call_duration_us_bucket"
+                    f"{_prom_labels(le=2 ** (int(k) + 1), **hlbl)} {cum}"
+                )
+            lines.append(
+                "accl_tenant_call_duration_us_bucket"
+                f'{_prom_labels(le="+Inf", **hlbl)} {lat.get("count", cum)}'
+            )
+            lines.append(
+                f"accl_tenant_call_duration_us_sum{_prom_labels(**hlbl)} "
+                f"{(lat.get('sum_ns') or 0) / 1e3:.3f}"
+            )
+            lines.append(
+                "accl_tenant_call_duration_us_count"
+                f"{_prom_labels(**hlbl)} {lat.get('count', cum)}"
+            )
 
     # postmortem plane: bundle accounting (the lifetime counter also
     # rides accl_postmortem_bundles_total in the counters section)

@@ -583,6 +583,162 @@ def check_cmdring_capture(bench_path: str, lkg_path: str = None) -> None:
     check_cmdring(extras, lkg.get("result") or {})
 
 
+# QoS arbiter gate (multi-tenant arbiter PR): the capture must prove
+# the warm path with the arbiter DISABLED costs <=5% over the facade
+# bench's own warm round from the same capture (carrying the plane is
+# nearly free when it is off — one attribute check per call), that the
+# ARMED admission path stays within the looser engineering budget
+# (3x), that under the seeded adversarial cross-tenant load the
+# GUARANTEED tenant's p99 — read from the live /tenants histograms —
+# held its bound while the flooder's admissions visibly queued, that
+# the UNARBITRATED baseline run violated the guaranteed SLO (a blown
+# p99, failed serve calls, or a mean-latency blowout), and that the
+# command ring honored the configured per-tenant slot budget.
+ARBITER_OVERHEAD_TOLERANCE_PCT = float(
+    os.environ.get("ACCL_ARBITER_OVERHEAD_TOLERANCE_PCT", "5.0")
+)
+
+
+class ArbiterGateError(ValueError):
+    """The capture's QoS-arbiter evidence is missing/incomplete, its
+    warm-path budget blew, the guaranteed tenant missed its p99 bound,
+    the unarbitrated baseline did NOT violate it (the arbiter bought
+    nothing), or the ring ignored its slot budget."""
+
+
+def check_arbiter(extras: dict, tolerance_pct: float = None) -> None:
+    """Gate a capture's QoS-arbiter evidence.  No-op when the arbiter
+    bench never ran (wedged captures carry no arbiter keys)."""
+    tol = (
+        ARBITER_OVERHEAD_TOLERANCE_PCT
+        if tolerance_pct is None else tolerance_pct
+    )
+    extras = extras or {}
+    off = extras.get("arbiter_off_round_us")
+    on = extras.get("arbiter_on_round_us")
+    p99 = extras.get("arbiter_guaranteed_p99_us")
+    bound = extras.get("arbiter_p99_bound_us")
+    if off is None and on is None and p99 is None:
+        return  # arbiter bench never ran: nothing to gate
+    if off is None or on is None:
+        raise ArbiterGateError(
+            "capture carries partial arbiter evidence (need "
+            "arbiter_off_round_us + arbiter_on_round_us together) — "
+            "the warm-path budget is unverifiable"
+        )
+    # the <=5% claim is about the DISABLED plane: carrying the intake
+    # gate unarmed must not tax the warm path the facade bench measured
+    # in this same capture (same call shape, same process)
+    facade = extras.get("facade_call_overhead_us")
+    if facade is not None and facade > 0 and off > (
+        1.0 + tol / 100.0
+    ) * facade:
+        raise ArbiterGateError(
+            f"disabled-arbiter warm round {off:.2f} us exceeds "
+            f"{1.0 + tol / 100.0:.2f}x the capture's own facade warm "
+            f"round {facade:.2f} us: the plane taxes the warm path "
+            "even when off; fix it instead of committing the slower "
+            "capture"
+        )
+    # the ARMED path carries the real admission bookkeeping — an
+    # OPT-IN cost (tenants registered + the arbiter armed), held to a
+    # 3x engineering budget that catches a runaway admission cost (an
+    # accidental O(n) scan per call blows it instantly) without
+    # flapping on host noise: back-to-back runs of one binary measure
+    # the same ~15 us gate 3 percentage points apart on a busy CPU
+    # host.  Prefer the bench's paired-difference estimate
+    # (drift-cancelling) and fall back to the raw on/off ratio for
+    # captures that predate it.
+    pct = extras.get("arbiter_overhead_pct")
+    if pct is None:
+        pct = max(0.0, (on - off) / max(off, 1e-9) * 100.0)
+    if pct > 3 * tol:
+        raise ArbiterGateError(
+            f"armed-arbiter warm path costs {pct:.1f}% over the "
+            f"disabled path ({on:.2f} vs {off:.2f} us medians; "
+            f"> {3 * tol:.1f}% armed budget): the admission gate is "
+            "leaking onto the warm path; fix it instead of committing "
+            "the slower capture"
+        )
+    if p99 is None or bound is None:
+        raise ArbiterGateError(
+            "capture carries no adversarial-load evidence (need "
+            "arbiter_guaranteed_p99_us + arbiter_p99_bound_us from the "
+            "live /tenants histograms) — the fairness contract is "
+            "unverifiable"
+        )
+    if extras.get("arbiter_fair_errors"):
+        raise ArbiterGateError(
+            f"the GUARANTEED tenant errored under arbitration "
+            f"({extras['arbiter_fair_errors']} serve failures): its "
+            "p99 is not evidence from a healthy run; refusing the "
+            "capture (the flooder's chaos-plan losses are fine — its "
+            "class signed up for them)"
+        )
+    if p99 > bound:
+        raise ArbiterGateError(
+            f"guaranteed tenant p99 {p99:.0f} us exceeded its "
+            f"{bound:.0f} us bound UNDER ARBITRATION — the arbiter "
+            "failed the tenant it exists to protect; refusing the "
+            "capture"
+        )
+    if not extras.get("arbiter_flooder_queued_peak") and not extras.get(
+        "arbiter_flooder_wait_ns"
+    ):
+        raise ArbiterGateError(
+            "the flooder never queued or waited at the arbiter "
+            "(queued_peak=0, wait=0): the adversarial load exercised "
+            "no backpressure — the fairness evidence is vacuous"
+        )
+    base_p99 = extras.get("arbiter_baseline_p99_us")
+    base_errors = extras.get("arbiter_baseline_errors") or 0
+    base_mean = extras.get("arbiter_baseline_mean_us")
+    fair_mean = extras.get("arbiter_guaranteed_mean_us")
+    # the unarbitrated baseline must break the guaranteed tenant's SLO
+    # one way or another: a blown tail, failed serve calls, or a mean
+    # latency blowout (log2 p99 buckets are coarse; the mean is the
+    # quantization-proof half of the contrast)
+    violated = (
+        base_p99 is None or base_p99 > bound or base_errors > 0
+        or (
+            base_mean is not None and fair_mean
+            and base_mean >= 1.25 * fair_mean
+        )
+    )
+    if not violated:
+        raise ArbiterGateError(
+            f"the unarbitrated baseline held the guaranteed SLO too "
+            f"(p99 {base_p99:.0f} us <= {bound:.0f} us, 0 serve "
+            f"errors, mean {base_mean} vs arbitrated {fair_mean} us): "
+            "the workload is not adversarial enough to show the "
+            "arbiter buying anything; refusing the capture"
+        )
+    ring_budget = extras.get("arbiter_ring_budget")
+    ring_max = extras.get("arbiter_ring_max_window")
+    if ring_budget is not None:
+        if not extras.get("arbiter_ring_slots"):
+            raise ArbiterGateError(
+                "ring-share leg ran but no slot executed ring-resident "
+                "— the slot-budget evidence is vacuous"
+            )
+        if ring_max is None or ring_max > ring_budget:
+            raise ArbiterGateError(
+                f"ring refill windows reached {ring_max} slots against "
+                f"a {ring_budget}-slot tenant budget: the command ring "
+                "ignored its quota; refusing the capture"
+            )
+
+
+def check_arbiter_capture(bench_path: str) -> None:
+    """CLI form (``--check-arbiter BENCH_rNN.json``)."""
+    import json
+
+    with open(bench_path) as f:
+        doc = json.load(f)
+    result = doc.get("parsed") or doc.get("result") or doc
+    check_arbiter((result or {}).get("extras") or {})
+
+
 # Autotuned-plan refusal: a TuningPlan only ever *overrides* registers
 # where a candidate measured faster than the defaults, so a tuned sweep
 # should never be meaningfully slower than the default sweep at any
@@ -811,6 +967,16 @@ def main(argv=None) -> str:
         print(
             f"{argv[i + 1]}: monitor evidence present (live scrapes), "
             f"overhead within {MONITOR_OVERHEAD_TOLERANCE_PCT:.1f}%"
+        )
+        return ""
+    if "--check-arbiter" in argv:
+        i = argv.index("--check-arbiter")
+        check_arbiter_capture(argv[i + 1])
+        print(
+            f"{argv[i + 1]}: arbiter evidence present — warm-path "
+            f"budget within {ARBITER_OVERHEAD_TOLERANCE_PCT:.1f}%, "
+            "guaranteed p99 within bound, baseline violating, ring "
+            "budget honored"
         )
         return ""
     if "--check-tuned" in argv:

@@ -35,6 +35,23 @@ def get(port: int, route: str) -> str:
 def main() -> int:
     g = emulated_group(2)
     try:
+        # QoS arbiter plane: arm + register the world as a tenant so
+        # the /tenants route and the index summary carry live evidence
+        for a in g:
+            a.set_arbiter(True)
+        reg = [
+            threading.Thread(
+                target=lambda a: a.set_tenant_class(
+                    "guaranteed", name="smoke"
+                ),
+                args=(a,),
+            )
+            for a in g
+        ]
+        for t in reg:
+            t.start()
+        for t in reg:
+            t.join(60)
         send = [
             a.create_buffer_from(np.full(64, float(r + 1), np.float32))
             for r, a in enumerate(g)
@@ -60,7 +77,7 @@ def main() -> int:
             if line and not line.startswith("#"):
                 assert _PROM_LINE.match(line), f"malformed: {line!r}"
         snap = json.loads(get(port, "/snapshot"))
-        assert snap["schema_version"] == 4
+        assert snap["schema_version"] == 5
         assert snap["stragglers"]["enabled"] is True
         assert "postmortem" in snap
         trace = json.loads(get(port, "/trace"))
@@ -69,9 +86,21 @@ def main() -> int:
         # (the emulator has no ring — the route says so instead of 404)
         ring = json.loads(get(port, "/cmdring"))
         assert isinstance(ring, dict)
+        # QoS arbiter plane: the /tenants route serves the per-tenant
+        # admission counters + live latency histograms (the registered
+        # tenant's p99 must be live — the fairness gate reads it here)
+        tenants = json.loads(get(port, "/tenants"))
+        assert tenants["enabled"] is True
+        t0 = tenants["tenants"]["0"]
+        assert t0["class"] == "GUARANTEED"
+        assert t0["admitted"] > 0
+        assert t0["latency"]["p99_us"] is not None
         # ...and the index page answers "is this mesh healthy" alone
         index = get(port, "/")
-        for needle in ("/cmdring", "postmortem:", "membership: epoch="):
+        for needle in (
+            "/cmdring", "/tenants", "postmortem:",
+            "membership: epoch=", "tenant smoke:",
+        ):
             assert needle in index, f"index page missing {needle!r}"
         # flow well-formedness: both ranks' exports merge with every
         # flow start matched to a finish (the merge-CLI invariant)

@@ -232,7 +232,7 @@ def test_jax_free_module_traverses_from_import_alias(tmp_path, monkeypatch):
     (pkg / "sub" / "__init__.py").write_text("import numpy\n")
     (pkg / "sub" / "leaf.py").write_text("x = 1\n")
     for m in ("constants", "telemetry", "faults", "plans", "contract",
-              "monitor", "membership"):
+              "monitor", "membership", "arbiter"):
         (pkg / f"{m}.py").write_text("")
     import accl_tpu.analysis.graph as graph_mod
 
@@ -257,6 +257,7 @@ def test_jax_free_module_detects_violation(tmp_path, monkeypatch):
     (pkg / "contract.py").write_text("")
     (pkg / "monitor.py").write_text("")
     (pkg / "membership.py").write_text("")
+    (pkg / "arbiter.py").write_text("")
     import accl_tpu.analysis.base as base_mod
 
     monkeypatch.setattr(base_mod, "package_root", lambda: str(pkg))
@@ -282,7 +283,7 @@ def test_jax_free_module_sees_with_block_imports(tmp_path, monkeypatch):
         "    import numpy\n"
     )
     for m in ("constants", "overlap", "telemetry", "faults", "contract",
-              "monitor", "membership"):
+              "monitor", "membership", "arbiter"):
         (pkg / f"{m}.py").write_text("")
     import accl_tpu.analysis.base as base_mod
     import accl_tpu.analysis.graph as graph_mod
@@ -317,7 +318,7 @@ def test_jax_free_modules_import_without_heavy_stack():
         pkg.__path__ = [root]
         sys.modules['accl_tpu'] = pkg
         for m in ('constants', 'overlap', 'telemetry', 'faults', 'plans',
-                  'contract', 'monitor', 'membership'):
+                  'contract', 'monitor', 'membership', 'arbiter'):
             spec = importlib.util.spec_from_file_location(
                 'accl_tpu.' + m, os.path.join(root, m + '.py'))
             mod = importlib.util.module_from_spec(spec)

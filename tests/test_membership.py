@@ -648,6 +648,102 @@ def test_ring_breaker_degrades_and_reprobes(monkeypatch):
 
 
 # ---------------------------------------------------------------------------
+# gang-tier kill -> shrink -> serve -> restore (the PR 12 deferral:
+# the cutover machinery was wired on the device mesh but only the
+# emulated transports were chaos-soaked)
+# ---------------------------------------------------------------------------
+
+
+def test_gang_kill_shrink_serve_restore():
+    """World 4 on the gang (xla_group) device mesh, rank 3 goes silent:
+    the slot watchdog strikes it dead, the surviving majority agrees on
+    the shared board, the in-flight collective fails with structured
+    RANK_EVICTED, the group serves bit-correct at world 3 over the
+    shrunk submesh, and a collective soft_reset restores full
+    membership — the full elastic cycle at gang tier."""
+    from accl_tpu.core import xla_group
+
+    g = xla_group(4)
+    try:
+        for a in g:
+            a.set_elastic(True)
+            a.set_timeout(1.0)  # two watchdog strikes = ~2 s to "dead"
+        survivors = g[:3]
+
+        def doomed(a, r):
+            # rank 3 never arrives at the gang slot: each attempt burns
+            # the slot watchdog deadline and strikes the absent session;
+            # the SECOND strike marks it dead, elastic proposes, and the
+            # bounded post-failure gate surfaces RANK_EVICTED
+            codes = []
+            for _ in range(4):
+                s = a.create_buffer_from(
+                    np.full(64, r + 1.0, np.float32)
+                )
+                d = a.create_buffer(64, np.float32)
+                try:
+                    a.allreduce(s, d, 64)
+                    return codes  # shrink already applied mid-loop
+                except ACCLError as e:
+                    codes.append(int(e.code))
+                    if e.code & ErrorCode.RANK_EVICTED:
+                        return codes
+            return codes
+
+        t0 = time.monotonic()
+        failed = run_parallel(survivors, doomed, timeout=40.0)
+        shrink_s = time.monotonic() - t0
+        assert shrink_s < 20.0, f"gang shrink took {shrink_s:.1f}s"
+        for codes in failed:
+            assert codes and codes[-1] & int(ErrorCode.RANK_EVICTED), failed
+        assert [a.size for a in survivors] == [3, 3, 3]
+        assert [a._membership.epoch for a in survivors] == [1, 1, 1]
+        # the agreement rode the gang anchor's shared board
+        assert survivors[0]._membership.snapshot()["exchange"] == "board"
+
+        # N green collectives at world 3, bit-correct over the submesh
+        expected = float(1 + 2 + 3)
+
+        def serve(a, r):
+            out = []
+            for _ in range(4):
+                s = a.create_buffer_from(
+                    np.full(64, r + 1.0, np.float32)
+                )
+                d = a.create_buffer(64, np.float32)
+                a.allreduce(s, d, 64)
+                d.sync_from_device()
+                out.append(float(d.data[0]))
+            return out
+
+        served = run_parallel(survivors, serve, timeout=60.0)
+        for vals in served:
+            assert vals == [expected] * 4, served
+
+        # heal: the collective soft_reset re-admits the silent rank
+        for a in g:
+            a.set_timeout(10.0)
+        run_parallel(g, lambda a, r: a.soft_reset(), timeout=60.0)
+        assert [a.size for a in g] == [4, 4, 4, 4]
+
+        def full(a, r):
+            s = a.create_buffer_from(np.full(64, r + 1.0, np.float32))
+            d = a.create_buffer(64, np.float32)
+            a.allreduce(s, d, 64)
+            d.sync_from_device()
+            return float(d.data[0])
+
+        total = float(1 + 2 + 3 + 4)
+        assert run_parallel(g, full, timeout=60.0) == [total] * 4
+        # the shrink left its audit trail on the live surface
+        snap = g[0].telemetry_snapshot()
+        assert snap["membership"]["evictions_total"] == 1
+        assert snap["membership"]["restores_total"] == 1
+    finally:
+        _deinit(g)
+
+
+# ---------------------------------------------------------------------------
 # dist-tier KV digest piggyback (the PR 7 deferral, unit-proven)
 # ---------------------------------------------------------------------------
 
