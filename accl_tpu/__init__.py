@@ -52,8 +52,15 @@ from .membership import (  # noqa: F401
     ELASTIC_ENV,
     MembershipView,
 )
+from .errorfeedback import ResidualStore  # noqa: F401
 from .plans import CollectivePlan, PlanCache, size_bucket  # noqa: F401
 from .request import Request, RequestStatus  # noqa: F401
+from .wire import (  # noqa: F401
+    call_seed as wire_call_seed,
+    is_wire_dtype,
+    wire_lane_dtypes,
+    wire_nbytes,
+)
 from .telemetry import (  # noqa: F401
     CallRecord,
     FlightRecorder,

@@ -42,6 +42,11 @@ JAX_FREE_MODULES = (
     "accl_tpu.monitor",
     "accl_tpu.membership",
     "accl_tpu.arbiter",
+    # quantized wire plane: the shared host codec + error-feedback
+    # residual store (lazy numpy, the constants.py pattern) — socket
+    # rank processes and the analysis tooling import both
+    "accl_tpu.wire",
+    "accl_tpu.errorfeedback",
 )
 
 #: top-level packages whose module-scope import breaks jax-freedom
@@ -351,6 +356,22 @@ _CMDRING_MODULES = (
 #: reference every executable opcode (the cross-file presence check)
 _CMDRING_DECODE_MODULE = "ops/pallas/cmdring.py"
 
+#: the shared device-side wire-lane module: its literal ``WIRE_LANES``
+#: table must cover every dtype constants.WIRE_LANE_DTYPES registers
+_WIRE_LANE_MODULE = "ops/wire.py"
+
+#: the decode module's two sequencer lowerings: EACH must route its
+#: wire cast through the shared lane machinery (a wire value only one
+#: lowering decodes is a finding — the quantized-wire cross-check)
+_CMDRING_LOWERING_FUNCS = ("_decode_slot_xla", "_pallas_windows")
+
+#: names that constitute "routing through the shared lane machinery":
+#: the roundtrip helper, or the cast+scaled lane pair it is built from
+_WIRE_LANE_HELPERS = frozenset((
+    "wire_lane_roundtrip", "_cast_lane", "quantize_int8",
+    "dequantize_int8",
+))
+
 #: opcodes exempt from the decode-presence requirement: NOP is the
 #: padding slot (decoded, skipped), HALT the teardown marker — neither
 #: executes a collective
@@ -416,6 +437,66 @@ def _cmdring_opcodes(src: SourceFile):
     return opcodes, mapped, map_line
 
 
+def _wire_lane_dtypes(src: SourceFile):
+    """constants.WIRE_LANE_DTYPES as a literal {member: numpy name}
+    dict (None when absent — pre-quantized-wire trees)."""
+    for node in src.tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == "WIRE_LANE_DTYPES"
+            and isinstance(node.value, ast.Dict)
+        ):
+            out = {}
+            for k, v in zip(node.value.keys, node.value.values):
+                if isinstance(k, ast.Constant) and isinstance(
+                    v, ast.Constant
+                ):
+                    out[k.value] = v.value
+            return out, node.lineno
+    return None, 1
+
+
+def _wire_lanes_table(src: SourceFile):
+    """ops/wire.py's literal ``WIRE_LANES`` table (numpy-name keys)."""
+    for node in src.tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == "WIRE_LANES"
+            and isinstance(node.value, ast.Dict)
+        ):
+            keys = set()
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant):
+                    keys.add(k.value)
+            return keys, node.lineno
+    return None, 1
+
+
+def _func_wire_refs(src: SourceFile, fn_name: str):
+    """(found_fn, helper names referenced) for one lowering function:
+    every ``X.helper`` / bare ``helper`` reference inside its body."""
+    for node in src.tree.body:
+        if isinstance(node, ast.FunctionDef) and node.name == fn_name:
+            refs = set()
+            for sub in ast.walk(node):
+                if (
+                    isinstance(sub, ast.Attribute)
+                    and sub.attr in _WIRE_LANE_HELPERS
+                ):
+                    refs.add(sub.attr)
+                elif (
+                    isinstance(sub, ast.Name)
+                    and sub.id in _WIRE_LANE_HELPERS
+                ):
+                    refs.add(sub.id)
+            return node, refs
+    return None, set()
+
+
 def _cmdopcode_refs(src: SourceFile):
     """Every ``CmdOpcode.<NAME>`` attribute referenced in a module (the
     presence evidence that its decode path handles the opcode)."""
@@ -458,6 +539,7 @@ def check_cmdring_slot_layout(sources: List[SourceFile]) -> List[Finding]:
     consts = None
     ringmods: List[SourceFile] = []
     decode_mod = None
+    lane_mod = None
     for src in sources:
         mod = _module_name(src.path, root)
         if mod == "accl_tpu.constants":
@@ -468,10 +550,63 @@ def check_cmdring_slot_layout(sources: List[SourceFile]) -> List[Finding]:
             ringmods.append(src)
         if rel == _CMDRING_DECODE_MODULE:
             decode_mod = src
+        if rel == _WIRE_LANE_MODULE:
+            lane_mod = src
     if consts is None:
         return findings  # partial-scope run without constants.py
     fields, slot_words = _cmdring_table(consts)
     opcodes, mapped, map_line = _cmdring_opcodes(consts)
+    # quantized-wire cross-check: every REGISTERED wire dtype must be
+    # handled by BOTH decode-loop lowerings.  Handling is proven
+    # structurally: (a) each lowering function routes its wire cast
+    # through the shared lane machinery (ops/wire helpers), so one lane
+    # table serves both; (b) that table covers every registered lane.
+    # A lane only one lowering decodes — or a registered dtype the
+    # shared table misses — fails the tree before it can surface as a
+    # silent workload fallback.
+    lanes, lanes_line = _wire_lane_dtypes(consts)
+    if lanes and decode_mod is not None:
+        for fn_name in _CMDRING_LOWERING_FUNCS:
+            fn_node, refs = _func_wire_refs(decode_mod, fn_name)
+            if fn_node is None:
+                findings.append(Finding(
+                    check="cmdring-slot-layout", path=decode_mod.path,
+                    line=1,
+                    message=f"decode module lost lowering function "
+                            f"{fn_name!r}: the wire-lane cross-check "
+                            "anchors on both lowerings by name",
+                ))
+            elif not refs:
+                findings.append(decode_mod.finding(
+                    "cmdring-slot-layout", fn_node,
+                    f"lowering {fn_name!r} never routes through the "
+                    f"shared wire-lane helpers "
+                    f"({sorted(_WIRE_LANE_HELPERS)}): a wire dtype "
+                    "this lowering decodes privately can diverge from "
+                    "the other lowering's lane",
+                ))
+        if lane_mod is not None:
+            table, table_line = _wire_lanes_table(lane_mod)
+            if table is None:
+                findings.append(Finding(
+                    check="cmdring-slot-layout", path=lane_mod.path,
+                    line=1,
+                    message="ops/wire.py lost its literal WIRE_LANES "
+                            "table — the registered-lane coverage "
+                            "cross-check reads it",
+                ))
+            else:
+                missing = sorted(set(lanes.values()) - table)
+                if missing:
+                    findings.append(Finding(
+                        check="cmdring-slot-layout",
+                        path=lane_mod.path, line=table_line,
+                        message=f"registered wire dtypes {missing} "
+                                "(constants.WIRE_LANE_DTYPES) missing "
+                                "from the shared WIRE_LANES table: "
+                                "both lowerings would fall back on "
+                                "them",
+                    ))
     if opcodes is not None and ringmods:
         vals = list(opcodes.values())
         if (

@@ -82,6 +82,19 @@ DEFAULT_ARITH_CONFIG: Dict[Tuple[DataType, DataType], ArithConfig] = {
     (DataType.BFLOAT16, DataType.FLOAT8_E5M2): ArithConfig(
         DataType.BFLOAT16, DataType.FLOAT8_E5M2
     ),
+    # int8 wire pairs: blockwise absmax-scaled quantization (one fp32
+    # scale per constants.WIRE_SEGMENT_ELEMS elements rides the wire
+    # beside the int8 payload — see accl_tpu.wire).  SUM only: MAX over
+    # per-block rescaled integers is not order-independent across
+    # differently-scaled contributions.
+    (DataType.FLOAT32, DataType.INT8): ArithConfig(
+        DataType.FLOAT32, DataType.INT8,
+        reduce_functions=(ReduceFunction.SUM,),
+    ),
+    (DataType.BFLOAT16, DataType.INT8): ArithConfig(
+        DataType.BFLOAT16, DataType.INT8,
+        reduce_functions=(ReduceFunction.SUM,),
+    ),
 }
 
 

@@ -54,6 +54,13 @@ class CallOptions:
     # via effective_tuning()/eager_limit() below
     plan: Optional[object] = None
     tuning: Optional[dict] = None
+    # quantized wire plane (accl_tpu.wire): the stochastic-rounding
+    # seed for this call's wire lane.  0 = deterministic rounding (the
+    # f16/bf16 lanes); nonzero for the fp8/int8 lanes, derived
+    # SPMD-uniformly by the facade (wire.call_seed) and mixed per rank
+    # at the point of encoding (wire.rank_seed) so ranks draw
+    # independent streams from one shared slot/seed value
+    wire_seed: int = 0
 
     @spmd_uniform
     def eager_limit(self, default: int) -> int:

@@ -98,7 +98,11 @@ def _pad_chunks_program(chunks: int, n: int, nb: int, wire_name, device):
     def f(a):
         m = a[: chunks * n].reshape(chunks, n)
         if wire_name is not None:
-            m = m.astype(jnp.dtype(wire_name)).astype(m.dtype)
+            # the shared in-program wire lane (cast lanes + the scaled
+            # int8 lane), mirroring the gang tier's decode-loop helper
+            from ...ops import wire as devwire
+
+            m = devwire.wire_lane_roundtrip(m, jnp.dtype(wire_name))
         if nb != n:
             m = jnp.pad(m, ((0, 0), (0, nb - n)))
         return m.reshape(1, chunks * nb)
@@ -536,7 +540,17 @@ class DistEngine(StreamPortMixin, BaseEngine):
         # committed put of the bucket-shaped row
         m = row.reshape(chunks, n)
         if wire_name is not None:
-            m = m.astype(wire_name).astype(npdt)
+            # the shared host codec (scaled int8 lane + SR seeds
+            # included), per chunk — mirrors the emulator's chunk lanes
+            from ... import wire as wirecodec
+
+            seed = wirecodec.options_rank_seed(options)
+            m = np.stack([
+                wirecodec.roundtrip(
+                    c, options.arithcfg.compressed, seed
+                ).astype(npdt)
+                for c in m
+            ])
         if nb != n:
             m = np.concatenate(
                 [m, np.zeros((chunks, nb - n), npdt)], axis=1

@@ -30,6 +30,7 @@ from ...constants import (
     StreamFlags,
     dtype_to_numpy,
 )
+from ... import wire as wirecodec
 from ..base import CallOptions
 from .dataplane import cast_array, cast_bytes, reduce_inplace
 from .fabric import Message, MsgType
@@ -57,6 +58,44 @@ def _wire_dtype(call: CallOptions) -> DataType:
     if call.compression & CompressionFlags.ETH_COMPRESSED:
         return cfg.compressed
     return cfg.uncompressed
+
+
+def _wire_seed(call: CallOptions) -> int:
+    """This rank's SR seed for the call's wire lane (0 = deterministic
+    — every uncompressed call and the f16/bf16 lanes): the ONE shared
+    derivation rule (wire.options_rank_seed), mirroring the sequencer
+    decode loop's on-device rank mixing."""
+    return wirecodec.options_rank_seed(call)
+
+
+def _encode_chunk(call: CallOptions, data: np.ndarray) -> bytes:
+    """One logical chunk's wire bytes: the shared quantized-wire codec
+    for the scaled (int8) and stochastic lanes, the classic cast lane
+    (native hp_compression acceleration included) otherwise — both
+    produce ``wire_nbytes`` bytes the receive side sizes with."""
+    wire_dt = _wire_dtype(call)
+    seed = _wire_seed(call)
+    if wirecodec.is_scaled(wire_dt) or seed:
+        return wirecodec.encode_bytes(data, wire_dt, seed)
+    return cast_array(np.asarray(data), wire_dt).tobytes()
+
+
+def _decode_chunk(call: CallOptions, raw: bytes, n: int, out_dt: DataType):
+    """Inverse of :func:`_encode_chunk` for ``n`` elements (seed-free:
+    SR is an encode-side property)."""
+    wire_dt = _wire_dtype(call)
+    if wirecodec.is_scaled(wire_dt):
+        return wirecodec.decode_bytes(
+            raw, wire_dt, n, dtype_to_numpy(out_dt)
+        )
+    arr = np.frombuffer(raw, dtype=dtype_to_numpy(wire_dt))[:n]
+    return cast_array(arr, out_dt)
+
+
+def _wire_chunk_nbytes(call: CallOptions, n: int) -> int:
+    """Wire bytes a chunk of ``n`` elements occupies — the codec's ONE
+    sizing rule (scale sidecars included), shared with the send side."""
+    return wirecodec.wire_nbytes(n, _wire_dtype(call))
 
 
 def _acc_dtype(call: CallOptions) -> DataType:
@@ -93,11 +132,24 @@ def _tun(eng, call: CallOptions, name: str):
 
 
 def _use_rendezvous(eng, call: CallOptions, nbytes: int) -> bool:
+    """Protocol verdict for one chunk (``nbytes`` = UNCOMPRESSED chunk
+    size, the symmetric input both ends derive from their own call).
+    The reference rule is rendezvous iff large AND uncompressed AND
+    unstreamed (``send`` c:587); the quantized wire plane RELAXES the
+    compression clause for the pure wire lane (ETH_COMPRESSED only):
+    the one-sided write moves the ENCODED frame, so a large compressed
+    transfer pays one quarter the bytes instead of falling back to the
+    segmented eager path whose per-segment matching would bury the
+    saving — the halve-the-wire-bytes lever, applied to the protocol
+    tier too.  Operand/result-compression flags and streams keep the
+    eager path (their lanes live in the rx/stream machinery)."""
+    if nbytes <= call.eager_limit(eng.max_eager_size):
+        return False
+    if call.stream != StreamFlags.NO_STREAM:
+        return False
     return (
-        nbytes > call.eager_limit(eng.max_eager_size)
-        and call.compression == CompressionFlags.NO_COMPRESSION
-        and call.stream == StreamFlags.NO_STREAM
-    )
+        call.compression & ~CompressionFlags.ETH_COMPRESSED
+    ) == CompressionFlags.NO_COMPRESSION
 
 
 # ---------------------------------------------------------------------------
@@ -145,6 +197,9 @@ class RecvHandle:
     nseg: int = 0  # eager: number of segments to match
     vaddr: int = 0  # rndzv: registered write token
     raw: Optional[bytearray] = None
+    # compressed rendezvous: the one-sided write lands the ENCODED wire
+    # frame here; the waiter decodes into the real destination
+    staging: Optional[np.ndarray] = None
 
 
 def eager_recv_post(
@@ -242,11 +297,19 @@ def send_chunk(
 ) -> Generator:
     """Send one logical chunk, choosing eager/rendezvous like the firmware."""
     if _use_rendezvous(eng, call, data.nbytes):
-        yield from rndzv_send(eng, comm, peer, tag, data.tobytes())
+        if call.compression & CompressionFlags.ETH_COMPRESSED:
+            # compressed rendezvous: the one-sided write moves the
+            # encoded frame (the receiver registered a staging region
+            # of exactly wire_nbytes — see recv_chunk_post)
+            yield from rndzv_send(
+                eng, comm, peer, tag, _encode_chunk(call, data)
+            )
+        else:
+            yield from rndzv_send(eng, comm, peer, tag, data.tobytes())
     else:
-        wire_dt = _wire_dtype(call)
-        payload = cast_array(data, wire_dt).tobytes()
-        yield from eager_send(eng, comm, peer, tag, payload)
+        yield from eager_send(
+            eng, comm, peer, tag, _encode_chunk(call, data)
+        )
     return None
 
 
@@ -259,10 +322,17 @@ def recv_chunk_post(
     dst: np.ndarray,
 ) -> RecvHandle:
     if _use_rendezvous(eng, call, dst.nbytes):
+        if call.compression & CompressionFlags.ETH_COMPRESSED:
+            staging = np.empty(
+                _wire_chunk_nbytes(call, dst.size), np.uint8
+            )
+            handle = rndzv_recv_post(eng, comm, peer, tag, staging)
+            handle.staging = staging
+            return handle
         return rndzv_recv_post(eng, comm, peer, tag, dst)
-    wire_dt = _wire_dtype(call)
-    wire_nbytes = dst.size * dtype_to_numpy(wire_dt).itemsize
-    return eager_recv_post(eng, comm, peer, tag, wire_nbytes)
+    return eager_recv_post(
+        eng, comm, peer, tag, _wire_chunk_nbytes(call, dst.size)
+    )
 
 
 def recv_chunk_wait(
@@ -274,11 +344,20 @@ def recv_chunk_wait(
 ) -> Generator:
     if handle.protocol == "rndzv":
         yield from rndzv_recv_wait(eng, comm, handle)
+        if handle.staging is not None:
+            np.copyto(
+                dst,
+                _decode_chunk(
+                    call, handle.staging.tobytes(), dst.size,
+                    call_res_dtype_of(dst),
+                ),
+            )
     else:
         raw = yield from eager_recv_wait(eng, comm, handle)
-        wire_dt = _wire_dtype(call)
-        arr = np.frombuffer(raw, dtype=dtype_to_numpy(wire_dt))[: dst.size]
-        np.copyto(dst, cast_array(arr, call_res_dtype_of(dst)))
+        np.copyto(
+            dst,
+            _decode_chunk(call, raw, dst.size, call_res_dtype_of(dst)),
+        )
     return None
 
 
@@ -312,21 +391,24 @@ def recv_reduce_chunk(
     """Receive a chunk and reduce it into ``acc`` (ref ``fused_recv_reduce``
     c:716-749).  Rendezvous lands in a spare buffer first (ref TMP1-3)."""
     if _use_rendezvous(eng, call, acc.nbytes):
+        # recv_chunk_post/_wait own the protocol plumbing (incl. the
+        # compressed-rendezvous staging + frame decode): land in a
+        # spare, then fold — ONE copy of the frame logic
         tmp = np.empty_like(acc)
-        handle = rndzv_recv_post(eng, comm, peer, tag, tmp)
-        yield from rndzv_recv_wait(eng, comm, handle)
+        handle = recv_chunk_post(eng, call, comm, peer, tag, tmp)
+        yield from recv_chunk_wait(eng, call, comm, handle, tmp)
         reduce_inplace(call.reduce_function, acc, tmp)
     else:
         handle = eager_recv_post(
-            eng,
-            comm,
-            peer,
-            tag,
-            acc.size * dtype_to_numpy(_wire_dtype(call)).itemsize,
+            eng, comm, peer, tag, _wire_chunk_nbytes(call, acc.size)
         )
         raw = yield from eager_recv_wait(eng, comm, handle)
-        arr = np.frombuffer(raw, dtype=dtype_to_numpy(_wire_dtype(call)))[: acc.size]
-        reduce_inplace(call.reduce_function, acc, cast_array(arr, call_res_dtype_of(acc)))
+        reduce_inplace(
+            call.reduce_function, acc,
+            np.asarray(_decode_chunk(
+                call, raw, acc.size, call_res_dtype_of(acc)
+            )),
+        )
     return None
 
 
@@ -537,22 +619,23 @@ def op_gather(eng, call: CallOptions) -> Generator:
             )
             peers = [p for p in range(size) if p != root]
             for i in range(0, len(peers), window):
-                batch = peers[i : i + window]
-                handles = [
-                    rndzv_recv_post(
-                        eng,
-                        comm,
-                        p,
-                        call.tag,
-                        dst_all[p * count : (p + 1) * count],
-                    )
-                    for p in batch
+                # recv_chunk_post/_wait own the protocol plumbing
+                # (incl. the compressed-rendezvous staging + frame
+                # decode — a raw receive would skip the wire lane)
+                batch = [
+                    (p, dst_all[p * count : (p + 1) * count])
+                    for p in peers[i : i + window]
                 ]
-                for h in handles:
-                    yield from rndzv_recv_wait(eng, comm, h)
+                handles = [
+                    (recv_chunk_post(eng, call, comm, p, call.tag, dst),
+                     dst)
+                    for p, dst in batch
+                ]
+                for h, dst in handles:
+                    yield from recv_chunk_wait(eng, call, comm, h, dst)
         else:
-            yield from rndzv_send(
-                eng, comm, root, call.tag, _op0_view(call).tobytes()
+            yield from send_chunk(
+                eng, call, comm, root, call.tag, _op0_view(call)
             )
         return ErrorCode.OK
     # eager ring relay toward root
@@ -714,10 +797,14 @@ def op_reduce_scatter(eng, call: CallOptions) -> Generator:
         send_blk = acc[send_c * count : (send_c + 1) * count]
         recv_blk = acc[recv_c * count : (recv_c + 1) * count]
         if _use_rendezvous(eng, call, count * npdt.itemsize):
+            # recv_chunk_post/_wait own the protocol plumbing (incl.
+            # the compressed-rendezvous staging + frame decode —
+            # receiving the peer's ENCODED frame into a raw npdt tmp
+            # would fold reinterpreted wire bytes into the accumulator)
             tmp = np.empty(count, npdt)
-            handle = rndzv_recv_post(eng, comm, prv, call.tag, tmp)
+            handle = recv_chunk_post(eng, call, comm, prv, call.tag, tmp)
             yield from send_chunk(eng, call, comm, nxt, call.tag, send_blk)
-            yield from rndzv_recv_wait(eng, comm, handle)
+            yield from recv_chunk_wait(eng, call, comm, handle, tmp)
             reduce_inplace(call.reduce_function, recv_blk, tmp)
         else:
             yield from send_chunk(eng, call, comm, nxt, call.tag, send_blk)

@@ -1067,6 +1067,11 @@ class EmuEngine(BaseEngine):
                 return ErrorCode.CONFIG_ERROR
             if key == TuningKey.RING_SEGMENTS and val < 1:
                 return ErrorCode.CONFIG_ERROR
+            if key == TuningKey.WIRE_DTYPE and int(val) != 0:
+                from ...wire import is_wire_dtype
+
+                if not is_wire_dtype(int(val)):
+                    return ErrorCode.CONFIG_ERROR
             if key in ALGORITHM_TUNING_KEYS:
                 try:
                     algo = AllreduceAlgorithm(int(val))

@@ -266,6 +266,27 @@ class Fabric:
 
     _injector: Optional[FaultInjector] = None
     _delay_lock: Optional[threading.Lock] = None
+    #: modeled link rate in bytes/s (None = unpaced, the default): the
+    #: emulated wire's bandwidth model.  The in-process transports move
+    #: frames at memcpy speed (~10 GB/s), which is no wire at all — a
+    #: compression sweep measured there reads codec cost only.  With a
+    #: rate set (``set_wire_rate`` / ACCL_WIRE_GBPS, read by the bench
+    #: harness), every transmit pays payload_bytes/rate of wall clock,
+    #: serialized per sender like a real NIC — deterministic, byte-
+    #: proportional, honest about WHAT is being measured (the artifact
+    #: records the modeled rate).
+    _wire_rate_Bps: Optional[float] = None
+
+    def set_wire_rate(self, gbps: Optional[float]) -> None:
+        """Model the link at ``gbps`` gigabits/s (None disables)."""
+        self._wire_rate_Bps = (
+            None if not gbps else float(gbps) * 1e9 / 8.0
+        )
+
+    def _pace(self, msg: "Message") -> None:
+        rate = self._wire_rate_Bps
+        if rate and msg.payload:
+            time.sleep(len(msg.payload) / rate)
 
     def install_fault_plan(self, plan: Optional[FaultPlan]) -> Optional[FaultInjector]:
         """Arm (or with ``None``, disarm) a fault plan on this fabric."""
@@ -375,6 +396,7 @@ class Fabric:
             provider = traces.get((msg.comm_id, msg.src))
             if provider is not None:
                 msg.trc = provider.trace_stamp(msg.comm_id)
+        self._pace(msg)  # modeled link rate (no-op when unpaced)
         inj = self._injector
         if inj is None:
             self._transmit(address, msg)

@@ -301,6 +301,26 @@ class NativeEngine(BaseEngine):
             else:
                 req.complete(ErrorCode.CONFIG_ERROR)
             return req
+        if (
+            options.op == Operation.CONFIG
+            and int(options.cfg_function) == int(ConfigFunction.SET_TUNING)
+            and int(options.cfg_key) == int(TuningKey.WIRE_DTYPE)
+        ):
+            # quantized-wire verdict register, handled host-side like
+            # pipeline_threshold: the ABI predates it and the facade's
+            # _plan_for reads this host mirror anyway — same validation
+            # as every other tier (0 or a registered wire lane)
+            from ... import wire as wirecodec
+
+            req = Request(op_name=options.op.name)
+            req.mark_executing()
+            val = int(options.cfg_value)
+            if val == 0 or wirecodec.is_wire_dtype(val):
+                self.tuning["wire_dtype"] = val
+                req.complete(ErrorCode.OK)
+            else:
+                req.complete(ErrorCode.CONFIG_ERROR)
+            return req
         mv = self.membership
         if (
             mv is not None and mv.self_evicted
@@ -323,6 +343,16 @@ class NativeEngine(BaseEngine):
                 "elapsed_s": 0.0,
             })
             return req
+        # quantized wire plane, host-side mirror: the C ABI's cast
+        # lanes (hp_compression role) cover the f16/bf16/fp8 wire
+        # dtypes; the SCALED int8 lane (per-segment absmax + SR) is
+        # mirrored here through the shared host codec — the operand is
+        # pre-rounded through the wire exactly as the other tiers
+        # round it, and the C engine runs the call uncompressed, so
+        # every tier computes the same quantized sum.  (Wire BYTES on
+        # this tier stay full-width — the honest-bytes lane needs ABI
+        # growth; the numeric protocol is what must agree.)
+        options = self._mirror_scaled_wire(options)
         args = _CallArgs()
         args.op = int(options.op)
         args.cfg_function = int(options.cfg_function)
@@ -385,6 +415,59 @@ class NativeEngine(BaseEngine):
 
                 req.add_done_callback(_mirror)
         return req
+
+    def _mirror_scaled_wire(self, options: CallOptions) -> CallOptions:
+        """Scaled-wire (int8) calls re-shaped for the C ABI: round the
+        operand through the shared host codec (blockwise absmax + this
+        call's rank-mixed SR seed — the identical arithmetic every
+        other tier runs) into a staging buffer, then dispatch the call
+        UNCOMPRESSED.  Cast-lane and uncompressed calls pass through
+        untouched."""
+        from ...constants import CompressionFlags, Operation
+        from ... import wire as wirecodec
+
+        cfg = options.arithcfg
+        if (
+            cfg is None
+            or not options.compression & CompressionFlags.ETH_COMPRESSED
+            or not wirecodec.is_scaled(cfg.compressed)
+            or options.op == Operation.CONFIG
+            or options.op0 is None
+            or options.op0.is_dummy
+        ):
+            return options
+        import dataclasses
+
+        import numpy as np
+
+        from ...arithconfig import ArithConfig
+        from ...buffer import EmuBuffer
+
+        seed = wirecodec.options_rank_seed(options)
+        # operand WIDTH follows the op: the P-wide ops' op0 spans
+        # size*count elements (staging only `count` would hand the C
+        # engine a truncated buffer it reads past)
+        in_w = options.count
+        if options.comm is not None and options.op in (
+            Operation.REDUCE_SCATTER, Operation.ALLTOALL,
+            Operation.SCATTER,
+        ):
+            in_w *= options.comm.size
+        x = np.asarray(options.op0.device_view()[:in_w])
+        rounded = wirecodec.roundtrip(
+            x, cfg.compressed, seed
+        ).astype(x.dtype)
+        staged = EmuBuffer.from_array(np.ascontiguousarray(rounded))
+        staged.sync_to_device()
+        return dataclasses.replace(
+            options,
+            op0=staged,
+            arithcfg=ArithConfig(
+                cfg.uncompressed, cfg.uncompressed, cfg.reduce_functions
+            ),
+            compression=options.compression
+            & ~CompressionFlags.ETH_COMPRESSED,
+        )
 
     def shutdown(self) -> None:
         if not self._shut:

@@ -837,6 +837,10 @@ class GangCommandRing:
         words[_F["count"]] = plan["n"]
         words[_F["function"]] = int(lead.reduce_function)
         words[_F["wire"]] = wire
+        # quantized wire plane: the call's SR seed rides the flags word
+        # as slot DATA (rank-mixed inside the decode loop) — seed churn
+        # on a warm compressed stream never recompiles the sequencer
+        words[_F["flags"]] = int(getattr(lead, "wire_seed", 0)) & 0x7FFFFFFF
         if "p2p" in plan:
             words[_F["root"]] = plan["p2p"][0]
             words[_F["peer"]] = plan["p2p"][1]

@@ -315,6 +315,16 @@ def apply_tuning(tuning: dict, options) -> ErrorCode:
         if int(val) < 1:
             return ErrorCode.CONFIG_ERROR
         tuning["ring_segments"] = int(val)
+    elif key == TuningKey.WIRE_DTYPE:
+        # quantized wire plane: the per-bucket compression verdict must
+        # name a REGISTERED wire lane (or 0 = off) — a typo'd DataType
+        # must fail the config write, not surface as an arith-lookup
+        # error N calls later
+        from ...wire import is_wire_dtype
+
+        if int(val) != 0 and not is_wire_dtype(int(val)):
+            return ErrorCode.CONFIG_ERROR
+        tuning["wire_dtype"] = int(val)
     else:
         if key == TuningKey.GATHER_FLAT_TREE_MAX_FANIN and val < 1:
             return ErrorCode.CONFIG_ERROR
@@ -1647,7 +1657,21 @@ class XLAGangContext:
         def wire_cast(arr: np.ndarray) -> np.ndarray:
             if wire_npdt is None:
                 return arr
-            return arr.astype(wire_npdt).astype(arr.dtype)
+            # the shared host codec, per contribution row with each
+            # rank's mixed seed (rows ARE the per-rank contributions
+            # on this host-staged path, so the rounding matches what
+            # the fabric tiers — and the facade's EF residual
+            # accounting — compute for the same call)
+            from ... import wire as wirecodec
+
+            base_seed = getattr(lead, "wire_seed", 0)
+            return np.stack([
+                wirecodec.roundtrip(
+                    row, lead.arithcfg.compressed,
+                    wirecodec.rank_seed(base_seed, r),
+                ).astype(arr.dtype)
+                for r, row in enumerate(arr)
+            ])
 
         ic = self.interactions
         if op == Operation.ALLREDUCE:

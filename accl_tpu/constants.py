@@ -121,6 +121,14 @@ class TuningKey(enum.IntEnum):
     # chunk k overlaps device execution of chunk k-1).  0 disables the
     # host-level split (the conservative default; the autotuner races it)
     PIPELINE_THRESHOLD = 11
+    # quantized wire plane: the per-bucket compression verdict — the
+    # DataType value (from WIRE_LANE_DTYPES) a call's payload rides the
+    # wire in when the caller requested no explicit compress_dtype.
+    # 0 (DataType.NONE) = off, the conservative default; typically set
+    # per size bucket by an autotuned TuningPlan overlay (the reference
+    # hard-wires its hp_compression lane per ArithConfig — this makes
+    # the lane a measured, per-bucket register like any algorithm)
+    WIRE_DTYPE = 12
 
 
 class AllreduceAlgorithm(enum.IntEnum):
@@ -147,6 +155,7 @@ TUNING_KEY_NAMES = {
     TuningKey.SCATTER_ALGORITHM: "scatter_algorithm",
     TuningKey.GATHER_ALGORITHM: "gather_algorithm",
     TuningKey.PIPELINE_THRESHOLD: "pipeline_threshold",
+    TuningKey.WIRE_DTYPE: "wire_dtype",
 }
 
 #: lowerings valid for the ROOTED algorithm registers (no ppermute-ring /
@@ -190,6 +199,44 @@ class DataType(enum.IntEnum):
     # generation this targets computes and transports fp8 natively
     FLOAT8_E4M3 = 8
     FLOAT8_E5M2 = 9
+
+
+#: Registered WIRE LANES: DataType member name -> numpy dtype name, the
+#: ONE vocabulary of reduced-precision wire formats the whole stack
+#: speaks (facade verdicts, the shared host codec in accl_tpu.wire, the
+#: slot ``wire`` field of the command ring, and BOTH sequencer decode
+#: lowerings).  A LITERAL dict on purpose: the acclint
+#: ``cmdring-slot-layout`` cross-check parses it from the AST and fails
+#: the tree when a registered lane is not handled by both decode-loop
+#: lowerings — growing this table without wiring a lane is a finding,
+#: not a workload fallback.
+WIRE_LANE_DTYPES = {
+    "FLOAT16": "float16",
+    "BFLOAT16": "bfloat16",
+    "FLOAT8_E4M3": "float8_e4m3fn",
+    "FLOAT8_E5M2": "float8_e5m2",
+    "INT8": "int8",
+}
+
+#: wire lanes that ride a per-segment absmax scale sidecar (blockwise
+#: quantization) instead of a plain dtype cast
+SCALED_WIRE_DTYPES = ("INT8",)
+
+#: elements per int8 scale block — one fp32 scale (absmax/127) per
+#: WIRE_SEGMENT_ELEMS elements of payload.  256 keeps the scale sidecar
+#: at ~1.6% of the int8 payload while bounding the absmax blast radius
+#: of one outlier to 1 KiB of fp32 source data.
+WIRE_SEGMENT_ELEMS = 256
+
+#: wire lanes rounded STOCHASTICALLY by default (fp8/int8: at 2-3
+#: mantissa bits / 8 quantization levels per scale block, deterministic
+#: round-to-nearest biases repeated compressed reductions hard enough
+#: to stall convergence — the error-feedback plane assumes unbiased
+#: rounding).  f16/bf16 keep deterministic round-to-nearest-even, the
+#: reference hp_compression behavior.
+STOCHASTIC_WIRE_DTYPES = (
+    "FLOAT8_E4M3", "FLOAT8_E5M2", "INT8",
+)
 
 
 #: itemsize per DataType, table-driven so ``dtype_size`` needs no numpy
@@ -426,6 +473,11 @@ TUNING_DEFAULTS = {
     # conservative default; RING_SEGMENTS > 1 + a positive threshold arm
     # it, typically via an autotuned TuningPlan)
     "pipeline_threshold": 0,
+    # quantized wire plane: 0 = no automatic wire compression (explicit
+    # compress_dtype= keeps working); a DataType value from
+    # WIRE_LANE_DTYPES makes eligible calls ride that lane — typically
+    # set per size bucket by an autotuned TuningPlan overlay
+    "wire_dtype": 0,
 }
 
 # Overlap plane (async in-flight window) defaults: how many collectives
@@ -498,7 +550,8 @@ CMDRING_FIELDS = {
     "dtype": 3,     # DataType of the operand
     "function": 4,  # ReduceFunction (ALLREDUCE/REDUCE_SCATTER slots)
     "root": 5,      # comm-relative root rank (BCAST; src for SEND/RECV)
-    "flags": 6,     # reserved (future lanes)
+    "flags": 6,     # stochastic-rounding seed of the wire lane (0 =
+                    # deterministic; rank-mixed on device — wire.rank_seed)
     "nseg": 7,      # ring segmentation register snapshot
     "peer": 8,      # comm-relative destination rank (SEND/RECV slots)
     "wire": 9,      # DataType of the compressed wire lane (0 = none)

@@ -42,6 +42,8 @@ from .constants import (
 )
 from .contract import ContractVerifier, board_for, env_enabled as _verify_env
 from .contract import verdict_context
+from .errorfeedback import ResidualStore
+from . import wire as _wire
 from .faults import HealthTransitions
 from . import arbiter as _arb
 from . import membership as _mbr
@@ -117,6 +119,23 @@ class ACCL:
         # matching signature on the fabric tiers (the cross-segment
         # steal race test_segmented_pipelining_emulator caught)
         self._pipeline_ctr: dict = {}
+        # quantized wire plane (accl_tpu.wire / accl_tpu.errorfeedback):
+        # per-comm stochastic-rounding call counters (SPMD-uniform —
+        # every rank issues the same compressed-collective sequence, so
+        # derived seeds match with zero wire bytes; cleared by
+        # soft_reset with the rest of the sequence space) and the
+        # error-feedback residual store, living BESIDE the plan cache
+        # with the plan cache's lifecycle (invalidation hook below).
+        # Error feedback arms via ACCL_ERROR_FEEDBACK=1 /
+        # set_error_feedback() — opt-in: the pre-dispatch residual
+        # accounting reads the operand on the host, which the warm
+        # 1-interaction gang path must not pay by default.
+        self._wire_ctr: dict = {}
+        self._residuals = ResidualStore()
+        self._plans.add_invalidation_hook(self._residuals.invalidate)
+        self._error_feedback = (
+            os.environ.get("ACCL_ERROR_FEEDBACK", "0") == "1"
+        )
         # monitor plane (accl_tpu.monitor): continuous observability —
         # straggler tracker + anomaly watchdog riding the telemetry
         # completion observer, plus the opt-in scrape service
@@ -396,6 +415,11 @@ class ACCL:
         # state, exactly like the tuning registers).
         self._arbiter_seq.clear()
         self._arbiter.reset_ledger()
+        # quantized wire plane: SR-seed counters restart with the rest
+        # of the sequence space (collective by contract, so derived
+        # seeds stay aligned across ranks); the residual store already
+        # cleared via the plan-cache invalidation hook on RESET
+        self._wire_ctr.clear()
         for comm in self._communicators:
             comm.reset_sequences()
         self._config(ConfigFunction.ENABLE_TRANSPORT, 1)
@@ -1146,13 +1170,18 @@ class ACCL:
         else:
             key = TuningKey(key)
         if isinstance(value, str):
-            try:
-                value = AllreduceAlgorithm[value.upper()]
-            except KeyError:
-                raise ValueError(
-                    f"unknown algorithm {value!r}; valid: "
-                    f"{[a.name.lower() for a in AllreduceAlgorithm]}"
-                ) from None
+            if key == TuningKey.WIRE_DTYPE:
+                from .tuning import wire_dtype_value
+
+                value = wire_dtype_value(value)
+            else:
+                try:
+                    value = AllreduceAlgorithm[value.upper()]
+                except KeyError:
+                    raise ValueError(
+                        f"unknown algorithm {value!r}; valid: "
+                        f"{[a.name.lower() for a in AllreduceAlgorithm]}"
+                    ) from None
         self._config(ConfigFunction.SET_TUNING, float(value), key=int(key))
 
     def load_tuning_plan(self, plan, strict: bool = True,
@@ -1241,6 +1270,12 @@ class ACCL:
     #: operand kinds are not, and a half-split collective deadlocks.
     _PIPELINE_OPS = frozenset((Operation.ALLREDUCE, Operation.BCAST))
 
+    #: collectives the per-bucket WIRE_DTYPE verdict may compress
+    #: automatically: the reduction whose wire bytes dominate training
+    #: steps (and the one the error-feedback plane covers).  Explicit
+    #: ``compress_dtype=`` keeps working on every op that accepts it.
+    _WIRE_VERDICT_OPS = frozenset((Operation.ALLREDUCE,))
+
     def _plan_for(
         self,
         op: Operation,
@@ -1265,13 +1300,52 @@ class ACCL:
         self._call_tls.plan_hit = hit  # stamped onto this call's record
         if plan is not None:
             return plan
-        cfg, flags = self._resolve_arithcfg(dtype, cdt)
-        wire = cfg.compressed if flags & CompressionFlags.ETH_COMPRESSED else None
         overlay = None
         if self._tuning_plan is not None:
             overlay = self._tuning_plan.registers_for(
                 op.name.lower(), bucket
             ) or None
+        # quantized wire plane: when the caller requested no explicit
+        # compress_dtype, the per-bucket WIRE_DTYPE register (TuningPlan
+        # overlay over the engine's global table) decides the wire lane
+        # — off / f16 / bf16 / fp8 / int8 as a measured verdict, raced
+        # by the autotuner like any algorithm register.  SPMD-uniform:
+        # registers and overlays are identical across ranks, and the
+        # verdict is baked into the cached plan (register writes and
+        # plan loads invalidate the pool).  Scoped to the wire-verdict
+        # op set; an operand dtype with no registered arith pair for
+        # the verdict dtype keeps the uncompressed wire.
+        if cdt is None and op in self._WIRE_VERDICT_OPS:
+            wd = (overlay or {}).get("wire_dtype")
+            if wd is None:
+                wd = self._engine_tuning().get("wire_dtype", 0)
+            try:
+                verdict = DataType(int(wd or 0))
+            except ValueError:
+                verdict = DataType.NONE
+            # the verdict ops carry the reduce function as extra[0]:
+            # a lane whose arith pair cannot run this call's function
+            # (the SUM-only int8 pair under a MAX allreduce) keeps the
+            # uncompressed wire instead of breaking a call that worked
+            # before the register was armed
+            fn_ok = True
+            if extra and (dtype, verdict) in self._arith:
+                try:
+                    fn_ok = self._arith[(dtype, verdict)].supports(
+                        ReduceFunction(int(extra[0]))
+                    )
+                except (ValueError, TypeError):
+                    fn_ok = True
+            if (
+                verdict != DataType.NONE
+                and verdict != dtype
+                and _wire.is_wire_dtype(verdict)
+                and (dtype, verdict) in self._arith
+                and fn_ok
+            ):
+                cdt = verdict
+        cfg, flags = self._resolve_arithcfg(dtype, cdt)
+        wire = cfg.compressed if flags & CompressionFlags.ETH_COMPRESSED else None
         eager_limit = (overlay or {}).get(
             "max_eager_size", self._max_eager_size
         )
@@ -1708,6 +1782,93 @@ class ACCL:
         on fabric-less engines — see _launch_pipelined)."""
         return getattr(self._call_tls, "pipeline_tag", 0) or 0
 
+    def _derive_wire_seed(self, plan, comm: Communicator,
+                          op: Operation) -> int:
+        """Per-call stochastic-rounding seed for a compressed collective
+        (0 = deterministic rounding — the f16/bf16 lanes, and every
+        uncompressed call).  Derived from SPMD-uniform facts only (comm
+        id + epoch + a per-comm counter every rank advances for the
+        same calls — the contract-sequence discipline), so all ranks
+        hold the same seed with zero wire bytes; each rank then mixes
+        its own rank in at the point of encoding (wire.rank_seed), so
+        streams stay independent across ranks.  Scoped to the contract
+        collectives: p2p pairs keep deterministic lanes (one-shot
+        transfers have no bias accumulation to fight, and a directed-
+        channel counter is not worth the machinery)."""
+        wire = plan.wire_dtype
+        if (
+            wire is None
+            or op not in self._CONTRACT_OPS
+            or not _wire.is_stochastic(wire)
+        ):
+            return 0
+        ctr = self._wire_ctr.get(comm.id, 0)
+        self._wire_ctr[comm.id] = ctr + 1
+        return _wire.call_seed(comm.id, comm.epoch, ctr, int(wire))
+
+    def set_error_feedback(self, enabled: bool = True) -> None:
+        """Arm (or disarm) error-feedback accounting for compressed
+        allreduce on this handle: contributions carry the previous
+        call's compression residual (``compress(grad + residual)``,
+        ``residual = grad_eff - decompress(wire)``) so quantized-wire
+        gradient sums converge to the uncompressed series (EF-SGD).
+        Collective by contract — every rank of the group arms it at the
+        same point (the residual add changes what crosses the wire).
+        Residuals live beside the plan cache and clear with it
+        (register writes, soft_reset, epoch churn); also armable via
+        ``ACCL_ERROR_FEEDBACK=1`` at handle construction.  Opt-in: the
+        accounting reads the operand on the host pre-dispatch — a
+        per-call cost the default zero-copy warm path must not pay."""
+        was = self._error_feedback
+        self._error_feedback = bool(enabled)
+        if was and not enabled:
+            self._residuals.invalidate("error_feedback_off")
+
+    def _error_feedback_operand(
+        self, plan, comm: Communicator, sendbuf: BaseBuffer, n: int,
+        function: ReduceFunction, seed: int,
+    ):
+        """The EF pre-dispatch step for one allreduce contribution:
+        returns a staging buffer holding ``grad + residual`` (what the
+        engine should compress and dispatch), or None when error
+        feedback does not apply to this call.  The gate reads only
+        SPMD-uniform facts (armed flag, plan wire verdict, reduce
+        function) — never buffer identity or rank."""
+        wire = plan.wire_dtype
+        if (
+            not self._error_feedback
+            or wire is None
+            or function != ReduceFunction.SUM
+        ):
+            return None
+        # Residual identity: (comm, epoch, op, exact count, segment
+        # position).  Count — not the pow2 bucket — keys the stream:
+        # two same-bucket tensors must never blend residuals (each
+        # would inject the OTHER's quantization error and break the EF
+        # telescoping sum).  Pipelined segments add their POSITION
+        # index (a TLS fact set on every tier — the reserved tag is
+        # fabric-only and its call-counter half varies per call, which
+        # would orphan residuals every step).  Remaining assumption,
+        # documented: one logical gradient stream per (comm, count) —
+        # the flat fused-gradient-buffer practice; two distinct
+        # equal-count tensors alternating on one comm would still
+        # alias.
+        seg = getattr(self._call_tls, "pipeline_seg_index", 0)
+        key = (comm.id, comm.epoch, Operation.ALLREDUCE, n, seg)
+        x = np.asarray(sendbuf.device_view()[:n])
+        x_eff = self._residuals.apply(
+            key, x.astype(np.float32, copy=False), wire,
+            _wire.rank_seed(seed, comm.local_rank),
+        )
+        tel = self._telemetry
+        if tel is not None:
+            tel.metrics.inc(
+                "accl_compression_ef_updates_total", (wire.name,)
+            )
+        return self.engine.create_buffer(
+            n, sendbuf.dtype, data=x_eff.astype(x.dtype, copy=False)
+        )
+
     def _pipeline_segments_for(self, plan, count: int, dtype) -> int:
         """Sub-launch count for this call, from the plan's cached
         pipelining verdict; 1 when the split does not apply (below
@@ -1812,10 +1973,16 @@ class ACCL:
                 self._call_tls.pipeline_tag = (
                     seg_tags[i] if seg_tags is not None else 0
                 )
+                # segment POSITION, tier-uniform (device tiers keep tag
+                # 0, but the error-feedback residual key still needs
+                # per-segment identity — equal-count segments must
+                # never blend residual streams)
+                self._call_tls.pipeline_seg_index = i
                 inner.append(launch_seg(s0, s1))
         finally:
             self._call_tls.pipelining = False
             self._call_tls.pipeline_tag = 0
+            self._call_tls.pipeline_seg_index = 0
             self._call_tls.parent_trace = None
 
         def _resolve(inner=inner):
@@ -1936,6 +2103,28 @@ class ACCL:
         tracked = False
         try:
             self._contract_gate(options, context)
+            # quantized wire plane: per-wire-dtype accounting at intake
+            # (casts + bytes the narrow lane keeps off the wire for
+            # this rank's contribution — the effective-bandwidth
+            # evidence's live counterpart)
+            if (
+                tel is not None
+                and options.arithcfg is not None
+                and options.compression & CompressionFlags.ETH_COMPRESSED
+            ):
+                wname = options.arithcfg.compressed.name
+                payload_b = options.count * dtype_size(
+                    options.arithcfg.uncompressed
+                )
+                tel.metrics.inc(
+                    "accl_compression_casts_total", (wname,)
+                )
+                tel.metrics.inc(
+                    "accl_compression_wire_bytes_saved_total", (wname,),
+                    max(0, payload_b - _wire.wire_nbytes(
+                        options.count, options.arithcfg.compressed
+                    )),
+                )
             # trace/span id assigned at INTAKE — before dispatch — so
             # the fabric's outbound trace stamp covers this call's own
             # wire traffic, not just its successors'
@@ -2167,6 +2356,28 @@ class ACCL:
         )
         return self._launch(opts, run_async, "combine")
 
+    @staticmethod
+    def _check_p2p_wire(cfg: ArithConfig, flags, opname: str) -> None:
+        """Scaled wire lanes (int8) are reduction lanes: the per-segment
+        absmax frame exists so quantized gradient SUMS stay accurate,
+        and the p2p channels speak plain cast lanes (fp8/f16/bf16 work
+        there today).  Requesting an int8 wire on p2p fails loudly at
+        intake instead of silently transporting garbage casts."""
+        if flags & CompressionFlags.ETH_COMPRESSED and _wire.is_scaled(
+            cfg.compressed
+        ):
+            raise ACCLError(
+                ErrorCode.COMPRESSION_ERROR,
+                f"{opname}: scaled wire lane {cfg.compressed.name} is "
+                "collective-only",
+                details={
+                    "op": opname,
+                    "wire": cfg.compressed.name,
+                    "hint": "use a cast lane (float16/bfloat16/fp8) "
+                            "for p2p, scaled int8 for allreduce",
+                },
+            )
+
     # -- point-to-point ------------------------------------------------------
     def send(
         self,
@@ -2185,6 +2396,7 @@ class ACCL:
         dtype = srcbuf.dtype if srcbuf is not None else DataType.FLOAT32
         n = self._count_of(srcbuf, count) if srcbuf is not None else int(count)
         cfg, flags = self._resolve_arithcfg(dtype, compress_dtype)
+        self._check_p2p_wire(cfg, flags, "send")
         stream = StreamFlags.OP0_STREAM if from_stream else StreamFlags.NO_STREAM
         opts = CallOptions(
             op=Operation.SEND,
@@ -2218,6 +2430,7 @@ class ACCL:
         dtype = dstbuf.dtype if dstbuf is not None else DataType.FLOAT32
         n = self._count_of(dstbuf, count) if dstbuf is not None else int(count)
         cfg, flags = self._resolve_arithcfg(dtype, compress_dtype)
+        self._check_p2p_wire(cfg, flags, "recv")
         stream = StreamFlags.RES_STREAM if to_stream else StreamFlags.NO_STREAM
         opts = CallOptions(
             op=Operation.RECV,
@@ -2476,6 +2689,7 @@ class ACCL:
             res=recvbuf if recvbuf is not None else DummyBuffer(0, op_dtype),
             plan=plan,
             tuning=plan.tuning,
+            wire_seed=self._derive_wire_seed(plan, comm, Operation.REDUCE),
         )
         return self._launch(opts, run_async, "reduce")
 
@@ -2507,6 +2721,10 @@ class ACCL:
                 ),
                 "allreduce",
             )
+        seed = self._derive_wire_seed(plan, comm, Operation.ALLREDUCE)
+        staged = self._error_feedback_operand(
+            plan, comm, sendbuf, n, function, seed
+        )
         opts = CallOptions(
             op=Operation.ALLREDUCE,
             comm=comm,
@@ -2516,10 +2734,11 @@ class ACCL:
             arithcfg=plan.arithcfg,
             compression=plan.compression,
             host=host,
-            op0=sendbuf,
+            op0=staged if staged is not None else sendbuf,
             res=recvbuf,
             plan=plan,
             tuning=plan.tuning,
+            wire_seed=seed,
         )
         return self._launch(opts, run_async, "allreduce")
 
@@ -2552,6 +2771,9 @@ class ACCL:
             res=recvbuf,
             plan=plan,
             tuning=plan.tuning,
+            wire_seed=self._derive_wire_seed(
+                plan, comm, Operation.REDUCE_SCATTER
+            ),
         )
         return self._launch(opts, run_async, "reduce_scatter")
 
@@ -2732,6 +2954,17 @@ class ACCL:
             # the live latency histograms with their p99 tails (the
             # one-line answer to "who is hogging the fabric?")
             "tenants": self._arbiter.snapshot(),
+            # quantized wire plane: SR call accounting + error-feedback
+            # residual health (the one-line answer to "is the wire
+            # verdict safe for this workload?" — a bounded residual
+            # norm is the convergence signal)
+            "compression": {
+                "sr_calls": sum(self._wire_ctr.values()),
+                "error_feedback": dict(
+                    self._residuals.stats(),
+                    enabled=self._error_feedback,
+                ),
+            },
             "stragglers": (
                 mon.straggler_snapshot() if mon is not None
                 else {"enabled": False}

@@ -259,11 +259,29 @@ def compressed_allreduce(
     compression' (hp_compression kernels + ETH_COMPRESSED): reduce-scatter
     in wire dtype, accumulate locally in the original dtype, allgather the
     narrow result.  Counts that don't divide the axis size are padded
-    (statically) around the scatter/gather pair."""
+    (statically) around the scatter/gather pair.
+
+    Sub-byte-precision lanes (fp8) and the scaled int8 lane round each
+    CONTRIBUTION through the wire once and then reduce at the original
+    dtype — accumulating AT 2-3 mantissa bits (or across differently
+    scaled int8 blocks) is numerically meaningless, and single-rounding
+    is exactly the command-ring decode loop's semantic, so warm (ring)
+    and cold (this program) compressed calls agree."""
     orig = x.dtype
     n = x.shape[0]
     size = lax.axis_size(axis_name)
     pad = (-n) % size
+    from ..constants import numpy_to_dtype
+    from ..wire import dropped_mantissa_bits, is_scaled
+
+    _dt = numpy_to_dtype(jnp.dtype(wire_dtype))
+    if is_scaled(_dt) or (dropped_mantissa_bits(_dt) or 0) >= 20:
+        from . import wire as devwire
+
+        rounded = devwire.wire_lane_roundtrip(x, jnp.dtype(wire_dtype))
+        if function == ReduceFunction.SUM:
+            return lax.psum(rounded, axis_name)
+        return _REDUCERS[function](rounded, axis_name)
     narrow = x.astype(wire_dtype)
     if pad:
         narrow = jnp.concatenate(

@@ -122,7 +122,13 @@ def _with_prep(sfn, prep):
         if take_w is not None and take_w != x.shape[0]:
             x = x[:take_w]
         if wire_name is not None:
-            x = x.astype(jnp.dtype(wire_name)).astype(x.dtype)
+            # the shared wire lane helper: covers the scaled int8 lane
+            # (blockwise quantize round-trip) beside the plain cast
+            # lanes; deterministic here — the prep spec is part of the
+            # program-cache key and carries no per-call seed
+            from . import wire as devwire
+
+            x = devwire.wire_lane_roundtrip(x, jnp.dtype(wire_name))
         return sfn(x)
 
     return fused

@@ -97,6 +97,8 @@ from ._common import (
 )
 from .put import remote_block_put
 from .ring import _neighbors, _ring_barrier, relay_allgather_hops
+from ... import wire as wirecodec
+from .. import wire as devwire
 
 __all__ = [
     "decode_slot",
@@ -233,15 +235,48 @@ def status_words(slots):
 
 def _decode_slot_xla(slots, i, own, me, size, shape: WindowShape):
     """One slot of the flat (element-granular) XLA decode loop: the
-    wire-cast rounding lane, ONE ``lax.all_gather`` wire move, and the
-    shared epilogue."""
+    wire-cast rounding lane, the ``lax.all_gather`` wire move (int8
+    lanes additionally gather their per-segment scale sidecar — the
+    honest wire-byte accounting), and the shared epilogue.  The SR seed
+    rides the slot's ``flags`` word as DATA (rank-mixed on device), so
+    seed churn never recompiles a warm window."""
     wire = shape.wires[i]
     x = own
     if wire is not None:
         # the compressed lane lowered into the decode loop: every
         # contribution rounds through the wire dtype exactly like the
-        # compressed_allreduce program (single rounding, on device)
-        x = x.astype(jnp.dtype(wire))
+        # compressed_allreduce program (single rounding, on device).
+        # ONE lane helper covers every registered wire dtype on both
+        # lowerings (acclint cross-checks this module for it).
+        from ...constants import numpy_to_dtype
+
+        seed = devwire.rank_seed(
+            slots[i, _F["flags"]].astype(jnp.uint32), me
+        )
+        if wirecodec.is_scaled(numpy_to_dtype(np.dtype(wire))):
+            # scaled lane: the wire moves int8 values + fp32 scales;
+            # contributions dequantize per source rank before the fold
+            q, scales = devwire.quantize_int8(x, seed)
+            gq = lax.all_gather(q, _axis_name())
+            gs = lax.all_gather(scales, _axis_name())
+            in_w = shape.in_ws[i]
+            blocks = [
+                devwire.dequantize_int8(
+                    gq[r], gs[r], in_w, out_dtype=own.dtype
+                )
+                for r in range(size)
+            ]
+            chunk = in_w // size if size and in_w % size == 0 else None
+            return slot_epilogue(
+                blocks, own, me,
+                slots[i, _F["opcode"]],
+                slots[i, _F["function"]],
+                slots[i, _F["root"]],
+                slots[i, _F["peer"]],
+                shape.out_ws[i],
+                chunk=chunk,
+            )
+        x = devwire._cast_lane(x, jnp.dtype(wire), seed)
     g = lax.all_gather(x, _axis_name())
     blocks = [g[r].astype(own.dtype) for r in range(size)]
     in_w = shape.in_ws[i]
@@ -255,6 +290,8 @@ def _decode_slot_xla(slots, i, own, me, size, shape: WindowShape):
         shape.out_ws[i],
         chunk=chunk,
     )
+
+
 
 
 def _axis_name():
@@ -512,15 +549,17 @@ def _unpack_rows(y, w: int, chunks: int):
 
 
 def _pallas_windows(slots, xs, axis_name, size, nwin, depth,
-                    shape: WindowShape,
+                    shape: WindowShape, me=None,
                     interpret: InterpretArg = None):
     """Trace a backlog of ``nwin`` windows through one ``pallas_call``.
     Per-slot operands are packed to one uniform tile-aligned height
     inside the traced body (zero extra dispatch — this all runs in the
     SAME program); f16 windows ride a f32 compute view around the
     kernel (Mosaic has no f16) and per-slot wire casts run as rounding
-    lanes before packing — both 'inside the decode loop' at the program
-    level, with no extra host interaction."""
+    lanes before packing (the SAME shared lane helper the xla lowering
+    decodes with — fp8/int8 included, seeds from the slot ``flags``
+    words) — both 'inside the decode loop' at the program level, with
+    no extra host interaction."""
     npdt = shape.npdt
     f16_view = np.dtype(npdt) == np.float16
     compute = jnp.float32 if f16_view else npdt
@@ -558,10 +597,20 @@ def _pallas_windows(slots, xs, axis_name, size, nwin, depth,
             x = xs[w_idx][i].astype(compute)
             wire = shape.wires[i]
             if wire is not None and np.dtype(wire) != np.dtype(npdt):
-                # wire rounding lane inside the decode loop; Mosaic
-                # dtypes only — the engine routes f16 wires to the xla
-                # lowering
-                x = x.astype(jnp.dtype(wire)).astype(compute)
+                # wire rounding lane inside the decode loop (the shared
+                # per-lane helper: cast lanes + the scaled int8 lane,
+                # SR seed from the slot flags word); Mosaic dtypes only
+                # INSIDE the kernel — the rounding happens in jnp
+                # before packing, so fp8/int8 lanes ride fine while the
+                # engine routes f16 wires to the xla lowering
+                k = w_idx * depth + i
+                seed = devwire.rank_seed(
+                    slots[k, _F["flags"]].astype(jnp.uint32),
+                    me if me is not None else jnp.uint32(0),
+                )
+                x = devwire.wire_lane_roundtrip(
+                    x, jnp.dtype(wire), seed
+                )
             packed.append(_pack_rows(x, rows, slot_chunks[i], compute))
     xp = jnp.concatenate(packed, axis=0)
     total_out = sum(out_rows) * nwin
@@ -662,7 +711,7 @@ def _windows_program(mesh_id: int, shape_key: tuple, nwin: int,
         ]
         if lowering == "pallas":
             outs = _pallas_windows(
-                slots, xs, AXIS, size, nwin, depth, shape
+                slots, xs, AXIS, size, nwin, depth, shape, me=me
             )
         else:
             outs = [

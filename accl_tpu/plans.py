@@ -139,6 +139,17 @@ class PlanCache:
         self.misses = 0
         self.invalidations = 0
         self.last_invalidation: Optional[str] = None
+        # companion-state invalidation hooks: state that lives BESIDE
+        # the plan cache with the plan cache's lifecycle (the error-
+        # feedback residual store) registers here so every invalidation
+        # site clears it too — one lifecycle, not N call sites
+        self._hooks: list = []
+
+    def add_invalidation_hook(self, fn) -> None:
+        """Call ``fn(reason)`` on every :meth:`invalidate` — for state
+        whose validity is coupled to the cached plans (e.g. compression
+        residuals accumulated under a plan's wire verdict)."""
+        self._hooks.append(fn)
 
     # -- lookup / store ------------------------------------------------------
     def get(self, key: Tuple) -> Optional[CollectivePlan]:
@@ -173,6 +184,12 @@ class PlanCache:
             self._plans.clear()
             self.invalidations += 1
             self.last_invalidation = reason or None
+            hooks = list(self._hooks)
+        for fn in hooks:  # outside the lock: hooks take their own
+            try:
+                fn(reason)
+            except Exception:  # pragma: no cover - must not fail config
+                pass
 
     # -- introspection -------------------------------------------------------
     def __len__(self) -> int:

@@ -88,8 +88,11 @@ ERROR_TAIL = 32
 #: 4 = the causal trace plane (postmortem section, trace ids in
 #: flight records, cmdring window timelines under engine.cmdring);
 #: 5 = the QoS arbiter plane (tenants section: per-tenant admission
-#: counters, quotas, and live latency histograms with p99 tails).
-SCHEMA_VERSION = 5
+#: counters, quotas, and live latency histograms with p99 tails);
+#: 6 = the quantized wire plane (compression section: per-wire-dtype
+#: cast/bytes-saved counters, SR call count, error-feedback residual
+#: store stats incl. the residual-norm gauge).
+SCHEMA_VERSION = 6
 
 # One epoch<->monotonic anchor per process: records carry perf_counter_ns
 # timestamps (cheap, monotonic), trace export maps them onto the epoch
@@ -898,7 +901,11 @@ def to_prometheus(snapshot: dict) -> str:
             seen_types.add(name)
         lbl = dict(base)
         if labels:
-            lbl["op"] = labels[0]
+            # compression counters label by wire lane, not collective op
+            key0 = (
+                "wire" if name.startswith("accl_compression_") else "op"
+            )
+            lbl[key0] = labels[0]
         if len(labels) > 1:
             lbl["code"] = labels[1]
         lines.append(f"{name}{_prom_labels(**lbl)} {val}")
@@ -1012,6 +1019,24 @@ def to_prometheus(snapshot: dict) -> str:
             f"accl_cmdring_window_latency_us_count"
             f"{_prom_labels(**base)} {cum}"
         )
+
+    # quantized wire plane: error-feedback health (the residual-norm
+    # gauge is THE convergence signal — a norm growing without bound
+    # means the wire verdict is too aggressive for the workload)
+    comp = snapshot.get("compression") or {}
+    ef = comp.get("error_feedback") or {}
+    gauge(
+        "accl_compression_ef_enabled", int(bool(ef.get("enabled")))
+    )
+    gauge("accl_compression_ef_entries", ef.get("entries"))
+    # (ef updates are NOT re-exported here: the wire-labeled
+    # accl_compression_ef_updates_total counter from the facade's
+    # intake path already carries them — a second unlabeled sample
+    # would double every sum() over the metric)
+    gauge(
+        "accl_compression_residual_norm", ef.get("max_residual_norm")
+    )
+    gauge("accl_compression_sr_calls_total", comp.get("sr_calls"))
 
     # QoS arbiter plane: per-tenant admission counters/gauges and the
     # per-tenant completion-latency histogram — a REAL Prometheus

@@ -39,11 +39,13 @@ import numpy as np
 
 from .constants import (
     AllreduceAlgorithm,
+    DataType,
     EAGER_THRESHOLD_DEFAULT,
     MAX_EAGER_SIZE_LIMIT,
     ROOTED_ALGORITHMS,
     TUNING_DEFAULTS,
     TUNING_KEY_NAMES,
+    WIRE_LANE_DTYPES,
 )
 from .plans import size_bucket
 
@@ -72,6 +74,31 @@ VALID_REGISTERS = frozenset(TUNING_KEY_NAMES.values()) | {"max_eager_size"}
 _ALGO_REGISTERS = frozenset(
     n for n in TUNING_KEY_NAMES.values() if n.endswith("_algorithm")
 )
+
+
+def wire_dtype_value(val) -> int:
+    """Normalize a wire-dtype register value to its DataType int: 0 /
+    "off" disables; DataType member names ("INT8", "float8_e4m3") and
+    numpy lane names ("float8_e4m3fn", "int8") both resolve — plan
+    files should be writable by humans."""
+    if isinstance(val, str):
+        name = val.strip().lower()
+        if name in ("", "0", "off", "none"):
+            return 0
+        for member, np_name in WIRE_LANE_DTYPES.items():
+            if name in (member.lower(), np_name.lower()):
+                return int(DataType[member])
+        raise ValueError(
+            f"unknown wire dtype {val!r}; valid: off, "
+            f"{sorted(n.lower() for n in WIRE_LANE_DTYPES)}"
+        )
+    ival = int(val)
+    if ival != 0 and DataType(ival).name not in WIRE_LANE_DTYPES:
+        raise ValueError(
+            f"wire_dtype {ival} ({DataType(ival).name}) is not a "
+            f"registered wire lane ({sorted(WIRE_LANE_DTYPES)})"
+        )
+    return ival
 
 #: the full restoration state: every register the autotuner may touch,
 #: at its engine default
@@ -119,6 +146,11 @@ def validate_registers(regs: Dict[str, object]) -> Dict[str, object]:
                     f"{[a.name.lower() for a in ROOTED_ALGORITHMS]})"
                 )
             val = algo.name.lower()
+        elif name == "wire_dtype":
+            try:
+                val = wire_dtype_value(val)
+            except ValueError as e:
+                raise ValueError(f"register {name}: {e}") from None
         else:
             val = int(val)
             if val < 0:
@@ -334,6 +366,7 @@ def _candidates(
     eager_candidates: Sequence[int],
     segments: Sequence[int],
     pipeline_thresholds: Sequence[int] = (),
+    wire_dtypes: Sequence = (),
 ) -> List[Dict[str, object]]:
     """Tier-appropriate register sets to race for one collective.  The
     empty dict (the defaults) is always candidate 0 — a plan can only
@@ -385,6 +418,17 @@ def _candidates(
         elif op == "gather":
             fanins = sorted({1, 2, max(1, world - 1)})
             cands += [{"gather_flat_tree_max_fanin": f} for f in fanins]
+    if op == "allreduce":
+        # quantized wire plane: per-bucket compression verdicts raced
+        # like any register — off is always candidate 0 (the defaults),
+        # so a lane only wins where the byte saving beats its cast cost
+        # by the hysteresis margin (the wall-clock race; correctness is
+        # gated separately by check_compression's convergence leg)
+        cands += [
+            {"wire_dtype": wire_dtype_value(wd)}
+            for wd in wire_dtypes
+            if wire_dtype_value(wd) != 0
+        ]
     for e in eager_candidates:
         cands.append({"max_eager_size": int(e)})
     return cands
@@ -419,6 +463,7 @@ def autotune(
     eager_candidates: Sequence[int] = (),
     segments: Sequence[int] = (1, 2, 4),
     pipeline_thresholds: Sequence[int] = (),
+    wire_dtypes: Sequence = (),
     margin: float = 0.10,
     log=None,
 ) -> TuningPlan:
@@ -452,7 +497,7 @@ def autotune(
                 measured: List[tuple] = []
                 for regs in _candidates(
                     tier, op, world, include_pallas, eager_candidates,
-                    segments, pipeline_thresholds,
+                    segments, pipeline_thresholds, wire_dtypes,
                 ):
                     try:
                         # the register writes are part of the candidate:
@@ -506,6 +551,7 @@ def autotune(
         "eager_candidates": [int(e) for e in eager_candidates],
         "segments": [int(s) for s in segments],
         "pipeline_thresholds": [int(t) for t in pipeline_thresholds],
+        "wire_dtypes": [wire_dtype_value(w) for w in wire_dtypes],
         "margin": float(margin),
     }
     try:
@@ -565,6 +611,21 @@ def main(argv=None) -> int:
              "pipelining axes (e.g. 65536 262144)",
     )
     ap.add_argument(
+        "--wire-dtypes", nargs="*", default=[],
+        help="wire-compression verdicts to race for allreduce (per-"
+             "bucket WIRE_DTYPE register): names from the registered "
+             "lanes, e.g. float16 bfloat16 float8_e4m3 int8 — 'off' "
+             "(the defaults) is always candidate 0",
+    )
+    ap.add_argument(
+        "--wire-gbps", type=float, default=None,
+        help="emulator backend only: pace the in-process fabric at this "
+             "modeled link rate (Fabric.set_wire_rate) for the whole "
+             "race — the regime wire-compression verdicts exist for; "
+             "unpaced loopback is memcpy and every lane loses to its "
+             "own codec cost.  Recorded in the plan's provenance.",
+    )
+    ap.add_argument(
         "--margin", type=float, default=0.10,
         help="a non-default candidate must beat the defaults by this "
              "fraction to win its bucket (noise hysteresis)",
@@ -601,6 +662,11 @@ def main(argv=None) -> int:
         if args.backend == "emulator"
         else core.xla_group(args.world)
     )
+    if args.wire_gbps:
+        if args.backend != "emulator":
+            raise SystemExit("--wire-gbps models the emulated fabric "
+                             "(use --backend emulator)")
+        group[0].engine.fabric.set_wire_rate(args.wire_gbps)
     try:
         plan = autotune(
             group,
@@ -613,6 +679,7 @@ def main(argv=None) -> int:
             eager_candidates=args.eager,
             segments=args.segments,
             pipeline_thresholds=args.pipeline_thresholds,
+            wire_dtypes=args.wire_dtypes,
             margin=args.margin,
             log=lambda msg: print(msg, file=sys.stderr),
         )
@@ -620,6 +687,8 @@ def main(argv=None) -> int:
         for a in group:
             a.deinit()
     plan.provenance["backend"] = args.backend
+    if args.wire_gbps:
+        plan.provenance["wire_gbps_model"] = float(args.wire_gbps)
     text = plan.to_json()
     if args.out == "-":
         print(text)

@@ -739,6 +739,132 @@ def check_arbiter_capture(bench_path: str) -> None:
     check_arbiter((result or {}).get("extras") or {})
 
 
+# Quantized-wire gate (wire-compression PR): the capture must prove the
+# fp8/int8 lanes BUY bandwidth where they exist to (the paced large-
+# bucket sweep — the artifact records the modeled link rate, the CPU
+# mesh's honest way to have a wire at all), that the wire-byte sizing
+# matches the lanes' ratios (the sidecar accounted), and that the
+# error-feedback convergence delta is inside the documented bound.
+COMPRESSION_CONVERGENCE_BOUND_PCT = float(
+    os.environ.get("ACCL_COMPRESSION_CONVERGENCE_BOUND_PCT", "10.0")
+)
+
+
+class CompressionGateError(ValueError):
+    """The capture's quantized-wire evidence is missing/incomplete, a
+    reduced-precision lane failed to beat the f32 wire at the large
+    bucket on the paced sweep, the wire-byte accounting is off, or the
+    error-feedback convergence delta blew its bound."""
+
+
+#: lanes the sweep must carry, with the wire-byte ratio ceiling each
+#: must respect vs the payload (int8/f16 sidecar slack included)
+COMPRESSION_EVIDENCE_LANES = {
+    "off": 1.01,
+    "float16": 0.51,
+    "float8_e4m3": 0.26,
+    "int8": 0.26,
+}
+
+
+def check_compression(extras: dict, bound_pct: float = None) -> None:
+    """Gate a capture's quantized-wire evidence.  No-op when the
+    compression bench never ran (wedged captures carry no compression
+    keys); otherwise the sweep must cover every evidence lane at the
+    recorded payload with sane wire-byte sizing, the fp8/int8 lanes
+    must show a MEASURED effective-bandwidth gain over the f32 wire
+    (under the artifact's recorded link model — evidence without the
+    model rate is refused as unverifiable), and the convergence leg's
+    error-feedback delta must be within the documented bound."""
+    bound = (
+        COMPRESSION_CONVERGENCE_BOUND_PCT
+        if bound_pct is None else bound_pct
+    )
+    extras = extras or {}
+    sweep = extras.get("compression_sweep")
+    conv = extras.get("compression_convergence")
+    gains = {
+        "fp8": extras.get("compression_effective_gain_fp8"),
+        "int8": extras.get("compression_effective_gain_int8"),
+    }
+    if sweep is None and conv is None:
+        return  # compression bench never ran: nothing to gate
+    if sweep is None or conv is None or None in gains.values():
+        raise CompressionGateError(
+            "capture carries partial quantized-wire evidence (need "
+            "compression_sweep + compression_convergence + the "
+            "effective-gain keys together) — the wire lanes are "
+            "unverifiable"
+        )
+    if not extras.get("compression_wire_gbps_model"):
+        raise CompressionGateError(
+            "compression sweep carries no modeled link rate "
+            "(compression_wire_gbps_model): an unpaced in-process "
+            "sweep measures codec cost, not a wire; refusing the "
+            "capture"
+        )
+    payload = extras.get("compression_payload_bytes") or 0
+    if payload < 1 << 20:
+        raise CompressionGateError(
+            f"compression sweep payload {payload} B is below the "
+            "large-bucket floor (1 MiB): the gate exists for the "
+            "bandwidth regime"
+        )
+    missing = [l for l in COMPRESSION_EVIDENCE_LANES if l not in sweep]
+    if missing:
+        raise CompressionGateError(
+            f"compression sweep missing lanes {missing}: every "
+            "registered verdict must be measured"
+        )
+    for lane, ceil in COMPRESSION_EVIDENCE_LANES.items():
+        wb = sweep[lane].get("wire_bytes_per_contrib") or 0
+        if wb > ceil * payload:
+            raise CompressionGateError(
+                f"lane {lane}: {wb} wire bytes for a {payload} B "
+                f"payload exceeds the {ceil:.2f}x lane ceiling — the "
+                "wire-byte accounting (or the lane itself) is wrong"
+            )
+    for name, gain in gains.items():
+        if gain <= 0:
+            raise CompressionGateError(
+                f"{name} lane shows no effective-bandwidth gain over "
+                f"the f32 wire at the large bucket (gain {gain:+.1%} "
+                f"under the "
+                f"{extras.get('compression_wire_gbps_model')} Gb/s "
+                "link model) — the lane does not pay for itself; "
+                "refusing the capture"
+            )
+    delta = conv.get("delta_pct")
+    # one-sided: only EF converging WORSE than the f32 wire indicates
+    # a problem (a large negative delta just means the compressed run
+    # landed below a near-zero baseline — better, not broken)
+    if delta is None or not (
+        isinstance(delta, (int, float)) and delta <= bound
+    ):
+        raise CompressionGateError(
+            f"error-feedback convergence delta {delta}% vs the f32 "
+            f"wire exceeds the +{bound}% bound (wire "
+            f"{conv.get('wire')}, {conv.get('steps')} steps) — the "
+            "compressed gradients are not converging; refusing the "
+            "capture"
+        )
+
+
+def check_compression_capture(bench_path: str) -> None:
+    """CLI form (``--check-compression <capture>.json``): accepts both
+    the extras-wrapped bench shape and the committed standalone capture
+    (a ``compression`` section or flat keys)."""
+    import json
+
+    with open(bench_path) as f:
+        doc = json.load(f)
+    result = doc.get("parsed") or doc.get("result") or doc
+    extras = (result or {}).get("extras") or result.get(
+        "compression"
+    ) or result
+    check_compression(extras)
+
+
 # Autotuned-plan refusal: a TuningPlan only ever *overrides* registers
 # where a candidate measured faster than the defaults, so a tuned sweep
 # should never be meaningfully slower than the default sweep at any
@@ -977,6 +1103,16 @@ def main(argv=None) -> str:
             f"budget within {ARBITER_OVERHEAD_TOLERANCE_PCT:.1f}%, "
             "guaranteed p99 within bound, baseline violating, ring "
             "budget honored"
+        )
+        return ""
+    if "--check-compression" in argv:
+        i = argv.index("--check-compression")
+        check_compression_capture(argv[i + 1])
+        print(
+            f"{argv[i + 1]}: quantized-wire gate ok — fp8/int8 "
+            "effective-bandwidth gain at the large bucket, wire-byte "
+            "ratios sane, error-feedback convergence within "
+            f"{COMPRESSION_CONVERGENCE_BOUND_PCT:.1f}%"
         )
         return ""
     if "--check-tuned" in argv:

@@ -57,6 +57,7 @@ try:
         ARCH_REGRESSION_TOLERANCE,
         ArchOverheadRegressionError,
         CmdringGateError,
+        CompressionGateError,
         OVERLAP_REGRESSION_TOLERANCE,
         OverlapGateError,
         TelemetryGateError,
@@ -64,6 +65,7 @@ try:
         VerifyGateError,
         check_arch_overhead,
         check_cmdring,
+        check_compression,
         check_overlap,
         check_telemetry,
         check_tuned_not_slower,
@@ -74,6 +76,7 @@ except ImportError:  # pragma: no cover - running as a package module
         ARCH_REGRESSION_TOLERANCE,
         ArchOverheadRegressionError,
         CmdringGateError,
+        CompressionGateError,
         OVERLAP_REGRESSION_TOLERANCE,
         OverlapGateError,
         TelemetryGateError,
@@ -81,6 +84,7 @@ except ImportError:  # pragma: no cover - running as a package module
         VerifyGateError,
         check_arch_overhead,
         check_cmdring,
+        check_compression,
         check_overlap,
         check_telemetry,
         check_tuned_not_slower,

@@ -69,15 +69,38 @@ def main() -> int:
                 t.start()
             for t in threads:
                 t.join(60)
+        # quantized wire plane: one error-feedback int8 round so the
+        # compression counters + residual gauge carry live evidence
+        for a in g:
+            a.set_error_feedback(True)
+        threads = [
+            threading.Thread(
+                target=lambda a, r: a.allreduce(
+                    send[r], recv[r], 64, compress_dtype="int8"
+                ),
+                args=(a, r),
+            )
+            for r, a in enumerate(g)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
 
         port = g[0].start_monitor(0)
         metrics = get(port, "/metrics")
         assert "accl_calls_total" in metrics, "no accl_ metrics served"
+        # quantized wire plane: per-wire-dtype counters + EF gauges
+        assert 'accl_compression_casts_total{' in metrics
+        assert 'wire="INT8"' in metrics, "compression wire label missing"
+        assert "accl_compression_wire_bytes_saved_total" in metrics
+        assert "accl_compression_residual_norm" in metrics
+        assert "accl_compression_ef_updates_total" in metrics
         for line in metrics.splitlines():
             if line and not line.startswith("#"):
                 assert _PROM_LINE.match(line), f"malformed: {line!r}"
         snap = json.loads(get(port, "/snapshot"))
-        assert snap["schema_version"] == 5
+        assert snap["schema_version"] == 6
         assert snap["stragglers"]["enabled"] is True
         assert "postmortem" in snap
         trace = json.loads(get(port, "/trace"))

@@ -232,7 +232,8 @@ def test_jax_free_module_traverses_from_import_alias(tmp_path, monkeypatch):
     (pkg / "sub" / "__init__.py").write_text("import numpy\n")
     (pkg / "sub" / "leaf.py").write_text("x = 1\n")
     for m in ("constants", "telemetry", "faults", "plans", "contract",
-              "monitor", "membership", "arbiter"):
+              "monitor", "membership", "arbiter", "wire",
+              "errorfeedback"):
         (pkg / f"{m}.py").write_text("")
     import accl_tpu.analysis.graph as graph_mod
 
@@ -258,6 +259,8 @@ def test_jax_free_module_detects_violation(tmp_path, monkeypatch):
     (pkg / "monitor.py").write_text("")
     (pkg / "membership.py").write_text("")
     (pkg / "arbiter.py").write_text("")
+    (pkg / "wire.py").write_text("")
+    (pkg / "errorfeedback.py").write_text("")
     import accl_tpu.analysis.base as base_mod
 
     monkeypatch.setattr(base_mod, "package_root", lambda: str(pkg))
@@ -283,7 +286,8 @@ def test_jax_free_module_sees_with_block_imports(tmp_path, monkeypatch):
         "    import numpy\n"
     )
     for m in ("constants", "overlap", "telemetry", "faults", "contract",
-              "monitor", "membership", "arbiter"):
+              "monitor", "membership", "arbiter", "wire",
+              "errorfeedback"):
         (pkg / f"{m}.py").write_text("")
     import accl_tpu.analysis.base as base_mod
     import accl_tpu.analysis.graph as graph_mod
@@ -318,7 +322,8 @@ def test_jax_free_modules_import_without_heavy_stack():
         pkg.__path__ = [root]
         sys.modules['accl_tpu'] = pkg
         for m in ('constants', 'overlap', 'telemetry', 'faults', 'plans',
-                  'contract', 'monitor', 'membership', 'arbiter'):
+                  'contract', 'monitor', 'membership', 'arbiter',
+                  'wire', 'errorfeedback'):
             spec = importlib.util.spec_from_file_location(
                 'accl_tpu.' + m, os.path.join(root, m + '.py'))
             mod = importlib.util.module_from_spec(spec)

@@ -357,7 +357,7 @@ def test_facade_admission_counters_and_latency():
         recv[0].sync_from_device()
         assert recv[0].data[0] == 3.0
         snap = g[0].telemetry_snapshot()
-        assert snap["schema_version"] == 5
+        assert snap["schema_version"] == 6
         # per-call tenant forensics: flight records carry the admitting
         # tenant (the attribution the arbiter plane documents)
         assert any(

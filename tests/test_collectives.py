@@ -564,7 +564,11 @@ def test_allreduce_fp8_wire(group4, rng, wire):
     payload crosses the wire as e4m3/e5m2 and accumulates in fp32.
     Compared against the true fp32 sum with format-scale tolerance: the
     ring re-quantizes each partial sum per hop, so a few quantization
-    steps of error accumulate (rel step: e4m3 2^-3, e5m2 2^-2)."""
+    steps of error accumulate (rel step: e4m3 2^-3, e5m2 2^-2) — and
+    since the quantized-wire plane the fp8 lanes round STOCHASTICALLY
+    (full-ulp uniform noise per hop instead of deterministic half-ulp,
+    unbiased in expectation), so the bound carries the SR variance of
+    2(P-1) hops, not the deterministic worst case."""
     import ml_dtypes
 
     wire_dt = getattr(ml_dtypes, wire)
@@ -582,9 +586,14 @@ def test_allreduce_fp8_wire(group4, rng, wire):
         recv.sync_from_device()
         return recv.data.copy()
 
+    # SR variance sizing: partial sums reach ~4 (cancellation included),
+    # e4m3 ulp there is 0.5; 2(P-1)=6 hops of uniform full-ulp noise
+    # give sigma ~0.7, and the max over 1024 elements needs ~4 sigma of
+    # headroom — still far below the ~2-4 value scale, so a broken lane
+    # (garbage casts, wrong scales) fails loudly while tail draws pass
     tol = (
-        dict(rtol=0.3, atol=0.6) if wire == "float8_e5m2"
-        else dict(rtol=0.15, atol=0.3)
+        dict(rtol=0.5, atol=2.0) if wire == "float8_e5m2"
+        else dict(rtol=0.3, atol=1.0)
     )
     for got in run_parallel(group4, work):
         np.testing.assert_allclose(got, expected, **tol)

@@ -85,7 +85,7 @@ def test_scrape_endpoints_smoke():
         status, body = _get(port, "/snapshot")
         assert status == 200
         snap = json.loads(body)
-        assert snap["schema_version"] == 5
+        assert snap["schema_version"] == 6
         for key in ("flight_recorder", "metrics", "stragglers",
                     "anomalies", "monitor", "health"):
             assert key in snap

@@ -647,9 +647,9 @@ def test_snapshot_carries_schema_version():
     g = emulated_group(2)
     try:
         snap = g[0].telemetry_snapshot()
-        assert snap["schema_version"] == T.SCHEMA_VERSION == 5
+        assert snap["schema_version"] == T.SCHEMA_VERSION == 6
         # the JSON exporter round-trips it
-        assert json.loads(g[0].telemetry_json())["schema_version"] == 5
+        assert json.loads(g[0].telemetry_json())["schema_version"] == 6
     finally:
         _deinit(g)
 
