@@ -76,11 +76,19 @@ _TAINT_NAMES = frozenset((
     "rank", "local_rank", "world_rank",
 ))
 #: attribute terminals that read process-local state
+#: (``self_evicted`` is the membership plane's per-rank verdict bit —
+#: true on exactly one rank of the old group, the definition of
+#: rank-varying)
 _TAINT_ATTRS = frozenset((
     "rank", "local_rank", "world_rank", "is_dummy", "is_host_only",
-    "process_index", "process_id",
+    "process_index", "process_id", "self_evicted",
 ))
-_TAINT_SUBSTR = ("health", "tenant_class")
+#: ``last_join`` covers raw join-state reads (snapshot["last_join"],
+#: view._last_join): members and a just-admitted candidate observe the
+#: join at different moments, so branching a collective on the raw
+#: record diverges — route it through the latched ``join_decision()``
+#: accessor instead
+_TAINT_SUBSTR = ("health", "tenant_class", "last_join")
 
 #: built-in sanitizers (beyond same-module @spmd_uniform functions):
 #: ``create_communicator`` is the blessed MPI_Comm_split-style
@@ -94,13 +102,17 @@ _TAINT_SUBSTR = ("health", "tenant_class")
 #: SPMD-uniform by construction.  The QoS arbiter plane's decision
 #: accessor joins them: ``admit`` returns the per-(comm, call index)
 #: admission record latched on the shared arbiter — every rank reads
-#: the same class/throttle verdict.  Raw health-map and tenant-class
-#: reads stay taint SOURCES (_TAINT_SUBSTR above): a collective
-#: branched on a locally-read ``tenant_class`` field still flags —
+#: the same class/throttle verdict.  The elastic-expansion admission
+#: accessor ``join_decision`` joins the membership set: it returns the
+#: latest APPLIED join record — majority-confirmed and cutover-applied,
+#: identical on every member by the agreement protocol.  Raw
+#: health-map, tenant-class and join-state reads stay taint SOURCES
+#: (_TAINT_SUBSTR above): a collective branched on a locally-read
+#: ``tenant_class`` field or raw ``last_join`` record still flags —
 #: route it through the latched decision instead.
 _BUILTIN_SANITIZERS = frozenset((
     "create_communicator", "split",
-    "demote_decision", "suggest_root",
+    "demote_decision", "suggest_root", "join_decision",
     "admit",
 ))
 

@@ -2056,13 +2056,18 @@ class XLAEngine(StreamPortMixin, BaseEngine):
 
     def on_membership_cutover(self, plan: dict, addresses: tuple = (),
                               comm_ids: tuple = ()) -> None:
-        """Post-shrink session re-arm: halt the command ring's
-        persistent runs and abandon its per-comm sessions (they re-arm
-        lazily over the survivors at the next warm window — the
-        documented tear-down/re-arm), drop the evicted sessions'
-        watchdog entries, and clear the suspect strikes the failure
+        """Post-cutover session re-arm (shrink AND grow): halt the
+        command ring's persistent runs and abandon its per-comm
+        sessions (they re-arm lazily over the new membership at the
+        next warm window — the documented tear-down/re-arm), drop the
+        evicted sessions' watchdog entries — and, on a JOIN, the
+        admitted sessions' too: the candidate's previous life may have
+        left a ``dead`` verdict that would fail-fast its first
+        post-join window — and clear the suspect strikes the failure
         cascade accrued against survivors."""
-        for s in plan.get("evict", ()):
+        for s in tuple(plan.get("evict", ())) + tuple(
+            plan.get("admit", ())
+        ):
             self.gang.health.pop(s, None)
         # snapshot before iterating: the watchdog timer thread inserts
         # concurrently, and a bare .values() walk can raise mid-cutover

@@ -135,6 +135,68 @@ class Communicator:
             self._inbound_seq = {i: 0 for i in range(len(self.ranks))}
             return translation
 
+    def grow(
+        self, admit: Sequence[int],
+        rank_info: Optional[Dict[int, Rank]] = None,
+    ) -> Optional[Dict[int, int]]:
+        """Cut this communicator over IN PLACE to a GROWN membership —
+        the elastic-expansion cutover (the :meth:`shrink` discipline,
+        other direction).  ``admit`` are world *sessions* to admit.
+        Sessions known from the pre-shrink membership return to their
+        ORIGINAL world slots (the ``_full_ranks`` ordering rule — every
+        member derives the same post-join rank order without exchanging
+        it); genuinely new sessions need a :class:`Rank` in
+        ``rank_info`` and append in ascending session order.  A fresh
+        epoch starts (plan caches and seqn dedup re-key — the admitted
+        rank's PREVIOUS life, if it had one, can never cross-match) and
+        every per-peer sequence counter restarts at 0.  Returns the
+        translation table ``{old comm-relative rank -> new}``; an
+        ``admit`` of sessions already present (the candidate's own
+        re-key at admission) yields the identity translation with the
+        fresh epoch."""
+        admit = {int(s) for s in admit}
+        with self._lock:
+            base = (
+                list(self._full_ranks) if self._full_ranks is not None
+                else list(self.ranks)
+            )
+            current = {r.session for r in self.ranks}
+            target = current | admit
+            known = {r.session for r in base}
+            extras = []
+            for s in sorted(admit - known):
+                if rank_info is None or s not in rank_info:
+                    raise ValueError(
+                        f"admitted session {s} unknown to this "
+                        "communicator and no rank_info given"
+                    )
+                extras.append(rank_info[s])
+            new_ranks = [r for r in base if r.session in target] + extras
+            old_index = {r.session: i for i, r in enumerate(self.ranks)}
+            translation = {
+                old_index[r.session]: new
+                for new, r in enumerate(new_ranks)
+                if r.session in old_index
+            }
+            local_session = self.ranks[self.local_rank].session
+            self.ranks = new_ranks
+            self.local_rank = next(
+                i for i, r in enumerate(new_ranks)
+                if r.session == local_session
+            )
+            if self._full_ranks is not None and len(new_ranks) >= len(
+                self._full_ranks
+            ) and known <= {r.session for r in new_ranks}:
+                # grown back to (at least) the stashed membership: the
+                # shrink is fully undone and soft_reset has nothing to
+                # re-admit
+                self._full_ranks = None
+                self._full_local = None
+            self.epoch = next(_comm_epochs)
+            self._outbound_seq = {i: 0 for i in range(len(self.ranks))}
+            self._inbound_seq = {i: 0 for i in range(len(self.ranks))}
+            return translation
+
     def restore(self) -> bool:
         """Undo every shrink: re-admit the full pre-shrink membership
         (the soft_reset recovery path, collective by contract like the

@@ -923,6 +923,67 @@ class ContractVerifier:
             st.claims.clear()
             st.pending_relays.clear()
 
+    def join_comm(self, comm_id: int, local_rank: int, sessions: tuple,
+                  membership_epoch: int,
+                  base: Optional[tuple] = None) -> None:
+        """Membership-plane GROW cutover: re-register the grown
+        membership and fold a ``__join__`` marker into the digest
+        stream — the ``__shrink__`` discipline run in the other
+        direction.  ``base`` is the agreed ``(calls, digest)`` restart
+        point carried by the confirmed join plan's warm handoff: every
+        member (survivor and candidate alike) rebases its stream on it
+        before folding the marker, so the candidate — whose local
+        stream is empty or belongs to a previous life — converges on
+        the group's digest at the cutover boundary instead of
+        diverging forever.  A rank that MISSED the cutover keeps
+        rolling its old stream and diverges within one verification
+        window, exactly like a missed shrink.  Without a base
+        (defensive: a plan with no handoff) the marker folds into the
+        continuous stream, shrink-style."""
+        with self._lock:
+            st = self._comm_state(comm_id)
+            st.local_rank = int(local_rank)
+            st.sessions = tuple(sessions)
+            st.size = len(st.sessions)
+            if base is not None:
+                try:
+                    st.calls = int(base[0])
+                    st.digest = int(base[1])
+                except (TypeError, ValueError, IndexError):
+                    pass
+            fp = call_fingerprint(
+                "__join__", comm_id, self.generation, None,
+                len(sessions), membership_epoch, 0, st.calls,
+            )
+            st.digest = roll_digest(st.digest, fp)
+            st.claims.clear()
+            st.pending_relays.clear()
+
+    def export_handoff(self) -> dict:
+        """The contract half of the warm-handoff artifacts an admitting
+        member exports for the candidate (JSON-serializable): the
+        generation (so stale wire stamps from the candidate's previous
+        life are ignored by ``observe_claim``) and each registered
+        communicator's ``(calls, digest)`` baseline — the agreed
+        restart point :meth:`join_comm` rebases every member on."""
+        with self._lock:
+            return {
+                "generation": self.generation,
+                "interval": self.interval,
+                "comms": {
+                    str(cid): {"calls": st.calls, "digest": st.digest}
+                    for cid, st in self._comms.items()
+                },
+            }
+
+    def adopt_generation(self, generation: int) -> None:
+        """Candidate-side handoff adoption: align the verification
+        generation with the group's (the candidate's own generation
+        belongs to its previous life — its posts would be ignored and
+        peers' claims skipped without this)."""
+        with self._lock:
+            self.generation = int(generation)
+
     def reset(self) -> None:
         """soft_reset recovery: drop every verdict, digest and claim and
         start a new generation (collective by contract, so generations

@@ -185,6 +185,11 @@ class ACCL:
             send_fn=self._membership_send,
         )
         self._membership.elastic = _mbr.env_elastic()
+        # warm handoff (elastic expansion): the artifact exporter the
+        # JOIN agreement attaches to its confirm — contract baselines,
+        # tuning plan, plan verdicts — so an admitted rank's first
+        # window is contract-conformant
+        self._membership.handoff_fn = self._membership_handoff
         self._health_events = HealthTransitions()
         self._demote_seq: dict = {}  # comm id -> routing call index
         self._demoted_seen: set = set()  # (comm, rank) demotions counted
@@ -607,21 +612,77 @@ class ACCL:
         return plan
 
     def suggest_root(self, comm: Optional[Communicator] = None) -> int:
-        """The lowest comm-relative rank NOT currently demoted by the
-        straggler circuit breaker — the advisory root/relay choice for
-        callers that pick their own roots.  0 (the stock choice) when
-        nothing is demoted or demotion routing is off (wire tiers,
-        elastic unarmed)."""
+        """The lowest comm-relative rank NOT currently flagged slow —
+        the advisory root/relay choice for callers that pick their own
+        roots.  Board-anchored tiers read the straggler circuit
+        breaker's demotion ledger (majority-grade verdicts); wire tiers
+        have no shared ledger, so the monitor plane's PAIRWISE
+        slow-rank verdicts feed in instead — annotation-only advice
+        from this rank's own observations (each side may suggest a
+        different root; callers that need agreement use the
+        ledger-latched ``_barrier_root`` path, which wire tiers never
+        take).  0 (the stock choice) when nothing is flagged or the
+        monitor is off."""
         comm = comm or self._world
         demoted = set(self._membership.demoted(comm.id))
+        if (
+            self._membership.ledger is None
+            and self._monitor is not None
+        ):
+            # wire tier: pairwise verdicts as advisory input (no
+            # demotion ledger — nothing is ever demoted, routing by
+            # callers' choice only)
+            demoted |= set(self._monitor.slow_ranks(comm.id))
         for r in range(comm.size):
             if r not in demoted:
                 return r
         return 0
 
+    def join_rank(self, timeout: Optional[float] = None):
+        """The candidate's side of elastic EXPANSION: petition the live
+        group for admission, wait (bounded, ``ACCL_JOIN_CONFIRM_S``)
+        for the strict-majority confirm, and cut this handle over to
+        the grown membership — fresh comm epochs, the ``__join__``
+        digest marker, and the group's warm-handoff artifacts (contract
+        baselines, tuning plan, plan verdicts) adopted so the first
+        window is contract-conformant.  The natural caller is a
+        previously-evicted rank re-joining after the operator healed
+        its fault (the kill→shrink→serve→join→serve cycle); survivors
+        apply their half of the cutover at their next call boundary,
+        exactly like eviction.  Returns the applied join record, or
+        None when confirmation did not arrive in time (the petition
+        stands; re-calling retries)."""
+        mv = self._membership
+        if not mv.elastic:
+            raise ACCLError(
+                ErrorCode.INVALID_OPERATION,
+                "join_rank needs elastic membership "
+                "(ACCL_ELASTIC=1 / set_elastic())",
+                details={"op": "join_rank"},
+            )
+        mv.petition_join()
+        plan = mv.wait_confirmed(
+            timeout=_mbr.env_join_s() if timeout is None else timeout
+        )
+        if plan is None or plan.get("kind") != "join":
+            return None
+        return self._apply_cutover()
+
+    def join_decision(self) -> dict:
+        """The latched admission-decision accessor (the
+        ``demote_decision``/``suggest_root`` discipline): the latest
+        APPLIED join record — majority-confirmed and cutover-applied,
+        identical on every member — safe to branch collective sequences
+        on, unlike raw membership/health state."""
+        return self._membership.join_decision()
+
     def _membership_send(self, payload: dict, exclude) -> None:
-        """MEMBER agreement frames to the surviving world peers (the
-        wire exchange path; board-anchored tiers never call this)."""
+        """MEMBER agreement frames to the world peers minus ``exclude``
+        (the wire exchange path; board-anchored tiers never call this).
+        Iterates the FULL pre-shrink membership when one is stashed:
+        eviction phases exclude the condemned explicitly, but JOIN
+        phases must reach the candidate — a session outside the shrunk
+        group that the survivors' world communicator no longer lists."""
         fabric = getattr(self.engine, "fabric", None)
         if fabric is None:
             return
@@ -630,9 +691,11 @@ class ACCL:
         from .backends.emulator.fabric import Message, MsgType
 
         comm = self._world
+        ranks = getattr(comm, "_full_ranks", None) or comm.ranks
+        local_session = comm.ranks[comm.local_rank].session
         data = _json.dumps(payload).encode()
-        for i, r in enumerate(comm.ranks):
-            if i == comm.local_rank or r.session in exclude:
+        for i, r in enumerate(ranks):
+            if r.session == local_session or r.session in exclude:
                 continue
             try:
                 fabric.send(r.address, Message(
@@ -641,6 +704,34 @@ class ACCL:
                 ))
             except Exception:
                 pass  # a dead/partitioned peer: nothing to tell
+
+    def _membership_handoff(self) -> dict:
+        """The warm-handoff artifact bundle a JOIN confirm carries (the
+        ``MembershipView.handoff_fn`` exporter): everything an admitted
+        rank needs for a contract-conformant first window.  Bounded,
+        JSON-safe, side-effect-free — it rides a board plan or one
+        MEMBER wire frame."""
+        contract = (
+            self._contract.export_handoff()
+            if self._contract is not None else None
+        )
+        tuning = (
+            self._tuning_plan.to_json()
+            if self._tuning_plan is not None else None
+        )
+        return {
+            "contract": contract,
+            "tuning_plan": tuning,
+            "plan_verdicts": self._plans.export_verdicts(),
+            "trace_gen": self._trace_gen,
+            # SPMD-uniform per-comm counters the joiner must resume at
+            # (stochastic-rounding seeds and pipelined-segment tags
+            # derive from these with zero wire bytes)
+            "wire_ctr": {str(k): v for k, v in self._wire_ctr.items()},
+            "pipeline_ctr": {
+                str(k): v for k, v in self._pipeline_ctr.items()
+            },
+        }
 
     # -- postmortem plane (accl_tpu.monitor.BlackBox) -------------------------
     def _postmortem_evidence(self) -> dict:
@@ -798,6 +889,8 @@ class ACCL:
         plan = mv.take_cutover()
         if plan is None:
             return None
+        if plan.get("kind") == "join":
+            return self._apply_join(plan)
         evicted_sessions = set(plan["evict"])
         if mv.self_evicted:
             return plan  # out of the group: nothing local to shrink
@@ -870,6 +963,147 @@ class ACCL:
                 key=("RANK_EVICTED", self._membership.epoch),
             )
         return plan
+
+    def _apply_join(self, plan: dict) -> dict:
+        """Apply a consumed JOIN record (``take_cutover`` already
+        realigned the view): grow every communicator that knew the
+        admitted sessions (fresh epoch, zeroed seqns, original world
+        slots — the ``Communicator.grow`` ordering rule, so every
+        member derives the same post-join rank order with zero extra
+        wire bytes), rebase the contract digest streams on the
+        handoff's agreed baseline and fold the ``__join__`` marker,
+        migrate error-feedback residuals per bucket (lazy, behind each
+        bucket's drain point), re-register the monitor/contract/trace
+        rank spaces, and re-arm the engine at the grown world.  The
+        candidate additionally adopts the warm-handoff artifacts —
+        contract generation, tuning plan, plan verdicts, SPMD-uniform
+        counters — so its first window is contract-conformant."""
+        mv = self._membership
+        admit = {int(s) for s in plan.get("admit") or ()}
+        local_session = self._world.ranks[self._world.local_rank].session
+        candidate = local_session in admit
+        handoff = plan.get("handoff") or {}
+        # in-flight work first: the incremental migrations below are
+        # "behind the drain point" by construction — nothing launched
+        # under the old membership is still running
+        self.engine.drain_inflight()
+        fabric = getattr(self.engine, "fabric", None)
+        cdoc = handoff.get("contract") or {}
+        addresses = []
+        grown_ids = []
+        for comm in self._communicators:
+            sessions = {r.session for r in comm.ranks}
+            full = getattr(comm, "_full_ranks", None) or ()
+            known = {r.session for r in full} | sessions
+            hit = admit & known
+            if not hit:
+                continue
+            old_epoch = comm.epoch
+            if comm.grow(hit) is None:  # pragma: no cover - grow never
+                continue                # drops the local rank
+            grown_ids.append(comm.id)
+            addresses.extend(
+                r.address for i, r in enumerate(comm.ranks)
+                if (r.session in hit if not candidate
+                    else i != comm.local_rank)
+            )
+            if not candidate:
+                # survivors carry their residual streams across the
+                # epoch bump; the candidate's previous life is stale
+                # by the whole absence and restarts at zeros
+                self._residuals.migrate_epoch(
+                    comm.id, old_epoch, comm.epoch
+                )
+            if self._contract is not None:
+                entry = (cdoc.get("comms") or {}).get(str(comm.id))
+                base = None
+                if entry is not None:
+                    base = (entry.get("calls", 0), entry.get("digest", 0))
+                self._contract.join_comm(
+                    comm.id, comm.local_rank,
+                    tuple(r.session for r in comm.ranks),
+                    plan.get("applied_epoch", mv.epoch), base=base,
+                )
+                if fabric is not None and hasattr(
+                    fabric, "register_contract"
+                ):
+                    fabric.register_contract(
+                        comm.id, comm.local_rank, self._contract
+                    )
+            if self._monitor is not None:
+                self._monitor.tracker.begin_comm(
+                    comm.id, comm.local_rank, comm.size
+                )
+                if fabric is not None and hasattr(fabric, "register_skew"):
+                    fabric.register_skew(
+                        comm.id, comm.local_rank, self._monitor.tracker
+                    )
+            if self._telemetry is not None and fabric is not None and (
+                hasattr(fabric, "register_trace")
+            ):
+                fabric.register_trace(comm.id, comm.local_rank, self)
+        if candidate:
+            # warm handoff: adopt the group's artifacts BEFORE the plan
+            # pool clears so the first post-join window meets the same
+            # verdicts/generation the survivors run
+            if self._contract is not None and cdoc.get(
+                "generation"
+            ) is not None:
+                self._contract.adopt_generation(cdoc["generation"])
+            tp = handoff.get("tuning_plan")
+            if tp:
+                try:
+                    from .tuning import TuningPlan
+
+                    self.load_tuning_plan(
+                        TuningPlan.from_json(tp), strict=False
+                    )
+                except Exception:  # a stale plan must never fail a join
+                    pass
+            for attr, key in (
+                ("_wire_ctr", "wire_ctr"), ("_pipeline_ctr", "pipeline_ctr"),
+            ):
+                carried = handoff.get(key) or {}
+                try:
+                    getattr(self, attr).update(
+                        {int(k): int(v) for k, v in carried.items()}
+                    )
+                except (TypeError, ValueError):
+                    pass
+            gen = handoff.get("trace_gen")
+            if isinstance(gen, int) and gen > self._trace_gen:
+                self._trace_gen = gen
+        self.engine.on_membership_cutover(
+            plan, addresses=tuple(sorted(set(addresses))),
+            comm_ids=tuple(grown_ids),
+        )
+        # stale pre-join plans must never serve the grown group; the
+        # "membership_join" reason keeps migrated residuals (the one
+        # invalidation that preserves — wire verdicts did not change)
+        self._plans.invalidate("membership_join")
+        if candidate:
+            self._plans.adopt_verdicts(handoff.get("plan_verdicts"))
+        for s in sorted(admit):
+            self._health_events.note(s, "evicted", "joined")
+        if self._telemetry is not None:
+            self._telemetry.metrics.inc("accl_membership_joins_total")
+        return plan
+
+    def _membership_report(self) -> dict:
+        """The merged membership view (``telemetry_snapshot()
+        ["membership"]`` and the ``/membership`` route): the elastic
+        state machine's snapshot plus the advisory traffic-aware scale
+        recommendation from the arbiter's per-tenant p99 histograms —
+        advisory ONLY (the ``suspect_slow`` annotation discipline):
+        nothing ever acts on it automatically."""
+        doc = self._membership.snapshot()
+        doc["scale_advice"] = (
+            self._monitor.scale_advice(
+                self._arbiter.snapshot(), self._world.size
+            )
+            if self._monitor is not None else None
+        )
+        return doc
 
     def _membership_intake(self, options: CallOptions,
                            context: str) -> None:
@@ -2945,10 +3179,11 @@ class ACCL:
             # bucket) anomaly alerts, and the live-service state (the
             # one-line answer to "which rank is slow?")
             # membership plane: the elastic state machine (epoch,
-            # evictions, demotion breakers) and the health-transition
-            # event ring (the one-line answer to "who left the group,
-            # and when?")
-            "membership": self._membership.snapshot(),
+            # evictions, admissions, demotion breakers), the advisory
+            # traffic-aware scale recommendation, and the health-
+            # transition event ring (the one-line answer to "who left
+            # the group, and when — and should it grow back?")
+            "membership": self._membership_report(),
             "health_events": self._health_events.snapshot(),
             # arbiter plane: per-tenant admission counters, quotas, and
             # the live latency histograms with their p99 tails (the
@@ -3061,6 +3296,11 @@ class ACCL:
 
             return _json.dumps(self._arbiter.snapshot(), default=str)
 
+        def _membership_doc() -> str:
+            import json as _json
+
+            return _json.dumps(self._membership_report(), default=str)
+
         srv = _monitor.MonitorServer({
             "/": (self._monitor_index, "text/plain; charset=utf-8"),
             "/metrics": (
@@ -3071,6 +3311,7 @@ class ACCL:
             "/trace": (_trace_doc, "application/json"),
             "/cmdring": (_cmdring_doc, "application/json"),
             "/tenants": (_tenants_doc, "application/json"),
+            "/membership": (_membership_doc, "application/json"),
         }, port=int(port))
         srv.start()
         self._monitor.server = srv
@@ -3085,7 +3326,8 @@ class ACCL:
         lines = [
             f"accl monitor — rank {self._world.local_rank}/"
             f"{self._world.size} ({type(self.engine).__name__})",
-            "routes: /metrics /snapshot /trace /cmdring /tenants",
+            "routes: /metrics /snapshot /trace /cmdring /tenants "
+            "/membership",
             "",
         ]
         ring = self.engine.telemetry_report().get("cmdring") or {}
@@ -3135,11 +3377,14 @@ class ACCL:
             )
         else:
             lines.append("anomaly: none")
-        mem = self._membership.snapshot()
+        mem = self._membership_report()
+        advice = mem.get("scale_advice") or {}
         lines.append(
             f"membership: epoch={mem.get('epoch')} "
             f"elastic={mem.get('elastic')} "
-            f"evicted={sorted(mem.get('evicted') or [])}"
+            f"evicted={sorted(mem.get('evicted') or [])} "
+            f"joins={mem.get('joins_total', 0)} "
+            f"scale_advice={advice.get('recommendation', '-')}"
         )
         # arbiter plane: the one-line per-tenant QoS summary — class,
         # admission counts, live p99 — so a bare browser hit answers
@@ -3253,6 +3498,7 @@ class ACCL:
                 "epoch": self._membership.epoch,
                 "evicted": sorted(self._membership.evicted),
                 "demoted": self._membership.demoted(self._world.id),
+                "joins_total": self._membership.joins_total,
             },
             # contract plane armed? (ACCL_VERIFY / set_contract_verify)
             "contract_verify": (

@@ -24,6 +24,19 @@ writes, membership churn) clears residuals too via the plan-cache
 invalidation hook — a residual accumulated under one wire verdict must
 never feed a call dispatched under another.
 
+The one exception is elastic *expansion*: a JOIN cutover changes the
+comm epoch but does NOT change the wire verdict the survivors'
+residuals were accumulated under (the grown plan re-tunes lazily, and
+zeros-vs-carried only affects convergence speed, never correctness).
+Dropping every residual there would silently restart EF convergence on
+each admission, so :meth:`migrate_epoch` records an old→new epoch
+mapping instead and :meth:`apply` re-keys each bucket **lazily on its
+first post-cutover touch** — per-bucket, behind that bucket's drain
+point (the cutover only fires at a call boundary after the in-flight
+window drained), never a global drain.  The admitted rank's previous
+life never aliases: its fresh epochs have no mapping, so its old keys
+just age out under the entry cap.
+
 The residual update itself is computed with the SAME shared codec
 (:mod:`accl_tpu.wire`) and the call's SR seed the engine lane uses, so
 where the engine rounds each contribution once with that seed (the
@@ -56,6 +69,11 @@ __all__ = ["ResidualStore"]
 #: correct (residuals are an optimization, zeros are always safe)
 DEFAULT_MAX_ENTRIES = 64
 
+#: pending epoch-migration cap — one mapping per JOIN cutover per comm;
+#: exceeding it means pathological membership churn, where restarting
+#: EF from zeros is the safe answer
+MAX_MIGRATIONS = 16
+
 
 class ResidualStore:
     """Per-(comm, epoch, op, bucket) compression-residual accumulators.
@@ -72,6 +90,11 @@ class ResidualStore:
         self.updates = 0
         self.invalidations = 0
         self.last_invalidation: Optional[str] = None
+        # elastic-expansion lazy re-key: {(comm, new epoch) -> (comm,
+        # old epoch)} recorded at the JOIN cutover, consumed bucket by
+        # bucket on first touch (see migrate_epoch)
+        self._migrations: Dict[Tuple, Tuple] = {}
+        self.migrations = 0
         # running L2 norm of the most recent residual per key (the
         # convergence health signal: a norm that grows without bound
         # means the wire lane is too aggressive for this workload)
@@ -88,6 +111,23 @@ class ResidualStore:
         x = np.asarray(x)
         with self._lock:
             r = self._entries.get(key)
+            if r is None and self._migrations:
+                # lazy per-bucket epoch migration (JOIN cutover): walk
+                # the mapping chain — sequential joins before this
+                # bucket's first touch compose — and move the residual
+                # under the new key exactly once
+                src = self._migrations.get((key[0], key[1]))
+                seen = set()
+                while src is not None and src not in seen:
+                    seen.add(src)
+                    old_key = src + key[2:]
+                    r = self._entries.pop(old_key, None)
+                    if r is not None:
+                        self._entries[key] = r
+                        self._norms[key] = self._norms.pop(old_key, 0.0)
+                        self.migrations += 1
+                        break
+                    src = self._migrations.get(src)
             if r is not None and (
                 r.shape != x.shape or r.dtype != x.dtype
             ):
@@ -116,13 +156,42 @@ class ResidualStore:
             r = self._entries.get(key)
             return None if r is None else r.copy()
 
+    def migrate_epoch(
+        self, comm_id: int, old_epoch: int, new_epoch: int
+    ) -> None:
+        """Record that ``comm_id``'s residual stream continues under
+        ``new_epoch`` (a JOIN cutover re-epoched the communicator
+        without changing the wire verdict).  O(1) at the cutover:
+        entries stay put and each bucket re-keys lazily on its first
+        post-cutover :meth:`apply` — behind that bucket's drain point
+        by construction.  Beyond :data:`MAX_MIGRATIONS` pending
+        mappings everything clears (zeros are always safe)."""
+        with self._lock:
+            if len(self._migrations) >= MAX_MIGRATIONS:
+                self._entries.clear()
+                self._norms.clear()
+                self._migrations.clear()
+                return
+            if int(old_epoch) != int(new_epoch):
+                self._migrations[(int(comm_id), int(new_epoch))] = (
+                    int(comm_id), int(old_epoch),
+                )
+
     def invalidate(self, reason: str = "") -> None:
         """Drop every residual (the plan-cache hook: register writes,
         soft_reset, membership churn — anything that may change the
-        wire verdict a key's calls ride)."""
+        wire verdict a key's calls ride).  A ``membership_join``
+        invalidation is the one migration-preserving exception: the
+        grow cutover re-epochs comms but leaves wire verdicts intact,
+        so entries with a registered epoch migration survive to be
+        re-keyed lazily (see :meth:`migrate_epoch`)."""
         with self._lock:
-            self._entries.clear()
-            self._norms.clear()
+            if not (
+                reason.startswith("membership_join") and self._migrations
+            ):
+                self._entries.clear()
+                self._norms.clear()
+                self._migrations.clear()
             self.invalidations += 1
             self.last_invalidation = reason or None
 
@@ -137,4 +206,6 @@ class ResidualStore:
                 "invalidations": self.invalidations,
                 "last_invalidation": self.last_invalidation,
                 "max_residual_norm": round(worst, 6),
+                "migrations": self.migrations,
+                "pending_migrations": len(self._migrations),
             }

@@ -87,6 +87,15 @@ class FaultRule:
     Action parameters: ``delay_s`` (delay), ``rank`` (kill_rank, the
     comm-relative rank to kill), ``groups`` (partition, a list of rank
     lists defining the islands).
+
+    Bounded duration: ``partition``/``drop`` rules may carry an
+    optional ``heal_after`` occurrence count — after the rule has
+    dropped that many messages its standing damage clears ITSELF (the
+    partition island is removed / the drop rule deactivates) and a
+    ``healed`` event is logged.  Healing is counter-driven like firing,
+    so the same plan against the same traffic heals at the same
+    message — which is what makes join-after-partition soaks
+    replayable without out-of-band plan surgery.
     """
 
     action: FaultAction
@@ -100,6 +109,7 @@ class FaultRule:
     delay_s: float = 0.1
     rank: Optional[int] = None
     groups: Optional[List[List[int]]] = None
+    heal_after: Optional[int] = None
 
     def __post_init__(self):
         self.action = FaultAction(self.action)
@@ -109,6 +119,14 @@ class FaultRule:
             raise ValueError("diverge rule needs a rank")
         if self.action == FaultAction.PARTITION and not self.groups:
             raise ValueError("partition rule needs groups")
+        if self.heal_after is not None:
+            if self.action not in (FaultAction.PARTITION, FaultAction.DROP):
+                raise ValueError(
+                    "heal_after only applies to partition/drop rules"
+                )
+            if int(self.heal_after) < 1:
+                raise ValueError("heal_after must be a positive count")
+            self.heal_after = int(self.heal_after)
 
     def matches(self, msg) -> bool:
         if self.comm is not None and msg.comm_id != self.comm:
@@ -131,7 +149,7 @@ class FaultRule:
     def to_dict(self) -> dict:
         d = {"action": self.action.value}
         for f in ("comm", "src", "dst", "tag", "msg_type", "count",
-                  "rank", "groups"):
+                  "rank", "groups", "heal_after"):
             v = getattr(self, f)
             if v is not None:
                 d[f] = v
@@ -214,15 +232,21 @@ class FaultInjector:
         # (comm_scope, rank) pairs currently dead; comm_scope is the rule's
         # comm match (None = any communicator)
         self._dead: Set[Tuple[Optional[int], int]] = set()
-        # active partitions: (comm_scope, rank -> island index)
-        self._partitions: List[Tuple[Optional[int], Dict[int, int]]] = []
+        # active partitions: (comm_scope, rank -> island index, rule idx)
+        self._partitions: List[
+            Tuple[Optional[int], Dict[int, int], Optional[int]]
+        ] = []
+        # heal_after bookkeeping: per-rule occurrence counters and the
+        # healed latch (a healed rule never fires again this install)
+        self._heal_ctr = [0] * len(plan.rules)
+        self.healed = [False] * len(plan.rules)
         for i, rule in enumerate(plan.rules):
             if rule.nth == 0:
                 if rule.action == FaultAction.KILL_RANK:
                     self._dead.add((rule.comm, rule.rank))
                 elif rule.action == FaultAction.PARTITION:
                     self._partitions.append(
-                        (rule.comm, self._island_map(rule.groups))
+                        (rule.comm, self._island_map(rule.groups), i)
                     )
 
     @staticmethod
@@ -264,9 +288,12 @@ class FaultInjector:
                 v.drop = True
                 self._log("dead_src_drop", None, msg)
                 return v
-            if self._crosses_partition(msg):
+            part = self._which_partition(msg)
+            if part is not None:
+                ridx = self._partitions[part][2]
                 v.drop = True
-                self._log("partition_drop", None, msg)
+                self._log("partition_drop", ridx, msg)
+                self._count_heal(ridx, part, msg)
                 return v
             for i, rule in enumerate(self.plan.rules):
                 if rule.action in (FaultAction.KILL_RANK,
@@ -274,6 +301,8 @@ class FaultInjector:
                     continue  # install-time rules never fire per-message
                 if rule.action == FaultAction.DIVERGE:
                     continue  # fires on fingerprints, not wire messages
+                if self.healed[i]:
+                    continue  # a self-healed rule never fires again
                 if not rule.matches(msg):
                     continue
                 self._matched[i] += 1
@@ -285,6 +314,7 @@ class FaultInjector:
                 self._log(rule.action.value, i, msg)
                 if rule.action == FaultAction.DROP:
                     v.drop = True
+                    self._count_heal(i, None, msg)
                     return v
                 if rule.action == FaultAction.DELAY:
                     v.delay_s = max(v.delay_s, float(rule.delay_s))
@@ -299,23 +329,49 @@ class FaultInjector:
                         return v
                 elif rule.action == FaultAction.PARTITION:
                     island = self._island_map(rule.groups)
-                    self._partitions.append((rule.comm, island))
-                    if self._crosses_partition(msg):
+                    self._partitions.append((rule.comm, island, i))
+                    part = self._which_partition(msg)
+                    if part is not None:
                         v.drop = True
+                        self._count_heal(
+                            self._partitions[part][2], part, msg
+                        )
                         return v
         return v
 
     def _is_dead(self, comm_id: int, rank: int) -> bool:
         return (None, rank) in self._dead or (comm_id, rank) in self._dead
 
-    def _crosses_partition(self, msg) -> bool:
-        for comm_scope, island in self._partitions:
+    def _which_partition(self, msg) -> Optional[int]:
+        """Index of the first active partition this message crosses,
+        None when it crosses none."""
+        for p, (comm_scope, island, _ridx) in enumerate(self._partitions):
             if comm_scope is not None and msg.comm_id != comm_scope:
                 continue
             a, b = island.get(msg.src), island.get(msg.dst)
             if a is not None and b is not None and a != b:
-                return True
-        return False
+                return p
+        return None
+
+    def _count_heal(self, ridx: Optional[int], part: Optional[int],
+                    msg) -> None:
+        """One ``heal_after`` occurrence for rule ``ridx`` (caller holds
+        the lock).  Reaching the count clears the rule's standing
+        damage: the partition island at ``part`` is removed, a drop
+        rule latches healed — deterministic, since occurrences are the
+        dropped messages themselves."""
+        if ridx is None:
+            return
+        rule = self.plan.rules[ridx]
+        if rule.heal_after is None or self.healed[ridx]:
+            return
+        self._heal_ctr[ridx] += 1
+        if self._heal_ctr[ridx] < rule.heal_after:
+            return
+        self.healed[ridx] = True
+        if part is not None:
+            self._partitions.pop(part)
+        self._log("healed", ridx, msg)
 
     def on_fingerprint(self, comm_id: int, rank: int) -> int:
         """The contract plane's hook (``accl_tpu.contract``): a nonzero
@@ -403,6 +459,7 @@ class FaultInjector:
                 "events": len(self.log),
                 "dead": sorted(self._dead),
                 "partitions": len(self._partitions),
+                "healed": list(self.healed),
             }
 
 

@@ -38,6 +38,22 @@ Three coupled pieces:
   size.  ``soft_reset`` (collective, after the operator heals the
   fabric) restores full membership.
 
+* **Join protocol** (elastic expansion — the shrink discipline run in
+  the GROW direction): a candidate rank *petitions* over the same
+  agreement paths (board event on InProc/gang anchors,
+  ``join_petition`` MEMBER frames on the socket tier).  The candidate
+  never votes on its own admission — elastic members *second* the
+  petition at their current epoch and a strict majority of the current
+  members confirms a ``kind="join"`` plan.  Every member applies the
+  cutover at its next call boundary (``Communicator.grow()`` in place,
+  fresh epoch, ``__join__`` contract marker); the candidate applies it
+  inside ``ACCL.join_rank()``, aligning its membership epoch and
+  cumulative eviction record to the group's (it missed every bump
+  since its previous life, if it had one).  The confirming member's
+  **warm-handoff** artifacts (contract generation + per-comm digest
+  baseline, tuning plan, plan-cache verdicts) ride the confirmed plan
+  so the candidate's first verification window is contract-conformant.
+
 * **Straggler demotion** (:class:`DemotionLedger`) — a convicted
   ``slow_rank`` (PR 8: two-window arrival-skew dominance, exchanged
   cross-rank) is *demoted*: kept in the communicator, excluded from
@@ -86,19 +102,23 @@ __all__ = [
     "CircuitBreaker",
     "DemotionLedger",
     "ELASTIC_ENV",
+    "JOIN_CONFIRM_ENV",
     "MembershipBoard",
     "MembershipView",
     "board_for",
     "env_elastic",
+    "env_join_s",
     "ledger_for",
 ]
 
 ELASTIC_ENV = "ACCL_ELASTIC"
 DEMOTE_COOLDOWN_ENV = "ACCL_DEMOTE_COOLDOWN_S"
 EVICT_CONFIRM_ENV = "ACCL_EVICT_CONFIRM_S"
+JOIN_CONFIRM_ENV = "ACCL_JOIN_CONFIRM_S"
 
 DEFAULT_DEMOTE_COOLDOWN_S = 30.0
 DEFAULT_EVICT_CONFIRM_S = 5.0
+DEFAULT_JOIN_CONFIRM_S = 5.0
 
 #: cutover records retained per view (the eviction history the
 #: determinism test replays)
@@ -125,6 +145,13 @@ def env_confirm_s() -> float:
     """How long a failed collective waits for eviction confirmation
     before surfacing its raw timeout (bounded — the shrink deadline)."""
     return max(0.1, _env_float(EVICT_CONFIRM_ENV, DEFAULT_EVICT_CONFIRM_S))
+
+
+def env_join_s() -> float:
+    """How long a candidate's ``join_rank`` waits for admission before
+    returning None (bounded — the grow deadline; the petition stands
+    and a retry re-petitions)."""
+    return max(0.1, _env_float(JOIN_CONFIRM_ENV, DEFAULT_JOIN_CONFIRM_S))
 
 
 # ---------------------------------------------------------------------------
@@ -242,20 +269,28 @@ def ledger_for(anchor) -> Optional["DemotionLedger"]:
 
 
 class MembershipBoard:
-    """Shared eviction-agreement state for rank handles in one process.
+    """Shared membership-agreement state for rank handles in one process.
 
-    Votes are keyed ``(epoch, eviction set)``; a post that completes a
-    strict majority of the would-be survivors confirms the plan.
-    Listeners observe both proposals (so elastic peers can second) and
-    confirmations (so every handle cuts over).  Votes from ranks inside
-    the eviction set never count toward the majority.
+    Votes are keyed ``(epoch, kind, member set)`` — ``kind`` is
+    ``"evict"`` (the shrink direction) or ``"join"`` (the grow
+    direction); a post that completes a strict majority confirms the
+    plan.  Listeners observe proposals and join petitions (so elastic
+    peers can second) and confirmations (so every handle cuts over).
+    Votes from ranks inside the eviction/admission set never count
+    toward the majority — the condemned don't vote, and neither does
+    the candidate petitioning its own admission.
     """
+
+    #: confirmed plans retained (one per applied cutover; a bound only
+    #: guards pathological epoch churn)
+    _PLAN_CAP = 64
 
     def __init__(self):
         self._lock = threading.Lock()
-        # (epoch, frozenset(evict)) -> set(voting world ranks)
+        # (epoch, kind, frozenset(members)) -> set(voting world ranks)
         self._votes: Dict[tuple, Set[int]] = {}
-        self._plans: Dict[int, dict] = {}  # epoch -> confirmed plan
+        self._plans: Dict[tuple, dict] = {}  # (epoch, kind) -> plan
+        self._plan_order: List[tuple] = []
         self._listeners: List[Callable[[dict], None]] = []
 
     def add_listener(self, fn: Callable[[dict], None]) -> None:
@@ -268,9 +303,9 @@ class MembershipBoard:
             if fn in self._listeners:
                 self._listeners.remove(fn)
 
-    def standing(self, epoch: int) -> Optional[dict]:
+    def standing(self, epoch: int, kind: str = "evict") -> Optional[dict]:
         with self._lock:
-            plan = self._plans.get(epoch)
+            plan = self._plans.get((epoch, kind))
             return dict(plan) if plan is not None else None
 
     def clear(self) -> None:
@@ -278,6 +313,14 @@ class MembershipBoard:
         with self._lock:
             self._votes.clear()
             self._plans.clear()
+            self._plan_order.clear()
+
+    def _store_plan(self, key: tuple, plan: dict) -> None:
+        # caller holds self._lock
+        self._plans[key] = plan
+        self._plan_order.append(key)
+        while len(self._plan_order) > self._PLAN_CAP:
+            self._plans.pop(self._plan_order.pop(0), None)
 
     def post(self, epoch: int, evict: FrozenSet[int], rank: int,
              world: int,
@@ -297,12 +340,12 @@ class MembershipBoard:
         notify: List[tuple] = []
         plan = None
         with self._lock:
-            stand = self._plans.get(epoch)
+            stand = self._plans.get((epoch, "evict"))
             if stand is not None:
                 return dict(stand)
             if rank in evict or rank in excluded:
                 return None  # the condemned/evicted don't vote
-            votes = self._votes.setdefault((epoch, evict), set())
+            votes = self._votes.setdefault((epoch, "evict", evict), set())
             fresh = rank not in votes
             votes.add(rank)
             survivors = world - len(excluded | evict)
@@ -317,11 +360,85 @@ class MembershipBoard:
                     "survivors": survivors,
                     "basis": "board",
                 }
-                self._plans[epoch] = plan
+                self._store_plan((epoch, "evict"), plan)
                 notify.append(("confirmed", dict(plan)))
             elif fresh:
                 notify.append(("propose", {
                     "epoch": epoch, "evict": sorted(evict),
+                    "votes": sorted(votes), "world": world,
+                }))
+        for kind, payload in notify:
+            for fn in listeners:
+                try:
+                    fn(dict(payload, type=kind))
+                except Exception:  # a listener must never fail the vote
+                    pass
+        return dict(plan) if plan is not None else None
+
+    def petition(self, admit: FrozenSet[int], world: int) -> None:
+        """The candidate's JOIN petition: NOT a vote — a listener event
+        (type ``join_petition``) the elastic members answer by
+        seconding (:meth:`post_join`).  A petition is idempotent and
+        retryable; the candidate learns the outcome from the confirmed
+        plan's listener event."""
+        admit = frozenset(int(r) for r in admit)
+        with self._lock:
+            listeners = list(self._listeners)
+        payload = {"admit": sorted(admit), "world": world}
+        for fn in listeners:
+            try:
+                fn(dict(payload, type="join_petition"))
+            except Exception:  # a listener must never fail the petition
+                pass
+
+    def post_join(self, epoch: int, admit: FrozenSet[int], rank: int,
+                  world: int, excluded: FrozenSet[int] = frozenset(),
+                  handoff: Optional[dict] = None) -> Optional[dict]:
+        """One member's vote for ADMITTING ``admit`` (world sessions)
+        at membership ``epoch`` — the grow mirror of :meth:`post`.  The
+        candidate itself never votes (it petitions; the group decides);
+        ``excluded`` is the voter's cumulative evicted set and the
+        strict majority is over the CURRENT members (world minus
+        excluded — the admitted are joining, not leaving, so they don't
+        shrink the base).  The confirming voter's ``handoff`` (the
+        warm-start artifacts its facade exported) rides the plan to the
+        candidate, and ``excluded_after`` carries the post-join
+        cumulative eviction record the candidate aligns to.  Returns
+        the confirmed plan once the majority voted; notifies listeners
+        OUTSIDE the board lock, like :meth:`post`."""
+        admit = frozenset(int(r) for r in admit)
+        excluded = frozenset(int(r) for r in excluded)
+        notify: List[tuple] = []
+        plan = None
+        with self._lock:
+            stand = self._plans.get((epoch, "join"))
+            if stand is not None:
+                return dict(stand)
+            if rank in admit or rank in excluded:
+                return None  # the candidate (and the evicted) don't vote
+            votes = self._votes.setdefault((epoch, "join", admit), set())
+            fresh = rank not in votes
+            votes.add(rank)
+            members = world - len(excluded)
+            listeners = list(self._listeners)
+            if len(votes) * 2 > members:
+                plan = {
+                    "kind": "join",
+                    "epoch": epoch,
+                    "admit": sorted(admit),
+                    "votes": sorted(votes),
+                    "world": world,
+                    "survivors": members,
+                    "excluded_after": sorted(excluded - admit),
+                    "basis": "board",
+                }
+                if handoff:
+                    plan["handoff"] = handoff
+                self._store_plan((epoch, "join"), plan)
+                notify.append(("confirmed", dict(plan)))
+            elif fresh:
+                notify.append(("join_propose", {
+                    "epoch": epoch, "admit": sorted(admit),
                     "votes": sorted(votes), "world": world,
                 }))
         for kind, payload in notify:
@@ -502,6 +619,17 @@ class MembershipView:
         self.proposals = 0
         self.evictions_total = 0
         self.restores_total = 0
+        # join (grow) agreement state for the CURRENT epoch
+        self._join_votes: Dict[FrozenSet[int], Set[int]] = {}
+        self._own_join: Optional[FrozenSet[int]] = None
+        self._last_join: Optional[dict] = None  # latest APPLIED join
+        self.joins_total = 0
+        self.petitions = 0
+        # warm handoff: the facade's artifact exporter (contract
+        # generation + digest baselines, tuning plan, plan verdicts) —
+        # called by the vote that confirms an admission, so the
+        # artifacts ride the plan to the candidate
+        self.handoff_fn: Optional[Callable[[], dict]] = None
         self._listeners: List[Callable[[dict], None]] = []
         if board is not None:
             board.add_listener(self._on_board_event)
@@ -560,6 +688,34 @@ class MembershipView:
         self._broadcast("propose", epoch, evict)
         return None
 
+    def petition_join(self) -> None:
+        """The candidate's end of the GROW agreement (phase 1): ask the
+        group to admit this session.  Clears any stale pending state
+        from the previous life first (the eviction plan the condemned
+        rank adopted but never applied would otherwise block the
+        admission confirm from landing); the admission confirms via the
+        normal plan surface (``wait_confirmed`` → ``take_cutover``).
+        Idempotent and retryable — a petition that races an in-flight
+        eviction agreement is simply ignored by busy members."""
+        with self._lock:
+            self._plan = None
+            self._votes.clear()
+            self._join_votes.clear()
+            self._own_vote = None
+            self._own_join = None
+            self._announced = False
+            self._confirmed.clear()
+            self.petitions += 1
+        admit = frozenset({self.rank})
+        if self.board is not None:
+            self.board.petition(admit, self.world)
+            return
+        self._send_frames({
+            "phase": "join_petition",
+            "admit": sorted(admit),
+            "src_session": self.rank,
+        }, exclude=set())
+
     def _vote(self, epoch: int, evict: FrozenSet[int], rank: int,
               reason: str = "", evidence: Optional[dict] = None
               ) -> Optional[dict]:
@@ -599,8 +755,62 @@ class MembershipView:
         self._adopt_plan(plan, reason, evidence)
         return plan
 
+    def _vote_join(self, epoch: int, admit: FrozenSet[int],
+                   rank: int) -> Optional[dict]:
+        """Register one ADMISSION vote (board post or local wire tally)
+        and adopt the join plan if it confirms.  The voter's handoff
+        artifacts ride the board post (the confirming vote's land in
+        the plan); on wire tiers the handoff attaches to the confirm
+        broadcast instead."""
+        if self.board is not None:
+            with self._lock:
+                excluded = frozenset(self.evicted)
+            handoff = None
+            if rank == self.rank and self.handoff_fn is not None:
+                try:
+                    handoff = self.handoff_fn()
+                except Exception:  # an exporter must never fail the vote
+                    handoff = None
+            plan = self.board.post_join(
+                epoch, admit, rank, self.world,
+                excluded=excluded, handoff=handoff,
+            )
+            if plan is not None:
+                self._adopt_plan(plan)
+            return plan
+        with self._lock:
+            if self._plan is not None:
+                if self._plan.get("kind") == "join":
+                    return dict(self._plan)
+                return None
+            if (
+                epoch != self.epoch or rank in admit
+                or rank in self.evicted  # the evicted don't vote
+            ):
+                return None
+            votes = self._join_votes.setdefault(admit, set())
+            votes.add(rank)
+            members = self.world - len(self.evicted)
+            if len(votes) * 2 <= members:
+                return None
+            plan = {
+                "kind": "join",
+                "epoch": epoch,
+                "admit": sorted(admit),
+                "votes": sorted(votes),
+                "world": self.world,
+                "survivors": members,
+                "excluded_after": sorted(self.evicted - admit),
+                "basis": "wire",
+            }
+        self._adopt_plan(plan)
+        return plan
+
     def _adopt_plan(self, plan: dict, reason: str = "",
                     evidence: Optional[dict] = None) -> None:
+        if plan.get("kind") == "join":
+            self._adopt_join(plan)
+            return
         announce = False
         with self._lock:
             if self._plan is not None or plan.get("epoch") != self.epoch:
@@ -642,13 +852,70 @@ class MembershipView:
         except Exception:  # a dead peer mid-broadcast: nothing to tell
             pass
 
+    def _send_frames(self, payload: dict, exclude: Set[int]) -> None:
+        """Raw MEMBER frames to the world peers minus ``exclude`` —
+        the join phases' exchange (which, unlike evictions, must REACH
+        sessions currently outside the shrunk group: the candidate).
+        Board tiers skip, like :meth:`_broadcast`."""
+        if self._send is None or self.board is not None:
+            return
+        try:
+            self._send(payload, set(exclude))
+        except Exception:  # a dead peer mid-broadcast: nothing to tell
+            pass
+
+    def _adopt_join(self, plan: dict) -> None:
+        """Adopt a confirmed JOIN plan.  Members require the plan at
+        their current epoch (the evict discipline); the candidate — by
+        definition desynced, it missed every epoch bump since its
+        previous life — accepts any join covering it that is not older
+        than its own record."""
+        candidate = self.rank in set(plan.get("admit") or ())
+        announce = False
+        with self._lock:
+            if self._plan is not None:
+                return
+            epoch = plan.get("epoch", -1)
+            if candidate:
+                if not isinstance(epoch, int) or epoch < self.epoch:
+                    return  # a previous life's admission: stale
+            elif epoch != self.epoch:
+                return
+            self._plan = dict(plan)
+            self._confirmed.set()
+            announce = not self._announced and not candidate
+            self._announced = True
+        if announce:
+            self._broadcast_join_confirm(plan)
+        self._notify(dict(plan, type="confirmed"))
+
+    def _broadcast_join_confirm(self, plan: dict) -> None:
+        """Wire-tier confirm for a JOIN: the announcing member attaches
+        its warm-handoff artifacts so the candidate can align its
+        contract stream before its first collective."""
+        if self._send is None or self.board is not None:
+            return
+        payload = dict(plan)
+        if "handoff" not in payload and self.handoff_fn is not None:
+            try:
+                payload["handoff"] = self.handoff_fn()
+            except Exception:  # an exporter must never fail the confirm
+                pass
+        payload["phase"] = "join_confirm"
+        payload["src_session"] = self.rank
+        admit = set(plan.get("admit") or ())
+        self._send_frames(payload, exclude=set(self.evicted) - admit)
+
     def observe_wire(self, payload: dict, src: int = -1) -> None:
         """A peer's MEMBER frame (fabric delivery thread).  Elastic
         handles *second* proposals they cannot refute (phase 2 of the
         agreement); confirmed frames carry the full vote set and are
         adopted directly once the majority checks out locally."""
+        phase = payload.get("phase")
+        if phase in ("join_petition", "join_propose", "join_confirm"):
+            self._observe_join_wire(phase, payload, src)
+            return
         try:
-            phase = payload.get("phase")
             epoch = int(payload.get("epoch", -1))
             evict = frozenset(int(r) for r in payload.get("evict") or ())
             voter = int(payload.get("src_session", src))
@@ -691,13 +958,140 @@ class MembershipView:
             self._broadcast("confirm" if self.confirmed() else "propose",
                             epoch, evict)
 
+    def _observe_join_wire(self, phase: str, payload: dict,
+                           src: int) -> None:
+        """The GROW agreement's wire phases.  ``join_petition`` (from
+        the candidate): elastic members not mid-agreement second it at
+        their current epoch and re-broadcast; a member that ALREADY
+        applied an admission covering the candidate re-sends the
+        confirm (a lost-confirm retry must converge, not re-vote).
+        ``join_propose`` (member→member): tally the voter, second if
+        fresh.  ``join_confirm``: adopt — the candidate from any epoch
+        not older than its own record, members at their current one."""
+        try:
+            admit = frozenset(int(r) for r in payload.get("admit") or ())
+            voter = int(payload.get("src_session", src))
+        except (TypeError, ValueError):
+            return
+        if not admit:
+            return
+        if self.rank in admit:
+            # frames about OUR OWN admission: only the confirm matters
+            if phase == "join_confirm":
+                plan = {
+                    k: v for k, v in payload.items()
+                    if k not in ("phase", "src_session")
+                }
+                plan.setdefault("kind", "join")
+                plan.setdefault("basis", "wire")
+                self._adopt_plan(plan)
+            return
+        if not self.elastic:
+            return
+        with self._lock:
+            if self.self_evicted or self.rank in self.evicted:
+                return
+            busy = self._plan is not None or self._own_vote is not None
+            applied = (
+                dict(self._last_join)
+                if self._last_join is not None else None
+            )
+        if phase == "join_petition":
+            if (
+                applied is not None
+                and admit <= set(applied.get("admit") or ())
+                and applied.get("applied_epoch", 0) >= self.epoch
+            ):
+                # already admitted; the candidate missed the confirm
+                resend = dict(applied)
+                resend.pop("applied_epoch", None)
+                resend["phase"] = "join_confirm"
+                resend["src_session"] = self.rank
+                self._send_frames(resend, exclude=set(self.evicted) - admit)
+                return
+            if busy:
+                return  # an agreement is in flight; the candidate retries
+            with self._lock:
+                if self._own_join is None:
+                    self._own_join = admit
+                vote_self = self._own_join == admit
+                epoch = self.epoch
+            if not vote_self:
+                return  # already seconding a different admission
+            self._send_frames({
+                "phase": "join_propose", "epoch": epoch,
+                "admit": sorted(admit), "src_session": self.rank,
+            }, exclude=set(self.evicted) - admit)
+            self._vote_join(epoch, admit, self.rank)
+            return
+        try:
+            epoch = int(payload.get("epoch", -1))
+        except (TypeError, ValueError):
+            return
+        if phase == "join_propose":
+            if epoch != self.epoch:
+                return
+            self._vote_join(epoch, admit, voter)
+            second = False
+            vote_self = False
+            with self._lock:
+                if self._plan is None and self._own_vote is None:
+                    if self._own_join is None:
+                        self._own_join = admit
+                        second = True
+                    vote_self = self._own_join == admit
+            if second:
+                self._send_frames({
+                    "phase": "join_propose", "epoch": epoch,
+                    "admit": sorted(admit), "src_session": self.rank,
+                }, exclude=set(self.evicted) - admit)
+            if vote_self:
+                self._vote_join(epoch, admit, self.rank)
+            return
+        if phase == "join_confirm":
+            plan = {
+                k: v for k, v in payload.items()
+                if k not in ("phase", "src_session")
+            }
+            plan.setdefault("kind", "join")
+            plan.setdefault("basis", "wire")
+            # tally the aggregated votes so local state agrees, then
+            # adopt (epoch-checked inside)
+            try:
+                voters = {int(v) for v in payload.get("votes") or ()}
+            except (TypeError, ValueError):
+                voters = set()
+            for v in sorted((voters | {voter}) - admit):
+                self._vote_join(epoch, admit, v)
+            self._adopt_plan(plan)
+
     def _on_board_event(self, event: dict) -> None:
-        """Board listener: adopt confirmations; second proposals (the
-        elastic handles' phase-2 vote)."""
+        """Board listener: adopt confirmations; second proposals and
+        join petitions (the elastic handles' phase-2 vote)."""
         if event.get("type") == "confirmed":
             self._adopt_plan({k: v for k, v in event.items() if k != "type"})
             return
-        if not self.elastic or event.get("type") != "propose":
+        if not self.elastic:
+            return
+        if event.get("type") == "join_petition":
+            try:
+                admit = frozenset(
+                    int(r) for r in event.get("admit") or ()
+                )
+            except (TypeError, ValueError):
+                return
+            if not admit or self.rank in admit:
+                return
+            with self._lock:
+                if (
+                    self.self_evicted or self.rank in self.evicted
+                    or self._plan is not None
+                    or self._own_vote is not None
+                ):
+                    return
+            self._vote_join(self.epoch, admit, self.rank)
+            return
+        if event.get("type") != "propose":
             return
         try:
             epoch = int(event.get("epoch", -1))
@@ -748,7 +1142,11 @@ class MembershipView:
         with self._lock:
             if session in self.evicted:
                 return True
-            return self._plan is not None and session in self._plan["evict"]
+            return (
+                self._plan is not None
+                and self._plan.get("kind", "evict") == "evict"
+                and session in self._plan["evict"]
+            )
 
     def evidence(self) -> dict:
         """The agreement evidence attached to RANK_EVICTED errors."""
@@ -763,19 +1161,43 @@ class MembershipView:
     # -- cutover / restore ----------------------------------------------------
     def take_cutover(self) -> Optional[dict]:
         """Atomically consume the confirmed plan: bump the membership
-        epoch, fold the eviction set into the cumulative record, reset
-        the agreement state for the new epoch.  Exactly one non-None
-        return per confirmed plan per view — the facade applies the
-        communicator surgery on it."""
+        epoch, fold the eviction/admission set into the cumulative
+        record, reset the agreement state for the new epoch.  Exactly
+        one non-None return per confirmed plan per view — the facade
+        applies the communicator surgery on it.  For a JOIN plan the
+        admitted side ALIGNS instead of bumping: its epoch becomes the
+        group's post-join epoch and its cumulative eviction record
+        becomes the plan's ``excluded_after`` (it missed every bump
+        since its previous life)."""
         with self._lock:
             plan = self._plan
             if plan is None:
                 return None
             self._plan = None
             self._votes.clear()
+            self._join_votes.clear()
             self._own_vote = None
+            self._own_join = None
             self._announced = False
             self._confirmed.clear()
+            if plan.get("kind") == "join":
+                admit = set(int(r) for r in plan.get("admit") or ())
+                if self.rank in admit:
+                    self.epoch = int(plan.get("epoch", self.epoch)) + 1
+                    self.evicted = set(
+                        int(r) for r in plan.get("excluded_after") or ()
+                    )
+                    self.self_evicted = False
+                else:
+                    self.epoch += 1
+                    self.evicted -= admit
+                self.joins_total += 1
+                record = dict(plan, applied_epoch=self.epoch)
+                self._last_join = dict(record)
+                self.history.append(record)
+                if len(self.history) > _HISTORY_CAP:
+                    self.history.pop(0)
+                return dict(record)
             self.epoch += 1
             self.evicted |= set(plan["evict"])
             if self.rank in self.evicted:
@@ -813,7 +1235,10 @@ class MembershipView:
             self.self_evicted = False
             self._plan = None
             self._votes.clear()
+            self._join_votes.clear()
             self._own_vote = None
+            self._own_join = None
+            self._last_join = None
             self._announced = False
             self._confirmed.clear()
             self.epoch = 0
@@ -849,6 +1274,27 @@ class MembershipView:
             return []
         return self.ledger.demoted(comm_id)
 
+    # -- admission ------------------------------------------------------------
+    @spmd_uniform
+    def join_decision(self) -> dict:
+        """The latched admission-decision surface: the latest APPLIED
+        join record — majority-confirmed and cutover-applied, so every
+        member reads the same record (the ``demote_decision``
+        discipline applied to admission; never derived from local
+        observation).  The stock record when the group never grew."""
+        with self._lock:
+            if self._last_join is None:
+                return {
+                    "epoch": self.epoch, "admitted": [],
+                    "world": self.world, "joins_total": 0,
+                }
+            return {
+                "epoch": self._last_join.get("applied_epoch", self.epoch),
+                "admitted": list(self._last_join.get("admit") or ()),
+                "world": self._last_join.get("world", self.world),
+                "joins_total": self.joins_total,
+            }
+
     # -- telemetry ------------------------------------------------------------
     def snapshot(self) -> dict:
         with self._lock:
@@ -862,8 +1308,14 @@ class MembershipView:
                     dict(self._plan) if self._plan is not None else None
                 ),
                 "proposals": self.proposals,
+                "petitions": self.petitions,
                 "evictions_total": self.evictions_total,
+                "joins_total": self.joins_total,
                 "restores_total": self.restores_total,
+                "last_join": (
+                    dict(self._last_join)
+                    if self._last_join is not None else None
+                ),
                 "history": [dict(h) for h in self.history],
                 "exchange": "board" if self.board is not None else "wire",
             }

@@ -82,6 +82,20 @@ Env knobs:
 * ``ACCL_STRAGGLER_MIN_US``       — absolute lag floor (default 200.0)
 * ``ACCL_STRAGGLER_WINDOWS``      — consecutive windows to convict (2)
 * ``ACCL_ANOMALY_FACTOR``         — latency regression factor (4.0)
+* ``ACCL_SCALE_GROW_P99_US``      — tenant p99 high-water for a *grow*
+  recommendation (default 50000.0)
+* ``ACCL_SCALE_SHRINK_P99_US``    — tenant p99 low-water for a *shrink*
+  recommendation (default 1000.0)
+
+Traffic-aware scale advice (:class:`ScaleAdvisor`) closes the loop from
+the QoS arbiter's per-tenant latency histograms to the elastic
+membership plane — advisory only, the ``suspect_slow`` annotation
+discipline: a sustained p99 tail or queue backlog on guaranteed-class
+tenants yields a ``grow`` recommendation, a uniformly idle tail yields
+``shrink``, and the verdict surfaces in
+``telemetry_snapshot()["membership"]["scale_advice"]`` and the
+``/membership`` route.  Nothing ever acts on it automatically —
+``join_rank``/``evict_rank`` are the operator's calls.
 """
 
 from __future__ import annotations
@@ -100,6 +114,7 @@ __all__ = [
     "BlackBox",
     "Monitor",
     "MonitorServer",
+    "ScaleAdvisor",
     "SkewJudge",
     "SkewTracker",
     "TraceStreamWriter",
@@ -125,6 +140,14 @@ DEFAULT_ANOMALY_FACTOR = 4.0
 ANOMALY_WARMUP = 16
 ANOMALY_ALPHA = 0.1
 EWMA_ALPHA = 0.5
+
+SCALE_GROW_ENV = "ACCL_SCALE_GROW_P99_US"
+SCALE_SHRINK_ENV = "ACCL_SCALE_SHRINK_P99_US"
+DEFAULT_SCALE_GROW_P99_US = 50_000.0
+DEFAULT_SCALE_SHRINK_P99_US = 1_000.0
+#: completed calls a tenant needs before its tail counts (a two-sample
+#: histogram's p99 is noise, not pressure)
+SCALE_MIN_SAMPLES = 32
 
 #: skew windows / judged markers retained per communicator (a peer far
 #: ahead/behind must still find its comparison point — the contract
@@ -944,6 +967,127 @@ class AnomalyWatchdog:
 
 
 # ---------------------------------------------------------------------------
+# traffic-aware scale advice
+# ---------------------------------------------------------------------------
+
+
+class ScaleAdvisor:
+    """Advisory grow/shrink recommendations from the QoS arbiter's
+    per-tenant latency histograms.
+
+    A pure, deterministic function of the arbiter snapshot — no clocks,
+    no randomness, no internal traffic state — so the same tenant
+    pressure always yields the same advice (the chaos soaks assert
+    this).  The verdict NEVER acts (the ``suspect_slow`` annotation
+    discipline): it is surfaced through ``telemetry_snapshot()
+    ["membership"]["scale_advice"]`` and the ``/membership`` route, and
+    the operator decides whether to call ``join_rank``/``evict_rank``.
+
+    Rules, in precedence order:
+
+    * **grow** — any tenant with ≥ :data:`SCALE_MIN_SAMPLES` completed
+      calls whose p99 exceeds the high-water mark, or whose queue
+      backlog exceeds its own outstanding-window limit (grant starvation
+      is tail pressure even before the histogram shows it).
+    * **shrink** — every sampled tenant rides below the low-water p99
+      with empty queues, and at least one tenant has samples (an idle
+      fabric is not evidence).
+    * **hold** — anything else, including no data at all.
+    """
+
+    def __init__(
+        self,
+        grow_p99_us: Optional[float] = None,
+        shrink_p99_us: Optional[float] = None,
+    ):
+        self.grow_p99_us = float(
+            grow_p99_us
+            if grow_p99_us is not None
+            else os.environ.get(SCALE_GROW_ENV, DEFAULT_SCALE_GROW_P99_US)
+        )
+        self.shrink_p99_us = float(
+            shrink_p99_us
+            if shrink_p99_us is not None
+            else os.environ.get(
+                SCALE_SHRINK_ENV, DEFAULT_SCALE_SHRINK_P99_US
+            )
+        )
+        self.advisories = 0
+        self._last: Optional[dict] = None
+        self._lock = threading.Lock()
+
+    def advise(self, arbiter_snapshot: Optional[dict], world: int) -> dict:
+        """One advisory pass over ``QosArbiter.snapshot()`` output.
+        Tolerates a disarmed/absent arbiter (→ hold, reason given)."""
+        tenants = (arbiter_snapshot or {}).get("tenants") or {}
+        hot: List[dict] = []
+        sampled = 0
+        idle = True
+        for cid in sorted(tenants, key=str):
+            t = tenants[cid] or {}
+            lat = t.get("latency") or {}
+            p99 = lat.get("p99_us")
+            samples = int(lat.get("count") or 0)
+            queued = int(t.get("queued") or 0)
+            limit = int(t.get("outstanding_limit") or 0)
+            backlogged = limit > 0 and queued > limit
+            if samples >= SCALE_MIN_SAMPLES:
+                sampled += 1
+                if p99 is not None and p99 > self.grow_p99_us:
+                    hot.append({
+                        "tenant": str(cid),
+                        "class": t.get("class"),
+                        "p99_us": p99,
+                        "reason": "p99_over_high_water",
+                    })
+                    idle = False
+                elif p99 is not None and p99 > self.shrink_p99_us:
+                    idle = False
+            if backlogged:
+                hot.append({
+                    "tenant": str(cid),
+                    "class": t.get("class"),
+                    "queued": queued,
+                    "outstanding_limit": limit,
+                    "reason": "queue_backlog",
+                })
+                idle = False
+        if hot:
+            rec, why = "grow", "tail_pressure"
+        elif sampled and idle:
+            rec, why = "shrink", "idle_tail"
+        else:
+            rec, why = "hold", (
+                "insufficient_data" if not sampled else "within_band"
+            )
+        advice = {
+            "recommendation": rec,
+            "reason": why,
+            "world": int(world),
+            "hot_tenants": hot,
+            "tenants_sampled": sampled,
+            "grow_p99_us": self.grow_p99_us,
+            "shrink_p99_us": self.shrink_p99_us,
+            "advisory_only": True,
+        }
+        with self._lock:
+            self.advisories += 1
+            self._last = advice
+        return advice
+
+    def last(self) -> Optional[dict]:
+        with self._lock:
+            return dict(self._last) if self._last is not None else None
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "advisories": self.advisories,
+                "last": dict(self._last) if self._last else None,
+            }
+
+
+# ---------------------------------------------------------------------------
 # the scrape service
 # ---------------------------------------------------------------------------
 
@@ -1198,6 +1342,7 @@ class Monitor:
             rank, world, judge=judge_for(anchor, world)
         )
         self.watchdog = AnomalyWatchdog()
+        self.scale = ScaleAdvisor()
         self.server: Optional[MonitorServer] = None
         self.stream: Optional[TraceStreamWriter] = None
         telemetry.add_observer(self._observe)
@@ -1240,6 +1385,14 @@ class Monitor:
 
     def slow_ranks(self, comm_id: int) -> List[int]:
         return self.tracker.judge.slow_ranks(comm_id)
+
+    def scale_advice(
+        self, arbiter_snapshot: Optional[dict], world: int
+    ) -> dict:
+        """One :class:`ScaleAdvisor` pass (advisory only — see the
+        class docstring); the result is also retained for the snapshot
+        surface."""
+        return self.scale.advise(arbiter_snapshot, world)
 
     def reset(self) -> None:
         """soft_reset recovery: clear skew accumulators, baselines and

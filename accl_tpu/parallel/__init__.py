@@ -17,6 +17,7 @@ from .zero import (  # noqa: F401
     schedule_lr,
     init_zero_state,
     make_zero_train_step,
+    reshard_plan,
     zero_adam_update,
     zero_state_specs,
 )

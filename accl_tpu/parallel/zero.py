@@ -124,6 +124,45 @@ def _dp_slice(x, dp: int, idx):
     )
 
 
+def reshard_plan(n: int, old_dp: int, new_dp: int) -> list:
+    """Incremental ZeRO shard-ownership migration plan for an elastic
+    membership cutover (``join_rank``/``evict_rank`` changed the dp
+    world).  Pure integer math over the ``_dp_slice`` layout rule — no
+    jax, no mesh — so every member derives the identical plan from the
+    agreed (old_dp, new_dp) pair with zero wire bytes, the
+    ``Communicator.grow`` slot-ordering discipline.
+
+    Returns one entry per NEW dp rank: ``{"rank", "begin", "end",
+    "fetch": [{"src", "begin", "end"}, ...]}`` where ``fetch`` lists
+    the logical index ranges (within [0, n)) the rank must pull from
+    each OLD owner whose slice overlaps its new one; a range whose old
+    owner IS the rank itself is omitted — already local, nothing moves.
+    That makes the migration incremental by construction: each fetch
+    range is an independent bucket the facade schedules behind its own
+    drain point, not a global stop-the-world re-slice."""
+    n = int(n)
+    old_dp, new_dp = int(old_dp), int(new_dp)
+    if n < 0 or old_dp < 1 or new_dp < 1:
+        raise ValueError("reshard_plan needs n >= 0 and dp sizes >= 1")
+    old_shard = _padded(n, old_dp) // old_dp
+    new_shard = _padded(n, new_dp) // new_dp
+    plan = []
+    for j in range(new_dp):
+        begin = min(j * new_shard, n)
+        end = min(begin + new_shard, n)
+        fetch = []
+        i = begin
+        while i < end:
+            src = min(i // old_shard, old_dp - 1) if old_shard else 0
+            seg_end = min(end, (src + 1) * old_shard) if old_shard else end
+            if src != j:
+                fetch.append({"src": src, "begin": i, "end": seg_end})
+            i = seg_end
+        plan.append({"rank": j, "begin": begin, "end": end,
+                     "fetch": fetch})
+    return plan
+
+
 def _spec_axes(spec) -> tuple:
     """Mesh axes a PartitionSpec shards over, flattened in order."""
     axes = []

@@ -139,6 +139,10 @@ class PlanCache:
         self.misses = 0
         self.invalidations = 0
         self.last_invalidation: Optional[str] = None
+        # warm-handoff bookkeeping (elastic expansion): the verdict
+        # digest adopted at admission, kept for introspection only
+        self._handoff_seed: list = []
+        self.handoffs_adopted = 0
         # companion-state invalidation hooks: state that lives BESIDE
         # the plan cache with the plan cache's lifecycle (the error-
         # feedback residual store) registers here so every invalidation
@@ -191,6 +195,35 @@ class PlanCache:
             except Exception:  # pragma: no cover - must not fail config
                 pass
 
+    # -- warm handoff (elastic expansion) ------------------------------------
+    def export_verdicts(self, limit: int = 32) -> list:
+        """The tuned-verdict digest a JOIN handoff carries: the cached
+        plans' ``describe()`` dicts (bounded, deterministic order).
+        Plans embed engine state (cmdring slots, buffer geometry) that
+        does NOT transfer — the admitted rank rebuilds its own plans —
+        so this is *seed context*, not a cache transplant: the verdicts
+        tell the joiner what wire/eager/pipeline decisions its first
+        window will meet, keeping it contract-conformant without a
+        warm-up divergence."""
+        with self._lock:
+            plans = [
+                self._plans[k].describe()
+                for k in sorted(self._plans, key=repr)
+            ]
+        return plans[: max(0, int(limit))]
+
+    def adopt_verdicts(self, verdicts) -> int:
+        """Record a handoff's verdict digest (the admitted rank's side).
+        Nothing is installed into the cache — keys embed live engine
+        state — but the seed is retained for introspection and counted,
+        so tests and the snapshot can assert the warm handoff actually
+        rode the admission."""
+        seed = [dict(v) for v in (verdicts or []) if isinstance(v, dict)]
+        with self._lock:
+            self._handoff_seed = seed
+            self.handoffs_adopted += 1
+        return len(seed)
+
     # -- introspection -------------------------------------------------------
     def __len__(self) -> int:
         with self._lock:
@@ -207,4 +240,6 @@ class PlanCache:
                 "size": len(self._plans),
                 "invalidations": self.invalidations,
                 "last_invalidation": self.last_invalidation,
+                "handoffs_adopted": self.handoffs_adopted,
+                "handoff_seed_verdicts": len(self._handoff_seed),
             }

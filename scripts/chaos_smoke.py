@@ -2,10 +2,14 @@
 (jax-free) emulator tiers — kill a rank under a seeded FaultPlan,
 assert the surviving majority agrees and shrinks within a bounded
 deadline, serves bit-correct at the new world size, and soft_reset
-restores full membership.  Runs the cycle on BOTH transports (InProc
-board agreement, Socket MEMBER-frame agreement) plus the membership
-units.  Needs numpy only — the same footprint as the monitor/ring
-smokes it runs next to (.github/workflows/analysis.yml).
+restores full membership.  A second leg runs the EXPANSION direction:
+after the heal the victim petitions back in via join_rank, every
+member cuts over (reshard: fresh comm epochs, __join__ digest marker,
+warm handoff) and the group serves bit-correct at the full world
+again.  Both legs run on BOTH transports (InProc board agreement,
+Socket MEMBER-frame agreement) plus the membership units.  Needs
+numpy only — the same footprint as the monitor/ring smokes it runs
+next to (.github/workflows/analysis.yml).
 
 Usage::
 
@@ -124,6 +128,69 @@ def cycle(group, injectors, world, victim, label):
     assert "accl_membership_epoch" in group[0].telemetry_prometheus()
 
 
+def join_leg(group, injectors, world, victim, label):
+    """kill -> shrink -> serve -> heal -> join_rank -> reshard -> serve:
+    the GROW direction of the elastic cycle."""
+    survivors = [a for i, a in enumerate(group) if i != victim]
+
+    def doomed(a, r):
+        s = a.create_buffer_from(np.full(64, r + 1.0, np.float32))
+        d = a.create_buffer(64, np.float32)
+        try:
+            a.allreduce(s, d, 64)
+            return "ok"
+        except ACCLError as e:
+            return int(e.code)
+
+    failed = run_parallel(survivors, doomed, timeout=30.0)
+    assert all(c & int(ErrorCode.RANK_EVICTED) for c in failed), failed
+
+    expected = float(sum(i + 1 for i in range(world) if i != victim))
+
+    def serve(a, r):
+        s = a.create_buffer_from(np.full(64, r + 1.0, np.float32))
+        d = a.create_buffer(64, np.float32)
+        a.allreduce(s, d, 64)
+        d.sync_from_device()
+        return float(d.data[0])
+
+    assert run_parallel(survivors, serve, timeout=30.0) == \
+        [expected] * len(survivors)
+    print(f"[{label}] shrink + serve at world {world - 1} (join leg)")
+
+    for inj in injectors:
+        if inj is not None:
+            inj.clear()
+    for a in group:
+        a.set_timeout(10.0)
+
+    def rejoin(a, r):
+        if r == victim:
+            plan = a.join_rank(timeout=20.0)
+            assert plan is not None and plan.get("kind") == "join", plan
+        else:
+            deadline = time.monotonic() + 20.0
+            mv = a._membership
+            while time.monotonic() < deadline:
+                if mv.cutover_ready() or mv.joins_total:
+                    break
+                time.sleep(0.02)
+            else:
+                raise AssertionError(f"rank {r}: join confirm never came")
+        return serve(a, r)
+
+    total = float(sum(i + 1 for i in range(world)))
+    t0 = time.monotonic()
+    assert run_parallel(group, rejoin, timeout=60.0) == [total] * world
+    assert [a.size for a in group] == [world] * world
+    snap = group[0].telemetry_snapshot()["membership"]
+    assert snap["joins_total"] == 1 and snap["evicted"] == []
+    assert snap["scale_advice"] is not None  # advisory surface is live
+    assert "accl_membership_joins_total" in group[0].telemetry_prometheus()
+    print(f"[{label}] join_rank resharded back to world {world} in "
+          f"{time.monotonic() - t0:.2f}s and served bit-correct")
+
+
 def units():
     brk = CircuitBreaker(threshold=2, cooldown_s=5.0, clock=lambda: 0.0)
     brk.record_failure("x")
@@ -134,7 +201,18 @@ def units():
     assert board.post(0, frozenset({3}), rank=2, world=4) is None
     plan = board.post(0, frozenset({3}), rank=0, world=4)
     assert plan is not None and plan["evict"] == [3]
-    print("[units] breaker + board agreement OK")
+    # the grow mirror: candidate petitions, members admit by majority
+    assert board.post_join(
+        1, frozenset({3}), rank=3, world=4, excluded=frozenset({3})
+    ) is None  # the candidate doesn't vote
+    assert board.post_join(
+        1, frozenset({3}), rank=0, world=4, excluded=frozenset({3})
+    ) is None
+    join = board.post_join(
+        1, frozenset({3}), rank=1, world=4, excluded=frozenset({3})
+    )
+    assert join is not None and join["admit"] == [3]
+    print("[units] breaker + board agreement (evict AND join) OK")
 
 
 def main() -> int:
@@ -170,6 +248,41 @@ def main() -> int:
             a.set_timeout(2.0)
         injectors = [a.engine.fabric.fault_injector for a in g]
         cycle(g, injectors, world=4, victim=3, label="socket")
+    finally:
+        for a in g:
+            a.deinit()
+
+    # the GROW direction: fresh groups, kill -> shrink -> join -> serve
+    g = emulated_group(4)
+    try:
+        for a in g:
+            a.set_elastic(True)
+            a.set_timeout(1.5)
+        inj = g[0].engine.fabric.install_fault_plan(kill_plan(3, seed=31))
+        join_leg(g, [inj], world=4, victim=3, label="inproc")
+    finally:
+        for a in g:
+            a.deinit()
+
+    os.environ[FAULT_PLAN_ENV] = kill_plan(3, seed=37).to_env()
+    ports, socks = [], []
+    for _ in range(4):
+        s = socketlib.socket()
+        s.setsockopt(socketlib.SOL_SOCKET, socketlib.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        socks.append(s)
+    for s in socks:
+        s.close()
+    addrs = [f"127.0.0.1:{p}" for p in ports]
+    g = [socket_group_member(i, addrs) for i in range(4)]
+    del os.environ[FAULT_PLAN_ENV]
+    try:
+        for a in g:
+            a.set_elastic(True)
+            a.set_timeout(2.0)
+        injectors = [a.engine.fabric.fault_injector for a in g]
+        join_leg(g, injectors, world=4, victim=3, label="socket")
     finally:
         for a in g:
             a.deinit()

@@ -816,6 +816,22 @@ BAD_SEQUENCES = [
         if health[2]["state"] == "ok":
             accl.allreduce(a, b, 64, comm=comm)
     """,
+    # elastic expansion: branching a collective on the RAW last_join
+    # record (snapshot arrival timing differs per rank around a
+    # cutover) instead of the latched join_decision accessor
+    """
+    def work(accl, comm):
+        snap = accl.telemetry_snapshot()
+        if snap["membership"]["last_join"]:
+            accl.barrier(comm=comm)
+    """,
+    # the candidate's per-rank self_evicted bit steering a contract
+    # field — survivors read False, the healing rank True
+    """
+    def work(accl, view):
+        root = 1 if view.self_evicted else 0
+        accl.bcast(buf, 64, root=root)
+    """,
 ]
 
 GOOD_SEQUENCES = [
@@ -879,6 +895,14 @@ GOOD_SEQUENCES = [
     def work(accl, comm, seq):
         d = view.demote_decision(comm.id, 4, seq, [], {})
         accl.bcast(buf, 64, root=d["root"])
+    """,
+    # join_decision is its admission mirror: majority-confirmed and
+    # cutover-applied, every member reads the same record — a
+    # sanitizer by construction
+    """
+    def work(accl, comm):
+        d = accl.join_decision()
+        accl.bcast(buf, 64, root=min(d["admitted"] or [0]))
     """,
 ]
 

@@ -676,3 +676,158 @@ def test_delay_fault_on_world3_socket_tier_completes(fault_plan,
             recv[r].sync_from_device()
     finally:
         _deinit(g)
+
+
+# ---------------------------------------------------------------------------
+# heal_after: bounded-duration damage (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+
+class _Msg:
+    """Minimal message stand-in for driving FaultInjector.on_send."""
+
+    def __init__(self, src, dst, comm_id=0, tag=0, msg_type="EAGER",
+                 seqn=0):
+        self.src = src
+        self.dst = dst
+        self.comm_id = comm_id
+        self.tag = tag
+        self.msg_type = msg_type
+        self.seqn = seqn
+
+
+def test_heal_after_validation():
+    """heal_after only applies to partition/drop rules and must be a
+    positive count."""
+    FaultRule(action="drop", src=0, heal_after=2)  # fine
+    FaultRule(action="partition", groups=[[0], [1]], heal_after=1)  # fine
+    with pytest.raises(ValueError):
+        FaultRule(action="delay", heal_after=2)
+    with pytest.raises(ValueError):
+        FaultRule(action="drop", src=0, heal_after=0)
+    # the knob round-trips the serialized plan
+    plan = FaultPlan(
+        rules=[FaultRule(action="drop", src=0, heal_after=3)], seed=5
+    )
+    again = FaultPlan.from_json(plan.to_json())
+    assert again.rules[0].heal_after == 3
+
+
+def test_partition_heals_after_occurrence_count():
+    """A partition with heal_after=3 drops exactly 3 crossing messages,
+    then removes its island and never fires again; same-island traffic
+    is never affected."""
+    from accl_tpu.faults import FaultInjector
+
+    plan = FaultPlan(rules=[FaultRule(
+        action="partition", groups=[[0, 1], [2]], nth=0, heal_after=3,
+    )], seed=9)
+    inj = FaultInjector(plan)
+    dropped = []
+    for i in range(6):
+        v = inj.on_send(_Msg(src=0, dst=2, seqn=i))
+        dropped.append(v.drop)
+    assert dropped == [True, True, True, False, False, False]
+    s = inj.stats()
+    assert s["healed"] == [True]
+    assert s["partitions"] == 0
+    assert s["by_action"]["healed"] == 1
+    # same-island traffic flowed throughout
+    assert not inj.on_send(_Msg(src=0, dst=1)).drop
+
+
+def test_drop_rule_heals_after_occurrence_count():
+    from accl_tpu.faults import FaultInjector
+
+    plan = FaultPlan(rules=[FaultRule(
+        action="drop", src=1, dst=0, heal_after=2,
+    )], seed=9)
+    inj = FaultInjector(plan)
+    out = [inj.on_send(_Msg(src=1, dst=0, seqn=i)).drop for i in range(5)]
+    assert out == [True, True, False, False, False]
+    assert inj.stats()["healed"] == [True]
+    # unrelated flows never matched
+    assert not inj.on_send(_Msg(src=0, dst=1)).drop
+
+
+def test_heal_after_is_deterministic():
+    """Counter-driven healing: the same plan against the same message
+    sequence heals at the same message, with an identical fault log —
+    what makes join-after-partition soaks replayable."""
+    from accl_tpu.faults import FaultInjector
+
+    plan = FaultPlan(rules=[
+        FaultRule(action="partition", groups=[[0, 1], [2, 3]], nth=0,
+                  heal_after=4),
+        FaultRule(action="drop", src=3, dst=0, tag=7, heal_after=2),
+    ], seed=21)
+    traffic = [
+        _Msg(src=s, dst=d, tag=t, seqn=i)
+        for i, (s, d, t) in enumerate(
+            [(0, 2, 0), (1, 3, 0), (3, 0, 7), (2, 0, 0), (3, 1, 0),
+             (3, 0, 7), (0, 3, 0), (1, 2, 0), (3, 0, 7), (0, 2, 0)]
+        )
+    ]
+
+    def run():
+        inj = FaultInjector(plan)
+        verdicts = [inj.on_send(m).drop for m in traffic]
+        return verdicts, list(inj.log), inj.stats()["healed"]
+
+    first = run()
+    second = run()
+    assert first == second
+    verdicts, log, healed = first
+    assert healed == [True, True]
+    heal_events = [e for e in log if e["action"] == "healed"]
+    assert len(heal_events) == 2
+    # after both heals, the remaining traffic flowed
+    assert verdicts[-1] is False
+
+
+def test_partition_heals_end_to_end_inproc():
+    """World 2 with a self-healing partition: the first collective's
+    dropped traffic burns down the heal counter and the island removes
+    ITSELF — no operator injector.clear().  The failed attempts leave
+    latched peer-health suspicion behind, which the documented
+    soft_reset lever clears; the retry then completes value-correct."""
+    g = emulated_group(2)
+    try:
+        for a in g:
+            a.set_timeout(1.0)
+        g[0].engine.fabric.install_fault_plan(FaultPlan(rules=[
+            FaultRule(action="partition", groups=[[0], [1]], nth=0,
+                      heal_after=2),
+        ], seed=13))
+        send = [a.create_buffer_from(np.full(16, r + 1.0, np.float32))
+                for r, a in enumerate(g)]
+        recv = [a.create_buffer(16, np.float32) for a in g]
+
+        def doomed(a, r):
+            try:
+                a.allreduce(send[r], recv[r], 16)
+                return None
+            except ACCLError as e:
+                return int(e.code)
+
+        # the partitioned attempt times out on both sides, but its
+        # dropped frames consumed the heal counter: the island is gone
+        assert all(c is not None for c in run_parallel(
+            g, doomed, timeout=30.0
+        ))
+        inj = g[0].engine.fabric.fault_injector
+        assert inj.stats()["healed"] == [True]
+        assert inj.stats()["partitions"] == 0
+
+        # clear the latched peer suspicion (collective) and serve —
+        # note: no injector.clear() anywhere in this test
+        run_parallel(g, lambda a, r: a.soft_reset(), timeout=30.0)
+
+        def work(a, r):
+            a.allreduce(send[r], recv[r], 16)
+            recv[r].sync_from_device()
+            return float(recv[r].data[0])
+
+        assert run_parallel(g, work, timeout=30.0) == [3.0, 3.0]
+    finally:
+        _deinit(g)

@@ -818,3 +818,656 @@ def test_kv_digest_exchange_tolerates_kv_failures():
     v.record("barrier", 1, None, 0, "0/0", 0)
     out = kv_digest_exchange(_DeadKV(), v, 1, 0, 2, state={})
     assert out["errors"] == 1 and out["posted"] == 0
+
+
+# ---------------------------------------------------------------------------
+# elastic EXPANSION (ISSUE 17): JOIN protocol units
+# ---------------------------------------------------------------------------
+
+
+def test_membership_board_join_petition_and_majority():
+    """A petition is an event, not a vote; a strict majority of the
+    CURRENT members admits; the candidate (and the evicted) never
+    vote; the confirming voter's handoff rides the plan."""
+    board = MembershipBoard()
+    events = []
+    board.add_listener(events.append)
+    board.petition(frozenset({3}), world=4)
+    assert events[-1]["type"] == "join_petition"
+    assert events[-1]["admit"] == [3]
+    # world 4, evicted {3}: members 3, majority needs 2
+    assert board.post_join(
+        1, frozenset({3}), rank=3, world=4, excluded=frozenset({3})
+    ) is None  # the candidate doesn't vote
+    assert board.post_join(
+        1, frozenset({3}), rank=0, world=4, excluded=frozenset({3})
+    ) is None
+    plan = board.post_join(
+        1, frozenset({3}), rank=1, world=4, excluded=frozenset({3}),
+        handoff={"trace_gen": 7},
+    )
+    assert plan is not None and plan["kind"] == "join"
+    assert plan["admit"] == [3] and sorted(plan["votes"]) == [0, 1]
+    assert plan["excluded_after"] == []  # the admitted leave the record
+    assert plan["handoff"] == {"trace_gen": 7}
+    assert [e["type"] for e in events[-2:]] == ["join_propose", "confirmed"]
+    # standing: later votes return the plan, not a new round
+    again = board.post_join(
+        1, frozenset({3}), rank=2, world=4, excluded=frozenset({3})
+    )
+    assert again["votes"] == plan["votes"]
+
+
+def _pump_frames(frames, views, rounds=8):
+    """Deliver queued wire frames until quiescent."""
+    for _ in range(rounds):
+        moved = False
+        for r in list(frames):
+            q, frames[r] = frames[r], []
+            for f in q:
+                moved = True
+                views[r].observe_wire(f)
+        if not moved:
+            return
+    raise AssertionError("wire agreement never went quiescent")
+
+
+def test_wire_join_agreement_three_phase():
+    """Wire-mode GROW agreement: the (evicted) candidate petitions, the
+    members second and confirm over MEMBER frames, and the cutover
+    ALIGNS the candidate's epoch with the survivors' bump."""
+    frames = {0: [], 1: [], 2: []}
+    views = {}
+
+    def send_for(me):
+        def send(payload, exclude):
+            for peer in (0, 1, 2):
+                if peer != me and peer not in exclude:
+                    frames[peer].append(dict(payload))
+        return send
+
+    for r in (0, 1, 2):
+        views[r] = MembershipView(rank=r, world=3, send_fn=send_for(r))
+        views[r].elastic = True
+    for r in (0, 1):  # survivors: rank 2 was evicted at epoch 0 -> 1
+        views[r].epoch = 1
+        views[r].evicted = {2}
+    views[2].self_evicted = True
+
+    views[2].petition_join()
+    _pump_frames(frames, views)
+    for r in (0, 1):
+        plan = views[r].confirmed()
+        assert plan is not None and plan["kind"] == "join", (r, plan)
+        assert plan["admit"] == [2] and sorted(plan["votes"]) == [0, 1]
+    cand = views[2].confirmed()
+    assert cand is not None and cand["kind"] == "join"
+    # cutover: survivors bump 1 -> 2, the candidate ALIGNS 0 -> 2
+    for r in (0, 1, 2):
+        rec = views[r].take_cutover()
+        assert rec is not None and rec["applied_epoch"] == 2, (r, rec)
+        assert views[r].take_cutover() is None  # one-shot
+    assert [views[r].epoch for r in (0, 1, 2)] == [2, 2, 2]
+    assert [views[r].evicted for r in (0, 1, 2)] == [set(), set(), set()]
+    assert not views[2].self_evicted
+    assert [views[r].joins_total for r in (0, 1, 2)] == [1, 1, 1]
+    # the latched decision surface reads identically on every member
+    decisions = [views[r].join_decision() for r in (0, 1, 2)]
+    assert decisions[0] == decisions[1] == decisions[2]
+    assert decisions[0]["admitted"] == [2] and decisions[0]["epoch"] == 2
+
+
+def test_wire_join_lost_confirm_resends():
+    """A member that already APPLIED the admission answers a repeat
+    petition with the applied record as a fresh confirm — the
+    lost-confirm retry converges instead of re-voting."""
+    frames = {0: [], 1: [], 2: []}
+    views = {}
+    lossy = [True]  # while set, every frame TO the candidate is lost
+
+    def send_for(me):
+        def send(payload, exclude):
+            for peer in (0, 1, 2):
+                if peer == 2 and lossy[0]:
+                    continue
+                if peer != me and peer not in exclude:
+                    frames[peer].append(dict(payload))
+        return send
+
+    for r in (0, 1, 2):
+        views[r] = MembershipView(rank=r, world=3, send_fn=send_for(r))
+        views[r].elastic = True
+    for r in (0, 1):
+        views[r].epoch = 1
+        views[r].evicted = {2}
+
+    views[2].petition_join()
+    _pump_frames(frames, views)
+    for r in (0, 1):
+        assert views[r].take_cutover() is not None
+    assert views[2].confirmed() is None
+    # retry after the fabric heals: the survivors already applied the
+    # admission, so they answer with the record as a fresh confirm
+    lossy[0] = False
+    views[2].petition_join()
+    _pump_frames(frames, views)
+    assert views[2].confirmed() is not None
+    rec = views[2].take_cutover()
+    assert rec is not None and views[2].epoch == 2
+    assert [views[r].epoch for r in (0, 1)] == [2, 2]  # no re-vote
+
+
+def test_communicator_grow_round_trip():
+    from accl_tpu.communicator import Communicator, Rank
+
+    ranks = [Rank(address=f"x:{i}", session=i) for i in range(4)]
+    c = Communicator(ranks, 1, comm_id=9)
+    e0 = c.epoch
+    c.shrink([0, 1, 2])
+    e1 = c.epoch
+    # a KNOWN session returns to its ORIGINAL world slot
+    tr = c.grow({3})
+    assert c.size == 4 and [r.session for r in c.ranks] == [0, 1, 2, 3]
+    assert c.local_rank == 1
+    assert tr == {0: 0, 1: 1, 2: 2}  # survivors keep their slots here
+    assert c.epoch not in (e0, e1)  # fresh epoch: seqn/plan re-key
+    assert not c.restore()  # grown back: nothing left to re-admit
+    # identity grow (the candidate's own re-key): same slots, new epoch
+    e2 = c.epoch
+    tr = c.grow({3})
+    assert tr == {i: i for i in range(4)} and c.epoch != e2
+    # a genuinely NEW session needs rank_info and appends in order
+    with pytest.raises(ValueError):
+        c.grow({7})
+    c.grow({7}, rank_info={7: Rank(address="x:7", session=7)})
+    assert [r.session for r in c.ranks] == [0, 1, 2, 3, 7]
+    assert c.size == 5 and c.local_rank == 1
+
+
+def test_join_marker_rebases_candidate_and_diverges_missed_rank():
+    """The __join__ digest marker rebases every member on the handoff's
+    agreed (calls, digest) baseline: the candidate — whose local stream
+    is empty — converges with the survivors, while a rank that missed
+    the cutover diverges within one window."""
+    from accl_tpu.contract import ContractVerifier
+
+    a = ContractVerifier(rank=0, world=3)   # survivor
+    b = ContractVerifier(rank=1, world=3)   # rank that MISSES the cutover
+    c = ContractVerifier(rank=2, world=3)   # candidate, fresh stream
+    for v in (a, b):
+        v.begin_comm(5, v.rank, (0, 1, 2))
+        for _ in range(3):
+            v.record("allreduce", 5, "FLOAT32", 64, "0/0", 0)
+    c.begin_comm(5, 2, (0, 1, 2))
+    base = a.export_handoff()["comms"]["5"]
+    for v in (a, c):
+        v.join_comm(5, v.rank, (0, 1, 2), membership_epoch=2,
+                    base=(base["calls"], base["digest"]))
+    c.adopt_generation(a.export_handoff()["generation"])
+    for v in (a, b, c):
+        v.record("allreduce", 5, "FLOAT32", 64, "0/0", 0)
+    with a._lock:
+        da, ca = a._comms[5].digest, a._comms[5].calls
+    with b._lock:
+        db = b._comms[5].digest
+    with c._lock:
+        dc, cc = c._comms[5].digest, c._comms[5].calls
+    assert da == dc and ca == cc  # candidate rebased: converged
+    assert da != db               # missed rank: diverges
+
+
+def test_residual_store_lazy_epoch_migration():
+    """migrate_epoch is O(1) at the cutover: entries re-key lazily on
+    first touch, mapping chains compose across sequential joins, a
+    membership_join invalidation preserves pending migrations, and any
+    other reason (or overflow) clears wholesale."""
+    from accl_tpu import DataType
+    from accl_tpu.errorfeedback import MAX_MIGRATIONS, ResidualStore
+
+    store = ResidualStore()
+    x = np.linspace(-1.0, 1.0, 64).astype(np.float32)
+    key_old = (9, 100, "allreduce", 64)
+    store.apply(key_old, x, DataType.INT8)
+    r_old = store.residual(key_old)
+    assert r_old is not None and float(np.abs(r_old).max()) > 0.0
+
+    # the JOIN cutover path: record the mapping, then the
+    # migration-preserving invalidation
+    store.migrate_epoch(9, 100, 200)
+    store.invalidate("membership_join")
+    assert store.stats()["pending_migrations"] == 1
+    assert store.residual(key_old) is not None  # preserved, not cleared
+
+    # first post-cutover touch moves the bucket under the new epoch:
+    # the carried residual corrects this apply exactly as if the epoch
+    # never changed (vs. a cold store, which starts from zeros)
+    key_new = (9, 200, "allreduce", 64)
+    corrected = store.apply(key_new, x, DataType.INT8)
+    cold = ResidualStore().apply(key_new, x, DataType.INT8)
+    assert not np.array_equal(corrected, cold)
+    assert np.allclose(corrected, x + r_old)
+    assert store.residual(key_old) is None  # moved, not copied
+    assert store.stats()["migrations"] == 1
+
+    # chains compose: a second join before an untouched bucket's first
+    # touch walks old -> mid -> new
+    key2_old = (9, 200, "reduce_scatter", 32)
+    store.apply(key2_old, x[:32], DataType.INT8)
+    store.migrate_epoch(9, 200, 300)
+    store.invalidate("membership_join")
+    store.migrate_epoch(9, 300, 400)
+    store.invalidate("membership_join")
+    store.apply((9, 400, "reduce_scatter", 32), x[:32], DataType.INT8)
+    assert store.residual(key2_old) is None
+    assert store.stats()["migrations"] == 2
+
+    # any NON-join invalidation clears everything, mappings included
+    store.invalidate("plan_register")
+    s = store.stats()
+    assert s["entries"] == 0 and s["pending_migrations"] == 0
+
+    # overflow guard: past MAX_MIGRATIONS pending mappings, wholesale
+    # clear (zeros are always safe)
+    store.apply(key_old, x, DataType.INT8)
+    for i in range(MAX_MIGRATIONS + 1):
+        store.migrate_epoch(9, 100 + i, 101 + i)
+    s = store.stats()
+    assert s["entries"] == 0 and s["pending_migrations"] == 0
+
+
+# ---------------------------------------------------------------------------
+# the full elastic cycle: kill -> shrink -> serve -> JOIN -> serve
+# (InProc AND Socket, deterministic, postmortem-bundled)
+# ---------------------------------------------------------------------------
+
+
+def _join_cycle(group, injectors, world, victim, timeout=30.0):
+    """kill -> shrink -> serve@N-1 -> heal -> join_rank -> serve@N on an
+    already-armed group; returns the determinism record."""
+    survivors = [a for i, a in enumerate(group) if i != victim]
+
+    def doomed(a, r):
+        s = a.create_buffer_from(np.full(64, r + 1.0, np.float32))
+        d = a.create_buffer(64, np.float32)
+        try:
+            a.allreduce(s, d, 64)
+            return "ok"
+        except ACCLError as e:
+            return int(e.code)
+
+    failed = run_parallel(survivors, doomed, timeout=timeout)
+    assert all(c & int(ErrorCode.RANK_EVICTED) for c in failed), failed
+    assert [a.size for a in survivors] == [world - 1] * len(survivors)
+
+    def serve(a, r):
+        s = a.create_buffer_from(np.full(64, r + 1.0, np.float32))
+        d = a.create_buffer(64, np.float32)
+        a.allreduce(s, d, 64)
+        d.sync_from_device()
+        return float(d.data[0])
+
+    small = float(sum(i + 1 for i in range(world) if i != victim))
+    shrunk = run_parallel(survivors, serve, timeout=timeout)
+    assert shrunk == [small] * len(survivors), shrunk
+
+    # operator heals the fault; the victim petitions its way back in
+    for inj in injectors:
+        if inj is not None:
+            inj.clear()
+    for a in group:
+        a.set_timeout(10.0)
+
+    def rejoin(a, r):
+        if r == victim:
+            plan = a.join_rank(timeout=20.0)
+            assert plan is not None and plan.get("kind") == "join", plan
+        else:
+            # survivors apply their half of the cutover at the next
+            # call boundary; wait (bounded) for the confirm to land
+            deadline = time.monotonic() + 20.0
+            mv = a._membership
+            while time.monotonic() < deadline:
+                if mv.cutover_ready() or mv.joins_total:
+                    break
+                time.sleep(0.02)
+            else:
+                raise AssertionError(f"rank {r}: join confirm never came")
+        return serve(a, r)
+
+    total = float(sum(i + 1 for i in range(world)))
+    grown = run_parallel(group, rejoin, timeout=timeout * 2)
+    assert grown == [total] * world, grown
+    assert [a.size for a in group] == [world] * world
+    return {
+        "failed": failed,
+        "serve_small": shrunk,
+        "serve_full": grown,
+        "membership": [
+            {
+                k: a._membership.snapshot()[k]
+                for k in ("epoch", "evicted", "evictions_total",
+                          "joins_total", "self_evicted")
+            }
+            for a in group
+        ],
+        # votes vary with thread timing; the applied record's uniform
+        # fields are the determinism surface
+        "history": [
+            [
+                {"kind": h.get("kind"), "epoch": h.get("applied_epoch"),
+                 "evict": h.get("evict"), "admit": h.get("admit")}
+                for h in a._membership.snapshot()["history"]
+            ]
+            for a in group
+        ],
+        "decisions": [a.join_decision() for a in group],
+    }
+
+
+def _run_inproc_join_cycle(seed=11):
+    g = emulated_group(4)
+    try:
+        for a in g:
+            a.set_elastic(True)
+            a.set_timeout(1.5)
+        inj = g[0].engine.fabric.install_fault_plan(_kill_plan(3, seed))
+        rec = _join_cycle(g, [inj], world=4, victim=3)
+        snap = g[0].telemetry_snapshot()["membership"]
+        assert snap["evictions_total"] == 1
+        assert snap["joins_total"] == 1
+        assert snap["epoch"] == 2  # evict bump + join bump
+        assert snap["evicted"] == []
+        prom = g[0].telemetry_prometheus()
+        assert "accl_membership_joins_total" in prom
+        return rec
+    finally:
+        _deinit(g)
+
+
+def test_kill_shrink_serve_join_serve_inproc(tmp_path, monkeypatch):
+    """World 4, kill rank 3: survivors evict and serve at 3; the healed
+    victim petitions back in via join_rank, every member cuts over at
+    its next call boundary, and the group serves bit-correct at 4 with
+    a fresh epoch.  The induced failure postmortem-bundles once per
+    surviving handle."""
+    monkeypatch.setenv("ACCL_POSTMORTEM_DIR", str(tmp_path))
+    rec = _run_inproc_join_cycle()
+    # every member latched the SAME admission decision
+    assert rec["decisions"][0]["admitted"] == [3]
+    assert all(d == rec["decisions"][0] for d in rec["decisions"])
+    assert any(os.listdir(str(tmp_path))), "no postmortem bundle written"
+
+
+def test_join_cycle_deterministic_per_seed():
+    """Same FaultPlan seed -> same terminal codes, serve results,
+    membership facts, applied history and admission decisions — twice,
+    from fresh groups."""
+    first = _run_inproc_join_cycle(seed=42)
+    second = _run_inproc_join_cycle(seed=42)
+    assert first == second
+
+
+def test_kill_shrink_serve_join_serve_socket(monkeypatch):
+    """The full join cycle over the one-process-per-rank socket
+    transport: petition/propose/confirm ride MEMBER wire frames that
+    must REACH the candidate outside the shrunk group, and the confirm
+    carries the warm handoff."""
+    plan = _kill_plan(3, seed=23)
+    monkeypatch.setenv(FAULT_PLAN_ENV, plan.to_env())
+    ports, socks = [], []
+    for _ in range(4):
+        s = socketlib.socket()
+        s.setsockopt(socketlib.SOL_SOCKET, socketlib.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        socks.append(s)
+    for s in socks:
+        s.close()
+    addrs = [f"127.0.0.1:{p}" for p in ports]
+    g = [socket_group_member(i, addrs) for i in range(4)]
+    monkeypatch.delenv(FAULT_PLAN_ENV)
+    try:
+        for a in g:
+            a.set_elastic(True)
+            a.set_timeout(2.0)
+            a.set_contract_verify(True, interval=4)
+        injectors = [a.engine.fabric.fault_injector for a in g]
+        rec = _join_cycle(g, injectors, world=4, victim=3, timeout=40.0)
+        assert g[0]._membership.snapshot()["exchange"] == "wire"
+        assert rec["decisions"][0]["admitted"] == [3]
+        assert all(d == rec["decisions"][0] for d in rec["decisions"])
+        # the warm handoff aligned the candidate's contract generation
+        gens = {a._contract.generation for a in g}
+        assert len(gens) == 1, gens
+    finally:
+        _deinit(g)
+
+
+def _evict_then_rejoin(group, victim, world, timeout=30.0):
+    """One explicit evict -> serve -> join_rank -> serve round; returns
+    the world-comm epoch after the join."""
+    survivors = [a for i, a in enumerate(group) if i != victim]
+    res = run_parallel(
+        survivors, lambda a, r: a.evict_rank(victim), timeout=timeout
+    )
+    assert all(p is not None and p["evict"] == [victim] for p in res)
+
+    def serve(a, r):
+        s = a.create_buffer_from(np.full(32, r + 1.0, np.float32))
+        d = a.create_buffer(32, np.float32)
+        a.allreduce(s, d, 32)
+        d.sync_from_device()
+        return float(d.data[0])
+
+    small = float(sum(i + 1 for i in range(world) if i != victim))
+    assert run_parallel(survivors, serve, timeout=timeout) == \
+        [small] * len(survivors)
+
+    def rejoin(a, r):
+        if r == victim:
+            plan = a.join_rank(timeout=20.0)
+            assert plan is not None and plan.get("kind") == "join", plan
+        else:
+            deadline = time.monotonic() + 20.0
+            mv = a._membership
+            joins0 = mv.joins_total
+            while time.monotonic() < deadline:
+                if mv.cutover_ready() or mv.joins_total > joins0:
+                    break
+                time.sleep(0.02)
+            else:
+                raise AssertionError(f"rank {r}: join confirm never came")
+        return serve(a, r)
+
+    total = float(sum(i + 1 for i in range(world)))
+    assert run_parallel(group, rejoin, timeout=timeout * 2) == \
+        [total] * world
+    return group[0]._world.epoch
+
+
+def test_repeated_elasticity_same_rank_inproc():
+    """Evict -> join -> evict -> join of the SAME rank id: every life
+    gets a fresh comm epoch (no seqn-ledger or residual-store
+    cross-match with a previous life) and the membership epoch strictly
+    advances through the whole sequence."""
+    g = emulated_group(4)
+    try:
+        for a in g:
+            a.set_elastic(True)
+            a.set_timeout(10.0)
+        epochs = {g[0]._world.epoch}
+        for _round in range(2):
+            e = _evict_then_rejoin(g, victim=3, world=4)
+            assert e not in epochs  # fresh comm epoch per life
+            epochs.add(e)
+        snaps = [a._membership.snapshot() for a in g]
+        assert [s["epoch"] for s in snaps] == [4] * 4
+        assert [s["joins_total"] for s in snaps] == [2] * 4
+        assert [s["evicted"] for s in snaps] == [[]] * 4
+        assert snaps[0]["evictions_total"] == 2
+        # the latched decision reads identically on every member and
+        # reflects the LAST admission
+        decisions = [a.join_decision() for a in g]
+        assert all(d == decisions[0] for d in decisions)
+        assert decisions[0]["admitted"] == [3]
+        assert decisions[0]["joins_total"] == 2
+    finally:
+        _deinit(g)
+
+
+def test_repeated_elasticity_same_rank_socket():
+    """The same evict -> join -> evict -> join sequence over the socket
+    tier: wire seqn dedup and membership-epoch fencing re-key per life,
+    so a rank id's second admission never cross-matches its first."""
+    ports, socks = [], []
+    for _ in range(3):
+        s = socketlib.socket()
+        s.setsockopt(socketlib.SOL_SOCKET, socketlib.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        socks.append(s)
+    for s in socks:
+        s.close()
+    addrs = [f"127.0.0.1:{p}" for p in ports]
+    g = [socket_group_member(i, addrs) for i in range(3)]
+    try:
+        for a in g:
+            a.set_elastic(True)
+            a.set_timeout(10.0)
+        epochs = {g[0]._world.epoch}
+        for _round in range(2):
+            e = _evict_then_rejoin(g, victim=2, world=3, timeout=40.0)
+            assert e not in epochs
+            epochs.add(e)
+        assert g[0]._membership.snapshot()["exchange"] == "wire"
+        assert [a._membership.snapshot()["joins_total"] for a in g] == \
+            [2] * 3
+        assert [a.size for a in g] == [3] * 3
+    finally:
+        _deinit(g)
+
+
+def test_wire_suggest_root_pins_advisory_only():
+    """Socket-tier straggler remainder: with no shared demotion ledger,
+    the monitor plane's PAIRWISE slow-rank verdicts feed suggest_root —
+    annotation-only, each side from its own observations — while board
+    tiers keep reading the ledger and ignore pairwise verdicts."""
+    ports, socks = [], []
+    for _ in range(2):
+        s = socketlib.socket()
+        s.setsockopt(socketlib.SOL_SOCKET, socketlib.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        socks.append(s)
+    for s in socks:
+        s.close()
+    addrs = [f"127.0.0.1:{p}" for p in ports]
+    g = [socket_group_member(i, addrs) for i in range(2)]
+    try:
+        assert g[1]._membership.ledger is None  # wire tier: no ledger
+        assert g[1].suggest_root() == 0  # nothing flagged: stock choice
+        # drive g[1]'s local judge to a deterministic conviction
+        # (synthetic 3-observer windows; the judge is pure math)
+        judge = g[1]._monitor.tracker.judge
+        judge.min_us = 200.0
+        judge.persist = 1
+        cid = g[1]._world.id
+        judge.post_latency(cid, 0, 1, {0: 90000.0, 2: 12.0}, world=3)
+        judge.post_latency(cid, 0, 2, {0: 91000.0, 1: 11.0}, world=3)
+        judge.post_latency(cid, 0, 0, {1: 9.0, 2: 10.0}, world=3)
+        assert judge.slow_ranks(cid) == [0]
+        # the verdict reroutes THIS side's advisory root...
+        assert g[1].suggest_root() == 1
+        # ...the unconvinced side still suggests the stock root
+        assert g[0].suggest_root() == 0
+        # and nothing acted on it: collectives keep flowing
+        def serve(a, r):
+            s = a.create_buffer_from(np.full(16, r + 1.0, np.float32))
+            d = a.create_buffer(16, np.float32)
+            a.allreduce(s, d, 16)
+            d.sync_from_device()
+            return float(d.data[0])
+
+        assert run_parallel(g, serve, timeout=30.0) == [3.0, 3.0]
+    finally:
+        _deinit(g)
+
+    # board tier: the shared ledger is the only demotion source; a
+    # pairwise verdict never feeds suggest_root
+    g = emulated_group(2)
+    try:
+        assert g[0]._membership.ledger is not None
+        judge = g[0]._monitor.tracker.judge
+        judge._slow[g[0]._world.id] = {"kind": "slow_rank", "rank": 0}
+        assert g[0].suggest_root() == 0
+    finally:
+        _deinit(g)
+
+
+# ---------------------------------------------------------------------------
+# warm handoff: ZeRO shard-ownership reshard plan (pure math, SPMD-derivable)
+# ---------------------------------------------------------------------------
+
+
+def test_zero_reshard_plan_incremental():
+    """Every member derives the identical incremental fetch plan from the
+    agreed (old_dp, new_dp) pair — full coverage, already-local ranges
+    omitted, zero wire bytes spent agreeing on it."""
+    from accl_tpu.parallel.zero import reshard_plan
+
+    # grow 3 -> 4 over 12 elements: each new slice is covered exactly,
+    # and fetch ranges only name segments whose OLD owner differs
+    plan = reshard_plan(12, 3, 4)
+    assert [p["rank"] for p in plan] == [0, 1, 2, 3]
+    for p in plan:
+        for f in p["fetch"]:
+            assert f["begin"] >= p["begin"] and f["end"] <= p["end"]
+            assert f["begin"] < f["end"]
+            old_owner_lo = f["begin"] // 4  # old shard = 12/3 = 4
+            old_owner_hi = (f["end"] - 1) // 4
+            assert old_owner_lo == old_owner_hi == f["src"] != p["rank"]
+        # segments NOT fetched are exactly the ones the rank already owns
+        fetched = {
+            i for f in p["fetch"] for i in range(f["begin"], f["end"])
+        }
+        local = set(range(p["begin"], p["end"])) - fetched
+        assert all(i // 4 == p["rank"] for i in local)
+    # slices tile [0, 12) without gap or overlap
+    spans = [(p["begin"], p["end"]) for p in plan]
+    assert spans[0][0] == 0 and spans[-1][1] == 12
+    for (_, e), (b, _) in zip(spans, spans[1:]):
+        assert e == b
+
+    # identity reshard: everything is already local, nothing moves
+    assert all(p["fetch"] == [] for p in reshard_plan(12, 4, 4))
+
+    # shrink 4 -> 3: rank 1's new slice [4, 8) straddles old owners 1
+    # and 2, so exactly the [6, 8) remainder is fetched from old rank 2
+    shrink = reshard_plan(12, 4, 3)
+    assert shrink[1]["begin"] == 4 and shrink[1]["end"] == 8
+    assert shrink[1]["fetch"] == [{"src": 2, "begin": 6, "end": 8}]
+    # rank 0 grows into old rank 1's tail
+    assert shrink[0]["fetch"] == [{"src": 1, "begin": 3, "end": 4}]
+
+    # padding: 10 elements over dp=4 pads to shard 3; the last new rank's
+    # slice clamps to n and every fetch stays inside [0, n)
+    pad = reshard_plan(10, 4, 3)
+    assert all(f["end"] <= 10 for p in pad for f in p["fetch"])
+    assert pad[-1]["end"] == 10
+
+    # empty tensor: plans exist, nothing to move
+    assert all(
+        p["begin"] == p["end"] == 0 and p["fetch"] == []
+        for p in reshard_plan(0, 2, 3)
+    )
+
+    # bad shapes are loud
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        reshard_plan(-1, 2, 2)
+    with _pytest.raises(ValueError):
+        reshard_plan(8, 0, 2)
+
+    # deterministic: same inputs, same plan object graph
+    assert reshard_plan(1000, 7, 5) == reshard_plan(1000, 7, 5)

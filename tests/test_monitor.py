@@ -579,3 +579,101 @@ def test_skew_streak_broken_by_quiet_window():
     assert window(2, 9000.0) is None      # dominant again: streak 1
     v = window(3, 9000.0)                 # consecutive: streak 2 convicts
     assert v is not None and v["rank"] == 1 and v["streak"] == 2
+
+
+# ---------------------------------------------------------------------------
+# ScaleAdvisor: traffic-aware grow/shrink advice (ISSUE 17, advisory only)
+# ---------------------------------------------------------------------------
+
+
+def _tenant(p99, count, queued=0, limit=8, cls="batch"):
+    return {
+        "class": cls,
+        "latency": {"p99_us": p99, "count": count},
+        "queued": queued,
+        "outstanding_limit": limit,
+    }
+
+
+def test_scale_advisor_grow_shrink_hold():
+    from accl_tpu.monitor import SCALE_MIN_SAMPLES, ScaleAdvisor
+
+    adv = ScaleAdvisor(grow_p99_us=1000.0, shrink_p99_us=100.0)
+    # no data at all -> hold, never shrink-on-silence
+    out = adv.advise(None, world=4)
+    assert (out["recommendation"], out["reason"]) == \
+        ("hold", "insufficient_data")
+    assert out["advisory_only"] is True
+    # a sampled tenant over the high-water p99 -> grow
+    out = adv.advise(
+        {"tenants": {"0": _tenant(5000.0, SCALE_MIN_SAMPLES)}}, world=4
+    )
+    assert (out["recommendation"], out["reason"]) == \
+        ("grow", "tail_pressure")
+    assert out["hot_tenants"][0]["reason"] == "p99_over_high_water"
+    # queue backlog beyond the outstanding window -> grow, even with a
+    # cold histogram (grant starvation precedes tail evidence)
+    out = adv.advise(
+        {"tenants": {"1": _tenant(None, 0, queued=20, limit=8)}}, world=4
+    )
+    assert out["recommendation"] == "grow"
+    assert out["hot_tenants"][0]["reason"] == "queue_backlog"
+    # every sampled tenant under the low-water mark, no queues -> shrink
+    out = adv.advise(
+        {"tenants": {"0": _tenant(50.0, SCALE_MIN_SAMPLES)}}, world=4
+    )
+    assert (out["recommendation"], out["reason"]) == ("shrink", "idle_tail")
+    # mid-band -> hold
+    out = adv.advise(
+        {"tenants": {"0": _tenant(500.0, SCALE_MIN_SAMPLES)}}, world=4
+    )
+    assert (out["recommendation"], out["reason"]) == ("hold", "within_band")
+    # under-sampled tenants never count (a cold histogram is not idle)
+    out = adv.advise(
+        {"tenants": {"0": _tenant(50.0, SCALE_MIN_SAMPLES - 1)}}, world=4
+    )
+    assert (out["recommendation"], out["reason"]) == \
+        ("hold", "insufficient_data")
+
+
+def test_scale_advisor_deterministic_and_latched():
+    """A pure function of the snapshot: same tenant pressure, same
+    advice — and the last advisory latches for the snapshot surface."""
+    from accl_tpu.monitor import SCALE_MIN_SAMPLES, ScaleAdvisor
+
+    snap = {"tenants": {
+        "3": _tenant(9000.0, SCALE_MIN_SAMPLES, cls="latency"),
+        "5": _tenant(40.0, SCALE_MIN_SAMPLES),
+    }}
+    a = ScaleAdvisor(grow_p99_us=1000.0, shrink_p99_us=100.0)
+    b = ScaleAdvisor(grow_p99_us=1000.0, shrink_p99_us=100.0)
+    assert a.advise(snap, world=4) == b.advise(snap, world=4)
+    assert a.last() == b.last()
+    assert a.snapshot()["advisories"] == 1
+    assert a.snapshot()["last"]["recommendation"] == "grow"
+
+
+def test_scale_advice_on_live_surfaces():
+    """The advisory rides telemetry_snapshot()["membership"] and the
+    /membership monitor route — surfaced, never acted on."""
+    g = emulated_group(2)
+    try:
+        doc = g[0].telemetry_snapshot()["membership"]
+        advice = doc.get("scale_advice")
+        assert advice is not None
+        assert advice["advisory_only"] is True
+        assert advice["recommendation"] in ("grow", "shrink", "hold")
+        port = g[0].start_monitor(0)
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/membership", timeout=5
+        ).read().decode()
+        served = json.loads(body)
+        assert served["scale_advice"]["recommendation"] == \
+            advice["recommendation"]
+        index = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/", timeout=5
+        ).read().decode()
+        assert "/membership" in index
+    finally:
+        for a in g:
+            a.deinit()
