@@ -61,6 +61,15 @@ class CallOptions:
     # at the point of encoding (wire.rank_seed) so ranks draw
     # independent streams from one shared slot/seed value
     wire_seed: int = 0
+    # fused compute slot (constants.FusedCompute value): which compute
+    # epilogue rides this call's command-ring slot.  0 = plain
+    # collective; nonzero calls pack their compute operands into the
+    # operand row (cmdring.ring_widths fused geometry) and NEVER run
+    # the plain base op off-ring — ineligible fused calls decompose on
+    # host with a counted fallback.  fuse_param is the epilogue scalar
+    # (alpha / lr / scale), carried Q16.16 in the slot's fparam word.
+    fuse: int = 0
+    fuse_param: float = 0.0
 
     @spmd_uniform
     def eager_limit(self, default: int) -> int:

@@ -1072,6 +1072,13 @@ class EmuEngine(BaseEngine):
 
                 if not is_wire_dtype(int(val)):
                     return ErrorCode.CONFIG_ERROR
+            if key == TuningKey.CMDRING_RUN_WINDOWS:
+                from ...constants import CMDRING_MAX_RUN_WINDOWS
+
+                if int(val) > CMDRING_MAX_RUN_WINDOWS:
+                    return ErrorCode.CONFIG_ERROR
+            if key == TuningKey.CMDRING_LINGER_US and int(val) > 1_000_000:
+                return ErrorCode.CONFIG_ERROR
             if key in ALGORITHM_TUNING_KEYS:
                 try:
                     algo = AllreduceAlgorithm(int(val))

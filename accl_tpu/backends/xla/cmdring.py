@@ -56,7 +56,9 @@ from ...cmdring import (
     complementary_pair,
     default_linger_s,
     default_run_windows,
+    encode_fparam,
     encode_slot,
+    fused_slot_eligible,
     register_mailbox,
     ring_widths,
     unregister_mailbox,
@@ -66,12 +68,14 @@ from ...constants import (
     CMDRING_DEPTH_ENV,
     CMDRING_ENV,
     CMDRING_FIELDS,
+    CMDRING_FUSED_OPCODES,
     CMDRING_MAX_BYTES_ENV,
     CMDRING_MAX_DEPTH,
     CMDRING_MAX_PAYLOAD_BYTES,
     CMDRING_OPCODES,
     CMDRING_ST_OK,
     ErrorCode,
+    FusedCompute,
     Operation,
     dtype_to_numpy,
 )
@@ -95,6 +99,45 @@ _P_WIDE = (Operation.REDUCE_SCATTER, Operation.ALLTOALL)
 
 def _env_mode() -> str:
     return os.environ.get(CMDRING_ENV, "1").strip().lower()
+
+
+#: opcode word chaos poisoning writes into a refill's first slot —
+#: out of every lowering's opcode range, so the sequencer reports
+#: BAD_OP and the slot fails fast with INVALID_OPERATION
+_CHAOS_BAD_OPCODE = 0x7F
+
+
+class _RingMsgType:
+    """Message-type token for ring-refill pseudo-messages shown to the
+    fault injector (``FaultRule(msg_type="RING")`` matches them; int
+    rules never do — the ring is not a wire MsgType)."""
+
+    name = "RING"
+
+    def __int__(self) -> int:
+        return -1
+
+    def __str__(self) -> str:
+        return "RING"
+
+
+_RING_MSG_TYPE = _RingMsgType()
+
+
+class _RingRefillMsg:
+    """One refill window as the fault injector sees it: the host encode
+    (src 0) ringing the gang's doorbell.  ``dst`` is None — only
+    wildcard-dst rules reach the ring path."""
+
+    __slots__ = ("comm_id", "src", "dst", "tag", "msg_type", "seqn")
+
+    def __init__(self, comm_id: int, seqn: int):
+        self.comm_id = comm_id
+        self.src = 0
+        self.dst = None
+        self.tag = 0
+        self.msg_type = _RING_MSG_TYPE
+        self.seqn = seqn
 
 
 def default_lowering() -> str:
@@ -387,6 +430,9 @@ class GangCommandRing:
         self._slot_budgets: Dict[int, int] = {}
         self.comm_slots: Dict[int, int] = {}
         self.budgeted_windows = 0
+        # chaos plane: per-action counts of fault-injector verdicts
+        # applied to refill windows (tests assert fail-fast + recovery)
+        self.chaos_faults: Dict[str, int] = {}
 
     # -- introspection -------------------------------------------------------
     def supports(self, op) -> bool:
@@ -480,6 +526,7 @@ class GangCommandRing:
                 ) if self.dispatches else 0.0,
                 "ops": dict(self.op_slots),
                 "fallbacks": dict(self.fallbacks),
+                "chaos_faults": dict(self.chaos_faults),
                 "breakers": breakers,
                 # QoS arbiter plane: configured per-comm slot budgets,
                 # per-comm ring-slot residency (the fairness evidence)
@@ -516,6 +563,12 @@ class GangCommandRing:
         with self._lock:
             self.fallbacks[reason] = self.fallbacks.get(reason, 0) + 1
         return False
+
+    def note_fallback(self, reason: str) -> None:
+        """Count a ring miss decided OUTSIDE run_batch (the engine's
+        fused host decomposition) on the same fallback table the
+        evidence gates read."""
+        self._fallback(reason)
 
     def set_slot_budget(self, comm_id: int,
                         slots: Optional[int]) -> None:
@@ -657,6 +710,50 @@ class GangCommandRing:
             "writers": {dst}, "p2p": (src, dst),
         }
 
+    def _plan_fused(self, comm, calls, lead, plan, fuse: int):
+        """Re-validate a planned position against the fused-slot
+        geometry and patch the plan to the packed operand widths.
+        Returns the patched plan dict, or the fallback REASON string
+        (the shared :func:`accl_tpu.cmdring.fused_slot_eligible`
+        predicate — the numpy-only CI smoke gates the same verdicts)."""
+        in_w, out_w = ring_widths(
+            lead.op, lead.count, comm.size, fuse=fuse
+        )
+        # the smallest packed operand across the gang decides width
+        # eligibility: every rank must have staged the full fused row
+        opn = in_w
+        for c in calls:
+            buf = c.op0
+            if buf is None or buf.is_dummy:
+                opn = 0
+                break
+            if buf.count < in_w:
+                opn = min(opn, int(buf.count))
+        reason = fused_slot_eligible(
+            fuse, lead.op, comm.size, lead.count, opn, plan["npdt"],
+            compressed=bool(plan["compressed"]),
+        )
+        if reason is not None:
+            return reason
+        patched = dict(plan)
+        patched["fuse"] = int(fuse)
+        patched["fparam"] = float(getattr(lead, "fuse_param", 0.0))
+        patched["in_w"] = in_w
+        patched["out_w"] = out_w
+        # the hop offset of an attn-hop slot rides the call's root_src
+        # (SPMD-uniform — the same value on every rank by _sig match)
+        if FusedCompute(fuse) == FusedCompute.ATTN_HOP:
+            patched["hop"] = int(lead.root_src) % comm.size
+        return patched
+
+    def _slot_opcode(self, plan):
+        """The CmdOpcode one planned slot encodes as (fused slots remap
+        their base op through CMDRING_FUSED_OPCODES)."""
+        fuse = plan.get("fuse", 0)
+        if fuse:
+            return CMDRING_FUSED_OPCODES[FusedCompute(fuse)]
+        return CMDRING_OPCODES[plan["op"]]
+
     # -- the refill path -----------------------------------------------------
     def run_batch(self, comm, entries, npos: int,
                   t0: Optional[int] = None) -> bool:
@@ -719,15 +816,27 @@ class GangCommandRing:
                 plans.append((calls, lead, plan))
                 continue
             else:
-                n_eff = lead.count * (
-                    comm.size if lead.op in _P_WIDE else 1
-                )
+                fuse = int(getattr(lead, "fuse", 0))
+                if fuse:
+                    # fused slots size by their packed operand geometry
+                    # (grads ‖ param tail, kv ‖ q), not the base op's
+                    n_eff, _ = ring_widths(
+                        lead.op, lead.count, comm.size, fuse=fuse
+                    )
+                else:
+                    n_eff = lead.count * (
+                        comm.size if lead.op in _P_WIDE else 1
+                    )
                 nbytes = n_eff * lead.arithcfg.uncompressed_elem_bytes
                 if nbytes > self.max_bytes:
                     return self._fallback("oversized")
                 plan = self._plan_collective(comm, calls, lead, mesh)
                 if plan is None:
                     return self._fallback("host_operands")
+                if fuse:
+                    plan = self._plan_fused(comm, calls, lead, plan, fuse)
+                    if isinstance(plan, str):
+                        return self._fallback(plan)
             # one payload dtype per window: the pallas lowering packs
             # every slot into ONE concatenated buffer, where a mixed
             # window would silently promote
@@ -812,7 +921,7 @@ class GangCommandRing:
         carries a plan (the plan -> slot encoding cache), patching only
         the per-call fields (seqn, count, root, peer, function)."""
         op = plan["op"]
-        opcode = CMDRING_OPCODES[op]
+        opcode = self._slot_opcode(plan)
         wire = 0
         if plan["compressed"] and plan["wire_npdt"] is not None:
             wire = int(lead.arithcfg.compressed)
@@ -841,6 +950,12 @@ class GangCommandRing:
         # as slot DATA (rank-mixed inside the decode loop) — seed churn
         # on a warm compressed stream never recompiles the sequencer
         words[_F["flags"]] = int(getattr(lead, "wire_seed", 0)) & 0x7FFFFFFF
+        # fused compute slots: the epilogue scalar rides the fparam
+        # word Q16.16; an attn-hop slot's hop OFFSET rides the peer
+        # word (SPMD-uniform — each rank derives its source on device)
+        words[_F["fparam"]] = (
+            encode_fparam(plan["fparam"]) if plan.get("fuse") else 0
+        )
         if "p2p" in plan:
             words[_F["root"]] = plan["p2p"][0]
             words[_F["peer"]] = plan["p2p"][1]
@@ -848,7 +963,7 @@ class GangCommandRing:
             words[_F["root"]] = (
                 lead.root_src if op == Operation.BCAST else 0
             )
-            words[_F["peer"]] = 0
+            words[_F["peer"]] = plan.get("hop", 0)
         slot_idx = session.head % self.ring_depth_of(session)
         session.ring[slot_idx] = words
         session.head += 1
@@ -864,7 +979,10 @@ class GangCommandRing:
         in_ws, out_ws, wires = [], [], []
         npdt = None
         for _, lead, plan in window:
-            in_w, out_w = ring_widths(plan["op"], plan["n"], comm.size)
+            in_w, out_w = ring_widths(
+                plan["op"], plan["n"], comm.size,
+                fuse=plan.get("fuse", 0),
+            )
             in_ws.append(in_w)
             out_ws.append(out_w)
             wires.append(
@@ -942,6 +1060,55 @@ class GangCommandRing:
                     "deadline"
                 )
 
+    def _window_posture(self, window):
+        """Per-window sequencer posture: the lead call's tuning-register
+        overlay (``CMDRING_RUN_WINDOWS`` / ``CMDRING_LINGER_US``, raced
+        as autotuner axes and dispatched per plan key) over the gang's
+        env-default registers.  0 = default — the env knobs keep
+        steering any call without an overlay."""
+        lead = window[0][1]
+        t = lead.effective_tuning(getattr(self.gang, "tuning", None) or {})
+        rw = int(t.get("cmdring_run_windows", 0) or 0)
+        lus = int(t.get("cmdring_linger_us", 0) or 0)
+        run_windows = rw if rw > 0 else self.run_windows
+        linger_s = (lus / 1e6) if lus > 0 else self.linger_s
+        return run_windows, linger_s
+
+    def _chaos_hook(self, comm, window, slots_np):
+        """The chaos plane's reach into the ring path.  Refills never
+        cross the emulated fabric, so the installed fault injector sees
+        each window as ONE pseudo-message of type ``"RING"``:
+        ``corrupt``/``drop`` poison the first slot's opcode word to an
+        out-of-range value — the sequencer reports BAD_OP and that
+        slot's requests complete INVALID_OPERATION fast, never a hang
+        (a silently vanished refill would strand its waiters);
+        ``delay`` sleeps a bounded interval before the doorbell.
+        Returns the (possibly poisoned) slot rows."""
+        from ...contract import _injector_for
+
+        inj = _injector_for(getattr(self.gang, "fabric", None))
+        if inj is None:
+            return slots_np
+        msg = _RingRefillMsg(comm.id, int(slots_np[0, _F["seqn"]]))
+        v = inj.on_send(msg)
+        action = None
+        if v.corrupt or v.drop or v.dead_dst:
+            action = "corrupt" if v.corrupt else "drop"
+            slots_np = slots_np.copy()
+            slots_np[0, _F["opcode"]] = _CHAOS_BAD_OPCODE
+        if v.delay_s > 0:
+            with self._lock:
+                self.chaos_faults["delay"] = (
+                    self.chaos_faults.get("delay", 0) + 1
+                )
+            time.sleep(min(float(v.delay_s), 1.0))
+        if action is not None:
+            with self._lock:
+                self.chaos_faults[action] = (
+                    self.chaos_faults.get(action, 0) + 1
+                )
+        return slots_np
+
     # -- dispatch ------------------------------------------------------------
     def _dispatch_window(self, comm, mesh, window, reqs_per_slot,
                          t0, probe: bool = False) -> None:
@@ -970,7 +1137,7 @@ class GangCommandRing:
             self.last_window = n
             self.max_window = max(self.max_window, n)
             for _, _, plan in window:
-                name = CMDRING_OPCODES[plan["op"]].name
+                name = self._slot_opcode(plan).name
                 self.op_slots[name] = self.op_slots.get(name, 0) + 1
             window_id = session.next_window
             session.next_window += 1
@@ -994,7 +1161,7 @@ class GangCommandRing:
                         break
                 park.slots_info.append({
                     "seqn": int(slot_rows[k][_F["seqn"]]),
-                    "opcode": CMDRING_OPCODES[plan["op"]].name,
+                    "opcode": self._slot_opcode(plan).name,
                     "trace_id": tid,
                 })
             session.parks.append(park)
@@ -1007,7 +1174,7 @@ class GangCommandRing:
                             session.written.get(rid, 0) + 1
                         )
             self._inflight_windows += 1
-        slots_np = np.stack(slot_rows)
+        slots_np = self._chaos_hook(comm, window, np.stack(slot_rows))
 
         try:
             gang.interactions.bump()  # THE refill: one host interaction
@@ -1037,7 +1204,7 @@ class GangCommandRing:
                     park.form = "mailbox"
                     run = self._post_or_dispatch(
                         comm, mesh, session, shape, window_id, slots_np,
-                        payload,
+                        payload, self._window_posture(window),
                     )
                 else:
                     waiter_st = self._dispatch_inline(
@@ -1075,11 +1242,14 @@ class GangCommandRing:
         return "pallas"
 
     def _post_or_dispatch(self, comm, mesh, session, shape, window_id,
-                          slots_np, payload) -> "_ResidentRun":
+                          slots_np, payload, posture) -> "_ResidentRun":
         """The persistent doorbell: post into the live run when one
         accepts this shape, else arm a fresh run (ONE dispatch) and
         post the window as its first pull.  Returns the run the window
-        rode (its failure latch feeds the window's waiter)."""
+        rode (its failure latch feeds the window's waiter).  ``posture``
+        is the arming window's (run_windows, linger_s) from its tuning
+        overlay — a live run keeps the posture it launched with."""
+        run_windows, linger_s = posture
         with self._lock:
             run = session.run
         if run is not None and run.shape == shape:
@@ -1094,15 +1264,15 @@ class GangCommandRing:
             self._prune_retired_runs()
         mbox = SequencerMailbox(
             comm.size, shape,
-            run_windows=self.run_windows,
-            linger_s=self.linger_s,
+            run_windows=run_windows,
+            linger_s=linger_s,
             on_window_done=self._make_window_done(comm.id),
         )
         mid = register_mailbox(mbox)
         ok = mbox.post(window_id, slots_np, payload)
         assert ok  # fresh mailbox always accepts its first window
         new_run = _ResidentRun(mbox, mid, shape)
-        new_run.launch(mesh, self.run_windows)
+        new_run.launch(mesh, run_windows)
         with self._lock:
             session.run = new_run
             self.dispatches += 1

@@ -325,6 +325,19 @@ def apply_tuning(tuning: dict, options) -> ErrorCode:
         if int(val) != 0 and not is_wire_dtype(int(val)):
             return ErrorCode.CONFIG_ERROR
         tuning["wire_dtype"] = int(val)
+    elif key == TuningKey.CMDRING_RUN_WINDOWS:
+        # persistent-sequencer posture registers: 0 = env default;
+        # the run-windows budget is clamped exactly like the env knob
+        # (an unbounded run would pin the device stream indefinitely)
+        from ...constants import CMDRING_MAX_RUN_WINDOWS
+
+        if int(val) > CMDRING_MAX_RUN_WINDOWS:
+            return ErrorCode.CONFIG_ERROR
+        tuning["cmdring_run_windows"] = int(val)
+    elif key == TuningKey.CMDRING_LINGER_US:
+        if int(val) > 1_000_000:  # >1s would pin the device stream
+            return ErrorCode.CONFIG_ERROR
+        tuning["cmdring_linger_us"] = int(val)
     else:
         if key == TuningKey.GATHER_FLAT_TREE_MAX_FANIN and val < 1:
             return ErrorCode.CONFIG_ERROR
@@ -748,7 +761,7 @@ class XLAGangContext:
     def _sig(c: CallOptions) -> tuple:
         return (
             c.op, c.count, c.reduce_function, c.root_src, c.root_dst,
-            c.compression,
+            c.compression, c.fuse, c.fuse_param,
         )
 
     def _execute(self, comm: Communicator, slot: _GangSlot) -> None:
@@ -796,6 +809,16 @@ class XLAGangContext:
                 code = ErrorCode.INVALID_OPERATION
             elif any(self._sig(c) != self._sig(lead) for c in calls[1:]):
                 code = ErrorCode.INVALID_OPERATION  # mismatched gang calls
+            elif lead.fuse:
+                # a fused call that missed the ring: its operand is
+                # PACKED for the slot (grads ‖ param tail, kv ‖ q), so
+                # the plain base op would compute the wrong thing —
+                # decompose with the host reference semantics instead
+                # (counted on the ring's fallback table)
+                with jax.profiler.TraceAnnotation(
+                    f"accl::fused{int(lead.fuse)}_decomposed"
+                ):
+                    code = self._execute_fused_decomposed(comm, calls)
             else:
                 # named range in the xprof timeline (the per-call span the
                 # reference's perf counter provides, SURVEY §5 tracing)
@@ -869,6 +892,67 @@ class XLAGangContext:
         for r, (call, req) in enumerate(zip(calls, reqs)):
             self._route_p2p_channel(comm, r, call, req)
         return None
+
+    def _execute_fused_decomposed(
+        self, comm: Communicator, calls: List[CallOptions]
+    ) -> ErrorCode:
+        """Host-reference execution of a fused call that fell off the
+        ring.  The operand is packed for the slot, so the plain base op
+        has no correct off-ring spelling; the decomposition computes
+        the fused semantics itself — the shared width/epilogue
+        definitions from :mod:`accl_tpu.cmdring`, in numpy — and counts
+        the miss as a ``fused_decomposed`` ring fallback.  Correctness
+        over speed: the warm path is the ring slot."""
+        from ...cmdring import ring_widths
+        from ...constants import FusedCompute, ReduceFunction
+
+        lead = calls[0]
+        size = len(calls)
+        try:
+            fuse = FusedCompute(int(lead.fuse))
+        except ValueError:
+            return ErrorCode.INVALID_OPERATION
+        n = int(lead.count)
+        if n <= 0 or fuse == FusedCompute.NONE:
+            return ErrorCode.INVALID_OPERATION
+        in_w, _ = ring_widths(lead.op, n, size, fuse=fuse)
+        rows = []
+        for c in calls:
+            if c.op0 is None or c.op0.is_dummy:
+                return ErrorCode.INVALID_OPERATION
+            view = np.asarray(c.op0.device_view())
+            if view.shape[0] < in_w:
+                return ErrorCode.INVALID_OPERATION
+            # copy: the result write below may alias the operand
+            rows.append(view[:in_w].copy())
+        self.cmdring.note_fallback("fused_decomposed")
+        fp = float(lead.fuse_param)
+        outs = []
+        if fuse == FusedCompute.ATTN_HOP:
+            from ...ops.pallas.ring import hop_source
+
+            hop = int(lead.root_src)
+            for r in range(size):
+                src = hop_source(r, hop, size)
+                outs.append(fp * (rows[r][n:2 * n] * rows[src][:n]))
+        else:
+            stack = np.stack([row[: n * size] for row in rows])
+            if lead.reduce_function == ReduceFunction.MAX:
+                reduced = stack.max(axis=0)
+            else:
+                reduced = stack.sum(axis=0)
+            for r in range(size):
+                chunk = reduced[r * n:(r + 1) * n]
+                if fuse == FusedCompute.MATMUL_RS:
+                    outs.append(fp * chunk)
+                else:  # APPLY: param tail minus the scaled reduced chunk
+                    outs.append(
+                        rows[r][size * n:(size + 1) * n] - fp * chunk
+                    )
+        for r, c in enumerate(calls):
+            if c.res is not None and not c.res.is_dummy:
+                _write_host_result(c.res, outs[r], n, self.interactions)
+        return ErrorCode.OK
 
     def _route_p2p_channel(self, comm: Communicator, rank: int,
                            call: CallOptions, req: Request) -> None:
@@ -1005,6 +1089,11 @@ class XLAGangContext:
             calls = [e[0][i] for e in entries]
             lead = calls[0]
             if any(self._sig(c) != self._sig(lead) for c in calls[1:]):
+                return False
+            if lead.fuse:
+                # fused positions never run the plain lowerings (the
+                # packed operand layout differs) — the sequential path
+                # decomposes them with the host reference
                 return False
             # (_plan_device_call also enforces the BCAST op0-is-res form)
             plan = self._plan_device_call(comm, calls, lead, mesh)

@@ -41,6 +41,7 @@ import numpy as np
 
 from .constants import (
     CMDRING_FIELDS,
+    CMDRING_FPARAM_ONE,
     CMDRING_LINGER_ENV,
     CMDRING_LINGER_MS_DEFAULT,
     CMDRING_MAX_RUN_WINDOWS,
@@ -48,6 +49,7 @@ from .constants import (
     CMDRING_RUN_WINDOWS_ENV,
     CMDRING_SLOT_WORDS,
     CmdOpcode,
+    FusedCompute,
     Operation,
     ReduceFunction,
 )
@@ -56,11 +58,14 @@ __all__ = [
     "SequencerMailbox",
     "WindowShape",
     "complementary_pair",
+    "decode_fparam",
     "decode_slot",
     "default_linger_s",
     "default_run_windows",
+    "encode_fparam",
     "encode_slot",
     "encode_window",
+    "fused_slot_eligible",
     "mailbox_for",
     "register_mailbox",
     "ring_widths",
@@ -86,11 +91,13 @@ def encode_slot(
     nseg: int = 1,
     peer: int = 0,
     wire: int = 0,
+    fparam: int = 0,
 ) -> np.ndarray:
     """One command slot as ``(CMDRING_SLOT_WORDS,)`` int32 — every field
     written through :data:`CMDRING_FIELDS`, never a literal index.
     ``root`` doubles as the SEND/RECV source rank with ``peer`` the
-    destination; ``wire`` is the compressed wire DataType (0 = none)."""
+    destination; ``wire`` is the compressed wire DataType (0 = none);
+    ``fparam`` a fused epilogue's Q16.16 scalar (see ``encode_fparam``)."""
     words = np.zeros(CMDRING_SLOT_WORDS, np.int32)
     words[_F["seqn"]] = int(seqn) & 0x7FFFFFFF
     words[_F["opcode"]] = int(opcode)
@@ -102,7 +109,22 @@ def encode_slot(
     words[_F["nseg"]] = max(1, int(nseg))
     words[_F["peer"]] = int(peer)
     words[_F["wire"]] = int(wire)
+    words[_F["fparam"]] = int(fparam)
     return words
+
+
+def encode_fparam(x: float) -> int:
+    """A fused epilogue's scalar as the Q16.16 fparam word: exact for
+    the power-of-two alphas/lrs/scales that dominate training, and
+    decoded identically by both lowerings (int-to-float divide — no
+    float bit-pattern punning through the int32 slot plane)."""
+    q = int(round(float(x) * CMDRING_FPARAM_ONE))
+    return max(-(2 ** 31), min(2 ** 31 - 1, q))
+
+
+def decode_fparam(word: int) -> float:
+    """The host-side inverse of :func:`encode_fparam`."""
+    return float(int(word)) / CMDRING_FPARAM_ONE
 
 
 def decode_slot(words) -> dict:
@@ -158,11 +180,34 @@ def complementary_pair(calls) -> Optional[Tuple[int, int]]:
     return src, dst
 
 
-def ring_widths(op: Operation, count: int, size: int) -> Tuple[int, int]:
+def ring_widths(
+    op: Operation, count: int, size: int, fuse: int = 0
+) -> Tuple[int, int]:
     """(operand width, result width) in elements for one ring slot —
     the sequencer analog of the engine's IN_W/OUT_W tables.  BARRIER
-    rides a one-element token; SEND/RECV move ``count`` point-to-point."""
+    rides a one-element token; SEND/RECV move ``count`` point-to-point.
+
+    Fused slots pack their compute operands into the SAME operand row
+    (one pull per slot — the fused epilogue never re-enters the host):
+
+    * ``MATMUL_RS``: GEMM partials in reduce-scatter layout — the plain
+      RS geometry, ``(n*size, n)``; the epilogue only scales.
+    * ``APPLY``: gradients in allreduce layout plus this rank's param
+      chunk riding the tail — ``(n*(size+1), n)``; the result is the
+      applied param chunk, not the reduced gradient.
+    * ``ATTN_HOP``: the kv block to relay plus the resident q block —
+      ``(2n, n)``; the result is the scaled partial score block.
+
+    The width RELATIONS fully determine the fused geometry: operand
+    width ``out*(size+1)`` only arises for APPLY, ``2*out`` (size>2)
+    only for ATTN_HOP — the sequencer lowerings classify slots by these
+    relations with the opcode word selecting within a class."""
     n = int(count)
+    fuse = FusedCompute(int(fuse))
+    if fuse == FusedCompute.APPLY:
+        return n * (size + 1), n
+    if fuse == FusedCompute.ATTN_HOP:
+        return 2 * n, n
     if op in (Operation.REDUCE_SCATTER, Operation.ALLTOALL):
         in_w = n * size
     elif op == Operation.BARRIER:
@@ -176,6 +221,54 @@ def ring_widths(op: Operation, count: int, size: int) -> Tuple[int, int]:
     else:
         out_w = n
     return in_w, out_w
+
+
+#: FusedCompute -> the base Operation its call rides (the engine plans
+#: the collective half with this op; the fuse hint selects the epilogue)
+FUSED_BASE_OPS = {
+    FusedCompute.MATMUL_RS: Operation.REDUCE_SCATTER,
+    FusedCompute.APPLY: Operation.ALLREDUCE,
+    FusedCompute.ATTN_HOP: Operation.ALLREDUCE,
+}
+
+
+def fused_slot_eligible(
+    fuse: int,
+    op: Operation,
+    size: int,
+    count: int,
+    operand_count: int,
+    npdt,
+    compressed: bool = False,
+) -> Optional[str]:
+    """Why a fused call CANNOT ride a ring slot (None = eligible) — the
+    ONE fused-eligibility predicate, numpy-only so the CI ring smoke
+    gates it without jax and the engine planner counts the same reasons.
+
+    Fused epilogues are float arithmetic fused into the relay: they need
+    a real ring (size >= 2), a float operand, the fuse's base operation,
+    an operand row packed to exactly the fused width, and no wire
+    compression (the epilogue would otherwise run on lossy-cast chunks
+    the plain path never produces)."""
+    try:
+        fuse = FusedCompute(int(fuse))
+    except ValueError:
+        return "unknown_fuse"
+    if fuse == FusedCompute.NONE:
+        return None
+    base = FUSED_BASE_OPS.get(fuse)
+    if base is None or op != base:
+        return "fused_base_op"
+    if int(size) < 2:
+        return "fused_world_too_small"
+    if np.dtype(npdt).kind != "f":
+        return "fused_dtype"
+    in_w, _ = ring_widths(base, count, size, fuse=fuse)
+    if int(operand_count) != in_w:
+        return "fused_operand_width"
+    if compressed:
+        return "fused_compressed"
+    return None
 
 
 # ---------------------------------------------------------------------------

@@ -129,6 +129,15 @@ class TuningKey(enum.IntEnum):
     # hard-wires its hp_compression lane per ArithConfig — this makes
     # the lane a measured, per-bucket register like any algorithm)
     WIRE_DTYPE = 12
+    # streaming posture of the persistent sequencer, promoted from the
+    # ACCL_CMDRING_RUN_WINDOWS / ACCL_CMDRING_LINGER_MS env knobs to
+    # raceable per-plan registers: how many refill windows one run
+    # drains before re-dispatching (0 = env default), and how long an
+    # idle run lingers before parking, in MICROSECONDS (0 = env
+    # default; an int register, so the ms-granular env knob races at
+    # sub-ms resolution)
+    CMDRING_RUN_WINDOWS = 13
+    CMDRING_LINGER_US = 14
 
 
 class AllreduceAlgorithm(enum.IntEnum):
@@ -156,6 +165,8 @@ TUNING_KEY_NAMES = {
     TuningKey.GATHER_ALGORITHM: "gather_algorithm",
     TuningKey.PIPELINE_THRESHOLD: "pipeline_threshold",
     TuningKey.WIRE_DTYPE: "wire_dtype",
+    TuningKey.CMDRING_RUN_WINDOWS: "cmdring_run_windows",
+    TuningKey.CMDRING_LINGER_US: "cmdring_linger_us",
 }
 
 #: lowerings valid for the ROOTED algorithm registers (no ppermute-ring /
@@ -478,6 +489,12 @@ TUNING_DEFAULTS = {
     # WIRE_LANE_DTYPES makes eligible calls ride that lane — typically
     # set per size bucket by an autotuned TuningPlan overlay
     "wire_dtype": 0,
+    # persistent-sequencer streaming posture: 0 = ride the
+    # ACCL_CMDRING_RUN_WINDOWS / ACCL_CMDRING_LINGER_MS env defaults;
+    # nonzero values (windows per run / idle linger in microseconds)
+    # override per plan key, typically from an autotuned overlay
+    "cmdring_run_windows": 0,
+    "cmdring_linger_us": 0,
 }
 
 # Overlap plane (async in-flight window) defaults: how many collectives
@@ -520,13 +537,45 @@ class CmdOpcode(enum.IntEnum):
     BARRIER = 7    # the gather IS the sync; orders the slots around it
     SEND = 8       # matched p2p pair as one slot (root=src, peer=dst)
     RECV = 9       # the complementary spelling of the same pair slot
+    # Fused compute slots (the reference accl_hls/vadd_put discipline):
+    # a compute epilogue runs inside the slot's relay instead of a host
+    # round-trip between the kernel and the collective that consumes it.
+    FUSED_MATMUL_RS = 10   # scaled GEMM-partial epilogue feeding a
+                           # reduce-scatter relay (alpha in fparam)
+    FUSED_APPLY = 11       # optimizer apply-on-arrival: own param chunk
+                           # rides the operand tail; the reduced grad
+                           # chunk is applied (p - lr*g) during the
+                           # gather, not after it (lr in fparam)
+    FUSED_ATTN_HOP = 12    # ring-attention hop: q rides the operand
+                           # tail, kv relays one hop; the epilogue emits
+                           # the scaled partial score block (scale in
+                           # fparam, hop offset in peer)
+
+
+class FusedCompute(enum.IntEnum):
+    """Fuse hint of a call (``CallOptions.fuse``): which compute
+    epilogue rides the collective's command-ring slot.  NONE is the
+    plain collective; every other member maps to a fused CmdOpcode via
+    ``CMDRING_FUSED_OPCODES``.  Fused calls that miss the ring cannot
+    run the plain base op (the packed operand layout differs) — the
+    engine decomposes them on host with a counted fallback instead."""
+
+    NONE = 0
+    MATMUL_RS = 1
+    APPLY = 2
+    ATTN_HOP = 3
 
 
 #: Operation -> CmdOpcode: the ONE definition of the sequencer's
 #: warm-path subset (engine eligibility, slot encoding and the bench's
 #: per-opcode residency evidence all read this table).  COPY/COMBINE/
 #: SCATTER/GATHER/REDUCE stay host-dispatch: rooted trees and local ops
-#: are not floor-bound the way the warm window stream is.
+#: are not floor-bound the way the warm window stream is.  Fused
+#: opcodes are keyed by their fuse-hint name (they share a base
+#: Operation with a plain entry, so the Operation key is taken): the
+#: planner resolves them through CMDRING_FUSED_OPCODES below, and the
+#: string keys keep this table the exhaustive executable-opcode
+#: coverage map that acclint checks values-first.
 CMDRING_OPCODES = {
     Operation.ALLREDUCE: CmdOpcode.ALLREDUCE,
     Operation.BCAST: CmdOpcode.BCAST,
@@ -536,10 +585,29 @@ CMDRING_OPCODES = {
     Operation.BARRIER: CmdOpcode.BARRIER,
     Operation.SEND: CmdOpcode.SEND,
     Operation.RECV: CmdOpcode.RECV,
+    "fused_matmul_rs": CmdOpcode.FUSED_MATMUL_RS,
+    "fused_apply": CmdOpcode.FUSED_APPLY,
+    "fused_attn_hop": CmdOpcode.FUSED_ATTN_HOP,
 }
 
+#: FusedCompute -> CmdOpcode: the slot opcode a fuse hint encodes as.
+#: Also pins each fused opcode's BASE operation semantics: MATMUL_RS
+#: rides a REDUCE_SCATTER call, APPLY and ATTN_HOP ride ALLREDUCE
+#: calls (their operand carries the fused tail — see ring_widths).
+CMDRING_FUSED_OPCODES = {
+    FusedCompute.MATMUL_RS: CmdOpcode.FUSED_MATMUL_RS,
+    FusedCompute.APPLY: CmdOpcode.FUSED_APPLY,
+    FusedCompute.ATTN_HOP: CmdOpcode.FUSED_ATTN_HOP,
+}
+
+#: Q16.16 fixed-point unit of the fparam slot word: fused epilogues
+#: carry their scalar (alpha / lr / scale) as round(x * FPARAM_ONE)
+#: in an int32 word — exact for the power-of-two scales that dominate
+#: training, and identical across both lowerings.
+CMDRING_FPARAM_ONE = 65536
+
 #: int32 words per slot (fields below + reserved headroom)
-CMDRING_SLOT_WORDS = 10
+CMDRING_SLOT_WORDS = 11
 
 #: field name -> word index within a slot.  Indices must stay dense,
 #: unique and < CMDRING_SLOT_WORDS (enforced by acclint).
@@ -553,8 +621,13 @@ CMDRING_FIELDS = {
     "flags": 6,     # stochastic-rounding seed of the wire lane (0 =
                     # deterministic; rank-mixed on device — wire.rank_seed)
     "nseg": 7,      # ring segmentation register snapshot
-    "peer": 8,      # comm-relative destination rank (SEND/RECV slots)
+    "peer": 8,      # comm-relative destination rank (SEND/RECV slots);
+                    # hop OFFSET for FUSED_ATTN_HOP (slots are encoded
+                    # once globally, so the word must be SPMD-uniform —
+                    # each rank derives its source as (me - peer) % size)
     "wire": 9,      # DataType of the compressed wire lane (0 = none)
+    "fparam": 10,   # Q16.16 fixed-point scalar of a fused epilogue
+                    # (alpha / lr / scale; 0 for plain slots)
 }
 
 #: per-slot status-word retcodes the sequencer writes back

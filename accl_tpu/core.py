@@ -32,6 +32,7 @@ from .constants import (
     DataType,
     DEFAULT_RX_BUFFER_SIZE,
     ErrorCode,
+    FusedCompute,
     HostFlags,
     Operation,
     ReduceFunction,
@@ -1549,7 +1550,13 @@ class ACCL:
         # plan loads invalidate the pool).  Scoped to the wire-verdict
         # op set; an operand dtype with no registered arith pair for
         # the verdict dtype keeps the uncompressed wire.
-        if cdt is None and op in self._WIRE_VERDICT_OPS:
+        # fused-slot calls keep the uncompressed wire: the ring planner
+        # refuses compressed fused slots (fused_slot_eligible), and a
+        # verdict-compressed plan would force every fused call into the
+        # counted host decomposition
+        if cdt is None and op in self._WIRE_VERDICT_OPS and (
+            "fuse" not in extra
+        ):
             wd = (overlay or {}).get("wire_dtype")
             if wd is None:
                 wd = self._engine_tuning().get("wire_dtype", 0)
@@ -2304,8 +2311,14 @@ class ACCL:
             return
         cfg = options.arithcfg
         dt = cfg.uncompressed.name if cfg is not None else None
+        # fused compute slots fold the fuse kind into the fingerprinted
+        # op name: a rank issuing the PLAIN base op where its peers
+        # fused (or vice versa) diverges within one verification window
+        opname = options.op.name.lower()
+        if getattr(options, "fuse", 0):
+            opname += f".fused{int(options.fuse)}"
         verdict = c.record(
-            op=options.op.name.lower(),
+            op=opname,
             comm_id=options.comm.id,
             dtype=dt,
             count=options.count,
@@ -3010,6 +3023,126 @@ class ACCL:
             ),
         )
         return self._launch(opts, run_async, "reduce_scatter")
+
+    # -- fused compute slots (ref accl_hls kernel-initiated calls) -----------
+    def _fused_operand_check(self, sendbuf, need: int, what: str) -> None:
+        if sendbuf.count < need:
+            raise ValueError(
+                f"{what} needs a packed operand of at least {need} "
+                f"elements, got {sendbuf.count}"
+            )
+
+    def _fused_launch(self, op, fuse, sendbuf, recvbuf, n, function,
+                      comm, fuse_param, root_src, run_async, context):
+        """Shared tail of the fused facades: plan (fuse folded into the
+        cache key — a fused plan never aliases its plain base op's),
+        CallOptions with the fuse hint, launch.  Fused calls keep the
+        uncompressed wire and NEVER run the plain base op off-ring:
+        ring-ineligible calls decompose on host with a counted
+        fallback (``fallbacks["fused_decomposed"]``)."""
+        host = self._host_flags(sendbuf, None, recvbuf)
+        plan = self._plan_for(
+            op, comm, recvbuf.dtype, n, None, host,
+            (int(function), "fuse", int(fuse)),
+        )
+        opts = CallOptions(
+            op=op,
+            comm=comm,
+            count=n,
+            reduce_function=function,
+            root_src=root_src,
+            arithcfg=plan.arithcfg,
+            compression=plan.compression,
+            host=host,
+            op0=sendbuf,
+            res=recvbuf,
+            plan=plan,
+            tuning=plan.tuning,
+            fuse=int(fuse),
+            fuse_param=float(fuse_param),
+        )
+        return self._launch(opts, run_async, context)
+
+    def fused_matmul_reduce_scatter(
+        self,
+        sendbuf: BaseBuffer,
+        recvbuf: BaseBuffer,
+        count: Optional[int] = None,
+        scale: float = 1.0,
+        function: ReduceFunction = ReduceFunction.SUM,
+        comm: Optional[Communicator] = None,
+        run_async: bool = False,
+    ):
+        """GEMM partials straight into a reduce-scatter slot (the
+        ``accl_hls`` vadd_put discipline): ``sendbuf`` holds this
+        rank's ``size*count`` output partials laid out as ``size``
+        destination chunks; ``recvbuf`` receives ``scale *`` the
+        reduced chunk owned by this rank.  One command-ring slot, no
+        intermediate host round trip between compute and collective."""
+        comm = comm or self._world
+        n = self._count_of(recvbuf, count)
+        self._fused_operand_check(
+            sendbuf, n * comm.size, "fused_matmul_reduce_scatter"
+        )
+        return self._fused_launch(
+            Operation.REDUCE_SCATTER, FusedCompute.MATMUL_RS,
+            sendbuf, recvbuf, n, function, comm, scale, 0, run_async,
+            "fused_matmul_reduce_scatter",
+        )
+
+    def fused_apply(
+        self,
+        sendbuf: BaseBuffer,
+        recvbuf: BaseBuffer,
+        count: Optional[int] = None,
+        lr: float = 1.0,
+        function: ReduceFunction = ReduceFunction.SUM,
+        comm: Optional[Communicator] = None,
+        run_async: bool = False,
+    ):
+        """Optimizer-apply-on-arrival: ``sendbuf`` packs this rank's
+        gradient contribution (``size*count``, laid out as ``size``
+        destination chunks) followed by its OWN ``count``-wide
+        parameter shard; the epilogue applies ``param - lr * grad`` per
+        received chunk during the gather, and ``recvbuf`` gets the
+        updated shard — SGD step and gradient reduction in one slot."""
+        comm = comm or self._world
+        n = self._count_of(recvbuf, count)
+        self._fused_operand_check(
+            sendbuf, n * (comm.size + 1), "fused_apply"
+        )
+        return self._fused_launch(
+            Operation.ALLREDUCE, FusedCompute.APPLY,
+            sendbuf, recvbuf, n, function, comm, lr, 0, run_async,
+            "fused_apply",
+        )
+
+    def fused_attn_hop(
+        self,
+        sendbuf: BaseBuffer,
+        recvbuf: BaseBuffer,
+        hop: int,
+        count: Optional[int] = None,
+        scale: float = 1.0,
+        comm: Optional[Communicator] = None,
+        run_async: bool = False,
+    ):
+        """One ring-attention hop as a sequencer slot: ``sendbuf``
+        packs this rank's KV block (``count``) followed by its resident
+        Q block (``count``); the epilogue computes the partial
+        ``scale * q * kv_src`` against the block arriving from the rank
+        ``hop`` positions behind on the ring.  ``hop`` is SPMD-uniform
+        (same value on every rank — it rides the slot's peer word);
+        each rank derives its own source on device."""
+        comm = comm or self._world
+        n = self._count_of(recvbuf, count)
+        self._fused_operand_check(sendbuf, 2 * n, "fused_attn_hop")
+        hop = int(hop) % max(comm.size, 1)
+        return self._fused_launch(
+            Operation.ALLREDUCE, FusedCompute.ATTN_HOP,
+            sendbuf, recvbuf, n, ReduceFunction.SUM, comm, scale, hop,
+            run_async, "fused_attn_hop",
+        )
 
     def alltoall(
         self,

@@ -252,3 +252,46 @@ def striped_attention(
         return jnp.where(idx >= origin, tri, tri_strict)
 
     return _ring_scan(q, k, v, axis_name, mask_for, block_k)
+
+
+# ---------------------------------------------------------------------------
+# command-ring opt-in: attention hops as sequencer slots (FUSED_ATTN_HOP)
+# ---------------------------------------------------------------------------
+
+
+def fused_hop_partial(accl, kv_block, q_block, hop, scale=1.0,
+                      comm=None, timeout_s=60.0):
+    """One ring-attention hop issued as a command-ring slot
+    (``FUSED_ATTN_HOP``): this rank's K/V block relays around the ring
+    while the epilogue computes ``scale * q * kv_src`` against the
+    block arriving from ``hop`` positions behind — the hop's partial
+    score, produced inside the sequencer window instead of a ppermute
+    + host-side fold round trip.
+
+    ``kv_block`` and ``q_block`` are equal-width 1-D float blocks (a
+    flattened head tile); ``hop`` is SPMD-uniform.  Returns the partial
+    block, a host-side copy.  The shard_map ``ring_attention`` path
+    above stays the jit-compiled form; this surface is for pipelines
+    already driving collectives through the ACCL facade.
+    """
+    import numpy as np
+
+    kv = np.asarray(kv_block, np.float32).ravel()
+    q = np.asarray(q_block, np.float32).ravel()
+    if kv.size != q.size:
+        raise ValueError(
+            f"kv block ({kv.size}) and q block ({q.size}) must be "
+            "equal width — FUSED_ATTN_HOP packs them as one operand row"
+        )
+    send = accl.create_buffer_from(np.concatenate([kv, q]))
+    out = accl.create_buffer(q.size, np.float32)
+    with accl.batch():
+        req = accl.fused_attn_hop(
+            send, out, hop=hop, count=q.size, scale=scale, comm=comm,
+            run_async=True,
+        )
+    if not req.wait(timeout_s):
+        raise TimeoutError("fused attention hop timed out")
+    req.check()
+    out.sync_from_device()
+    return out.data[:out.count].copy()

@@ -1433,3 +1433,61 @@ def make_sharded_train_step(cfg: TransformerConfig, mesh: Mesh, lr: float = 1e-2
         donate_argnums=(0,),
     )
     return fn, partial(_shard_params, specs=specs, mesh=mesh)
+
+
+# ---------------------------------------------------------------------------
+# command-ring opt-in: fused optimizer step (FUSED_APPLY slots)
+# ---------------------------------------------------------------------------
+
+
+def fused_optimizer_step(accl, bucket_grads, bucket_params, lr,
+                         comm=None, timeout_s=60.0):
+    """One data-parallel SGD step through the command ring's
+    ``FUSED_APPLY`` slots: every gradient bucket reduces on-ring with
+    the optimizer apply running per received chunk DURING the gather —
+    no host round trip between reduction and update, so a warm step
+    costs exactly one refill interaction for all buckets.
+
+    ``bucket_grads[b]`` is this rank's ``size*n_b`` gradient
+    contribution in allreduce chunk layout; ``bucket_params[b]`` its
+    own ``n_b``-wide parameter shard.  Returns the applied shards
+    (``param - lr * reduced_grad_chunk`` per bucket), host-side copies.
+
+    This is the model zoo's fuse-hint surface: the facade sets
+    ``CallOptions.fuse`` and the engine planner routes eligible calls
+    to ``FUSED_APPLY`` ring slots, decomposing ineligible ones on host
+    with a counted ``fused_decomposed`` fallback — semantics identical
+    either way.
+    """
+    import numpy as np
+
+    world = (comm or accl._world).size
+    sends, outs = [], []
+    for g, p in zip(bucket_grads, bucket_params):
+        g = np.asarray(g, np.float32).ravel()
+        p = np.asarray(p, np.float32).ravel()
+        if g.size != world * p.size:
+            raise ValueError(
+                f"bucket gradient has {g.size} elements; FUSED_APPLY "
+                f"needs size*n = {world * p.size} (allreduce chunk "
+                "layout)"
+            )
+        sends.append(accl.create_buffer_from(np.concatenate([g, p])))
+        outs.append(accl.create_buffer(p.size, np.float32))
+    with accl.batch():
+        reqs = [
+            accl.fused_apply(
+                sends[b], outs[b], outs[b].count, lr=lr, comm=comm,
+                run_async=True,
+            )
+            for b in range(len(outs))
+        ]
+    for req in reqs:
+        if not req.wait(timeout_s):
+            raise TimeoutError("fused optimizer step timed out")
+        req.check()
+    applied = []
+    for out in outs:
+        out.sync_from_device()
+        applied.append(out.data[:out.count].copy())
+    return applied

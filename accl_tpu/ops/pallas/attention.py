@@ -62,6 +62,18 @@ def _mxu_precision(dtype):
     )
 
 
+def attn_hop_partial(q, kv, scale):
+    """One FUSED_ATTN_HOP epilogue: the scaled elementwise partial of the
+    resident q block against the kv block that just arrived on the relay
+    (the sequencer's flat-row form of a hop's score contribution — the
+    blocked kernel above folds full (T, D) tiles; a fused slot streams
+    the same hop product per lane row).  Shared by both sequencer
+    lowerings and the engine's host-decomposition reference so the slot
+    semantics have exactly one definition.  Works on jnp and numpy
+    operands alike."""
+    return (q * kv) * scale
+
+
 def _fold(bh, q_ref, k_blk_ref, v_blk_ref, o_acc, m_ref, l_ref, mask, scale):
     """Fold one visiting K/V block into (o, m, l) for batch-head ``bh``.
 

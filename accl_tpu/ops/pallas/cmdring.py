@@ -53,6 +53,7 @@ dispatch above ``CMDRING_MAX_PAYLOAD_BYTES``.
 
 from __future__ import annotations
 
+import math
 from functools import lru_cache
 from typing import Optional, Sequence
 
@@ -73,9 +74,12 @@ from jax.experimental.pallas import tpu as pltpu
 from ...cmdring import (  # noqa: F401  (re-export surface)
     SequencerMailbox,
     WindowShape,
+    decode_fparam,
     decode_slot,
+    encode_fparam,
     encode_slot,
     encode_window,
+    fused_slot_eligible,
     mailbox_for,
     register_mailbox,
     ring_widths,
@@ -83,6 +87,7 @@ from ...cmdring import (  # noqa: F401  (re-export surface)
 )
 from ...constants import (
     CMDRING_FIELDS,
+    CMDRING_FPARAM_ONE,
     CMDRING_SLOT_WORDS,
     CMDRING_ST_BAD_OP,
     CMDRING_ST_OK,
@@ -95,15 +100,19 @@ from ._common import (
     require_mosaic_dtypes,
     sublanes_for,
 )
+from .attention import attn_hop_partial
 from .put import remote_block_put
-from .ring import _neighbors, _ring_barrier, relay_allgather_hops
+from .ring import _neighbors, _ring_barrier, hop_source, relay_allgather_hops
 from ... import wire as wirecodec
 from .. import wire as devwire
 
 __all__ = [
+    "decode_fparam",
     "decode_slot",
+    "encode_fparam",
     "encode_slot",
     "encode_window",
+    "fused_slot_eligible",
     "run_session",
     "run_windows",
     "session_program",
@@ -143,27 +152,63 @@ def _root_select(blocks, root):
     return out
 
 
+def _fparam_scale(fparam, dtype):
+    """The fused epilogue's scalar, decoded ON DEVICE from the slot's
+    Q16.16 ``fparam`` word (int-to-float multiply by the exact
+    power-of-two reciprocal — no float bit-pattern punning through the
+    int32 slot plane; both lowerings decode identically)."""
+    if fparam is None:
+        fparam = 0
+    fp = jnp.asarray(fparam, jnp.int32).astype(jnp.float32)
+    return (fp * (1.0 / CMDRING_FPARAM_ONE)).astype(dtype)
+
+
+def _attn_hop_result(blocks, own, me, peer, out_lead, fp):
+    """The FUSED_ATTN_HOP candidate: the slot's ``peer`` word is the hop
+    OFFSET (SPMD-uniform), each rank derives its source rank on device
+    and folds the visiting kv block against the resident q block riding
+    the operand tail."""
+    size = len(blocks)
+    src = hop_source(me, peer, size)
+    visiting = _root_select(blocks, src)
+    return attn_hop_partial(
+        own[out_lead:2 * out_lead], visiting[:out_lead], fp
+    )
+
+
 def slot_epilogue(blocks, own, me, op, fn, root, peer, out_lead,
-                  chunk: Optional[int] = None):
+                  chunk: Optional[int] = None, fparam=None):
     """ONE per-slot decode epilogue for the full opcode space, shared by
     both lowerings.  ``blocks`` is the gathered per-rank block list
     (static length = world size), ``own`` this rank's (pass-through)
-    operand, and ``op``/``fn``/``root``/``peer`` int32 scalars read from
-    the slot words ON DEVICE.  ``out_lead`` is the slot's static result
-    height along the leading axis; ``chunk`` the per-rank sub-block
-    height for the P-wide ops (``in_lead // size`` — element-granular on
-    the flat XLA form, row-granular on the packed Pallas form).
+    operand, and ``op``/``fn``/``root``/``peer``/``fparam`` int32
+    scalars read from the slot words ON DEVICE.  ``out_lead`` is the
+    slot's static result height along the leading axis; ``chunk`` the
+    per-rank sub-block height for the P-wide ops (``in_lead // size`` —
+    element-granular on the flat XLA form, row-granular on the packed
+    Pallas form).
 
     Output GEOMETRY is compile-time (it shapes the program), so the
     width class picks the candidate set and the opcode selects within
     the class as data:
 
-    * ``out == in * size``  → ALLGATHER (the gathered stack, verbatim);
-    * ``in == out * size``  → REDUCE_SCATTER (fold, take my chunk);
-    * ``out == in``         → ALLREDUCE / BCAST / ALLTOALL / BARRIER /
-      SEND / RECV / NOP selected by the opcode word: the fold, the
-      root block, the transpose-of-chunks, the pass-through token, the
-      pair move (``me == peer`` adopts the src block), or ``own``.
+    * ``out == in * size``      → ALLGATHER (the gathered stack);
+    * ``in == out * (size+1)``  → FUSED_APPLY (optimizer apply-on-
+      arrival: the param chunk riding the operand tail minus
+      ``fparam`` times this rank's reduced gradient chunk — the apply
+      happens during the gather, not after it);
+    * ``in == out * size``      → REDUCE_SCATTER / FUSED_MATMUL_RS
+      (fold, take my chunk; the fused form scales by ``fparam`` — the
+      vadd_put discipline) / FUSED_ATTN_HOP at size 2 (where the hop
+      class coincides);
+    * ``in == out * 2``, size>2 → FUSED_ATTN_HOP (kv block relays one
+      hop, the epilogue emits the scaled partial against the resident
+      q block on the operand tail);
+    * ``out == in``             → ALLREDUCE / BCAST / ALLTOALL /
+      BARRIER / SEND / RECV / NOP selected by the opcode word: the
+      fold, the root block, the transpose-of-chunks, the pass-through
+      token, the pair move (``me == peer`` adopts the src block), or
+      ``own``.
     """
     size = len(blocks)
     in_lead = own.shape[0]
@@ -180,13 +225,52 @@ def slot_epilogue(blocks, own, me, op, fn, root, peer, out_lead,
             jnp.concatenate([own] * size, axis=0),
         )
     reduced = _reduce_chain(blocks, fn)
+    fp = _fparam_scale(fparam, own.dtype)
+    if in_lead == out_lead * (size + 1):
+        # FUSED_APPLY class: gradients in allreduce layout with this
+        # rank's param chunk riding the operand tail.  Fold the
+        # gathered gradients, take my chunk, apply p - lr*g — the
+        # optimizer step runs per received chunk during the gather.
+        # Opcode guards as data: a mis-encoded slot passes its own
+        # leading chunk through untouched.
+        grad = lax.dynamic_slice_in_dim(reduced, me * out_lead, out_lead)
+        mine = own[size * out_lead:(size + 1) * out_lead]
+        return jnp.where(
+            op == int(CmdOpcode.FUSED_APPLY),
+            mine - fp * grad,
+            own[:out_lead],
+        )
     if in_lead == out_lead * size:
         # REDUCE_SCATTER class: fold everything, keep my chunk (opcode
-        # guard as above — a mis-encoded slot keeps its own chunk)
-        return jnp.where(
+        # guard as above — a mis-encoded slot keeps its own chunk).
+        # FUSED_MATMUL_RS shares the geometry and scales the chunk by
+        # fparam (the GEMM-partial epilogue feeding the relay); at
+        # size 2 the attn-hop class coincides (2*out == size*out) and
+        # the opcode word selects it here.
+        mine = lax.dynamic_slice_in_dim(reduced, me * out_lead, out_lead)
+        res = jnp.where(
             op == int(CmdOpcode.REDUCE_SCATTER),
-            lax.dynamic_slice_in_dim(reduced, me * out_lead, out_lead),
+            mine,
             lax.dynamic_slice_in_dim(own, me * out_lead, out_lead),
+        )
+        res = jnp.where(
+            op == int(CmdOpcode.FUSED_MATMUL_RS), fp * mine, res
+        )
+        if size == 2:
+            res = jnp.where(
+                op == int(CmdOpcode.FUSED_ATTN_HOP),
+                _attn_hop_result(blocks, own, me, peer, out_lead, fp),
+                res,
+            )
+        return res
+    if in_lead == out_lead * 2:
+        # FUSED_ATTN_HOP class (size > 2): kv ‖ q operand rows — the
+        # relay moves the kv block one hop, the epilogue contracts it
+        # against the resident q block
+        return jnp.where(
+            op == int(CmdOpcode.FUSED_ATTN_HOP),
+            _attn_hop_result(blocks, own, me, peer, out_lead, fp),
+            own[:out_lead],
         )
     rooted = _root_select(blocks, root)
     res = jnp.where(op == int(CmdOpcode.ALLREDUCE), reduced, own)
@@ -275,6 +359,7 @@ def _decode_slot_xla(slots, i, own, me, size, shape: WindowShape):
                 slots[i, _F["peer"]],
                 shape.out_ws[i],
                 chunk=chunk,
+                fparam=slots[i, _F["fparam"]],
             )
         x = devwire._cast_lane(x, jnp.dtype(wire), seed)
     g = lax.all_gather(x, _axis_name())
@@ -289,6 +374,7 @@ def _decode_slot_xla(slots, i, own, me, size, shape: WindowShape):
         slots[i, _F["peer"]],
         shape.out_ws[i],
         chunk=chunk,
+        fparam=slots[i, _F["fparam"]],
     )
 
 
@@ -498,6 +584,7 @@ def _sequencer_kernel(axis_name: str, size: int, nwin: int, depth: int,
                 fn = slots_ref[k, _F["function"]]
                 root = slots_ref[k, _F["root"]]
                 peer = slots_ref[k, _F["peer"]]
+                fparam = slots_ref[k, _F["fparam"]]
                 blocks = [
                     gathered[pl.ds(r * rows, rows), :] for r in range(size)
                 ]
@@ -505,6 +592,7 @@ def _sequencer_kernel(axis_name: str, size: int, nwin: int, depth: int,
                 res = slot_epilogue(
                     blocks, block, me, op, fn, root, peer, o_rows,
                     chunk=chunk_rows,
+                    fparam=fparam,
                 )
                 o_ref[pl.ds(out_off, o_rows), :] = res
                 out_off += o_rows
@@ -566,29 +654,53 @@ def _pallas_windows(slots, xs, axis_name, size, nwin, depth,
     interp = default_interpret(interpret)
     require_mosaic_dtypes(interp, "command-ring sequencer", compute)
     sub = sublanes_for(compute)
-    # uniform slot height: every chunk row-aligned so the P-wide ops'
-    # per-rank sub-blocks slice on row boundaries
-    chunk_rows = max(
-        -(-max(
-            (w // size if w % size == 0 and w >= size else w)
-            for w in shape.in_ws
-        ) // LANES), 1)
-    chunk_rows = -(-chunk_rows // sub) * sub
-    rows = chunk_rows * size
     # per-slot chunking decided ONCE and used by pack, kernel slicing
-    # AND unpack — a pack/unpack mismatch would read padding as payload
+    # AND unpack — a pack/unpack mismatch would read padding as payload.
+    # Fused slots are classified by their width RELATIONS first (the
+    # same relations the epilogue branches on): an APPLY operand packs
+    # as size+1 chunks (grads ‖ param tail), an attn-hop operand as 2
+    # (kv ‖ q); everything else keeps the plain rule.
+    def _chunks_of(in_w: int, ow: int) -> int:
+        if size > 1 and in_w == ow * (size + 1):
+            return size + 1
+        if size > 1 and in_w == ow * size:
+            return size
+        if size > 1 and in_w == 2 * ow and ow < in_w:
+            return 2
+        return size if in_w % size == 0 and in_w >= size else 1
+
     slot_chunks = [
-        size if shape.in_ws[i] % size == 0 and shape.in_ws[i] >= size
-        else 1
-        for i in range(depth)
+        _chunks_of(shape.in_ws[i], shape.out_ws[i]) for i in range(depth)
     ]
+    # uniform slot height: rows = pc * L with pc the sublane-rounded
+    # max per-chunk height and L the lcm of every chunk divisor in the
+    # window (plus size, so plain P-wide slicing lands on row
+    # boundaries and rows // c stays sublane-aligned for every class)
+    pc = max(
+        -(-max(
+            shape.in_ws[i] // max(slot_chunks[i], 1)
+            if slot_chunks[i] > 1 else shape.in_ws[i]
+            for i in range(depth)
+        ) // LANES), 1)
+    pc = -(-pc // sub) * sub
+    lcm = size
+    for c in set(slot_chunks):
+        if c > 1:
+            lcm = lcm * c // math.gcd(lcm, c)
+    rows = pc * lcm
+    chunk_rows = rows // size  # the P-wide per-rank sub-block height
     out_rows = []
     for i in range(depth):
         ow = shape.out_ws[i]
-        if ow >= shape.in_ws[i] * size and size > 1:
-            out_rows.append(rows * size)  # allgather class
-        elif shape.in_ws[i] == ow * size and size > 1:
-            out_rows.append(chunk_rows)   # reduce-scatter class
+        in_w = shape.in_ws[i]
+        if ow >= in_w * size and size > 1:
+            out_rows.append(rows * size)          # allgather class
+        elif in_w == ow * (size + 1) and size > 1:
+            out_rows.append(rows // (size + 1))   # fused-apply class
+        elif in_w == ow * size and size > 1:
+            out_rows.append(chunk_rows)           # reduce-scatter class
+        elif in_w == 2 * ow and ow < in_w and size > 1:
+            out_rows.append(rows // 2)            # attn-hop class
         else:
             out_rows.append(rows)
     packed = []
@@ -655,8 +767,9 @@ def _pallas_windows(slots, xs, axis_name, size, nwin, depth,
                     )
                     for b in range(size)
                 ]).astype(npdt)
-            elif out_rows[i] == chunk_rows and size > 1:
-                # reduce-scatter class: the result is ONE chunk — flat
+            elif out_rows[i] < rows and size > 1:
+                # chunk-result classes (reduce-scatter, fused apply,
+                # attn hop): the result is ONE flat chunk
                 got = _unpack_rows(region, ow, 1).astype(npdt)
             else:
                 # same-width class: the result keeps the input layout

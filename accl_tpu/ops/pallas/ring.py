@@ -73,6 +73,15 @@ def _neighbors(axis_name: str, size: int):
     return me, nxt, prv
 
 
+def hop_source(me, hop, size):
+    """Rank whose block rank ``me`` holds after ``hop`` ring hops (the
+    FUSED_ATTN_HOP peer word carries the hop OFFSET, not an absolute
+    rank — slots are encoded once globally, so the word is SPMD-uniform
+    and each rank derives its source here, on device or host).  Works
+    for python ints and traced values alike."""
+    return (me - hop + size) % size
+
+
 def _ring_barrier(nxt, prv):
     neighbor_barrier(nxt, prv)
 

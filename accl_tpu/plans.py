@@ -108,6 +108,20 @@ class CollectivePlan:
             return self.pipeline_segments
         return 1
 
+    @property
+    def fuse(self) -> int:
+        """FusedCompute value folded into this plan's key extra tuple
+        (0 = plain collective).  The facade keys fused calls separately
+        from their plain base op, so a fused plan's cached
+        ``cmdring_slot`` template carries the FUSED opcode and is never
+        shared with the plain shape's template."""
+        extra = self.key[-1] if self.key else ()
+        try:
+            i = extra.index("fuse")
+            return int(extra[i + 1])
+        except (AttributeError, ValueError, IndexError, TypeError):
+            return 0
+
     def describe(self) -> dict:
         """Introspection form (tests / debug dumps)."""
         return {
@@ -120,6 +134,7 @@ class CollectivePlan:
             "pipeline_threshold": self.pipeline_threshold,
             "pipeline_segments": self.pipeline_segments,
             "cmdring_slot_cached": self.cmdring_slot is not None,
+            "fuse": self.fuse,
         }
 
 

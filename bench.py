@@ -266,8 +266,13 @@ def _bench_attention() -> dict:
 
 
 def _bench_train_mfu(
-    small: bool = False, attention: str = "auto", seq: int = 1024
+    small: bool = False, attention: str = "auto", seq: int = 1024,
+    fused: bool = False,
 ) -> dict:
+    if fused:
+        # the fused variant: the train step's grad-exchange + optimizer
+        # phase through the facade, fused slots vs host round-trip
+        return _bench_train_fused(small=small)
     """Flagship train-step MFU on the local devices: one dp x tp=1 sharded
     SGD step on the bf16 transformer; FLOPs from XLA's own cost analysis
     of the compiled step.  ``attention`` picks the lowering — "auto" (the
@@ -353,6 +358,262 @@ def _bench_train_mfu(
     if peak is not None:
         out[f"train_mfu{suffix}"] = round(achieved_per_dev / peak, 4)
     return out
+
+
+def _bench_train_fused(small: bool = False) -> dict:
+    """The fused-compute-slot train-step evidence (the ``accl_hls``
+    analog's headline): the SAME L-bucket data-parallel optimizer step
+    measured two ways on a 4-rank gang — UNFUSED (a batched window of
+    per-bucket facade reduce-scatters, then the classic host round
+    trip per bucket: read back the reduced chunk, apply ``param - lr *
+    grad`` on host, push the shard back for the next forward) vs FUSED
+    (one window of L ``fused_apply`` slots per step — gradient
+    reduction and the apply epilogue sequenced on device, updated
+    shards landing in device buffers, no host between compute and
+    collective).  The forward/backward compute is identical in both
+    variants and excluded on purpose: this leg isolates the phase the
+    fused slots change.  Counter-asserted in the artifact: warm fused
+    ``device_interactions``/step == refill count/step
+    (``check_cmdring`` gates equality), and the fused fallback
+    counters (``unsupported_op``/``compressed``/``fused_decomposed``)
+    read ZERO across the fused warm workload.  A second warm window
+    mixes all three fused opcodes (FUSED_MATMUL_RS / FUSED_APPLY /
+    FUSED_ATTN_HOP) for the per-opcode residency evidence."""
+    import threading
+
+    import jax
+
+    from accl_tpu.core import xla_group
+
+    world = 4
+    if len(jax.devices()) < world:
+        raise RuntimeError(
+            f"fused train-step leg needs a >= {world}-device mesh "
+            "(off-chip: XLA_FLAGS=--xla_force_host_platform_device_"
+            "count=8)"
+        )
+    n = _size(2 * 1024) if small else 16 * 1024  # per-rank shard
+    buckets = 8                                  # gradient buckets/step
+    steps = 3 if small else 8
+    lr = 0.125  # power of two: exact through the Q16.16 fparam word
+
+    def run_ranks(fn):
+        errs = []
+
+        def tgt(r):
+            try:
+                fn(r)
+            except Exception as e:  # surface, don't deadlock
+                errs.append(e)
+
+        ts = [
+            threading.Thread(target=tgt, args=(r,)) for r in range(world)
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        if errs:
+            raise errs[0]
+
+    g = xla_group(world)
+    try:
+        a0 = g[0]
+        ring = a0.engine.gang.cmdring
+        rng = np.random.default_rng(0)
+        grads = [
+            [
+                rng.standard_normal(world * n).astype(np.float32)
+                for _ in range(buckets)
+            ]
+            for _ in range(world)
+        ]
+        params = [
+            [
+                rng.standard_normal(n).astype(np.float32)
+                for _ in range(buckets)
+            ]
+            for _ in range(world)
+        ]
+
+        # -- unfused: RS window + per-bucket host apply round trips --------
+        send = [
+            [a.create_buffer_from(gr) for gr in grads[r]]
+            for r, a in enumerate(g)
+        ]
+        red = [
+            [a.create_buffer(n, np.float32) for _ in range(buckets)]
+            for a in g
+        ]
+        pdev = [
+            [a.create_buffer_from(p) for p in params[r]]
+            for r, a in enumerate(g)
+        ]
+
+        def unfused_step(r):
+            a = g[r]
+            with a.batch():  # best-case unfused: the RS half batches too
+                reqs = [
+                    a.reduce_scatter(
+                        send[r][b], red[r][b], n, run_async=True
+                    )
+                    for b in range(buckets)
+                ]
+            for req in reqs:
+                assert req.wait(120)
+                req.check()
+            for b in range(buckets):
+                red[r][b].sync_from_device()  # the round trip fused kills
+                pdev[r][b].data[:] = (
+                    pdev[r][b].data - lr * red[r][b].data
+                )
+                pdev[r][b].sync_to_device()   # shard back for the fwd
+
+        run_ranks(unfused_step)  # warm compile
+        ic0 = a0.capabilities()["device_interactions"]
+        with Timer() as t:
+            for _ in range(steps):
+                run_ranks(unfused_step)
+        unfused_us = t.elapsed_ns() / steps / 1e3
+        unfused_inter = (
+            a0.capabilities()["device_interactions"] - ic0
+        ) / steps
+
+        # -- fused: ONE window of L fused_apply slots per step -------------
+        fsend = [
+            [
+                a.create_buffer_from(
+                    np.concatenate([grads[r][b], params[r][b]])
+                )
+                for b in range(buckets)
+            ]
+            for r, a in enumerate(g)
+        ]
+        fout = [
+            [a.create_buffer(n, np.float32) for _ in range(buckets)]
+            for a in g
+        ]
+
+        def fused_step(r):
+            a = g[r]
+            with a.batch():
+                reqs = [
+                    a.fused_apply(
+                        fsend[r][b], fout[r][b], n, lr=lr,
+                        run_async=True,
+                    )
+                    for b in range(buckets)
+                ]
+            for req in reqs:
+                assert req.wait(120)
+                req.check()
+
+        run_ranks(fused_step)  # warm compile (arms the ring)
+        st0 = ring.stats()
+        ic0 = a0.capabilities()["device_interactions"]
+        with Timer() as t:
+            for _ in range(steps):
+                run_ranks(fused_step)
+        fused_us = t.elapsed_ns() / steps / 1e3
+        st1 = ring.stats()
+        fused_inter = (
+            a0.capabilities()["device_interactions"] - ic0
+        ) / steps
+        fused_refills = (st1["refills"] - st0["refills"]) / steps
+
+        # -- per-opcode residency: all three fused slots in ONE window -----
+        mm_send = [
+            a.create_buffer_from(
+                rng.standard_normal(world * n).astype(np.float32)
+            )
+            for a in g
+        ]
+        mm_out = [a.create_buffer(n, np.float32) for a in g]
+        kv = [
+            rng.standard_normal(n).astype(np.float32) for _ in range(world)
+        ]
+        q = [
+            rng.standard_normal(n).astype(np.float32) for _ in range(world)
+        ]
+        hop_send = [
+            a.create_buffer_from(np.concatenate([kv[r], q[r]]))
+            for r, a in enumerate(g)
+        ]
+        hop_out = [a.create_buffer(n, np.float32) for a in g]
+
+        def fused_window(r):
+            a = g[r]
+            with a.batch():
+                reqs = [
+                    a.fused_matmul_reduce_scatter(
+                        mm_send[r], mm_out[r], n, scale=0.5,
+                        run_async=True,
+                    ),
+                    a.fused_apply(
+                        fsend[r][0], fout[r][0], n, lr=lr,
+                        run_async=True,
+                    ),
+                    a.fused_attn_hop(
+                        hop_send[r], hop_out[r], hop=1, count=n,
+                        scale=2.0, run_async=True,
+                    ),
+                ]
+            for req in reqs:
+                assert req.wait(120)
+                req.check()
+
+        run_ranks(fused_window)  # cold
+        s0 = ring.stats()
+        run_ranks(fused_window)  # warm: every fused opcode rides
+        s1 = ring.stats()
+        ops0, ops1 = s0.get("ops") or {}, s1.get("ops") or {}
+        fused_op_slots = {
+            op: ops1.get(op, 0) - ops0.get(op, 0)
+            for op in ("FUSED_MATMUL_RS", "FUSED_APPLY", "FUSED_ATTN_HOP")
+        }
+        fb0 = st0.get("fallbacks") or {}
+        fb1 = s1.get("fallbacks") or {}
+        fused_fallbacks = {
+            reason: fb1.get(reason, 0) - fb0.get(reason, 0)
+            for reason in ("unsupported_op", "compressed",
+                           "fused_decomposed")
+        }
+
+        # flops of the measured phase (reduce + apply per shard element,
+        # per bucket): world adds + 2 apply ops per element, per rank —
+        # reported so a chip capture can carry MFU next to the walls
+        flops = buckets * (world * (world + 1) * n + world * 2 * n)
+        out = {
+            "gang_cmdring_fused_step_us": round(fused_us, 1),
+            "gang_cmdring_unfused_step_us": round(unfused_us, 1),
+            "gang_cmdring_fused_interactions_per_step": round(
+                fused_inter, 4
+            ),
+            "gang_cmdring_fused_refills_per_step": round(
+                fused_refills, 4
+            ),
+            "gang_cmdring_unfused_interactions_per_step": round(
+                unfused_inter, 4
+            ),
+            "gang_cmdring_fused_op_slots": fused_op_slots,
+            "gang_cmdring_fused_fallbacks": fused_fallbacks,
+            "train_fused_world": world,
+            "train_fused_shard_elems": n,
+            "train_fused_buckets": buckets,
+            "train_fused_steps": steps,
+            "train_fused_tflops": round(
+                flops / (fused_us / 1e6) / 1e12, 6
+            ),
+        }
+        peak = _peak_flops(jax.devices()[0].device_kind)
+        if peak is not None:
+            out["gang_cmdring_fused_mfu"] = round(
+                flops / (fused_us / 1e6) / peak, 6
+            )
+        return out
+    finally:
+        for a in g:
+            a.deinit()
 
 
 # measured HBM need of the T=4096 blockwise train step's compile (the
@@ -2643,6 +2904,16 @@ def main() -> None:
         extras, errors, "train_mfu",
         lambda: _bench_train_mfu(small=_SMALL or not on_tpu),
     )
+    # the fused-slot variant of the train step (the kernel-initiated
+    # collectives headline): needs a ring-capable gang, so only on a
+    # >=4-device mesh — check_cmdring gates its counters on capture
+    if ndev >= 4:
+        _try(
+            extras, errors, "train_mfu_fused",
+            lambda: _bench_train_mfu(
+                small=_SMALL or not on_tpu, fused=True
+            ),
+        )
     if on_tpu:
         # the with/without-fusion record: since the block-512 flash
         # kernel, "auto" resolves to FLASH at the bench's T=1024 (the

@@ -444,6 +444,13 @@ CMDRING_EVIDENCE_OPS = (
     "ALLREDUCE", "REDUCE_SCATTER", "ALLGATHER", "ALLTOALL", "BARRIER",
 )
 
+#: the fused compute slots the fused train-step leg must show
+#: ring-resident (kernel-initiated collectives: every fused opcode of
+#: the warm workload sequenced on device, none decomposed to the host)
+CMDRING_FUSED_EVIDENCE_OPS = (
+    "FUSED_MATMUL_RS", "FUSED_APPLY", "FUSED_ATTN_HOP",
+)
+
 
 def check_cmdring(extras: dict, lkg_result: dict = None,
                   tolerance: float = None) -> None:
@@ -472,6 +479,20 @@ def check_cmdring(extras: dict, lkg_result: dict = None,
     rpc = extras.get("gang_cmdring_refills_per_call")
     slots = extras.get("gang_cmdring_ring_slots")
     if floor is None and host is None and rpc is None:
+        if any(
+            extras.get(k) is not None
+            for k in (
+                "gang_cmdring_fused_step_us",
+                "gang_cmdring_fused_interactions_per_step",
+                "gang_cmdring_fused_op_slots",
+            )
+        ):
+            raise CmdringGateError(
+                "capture carries fused-slot evidence without the base "
+                "command-ring evidence (ring/host floors + refill "
+                "amortization) — fused counters are unanchored; "
+                "refusing the capture"
+            )
         return  # cmdring bench never ran: nothing to gate
     if floor is None or host is None or rpc is None:
         raise CmdringGateError(
@@ -550,6 +571,73 @@ def check_cmdring(extras: dict, lkg_result: dict = None,
                 f"gang_cmdring_sustained_floor_us {sustained:.1f} us "
                 f"regressed beyond {tol:.2f}x the last-known-good "
                 f"{sus_base:.1f} us; refusing the capture"
+            )
+    # fused-compute-slot evidence (captures carrying the fused train-step
+    # keys — every capture from the kernel-initiated collectives on): the
+    # warm fused step must cost exactly its refill count in host
+    # interactions, every fused opcode must show ring residency, the
+    # fused fallback counters (unsupported_op / compressed /
+    # fused_decomposed) must read ZERO on the fused warm workload, and
+    # the fused step wall must not exceed the unfused comparison step at
+    # the same model point.
+    f_step = extras.get("gang_cmdring_fused_step_us")
+    f_unfused = extras.get("gang_cmdring_unfused_step_us")
+    f_inter = extras.get("gang_cmdring_fused_interactions_per_step")
+    f_refills = extras.get("gang_cmdring_fused_refills_per_step")
+    f_ops = extras.get("gang_cmdring_fused_op_slots")
+    f_fb = extras.get("gang_cmdring_fused_fallbacks")
+    if any(
+        k is not None
+        for k in (f_step, f_unfused, f_inter, f_refills, f_ops, f_fb)
+    ):
+        if None in (f_step, f_unfused, f_inter, f_refills):
+            raise CmdringGateError(
+                "capture carries partial fused-slot evidence (need "
+                "gang_cmdring_fused_step_us + "
+                "gang_cmdring_unfused_step_us + "
+                "gang_cmdring_fused_interactions_per_step + "
+                "gang_cmdring_fused_refills_per_step together) — the "
+                "fused train step is unverifiable"
+            )
+        if abs(f_inter - f_refills) > 1e-9 or f_inter > 1.0:
+            raise CmdringGateError(
+                f"fused step host interactions ({f_inter}/step) != "
+                f"refill count ({f_refills}/step) or exceed one per "
+                "step: the fused window is re-entering the host between "
+                "compute and collective; refusing the capture"
+            )
+        missing = [
+            op for op in CMDRING_FUSED_EVIDENCE_OPS
+            if not (f_ops or {}).get(op)
+        ]
+        if missing:
+            raise CmdringGateError(
+                "fused per-opcode ring-residency evidence missing for "
+                f"{missing}: the fused warm workload left fused slots "
+                "on the host path; refusing the capture"
+            )
+        nonzero = {k: v for k, v in (f_fb or {}).items() if v}
+        if f_fb is None or nonzero:
+            raise CmdringGateError(
+                "fused fallback-counters-zero gate failed: "
+                f"{nonzero or 'no fused fallback evidence'} — "
+                "unsupported_op, compressed and fused_decomposed must "
+                "all read 0 on the fused warm workload"
+            )
+        if f_unfused > 0 and f_step > f_unfused:
+            raise CmdringGateError(
+                f"fused step wall {f_step:.1f} us exceeds the unfused "
+                f"comparison step {f_unfused:.1f} us — the fused slots "
+                "buy nothing at this point; refusing the capture"
+            )
+        f_base = ((lkg_result or {}).get("extras") or {}).get(
+            "gang_cmdring_fused_step_us"
+        )
+        if f_base is not None and f_base > 0 and f_step > tol * f_base:
+            raise CmdringGateError(
+                f"gang_cmdring_fused_step_us {f_step:.1f} us regressed "
+                f"beyond {tol:.2f}x the last-known-good {f_base:.1f} "
+                "us; refusing the capture"
             )
     base = ((lkg_result or {}).get("extras") or {}).get(
         "gang_cmdring_dispatch_floor_us"

@@ -21,20 +21,26 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 from accl_tpu.cmdring import (
+    FUSED_BASE_OPS,
     SequencerMailbox,
     WindowShape,
+    decode_fparam,
     decode_slot,
+    encode_fparam,
     encode_slot,
     encode_window,
+    fused_slot_eligible,
     mailbox_for,
     register_mailbox,
     ring_widths,
     unregister_mailbox,
 )
 from accl_tpu.constants import (
+    CMDRING_FUSED_OPCODES,
     CMDRING_OPCODES,
     CMDRING_SLOT_WORDS,
     CmdOpcode,
+    FusedCompute,
     Operation,
     ReduceFunction,
 )
@@ -62,6 +68,67 @@ def codec_smoke() -> None:
     assert ring_widths(Operation.ALLTOALL, 8, 4) == (32, 32)
     assert ring_widths(Operation.BARRIER, 0, 4) == (1, 1)
     print("codec: ok")
+
+
+def fused_smoke() -> None:
+    """Fused compute slots, host half: codec round-trip with the
+    Q16.16 fparam word, the fused width relations, and the planner's
+    eligibility predicate — the same units the engine planner and both
+    lowerings read, importable without jax."""
+    # every fused hint maps to a slot opcode and round-trips the codec
+    # with its epilogue scalar
+    for fuse, opcode in CMDRING_FUSED_OPCODES.items():
+        words = encode_slot(
+            3, opcode, 64, dtype=2, peer=1, fparam=encode_fparam(0.5)
+        )
+        d = decode_slot(words)
+        assert d["opcode"] is opcode, fuse
+        assert decode_fparam(d["fparam"]) == 0.5  # exact: power of two
+    # Q16.16: exact on power-of-two training scalars, clamped at int32
+    for exact in (1.0, -1.0, 0.125, 2.0, 0.0):
+        assert decode_fparam(encode_fparam(exact)) == exact
+    assert abs(decode_fparam(encode_fparam(0.3)) - 0.3) < 1e-4
+    assert encode_fparam(1e12) == 2 ** 31 - 1
+    assert encode_fparam(-1e12) == -(2 ** 31)
+    # the width RELATIONS that classify fused slots on device:
+    # APPLY in == out*(size+1); ATTN_HOP in == 2*out; MATMUL_RS keeps
+    # the plain reduce-scatter geometry
+    assert ring_widths(
+        Operation.REDUCE_SCATTER, 8, 4, fuse=FusedCompute.MATMUL_RS
+    ) == (32, 8)
+    assert ring_widths(
+        Operation.ALLREDUCE, 8, 4, fuse=FusedCompute.APPLY
+    ) == (40, 8)
+    assert ring_widths(
+        Operation.ALLREDUCE, 8, 4, fuse=FusedCompute.ATTN_HOP
+    ) == (16, 8)
+    # planner eligibility: every fuse is eligible on its base op at the
+    # fused operand width, and each refusal reason fires exactly where
+    # the engine counts it
+    for fuse, base in FUSED_BASE_OPS.items():
+        in_w, _out_w = ring_widths(base, 8, 4, fuse=fuse)
+        assert fused_slot_eligible(
+            fuse, base, 4, 8, in_w, np.float32
+        ) is None, fuse
+    cases = (
+        ((99, Operation.ALLREDUCE, 4, 8, 40, np.float32),
+         "unknown_fuse"),
+        ((FusedCompute.APPLY, Operation.REDUCE_SCATTER, 4, 8, 40,
+          np.float32), "fused_base_op"),
+        ((FusedCompute.MATMUL_RS, Operation.REDUCE_SCATTER, 1, 8, 8,
+          np.float32), "fused_world_too_small"),
+        ((FusedCompute.APPLY, Operation.ALLREDUCE, 4, 8, 40, np.int32),
+         "fused_dtype"),
+        ((FusedCompute.ATTN_HOP, Operation.ALLREDUCE, 4, 8, 8,
+          np.float32), "fused_operand_width"),
+    )
+    for args, want in cases:
+        assert fused_slot_eligible(*args) == want, (args, want)
+    assert fused_slot_eligible(
+        FusedCompute.APPLY, Operation.ALLREDUCE, 4, 8, 40, np.float32,
+        compressed=True,
+    ) == "fused_compressed"
+    print("fused: ok")
 
 
 def mailbox_smoke() -> None:
@@ -157,6 +224,20 @@ def gate_smoke() -> None:
         },
     }
     pr.check_cmdring(dict(good), {})
+    fused_good = dict(
+        good,
+        gang_cmdring_fused_step_us=9000.0,
+        gang_cmdring_unfused_step_us=18000.0,
+        gang_cmdring_fused_interactions_per_step=1.0,
+        gang_cmdring_fused_refills_per_step=1.0,
+        gang_cmdring_fused_op_slots={
+            op: 1 for op in pr.CMDRING_FUSED_EVIDENCE_OPS
+        },
+        gang_cmdring_fused_fallbacks={
+            "unsupported_op": 0, "compressed": 0, "fused_decomposed": 0,
+        },
+    )
+    pr.check_cmdring(dict(fused_good), {})
     for mutate, expect in (
         ({"gang_cmdring_redispatches_per_window": 1.0}, "re-dispatched"),
         (
@@ -170,11 +251,28 @@ def gate_smoke() -> None:
             assert expect in str(e), e
         else:
             raise AssertionError(f"gate accepted {mutate}")
+    # fused-evidence refusals: host re-entry, decomposed fallbacks, and
+    # a fused step slower than the unfused comparison all poison the
+    # capture the same way
+    for mutate, expect in (
+        ({"gang_cmdring_fused_interactions_per_step": 2.0,
+          "gang_cmdring_fused_refills_per_step": 2.0}, "re-entering"),
+        ({"gang_cmdring_fused_fallbacks": {"fused_decomposed": 2}},
+         "fallback"),
+        ({"gang_cmdring_fused_step_us": 20000.0}, "buy nothing"),
+    ):
+        try:
+            pr.check_cmdring(dict(fused_good, **mutate), {})
+        except pr.CmdringGateError as e:
+            assert expect in str(e), e
+        else:
+            raise AssertionError(f"gate accepted {mutate}")
     print("gate: ok")
 
 
 def main() -> int:
     codec_smoke()
+    fused_smoke()
     mailbox_smoke()
     gate_smoke()
     print("ring smoke: all ok")

@@ -1254,6 +1254,93 @@ def test_cmdring_flags_unimplemented_opcode_in_decoder(
     assert "unimplemented" in findings[0].message
 
 
+# the fused-opcode contract (kernel-initiated collectives): growing the
+# enum with FUSED_* compute slots without wiring the Operation map or a
+# lowering fails the tree — each wiring obligation has a known-bad
+# fixture
+
+_RING_CONSTS_FUSED = _RING_CONSTS + """
+class CmdOpcode:
+    NOP = 0
+    ALLREDUCE = 1
+    HALT = 2
+    FUSED_MATMUL_RS = 3
+    FUSED_APPLY = 4
+
+CMDRING_OPCODES = {
+    "allreduce": CmdOpcode.ALLREDUCE,
+    "fused_matmul_rs": CmdOpcode.FUSED_MATMUL_RS,
+    "fused_apply": CmdOpcode.FUSED_APPLY,
+}
+"""
+
+_RING_DECODER_FUSED = """
+from ...constants import CMDRING_FIELDS, CmdOpcode
+_F = CMDRING_FIELDS
+def decode(op, blocks, own, fp):
+    if op == CmdOpcode.ALLREDUCE:
+        return sum(blocks)
+    if op == CmdOpcode.FUSED_MATMUL_RS:
+        return fp * sum(blocks)
+    if op == CmdOpcode.FUSED_APPLY:
+        return own - fp * sum(blocks)
+    return own
+"""
+
+
+def test_cmdring_fused_opcode_contract_clean(tmp_path, monkeypatch):
+    findings = _ring_pkg(
+        tmp_path, monkeypatch, _RING_CONSTS_FUSED, _RING_DECODER_FUSED
+    )
+    assert not findings
+
+
+def test_cmdring_flags_sparse_fused_opcode_values(tmp_path, monkeypatch):
+    """A fused opcode added off the dense range (the tempting 0x10
+    block) breaks the sequencer's range-check status path."""
+    sparse = _RING_CONSTS_FUSED.replace(
+        "FUSED_APPLY = 4", "FUSED_APPLY = 16"
+    )
+    findings = _ring_pkg(
+        tmp_path, monkeypatch, sparse, _RING_DECODER_FUSED
+    )
+    assert len(findings) == 1
+    assert "dense" in findings[0].message
+    assert "CmdOpcode" in findings[0].message
+
+
+def test_cmdring_flags_unmapped_fused_opcode(tmp_path, monkeypatch):
+    """A fused opcode no Operation maps onto is dead enum growth — the
+    engine planner can never encode it."""
+    unmapped = _RING_CONSTS_FUSED.replace(
+        '    "fused_apply": CmdOpcode.FUSED_APPLY,\n', ""
+    )
+    findings = _ring_pkg(
+        tmp_path, monkeypatch, unmapped, _RING_DECODER_FUSED
+    )
+    assert len(findings) == 1
+    assert "FUSED_APPLY" in findings[0].message
+    assert "CMDRING_OPCODES" in findings[0].message
+
+
+def test_cmdring_flags_fused_opcode_missing_from_lowerings(
+    tmp_path, monkeypatch
+):
+    """The both-lowerings presence check: a fused opcode the decode
+    module (the shared decode loop BOTH lowerings run) never references
+    is an unimplemented epilogue, caught by the tree not a workload."""
+    decoder = _RING_DECODER_FUSED.replace(
+        "    if op == CmdOpcode.FUSED_APPLY:\n"
+        "        return own - fp * sum(blocks)\n", ""
+    )
+    findings = _ring_pkg(
+        tmp_path, monkeypatch, _RING_CONSTS_FUSED, decoder
+    )
+    assert len(findings) == 1
+    assert "FUSED_APPLY" in findings[0].message
+    assert "unimplemented" in findings[0].message
+
+
 # ---------------------------------------------------------------------------
 # postmortem-path (causal trace plane PR)
 # ---------------------------------------------------------------------------

@@ -21,7 +21,15 @@ from accl_tpu.constants import (
     CMDRING_FIELDS,
     CMDRING_SLOT_WORDS,
     CmdOpcode,
+    FusedCompute,
+    Operation,
     ReduceFunction,
+)
+from accl_tpu.cmdring import (
+    decode_fparam,
+    encode_fparam,
+    fused_slot_eligible,
+    ring_widths,
 )
 from accl_tpu.core import xla_group
 from accl_tpu.ops.pallas.cmdring import (
@@ -514,6 +522,21 @@ def test_committed_cpu_capture_passes_gate():
     assert not any(
         doc["cmdring"]["gang_cmdring_mixed_fallbacks"].values()
     )
+    # ...and the fused-compute-slot evidence (kernel-initiated
+    # collectives): the warm fused train step at exactly its refill
+    # count in host interactions, no faster-unfused inversion, every
+    # fused opcode ring-resident with fused fallbacks at zero
+    cm = doc["cmdring"]
+    assert cm["gang_cmdring_fused_interactions_per_step"] == (
+        cm["gang_cmdring_fused_refills_per_step"]
+    )
+    assert cm["gang_cmdring_fused_interactions_per_step"] <= 1.0
+    assert cm["gang_cmdring_fused_step_us"] <= (
+        cm["gang_cmdring_unfused_step_us"]
+    )
+    for op in mod.CMDRING_FUSED_EVIDENCE_OPS:
+        assert cm["gang_cmdring_fused_op_slots"][op] > 0
+    assert not any(cm["gang_cmdring_fused_fallbacks"].values())
 
 
 def test_mixed_dtype_window_falls_back(g4):
@@ -1200,3 +1223,593 @@ def test_f16_window_rides_ring_bit_accurate():
     finally:
         for a in g:
             a.deinit()
+
+# ---------------------------------------------------------------------------
+# fused compute slots: kernel-initiated collectives (the accl_hls analog)
+# ---------------------------------------------------------------------------
+
+
+def test_fused_slot_codec_round_trip():
+    """Fused opcodes ride the same 11-word slot with the epilogue
+    scalar in the Q16.16 fparam word — exact for the power-of-two
+    alphas/lrs/scales that dominate training."""
+    for fuse, opcode in (
+        (FusedCompute.MATMUL_RS, CmdOpcode.FUSED_MATMUL_RS),
+        (FusedCompute.APPLY, CmdOpcode.FUSED_APPLY),
+        (FusedCompute.ATTN_HOP, CmdOpcode.FUSED_ATTN_HOP),
+    ):
+        words = encode_slot(
+            7, opcode, 64, dtype=2, root=1, nseg=1, peer=1,
+            fparam=encode_fparam(0.125),
+        )
+        d = decode_slot(words)
+        assert d["opcode"] is opcode, fuse
+        assert decode_fparam(d["fparam"]) == 0.125  # exact: power of two
+    # Q16.16 exactness + clamp behavior
+    for exact in (1.0, -1.0, 0.5, 2.0, 0.0078125, -0.25):
+        assert decode_fparam(encode_fparam(exact)) == exact
+    assert abs(decode_fparam(encode_fparam(0.1)) - 0.1) < 1e-4
+    assert encode_fparam(1e9) == 2 ** 31 - 1  # clamped, never wraps
+    assert encode_fparam(-1e9) == -(2 ** 31)
+
+
+def test_ring_widths_fused_geometry():
+    """The width RELATIONS that classify fused slots: APPLY packs the
+    param shard behind the grads (in == out*(size+1)); ATTN_HOP packs
+    q behind kv (in == 2*out); MATMUL_RS keeps the plain RS geometry."""
+    assert ring_widths(
+        Operation.REDUCE_SCATTER, 8, 4, fuse=FusedCompute.MATMUL_RS
+    ) == (32, 8)
+    assert ring_widths(
+        Operation.ALLREDUCE, 8, 4, fuse=FusedCompute.APPLY
+    ) == (40, 8)
+    assert ring_widths(
+        Operation.ALLREDUCE, 8, 4, fuse=FusedCompute.ATTN_HOP
+    ) == (16, 8)
+
+
+def test_fused_eligibility_reasons():
+    """The ONE fused-eligibility predicate and its counted reasons —
+    the planner refuses exactly what the lowerings cannot sequence."""
+    f32 = np.float32
+    ok = fused_slot_eligible(
+        FusedCompute.APPLY, Operation.ALLREDUCE, 4, 8, 40, f32
+    )
+    assert ok is None
+    assert fused_slot_eligible(
+        99, Operation.ALLREDUCE, 4, 8, 40, f32
+    ) == "unknown_fuse"
+    assert fused_slot_eligible(
+        FusedCompute.APPLY, Operation.REDUCE_SCATTER, 4, 8, 40, f32
+    ) == "fused_base_op"
+    assert fused_slot_eligible(
+        FusedCompute.APPLY, Operation.ALLREDUCE, 1, 8, 16, f32
+    ) == "fused_world_too_small"
+    assert fused_slot_eligible(
+        FusedCompute.APPLY, Operation.ALLREDUCE, 4, 8, 40, np.int32
+    ) == "fused_dtype"
+    assert fused_slot_eligible(
+        FusedCompute.APPLY, Operation.ALLREDUCE, 4, 8, 32, f32
+    ) == "fused_operand_width"
+    assert fused_slot_eligible(
+        FusedCompute.APPLY, Operation.ALLREDUCE, 4, 8, 40, f32,
+        compressed=True,
+    ) == "fused_compressed"
+
+
+def test_fused_warm_window_counter_asserted(g4):
+    """THE tentpole counter-assert: a warm window mixing all three
+    fused opcodes is exactly ONE host refill interaction, every slot
+    ring-resident with zero fused fallbacks, and the epilogues compute
+    on-device: scaled reduce-scatter of GEMM partials, optimizer
+    apply-on-arrival, and the ring-attention hop partial."""
+    ring = _ring(g4[0])
+    world, n, lr, scale = 4, 16, 0.25, 0.5
+    parts = [
+        np.arange(world * n, dtype=np.float32) + 10.0 * r
+        for r in range(world)
+    ]
+    grads = [
+        np.arange(world * n, dtype=np.float32) * 0.1 + r
+        for r in range(world)
+    ]
+    params = [np.full(n, 100.0 + r, np.float32) for r in range(world)]
+    kv = [np.arange(n, dtype=np.float32) + 5.0 * r for r in range(world)]
+    q = [np.arange(n, dtype=np.float32) * 0.5 + r for r in range(world)]
+    mm_send = [a.create_buffer_from(parts[r]) for r, a in enumerate(g4)]
+    mm_out = [a.create_buffer(n, np.float32) for a in g4]
+    ap_send = [
+        a.create_buffer_from(np.concatenate([grads[r], params[r]]))
+        for r, a in enumerate(g4)
+    ]
+    ap_out = [a.create_buffer(n, np.float32) for a in g4]
+    hp_send = [
+        a.create_buffer_from(np.concatenate([kv[r], q[r]]))
+        for r, a in enumerate(g4)
+    ]
+    hp_out = [a.create_buffer(n, np.float32) for a in g4]
+
+    def work(a, r):
+        with a.batch():
+            r1 = a.fused_matmul_reduce_scatter(
+                mm_send[r], mm_out[r], n, scale=scale, run_async=True
+            )
+            r2 = a.fused_apply(
+                ap_send[r], ap_out[r], n, lr=lr, run_async=True
+            )
+            r3 = a.fused_attn_hop(
+                hp_send[r], hp_out[r], hop=1, count=n, scale=2.0,
+                run_async=True,
+            )
+        reqs = (r1, r2, r3)
+        for req in reqs:
+            assert req.wait(60)
+            req.check()
+        return reqs
+
+    run_parallel(g4, work)  # cold: compiles the fused window program
+    st0 = ring.stats()
+    ic0 = _interactions(g4[0])
+    reqs = run_parallel(g4, work)
+    st1 = ring.stats()
+    assert _interactions(g4[0]) - ic0 == 1, (
+        "a warm fused window of 3 compute slots must be exactly ONE "
+        "host refill interaction — compute never re-enters the host"
+    )
+    assert st1["refills"] - st0["refills"] == 1
+    assert st1["slots"] - st0["slots"] == 3
+    for op in ("FUSED_MATMUL_RS", "FUSED_APPLY", "FUSED_ATTN_HOP"):
+        assert st1["ops"].get(op, 0) - st0["ops"].get(op, 0) == 1, op
+    for reason in ("unsupported_op", "compressed", "fused_decomposed"):
+        assert st1["fallbacks"].get(reason, 0) == (
+            st0["fallbacks"].get(reason, 0)
+        ), reason
+    for rank_reqs in reqs:
+        for req in rank_reqs:
+            assert req.ring_resident is True
+    mm_ref = scale * np.sum(parts, axis=0).reshape(world, n)
+    gsum = np.sum(grads, axis=0).reshape(world, n)
+    for r in range(world):
+        mm_out[r].sync_from_device()
+        np.testing.assert_allclose(mm_out[r].data, mm_ref[r], rtol=1e-6)
+        ap_out[r].sync_from_device()
+        np.testing.assert_allclose(
+            ap_out[r].data, params[r] - lr * gsum[r], rtol=1e-6
+        )
+        hp_out[r].sync_from_device()
+        np.testing.assert_allclose(
+            hp_out[r].data, 2.0 * q[r] * kv[(r - 1) % world], rtol=1e-6
+        )
+
+
+def test_fused_ineligible_decomposes_counted(g4):
+    """A fused call the ring cannot sequence (int operand) NEVER runs
+    the plain base op: it decomposes on host with a counted
+    ``fused_decomposed`` fallback and bit-exact epilogue semantics."""
+    ring = _ring(g4[0])
+    world, n = 4, 8
+    grads = [
+        (np.arange(world * n) + r).astype(np.int32) for r in range(world)
+    ]
+    params = [np.full(n, 1000 * (r + 1), np.int32) for r in range(world)]
+    send = [
+        a.create_buffer_from(np.concatenate([grads[r], params[r]]))
+        for r, a in enumerate(g4)
+    ]
+    out = [a.create_buffer(n, np.int32) for a in g4]
+
+    def work(a, r):
+        with a.batch():
+            req = a.fused_apply(send[r], out[r], n, lr=2.0, run_async=True)
+        assert req.wait(60)
+        req.check()
+        return req
+
+    slots0 = ring.stats()["slots"]
+    dec0 = ring.stats()["fallbacks"].get("fused_decomposed", 0)
+    reqs = run_parallel(g4, work)
+    st = ring.stats()
+    assert st["fallbacks"].get("fused_decomposed", 0) > dec0
+    assert st["slots"] == slots0  # nothing rode the ring
+    for req in reqs:
+        assert req.ring_resident is None
+    gsum = np.sum(np.stack(grads), axis=0).reshape(world, n)
+    for r in range(world):
+        out[r].sync_from_device()
+        np.testing.assert_array_equal(
+            out[r].data, params[r] - 2 * gsum[r]
+        )  # exact: integer arithmetic, lr=2.0 exact in Q16.16
+
+
+# ---------------------------------------------------------------------------
+# streaming-posture registers: autotuner axes dispatched per plan key
+# ---------------------------------------------------------------------------
+
+
+def test_window_posture_reads_tuning_overlay(g4):
+    """_window_posture: the lead call's per-bucket register overlay
+    steers the arming window's (run_windows, linger_s); calls without
+    an overlay keep the gang's env-default posture (0 = default)."""
+    from accl_tpu.backends.base import CallOptions
+
+    ring = _ring(g4[0])
+    lead = CallOptions(
+        op=Operation.ALLREDUCE,
+        tuning={"cmdring_run_windows": 5, "cmdring_linger_us": 200000},
+    )
+    rw, ls = ring._window_posture([([], lead, {})])
+    assert rw == 5 and abs(ls - 0.2) < 1e-12
+    plain = CallOptions(op=Operation.ALLREDUCE)
+    assert ring._window_posture([([], plain, {})]) == (
+        ring.run_windows, ring.linger_s,
+    )
+    # a zero register means "env default", not "zero windows"
+    zero = CallOptions(
+        op=Operation.ALLREDUCE,
+        tuning={"cmdring_run_windows": 0, "cmdring_linger_us": 0},
+    )
+    assert ring._window_posture([([], zero, {})]) == (
+        ring.run_windows, ring.linger_s,
+    )
+
+
+def test_posture_plan_overlay_arms_resident_run(g4):
+    """E2E per-plan-key dispatch: a loaded TuningPlan's posture
+    registers ride CallOptions.tuning into _window_posture, so the
+    resident run armed by that bucket's stream carries the plan's
+    run-window budget and linger — not the env defaults."""
+    from accl_tpu.plans import size_bucket
+    from accl_tpu.tuning import TuningPlan
+
+    ring = _ring(g4[0])
+    n = 32
+    plan = TuningPlan(
+        world=4, tier="xla",
+        entries={"allreduce": {size_bucket(n): {"registers": {
+            "cmdring_run_windows": 3, "cmdring_linger_us": 900000,
+        }}}},
+    )
+    send = [
+        a.create_buffer_from(np.full(n, float(r + 1), np.float32))
+        for r, a in enumerate(g4)
+    ]
+    out = [a.create_buffer(n, np.float32) for a in g4]
+
+    def stream(a, r):
+        all_reqs = []
+        a.begin_batch()
+        try:
+            for _ in range(3):
+                all_reqs.extend(
+                    a.allreduce(send[r], out[r], n, run_async=True)
+                    for _ in range(2)
+                )
+                a._dispatch_pending()  # post pipelined, do NOT drain
+        finally:
+            a.end_batch()
+        for req in all_reqs:
+            assert req.wait(60)
+            req.check()
+
+    for a in g4:
+        a.load_tuning_plan(plan)
+    try:
+        run_parallel(g4, stream)  # arms the run under the overlay
+        comm_id = g4[0]._world.id
+        run = ring._sessions[comm_id].run
+        assert run is not None, "stream never armed a resident run"
+        assert run.mbox.run_windows == 3
+        assert abs(run.mbox.linger_s - 0.9) < 1e-12
+    finally:
+        for a in g4:
+            a.unload_tuning_plan()
+        run_parallel(g4, lambda a, r: a.soft_reset())  # kill the 0.9 s
+        # linger before the next test's counters read the ring
+    for r in range(4):
+        out[r].sync_from_device()
+        np.testing.assert_allclose(out[r].data, 10.0)
+
+
+# ---------------------------------------------------------------------------
+# chaos: fused windows fail fast, recover via soft_reset — never hang
+# ---------------------------------------------------------------------------
+
+
+def _fused_apply_buffers(g4, world=4, n=8):
+    grads = [
+        np.arange(world * n, dtype=np.float32) + r for r in range(world)
+    ]
+    params = [np.full(n, 50.0 + r, np.float32) for r in range(world)]
+    send = [
+        a.create_buffer_from(np.concatenate([grads[r], params[r]]))
+        for r, a in enumerate(g4)
+    ]
+    out = [a.create_buffer(n, np.float32) for a in g4]
+    ref = [
+        params[r] - 0.5 * np.sum(grads, axis=0).reshape(world, n)[r]
+        for r in range(world)
+    ]
+    return send, out, ref
+
+
+def _drive_fused(g4, send, out, n=8):
+    """One fused_apply window per rank; returns {rank: ACCLError}."""
+    import threading
+    import time as _time
+
+    from accl_tpu import ACCLError
+
+    errs = {}
+
+    def runner(a, r):
+        try:
+            with a.batch():
+                req = a.fused_apply(
+                    send[r], out[r], n, lr=0.5, run_async=True
+                )
+            assert req.wait(60)
+            req.check()
+        except ACCLError as e:
+            errs[r] = e
+
+    threads = [
+        threading.Thread(
+            target=runner, args=(a, i), name=f"accl-fused-rank{i}",
+            daemon=True,
+        )
+        for i, a in enumerate(g4)
+    ]
+    t0 = _time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert all(not t.is_alive() for t in threads), "fused window hung"
+    return errs, _time.monotonic() - t0
+
+
+@pytest.mark.chaos
+def test_chaos_corrupt_fused_window_fails_fast_soft_reset_recovers(g4):
+    """A corrupt fault mid-fused-window poisons the refill's opcode
+    word: the sequencer reports BAD_OP and the slot's requests fail
+    INVALID_OPERATION fast — with the flight-recorder tail — never a
+    hang; soft_reset then recovers the ring for a clean fused window."""
+    from accl_tpu import ErrorCode, FaultPlan, FaultRule
+    from accl_tpu import contract as contract_mod
+
+    ring = _ring(g4[0])
+    n = 8
+    send, out, ref = _fused_apply_buffers(g4, n=n)
+    _drive_fused(g4, send, out, n=n)  # cold: compile before the chaos
+    contract_mod.install_fault_plan(FaultPlan(
+        rules=[FaultRule(
+            action="corrupt", msg_type="RING", nth=1, count=1,
+        )],
+        seed=11,
+    ))
+    try:
+        errs, elapsed = _drive_fused(g4, send, out, n=n)
+        assert elapsed < 15, "corrupted fused window took the slow path"
+        assert errs, "poisoned fused window completed without error"
+        for e in errs.values():
+            assert e.code == ErrorCode.INVALID_OPERATION
+            assert "flight_recorder" in e.details
+        assert ring.stats()["chaos_faults"].get("corrupt", 0) >= 1
+    finally:
+        contract_mod.install_fault_plan(None)
+    run_parallel(g4, lambda a, r: a.soft_reset())
+    errs, _ = _drive_fused(g4, send, out, n=n)
+    assert not errs, f"fused window failed after soft_reset: {errs}"
+    for r in range(4):
+        out[r].sync_from_device()
+        np.testing.assert_allclose(out[r].data, ref[r], rtol=1e-6)
+
+
+@pytest.mark.chaos
+def test_chaos_delay_fused_window_bounded_and_correct(g4):
+    """A delay fault on the fused refill is BOUNDED (the ring clamps
+    the injected sleep) and the window still completes bit-correct —
+    delay perturbs timing, never results."""
+    from accl_tpu import FaultPlan, FaultRule
+    from accl_tpu import contract as contract_mod
+
+    ring = _ring(g4[0])
+    n = 8
+    send, out, ref = _fused_apply_buffers(g4, n=n)
+    _drive_fused(g4, send, out, n=n)  # cold
+    delays0 = ring.stats()["chaos_faults"].get("delay", 0)
+    contract_mod.install_fault_plan(FaultPlan(
+        rules=[FaultRule(
+            action="delay", msg_type="RING", nth=1, count=1,
+            delay_s=0.3,
+        )],
+        seed=12,
+    ))
+    try:
+        errs, elapsed = _drive_fused(g4, send, out, n=n)
+    finally:
+        contract_mod.install_fault_plan(None)
+    assert not errs, f"delayed fused window failed: {errs}"
+    assert elapsed < 15
+    assert ring.stats()["chaos_faults"].get("delay", 0) > delays0
+    for r in range(4):
+        out[r].sync_from_device()
+        np.testing.assert_allclose(out[r].data, ref[r], rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# the extended capture gate: fused-evidence refusals
+# ---------------------------------------------------------------------------
+
+
+def _fused_evidence(**over):
+    ev = _evidence(
+        gang_cmdring_fused_step_us=9000.0,
+        gang_cmdring_unfused_step_us=18000.0,
+        gang_cmdring_fused_interactions_per_step=1.0,
+        gang_cmdring_fused_refills_per_step=1.0,
+        gang_cmdring_fused_op_slots={
+            "FUSED_MATMUL_RS": 1, "FUSED_APPLY": 1, "FUSED_ATTN_HOP": 1,
+        },
+        gang_cmdring_fused_fallbacks={
+            "unsupported_op": 0, "compressed": 0, "fused_decomposed": 0,
+        },
+    )
+    ev.update(over)
+    return ev
+
+
+def test_check_cmdring_passes_fused_capture():
+    _gate().check_cmdring(_fused_evidence(), {})
+
+
+def test_check_cmdring_refuses_partial_fused_evidence():
+    mod = _gate()
+    for missing in (
+        "gang_cmdring_fused_step_us",
+        "gang_cmdring_unfused_step_us",
+        "gang_cmdring_fused_interactions_per_step",
+        "gang_cmdring_fused_refills_per_step",
+    ):
+        ev = _fused_evidence()
+        del ev[missing]
+        with pytest.raises(mod.CmdringGateError, match="partial fused"):
+            mod.check_cmdring(ev, {})
+
+
+def test_check_cmdring_refuses_fused_host_reentry():
+    """interactions/step must EQUAL the refill count and never exceed
+    one — a fused step re-entering the host between compute and
+    collective is exactly what the tentpole removes."""
+    mod = _gate()
+    with pytest.raises(mod.CmdringGateError, match="re-entering"):
+        mod.check_cmdring(_fused_evidence(
+            gang_cmdring_fused_interactions_per_step=2.0,
+            gang_cmdring_fused_refills_per_step=2.0,
+        ), {})
+    with pytest.raises(mod.CmdringGateError, match="re-entering"):
+        mod.check_cmdring(_fused_evidence(
+            gang_cmdring_fused_interactions_per_step=1.0,
+            gang_cmdring_fused_refills_per_step=0.5,
+        ), {})
+
+
+def test_check_cmdring_requires_fused_opcode_residency():
+    mod = _gate()
+    ev = _fused_evidence()
+    ev["gang_cmdring_fused_op_slots"] = dict(
+        ev["gang_cmdring_fused_op_slots"], FUSED_ATTN_HOP=0
+    )
+    with pytest.raises(mod.CmdringGateError, match="FUSED_ATTN_HOP"):
+        mod.check_cmdring(ev, {})
+
+
+def test_check_cmdring_fused_fallback_zero_gate():
+    mod = _gate()
+    for bad in (
+        {"unsupported_op": 1, "compressed": 0, "fused_decomposed": 0},
+        {"unsupported_op": 0, "compressed": 0, "fused_decomposed": 2},
+        None,  # fallbacks absent entirely: unverifiable, refused
+    ):
+        ev = _fused_evidence()
+        if bad is None:
+            del ev["gang_cmdring_fused_fallbacks"]
+        else:
+            ev["gang_cmdring_fused_fallbacks"] = bad
+        with pytest.raises(mod.CmdringGateError, match="fallback"):
+            mod.check_cmdring(ev, {})
+
+
+def test_check_cmdring_refuses_fused_slower_than_unfused():
+    mod = _gate()
+    with pytest.raises(mod.CmdringGateError, match="buy nothing"):
+        mod.check_cmdring(_fused_evidence(
+            gang_cmdring_fused_step_us=20000.0,
+            gang_cmdring_unfused_step_us=18000.0,
+        ), {})
+
+
+def test_check_cmdring_refuses_unanchored_fused_evidence():
+    """Fused keys WITHOUT the base command-ring evidence are refused —
+    unanchored fused counters would gate nothing."""
+    mod = _gate()
+    with pytest.raises(mod.CmdringGateError, match="unanchored"):
+        mod.check_cmdring({
+            "gang_cmdring_fused_step_us": 9000.0,
+            "gang_cmdring_fused_interactions_per_step": 1.0,
+        }, {})
+
+
+def test_check_cmdring_refuses_fused_lkg_regression():
+    mod = _gate()
+    lkg = {"extras": _fused_evidence(gang_cmdring_fused_step_us=1000.0)}
+    with pytest.raises(mod.CmdringGateError, match="fused_step_us"):
+        mod.check_cmdring(_fused_evidence(), lkg)
+
+
+# ---------------------------------------------------------------------------
+# model zoo opt-in: the fuse-hint helpers ride real training shapes
+# ---------------------------------------------------------------------------
+
+
+def test_model_zoo_fused_helpers_ride_ring(g4):
+    """transformer.fused_optimizer_step and
+    ring_attention.fused_hop_partial opt model code into fused slots
+    through the facade — warm steps stay at the refill count with the
+    documented epilogue numerics."""
+    from accl_tpu.models.ring_attention import fused_hop_partial
+    from accl_tpu.models.transformer import fused_optimizer_step
+
+    ring = _ring(g4[0])
+    world, n, lr = 4, 16, 0.125
+    buckets = 2
+    grads = [
+        [
+            np.arange(world * n, dtype=np.float32) * 0.01 + b + r
+            for b in range(buckets)
+        ]
+        for r in range(world)
+    ]
+    params = [
+        [np.full(n, 10.0 * (b + 1) + r, np.float32) for b in range(buckets)]
+        for r in range(world)
+    ]
+
+    def opt_step(a, r):
+        return fused_optimizer_step(a, grads[r], params[r], lr=lr)
+
+    run_parallel(g4, opt_step)  # cold
+    st0 = ring.stats()
+    ic0 = _interactions(g4[0])
+    outs = run_parallel(g4, opt_step)
+    st1 = ring.stats()
+    assert _interactions(g4[0]) - ic0 == 1  # all buckets, one refill
+    assert st1["refills"] - st0["refills"] == 1
+    assert st1["ops"].get("FUSED_APPLY", 0) - st0["ops"].get(
+        "FUSED_APPLY", 0
+    ) == buckets
+    for r in range(world):
+        gsum = np.sum(
+            [grads[rr] for rr in range(world)], axis=0
+        )  # (buckets, world*n)
+        for b in range(buckets):
+            ref = params[r][b] - lr * gsum[b].reshape(world, n)[r]
+            np.testing.assert_allclose(outs[r][b], ref, rtol=1e-6)
+
+    kv = [np.arange(n, dtype=np.float32) + r for r in range(world)]
+    q = [np.arange(n, dtype=np.float32) * 0.25 + r for r in range(world)]
+
+    def hop(a, r):
+        return fused_hop_partial(a, kv[r], q[r], hop=1, scale=4.0)
+
+    run_parallel(g4, hop)  # cold
+    st0 = ring.stats()
+    outs = run_parallel(g4, hop)
+    st1 = ring.stats()
+    assert st1["ops"].get("FUSED_ATTN_HOP", 0) - st0["ops"].get(
+        "FUSED_ATTN_HOP", 0
+    ) == 1
+    for r in range(world):
+        np.testing.assert_allclose(
+            outs[r], 4.0 * q[r] * kv[(r - 1) % world], rtol=1e-6
+        )

@@ -831,3 +831,116 @@ def test_partition_heals_end_to_end_inproc():
         assert run_parallel(g, work, timeout=30.0) == [3.0, 3.0]
     finally:
         _deinit(g)
+
+
+# ---------------------------------------------------------------------------
+# fused compute slots: the contract fingerprint covers the fuse hint
+# ---------------------------------------------------------------------------
+
+
+def _drive_ranks(group, work, timeout=60):
+    """Thread-per-rank driver returning {rank: ACCLError}; joins are
+    bounded — a hang is a test failure, not a suite timeout."""
+    errs = {}
+
+    def runner(a, rank):
+        try:
+            work(a, rank)
+        except ACCLError as e:
+            errs[rank] = e
+
+    threads = [
+        threading.Thread(
+            target=runner, args=(a, i), name=f"accl-fuse-skew-rank{i}",
+            daemon=True,
+        )
+        for i, a in enumerate(group)
+    ]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout)
+    assert all(not t.is_alive() for t in threads), "rank thread hung"
+    return errs, time.monotonic() - t0
+
+
+def test_fuse_vs_plain_skew_convicts_within_one_window():
+    """The contract fingerprint covers fused opcodes: a rank issuing a
+    FUSED_APPLY where its peers issue the plain allreduce (same base
+    op, same count — only the fuse hint skews) is convicted by the
+    majority within one verification window, every rank failing
+    CONTRACT_VIOLATION fast instead of wedging the gang window."""
+    from accl_tpu.core import xla_group
+
+    g = xla_group(4)
+    try:
+        for a in g:
+            a.set_contract_verify(True, interval=1)
+        n = 8
+        world = 4
+
+        def work(a, rank):
+            s = a.create_buffer_from(
+                np.full(n, rank + 1.0, np.float32)
+            )
+            d = a.create_buffer(n, np.float32)
+            a.allreduce(s, d, n)
+            if rank == 2:
+                packed = a.create_buffer_from(np.concatenate([
+                    np.ones(world * n, np.float32),
+                    np.full(n, 5.0, np.float32),
+                ]))
+                a.fused_apply(packed, d, n, lr=0.5)  # the skewed call
+            else:
+                a.allreduce(s, d, n)
+            a.allreduce(s, d, n)
+
+        errs, elapsed = _drive_ranks(g, work)
+        assert elapsed < 15, "fuse-vs-plain skew took the slow path"
+        assert errs, "skewed fuse hint was never convicted"
+        for e in errs.values():
+            assert e.code == ErrorCode.CONTRACT_VIOLATION
+            assert e.details["diverging_rank"] == 2
+    finally:
+        for a in g:
+            a.deinit()
+
+
+def test_uniform_fused_stream_passes_contract():
+    """The complement: an SPMD-uniform fused stream verifies clean —
+    the .fused suffix skews only when ranks actually disagree."""
+    from accl_tpu.core import xla_group
+
+    g = xla_group(4)
+    try:
+        for a in g:
+            a.set_contract_verify(True, interval=1)
+        n = 8
+        world = 4
+        grads = [
+            np.arange(world * n, dtype=np.float32) + r
+            for r in range(world)
+        ]
+        params = [np.full(n, 9.0 + r, np.float32) for r in range(world)]
+        send = [
+            a.create_buffer_from(np.concatenate([grads[r], params[r]]))
+            for r, a in enumerate(g)
+        ]
+        out = [a.create_buffer(n, np.float32) for a in g]
+
+        def work(a, rank):
+            for _ in range(3):
+                a.fused_apply(send[rank], out[rank], n, lr=0.5)
+
+        errs, _ = _drive_ranks(g, work)
+        assert not errs, f"uniform fused stream convicted: {errs}"
+        gsum = np.sum(grads, axis=0).reshape(world, n)
+        for r in range(world):
+            out[r].sync_from_device()
+            np.testing.assert_allclose(
+                out[r].data, params[r] - 0.5 * gsum[r], rtol=1e-6
+            )
+    finally:
+        for a in g:
+            a.deinit()

@@ -140,6 +140,92 @@ def test_validate_registers_rejects_garbage():
     assert out == {"allreduce_algorithm": "ring", "ring_segments": 2}
 
 
+def test_validate_registers_posture_clamps():
+    """The persistent-sequencer posture registers validate with the
+    engines' own SET_TUNING bounds: an unbounded run budget or >1s
+    linger would pin the device stream, so a plan carrying one fails at
+    load — not as CONFIG_ERROR mid-collective."""
+    from accl_tpu.constants import CMDRING_MAX_RUN_WINDOWS
+
+    out = validate_registers({
+        "cmdring_run_windows": CMDRING_MAX_RUN_WINDOWS,
+        "cmdring_linger_us": 1_000_000,
+    })
+    assert out == {
+        "cmdring_run_windows": CMDRING_MAX_RUN_WINDOWS,
+        "cmdring_linger_us": 1_000_000,
+    }
+    assert validate_registers({"cmdring_run_windows": 0}) == {
+        "cmdring_run_windows": 0  # 0 = env default, always valid
+    }
+    with pytest.raises(ValueError, match="cmdring_run_windows"):
+        validate_registers(
+            {"cmdring_run_windows": CMDRING_MAX_RUN_WINDOWS + 1}
+        )
+    with pytest.raises(ValueError, match="cmdring_linger_us"):
+        validate_registers({"cmdring_linger_us": 1_000_001})
+    with pytest.raises(ValueError, match="negative"):
+        validate_registers({"cmdring_run_windows": -1})
+
+
+def test_candidates_race_posture_axes():
+    """ACCL_CMDRING_RUN_WINDOWS / ACCL_CMDRING_LINGER_MS as autotuner
+    axes: raced for the XLA gang tier's allreduce only (the ring lives
+    there), out-of-bounds candidates filtered, defaults candidate 0."""
+    from accl_tpu.constants import CMDRING_MAX_RUN_WINDOWS
+    from accl_tpu.tuning import _candidates
+
+    cands = _candidates(
+        "xla", "allreduce", 4, False, (), (),
+        cmdring_run_windows=(32, 128, CMDRING_MAX_RUN_WINDOWS + 1, 0),
+        cmdring_linger_us=(500, 5000, 2_000_000),
+    )
+    assert cands[0] == {}  # the defaults always race
+    assert {"cmdring_run_windows": 32} in cands
+    assert {"cmdring_run_windows": 128} in cands
+    assert {"cmdring_linger_us": 500} in cands
+    assert {"cmdring_linger_us": 5000} in cands
+    # out-of-bounds / zero candidates are filtered, not clamped
+    for c in cands:
+        assert c.get("cmdring_run_windows", 1) > 0
+        assert c.get("cmdring_run_windows", 0) <= CMDRING_MAX_RUN_WINDOWS
+        assert c.get("cmdring_linger_us", 0) <= 1_000_000
+    # the axes are gang-ring scoped: no posture candidates for the
+    # emulator tier or for non-allreduce collectives
+    for tier, op in (("emulator", "allreduce"), ("xla", "bcast")):
+        others = _candidates(
+            tier, op, 4, False, (), (),
+            cmdring_run_windows=(32,), cmdring_linger_us=(500,),
+        )
+        assert not any(
+            "cmdring_run_windows" in c or "cmdring_linger_us" in c
+            for c in others
+        ), (tier, op)
+
+
+def test_tuning_cli_exposes_posture_axes(tmp_path, capsys):
+    """The sweep CLI races the posture registers end to end: the
+    ``--cmdring-run-windows`` / ``--cmdring-linger-us`` flags parse,
+    flow into autotune, and the emitted plan stays loadable (on the
+    emulator tier the axes are a no-op by design — gang-ring scoped —
+    so the race just keeps the defaults)."""
+    from accl_tpu.tuning import main as tuning_main
+
+    out = tmp_path / "plan.json"
+    rc = tuning_main([
+        "--backend", "emulator", "--world", "2",
+        "--min-exp", "4", "--max-exp", "4", "--runs", "1",
+        "--collectives", "allreduce", "--segments", "1",
+        "--cmdring-run-windows", "32",
+        "--cmdring-linger-us", "500",
+        "--out", str(out),
+    ])
+    assert rc == 0
+    plan = TuningPlan.load(str(out))
+    assert plan.world == 2 and plan.tier == "emulator"
+    assert "allreduce" in plan.entries
+
+
 def test_stale_plan_file_fails_loudly(tmp_path):
     path = tmp_path / "stale.json"
     path.write_text(json.dumps({
