@@ -69,6 +69,12 @@ from .telemetry import (  # noqa: F401
     merge_traces,
     to_prometheus,
 )
+from .topology import (  # noqa: F401
+    LinkClass,
+    SLICE_SIZE_ENV,
+    TOPOLOGY_ENV,
+    Topology,
+)
 from .tuning import TUNING_PLAN_ENV, TuningPlan, autotune  # noqa: F401
 
 __version__ = "0.1.0"

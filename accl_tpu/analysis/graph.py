@@ -47,6 +47,11 @@ JAX_FREE_MODULES = (
     # rank processes and the analysis tooling import both
     "accl_tpu.wire",
     "accl_tpu.errorfeedback",
+    # multi-slice plane: the descriptor and decomposition math are
+    # stdlib-only so every rank (and the analysis tooling, and the
+    # numpy-only CI smokes) derives identical plans without jax
+    "accl_tpu.topology",
+    "accl_tpu.hierarchical",
 )
 
 #: top-level packages whose module-scope import breaks jax-freedom

@@ -114,6 +114,18 @@ _BUILTIN_SANITIZERS = frozenset((
     "create_communicator", "split",
     "demote_decision", "suggest_root", "join_decision",
     "admit",
+    # topology accessors: slice/leader facts are pure functions of the
+    # descriptor every rank constructed identically (the collective
+    # set_topology contract), so leader-only cross-slice calls —
+    # `if topo.is_leader(rank): leaders_comm.allreduce(...)` — branch
+    # on uniform data, not rank-varying state
+    "slice_leader", "is_leader", "leaders", "slice_of",
+    "bcast_representatives",
+    # the facade's hierarchical subcomm cache rides split() — its
+    # result is a communicator whose members all make the same call,
+    # even though WHICH subcomm a rank holds varies by rank (each rail
+    # is its own collective domain)
+    "_hier_subcomm",
 ))
 
 

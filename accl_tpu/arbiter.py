@@ -84,12 +84,15 @@ from .contract import anchored
 __all__ = [
     "ARBITER_ENV",
     "CLASS_WEIGHTS",
+    "LEDGER_ENV",
     "QosArbiter",
     "Tenant",
     "TenantClass",
+    "TenantLedger",
     "TokenBucket",
     "arbiter_for",
     "env_arbiter",
+    "env_ledger",
     "hist_p99_us",
     "tenant_config_field",
     "tenant_config_valid",
@@ -98,6 +101,16 @@ __all__ = [
 ARBITER_ENV = "ACCL_ARBITER"
 MAX_WAIT_ENV = "ACCL_ARBITER_MAX_WAIT_S"
 QUANTUM_ENV = "ACCL_ARBITER_QUANTUM"
+#: opt-in for the CROSS-PROCESS tenant registry (dist tier): per-process
+#: arbiters publish their tenant weight tables through the KV plane and
+#: derive fabric-share token-bucket caps from the fleet-wide totals
+LEDGER_ENV = "ACCL_ARBITER_LEDGER"
+#: modeled fabric capacity the ledger divides into per-tenant shares
+#: (bytes/s); the honest default is deliberately generous — the ledger
+#: exists for *relative* fairness, and an operator who knows the link
+#: sets the real number
+LEDGER_FABRIC_ENV = "ACCL_ARBITER_FABRIC_BYTES_S"
+DEFAULT_LEDGER_FABRIC_BYTES_S = 1e9
 
 #: DRR credit granted per weight unit per round, in bytes.  Small
 #: enough that a BEST_EFFORT flooder's large payloads span several
@@ -139,6 +152,13 @@ def env_arbiter(environ=None) -> bool:
     """The ``ACCL_ARBITER`` opt-in (read at ACCL-handle construction):
     arms the acting half — DRR admission queueing and throttles."""
     return (environ or os.environ).get(ARBITER_ENV, "0") not in ("0", "")
+
+
+def env_ledger(environ=None) -> bool:
+    """The ``ACCL_ARBITER_LEDGER`` opt-in (read at ACCL-handle
+    construction on KV-capable tiers): arms the cross-process tenant
+    registry exchange."""
+    return (environ or os.environ).get(LEDGER_ENV, "0") not in ("0", "")
 
 
 def _env_float(name: str, default: float) -> float:
@@ -300,7 +320,7 @@ class Tenant:
         "outstanding", "_inflight", "outstanding_peak", "admitted",
         "completed", "cost_granted", "grant_wait_ns",
         "throttle_ns_total", "over_admissions", "queued_peak", "hist",
-        "template",
+        "template", "auto_rate",
     )
 
     def __init__(self, comm_id: int, name: str, cls: TenantClass,
@@ -313,6 +333,10 @@ class Tenant:
         self.window_share = DEFAULT_INFLIGHT_WINDOW
         self.ring_slots: Optional[int] = None
         self.bucket: Optional[TokenBucket] = None
+        # True when the bucket was derived by the cross-process ledger
+        # (a fabric share, re-derived on every exchange); an explicit
+        # set_quota rate clears it and is never overwritten by shares
+        self.auto_rate = False
         self.deficit = 0
         # per-owner (rank handle) waiting tickets + in-flight counts;
         # _inflight mirrors sum(outstanding.values()) so the hot path
@@ -386,6 +410,7 @@ class Tenant:
             "window_share": self.window_share,
             "ring_slots": self.ring_slots,
             "rate": self.bucket.snapshot() if self.bucket else None,
+            "auto_rate": self.auto_rate,
             "outstanding": self.in_flight(),
             "outstanding_peak": self.outstanding_peak,
             "outstanding_limit": self.window_share,
@@ -398,6 +423,59 @@ class Tenant:
             "throttle_ns_total": self.throttle_ns_total,
             "over_admissions": self.over_admissions,
             "latency": dict(hist, p99_us=hist_p99_us(hist)),
+        }
+
+
+class TenantLedger:
+    """Cross-process tenant-weight registry state for one arbiter.
+
+    Each process posts its local ``{tenant name: weight}`` map into the
+    dist tier's KV plane (the same plane the contract-digest ledger
+    rides) and sweeps every peer's posting back.  The arbiter then
+    re-derives per-tenant token-bucket rates as *fabric shares*:
+
+        rate = fabric_bytes_s * weight / (local_total + foreign_total)
+
+    so a GUARANTEED tenant in one process squeezes a BEST_EFFORT tenant
+    in another even though the two arbiters share no lock — only the KV
+    plane.  Derived rates are marked ``auto_rate`` and are re-derived on
+    every exchange; explicit ``set_quota`` rates are never overwritten.
+    """
+
+    __slots__ = ("process_key", "fabric_bytes_s", "state", "foreign",
+                 "exchanges", "posted", "errors")
+
+    def __init__(self, process_key: str,
+                 fabric_bytes_s: Optional[float] = None):
+        self.process_key = str(process_key)
+        self.fabric_bytes_s = float(
+            fabric_bytes_s if fabric_bytes_s is not None
+            else _env_float(LEDGER_FABRIC_ENV, DEFAULT_LEDGER_FABRIC_BYTES_S)
+        )
+        # exchange-protocol scratch (slot claim + last posted doc) owned
+        # by contract.kv_tenant_exchange
+        self.state: dict = {}
+        # last swept view: {process_key: {"weights": {...}, "total": n}}
+        self.foreign: dict = {}
+        self.exchanges = 0
+        self.posted = 0
+        self.errors = 0
+
+    def foreign_weight(self) -> int:
+        """Sum of every foreign process's tenant weights (the
+        denominator share the local tenants compete against)."""
+        return sum(int(doc.get("total", 0))
+                   for doc in self.foreign.values())
+
+    def snapshot(self) -> dict:
+        return {
+            "process": self.process_key,
+            "fabric_bytes_s": self.fabric_bytes_s,
+            "peers": len(self.foreign),
+            "foreign_weight": self.foreign_weight(),
+            "exchanges": self.exchanges,
+            "posted": self.posted,
+            "errors": self.errors,
         }
 
 
@@ -435,6 +513,9 @@ class QosArbiter:
         self.rounds = 0
         self.grant_timeouts = 0
         self.passthrough = 0
+        # cross-process tenant registry (attached when ACCL_ARBITER_LEDGER
+        # is set and the engine exposes a KV plane); None = local-only
+        self.ledger: Optional[TenantLedger] = None
 
     # -- registry ------------------------------------------------------------
     def register(self, comm_id: int, name: Optional[str] = None,
@@ -485,6 +566,10 @@ class QosArbiter:
                     TokenBucket(float(bytes_per_s), clock=self._clock)
                     if bytes_per_s > 0 else None
                 )
+                # an explicit operator rate is authoritative: the
+                # cross-process ledger must never overwrite it with a
+                # derived fabric share
+                t.auto_rate = False
             self._cv.notify_all()
             return t
 
@@ -753,6 +838,77 @@ class QosArbiter:
                     _DEFICIT_CAP_ROUNDS * t.weight * self.quantum + cost,
                 )
 
+    # -- cross-process tenant registry ---------------------------------------
+    def attach_ledger(self, ledger: TenantLedger) -> TenantLedger:
+        """Arm the cross-process registry: subsequent
+        ``ledger_exchange`` calls post local weights and re-derive
+        fabric-share rates against the swept foreign total."""
+        with self._cv:
+            self.ledger = ledger
+            return ledger
+
+    def local_weights(self) -> Dict[str, int]:
+        """``{tenant name: weight}`` for every registered tenant — the
+        doc this process posts to the KV plane."""
+        with self._lock:
+            return {
+                self._tenants[cid].name: int(self._tenants[cid].weight)
+                for cid in self._order
+            }
+
+    def ledger_exchange(self, kv, is_notfound=None) -> Optional[dict]:
+        """Post local tenant weights through ``kv`` and re-derive
+        fabric-share token-bucket rates from the swept peer view.
+        Returns the exchange counters, or None when no ledger is
+        attached (local-only arbiter)."""
+        led = self.ledger
+        if led is None:
+            return None
+        from . import contract as _contract
+        weights = self.local_weights()
+        foreign, out = _contract.kv_tenant_exchange(
+            kv, led.process_key, weights, led.state,
+            is_notfound=is_notfound,
+        )
+        led.foreign = foreign
+        led.exchanges += 1
+        led.posted += int(out.get("posted", 0))
+        led.errors += int(out.get("errors", 0))
+        self._apply_ledger_shares()
+        return out
+
+    def _apply_ledger_shares(self) -> None:
+        """Re-derive auto token-bucket rates as fabric shares.  Only
+        buckets the ledger itself installed (``auto_rate``) or tenants
+        with no bucket at all are touched — explicit ``set_quota``
+        rates stay authoritative.  With no foreign peers the auto caps
+        are lifted entirely (nothing to share the fabric with)."""
+        led = self.ledger
+        if led is None:
+            return
+        with self._cv:
+            foreign_total = led.foreign_weight()
+            local_total = sum(
+                int(t.weight) for t in self._tenants.values()
+            )
+            for t in self._tenants.values():
+                if t.bucket is not None and not t.auto_rate:
+                    continue  # explicit operator rate
+                if foreign_total <= 0:
+                    # sole process on the fabric: an auto cap would
+                    # only throttle against nobody
+                    t.bucket = None
+                    t.auto_rate = False
+                    continue
+                total = local_total + foreign_total
+                if total <= 0:
+                    continue
+                rate = led.fabric_bytes_s * (int(t.weight) / total)
+                if rate > 0:
+                    t.bucket = TokenBucket(rate, clock=self._clock)
+                    t.auto_rate = True
+            self._cv.notify_all()
+
     # -- recovery / telemetry ------------------------------------------------
     def reset_ledger(self) -> None:
         """soft_reset recovery: drop latched decisions and DRR credit —
@@ -792,6 +948,10 @@ class QosArbiter:
                 "rounds": self.rounds,
                 "grant_timeouts": self.grant_timeouts,
                 "passthrough": self.passthrough,
+                "ledger": (
+                    self.ledger.snapshot()
+                    if self.ledger is not None else None
+                ),
                 "tenants": {
                     str(cid): self._tenants[cid].snapshot()
                     for cid in self._order

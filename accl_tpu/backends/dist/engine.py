@@ -809,6 +809,12 @@ class DistEngine(StreamPortMixin, BaseEngine):
             self._kv_wrapped = kv_client(client)
         return self._kv_wrapped
 
+    def arbiter_kv(self):
+        """The KV plane handed to the QoS arbiter's cross-process tenant
+        ledger (same adapter the contract-digest ledger rides); raises
+        when the distributed KV service is unavailable."""
+        return self._kv()
+
     def _remote_stream_put(self, options: CallOptions) -> ErrorCode:
         n = options.count
         cfg = options.arithcfg

@@ -651,6 +651,14 @@ class EmuEngine(BaseEngine):
             # its peers (board = shared in-process judge, wire = the
             # per-message piggyback on the socket fabric)
             "skew_exchange": self.skew_exchange_mode(),
+            # topology plane: per-link-class byte/message counters +
+            # modeled rates (shared across ranks on the in-proc
+            # fabric; None until a topology registers)
+            "wire_classes": (
+                self.fabric.wire_class_stats()
+                if getattr(self.fabric, "_topologies", None)
+                else None
+            ),
         }
 
     # -- scheduler ----------------------------------------------------------
@@ -1067,11 +1075,17 @@ class EmuEngine(BaseEngine):
                 return ErrorCode.CONFIG_ERROR
             if key == TuningKey.RING_SEGMENTS and val < 1:
                 return ErrorCode.CONFIG_ERROR
-            if key == TuningKey.WIRE_DTYPE and int(val) != 0:
+            if key in (
+                TuningKey.WIRE_DTYPE,
+                TuningKey.WIRE_DTYPE_ICI,
+                TuningKey.WIRE_DTYPE_DCN,
+            ) and int(val) != 0:
                 from ...wire import is_wire_dtype
 
                 if not is_wire_dtype(int(val)):
                     return ErrorCode.CONFIG_ERROR
+            if key == TuningKey.HIERARCHICAL and int(val) > 1:
+                return ErrorCode.CONFIG_ERROR
             if key == TuningKey.CMDRING_RUN_WINDOWS:
                 from ...constants import CMDRING_MAX_RUN_WINDOWS
 

@@ -283,8 +283,109 @@ class Fabric:
             None if not gbps else float(gbps) * 1e9 / 8.0
         )
 
+    # -- topology plane (accl_tpu.topology): two-class paced model ----------
+    #: per-link-class modeled rates in bytes/s (the two-tier wire: fast
+    #: ICI within a slice, slow DCN across).  None entries fall back to
+    #: the single-class ``_wire_rate_Bps`` (which may itself be None =
+    #: unpaced).  Classification consults the topology registered per
+    #: communicator — comm-relative rank spaces, consistent because
+    #: each registered topology lives in its own comm's space.
+    _ici_rate_Bps: Optional[float] = None
+    _dcn_rate_Bps: Optional[float] = None
+
+    def set_wire_rates(self, ici_gbps: Optional[float] = None,
+                       dcn_gbps: Optional[float] = None) -> None:
+        """Model the two link classes separately (gigabits/s; None
+        disables that class's override)."""
+        self._ici_rate_Bps = (
+            None if not ici_gbps else float(ici_gbps) * 1e9 / 8.0
+        )
+        self._dcn_rate_Bps = (
+            None if not dcn_gbps else float(dcn_gbps) * 1e9 / 8.0
+        )
+
+    def register_topology(self, comm_id: int, topology) -> None:
+        """Attach (or with ``None`` detach) the slice descriptor for one
+        communicator's rank space — the send path classifies (and
+        counts) every wire byte of that comm as ICI vs DCN with one
+        dict probe, the contract/skew/trace stamp discipline."""
+        topos = getattr(self, "_topologies", None)
+        if topos is None:
+            topos = self._topologies = {}
+            self._class_lock = threading.Lock()
+            self._class_bytes = {"ici": 0, "dcn": 0, "loopback": 0,
+                                 "unclassified": 0}
+            self._class_msgs = {"ici": 0, "dcn": 0, "loopback": 0,
+                                "unclassified": 0}
+        if topology is None:
+            topos.pop(comm_id, None)
+        else:
+            topos[comm_id] = topology
+
+    def _link_class_of(self, msg: "Message") -> str:
+        topos = getattr(self, "_topologies", None)
+        if not topos:
+            return "unclassified"
+        topo = topos.get(msg.comm_id)
+        if topo is None:
+            return "unclassified"
+        try:
+            cls = topo.link_class(msg.src, msg.dst)
+        except KeyError:
+            return "unclassified"
+        return cls.name.lower()
+
+    def wire_class_stats(self) -> dict:
+        """Per-link-class byte/message counters + the modeled rates —
+        the telemetry evidence the topology capture gate counter-asserts
+        (hierarchical must cut DCN bytes by ~the slice factor)."""
+        lock = getattr(self, "_class_lock", None)
+        if lock is None:
+            bytes_, msgs = {}, {}
+        else:
+            with lock:
+                bytes_ = dict(self._class_bytes)
+                msgs = dict(self._class_msgs)
+        return {
+            "bytes": bytes_,
+            "messages": msgs,
+            "rates_gbps": {
+                "ici": (
+                    None if self._ici_rate_Bps is None
+                    else self._ici_rate_Bps * 8.0 / 1e9
+                ),
+                "dcn": (
+                    None if self._dcn_rate_Bps is None
+                    else self._dcn_rate_Bps * 8.0 / 1e9
+                ),
+                "default": (
+                    None if self._wire_rate_Bps is None
+                    else self._wire_rate_Bps * 8.0 / 1e9
+                ),
+            },
+        }
+
+    def reset_wire_class_stats(self) -> None:
+        lock = getattr(self, "_class_lock", None)
+        if lock is not None:
+            with lock:
+                for k in self._class_bytes:
+                    self._class_bytes[k] = 0
+                    self._class_msgs[k] = 0
+
     def _pace(self, msg: "Message") -> None:
         rate = self._wire_rate_Bps
+        if getattr(self, "_topologies", None):
+            cls = self._link_class_of(msg)
+            with self._class_lock:
+                self._class_bytes[cls] += len(msg.payload)
+                self._class_msgs[cls] += 1
+            if cls == "ici" and self._ici_rate_Bps is not None:
+                rate = self._ici_rate_Bps
+            elif cls == "dcn" and self._dcn_rate_Bps is not None:
+                rate = self._dcn_rate_Bps
+            elif cls == "loopback":
+                rate = None  # self-delivery is never paced
         if rate and msg.payload:
             time.sleep(len(msg.payload) / rate)
 

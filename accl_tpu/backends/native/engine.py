@@ -304,19 +304,42 @@ class NativeEngine(BaseEngine):
         if (
             options.op == Operation.CONFIG
             and int(options.cfg_function) == int(ConfigFunction.SET_TUNING)
-            and int(options.cfg_key) == int(TuningKey.WIRE_DTYPE)
+            and int(options.cfg_key) in (
+                int(TuningKey.WIRE_DTYPE),
+                int(TuningKey.WIRE_DTYPE_ICI),
+                int(TuningKey.WIRE_DTYPE_DCN),
+            )
         ):
-            # quantized-wire verdict register, handled host-side like
-            # pipeline_threshold: the ABI predates it and the facade's
-            # _plan_for reads this host mirror anyway — same validation
-            # as every other tier (0 or a registered wire lane)
+            # quantized-wire verdict registers (generic + per link
+            # class), handled host-side like pipeline_threshold: the
+            # ABI predates them and the facade's _plan_for reads this
+            # host mirror anyway — same validation as every other tier
+            # (0 or a registered wire lane)
             from ... import wire as wirecodec
+            from ...constants import TUNING_KEY_NAMES
 
             req = Request(op_name=options.op.name)
             req.mark_executing()
             val = int(options.cfg_value)
             if val == 0 or wirecodec.is_wire_dtype(val):
-                self.tuning["wire_dtype"] = val
+                name = TUNING_KEY_NAMES[TuningKey(int(options.cfg_key))]
+                self.tuning[name] = val
+                req.complete(ErrorCode.OK)
+            else:
+                req.complete(ErrorCode.CONFIG_ERROR)
+            return req
+        if (
+            options.op == Operation.CONFIG
+            and int(options.cfg_function) == int(ConfigFunction.SET_TUNING)
+            and int(options.cfg_key) == int(TuningKey.HIERARCHICAL)
+        ):
+            # topology-plane register, handled host-side: the facade's
+            # hierarchical dispatch reads the host mirror; the C
+            # dataplane only ever sees the decomposed sub-collectives
+            req = Request(op_name=options.op.name)
+            req.mark_executing()
+            if int(options.cfg_value) in (0, 1):
+                self.tuning["hierarchical"] = int(options.cfg_value)
                 req.complete(ErrorCode.OK)
             else:
                 req.complete(ErrorCode.CONFIG_ERROR)

@@ -315,16 +315,25 @@ def apply_tuning(tuning: dict, options) -> ErrorCode:
         if int(val) < 1:
             return ErrorCode.CONFIG_ERROR
         tuning["ring_segments"] = int(val)
-    elif key == TuningKey.WIRE_DTYPE:
+    elif key in (
+        TuningKey.WIRE_DTYPE,
+        TuningKey.WIRE_DTYPE_ICI,
+        TuningKey.WIRE_DTYPE_DCN,
+    ):
         # quantized wire plane: the per-bucket compression verdict must
         # name a REGISTERED wire lane (or 0 = off) — a typo'd DataType
         # must fail the config write, not surface as an arith-lookup
-        # error N calls later
+        # error N calls later.  The per-link-class variants validate
+        # identically (0 additionally means "defer to the generic")
         from ...wire import is_wire_dtype
 
         if int(val) != 0 and not is_wire_dtype(int(val)):
             return ErrorCode.CONFIG_ERROR
-        tuning["wire_dtype"] = int(val)
+        tuning[TUNING_KEY_NAMES[key]] = int(val)
+    elif key == TuningKey.HIERARCHICAL:
+        if int(val) > 1:
+            return ErrorCode.CONFIG_ERROR
+        tuning["hierarchical"] = int(val)
     elif key == TuningKey.CMDRING_RUN_WINDOWS:
         # persistent-sequencer posture registers: 0 = env default;
         # the run-windows budget is clamped exactly like the env knob

@@ -65,6 +65,14 @@ class Communicator:
         # membership stashed by shrink() so soft_reset can restore it
         self._full_ranks: Optional[List[Rank]] = None
         self._full_local: Optional[int] = None
+        # topology plane (accl_tpu.topology): slice/link-class
+        # descriptor in THIS communicator's rank space, or None (flat).
+        # Attached by the facade at construction / set_topology and
+        # derived through split/shrink/grow so a subcomm's link classes
+        # stay truthful; _full_topology mirrors _full_ranks for the
+        # restore path.
+        self.topology = None
+        self._full_topology = None
 
     # -- introspection ------------------------------------------------------
     @property
@@ -130,6 +138,10 @@ class Communicator:
             translation = {old: new for new, old in enumerate(keep)}
             self.ranks = [self.ranks[k] for k in keep]
             self.local_rank = translation[self.local_rank]
+            if self.topology is not None:
+                if self._full_topology is None:
+                    self._full_topology = self.topology
+                self.topology = self.topology.subtopology(keep)
             self.epoch = next(_comm_epochs)
             self._outbound_seq = {i: 0 for i in range(len(self.ranks))}
             self._inbound_seq = {i: 0 for i in range(len(self.ranks))}
@@ -179,6 +191,27 @@ class Communicator:
                 if r.session in old_index
             }
             local_session = self.ranks[self.local_rank].session
+            if self.topology is not None:
+                # surviving members keep their slice through the
+                # translation; admitted ranks land in singleton slices
+                # (conservative DCN classification — a joiner's real
+                # placement is unknown until re-described via
+                # set_topology, and DCN can only over-pay, never
+                # corrupt a fast-link assumption)
+                if self._full_topology is None:
+                    self._full_topology = self.topology
+                from .topology import Topology as _Topology
+
+                subs = [
+                    [translation[r] for r in s if r in translation]
+                    for s in self.topology.slices
+                ]
+                subs = [s for s in subs if s]
+                covered = {r for s in subs for r in s}
+                subs += [
+                    [i] for i in range(len(new_ranks)) if i not in covered
+                ]
+                self.topology = _Topology(subs)
             self.ranks = new_ranks
             self.local_rank = next(
                 i for i, r in enumerate(new_ranks)
@@ -209,6 +242,9 @@ class Communicator:
             self.local_rank = int(self._full_local)
             self._full_ranks = None
             self._full_local = None
+            if self._full_topology is not None:
+                self.topology = self._full_topology
+                self._full_topology = None
             self.epoch = next(_comm_epochs)
             self._outbound_seq = {i: 0 for i in range(len(self.ranks))}
             self._inbound_seq = {i: 0 for i in range(len(self.ranks))}
@@ -238,9 +274,15 @@ class Communicator:
         if self.local_rank not in members:
             return None
         new_ranks = [self.ranks[m] for m in members]
-        return Communicator(
+        sub = Communicator(
             new_ranks, members.index(self.local_rank), comm_id=comm_id
         )
+        if self.topology is not None:
+            # the subcomm inherits truthful link classes: member m of the
+            # parent becomes rank members.index(m) of the child, and
+            # subtopology() maps slices through exactly that ordering
+            sub.topology = self.topology.subtopology(members)
+        return sub
 
     # -- debug --------------------------------------------------------------
     def as_dict(self) -> dict:
@@ -253,6 +295,10 @@ class Communicator:
                 "epoch": self.epoch,
                 "size": self.size,
                 "local_rank": self.local_rank,
+                "topology": (
+                    None if self.topology is None
+                    else self.topology.signature()
+                ),
                 "ranks": [
                     {
                         "address": r.address,

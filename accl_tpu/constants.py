@@ -138,6 +138,18 @@ class TuningKey(enum.IntEnum):
     # sub-ms resolution)
     CMDRING_RUN_WINDOWS = 13
     CMDRING_LINGER_US = 14
+    # topology plane: 1 = decompose eligible collectives hierarchically
+    # (intra-slice / cross-slice stages over derived subcomms) when the
+    # communicator carries a multi-slice Topology; 0 = flat (the
+    # conservative default; the autotuner races hierarchical-vs-flat per
+    # (op x bucket x topology) like any other register)
+    HIERARCHICAL = 15
+    # per-link-class wire verdicts: the WIRE_DTYPE ladder split by the
+    # comm's uniform link class (fp8 on slow DCN, full width on fast
+    # ICI as the first ladder).  0 = defer to the generic WIRE_DTYPE
+    # register; a comm whose link classes mix always uses the generic
+    WIRE_DTYPE_ICI = 16
+    WIRE_DTYPE_DCN = 17
 
 
 class AllreduceAlgorithm(enum.IntEnum):
@@ -167,6 +179,9 @@ TUNING_KEY_NAMES = {
     TuningKey.WIRE_DTYPE: "wire_dtype",
     TuningKey.CMDRING_RUN_WINDOWS: "cmdring_run_windows",
     TuningKey.CMDRING_LINGER_US: "cmdring_linger_us",
+    TuningKey.HIERARCHICAL: "hierarchical",
+    TuningKey.WIRE_DTYPE_ICI: "wire_dtype_ici",
+    TuningKey.WIRE_DTYPE_DCN: "wire_dtype_dcn",
 }
 
 #: lowerings valid for the ROOTED algorithm registers (no ppermute-ring /
@@ -495,6 +510,13 @@ TUNING_DEFAULTS = {
     # override per plan key, typically from an autotuned overlay
     "cmdring_run_windows": 0,
     "cmdring_linger_us": 0,
+    # topology plane: 0 = flat dispatch (hierarchical decomposition off
+    # until a TuningPlan or explicit set_tuning arms it on a comm that
+    # actually carries a multi-slice Topology)
+    "hierarchical": 0,
+    # per-link-class wire verdicts: 0 = defer to the generic wire_dtype
+    "wire_dtype_ici": 0,
+    "wire_dtype_dcn": 0,
 }
 
 # Overlap plane (async in-flight window) defaults: how many collectives

@@ -63,6 +63,7 @@ __all__ = [
     "env_interval",
     "install_fault_plan",
     "kv_digest_exchange",
+    "kv_tenant_exchange",
     "roll_digest",
 ]
 
@@ -1085,6 +1086,97 @@ def kv_digest_exchange(kv, verifier: "ContractVerifier", comm_id: int,
         out["claims"] += 1
         verifier.observe_claim(comm_id, peer, gen, window, theirs)
     return out
+
+
+def kv_tenant_exchange(kv, process_key: str, weights: dict,
+                       state: Optional[dict] = None,
+                       is_notfound=None, slot_cap: int = 64):
+    """Share one process's QoS tenant weight table through the dist
+    tier's KV plane — the cross-process tenant registry (the arbiter's
+    per-process DRR is fair only among tenants it can SEE; two
+    one-process-per-rank jobs sharing a fabric each run a blind
+    arbiter, and the bulk job starves the serving job exactly as if
+    no arbiter existed).
+
+    Rendezvous rides the PR 12 contract-digest ledger discipline: the
+    first call claims a dense slot index via
+    ``key_value_increment("accl/arb/slots")`` (the KV plane's atomic
+    counter — no registry key to race), then posts this process's
+    table as JSON under ``accl/arb/slot/<i>`` (re-posted only when the
+    table changes, so warm exchanges cost one sweep).  The sweep scans
+    slots upward and stops at the first gap past our own slot (slots
+    are claimed densely; a gap *below* us is a peer that claimed but
+    has not posted yet, and is skipped, not a stop), bounded by
+    ``slot_cap``.
+
+    ``kv`` needs ``key_value_set_bytes`` / ``key_value_try_get_bytes``
+    / ``key_value_increment`` (the compat-wrapped jax KV client
+    surface); ``state`` carries the slot claim and last-posted doc
+    between calls.  Returns ``(foreign, counters)``: foreign maps each
+    peer process key to ``{"weights": {...}, "total": int}``.
+    Stdlib-only so the exchange is unit-testable without jax (a
+    dict-backed fake KV)."""
+    import json as _json
+
+    out = {"posted": 0, "peers": 0, "errors": 0}
+    st = state if state is not None else {}
+    slot = st.get("slot")
+    if slot is None:
+        try:
+            slot = int(kv.key_value_increment("accl/arb/slots", 1)) - 1
+        except Exception:
+            out["errors"] += 1
+            return {}, out  # the KV is unreachable: nothing to share
+        st["slot"] = slot
+    doc = _json.dumps(
+        {
+            "process": str(process_key),
+            "weights": {str(k): int(v) for k, v in sorted(weights.items())},
+        },
+        sort_keys=True,
+    )
+    if st.get("posted_doc") != doc:
+        try:
+            kv.key_value_set_bytes(f"accl/arb/slot/{slot}", doc.encode())
+            st["posted_doc"] = doc
+            out["posted"] = 1
+        except Exception:
+            out["errors"] += 1
+            return {}, out
+    foreign: dict = {}
+    for i in range(max(slot + 1, int(slot_cap))):
+        if i == slot:
+            continue
+        try:
+            raw = kv.key_value_try_get_bytes(f"accl/arb/slot/{i}")
+        except Exception as e:
+            if is_notfound is not None and is_notfound(e):
+                raw = None
+            else:
+                out["errors"] += 1
+                continue
+        if raw is None:
+            if i > slot:
+                break  # past the dense frontier: no more claimed slots
+            continue  # a lower slot claimed but not yet posted
+        try:
+            peer_doc = _json.loads(
+                raw.decode() if isinstance(raw, (bytes, bytearray))
+                else str(raw)
+            )
+            pk = str(peer_doc["process"])
+            w = {
+                str(k): int(v)
+                for k, v in (peer_doc.get("weights") or {}).items()
+            }
+        except (KeyError, TypeError, ValueError):
+            out["errors"] += 1
+            continue
+        if pk == str(process_key):
+            continue  # a stale slot from a restarted self
+        foreign[pk] = {"weights": w, "total": sum(w.values())}
+        out["peers"] += 1
+    return foreign, out
 
 
 def verdict_context(verdict: dict, op: Optional[str] = None) -> dict:

@@ -81,11 +81,31 @@ class ACCL:
         timeout_s: float = 30.0,
         max_eager_size: int = 32 * 1024,
         max_rendezvous_size: int = 16 * 1024 * 1024,
+        topology=None,
     ):
         self.engine = engine
         self._arith = dict(arith_config or DEFAULT_ARITH_CONFIG)
         self._world = Communicator(ranks, local_rank, comm_id=0)
         self._communicators: List[Communicator] = [self._world]
+        # topology plane (accl_tpu.topology): the slice / link-class
+        # descriptor, explicit or from ACCL_TOPOLOGY / ACCL_SLICE_SIZE /
+        # jax.distributed facts.  Attached to the world communicator and
+        # inherited by splits; hierarchical decomposition and per-class
+        # wire verdicts key on it.  _hier_comms caches the derived
+        # intra/cross subcomms per (comm id, epoch) — an epoch bump
+        # (shrink/grow/reset) re-derives naturally.
+        if topology is None:
+            from .topology import Topology as _Topology
+
+            topology = _Topology.from_env(len(ranks))
+        if topology is not None:
+            if topology.world != len(ranks):
+                raise ValueError(
+                    f"topology describes world={topology.world}, this "
+                    f"group is world={len(ranks)}"
+                )
+            self._world.topology = topology
+        self._hier_comms: dict = {}
         self._initialized = False
         # single-interaction batching: while a batch is open, collective
         # calls queue here and flush() hands them to the engine as ONE
@@ -211,6 +231,19 @@ class ACCL:
         # this handle's admission owner identity (one owner = one rank
         # handle; the per-rank window-share bound keys on it)
         self._arbiter_owner = ranks[local_rank].session
+        # cross-process tenant registry (ACCL_ARBITER_LEDGER=1 on a tier
+        # whose engine exposes a KV plane): per-process arbiters share
+        # tenant weights through the same KV the contract-digest ledger
+        # rides, and re-derive token-bucket rates as fabric shares
+        self._arbiter_exchange_ctr = 0
+        if (
+            _arb.env_ledger()
+            and self._arbiter.ledger is None
+            and hasattr(engine, "arbiter_kv")
+        ):
+            self._arbiter.attach_ledger(_arb.TenantLedger(
+                process_key=f"proc-{ranks[local_rank].session}",
+            ))
         # causal trace plane (accl_tpu.telemetry): deterministic
         # trace/span ids assigned at facade intake — per-comm collective
         # seqn counters plus directed p2p channel counters, both
@@ -229,6 +262,15 @@ class ACCL:
             fabric, "register_trace"
         ):
             fabric.register_trace(self._world.id, local_rank, self)
+        # two-class paced bandwidth model: hand the emulator fabric the
+        # world topology so it classifies (and counts) every wire byte
+        # as ICI vs DCN — the per-link-class telemetry counters
+        if (
+            self._world.topology is not None
+            and fabric is not None
+            and hasattr(fabric, "register_topology")
+        ):
+            fabric.register_topology(self._world.id, self._world.topology)
         # postmortem plane (accl_tpu.monitor.BlackBox): automatic
         # evidence bundles on structured failures.  In-process peers
         # solicit over an anchored registry (the contract-board
@@ -1369,6 +1411,31 @@ class ACCL:
             options.comm.id, req.get_duration_ns(),
             owner=self._arbiter_owner, release=bool(dec.get("paced")),
         )
+        if self._arbiter.ledger is not None:
+            # periodic (not per-call) cross-process weight exchange: KV
+            # round-trips are milliseconds, admissions are microseconds
+            self._arbiter_exchange_ctr += 1
+            if self._arbiter_exchange_ctr % 32 == 1:
+                self.arbiter_ledger_exchange()
+
+    def arbiter_ledger_exchange(self) -> Optional[dict]:
+        """Run one cross-process tenant-weight exchange through the
+        engine's KV plane and re-derive fabric-share rates; returns the
+        exchange counters, or None when no ledger is attached or the KV
+        plane is unreachable (exchange is advisory — admission never
+        blocks on it)."""
+        if self._arbiter.ledger is None:
+            return None
+        try:
+            kv = self.engine.arbiter_kv()
+        except Exception:
+            return None
+        notfound = getattr(self.engine, "_is_notfound", None)
+        try:
+            return self._arbiter.ledger_exchange(kv, is_notfound=notfound)
+        except Exception:
+            self._arbiter.ledger.errors += 1
+            return None
 
     def set_retry_policy(self, limit: int, backoff_s: float = 0.05) -> None:
         """Arm (or with ``limit=0`` disarm) the eager retransmit protocol
@@ -1405,7 +1472,11 @@ class ACCL:
         else:
             key = TuningKey(key)
         if isinstance(value, str):
-            if key == TuningKey.WIRE_DTYPE:
+            if key in (
+                TuningKey.WIRE_DTYPE,
+                TuningKey.WIRE_DTYPE_ICI,
+                TuningKey.WIRE_DTYPE_DCN,
+            ):
                 from .tuning import wire_dtype_value
 
                 value = wire_dtype_value(value)
@@ -1445,6 +1516,23 @@ class ACCL:
                     f"this group is world={self._world.size}"
                 )
             return None
+        if plan.topology is not None:
+            # topology provenance: a hierarchical / per-link-class wire
+            # winner was raced on a specific link-class layout — adopting
+            # it on a different one (or on a flat group) would dispatch
+            # decompositions the measurement never covered
+            here = (
+                None if self._world.topology is None
+                else self._world.topology.signature()
+            )
+            if plan.topology != here:
+                if strict:
+                    raise ValueError(
+                        f"tuning plan was raced on topology "
+                        f"{plan.topology!r}, this group's link-class "
+                        f"layout is {here!r}"
+                    )
+                return None
         if apply_defaults:
             for name, val in sorted((plan.defaults or {}).items()):
                 if name == "max_eager_size":
@@ -1530,7 +1618,19 @@ class ACCL:
         dispatches with no re-derivation."""
         cdt = None if compress_dtype is None else _as_datatype(compress_dtype)
         bucket = size_bucket(count)
-        key = (op, comm.id, comm.epoch, dtype, bucket, cdt, int(host), extra)
+        # topology plane: the communicator's topology signature is a
+        # plan-key axis (set_topology re-keys every cached plan like an
+        # epoch bump), and the comm's uniform link class steers the
+        # per-class wire verdict below.  The signature sits BEFORE
+        # ``extra`` — CollectivePlan.fuse reads key[-1] as the extra
+        # tuple.
+        topo = comm.topology
+        tsig = None if topo is None else topo.signature()
+        lc = None if topo is None else topo.comm_link_class()
+        key = (
+            op, comm.id, comm.epoch, dtype, bucket, cdt, int(host),
+            tsig, extra,
+        )
         plan, hit = self._plans.get_with_flag(key)
         self._call_tls.plan_hit = hit  # stamped onto this call's record
         if plan is not None:
@@ -1557,7 +1657,23 @@ class ACCL:
         if cdt is None and op in self._WIRE_VERDICT_OPS and (
             "fuse" not in extra
         ):
-            wd = (overlay or {}).get("wire_dtype")
+            # per-link-class ladder: a comm whose wire is uniformly ICI
+            # or DCN consults its class register first (overlay over
+            # table, like the generic); 0 — or a mixed-class comm —
+            # defers to the generic wire_dtype register.  fp8 on the
+            # slow DCN with full width on ICI is exactly two registers.
+            from .topology import LinkClass as _LC
+
+            reg = {_LC.ICI: "wire_dtype_ici", _LC.DCN: "wire_dtype_dcn"}.get(lc)
+            wd = None
+            if reg is not None:
+                wd = (overlay or {}).get(reg)
+                if wd is None:
+                    wd = self._engine_tuning().get(reg, 0)
+                if not int(wd or 0):
+                    wd = None
+            if wd is None:
+                wd = (overlay or {}).get("wire_dtype")
             if wd is None:
                 wd = self._engine_tuning().get("wire_dtype", 0)
             try:
@@ -1614,6 +1730,22 @@ class ACCL:
             psegs = int((overlay or {}).get(
                 "ring_segments", table.get("ring_segments", 1)
             ) or 1)
+        # topology plane: the hierarchical-dispatch verdict — the
+        # HIERARCHICAL register (overlay over table, raced by the
+        # autotuner) armed AND the topology shape actually decomposes
+        # this op.  The count-divisibility half of eligibility is
+        # re-checked per call in the entry point (counts vary within a
+        # bucket); this is the bucket-wide register half.
+        hier = False
+        if topo is not None and "fuse" not in extra:
+            from . import hierarchical as _hier
+
+            opname = op.name.lower()
+            if opname in _hier.HIER_OPS and _hier.multi_slice(topo):
+                hv = (overlay or {}).get("hierarchical")
+                if hv is None:
+                    hv = self._engine_tuning().get("hierarchical", 0)
+                hier = bool(int(hv or 0))
         plan = CollectivePlan(
             key, cfg, flags,
             wire_dtype=wire,
@@ -1623,6 +1755,8 @@ class ACCL:
             tuning=overlay,
             pipeline_threshold=pthresh,
             pipeline_segments=psegs,
+            hierarchical=hier,
+            link_class=lc,
         )
         return self._plans.store(plan)
 
@@ -1665,6 +1799,16 @@ class ACCL:
         comm = base.split(members, comm_id=comm_id)
         if comm is not None:
             self._communicators.append(comm)
+            if comm.topology is not None:
+                # split() derived the subcomm's topology from the base;
+                # hand it to the fabric so paced classes / per-class
+                # byte counters stay truthful in the subcomm's rank
+                # space too
+                fabric = getattr(self.engine, "fabric", None)
+                if fabric is not None and hasattr(
+                    fabric, "register_topology"
+                ):
+                    fabric.register_topology(comm.id, comm.topology)
             if self._monitor is not None:
                 # straggler windows on the subcomm piggyback like the
                 # world comm's; membership registered up front so a
@@ -1702,6 +1846,39 @@ class ACCL:
                         comm.id, comm.local_rank, self._contract
                     )
         return comm
+
+    # -- topology plane (accl_tpu.topology) ----------------------------------
+    @property
+    def topology(self):
+        """The world communicator's :class:`~accl_tpu.topology.Topology`
+        (None = flat)."""
+        return self._world.topology
+
+    def set_topology(self, topology,
+                     comm: Optional[Communicator] = None) -> None:
+        """Attach (or with ``None`` detach) a slice/link-class
+        :class:`~accl_tpu.topology.Topology` to ``comm`` (default: the
+        world).  Collective by contract — every rank must attach an
+        EQUAL descriptor, exactly like a register write: the topology
+        signature is a plan-key axis and the hierarchical decomposition
+        derives subcomms from it, so a skewed attach diverges dispatch.
+        Cached plans and derived subcomms drop; the fabric's paced
+        link-class model re-registers."""
+        comm = comm or self._world
+        if topology is not None and topology.world != comm.size:
+            raise ValueError(
+                f"topology describes world={topology.world}, "
+                f"communicator {comm.id} is size={comm.size}"
+            )
+        comm.topology = topology
+        comm._full_topology = None
+        self._plans.invalidate("set_topology")
+        self._hier_comms = {
+            k: v for k, v in self._hier_comms.items() if k[0] != comm.id
+        }
+        fabric = getattr(self.engine, "fabric", None)
+        if fabric is not None and hasattr(fabric, "register_topology"):
+            fabric.register_topology(comm.id, topology)
 
     # -- call plumbing -------------------------------------------------------
     def _resolve_arithcfg(
@@ -2095,7 +2272,20 @@ class ACCL:
         # equal-count tensors alternating on one comm would still
         # alias.
         seg = getattr(self._call_tls, "pipeline_seg_index", 0)
-        key = (comm.id, comm.epoch, Operation.ALLREDUCE, n, seg)
+        # topology plane: residual streams key per LINK CLASS too — a
+        # hierarchical decomposition runs the DCN stage under a
+        # different wire verdict than its ICI siblings (the per-class
+        # ladder), and blending those residuals would inject one lane's
+        # quantization error into the other's telescoping sum.  The
+        # subcomm axis is already covered by comm.id; the link class
+        # covers a topology swap re-classing the SAME comm.  Appended
+        # at the END: errorfeedback's epoch migration reconstructs keys
+        # as key[0], key[1], key[2:].
+        lc = -1
+        if comm.topology is not None:
+            cls = comm.topology.comm_link_class()
+            lc = int(cls) if cls is not None else -1
+        key = (comm.id, comm.epoch, Operation.ALLREDUCE, n, seg, lc)
         x = np.asarray(sendbuf.device_view()[:n])
         x_eff = self._residuals.apply(
             key, x.astype(np.float32, copy=False), wire,
@@ -2274,6 +2464,404 @@ class ACCL:
             raise self._deadlock_error(context)
         self._check_failed(outer, context)
         return outer
+
+    # -- hierarchical dispatch (accl_tpu.hierarchical) -----------------------
+    def _hier_state(self, comm: Communicator) -> dict:
+        """The per-(comm id, epoch) cache of derived slice/cross-slice
+        subcomms.  An epoch bump (shrink/grow/soft reset) re-derives
+        naturally — stale epochs of the same comm are pruned here so
+        elastic churn can't grow the cache unboundedly."""
+        key = (comm.id, comm.epoch)
+        st = self._hier_comms.get(key)
+        if st is None:
+            for k in [k for k in self._hier_comms if k[0] == comm.id]:
+                del self._hier_comms[k]
+            st = {}
+            self._hier_comms[key] = st
+        return st
+
+    def _hier_subcomm(self, comm, st, name, members):
+        """Derive (once) the subcomm over ``members`` of ``comm``.
+        create_communicator's deterministic ids need zero wire bytes,
+        and every member derives the same list from the shared topology
+        — the SPMD-uniform subcomm discipline; non-members never call
+        (each rank only derives the subcomms it belongs to)."""
+        sub = st.get(name)
+        if sub is None:
+            sub = self.create_communicator(list(members), base=comm)
+            st[name] = sub
+        return sub
+
+    def _hier_fingerprint(self, op_name, comm, dtype, count,
+                          root=0, context="") -> None:
+        """Contract-plane record of the DECOMPOSED call on the PARENT
+        communicator, op name ``"<op>.hier"``: a rank dispatching flat
+        where its peers went hierarchical (or vice versa) diverges
+        within one verification window, exactly like a fused-vs-plain
+        skew.  The sub-collectives additionally fingerprint on their
+        own subcomms like any other call."""
+        c = self._contract
+        if c is None:
+            return
+        verdict = c.record(
+            op=f"{op_name}.hier",
+            comm_id=comm.id,
+            dtype=dtype.name,
+            count=count,
+            root=f"{root}/0",
+            tag=0,
+        )
+        if verdict is not None:
+            raise self._contract_error(verdict, context or op_name)
+
+    def _hier_eligible_call(self, plan, comm, compress_dtype,
+                            op_name: str, count: int) -> bool:
+        """The per-call half of the hierarchical verdict: the plan's
+        register half armed, no explicit compression lane (an explicit
+        ``compress_dtype`` is honored exactly — only register-driven
+        wire verdicts ride the per-class ladders), not inside an open
+        batch (queued dispatch units stay flat), not already a stage of
+        a hierarchical or pipelined launch, and the (topology, count)
+        shape actually decomposes."""
+        if (
+            not plan.hierarchical
+            or compress_dtype is not None
+            or self._pending is not None
+            or getattr(self._call_tls, "hier", False)
+            or getattr(self._call_tls, "pipelining", False)
+        ):
+            return False
+        from . import hierarchical as _hier
+
+        return _hier.eligible(op_name, comm.topology, count)
+
+    def _launch_hier_stages(self, op_name, plan, comm, count, dtype,
+                            stages, run_async, context):
+        """Run a hierarchical decomposition as an async CHAIN of
+        sub-collective stages, returning ONE aggregate Request (the
+        :meth:`_launch_pipelined` aggregate discipline).  Each stage
+        thunk launches its sub-collective with ``run_async=True`` and
+        returns the Request — or None when this rank does not
+        participate in the stage (a non-leader during the cross-slice
+        stage), which advances straight to the next stage.  Chaining
+        rides done-callbacks, never a blocking wait: the test harness
+        posts every rank's call from one thread, and a stage that
+        blocked inside the entry call would deadlock the group."""
+        outer = Request(op_name=op_name.upper())
+        outer.mark_executing()
+        tel = self._telemetry
+        meta = None
+        tid = None
+        if tel is not None:
+            tid, phase = self._derive_collective_trace(op_name, comm)
+            meta = {
+                "op": op_name, "comm": comm.id, "epoch": comm.epoch,
+                "comm_rank": comm.local_rank, "comm_world": comm.size,
+                "dtype": dtype.name, "count": count,
+                "nbytes": count * dtype_size(dtype),
+                "bucket": plan.bucket, "algorithm": plan.algorithm,
+                "plan_hit": getattr(self._call_tls, "plan_hit", None),
+                "eager": plan.eager,
+                "hierarchical": True,
+                "trace_id": tid,
+                "trace_phase": phase,
+                "parent_id": None,
+            }
+        t0 = time.perf_counter_ns()
+        inner: list = []
+        lock = threading.Lock()
+        state = {"i": 0, "done": False}
+
+        def _finish(code, ctx):
+            with lock:
+                if state["done"]:
+                    return
+                state["done"] = True
+            depth = None
+            for q in inner:
+                if q.inflight_depth:
+                    depth = max(depth or 0, q.inflight_depth)
+            outer.inflight_depth = depth
+            outer.complete(
+                code, max(time.perf_counter_ns() - t0, 1), context=ctx
+            )
+
+        def _advance():
+            while True:
+                with lock:
+                    if state["done"]:
+                        return
+                    idx = state["i"]
+                    state["i"] += 1
+                if idx >= len(stages):
+                    _finish(ErrorCode.OK, None)
+                    return
+                # stages launch from completion-callback threads: the
+                # TLS guard (no re-decomposition) and the parent trace
+                # id must be set on WHATEVER thread runs the thunk
+                self._call_tls.hier = True
+                self._call_tls.parent_trace = tid
+                try:
+                    req = stages[idx]()
+                except ACCLError as e:
+                    _finish(e.code, dict(e.details) or None)
+                    return
+                except Exception as e:
+                    # a stage must fail the aggregate, never kill a
+                    # fabric completion thread
+                    _finish(ErrorCode.INVALID_OPERATION, {
+                        "op": op_name, "hier_stage": idx,
+                        "error": repr(e),
+                    })
+                    return
+                finally:
+                    self._call_tls.hier = False
+                    self._call_tls.parent_trace = None
+                if req is None:
+                    continue  # non-participant: straight to next stage
+                inner.append(req)
+
+                def _done(q=req):
+                    rc = q.get_retcode()
+                    if rc != ErrorCode.OK:
+                        _finish(rc, q.error_context)
+                        return
+                    # hop to a fresh thread: the callback fires on
+                    # whatever thread delivered the final frame — often
+                    # a PEER rank's thread — and launching the next
+                    # stage inline there would serialize independent
+                    # ranks' sends (and their modeled-wire pacing
+                    # sleeps) through one thread, flattening exactly
+                    # the concurrency the decomposition exists to buy
+                    threading.Thread(
+                        target=_advance,
+                        name=f"accl-hier-{op_name}",
+                        daemon=True,
+                    ).start()
+
+                req.add_done_callback(_done)
+                return
+
+        def _resolve():
+            for q in inner:
+                q.materialize()
+
+        outer.defer_result(_resolve)
+        if tel is not None:
+            tel.attach(outer, meta)
+        _advance()
+        if run_async:
+            return outer
+        if not outer.wait(timeout=drain_deadline_s(self._timeout_s)):
+            raise self._deadlock_error(context)
+        self._check_failed(outer, context)
+        return outer
+
+    def _hier_allreduce(self, plan, comm, sendbuf, recvbuf, n,
+                        function, run_async):
+        """Hierarchical allreduce.  Rail mode (symmetric topology,
+        count % S == 0): intra-slice reduce-scatter (ICI) -> allreduce
+        over the rail holding this chunk (DCN, n/S elements) ->
+        intra-slice allgather (ICI) — the slow links carry 1/S of the
+        flat ring's bytes.  Leader mode (any other multi-slice shape):
+        reduce to the slice leader -> allreduce over leaders (full
+        count) -> intra-slice bcast."""
+        from . import hierarchical as _hier
+
+        topo = comm.topology
+        mode = _hier.allreduce_mode(topo, n)
+        st = self._hier_state(comm)
+        me = comm.local_rank
+        sl = topo.slice_of(me)
+        members = list(topo.slice_members(sl))
+        intra = self._hier_subcomm(comm, st, ("intra", sl), members)
+        self._hier_fingerprint(
+            "allreduce", comm, sendbuf.dtype, n, context="allreduce"
+        )
+        if mode == "rail":
+            S = len(topo.slices[0])
+            li = topo.local_index(me)
+            rail = self._hier_subcomm(
+                comm, st, ("rail", li), topo.rail(li)
+            )
+            chunk = n // S
+            scratch = self.engine.create_buffer(chunk, sendbuf.dtype)
+            reduced = self.engine.create_buffer(chunk, sendbuf.dtype)
+            stages = [
+                lambda: self.reduce_scatter(
+                    sendbuf, scratch, chunk, function=function,
+                    comm=intra, run_async=True,
+                ),
+                lambda: self.allreduce(
+                    scratch, reduced, chunk, function=function,
+                    comm=rail, run_async=True,
+                ),
+                lambda: self.allgather(
+                    reduced, recvbuf, chunk, comm=intra, run_async=True,
+                ),
+            ]
+        else:
+            lead = topo.slice_leader(me)
+            lead_idx = members.index(lead)
+            scratch = self.engine.create_buffer(n, sendbuf.dtype)
+
+            def _s1():
+                return self.reduce(
+                    sendbuf, scratch if me == lead else None, n,
+                    root=lead_idx, function=function, comm=intra,
+                    run_async=True,
+                )
+
+            def _s2():
+                if me != lead:
+                    return None
+                lcomm = self._hier_subcomm(
+                    comm, st, "leaders", topo.leaders()
+                )
+                return self.allreduce(
+                    scratch, recvbuf, n, function=function,
+                    comm=lcomm, run_async=True,
+                )
+
+            def _s3():
+                if intra.size == 1:
+                    return None
+                return self.bcast(
+                    recvbuf, n, root=lead_idx, comm=intra,
+                    run_async=True,
+                )
+
+            stages = [_s1, _s2, _s3]
+        return self._launch_hier_stages(
+            "allreduce", plan, comm, n, sendbuf.dtype, stages,
+            run_async, "allreduce",
+        )
+
+    def _hier_allgather(self, plan, comm, sendbuf, recvbuf, n,
+                        run_async):
+        """Hierarchical allgather (symmetric contiguous topology):
+        intra-slice allgather (ICI) -> rail allgather (DCN) — the rail
+        stage's slice-major placement equals the flat rank-major
+        placement exactly because slices are contiguous ascending."""
+        topo = comm.topology
+        st = self._hier_state(comm)
+        me = comm.local_rank
+        sl = topo.slice_of(me)
+        li = topo.local_index(me)
+        S = len(topo.slices[0])
+        intra = self._hier_subcomm(
+            comm, st, ("intra", sl), topo.slice_members(sl)
+        )
+        rail = self._hier_subcomm(comm, st, ("rail", li), topo.rail(li))
+        self._hier_fingerprint(
+            "allgather", comm, sendbuf.dtype, n, context="allgather"
+        )
+        scratch = self.engine.create_buffer(S * n, sendbuf.dtype)
+        stages = [
+            lambda: self.allgather(
+                sendbuf, scratch, n, comm=intra, run_async=True
+            ),
+            lambda: self.allgather(
+                scratch, recvbuf, S * n, comm=rail, run_async=True
+            ),
+        ]
+        return self._launch_hier_stages(
+            "allgather", plan, comm, n, sendbuf.dtype, stages,
+            run_async, "allgather",
+        )
+
+    def _hier_reduce_scatter(self, plan, comm, sendbuf, recvbuf, n,
+                             function, run_async):
+        """Hierarchical reduce-scatter (symmetric contiguous topology):
+        permute the W send blocks host-side
+        (:func:`~accl_tpu.hierarchical.reduce_scatter_permutation`, so
+        chunk s*S+i routes through intra block i / rail block s) ->
+        intra-slice reduce-scatter over L*n-element blocks (ICI) ->
+        rail reduce-scatter over n-element blocks (DCN) — every rank
+        lands exactly its own fully-reduced chunk."""
+        from . import hierarchical as _hier
+
+        topo = comm.topology
+        st = self._hier_state(comm)
+        me = comm.local_rank
+        sl = topo.slice_of(me)
+        li = topo.local_index(me)
+        L, S = topo.num_slices, len(topo.slices[0])
+        W = L * S
+        intra = self._hier_subcomm(
+            comm, st, ("intra", sl), topo.slice_members(sl)
+        )
+        rail = self._hier_subcomm(comm, st, ("rail", li), topo.rail(li))
+        self._hier_fingerprint(
+            "reduce_scatter", comm, recvbuf.dtype, n,
+            context="reduce_scatter",
+        )
+        perm = _hier.reduce_scatter_permutation(topo)
+        arr = np.asarray(sendbuf.device_view()[: W * n])
+        staged = self.engine.create_buffer(
+            W * n, sendbuf.dtype,
+            data=np.ascontiguousarray(arr.reshape(W, n)[perm].reshape(-1)),
+        )
+        scratch = self.engine.create_buffer(L * n, sendbuf.dtype)
+        stages = [
+            lambda: self.reduce_scatter(
+                staged, scratch, L * n, function=function, comm=intra,
+                run_async=True,
+            ),
+            lambda: self.reduce_scatter(
+                scratch, recvbuf, n, function=function, comm=rail,
+                run_async=True,
+            ),
+        ]
+        return self._launch_hier_stages(
+            "reduce_scatter", plan, comm, n, recvbuf.dtype, stages,
+            run_async, "reduce_scatter",
+        )
+
+    def _hier_bcast(self, plan, comm, buf, n, root, run_async):
+        """Hierarchical bcast (any multi-slice topology): bcast over
+        one representative per slice — the root for its own slice, the
+        leader elsewhere — then bcast within each slice from its
+        representative.  The payload crosses the DCN once per remote
+        slice instead of riding whatever flat tree the registers
+        picked."""
+        from . import hierarchical as _hier
+
+        topo = comm.topology
+        st = self._hier_state(comm)
+        me = comm.local_rank
+        sl = topo.slice_of(me)
+        members = list(topo.slice_members(sl))
+        reps = _hier.bcast_representatives(topo, root)
+        my_rep = (
+            int(root) if sl == topo.slice_of(root) else members[0]
+        )
+        rep_idx = members.index(my_rep)
+        intra = self._hier_subcomm(comm, st, ("intra", sl), members)
+        self._hier_fingerprint(
+            "bcast", comm, buf.dtype, n, root=root, context="bcast"
+        )
+
+        def _s1():
+            if me not in reps:
+                return None
+            cross = self._hier_subcomm(comm, st, ("bcast", root), reps)
+            return self.bcast(
+                buf, n, root=reps.index(int(root)), comm=cross,
+                run_async=True,
+            )
+
+        def _s2():
+            if intra.size == 1:
+                return None
+            return self.bcast(
+                buf, n, root=rep_idx, comm=intra, run_async=True
+            )
+
+        return self._launch_hier_stages(
+            "bcast", plan, comm, n, buf.dtype, [_s1, _s2],
+            run_async, "bcast",
+        )
 
     #: operations under the cross-rank sequence contract: every rank of
     #: the communicator must issue them with matching op/dtype/count/
@@ -2743,6 +3331,10 @@ class ACCL:
             Operation.BCAST, comm, buf.dtype, n, compress_dtype, host,
             (root,),
         )
+        if self._hier_eligible_call(
+            plan, comm, compress_dtype, "bcast", n
+        ):
+            return self._hier_bcast(plan, comm, buf, n, root, run_async)
         nseg = self._pipeline_segments_for(plan, n, buf.dtype)
         if nseg > 1:
             return self._launch_pipelined(
@@ -2850,6 +3442,12 @@ class ACCL:
         plan = self._plan_for(
             Operation.ALLGATHER, comm, sendbuf.dtype, n, compress_dtype, host,
         )
+        if self._hier_eligible_call(
+            plan, comm, compress_dtype, "allgather", n
+        ):
+            return self._hier_allgather(
+                plan, comm, sendbuf, recvbuf, n, run_async
+            )
         opts = CallOptions(
             op=Operation.ALLGATHER,
             comm=comm,
@@ -2957,6 +3555,15 @@ class ACCL:
             Operation.ALLREDUCE, comm, sendbuf.dtype, n, compress_dtype,
             host, (int(function),),
         )
+        # topology plane: hierarchical decomposition BEFORE the
+        # pipelining split — the stages are ordinary facade calls on
+        # the derived subcomms and may pipeline there
+        if self._hier_eligible_call(
+            plan, comm, compress_dtype, "allreduce", n
+        ):
+            return self._hier_allreduce(
+                plan, comm, sendbuf, recvbuf, n, function, run_async
+            )
         nseg = self._pipeline_segments_for(plan, n, sendbuf.dtype)
         if nseg > 1:
             return self._launch_pipelined(
@@ -3006,6 +3613,12 @@ class ACCL:
             Operation.REDUCE_SCATTER, comm, recvbuf.dtype, n, compress_dtype,
             host, (int(function),),
         )
+        if self._hier_eligible_call(
+            plan, comm, compress_dtype, "reduce_scatter", n
+        ):
+            return self._hier_reduce_scatter(
+                plan, comm, sendbuf, recvbuf, n, function, run_async
+            )
         opts = CallOptions(
             op=Operation.REDUCE_SCATTER,
             comm=comm,

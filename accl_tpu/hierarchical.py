@@ -1,0 +1,153 @@
+"""Hierarchical collective decomposition rules (pure math, no wire).
+
+Role model: two-level collectives on two-tier networks (NCCL's
+inter/intra-node trees, Horovod's hierarchical allreduce, the
+reference's algorithm registers picking flat-vs-tree per network).
+Given a :class:`~accl_tpu.topology.Topology`, every facade collective
+that can decompose does so into sub-collectives on derived subcomms so
+the slow DCN carries ``1/slice_size`` of the bytes a flat ring pushes
+across it:
+
+* **allreduce, rail mode** (symmetric topology, count divisible by the
+  slice size): reduce-scatter within the slice (ICI) -> allreduce over
+  the *rail* — the ranks holding the same chunk in every slice (DCN,
+  count/S elements) -> allgather within the slice (ICI).  The rail is
+  the per-chunk generalization of "cross-slice allreduce over slice
+  leaders": after the intra reduce-scatter, chunk i's owners ARE the
+  leaders for chunk i.
+* **allreduce, leader mode** (anything else): reduce to the slice
+  leader (ICI) -> allreduce over the leaders (DCN, full count) ->
+  bcast within the slice (ICI).
+* **allgather** (symmetric + contiguous): intra allgather -> rail
+  allgather; contiguity makes the rail's slice-major placement equal
+  the flat rank-major placement.
+* **reduce_scatter** (symmetric + contiguous): permute send blocks
+  (:func:`reduce_scatter_permutation`) -> intra reduce-scatter over
+  L*n-element blocks -> rail reduce-scatter over n-element blocks;
+  the permutation routes chunk ``s*S + i`` through intra block i /
+  rail block s so every rank lands exactly its own chunk.
+* **bcast** (any multi-slice topology): bcast over one representative
+  per slice — the root for its own slice, the leader elsewhere
+  (:func:`bcast_representatives`) — then bcast within each slice from
+  its representative.
+
+Every decision here is a function of (topology, op, count) only — all
+SPMD-uniform facts — so every rank of a communicator picks the same
+decomposition with zero wire bytes; the facade additionally
+fingerprints the decomposed call on the PARENT communicator (op name
+``"<op>.hier"``), so a flat-vs-hierarchical skew convicts within one
+contract verify window like any other sequence divergence.
+
+Jax- and numpy-free (analysis ``jax-free-module`` enforced): the
+numpy-only CI smoke drives these rules directly.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from .topology import Topology
+
+__all__ = [
+    "HIER_OPS",
+    "allreduce_mode",
+    "bcast_eligible",
+    "bcast_representatives",
+    "eligible",
+    "gatherlike_eligible",
+    "multi_slice",
+    "reduce_scatter_permutation",
+]
+
+#: facade collectives with a hierarchical decomposition (lower-case op
+#: names — the register/plan vocabulary)
+HIER_OPS = ("allreduce", "allgather", "reduce_scatter", "bcast")
+
+
+def multi_slice(topo: Optional[Topology]) -> bool:
+    """The baseline eligibility every decomposition shares: at least
+    two slices AND at least one slice with two members.  All-singleton
+    slices (a pure-DCN comm — e.g. a rail subcomm) must NOT decompose:
+    the decomposition would recurse into an identical call."""
+    return (
+        topo is not None
+        and topo.num_slices >= 2
+        and topo.world > topo.num_slices
+    )
+
+
+def allreduce_mode(topo: Optional[Topology],
+                   count: int) -> Optional[str]:
+    """``"rail"`` / ``"leader"`` / None (stay flat).  Rail needs every
+    slice the same size and the count divisible by it (the intra
+    reduce-scatter hands each rank an equal chunk); leader mode covers
+    every other multi-slice shape at full-count DCN cost."""
+    if not multi_slice(topo):
+        return None
+    if topo.symmetric and count > 0 and count % len(topo.slices[0]) == 0:
+        return "rail"
+    return "leader"
+
+
+def gatherlike_eligible(topo: Optional[Topology]) -> bool:
+    """allgather / reduce_scatter eligibility: the rail stage places
+    blocks slice-major, which equals the flat rank-major placement only
+    when slices are contiguous ascending runs of equal size."""
+    return bool(multi_slice(topo) and topo.symmetric and topo.contiguous)
+
+
+def bcast_eligible(topo: Optional[Topology]) -> bool:
+    """bcast decomposes on any multi-slice topology (representatives
+    need no symmetry or contiguity)."""
+    return multi_slice(topo)
+
+
+def eligible(op: str, topo: Optional[Topology], count: int) -> bool:
+    """One predicate over (op name, topology, count) — the callable
+    the facade and the autotuner share, so a raced ``hierarchical``
+    register can only arm decompositions that exist."""
+    if op == "allreduce":
+        return allreduce_mode(topo, count) is not None
+    if op in ("allgather", "reduce_scatter"):
+        return gatherlike_eligible(topo)
+    if op == "bcast":
+        return bcast_eligible(topo)
+    return False
+
+
+def bcast_representatives(topo: Topology, root: int) -> List[int]:
+    """One rank per slice for the cross-slice bcast stage: the ROOT
+    for its own slice (no extra hop — the root already holds the
+    payload), the slice leader elsewhere.  Sorted ascending: every
+    rank derives the same member list, and the cross subcomm's rank
+    order is reproducible."""
+    rs = topo.slice_of(root)
+    reps = [
+        int(root) if si == rs else s[0]
+        for si, s in enumerate(topo.slices)
+    ]
+    return sorted(reps)
+
+
+def reduce_scatter_permutation(topo: Topology) -> List[int]:
+    """Block permutation staging a hierarchical reduce-scatter.
+
+    With L contiguous slices of size S (world W = L*S, flat chunk c
+    belongs to global rank c), the staged send buffer orders the W
+    per-rank blocks as::
+
+        [ s*S + i  for i in range(S) for s in range(L) ]
+
+    so intra block i (L consecutive blocks) carries the chunks of
+    local index i across ALL slices.  The intra reduce-scatter (count
+    L*n) then hands rank (s, i) the slice-partial sums of those L
+    chunks; the rail reduce-scatter (count n) hands it block s of that
+    — the fully-reduced chunk of global rank ``s*S + i``, exactly the
+    flat result."""
+    if not (topo.symmetric and topo.contiguous):
+        raise ValueError(
+            "reduce_scatter staging needs a symmetric contiguous "
+            "topology"
+        )
+    L, S = topo.num_slices, len(topo.slices[0])
+    return [s * S + i for i in range(S) for s in range(L)]

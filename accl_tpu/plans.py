@@ -66,11 +66,13 @@ class CollectivePlan:
         "key", "arithcfg", "compression", "wire_dtype", "bucket",
         "eager", "algorithm", "tuning", "engine",
         "pipeline_threshold", "pipeline_segments", "cmdring_slot",
+        "hierarchical", "link_class",
     )
 
     def __init__(self, key, arithcfg, compression, wire_dtype, bucket,
                  eager, algorithm, tuning=None,
-                 pipeline_threshold=0, pipeline_segments=1):
+                 pipeline_threshold=0, pipeline_segments=1,
+                 hierarchical=False, link_class=None):
         self.key = key
         self.arithcfg = arithcfg          # resolved ArithConfig
         self.compression = compression    # CompressionFlags
@@ -89,6 +91,14 @@ class CollectivePlan:
         # here so the warm path never re-reads engine registers.
         self.pipeline_threshold = int(pipeline_threshold or 0)
         self.pipeline_segments = int(pipeline_segments or 1)
+        # topology plane: the hierarchical-dispatch verdict for this
+        # plan's (op, bucket, topology) — True routes the call through
+        # the facade's slice/cross-slice decomposition — and the comm's
+        # uniform LinkClass (or None when classes mix), the axis the
+        # per-class wire verdict was resolved against.  Both cached so
+        # the warm path never re-reads registers or the slice table.
+        self.hierarchical = bool(hierarchical)
+        self.link_class = link_class
         # command-ring plane: the plan -> slot encoding, cached by the
         # gang engine on first ring-resident dispatch (an int32 word
         # template from accl_tpu.cmdring.encode_slot covering the FULL
@@ -135,6 +145,8 @@ class CollectivePlan:
             "pipeline_segments": self.pipeline_segments,
             "cmdring_slot_cached": self.cmdring_slot is not None,
             "fuse": self.fuse,
+            "hierarchical": self.hierarchical,
+            "link_class": getattr(self.link_class, "name", None),
         }
 
 

@@ -48,6 +48,7 @@ from .constants import (
     TUNING_KEY_NAMES,
     WIRE_LANE_DTYPES,
 )
+from .hierarchical import HIER_OPS, multi_slice
 from .plans import size_bucket
 
 #: env var naming a TuningPlan JSON file; loaded (non-strict) by every
@@ -147,11 +148,19 @@ def validate_registers(regs: Dict[str, object]) -> Dict[str, object]:
                     f"{[a.name.lower() for a in ROOTED_ALGORITHMS]})"
                 )
             val = algo.name.lower()
-        elif name == "wire_dtype":
+        elif name in ("wire_dtype", "wire_dtype_ici", "wire_dtype_dcn"):
+            # the per-link-class lanes validate exactly like the generic
+            # register: 0 on a per-class lane means "defer to wire_dtype",
+            # not "uncompressed" — the facade's resolution order
             try:
                 val = wire_dtype_value(val)
             except ValueError as e:
                 raise ValueError(f"register {name}: {e}") from None
+        elif name == "hierarchical":
+            val = int(val)
+            if val not in (0, 1):
+                # same bound the engines enforce at SET_TUNING
+                raise ValueError(f"register {name}: {val} not in (0, 1)")
         else:
             val = int(val)
             if val < 0:
@@ -207,6 +216,12 @@ class TuningPlan:
     )
     provenance: Dict[str, object] = dataclasses.field(default_factory=dict)
     version: int = 1
+    #: link-class layout the race ran under: a Topology signature string
+    #: (e.g. "2x4"), or None for a flat/unclassified group.  Load-time
+    #: provenance, not a register: `ACCL.load_tuning_plan` refuses a
+    #: plan raced on a different layout — a hierarchical/per-class-wire
+    #: winner is only meaningful on the topology it was measured on.
+    topology: Optional[str] = None
 
     # -- dispatch-side lookup ------------------------------------------------
     def registers_for(self, collective: str, bucket: int) -> Dict[str, object]:
@@ -233,6 +248,7 @@ class TuningPlan:
                 for op, per_op in self.entries.items()
             },
             "provenance": self.provenance,
+            "topology": self.topology,
         }
         return json.dumps(doc, indent=2, sort_keys=True)
 
@@ -253,6 +269,10 @@ class TuningPlan:
             entries=entries,
             provenance=dict(doc.get("provenance") or {}),
             version=int(doc.get("version", 1)),
+            topology=(
+                None if doc.get("topology") is None
+                else str(doc["topology"])
+            ),
         )
 
     def save(self, path: str) -> None:
@@ -381,6 +401,9 @@ def _candidates(
     wire_dtypes: Sequence = (),
     cmdring_run_windows: Sequence[int] = (),
     cmdring_linger_us: Sequence[int] = (),
+    race_hierarchical: bool = False,
+    wire_dtypes_ici: Sequence = (),
+    wire_dtypes_dcn: Sequence = (),
 ) -> List[Dict[str, object]]:
     """Tier-appropriate register sets to race for one collective.  The
     empty dict (the defaults) is always candidate 0 — a plan can only
@@ -460,6 +483,33 @@ def _candidates(
             for wd in wire_dtypes
             if wire_dtype_value(wd) != 0
         ]
+        # per-link-class wire ladders: an ICI/DCN lane only resolves on a
+        # communicator whose link class is uniform — on a mixed parent
+        # comm it no-ops (and ties with the defaults), on the derived
+        # slice/leader subcomms it is the actual per-hop verdict
+        cands += [
+            {"wire_dtype_ici": wire_dtype_value(wd)}
+            for wd in wire_dtypes_ici
+            if wire_dtype_value(wd) != 0
+        ]
+        cands += [
+            {"wire_dtype_dcn": wire_dtype_value(wd)}
+            for wd in wire_dtypes_dcn
+            if wire_dtype_value(wd) != 0
+        ]
+    if race_hierarchical and op in HIER_OPS:
+        # topology plane: race the slice/cross-slice decomposition
+        # against the flat lowering per bucket; for allreduce also race
+        # "hierarchical + fp8-on-DCN" — the cross-slice leader hop is
+        # the only leg a DCN lane compresses, so the combination is the
+        # shape the paper's multi-slice numbers come from
+        cands.append({"hierarchical": 1})
+        if op == "allreduce":
+            cands += [
+                {"hierarchical": 1, "wire_dtype_dcn": wire_dtype_value(wd)}
+                for wd in wire_dtypes_dcn
+                if wire_dtype_value(wd) != 0
+            ]
     for e in eager_candidates:
         cands.append({"max_eager_size": int(e)})
     return cands
@@ -497,6 +547,9 @@ def autotune(
     wire_dtypes: Sequence = (),
     cmdring_run_windows: Sequence[int] = (),
     cmdring_linger_us: Sequence[int] = (),
+    wire_dtypes_ici: Sequence = (),
+    wire_dtypes_dcn: Sequence = (),
+    topology=None,
     margin: float = 0.10,
     log=None,
 ) -> TuningPlan:
@@ -515,6 +568,12 @@ def autotune(
     keeps serving)."""
     world = len(group)
     tier = detect_tier(group)
+    if topology is None:
+        # the group's attached descriptor, when the caller didn't pass
+        # one explicitly — hierarchical candidates only make sense on
+        # the layout the group actually dispatches under
+        topology = getattr(group[0], "topology", None)
+    race_hier = topology is not None and multi_slice(topology)
     collectives = list(collectives or COLLECTIVES)
     sizes = list(sizes or [2**e for e in range(4, 17, 4)])
     say = log or (lambda msg: None)
@@ -532,6 +591,9 @@ def autotune(
                     tier, op, world, include_pallas, eager_candidates,
                     segments, pipeline_thresholds, wire_dtypes,
                     cmdring_run_windows, cmdring_linger_us,
+                    race_hierarchical=race_hier,
+                    wire_dtypes_ici=wire_dtypes_ici,
+                    wire_dtypes_dcn=wire_dtypes_dcn,
                 ):
                     try:
                         # the register writes are part of the candidate:
@@ -588,6 +650,10 @@ def autotune(
         "wire_dtypes": [wire_dtype_value(w) for w in wire_dtypes],
         "cmdring_run_windows": [int(r) for r in cmdring_run_windows],
         "cmdring_linger_us": [int(u) for u in cmdring_linger_us],
+        "wire_dtypes_ici": [wire_dtype_value(w) for w in wire_dtypes_ici],
+        "wire_dtypes_dcn": [wire_dtype_value(w) for w in wire_dtypes_dcn],
+        "topology": None if topology is None else topology.signature(),
+        "hierarchical_raced": bool(race_hier),
         "margin": float(margin),
     }
     try:
@@ -609,6 +675,7 @@ def autotune(
         defaults=dict(REGISTER_DEFAULTS),
         entries=entries,
         provenance=provenance,
+        topology=None if topology is None else topology.signature(),
     )
 
 
@@ -666,6 +733,35 @@ def main(argv=None) -> int:
              "e.g. 500 5000)",
     )
     ap.add_argument(
+        "--wire-dtypes-ici", nargs="*", default=[],
+        help="per-link-class wire lanes to race on ICI-uniform "
+             "communicators (WIRE_DTYPE_ICI register); same names as "
+             "--wire-dtypes",
+    )
+    ap.add_argument(
+        "--wire-dtypes-dcn", nargs="*", default=[],
+        help="per-link-class wire lanes to race on DCN-crossing hops "
+             "(WIRE_DTYPE_DCN register) — with --slice-size this also "
+             "races 'hierarchical + lane' for allreduce",
+    )
+    ap.add_argument(
+        "--slice-size", type=int, default=None,
+        help="emulator backend only: attach a symmetric multi-slice "
+             "Topology (world/slice-size slices) to the group, which "
+             "arms the hierarchical-vs-flat race and stamps the plan's "
+             "topology provenance",
+    )
+    ap.add_argument(
+        "--ici-gbps", type=float, default=None,
+        help="emulator backend only: modeled intra-slice link rate for "
+             "the two-class paced fabric (with --dcn-gbps)",
+    )
+    ap.add_argument(
+        "--dcn-gbps", type=float, default=None,
+        help="emulator backend only: modeled cross-slice link rate — "
+             "the slow class hierarchical decomposition exists to avoid",
+    )
+    ap.add_argument(
         "--wire-gbps", type=float, default=None,
         help="emulator backend only: pace the in-process fabric at this "
              "modeled link rate (Fabric.set_wire_rate) for the whole "
@@ -705,8 +801,16 @@ def main(argv=None) -> int:
 
     from . import core
 
+    topology = None
+    if args.slice_size:
+        if args.backend != "emulator":
+            raise SystemExit("--slice-size attaches an emulated-fabric "
+                             "topology (use --backend emulator)")
+        from .topology import Topology
+
+        topology = Topology.from_slice_size(args.world, args.slice_size)
     group = (
-        core.emulated_group(args.world)
+        core.emulated_group(args.world, topology=topology)
         if args.backend == "emulator"
         else core.xla_group(args.world)
     )
@@ -715,6 +819,13 @@ def main(argv=None) -> int:
             raise SystemExit("--wire-gbps models the emulated fabric "
                              "(use --backend emulator)")
         group[0].engine.fabric.set_wire_rate(args.wire_gbps)
+    if args.ici_gbps or args.dcn_gbps:
+        if args.backend != "emulator":
+            raise SystemExit("--ici-gbps/--dcn-gbps model the emulated "
+                             "fabric (use --backend emulator)")
+        group[0].engine.fabric.set_wire_rates(
+            ici_gbps=args.ici_gbps, dcn_gbps=args.dcn_gbps
+        )
     try:
         plan = autotune(
             group,
@@ -730,6 +841,9 @@ def main(argv=None) -> int:
             wire_dtypes=args.wire_dtypes,
             cmdring_run_windows=args.cmdring_run_windows,
             cmdring_linger_us=args.cmdring_linger_us,
+            wire_dtypes_ici=args.wire_dtypes_ici,
+            wire_dtypes_dcn=args.wire_dtypes_dcn,
+            topology=topology,
             margin=args.margin,
             log=lambda msg: print(msg, file=sys.stderr),
         )
@@ -739,6 +853,10 @@ def main(argv=None) -> int:
     plan.provenance["backend"] = args.backend
     if args.wire_gbps:
         plan.provenance["wire_gbps_model"] = float(args.wire_gbps)
+    if args.ici_gbps or args.dcn_gbps:
+        plan.provenance["wire_class_gbps_model"] = {
+            "ici": args.ici_gbps, "dcn": args.dcn_gbps,
+        }
     text = plan.to_json()
     if args.out == "-":
         print(text)

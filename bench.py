@@ -1959,6 +1959,116 @@ def _bench_compression() -> dict:
     }
 
 
+def _bench_topology() -> dict:
+    """Hierarchical-collective evidence (parse_results.check_topology):
+    flat vs hierarchical allreduce on a 2x4 multi-slice layout over the
+    emulator fabric's two-class paced link model
+    (``Fabric.set_wire_rates``; ``ACCL_TOPOLOGY_ICI_GBPS`` /
+    ``ACCL_TOPOLOGY_DCN_GBPS``, default 8 / 0.05 Gb/s — a fast
+    intra-slice interconnect over a slow cross-slice link, the regime
+    the decomposition exists for.  The DCN default sits low enough
+    that the modeled wire dominates the emulator's GIL-bound per-chunk
+    Python overhead — at DCN-realistic rates that constant overhead
+    drowns the very wall-clock difference the capture exists to
+    show).  Three claims, one capture:
+
+    * **wall clock** — with the cross-slice class paced slow, the
+      slice-local reduce-scatter / cross-slice rail allreduce /
+      slice-local allgather decomposition must beat the flat ring;
+    * **cross-link bytes** — the fabric's per-link-class counters must
+      show the DCN traffic cut by ~the slice factor (flat crosses
+      ``2*L*(W-1)/W * payload``, hierarchical ``2*(L-1) * payload``);
+    * **bit identity** — integer-valued payloads make differing
+      reduction orders exact, so hierarchical-vs-flat is a hard
+      equality, not a tolerance."""
+    import threading
+
+    from accl_tpu.core import emulated_group
+    from accl_tpu.topology import Topology
+
+    ici = float(os.environ.get("ACCL_TOPOLOGY_ICI_GBPS", "8.0"))
+    dcn = float(os.environ.get("ACCL_TOPOLOGY_DCN_GBPS", "0.05"))
+    world, slices = 8, 2
+    topo = Topology.from_slice_size(world, world // slices)
+    # 1 MiB fp32 even in SMALL mode: the gate's large-bucket floor —
+    # below it the sweep measures dispatch, not the wire
+    n = 1 << 18
+    reps = 2 if _SMALL else 3
+    rng = np.random.default_rng(7)
+    data = [
+        rng.integers(-64, 64, n).astype(np.float32) for _ in range(world)
+    ]
+    g = emulated_group(world, topology=topo)
+    try:
+        fabric = g[0].engine.fabric
+        fabric.set_wire_rates(ici_gbps=ici, dcn_gbps=dcn)
+        sends = [a.create_buffer_from(d.copy()) for a, d in zip(g, data)]
+        recvs = [a.create_buffer(n, np.float32) for a in g]
+
+        def work(i, k):
+            for _ in range(k):
+                g[i].allreduce(sends[i], recvs[i], n)
+
+        def run(k):
+            ts = [
+                threading.Thread(target=work, args=(i, k))
+                for i in range(world)
+            ]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+
+        def leg(hier: bool):
+            for a in g:
+                a.set_tuning("hierarchical", 1 if hier else 0)
+            run(1)  # warm: plans + (hier) subcommunicator derivation
+            fabric.reset_wire_class_stats()
+            with Timer() as t:
+                run(reps)
+            stats = fabric.wire_class_stats()
+            return (
+                {
+                    "wall_us": round(t.elapsed_ns() / reps / 1e3, 1),
+                    "dcn_bytes_per_run": int(
+                        (stats["bytes"].get("dcn") or 0) / reps
+                    ),
+                    "ici_bytes_per_run": int(
+                        (stats["bytes"].get("ici") or 0) / reps
+                    ),
+                },
+                [np.asarray(r.device_view()[:n]).copy() for r in recvs],
+            )
+
+        flat, flat_out = leg(False)
+        hier, hier_out = leg(True)
+        for a in g:
+            a.set_tuning("hierarchical", 0)
+        bit_identical = all(
+            np.array_equal(f, h) for f, h in zip(flat_out, hier_out)
+        )
+    finally:
+        for a in g:
+            a.deinit()
+    return {
+        "topology_signature": topo.signature(),
+        "topology_world": world,
+        "topology_num_slices": topo.num_slices,
+        "topology_payload_bytes": n * 4,
+        "topology_wire_gbps_model": {"ici": ici, "dcn": dcn},
+        "topology_flat": flat,
+        "topology_hier": hier,
+        "topology_speedup": round(
+            flat["wall_us"] / max(hier["wall_us"], 1e-9), 4
+        ),
+        "topology_dcn_reduction": round(
+            flat["dcn_bytes_per_run"]
+            / max(hier["dcn_bytes_per_run"], 1), 4
+        ),
+        "topology_bit_identical": bit_identical,
+    }
+
+
 def _compression_convergence(steps: int = 40, dim: int = 512,
                              batch: int = 64) -> dict:
     """The convergence leg: 2-rank DP-SGD linear regression with
@@ -2427,6 +2537,8 @@ def _save_lkg(result: dict) -> None:
         return  # nor one whose QoS-arbiter evidence failed its gate
     if gate_errors.get("compression_gate"):
         return  # nor one whose quantized-wire evidence failed its gate
+    if gate_errors.get("topology_gate"):
+        return  # nor one whose hierarchical-collective evidence failed
     if gate_errors.get("acclint"):
         return  # nor a capture from a tree violating project invariants
     if _SMALL or "tpu" not in str(result.get("device", "")).lower():
@@ -2894,6 +3006,7 @@ def main() -> None:
     )
     _try(extras, errors, "cmdring", _bench_cmdring)
     _try(extras, errors, "compression", _bench_compression)
+    _try(extras, errors, "topology", _bench_topology)
 
     if on_tpu or _SMALL:
         _try(extras, errors, "attention", _bench_attention)
@@ -2987,6 +3100,7 @@ def main() -> None:
             MonitorGateError,
             OverlapGateError,
             TelemetryGateError,
+            TopologyGateError,
             VerifyGateError,
             check_arbiter,
             check_arch_overhead,
@@ -2995,6 +3109,7 @@ def main() -> None:
             check_monitor,
             check_overlap,
             check_telemetry,
+            check_topology,
             check_verify,
         )
     except ImportError:  # pragma: no cover - repo layout changed
@@ -3055,6 +3170,14 @@ def main() -> None:
             check_compression(extras)
         except CompressionGateError as e:
             errors["compression_gate"] = str(e)
+        # hierarchical-collective gate: the two-class paced sweep must
+        # show hierarchical allreduce beating flat on wall clock with
+        # the DCN bytes cut by ~the slice factor (counter-asserted) and
+        # the result bit-identical to the flat lowering
+        try:
+            check_topology(extras)
+        except TopologyGateError as e:
+            errors["topology_gate"] = str(e)
 
     # static-analysis gate (acclint): a capture taken from a tree that
     # violates the project invariants (unbounded waits, broken jax-free

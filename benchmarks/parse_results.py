@@ -953,6 +953,143 @@ def check_compression_capture(bench_path: str) -> None:
     check_compression(extras)
 
 
+# Hierarchical-collective gate (multi-slice topology PR): the capture
+# must prove the slice/cross-slice decomposition BUYS cross-link
+# bandwidth where it exists to — under a two-class paced link model
+# (slow DCN, fast ICI; the CPU mesh's honest way to have a topology at
+# all) hierarchical allreduce must beat flat on wall clock AND move
+# ~slice-factor fewer bytes over the slow class (counter-asserted from
+# the fabric's per-link-class telemetry), while staying bit-identical
+# to the flat lowering.
+TOPOLOGY_SPEEDUP_FLOOR = float(
+    os.environ.get("ACCL_TOPOLOGY_SPEEDUP_FLOOR", "2.0")
+)
+
+#: slack factors: the DCN-reduction floor sits at 90% of the analytic
+#: ratio (control frames / rendezvous handshakes ride the same links),
+#: and the absolute hierarchical DCN budget allows 20% over the
+#: analytic 2*(L-1)*payload cross-slice exchange
+TOPOLOGY_DCN_REDUCTION_SLACK = 0.9
+TOPOLOGY_DCN_BUDGET_SLACK = 1.2
+
+
+class TopologyGateError(ValueError):
+    """The capture's hierarchical-collective evidence is missing or
+    incomplete, the modeled link classes are absent/inverted, the
+    speedup or cross-link byte reduction missed its floor, the
+    hierarchical DCN bytes blew their analytic budget, or the
+    hierarchical result diverged bitwise from the flat lowering."""
+
+
+def check_topology(extras: dict) -> None:
+    """Gate a capture's hierarchical-collective evidence.  No-op when
+    the topology bench never ran (wedged captures carry no topology
+    keys); otherwise the evidence must be COMPLETE — partial evidence
+    is refused as unverifiable, never waved through:
+
+    * a two-class link model with ``dcn < ici`` (an unpaced or
+      single-class sweep cannot show what the decomposition buys);
+    * payload at or above the 1 MiB large-bucket floor;
+    * wall-clock speedup >= the floor (default 2x);
+    * measured DCN-byte reduction >= 90% of the analytic flat/hier
+      ratio ``num_slices * (world-1) / world`` (for a contiguous ring
+      over L slices, flat crosses ``2*L*(W-1)/W * payload`` while
+      hierarchical crosses ``2*(L-1) * payload``);
+    * hierarchical DCN bytes within 1.2x of that ``2*(L-1)*payload``
+      analytic budget (the counters must describe the decomposition
+      actually claimed);
+    * bit-identical hierarchical-vs-flat results."""
+    extras = extras or {}
+    keys = (
+        "topology_signature", "topology_world", "topology_num_slices",
+        "topology_payload_bytes", "topology_wire_gbps_model",
+        "topology_flat", "topology_hier", "topology_speedup",
+        "topology_dcn_reduction", "topology_bit_identical",
+    )
+    present = [k for k in keys if extras.get(k) is not None]
+    if not present:
+        return  # topology bench never ran: nothing to gate
+    missing = [k for k in keys if extras.get(k) is None]
+    if missing:
+        raise TopologyGateError(
+            f"capture carries partial hierarchical-collective evidence "
+            f"(missing {missing}) — the decomposition is unverifiable"
+        )
+    rates = extras["topology_wire_gbps_model"]
+    ici = rates.get("ici") or 0
+    dcn = rates.get("dcn") or 0
+    if not (0 < dcn < ici):
+        raise TopologyGateError(
+            f"topology sweep link model is not two-class (ici={ici} "
+            f"Gb/s, dcn={dcn} Gb/s; need 0 < dcn < ici): without a "
+            "slow cross-slice class there is nothing for the "
+            "decomposition to buy; refusing the capture"
+        )
+    payload = extras["topology_payload_bytes"]
+    if payload < 1 << 20:
+        raise TopologyGateError(
+            f"topology sweep payload {payload} B is below the "
+            "large-bucket floor (1 MiB): the gate exists for the "
+            "bandwidth regime"
+        )
+    world = int(extras["topology_world"])
+    slices = int(extras["topology_num_slices"])
+    if slices < 2 or world <= slices:
+        raise TopologyGateError(
+            f"topology sweep ran on a degenerate layout (world={world}, "
+            f"slices={slices}): need >= 2 slices of >= 2 ranks for the "
+            "decomposition to exist"
+        )
+    speedup = float(extras["topology_speedup"])
+    if speedup < TOPOLOGY_SPEEDUP_FLOOR:
+        raise TopologyGateError(
+            f"hierarchical allreduce speedup {speedup:.2f}x under the "
+            f"(ici={ici}, dcn={dcn}) Gb/s model is below the "
+            f"{TOPOLOGY_SPEEDUP_FLOOR:.1f}x floor — the decomposition "
+            "does not pay for itself; refusing the capture"
+        )
+    analytic = slices * (world - 1) / world
+    reduction = float(extras["topology_dcn_reduction"])
+    if reduction < TOPOLOGY_DCN_REDUCTION_SLACK * analytic:
+        raise TopologyGateError(
+            f"DCN-byte reduction {reduction:.2f}x is below "
+            f"{TOPOLOGY_DCN_REDUCTION_SLACK:.0%} of the analytic "
+            f"{analytic:.2f}x (slices*(world-1)/world for "
+            f"{slices}x{world // slices}) — the cross-link saving the "
+            "decomposition exists for is not in the counters"
+        )
+    hier_dcn = (extras["topology_hier"] or {}).get("dcn_bytes_per_run")
+    budget = 2 * (slices - 1) * payload * TOPOLOGY_DCN_BUDGET_SLACK
+    if hier_dcn is None or not (0 < hier_dcn <= budget):
+        raise TopologyGateError(
+            f"hierarchical DCN bytes per run ({hier_dcn}) outside "
+            f"(0, {budget:.0f}] — the analytic 2*(slices-1)*payload "
+            "cross-slice exchange (plus slack); the per-link-class "
+            "counters do not describe the claimed decomposition"
+        )
+    if extras["topology_bit_identical"] is not True:
+        raise TopologyGateError(
+            "hierarchical allreduce result diverged bitwise from the "
+            "flat lowering on integer-valued data — the decomposition "
+            "is re-ordering reductions incorrectly; refusing the capture"
+        )
+
+
+def check_topology_capture(bench_path: str) -> None:
+    """CLI form (``--check-topology <capture>.json``): accepts both the
+    extras-wrapped bench shape and a standalone capture (a ``topology``
+    section or flat keys)."""
+    import json
+
+    with open(bench_path) as f:
+        doc = json.load(f)
+    result = doc.get("parsed") or doc.get("result") or doc
+    extras = (result or {}).get("extras") or result.get(
+        "topology"
+    ) or result
+    check_topology(extras)
+
+
 # Autotuned-plan refusal: a TuningPlan only ever *overrides* registers
 # where a candidate measured faster than the defaults, so a tuned sweep
 # should never be meaningfully slower than the default sweep at any
@@ -1201,6 +1338,16 @@ def main(argv=None) -> str:
             "effective-bandwidth gain at the large bucket, wire-byte "
             "ratios sane, error-feedback convergence within "
             f"{COMPRESSION_CONVERGENCE_BOUND_PCT:.1f}%"
+        )
+        return ""
+    if "--check-topology" in argv:
+        i = argv.index("--check-topology")
+        check_topology_capture(argv[i + 1])
+        print(
+            f"{argv[i + 1]}: hierarchical-collective gate ok — "
+            f">= {TOPOLOGY_SPEEDUP_FLOOR:.1f}x under the two-class "
+            "link model, DCN bytes cut by ~the slice factor "
+            "(counter-asserted), bit-identical to flat"
         )
         return ""
     if "--check-tuned" in argv:

@@ -233,7 +233,7 @@ def test_jax_free_module_traverses_from_import_alias(tmp_path, monkeypatch):
     (pkg / "sub" / "leaf.py").write_text("x = 1\n")
     for m in ("constants", "telemetry", "faults", "plans", "contract",
               "monitor", "membership", "arbiter", "wire",
-              "errorfeedback"):
+              "errorfeedback", "topology", "hierarchical"):
         (pkg / f"{m}.py").write_text("")
     import accl_tpu.analysis.graph as graph_mod
 
@@ -261,6 +261,8 @@ def test_jax_free_module_detects_violation(tmp_path, monkeypatch):
     (pkg / "arbiter.py").write_text("")
     (pkg / "wire.py").write_text("")
     (pkg / "errorfeedback.py").write_text("")
+    (pkg / "topology.py").write_text("")
+    (pkg / "hierarchical.py").write_text("")
     import accl_tpu.analysis.base as base_mod
 
     monkeypatch.setattr(base_mod, "package_root", lambda: str(pkg))
@@ -287,7 +289,7 @@ def test_jax_free_module_sees_with_block_imports(tmp_path, monkeypatch):
     )
     for m in ("constants", "overlap", "telemetry", "faults", "contract",
               "monitor", "membership", "arbiter", "wire",
-              "errorfeedback"):
+              "errorfeedback", "topology", "hierarchical"):
         (pkg / f"{m}.py").write_text("")
     import accl_tpu.analysis.base as base_mod
     import accl_tpu.analysis.graph as graph_mod
@@ -323,7 +325,7 @@ def test_jax_free_modules_import_without_heavy_stack():
         sys.modules['accl_tpu'] = pkg
         for m in ('constants', 'overlap', 'telemetry', 'faults', 'plans',
                   'contract', 'monitor', 'membership', 'arbiter',
-                  'wire', 'errorfeedback'):
+                  'wire', 'errorfeedback', 'topology', 'hierarchical'):
             spec = importlib.util.spec_from_file_location(
                 'accl_tpu.' + m, os.path.join(root, m + '.py'))
             mod = importlib.util.module_from_spec(spec)

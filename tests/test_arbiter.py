@@ -908,3 +908,176 @@ def test_arbiter_module_is_jax_free():
     )
     assert out.returncode == 0, out.stderr
     assert "ok" in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# cross-process tenant registry (the KV-plane ledger)
+# ---------------------------------------------------------------------------
+
+
+class _FakeKV:
+    """Dict-backed stand-in for the compat-wrapped jax KV client: the
+    three calls kv_tenant_exchange needs, shared across "processes" the
+    way the dist tier's KV service is."""
+
+    def __init__(self):
+        self.store: dict = {}
+        self.ctrs: dict = {}
+        self.lock = threading.Lock()
+
+    def key_value_set_bytes(self, key, value):
+        with self.lock:
+            self.store[key] = bytes(value)
+
+    def key_value_try_get_bytes(self, key):
+        with self.lock:
+            return self.store.get(key)
+
+    def key_value_increment(self, key, amount):
+        with self.lock:
+            self.ctrs[key] = self.ctrs.get(key, 0) + int(amount)
+            return self.ctrs[key]
+
+
+def test_kv_tenant_exchange_rendezvous_and_sweep():
+    from accl_tpu.contract import kv_tenant_exchange
+
+    kv = _FakeKV()
+    st_a: dict = {}
+    st_b: dict = {}
+    fa, out_a = kv_tenant_exchange(kv, "A", {"serve": 8}, st_a)
+    # first claimer: dense slot 0, posts, sees nobody
+    assert st_a["slot"] == 0
+    assert out_a == {"posted": 1, "peers": 0, "errors": 0}
+    assert fa == {}
+    fb, out_b = kv_tenant_exchange(kv, "B", {"bulk": 1, "logs": 2}, st_b)
+    assert st_b["slot"] == 1
+    assert out_b["posted"] == 1 and out_b["peers"] == 1
+    assert fb["A"] == {"weights": {"serve": 8}, "total": 8}
+    # warm exchange: unchanged table is NOT re-posted, sweep still runs
+    fa2, out_a2 = kv_tenant_exchange(kv, "A", {"serve": 8}, st_a)
+    assert out_a2["posted"] == 0 and out_a2["peers"] == 1
+    assert fa2["B"]["total"] == 3
+    # changed table re-posts
+    _, out_a3 = kv_tenant_exchange(kv, "A", {"serve": 4}, st_a)
+    assert out_a3["posted"] == 1
+    fb2, _ = kv_tenant_exchange(kv, "B", {"bulk": 1, "logs": 2}, st_b)
+    assert fb2["A"]["total"] == 4
+
+
+def test_kv_tenant_exchange_skips_stale_self_and_gaps():
+    from accl_tpu.contract import kv_tenant_exchange
+
+    kv = _FakeKV()
+    # a restarted process re-claims a fresh slot; its old slot still
+    # carries the same process key and must not count as a peer
+    st_old: dict = {}
+    kv_tenant_exchange(kv, "A", {"serve": 8}, st_old)
+    # a peer claims slot 1 but never posts (crashed mid-rendezvous)
+    kv.key_value_increment("accl/arb/slots", 1)
+    st_new: dict = {}
+    f, out = kv_tenant_exchange(kv, "A", {"serve": 8}, st_new)
+    assert st_new["slot"] == 2
+    assert f == {} and out["peers"] == 0
+    # D posts above A; A's sweep must skip the unposted gap at slot 1
+    # (below its own slot → a lagging claimant, not the frontier) and
+    # still reach D, while the stale slot-0 self stays excluded
+    st_d: dict = {}
+    kv_tenant_exchange(kv, "D", {"bulk": 1}, st_d)
+    assert st_d["slot"] == 3
+    f2, out2 = kv_tenant_exchange(kv, "A", {"serve": 8}, st_new)
+    assert "D" in f2 and f2["D"]["total"] == 1
+    assert out2["peers"] == 1  # D only: gap skipped, stale self skipped
+
+
+def test_ledger_fabric_shares_adversarial_pair_soak():
+    """Two per-process arbiters sharing one KV plane: a GUARANTEED(8)
+    serving tenant in one process and a BEST_EFFORT(1) bulk flooder in
+    the other converge to ~8:1 fabric-share rates, hold the split
+    across repeated exchanges, and re-derive when weights churn."""
+    from accl_tpu.arbiter import TenantLedger
+
+    kv = _FakeKV()
+    serve_arb = QosArbiter()
+    bulk_arb = QosArbiter()
+    serve_arb.register(1, name="serving", cls=TenantClass.GUARANTEED,
+                       weight=8)
+    bulk_arb.register(2, name="bulk", cls=TenantClass.BEST_EFFORT,
+                      weight=1)
+    serve_arb.attach_ledger(TenantLedger("proc-serve",
+                                         fabric_bytes_s=9e9))
+    bulk_arb.attach_ledger(TenantLedger("proc-bulk", fabric_bytes_s=9e9))
+
+    # before any peer is visible: no auto cap (nothing to share with)
+    serve_arb.ledger_exchange(kv)
+    assert serve_arb.tenant(1).bucket is None
+    # priming round: bulk posts and sees serve; serve's NEXT exchange
+    # sees bulk — the registry is eventually consistent by design
+    bulk_arb.ledger_exchange(kv)
+
+    # soak: interleaved exchanges, rates must settle and STAY at the
+    # 8:1 split of the modeled fabric
+    for _ in range(20):
+        serve_arb.ledger_exchange(kv)
+        bulk_arb.ledger_exchange(kv)
+        ts, tb = serve_arb.tenant(1), bulk_arb.tenant(2)
+        assert ts.bucket is not None and ts.auto_rate
+        assert tb.bucket is not None and tb.auto_rate
+        assert ts.bucket.rate == pytest.approx(8e9, rel=1e-6)
+        assert tb.bucket.rate == pytest.approx(1e9, rel=1e-6)
+
+    # the derived cap actually paces: the bulk flooder owes throttle
+    # time at its 1e9 B/s share while the serving tenant's 8e9 share
+    # absorbs the same burst untouched
+    owed_bulk = bulk_arb.tenant(2).bucket.throttle_ns(int(4e9))
+    owed_serve = serve_arb.tenant(1).bucket.throttle_ns(int(4e9))
+    assert owed_bulk > owed_serve
+
+    # weight churn re-derives: serving drops to weight 1 → even split
+    serve_arb.register(1, name="serving", cls=TenantClass.GUARANTEED,
+                       weight=1)
+    serve_arb.ledger_exchange(kv)
+    bulk_arb.ledger_exchange(kv)
+    serve_arb.ledger_exchange(kv)
+    assert serve_arb.tenant(1).bucket.rate == pytest.approx(
+        4.5e9, rel=1e-6
+    )
+    assert bulk_arb.tenant(2).bucket.rate == pytest.approx(
+        4.5e9, rel=1e-6
+    )
+
+    # an explicit operator rate is never overwritten by the ledger
+    bulk_arb.set_quota(2, bytes_per_s=123.0)
+    bulk_arb.ledger_exchange(kv)
+    assert bulk_arb.tenant(2).bucket.rate == pytest.approx(123.0)
+    assert not bulk_arb.tenant(2).auto_rate
+
+    # telemetry: the ledger rides the snapshot
+    snap = serve_arb.snapshot()
+    assert snap["ledger"]["process"] == "proc-serve"
+    assert snap["ledger"]["peers"] == 1
+    assert snap["ledger"]["exchanges"] >= 20
+
+
+def test_ledger_env_arming_and_facade_exchange(monkeypatch):
+    """ACCL_ARBITER_LEDGER arms the registry only on tiers whose engine
+    exposes a KV plane; the emulator has none, so the facade stays
+    local-only and the public exchange is a clean no-op."""
+    from accl_tpu.arbiter import env_ledger
+
+    assert not env_ledger({})
+    assert env_ledger({"ACCL_ARBITER_LEDGER": "1"})
+    assert not env_ledger({"ACCL_ARBITER_LEDGER": "0"})
+
+    monkeypatch.setenv("ACCL_ARBITER_LEDGER", "1")
+    group = emulated_group(2)
+    try:
+        for a in group:
+            assert a._arbiter.ledger is None
+            assert a.arbiter_ledger_exchange() is None
+    finally:
+        _deinit(group)
+    # the dist tier's engine DOES expose the plane the facade arms on
+    from accl_tpu.backends.dist.engine import DistEngine
+
+    assert hasattr(DistEngine, "arbiter_kv")
